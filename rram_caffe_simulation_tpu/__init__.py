@@ -1,0 +1,21 @@
+"""rram_caffe_simulation_tpu: a TPU-native (JAX/XLA/Pallas) re-design of the
+RRAM-fault-simulating Caffe fork `fightingnoble/rram-caffe-simulation`.
+
+Capability map (reference paths are relative to the reference repo):
+- proto/    wire-compatible config & serialization schema (src/caffe/proto/caffe.proto)
+- core/     fillers, parameter metadata, layer registry (filler.hpp, layer_factory.*)
+- ops/      pure-JAX layer implementations (src/caffe/layers/*)
+- net/      prototxt graph -> pure init/apply functions (src/caffe/net.cpp)
+- solver/   Caffe-exact SGD-family solvers + train loop (src/caffe/solver*.cpp)
+- fault/    RRAM cell-endurance fault engine + mitigation strategies
+            (src/caffe/failure_maker.*, src/caffe/strategy.*)
+- data/     host data pipeline (src/caffe/data_*, util/db*)
+- parallel/ mesh-based data/config parallelism (src/caffe/parallel.*)
+- utils/    io, snapshots, logging, timing (src/caffe/util/*)
+- models/   prototxt model zoo (models/, examples/)
+- tools/    CLI and experiment harness (tools/caffe.cpp, examples/cifar10/gaussian_failure)
+"""
+
+__version__ = "0.1.0"
+
+from .proto import pb  # noqa: F401

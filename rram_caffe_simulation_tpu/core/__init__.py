@@ -1,0 +1,2 @@
+from .fillers import make_filler  # noqa: F401
+from .registry import LAYER_REGISTRY, register_layer, create_layer  # noqa: F401

@@ -1,0 +1,93 @@
+"""Weight initializers ("fillers") with Caffe-equivalent semantics.
+
+Reference: include/caffe/filler.hpp:31-290 (ConstantFiller, UniformFiller,
+GaussianFiller incl. sparse mode, PositiveUnitballFiller, XavierFiller,
+MSRAFiller, BilinearFiller, GetFiller).
+
+Each filler is a pure function of a jax PRNG key and a shape; fan_in/fan_out
+follow Caffe's convention: for a blob of shape (d0, d1, ..., dn),
+fan_in = count / d0 and fan_out = count / d1 (filler.hpp:136-160).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape) -> tuple[float, float]:
+    count = int(np.prod(shape))
+    fan_in = count / shape[0] if len(shape) >= 1 else count
+    fan_out = count / shape[1] if len(shape) >= 2 else count
+    return fan_in, fan_out
+
+
+def _scale_n(filler, fan_in: float, fan_out: float) -> float:
+    vn = filler.variance_norm
+    from ..proto import pb
+    if vn == pb.FillerParameter.AVERAGE:
+        return (fan_in + fan_out) / 2.0
+    if vn == pb.FillerParameter.FAN_OUT:
+        return fan_out
+    return fan_in
+
+
+def make_filler(filler_param, dtype=jnp.float32):
+    """Return fill(key, shape) -> array for a FillerParameter."""
+    f = filler_param
+    ftype = f.type
+
+    if ftype == "constant":
+        def fill(key, shape):
+            return jnp.full(shape, f.value, dtype=dtype)
+    elif ftype == "uniform":
+        def fill(key, shape):
+            return jax.random.uniform(key, shape, dtype=dtype,
+                                      minval=f.min, maxval=f.max)
+    elif ftype == "gaussian":
+        def fill(key, shape):
+            kg, ks = jax.random.split(key)
+            x = f.mean + f.std * jax.random.normal(kg, shape, dtype=dtype)
+            if f.sparse >= 0:
+                # Bernoulli mask with p = sparse / fan_in keeps roughly
+                # `sparse` nonzeros per output (filler.hpp:92-117).
+                fan_in, _ = _fans(shape)
+                p = min(1.0, f.sparse / max(fan_in, 1.0))
+                mask = jax.random.bernoulli(ks, p, shape)
+                x = jnp.where(mask, x, 0.0)
+            return x
+    elif ftype == "positive_unitball":
+        def fill(key, shape):
+            x = jax.random.uniform(key, shape, dtype=dtype)
+            flat = x.reshape(shape[0], -1)
+            flat = flat / jnp.sum(flat, axis=1, keepdims=True)
+            return flat.reshape(shape)
+    elif ftype == "xavier":
+        def fill(key, shape):
+            fan_in, fan_out = _fans(shape)
+            scale = math.sqrt(3.0 / _scale_n(f, fan_in, fan_out))
+            return jax.random.uniform(key, shape, dtype=dtype,
+                                      minval=-scale, maxval=scale)
+    elif ftype == "msra":
+        def fill(key, shape):
+            fan_in, fan_out = _fans(shape)
+            std = math.sqrt(2.0 / _scale_n(f, fan_in, fan_out))
+            return std * jax.random.normal(key, shape, dtype=dtype)
+    elif ftype == "bilinear":
+        def fill(key, shape):
+            # Deterministic upsampling kernel (filler.hpp:213-246); blob must
+            # be 4-D with square spatial dims.
+            assert len(shape) == 4 and shape[2] == shape[3], \
+                "bilinear filler needs a square 4-D blob"
+            k = shape[3]
+            fac = (k + 1) // 2
+            center = fac - 1.0 if k % 2 == 1 else fac - 0.5
+            coords = np.arange(k, dtype=np.float64)
+            w1d = 1.0 - np.abs(coords - center) / fac
+            w2d = np.outer(w1d, w1d)
+            return jnp.broadcast_to(jnp.asarray(w2d, dtype=dtype), shape)
+    else:
+        raise ValueError(f"Unknown filler type: {ftype!r}")
+    return fill

@@ -1,0 +1,118 @@
+"""Layer contract and registry.
+
+Reference: include/caffe/layer.hpp:33 (Layer base: SetUp -> LayerSetUp/Reshape,
+Forward/Backward dispatch, owned param blobs) and layer_factory.hpp:56-137
+(LayerRegistry / REGISTER_LAYER_CLASS). The TPU design replaces the
+CPU/GPU virtual-dispatch pair with a single pure `apply` traced by XLA;
+`Backward` has no hand-written counterpart because `jax.grad` differentiates
+`apply` directly. Engine selection (Caffe vs cuDNN, layer_factory.cpp:38-230)
+collapses: every engine value lowers to the same XLA op.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+LAYER_REGISTRY: dict[str, type] = {}
+
+
+def register_layer(name: str) -> Callable[[type], type]:
+    def wrap(cls: type) -> type:
+        if name in LAYER_REGISTRY:
+            raise KeyError(f"Layer type {name!r} registered twice")
+        LAYER_REGISTRY[name] = cls
+        cls.type_name = name
+        return cls
+    return wrap
+
+
+def create_layer(layer_param, phase: int) -> "Layer":
+    """String->layer creation (reference layer_factory.hpp:75 CreateLayer)."""
+    t = layer_param.type
+    if t not in LAYER_REGISTRY:
+        raise KeyError(
+            f"Unknown layer type {t!r} (layer {layer_param.name!r}); "
+            f"registered: {sorted(LAYER_REGISTRY)}")
+    return LAYER_REGISTRY[t](layer_param, phase)
+
+
+@dataclasses.dataclass
+class LayerContext:
+    """Trace-time context threaded through every layer apply.
+
+    phase is static (it selects the traced branch, like Caffe's per-net
+    Phase); rng is a traced PRNG key consumed by stochastic layers
+    (Dropout, stochastic pooling, DummyData gaussian fillers).
+    """
+    phase: int  # pb.TRAIN or pb.TEST
+    rng: Optional[jax.Array] = None
+    # Net-level iteration counter, traced; used by BatchNorm moving averages.
+    iteration: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Learnable-parameter metadata (reference ParamSpec message + Net's
+    AppendParam bookkeeping, net.cpp:451-540)."""
+    name: str = ""
+    lr_mult: float = 1.0
+    decay_mult: float = 1.0
+
+
+class Layer:
+    """Base layer. Subclasses implement setup/init_params/apply.
+
+    Lifecycle: __init__(layer_param, phase) stores config; setup(bottom_shapes)
+    resolves static shape info and returns top shapes; init_params(key) draws
+    initial parameter arrays; apply(params, bottoms, ctx) is the pure traced
+    computation returning (tops, new_params_or_None). new_params carries
+    forward-pass state updates (BatchNorm moving stats) — the functional
+    replacement for Caffe layers mutating their own blobs_ during Forward.
+    """
+
+    type_name = "?"
+    # Data-source layers produce tops from the host pipeline, not bottoms.
+    is_data_source = False
+
+    def __init__(self, layer_param, phase: int):
+        self.lp = layer_param
+        self.phase = phase
+        self.name = layer_param.name
+        self.top_shapes: list[tuple[int, ...]] = []
+
+    # --- static setup ---------------------------------------------------
+    def setup(self, bottom_shapes: Sequence[tuple[int, ...]]) -> list[tuple[int, ...]]:
+        raise NotImplementedError
+
+    def init_params(self, key) -> list[Any]:
+        return []
+
+    def param_specs(self) -> list[ParamSpec]:
+        """One spec per param blob; pads/truncates lp.param like Caffe."""
+        n = self.num_params()
+        specs = []
+        for i in range(n):
+            if i < len(self.lp.param):
+                p = self.lp.param[i]
+                specs.append(ParamSpec(name=p.name, lr_mult=p.lr_mult,
+                                       decay_mult=p.decay_mult))
+            else:
+                specs.append(ParamSpec())
+        return specs
+
+    def num_params(self) -> int:
+        return 0
+
+    # --- traced computation ---------------------------------------------
+    def apply(self, params: Sequence[Any], bottoms: Sequence[Any],
+              ctx: LayerContext):
+        raise NotImplementedError
+
+    # --- loss plumbing (reference layer.hpp:99 ExactNumTopBlobs etc.) ----
+    def default_loss_weight(self, top_index: int) -> float:
+        return 0.0
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"

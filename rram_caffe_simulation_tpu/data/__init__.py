@@ -1,0 +1,7 @@
+"""Host data pipeline (reference: src/caffe/data_reader.*, data_transformer.*,
+util/db*, layers/base_data_layer.*).
+
+The reference's 3-thread pipeline (DataReader thread -> prefetch thread ->
+Forward pop) becomes a host-side iterator + double-buffered async
+jax.device_put; see loaders.py.
+"""

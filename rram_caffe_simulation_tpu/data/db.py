@@ -1,0 +1,50 @@
+"""LMDB/LevelDB Datum database access (reference: src/caffe/util/db_lmdb.cpp,
+db_leveldb.cpp, data_reader.cpp).
+
+This environment ships no lmdb/leveldb bindings; access is gated behind a
+clear error until a pure-python reader lands. Datum decode itself
+(datum_to_array) is self-contained and used by the converters/tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..proto import pb
+
+
+def datum_to_array(datum: "pb.Datum") -> tuple[np.ndarray, int]:
+    """Decode a serialized Datum into (C,H,W) uint8/float array + label
+    (reference data_transformer.cpp Transform(Datum) input handling)."""
+    shape = (datum.channels, datum.height, datum.width)
+    if datum.data:
+        arr = np.frombuffer(datum.data, dtype=np.uint8).reshape(shape)
+    else:
+        arr = np.asarray(datum.float_data, dtype=np.float32).reshape(shape)
+    return arr, datum.label
+
+
+def array_to_datum(arr: np.ndarray, label: int = 0) -> "pb.Datum":
+    d = pb.Datum(channels=arr.shape[0], height=arr.shape[1],
+                 width=arr.shape[2], label=int(label))
+    if arr.dtype == np.uint8:
+        d.data = arr.tobytes()
+    else:
+        d.float_data.extend(np.asarray(arr, np.float32).reshape(-1).tolist())
+    return d
+
+
+def open_db(source: str, backend):
+    try:
+        import lmdb  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            f"Datum DB source {source!r}: no lmdb/leveldb bindings in this "
+            "environment. Use Input/MemoryData/HDF5Data layers or the "
+            "ndarray dataset loaders in rram_caffe_simulation_tpu.data."
+        ) from None
+    raise NotImplementedError("LMDB cursor support pending")
+
+
+def infer_datum_shape(source: str, backend) -> tuple[int, int, int]:
+    db = open_db(source, backend)
+    raise NotImplementedError  # unreachable until open_db works

@@ -1,0 +1,35 @@
+"""Image-file ingestion for ImageDataLayer (reference:
+src/caffe/layers/image_data_layer.cpp, util/io.cpp ReadImageToDatum).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_image(path: str, color: bool = True, new_height: int = 0,
+               new_width: int = 0) -> np.ndarray:
+    """Load an image file to a (C,H,W) uint8 array (BGR channel order to
+    match Caffe/OpenCV conventions)."""
+    try:
+        from PIL import Image
+    except ImportError:
+        raise NotImplementedError(
+            "ImageData requires PIL, which this environment lacks") from None
+    img = Image.open(path)
+    img = img.convert("RGB" if color else "L")
+    if new_height > 0 and new_width > 0:
+        img = img.resize((new_width, new_height), Image.BILINEAR)
+    arr = np.asarray(img, dtype=np.uint8)
+    if color:
+        arr = arr[:, :, ::-1]  # RGB -> BGR like OpenCV
+        return arr.transpose(2, 0, 1)
+    return arr[None]
+
+
+def infer_image_shape(image_data_param) -> tuple[int, int, int]:
+    ip = image_data_param
+    with open(ip.source) as f:
+        first = f.readline().split()[0]
+    path = (ip.root_folder or "") + first
+    arr = load_image(path, ip.is_color, ip.new_height, ip.new_width)
+    return arr.shape

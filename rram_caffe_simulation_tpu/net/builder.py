@@ -1,0 +1,317 @@
+"""Net: prototxt graph -> pure functional init/apply.
+
+Reference: src/caffe/net.cpp — Init (net.cpp:49), FilterNet/StateMeetsRule
+(net.cpp:289,319), AppendTop/AppendBottom/AppendParam (net.cpp:386,426,451),
+ForwardFromTo (net.cpp:559), CopyTrainedLayersFrom (net.cpp:765), and the
+fork's failure-param bookkeeping (net.cpp:482-493).
+
+TPU design: the serial layer loop becomes a single pure function
+`apply(params, batch, ...)` traced and fused by XLA. InsertSplits
+(util/insert_splits.cpp) is unnecessary — autodiff already sums gradients of
+multi-consumer blobs. Parameter sharing is an indirection table resolved at
+build time, so shared params exist once in the pytree.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import LayerContext, create_layer
+from .. import ops  # noqa: F401  (importing ops registers every layer type)
+from ..proto import pb
+from ..utils.io import blob_to_array
+
+
+@dataclasses.dataclass
+class ParamRef:
+    """One learnable parameter slot, in Caffe's learnable_params_ order."""
+    layer_name: str
+    slot: int            # index within the layer's param list
+    owner_layer: str     # == layer_name unless shared
+    owner_slot: int
+    name: str            # ParamSpec name ('' if anonymous)
+    lr_mult: float
+    decay_mult: float
+    shape: tuple
+    fault_target: bool   # True for params of RRAM-fault-prone layers
+
+    @property
+    def key(self) -> tuple:
+        return (self.owner_layer, self.owner_slot)
+
+
+def state_meets_rule(state: "pb.NetState", rule: "pb.NetStateRule") -> bool:
+    """Reference net.cpp:319 StateMeetsRule."""
+    if rule.HasField("phase") and rule.phase != state.phase:
+        return False
+    if rule.HasField("min_level") and state.level < rule.min_level:
+        return False
+    if rule.HasField("max_level") and state.level > rule.max_level:
+        return False
+    stages = set(state.stage)
+    for s in rule.stage:
+        if s not in stages:
+            return False
+    for s in rule.not_stage:
+        if s in stages:
+            return False
+    return True
+
+
+def filter_net(net_param: "pb.NetParameter", state: "pb.NetState") -> "pb.NetParameter":
+    """Reference net.cpp:289 FilterNet."""
+    out = pb.NetParameter()
+    out.CopyFrom(net_param)
+    del out.layer[:]
+    for lp in net_param.layer:
+        assert not (lp.include and lp.exclude), \
+            f"layer {lp.name}: specify include or exclude rules, not both"
+        if lp.include:
+            keep = any(state_meets_rule(state, r) for r in lp.include)
+        else:
+            keep = not any(state_meets_rule(state, r) for r in lp.exclude)
+        if keep:
+            out.layer.add().CopyFrom(lp)
+    return out
+
+
+def _upgrade_legacy_inputs(net_param: "pb.NetParameter") -> None:
+    """Rewrite deprecated NetParameter.input/input_shape/input_dim into an
+    Input layer (reference util/upgrade_proto.cpp UpgradeNetInput)."""
+    if not net_param.input:
+        return
+    lp = pb.LayerParameter(name="input", type="Input")
+    lp.top.extend(net_param.input)
+    for i in range(len(net_param.input)):
+        shape = lp.input_param.shape.add()
+        if net_param.input_shape:
+            src = net_param.input_shape[min(i, len(net_param.input_shape) - 1)]
+            shape.dim.extend(src.dim)
+        else:
+            shape.dim.extend(net_param.input_dim[4 * i: 4 * i + 4])
+    # prepend
+    layers = list(net_param.layer)
+    del net_param.layer[:]
+    net_param.layer.add().CopyFrom(lp)
+    for l in layers:
+        net_param.layer.add().CopyFrom(l)
+    del net_param.input[:]
+    del net_param.input_shape[:]
+    del net_param.input_dim[:]
+
+
+class Net:
+    """Functional network built from a NetParameter.
+
+    params pytree layout: {layer_name: [jnp.ndarray, ...]} containing only
+    owner layers' blobs. apply() threads blobs through the layer sequence in
+    prototxt order (identical to ForwardFromTo's serial schedule, which XLA
+    then fuses/reorders freely).
+    """
+
+    def __init__(self, net_param: "pb.NetParameter", phase: int,
+                 stages=(), level: int = 0):
+        # Constructor args are authoritative over NetParameter.state, matching
+        # the reference Net constructor which force-sets phase/level/stages
+        # onto param.state before Init (net.cpp:26-44).
+        state = pb.NetState()
+        if net_param.HasField("state"):
+            state.CopyFrom(net_param.state)
+        state.phase = phase
+        state.level = level
+        state.stage.extend(s for s in stages if s not in state.stage)
+        net_param = pb.NetParameter.FromString(net_param.SerializeToString())
+        _upgrade_legacy_inputs(net_param)
+        self.param_proto = filter_net(net_param, state)
+        self.name = net_param.name
+        self.phase = int(state.phase)
+
+        self.layers = []                 # Layer objects, in order
+        self.layer_by_name = {}
+        self.blob_shapes: dict[str, tuple] = {}
+        self.data_source_tops: dict[str, tuple] = {}  # tops fed from host
+        self.loss_weights: dict[str, float] = {}      # blob -> weight
+        self._build()
+
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        produced: dict[str, tuple] = {}
+        consumed: set[str] = set()
+        self.learnable_params: list[ParamRef] = []
+        shared_by_name: dict[str, tuple] = {}  # ParamSpec.name -> (layer, slot, shape)
+        self._layer_slots: dict[str, list[tuple[str, int]]] = {}
+
+        for lp in self.param_proto.layer:
+            layer = create_layer(lp, self.phase)
+            if lp.name in self.layer_by_name:
+                raise ValueError(f"duplicate layer name {lp.name!r}")
+            bottom_shapes = []
+            for b in lp.bottom:
+                if b not in produced:
+                    raise ValueError(
+                        f"layer {lp.name!r}: unknown bottom blob {b!r}")
+                bottom_shapes.append(produced[b])
+                consumed.add(b)
+            top_shapes = layer.setup(bottom_shapes)
+            for t, shape in zip(lp.top, top_shapes):
+                produced[t] = tuple(shape)
+            if layer.is_data_source:
+                for t, shape in zip(lp.top, top_shapes):
+                    self.data_source_tops[t] = tuple(shape)
+            # loss weights (reference net.cpp AppendTop loss_weight handling)
+            for i, t in enumerate(lp.top):
+                w = (lp.loss_weight[i] if i < len(lp.loss_weight)
+                     else layer.default_loss_weight(i))
+                if w != 0.0:
+                    self.loss_weights[t] = self.loss_weights.get(t, 0.0) + w
+
+            # parameter table with sharing (reference net.cpp:451 AppendParam)
+            specs = layer.param_specs()
+            slots = []
+            for slot, spec in enumerate(specs):
+                shape = None  # filled after init; use placeholder from layer
+                if spec.name and spec.name in shared_by_name:
+                    owner_layer, owner_slot, owner_shape = shared_by_name[spec.name]
+                    slots.append((owner_layer, owner_slot))
+                    ref = ParamRef(lp.name, slot, owner_layer, owner_slot,
+                                   spec.name, spec.lr_mult, spec.decay_mult,
+                                   owner_shape,
+                                   getattr(layer, "fault_target", False))
+                else:
+                    slots.append((lp.name, slot))
+                    ref = ParamRef(lp.name, slot, lp.name, slot,
+                                   spec.name, spec.lr_mult, spec.decay_mult,
+                                   (), getattr(layer, "fault_target", False))
+                    if spec.name:
+                        shared_by_name[spec.name] = (lp.name, slot, ())
+                self.learnable_params.append(ref)
+            self._layer_slots[lp.name] = slots
+
+            self.layers.append(layer)
+            self.layer_by_name[lp.name] = layer
+
+        self.blob_shapes = produced
+        self.output_names = [b for b in produced if b not in consumed]
+
+        # Fork bookkeeping (reference net.cpp:482-493): failure-prone params
+        # are ALL params of fault-target layers (InnerProduct), and
+        # fc_params_ids_ indexes their 2-D weight matrices within that list.
+        self.failure_param_refs = [r for r in self.learnable_params
+                                   if r.fault_target and r.key == (r.layer_name, r.slot)]
+        self.fc_params_ids = []
+        for i, r in enumerate(self.failure_param_refs):
+            layer = self.layer_by_name[r.layer_name]
+            if r.slot == 0:  # the weight matrix
+                self.fc_params_ids.append(i)
+
+    # ------------------------------------------------------------------
+    def init(self, key) -> dict[str, list[Any]]:
+        """Draw initial parameters (fillers), or load from inline lp.blobs."""
+        params: dict[str, list[Any]] = {}
+        for layer in self.layers:
+            n = layer.num_params()
+            if n == 0:
+                continue
+            slots = self._layer_slots[layer.name]
+            owns = [i for i in range(n) if slots[i] == (layer.name, i)]
+            if not owns:
+                continue
+            key, sub = jax.random.split(key)
+            if layer.lp.blobs:
+                blobs = [jnp.asarray(blob_to_array(b)) for b in layer.lp.blobs]
+            else:
+                blobs = layer.init_params(sub)
+            params[layer.name] = [blobs[i] for i in range(n)]
+            # keep only owned slots (shared non-owner slots resolve elsewhere)
+            if len(owns) != n:
+                params[layer.name] = [blobs[i] if i in owns else None
+                                      for i in range(n)]
+        # record shapes on the param table
+        for ref in self.learnable_params:
+            arr = params.get(ref.owner_layer)
+            if arr is not None and arr[ref.owner_slot] is not None:
+                ref.shape = tuple(arr[ref.owner_slot].shape)
+        return params
+
+    def _gather_layer_params(self, params, layer) -> list[Any]:
+        slots = self._layer_slots[layer.name]
+        return [params[owner][slot] for owner, slot in slots]
+
+    # ------------------------------------------------------------------
+    def apply(self, params, batch: Optional[dict] = None, rng=None,
+              iteration=None, with_updates: bool = False):
+        """Run the net. Returns (blobs, loss) or (blobs, loss, new_params)
+        when with_updates (BatchNorm moving stats) is requested.
+        """
+        batch = batch or {}
+        ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration)
+        blobs: dict[str, Any] = {}
+        for name, shape in self.data_source_tops.items():
+            if name not in batch:
+                raise ValueError(f"batch missing data blob {name!r}")
+            blobs[name] = batch[name]
+        updates: dict[str, list] = {}
+        for layer in self.layers:
+            if layer.is_data_source:
+                continue
+            bottoms = [blobs[b] for b in layer.lp.bottom]
+            lparams = self._gather_layer_params(params, layer)
+            tops, new_params = layer.apply(lparams, bottoms, ctx)
+            if new_params is not None:
+                updates[layer.name] = new_params
+            for t, v in zip(layer.lp.top, tops):
+                blobs[t] = v
+        loss = jnp.asarray(0.0, dtype=jnp.float32)
+        for blob_name, w in self.loss_weights.items():
+            loss = loss + w * jnp.sum(blobs[blob_name])
+        if with_updates:
+            new_params = {ln: list(vals) for ln, vals in params.items()}
+            for ln, vals in updates.items():
+                new_params[ln] = vals
+            return blobs, loss, new_params
+        return blobs, loss
+
+    # ------------------------------------------------------------------
+    def copy_trained_from(self, params, source) -> dict[str, list[Any]]:
+        """Name-matched weight loading (reference net.cpp:765
+        CopyTrainedLayersFrom). `source` is a NetParameter with blobs (from a
+        .caffemodel) or a path. Returns updated params."""
+        from ..utils.io import read_net_param
+        if isinstance(source, str):
+            source = read_net_param(source)
+        params = {ln: list(v) for ln, v in params.items()}
+        for lp in source.layer:
+            if lp.name not in self.layer_by_name or not lp.blobs:
+                continue
+            layer = self.layer_by_name[lp.name]
+            target = params.get(lp.name)
+            if target is None:
+                continue
+            for i, b in enumerate(lp.blobs):
+                if i >= len(target) or target[i] is None:
+                    continue
+                arr = blob_to_array(b)
+                if tuple(arr.shape) != tuple(np.shape(target[i])):
+                    arr = arr.reshape(np.shape(target[i]))
+                target[i] = jnp.asarray(arr)
+            params[lp.name] = target
+        return params
+
+    def to_proto(self, params, write_diff: bool = False) -> "pb.NetParameter":
+        """Serialize layer definitions + current weights (reference
+        net.cpp ToProto)."""
+        from ..utils.io import array_to_blob
+        out = pb.NetParameter(name=self.name or "")
+        for layer in self.layers:
+            lp = out.layer.add()
+            lp.CopyFrom(layer.lp)
+            del lp.blobs[:]
+            if layer.name in params:
+                for arr in params[layer.name]:
+                    if arr is not None:
+                        array_to_blob(np.asarray(arr), lp.blobs.add())
+        return out

@@ -1,0 +1,9 @@
+"""Pure-JAX layer implementations (reference: src/caffe/layers/*).
+
+Importing this package registers every layer type with the LAYER_REGISTRY.
+"""
+from . import data_layers  # noqa: F401
+from . import vision  # noqa: F401
+from . import common  # noqa: F401
+from . import neuron  # noqa: F401
+from . import losses  # noqa: F401

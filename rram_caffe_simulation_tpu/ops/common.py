@@ -1,0 +1,447 @@
+"""Common compute/structural layers (reference: src/caffe/layers/
+{inner_product,eltwise,concat,slice,flatten,reshape,split,silence,tile,bias,
+scale,embed,reduction,argmax,batch_reindex,filter,parameter}_layer.*).
+
+InnerProductLayer is the RRAM fault target in the reference (net.cpp:482-493
+collects its params into failure_learnable_params_); here the net builder
+does the same bookkeeping over this registry's `fault_target` flag.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+from ._util import flat_shape_from
+
+
+@register_layer("InnerProduct")
+class InnerProductLayer(Layer):
+    """Fully connected: y = x . W^T (+ b). Reference
+    inner_product_layer.cpp:84-139. Weight shape (num_output, K) exactly as
+    Caffe stores it, so .caffemodel weights load without transposition."""
+
+    fault_target = True  # reference net.cpp:485: InnerProduct params are
+    # the RRAM failure-prone set
+
+    def setup(self, bottom_shapes):
+        ip = self.lp.inner_product_param
+        self.num_output = ip.num_output
+        self.bias_term = ip.bias_term
+        self.transpose = ip.transpose
+        self.axis = ip.axis % len(bottom_shapes[0])
+        outer, inner = flat_shape_from(bottom_shapes[0], self.axis)
+        self.K = inner
+        self.weight_shape = ((self.K, self.num_output) if self.transpose
+                             else (self.num_output, self.K))
+        self.out_shape = tuple(bottom_shapes[0][:self.axis]) + (self.num_output,)
+        self.top_shapes = [self.out_shape]
+        return self.top_shapes
+
+    def num_params(self):
+        return 2 if self.bias_term else 1
+
+    def init_params(self, key):
+        ip = self.lp.inner_product_param
+        kw, kb = jax.random.split(key)
+        params = [make_filler(ip.weight_filler)(kw, self.weight_shape)]
+        if self.bias_term:
+            params.append(make_filler(ip.bias_filler)(kb, (self.num_output,)))
+        return params
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0].reshape((-1, self.K))
+        w = params[0]
+        y = jnp.dot(x, w if self.transpose else w.T,
+                    preferred_element_type=bottoms[0].dtype)
+        if self.bias_term:
+            y = y + params[1]
+        return [y.reshape(self.out_shape[:-1] + (self.num_output,))], None
+
+
+@register_layer("Embed")
+class EmbedLayer(Layer):
+    """Lookup-table forward of one-hot InnerProduct (reference
+    embed_layer.cpp). Weight shape (input_dim, num_output)."""
+
+    def setup(self, bottom_shapes):
+        ep = self.lp.embed_param
+        self.num_output = ep.num_output
+        self.input_dim = ep.input_dim
+        self.bias_term = ep.bias_term
+        self.top_shapes = [tuple(bottom_shapes[0]) + (self.num_output,)]
+        return self.top_shapes
+
+    def num_params(self):
+        return 2 if self.bias_term else 1
+
+    def init_params(self, key):
+        ep = self.lp.embed_param
+        kw, kb = jax.random.split(key)
+        params = [make_filler(ep.weight_filler)(
+            kw, (self.input_dim, self.num_output))]
+        if self.bias_term:
+            params.append(make_filler(ep.bias_filler)(kb, (self.num_output,)))
+        return params
+
+    def apply(self, params, bottoms, ctx):
+        ids = bottoms[0].astype(jnp.int32)
+        y = jnp.take(params[0], ids, axis=0)
+        if self.bias_term:
+            y = y + params[1]
+        return [y], None
+
+
+@register_layer("Eltwise")
+class EltwiseLayer(Layer):
+    """PROD / SUM(coeff) / MAX over k bottoms (reference eltwise_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        ep = self.lp.eltwise_param
+        self.op = ep.operation
+        self.coeffs = list(ep.coeff) or [1.0] * len(bottom_shapes)
+        assert len(self.coeffs) == len(bottom_shapes)
+        self.top_shapes = [tuple(bottom_shapes[0])]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        if self.op == pb.EltwiseParameter.PROD:
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = y * b
+        elif self.op == pb.EltwiseParameter.SUM:
+            y = self.coeffs[0] * bottoms[0]
+            for c, b in zip(self.coeffs[1:], bottoms[1:]):
+                y = y + c * b
+        else:  # MAX
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = jnp.maximum(y, b)
+        return [y], None
+
+
+@register_layer("Concat")
+class ConcatLayer(Layer):
+    def setup(self, bottom_shapes):
+        cp = self.lp.concat_param
+        self.axis = (cp.axis if cp.HasField("axis") or not cp.HasField("concat_dim")
+                     else cp.concat_dim) % len(bottom_shapes[0])
+        out = list(bottom_shapes[0])
+        out[self.axis] = sum(s[self.axis] for s in bottom_shapes)
+        self.top_shapes = [tuple(out)]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        return [jnp.concatenate(bottoms, axis=self.axis)], None
+
+
+@register_layer("Slice")
+class SliceLayer(Layer):
+    def setup(self, bottom_shapes):
+        sp = self.lp.slice_param
+        self.axis = (sp.axis if sp.HasField("axis") or not sp.HasField("slice_dim")
+                     else sp.slice_dim) % len(bottom_shapes[0])
+        total = bottom_shapes[0][self.axis]
+        n_top = len(self.lp.top)
+        points = list(sp.slice_point)
+        if points:
+            assert len(points) == n_top - 1
+            bounds = [0] + points + [total]
+        else:
+            assert total % n_top == 0
+            step = total // n_top
+            bounds = list(range(0, total + 1, step))
+        self.sections = bounds[1:-1]
+        self.top_shapes = []
+        for i in range(n_top):
+            s = list(bottom_shapes[0])
+            s[self.axis] = bounds[i + 1] - bounds[i]
+            self.top_shapes.append(tuple(s))
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        return list(jnp.split(bottoms[0], self.sections, axis=self.axis)), None
+
+
+@register_layer("Split")
+class SplitLayer(Layer):
+    """Fan a blob to k consumers. In the functional graph this is a pure copy
+    (autodiff sums gradients automatically, which was the entire purpose of
+    the reference's InsertSplits rewrite, util/insert_splits.cpp:12)."""
+
+    def setup(self, bottom_shapes):
+        self.top_shapes = [tuple(bottom_shapes[0])] * len(self.lp.top)
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        return [bottoms[0]] * len(self.top_shapes), None
+
+
+@register_layer("Silence")
+class SilenceLayer(Layer):
+    def setup(self, bottom_shapes):
+        self.top_shapes = []
+        return []
+
+    def apply(self, params, bottoms, ctx):
+        return [], None
+
+
+@register_layer("Flatten")
+class FlattenLayer(Layer):
+    def setup(self, bottom_shapes):
+        fp = self.lp.flatten_param
+        s = bottom_shapes[0]
+        a = fp.axis % len(s)
+        e = fp.end_axis % len(s)
+        mid = int(np.prod(s[a:e + 1]))
+        self.out_shape = tuple(s[:a]) + (mid,) + tuple(s[e + 1:])
+        self.top_shapes = [self.out_shape]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        return [bottoms[0].reshape(self.out_shape)], None
+
+
+@register_layer("Reshape")
+class ReshapeLayer(Layer):
+    """Reference reshape_layer.cpp: dims of 0 copy the bottom dim, one -1
+    infers; axis/num_axes restrict the replaced span."""
+
+    def setup(self, bottom_shapes):
+        rp = self.lp.reshape_param
+        s = list(bottom_shapes[0])
+        a = rp.axis % (len(s) + 1) if rp.axis < 0 else rp.axis
+        n = len(s) - a if rp.num_axes == -1 else rp.num_axes
+        spec = list(rp.shape.dim)
+        new_mid = []
+        for i, d in enumerate(spec):
+            if d == 0:
+                new_mid.append(s[a + i])
+            else:
+                new_mid.append(int(d))
+        total_in = int(np.prod(s[a:a + n])) if n > 0 else 1
+        if -1 in new_mid:
+            known = int(np.prod([d for d in new_mid if d != -1]))
+            new_mid[new_mid.index(-1)] = total_in // known
+        self.out_shape = tuple(s[:a]) + tuple(new_mid) + tuple(s[a + n:])
+        self.top_shapes = [self.out_shape]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        return [bottoms[0].reshape(self.out_shape)], None
+
+
+@register_layer("Tile")
+class TileLayer(Layer):
+    def setup(self, bottom_shapes):
+        tp = self.lp.tile_param
+        self.axis = tp.axis % len(bottom_shapes[0])
+        self.tiles = tp.tiles
+        out = list(bottom_shapes[0])
+        out[self.axis] *= self.tiles
+        self.top_shapes = [tuple(out)]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        reps = [1] * bottoms[0].ndim
+        reps[self.axis] = self.tiles
+        return [jnp.tile(bottoms[0], reps)], None
+
+
+@register_layer("Bias")
+class BiasLayer(Layer):
+    """Add a (possibly learned) bias broadcast over trailing axes
+    (reference bias_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        bp = self.lp.bias_param
+        self.learned = len(bottom_shapes) == 1
+        s = bottom_shapes[0]
+        if self.learned:
+            axis = bp.axis % len(s)
+            num_axes = bp.num_axes
+            if num_axes == -1:
+                self.bias_shape = tuple(s[axis:])
+            else:
+                self.bias_shape = tuple(s[axis:axis + num_axes])
+            self.axis = axis
+        else:
+            self.bias_shape = tuple(bottom_shapes[1])
+            # find alignment axis: bias shape matches s[axis:axis+len]
+            self.axis = bp.axis % len(s)
+        self.bcast = ([1] * self.axis + list(self.bias_shape)
+                      + [1] * (len(s) - self.axis - len(self.bias_shape)))
+        self.top_shapes = [tuple(s)]
+        return self.top_shapes
+
+    def num_params(self):
+        return 1 if self.learned else 0
+
+    def init_params(self, key):
+        if not self.learned:
+            return []
+        return [make_filler(self.lp.bias_param.filler)(key, self.bias_shape)]
+
+    def apply(self, params, bottoms, ctx):
+        b = params[0] if self.learned else bottoms[1]
+        return [bottoms[0] + b.reshape(self.bcast)], None
+
+
+@register_layer("Scale")
+class ScaleLayer(Layer):
+    """Multiply by a (possibly learned) scale, with optional bias — the
+    affine half of Caffe BatchNorm+Scale pairs (reference scale_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        sp = self.lp.scale_param
+        self.learned = len(bottom_shapes) == 1
+        self.bias_term = sp.bias_term
+        s = bottom_shapes[0]
+        axis = sp.axis % len(s)
+        if self.learned:
+            if sp.num_axes == -1:
+                self.scale_shape = tuple(s[axis:])
+            else:
+                self.scale_shape = tuple(s[axis:axis + sp.num_axes])
+        else:
+            self.scale_shape = tuple(bottom_shapes[1])
+        self.axis = axis
+        self.bcast = ([1] * axis + list(self.scale_shape)
+                      + [1] * (len(s) - axis - len(self.scale_shape)))
+        self.top_shapes = [tuple(s)]
+        return self.top_shapes
+
+    def num_params(self):
+        n = 1 if self.learned else 0
+        if self.bias_term:
+            n += 1
+        return n
+
+    def init_params(self, key):
+        sp = self.lp.scale_param
+        ks, kb = jax.random.split(key)
+        params = []
+        if self.learned:
+            # Caffe defaults the scale filler to 1 when unset
+            # (scale_layer.cpp:39-47).
+            if sp.HasField("filler"):
+                params.append(make_filler(sp.filler)(ks, self.scale_shape))
+            else:
+                params.append(jnp.ones(self.scale_shape))
+        if self.bias_term:
+            params.append(make_filler(sp.bias_filler)(kb, self.scale_shape))
+        return params
+
+    def apply(self, params, bottoms, ctx):
+        if self.learned:
+            scale = params[0]
+            bias = params[1] if self.bias_term else None
+        else:
+            scale = bottoms[1]
+            bias = params[0] if self.bias_term else None
+        y = bottoms[0] * scale.reshape(self.bcast)
+        if bias is not None:
+            y = y + bias.reshape(self.bcast)
+        return [y], None
+
+
+@register_layer("Reduction")
+class ReductionLayer(Layer):
+    """SUM/ASUM/SUMSQ/MEAN over trailing axes (reference
+    reduction_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        rp = self.lp.reduction_param
+        self.op = rp.operation
+        self.coeff = rp.coeff
+        s = bottom_shapes[0]
+        self.axis = rp.axis % len(s)
+        self.top_shapes = [tuple(s[:self.axis])]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        flat = x.reshape(self.top_shapes[0] + (-1,))
+        if self.op == pb.ReductionParameter.SUM:
+            y = jnp.sum(flat, axis=-1)
+        elif self.op == pb.ReductionParameter.ASUM:
+            y = jnp.sum(jnp.abs(flat), axis=-1)
+        elif self.op == pb.ReductionParameter.SUMSQ:
+            y = jnp.sum(flat * flat, axis=-1)
+        else:  # MEAN
+            y = jnp.mean(flat, axis=-1)
+        return [y * self.coeff], None
+
+
+@register_layer("ArgMax")
+class ArgMaxLayer(Layer):
+    def setup(self, bottom_shapes):
+        ap = self.lp.argmax_param
+        self.top_k = ap.top_k
+        self.out_max_val = ap.out_max_val
+        self.has_axis = ap.HasField("axis")
+        s = bottom_shapes[0]
+        if self.has_axis:
+            self.axis = ap.axis % len(s)
+            out = list(s)
+            out[self.axis] = self.top_k
+            self.top_shapes = [tuple(out)]
+        else:
+            # legacy layout: (N, 1|2, top_k, 1)
+            ch = 2 if self.out_max_val else 1
+            self.top_shapes = [(s[0], ch, self.top_k, 1)]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.has_axis:
+            xm = jnp.moveaxis(x, self.axis, -1)
+            vals, idx = jax.lax.top_k(xm, self.top_k)
+            out = vals if self.out_max_val else idx.astype(x.dtype)
+            return [jnp.moveaxis(out, -1, self.axis)], None
+        flat = x.reshape(x.shape[0], -1)
+        vals, idx = jax.lax.top_k(flat, self.top_k)
+        idxf = idx.astype(x.dtype).reshape(x.shape[0], 1, self.top_k, 1)
+        if self.out_max_val:
+            valsf = vals.reshape(x.shape[0], 1, self.top_k, 1)
+            return [jnp.concatenate([idxf, valsf], axis=1)], None
+        return [idxf], None
+
+
+@register_layer("BatchReindex")
+class BatchReindexLayer(Layer):
+    """Gather batch items by an index bottom (reference
+    batch_reindex_layer.cpp). Output batch size must be static, so it comes
+    from the index bottom's shape."""
+
+    def setup(self, bottom_shapes):
+        self.n_out = bottom_shapes[1][0]
+        self.top_shapes = [(self.n_out,) + tuple(bottom_shapes[0][1:])]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        idx = bottoms[1].reshape(-1).astype(jnp.int32)
+        return [jnp.take(bottoms[0], idx, axis=0)], None
+
+
+@register_layer("Parameter")
+class ParameterLayer(Layer):
+    """Expose a learnable blob as a top (reference parameter_layer.hpp)."""
+
+    def setup(self, bottom_shapes):
+        self.shape = tuple(self.lp.parameter_param.shape.dim)
+        self.top_shapes = [self.shape]
+        return self.top_shapes
+
+    def num_params(self):
+        return 1
+
+    def init_params(self, key):
+        return [jnp.zeros(self.shape)]
+
+    def apply(self, params, bottoms, ctx):
+        return [params[0]], None

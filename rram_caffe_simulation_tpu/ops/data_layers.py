@@ -1,0 +1,186 @@
+"""Data/input layers (reference: src/caffe/layers/{base_data,data,image_data,
+hdf5_data,hdf5_output,memory_data,window_data,dummy_data,input}_layer.*).
+
+Design: in the functional graph, data-source layers declare top names and
+static shapes; actual batches are produced by the host pipeline
+(rram_caffe_simulation_tpu.data) and passed into Net.apply as a dict. This
+replaces the reference's 3-thread DataReader -> prefetch -> Forward_cpu
+pipeline (data_reader.cpp:73, base_data_layer.cpp:76-120) with a host-side
+iterator plus async jax.device_put. DummyData stays a traced generator so
+nets using it need no external input.
+"""
+from __future__ import annotations
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+
+
+class DataSourceLayer(Layer):
+    """Base for layers whose tops come from the host pipeline."""
+
+    is_data_source = True
+
+    def setup(self, bottom_shapes):
+        self.top_shapes = self.output_shapes()
+        return self.top_shapes
+
+    def output_shapes(self):
+        raise NotImplementedError
+
+    def apply(self, params, bottoms, ctx):
+        raise RuntimeError(
+            f"{self.type_name} tops must be fed via the batch dict")
+
+
+@register_layer("Input")
+class InputLayer(DataSourceLayer):
+    def output_shapes(self):
+        shapes = [tuple(int(d) for d in s.dim)
+                  for s in self.lp.input_param.shape]
+        n_top = len(self.lp.top)
+        if len(shapes) == 1 and n_top > 1:
+            shapes = shapes * n_top
+        assert len(shapes) == n_top, "Input needs one shape per top"
+        return shapes
+
+
+@register_layer("Data")
+class DataLayer(DataSourceLayer):
+    """LMDB/LevelDB-backed Datum stream (reference data_layer.cpp). Shapes
+    are inferred from the first record + transform_param, like
+    DataTransformer::InferBlobShape (data_transformer.cpp:100)."""
+
+    def output_shapes(self):
+        from ..data.db import infer_datum_shape
+        dp = self.lp.data_param
+        c, h, w = infer_datum_shape(dp.source, dp.backend)
+        crop = self.lp.transform_param.crop_size
+        if crop > 0:
+            h = w = crop
+        n = dp.batch_size
+        shapes = [(n, c, h, w)]
+        if len(self.lp.top) > 1:
+            shapes.append((n,))
+        return shapes
+
+
+@register_layer("ImageData")
+class ImageDataLayer(DataSourceLayer):
+    """File-list image stream (reference image_data_layer.cpp)."""
+
+    def output_shapes(self):
+        from ..data.image import infer_image_shape
+        ip = self.lp.image_data_param
+        c, h, w = infer_image_shape(ip)
+        crop = self.lp.transform_param.crop_size
+        if crop > 0:
+            h = w = crop
+        n = ip.batch_size
+        shapes = [(n, c, h, w)]
+        if len(self.lp.top) > 1:
+            shapes.append((n,))
+        return shapes
+
+
+@register_layer("HDF5Data")
+class HDF5DataLayer(DataSourceLayer):
+    """HDF5 dataset stream; tops are named datasets in file order
+    (reference hdf5_data_layer.cpp)."""
+
+    def output_shapes(self):
+        import h5py
+        hp = self.lp.hdf5_data_param
+        with open(hp.source) as f:
+            first = f.readline().strip()
+        shapes = []
+        with h5py.File(first, "r") as h5:
+            for top in self.lp.top:
+                ds = h5[top]
+                shapes.append((hp.batch_size,) + tuple(ds.shape[1:]))
+        return shapes
+
+
+@register_layer("MemoryData")
+class MemoryDataLayer(DataSourceLayer):
+    """In-memory arrays fed from the API (reference memory_data_layer.cpp)."""
+
+    def output_shapes(self):
+        mp = self.lp.memory_data_param
+        n = mp.batch_size
+        return [(n, mp.channels, mp.height, mp.width), (n,)]
+
+
+@register_layer("WindowData")
+class WindowDataLayer(DataSourceLayer):
+    """R-CNN window crops (reference window_data_layer.cpp)."""
+
+    def output_shapes(self):
+        wp = self.lp.window_data_param
+        crop = wp.crop_size
+        assert crop > 0, "WindowData requires crop_size"
+        return [(wp.batch_size, 3, crop, crop), (wp.batch_size,)]
+
+
+@register_layer("DummyData")
+class DummyDataLayer(Layer):
+    """Filler-generated tops, traced in-graph (reference
+    dummy_data_layer.cpp). Constant fillers refill every step exactly like
+    the reference's `refill_` logic; random fillers draw from ctx.rng."""
+
+    is_data_source = False  # generates its tops inside the traced graph
+
+    def setup(self, bottom_shapes):
+        dp = self.lp.dummy_data_param
+        n_top = len(self.lp.top)
+        if dp.shape:
+            shapes = [tuple(int(d) for d in s.dim) for s in dp.shape]
+        else:
+            shapes = [(dp.num[i], dp.channels[i], dp.height[i], dp.width[i])
+                      for i in range(len(dp.num))]
+        if len(shapes) == 1 and n_top > 1:
+            shapes = shapes * n_top
+        fillers = list(dp.data_filler)
+        if not fillers:
+            default = pb.FillerParameter()
+            fillers = [default] * n_top
+        elif len(fillers) == 1 and n_top > 1:
+            fillers = fillers * n_top
+        self.fillers = [make_filler(f) for f in fillers]
+        self.filler_types = [f.type for f in fillers]
+        self.top_shapes = shapes[:n_top]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        tops = []
+        for i, (fill, shape) in enumerate(zip(self.fillers, self.top_shapes)):
+            if self.filler_types[i] == "constant":
+                key = jax.random.PRNGKey(0)
+            else:
+                assert ctx.rng is not None, \
+                    "random DummyData fillers need a PRNG key"
+                key = jax.random.fold_in(
+                    ctx.rng,
+                    (zlib.crc32(self.name.encode()) + i) & 0x7FFFFFFF)
+            tops.append(fill(key, shape))
+        return tops, None
+
+
+@register_layer("HDF5Output")
+class HDF5OutputLayer(Layer):
+    """Sink layer: persists its bottoms to HDF5. In the traced graph it is a
+    no-op; the solver/CLI collects flagged blobs and writes them host-side
+    (reference hdf5_output_layer.cpp writes synchronously in Forward)."""
+
+    def setup(self, bottom_shapes):
+        self.file_name = self.lp.hdf5_output_param.file_name
+        self.top_shapes = []
+        return []
+
+    def apply(self, params, bottoms, ctx):
+        return [], None

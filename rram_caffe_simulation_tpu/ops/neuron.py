@@ -1,0 +1,165 @@
+"""Elementwise neuron layers (reference: src/caffe/layers/{relu,prelu,elu,
+sigmoid,tanh,absval,bnll,power,exp,log,threshold,dropout}_layer.*).
+
+All are trivially fused by XLA into neighboring matmuls/convs — the manual
+CUDA kernels of the reference collapse into jnp expressions.
+"""
+from __future__ import annotations
+
+import math
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+
+
+class _Elementwise(Layer):
+    def setup(self, bottom_shapes):
+        self.top_shapes = [tuple(bottom_shapes[0])]
+        return self.top_shapes
+
+
+@register_layer("ReLU")
+class ReLULayer(_Elementwise):
+    def setup(self, bottom_shapes):
+        self.negative_slope = self.lp.relu_param.negative_slope
+        return super().setup(bottom_shapes)
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.negative_slope:
+            return [jnp.where(x > 0, x, self.negative_slope * x)], None
+        return [jnp.maximum(x, 0)], None
+
+
+@register_layer("PReLU")
+class PReLULayer(_Elementwise):
+    """Learnable per-channel slope (reference prelu_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        pp = self.lp.prelu_param
+        self.channel_shared = pp.channel_shared
+        self.channels = bottom_shapes[0][1]
+        return super().setup(bottom_shapes)
+
+    def num_params(self):
+        return 1
+
+    def init_params(self, key):
+        shape = (1,) if self.channel_shared else (self.channels,)
+        pp = self.lp.prelu_param
+        if pp.HasField("filler"):
+            return [make_filler(pp.filler)(key, shape)]
+        return [jnp.full(shape, 0.25)]
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        a = params[0]
+        if not self.channel_shared:
+            a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, a * x)], None
+
+
+@register_layer("ELU")
+class ELULayer(_Elementwise):
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        alpha = self.lp.elu_param.alpha
+        return [jnp.where(x > 0, x, alpha * (jnp.exp(jnp.minimum(x, 0)) - 1))], None
+
+
+@register_layer("Sigmoid")
+class SigmoidLayer(_Elementwise):
+    def apply(self, params, bottoms, ctx):
+        return [jax.nn.sigmoid(bottoms[0])], None
+
+
+@register_layer("TanH")
+class TanHLayer(_Elementwise):
+    def apply(self, params, bottoms, ctx):
+        return [jnp.tanh(bottoms[0])], None
+
+
+@register_layer("AbsVal")
+class AbsValLayer(_Elementwise):
+    def apply(self, params, bottoms, ctx):
+        return [jnp.abs(bottoms[0])], None
+
+
+@register_layer("BNLL")
+class BNLLLayer(_Elementwise):
+    """log(1 + exp(x)), computed stably (reference bnll_layer.cpp:10-25)."""
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        return [jnp.maximum(x, 0) + jnp.log1p(jnp.exp(-jnp.abs(x)))], None
+
+
+@register_layer("Power")
+class PowerLayer(_Elementwise):
+    """(shift + scale * x) ^ power (reference power_layer.cpp)."""
+
+    def apply(self, params, bottoms, ctx):
+        pp = self.lp.power_param
+        y = pp.shift + pp.scale * bottoms[0]
+        if pp.power != 1.0:
+            y = jnp.power(y, pp.power)
+        return [y], None
+
+
+@register_layer("Exp")
+class ExpLayer(_Elementwise):
+    """base^(shift + scale*x); base -1 means e (reference exp_layer.cpp)."""
+
+    def apply(self, params, bottoms, ctx):
+        ep = self.lp.exp_param
+        inner = ep.shift + ep.scale * bottoms[0]
+        if ep.base == -1.0:
+            return [jnp.exp(inner)], None
+        return [jnp.exp(inner * math.log(ep.base))], None
+
+
+@register_layer("Log")
+class LogLayer(_Elementwise):
+    """log_base(shift + scale*x) (reference log_layer.cpp)."""
+
+    def apply(self, params, bottoms, ctx):
+        lp = self.lp.log_param
+        inner = lp.shift + lp.scale * bottoms[0]
+        y = jnp.log(inner)
+        if lp.base != -1.0:
+            y = y / math.log(lp.base)
+        return [y], None
+
+
+@register_layer("Threshold")
+class ThresholdLayer(_Elementwise):
+    def apply(self, params, bottoms, ctx):
+        t = self.lp.threshold_param.threshold
+        return [(bottoms[0] > t).astype(bottoms[0].dtype)], None
+
+
+@register_layer("Dropout")
+class DropoutLayer(_Elementwise):
+    """Inverted dropout: scale by 1/(1-ratio) at train, identity at test
+    (reference dropout_layer.cpp:30-60)."""
+
+    def setup(self, bottom_shapes):
+        self.ratio = self.lp.dropout_param.dropout_ratio
+        return super().setup(bottom_shapes)
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.phase != pb.TRAIN or self.ratio == 0.0:
+            return [x], None
+        assert ctx.rng is not None, "Dropout in TRAIN needs a PRNG key"
+        # crc32, not hash(): PYTHONHASHSEED randomizes hash() per process,
+        # which would break cross-process reproducibility of fault sweeps.
+        key = jax.random.fold_in(
+            ctx.rng, zlib.crc32(self.name.encode()) & 0x7FFFFFFF)
+        keep = jax.random.bernoulli(key, 1.0 - self.ratio, x.shape)
+        return [jnp.where(keep, x / (1.0 - self.ratio), 0.0).astype(x.dtype)], None

@@ -1,0 +1,409 @@
+"""Vision layers: Convolution, Deconvolution, Pooling, LRN, BatchNorm, MVN,
+Crop, Im2col (reference: src/caffe/layers/{base_conv,conv,deconv,pooling,lrn,
+batch_norm,mvn,crop,im2col}_layer.*).
+
+TPU design notes: Caffe lowers conv to im2col+GEMM by hand; here convolution
+is a single `lax.conv_general_dilated`, which XLA tiles directly onto the MXU
+— the entire im2col machinery (util/im2col.*) is subsumed. Blob layout keeps
+Caffe's NCHW semantics; XLA assigns physical TPU layouts itself.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+from ._util import (ave_pool_divisors, ceil_pad_hi, conv_spatial_params,
+                    pool_spatial_params, pooled_size)
+
+DIMNUMS_2D = ("NCHW", "OIHW", "NCHW")
+
+
+class _BaseConv(Layer):
+    """Shared setup for Convolution/Deconvolution
+    (reference base_conv_layer.cpp:17-200)."""
+
+    deconv = False
+
+    def setup(self, bottom_shapes):
+        cp = self.lp.convolution_param
+        assert cp.axis == 1, "only channel axis 1 is supported"
+        self.kernel, self.stride, self.pad, self.dilation = conv_spatial_params(cp)
+        self.num_output = cp.num_output
+        self.group = cp.group
+        self.bias_term = cp.bias_term
+        n, c = bottom_shapes[0][:2]
+        spatial = bottom_shapes[0][2:]
+        assert c % self.group == 0 and self.num_output % self.group == 0
+        if self.deconv:
+            # weight shape is (input_channels, num_output/group, kh, kw)
+            self.weight_shape = (c, self.num_output // self.group) + self.kernel
+            out_spatial = tuple(
+                self.stride[i] * (spatial[i] - 1)
+                + (self.dilation[i] * (self.kernel[i] - 1) + 1) - 2 * self.pad[i]
+                for i in range(len(spatial)))
+        else:
+            self.weight_shape = (self.num_output, c // self.group) + self.kernel
+            out_spatial = tuple(
+                (spatial[i] + 2 * self.pad[i]
+                 - (self.dilation[i] * (self.kernel[i] - 1) + 1))
+                // self.stride[i] + 1
+                for i in range(len(spatial)))
+        self.in_channels = c
+        for s in bottom_shapes[1:]:
+            assert tuple(s) == tuple(bottom_shapes[0]), \
+                f"{self.name}: all conv bottoms must share a shape"
+        n_top = max(1, len(self.lp.top))
+        self.top_shapes = [(n, self.num_output) + out_spatial] * n_top
+        return self.top_shapes
+
+    def num_params(self):
+        return 2 if self.bias_term else 1
+
+    def init_params(self, key):
+        cp = self.lp.convolution_param
+        kw, kb = jax.random.split(key)
+        weight = make_filler(cp.weight_filler)(kw, self.weight_shape)
+        params = [weight]
+        if self.bias_term:
+            params.append(make_filler(cp.bias_filler)(kb, (self.num_output,)))
+        return params
+
+
+@register_layer("Convolution")
+class ConvolutionLayer(_BaseConv):
+    """reference conv_layer.cpp + base_conv_layer.cpp (im2col+GEMM with
+    groups) -> one XLA convolution with feature_group_count."""
+
+    def apply(self, params, bottoms, ctx):
+        # Shared filters applied to each bottom independently
+        # (conv_layer.cpp loops over bottom.size()).
+        tops = []
+        for x in bottoms:
+            y = lax.conv_general_dilated(
+                x, params[0],
+                window_strides=self.stride,
+                padding=[(p, p) for p in self.pad],
+                rhs_dilation=self.dilation,
+                dimension_numbers=DIMNUMS_2D,
+                feature_group_count=self.group,
+                preferred_element_type=x.dtype)
+            if self.bias_term:
+                y = y + params[1].reshape((1, -1) + (1,) * (y.ndim - 2))
+            tops.append(y)
+        return tops, None
+
+
+@register_layer("Deconvolution")
+class DeconvolutionLayer(_BaseConv):
+    """reference deconv_layer.cpp: conv with forward/backward swapped ->
+    lax.conv_transpose-equivalent via lhs dilation."""
+
+    deconv = True
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        # Gradient-of-conv formulation: dilate the input by stride, pad by
+        # (effective_kernel - 1 - pad), and convolve with the flipped kernel.
+        kh = [self.dilation[i] * (self.kernel[i] - 1) + 1
+              for i in range(len(self.kernel))]
+        padding = [(kh[i] - 1 - self.pad[i], kh[i] - 1 - self.pad[i])
+                   for i in range(len(self.kernel))]
+        # weight (I, O/g, kh, kw) -> flip spatial, swap to (O, I/g, kh, kw)
+        w = params[0][:, :, ::-1, ::-1]
+        i, og = w.shape[:2]
+        w = w.reshape(self.group, i // self.group, og, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(og * self.group, i // self.group,
+                                          *w.shape[3:])
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1,) * len(self.stride),
+            padding=padding,
+            lhs_dilation=self.stride,
+            rhs_dilation=self.dilation,
+            dimension_numbers=DIMNUMS_2D,
+            feature_group_count=self.group,
+            preferred_element_type=x.dtype)
+        if self.bias_term:
+            y = y + params[1].reshape((1, -1) + (1,) * (y.ndim - 2))
+        return [y], None
+
+
+@register_layer("Pooling")
+class PoolingLayer(Layer):
+    """MAX/AVE/STOCHASTIC pooling with Caffe's CEIL output semantics
+    (reference pooling_layer.cpp:85-96,165-256)."""
+
+    def setup(self, bottom_shapes):
+        pp = self.lp.pooling_param
+        self.method = pp.pool
+        kernel, self.stride, self.pad = pool_spatial_params(pp)
+        n, c, h, w = bottom_shapes[0]
+        if pp.global_pooling:
+            kernel = (h, w)
+            self.pad = (0, 0)
+            self.stride = (1, 1)
+        self.kernel = kernel
+        ph = pooled_size(h, kernel[0], self.stride[0], self.pad[0])
+        pw = pooled_size(w, kernel[1], self.stride[1], self.pad[1])
+        self.in_hw = (h, w)
+        self.out_hw = (ph, pw)
+        # Explicit (lo, hi) padding reproducing ceil semantics under XLA's
+        # floor-based window placement.
+        self.xla_pad = (
+            (self.pad[0], ceil_pad_hi(h, kernel[0], self.stride[0], self.pad[0], ph)),
+            (self.pad[1], ceil_pad_hi(w, kernel[1], self.stride[1], self.pad[1], pw)),
+        )
+        self.top_shapes = [(n, c, ph, pw)]
+        if len(self.lp.top) > 1:  # optional mask top (MAX only)
+            self.top_shapes.append((n, c, ph, pw))
+        return self.top_shapes
+
+    def _reduce(self, x, init, op):
+        return lax.reduce_window(
+            x, init, op,
+            window_dimensions=(1, 1) + self.kernel,
+            window_strides=(1, 1) + self.stride,
+            padding=((0, 0), (0, 0)) + self.xla_pad)
+
+    def _patches(self, a, pad_value):
+        """Extract pooling windows -> (N, C, kh*kw, PH, PW)."""
+        (pl0, ph0), (pl1, ph1) = self.xla_pad
+        apad = jnp.pad(a, ((0, 0), (0, 0), (pl0, ph0), (pl1, ph1)),
+                       constant_values=pad_value)
+        p = lax.conv_general_dilated_patches(
+            apad, filter_shape=self.kernel, window_strides=self.stride,
+            padding=[(0, 0), (0, 0)], dimension_numbers=DIMNUMS_2D)
+        n_, _, oh, ow = p.shape
+        return p.reshape(n_, a.shape[1], self.kernel[0] * self.kernel[1],
+                         oh, ow)
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        if self.method == pb.PoolingParameter.MAX:
+            y = self._reduce(x, -jnp.inf, lax.max).astype(x.dtype)
+            tops = [y]
+            if len(self.top_shapes) > 1:
+                # Mask top: flat argmax index within the input feature map
+                # (pooling_layer.cpp:147 emits a mask when a 2nd top exists).
+                h, w = self.in_hw
+                idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+                idx = jnp.broadcast_to(idx, x.shape)
+                xp = self._patches(x, -jnp.inf)
+                ip = self._patches(idx, -1.0)
+                sel = jnp.argmax(xp == y[:, :, None], axis=2)
+                mask = jnp.take_along_axis(
+                    ip, sel[:, :, None], axis=2).squeeze(2).astype(x.dtype)
+                tops.append(mask)
+            return tops, None
+        elif self.method == pb.PoolingParameter.AVE:
+            s = self._reduce(x, 0.0, lax.add)
+            h, w = self.in_hw
+            dh = ave_pool_divisors(h, self.kernel[0], self.stride[0],
+                                   self.pad[0], self.out_hw[0])
+            dw = ave_pool_divisors(w, self.kernel[1], self.stride[1],
+                                   self.pad[1], self.out_hw[1])
+            div = jnp.asarray(np.outer(dh, dw), dtype=x.dtype)
+            return [s / div], None
+        else:  # STOCHASTIC (pooling_layer.cu: train samples ∝ value,
+            #  test takes the value-weighted average)
+            x_pos = jnp.maximum(x, 0.0)
+            if self.phase == pb.TRAIN and ctx.rng is not None:
+                xp = self._patches(x_pos, 0.0)
+                cums = jnp.cumsum(xp, axis=2)
+                total = cums[:, :, -1:]
+                key = jax.random.fold_in(
+                    ctx.rng, zlib.crc32(self.name.encode()) & 0x7FFFFFFF)
+                r = jax.random.uniform(key, total.shape, dtype=x.dtype) * total
+                sel = jnp.argmax(cums >= r, axis=2)
+                y = jnp.take_along_axis(xp, sel[:, :, None], axis=2).squeeze(2)
+            else:
+                num = self._reduce(x_pos * x_pos, 0.0, lax.add)
+                den = self._reduce(x_pos, 0.0, lax.add)
+                y = jnp.where(den > 0, num / jnp.maximum(den, 1e-12), 0.0)
+            return [y.astype(x.dtype)], None
+
+
+@register_layer("LRN")
+class LRNLayer(Layer):
+    """Local response normalization, ACROSS_CHANNELS / WITHIN_CHANNEL
+    (reference lrn_layer.cpp:118-164)."""
+
+    def setup(self, bottom_shapes):
+        lp = self.lp.lrn_param
+        self.size = lp.local_size
+        assert self.size % 2 == 1, "LRN local_size must be odd"
+        self.alpha, self.beta, self.k = lp.alpha, lp.beta, lp.k
+        self.across = (lp.norm_region == pb.LRNParameter.ACROSS_CHANNELS)
+        self.top_shapes = [tuple(bottom_shapes[0])]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        sq = x * x
+        half = (self.size - 1) // 2
+        if self.across:
+            # Channel-axis sliding sum as a sum of `size` shifted slices:
+            # channel-dim reduce_window mis-lowers on the TPU AOT compiler,
+            # and for the small window sizes LRN uses this fuses better.
+            c = x.shape[1]
+            padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+            ssum = padded[:, 0:c]
+            for d in range(1, self.size):
+                ssum = ssum + padded[:, d:d + c]
+            scale = self.k + (self.alpha / self.size) * ssum
+        else:
+            ssum = lax.reduce_window(
+                sq, 0.0, lax.add,
+                window_dimensions=(1, 1, self.size, self.size),
+                window_strides=(1, 1, 1, 1),
+                padding=((0, 0), (0, 0), (half, half), (half, half)))
+            scale = self.k + (self.alpha / (self.size * self.size)) * ssum
+        return [x * lax.pow(scale, -self.beta)], None
+
+
+@register_layer("BatchNorm")
+class BatchNormLayer(Layer):
+    """Caffe-style BatchNorm: 3 state blobs {mean, variance, scale_factor},
+    no learned affine (pair with Scale for that). Reference
+    batch_norm_layer.cpp:14-140. Stats are updated functionally: apply
+    returns replacement blob values instead of mutating.
+    """
+
+    def setup(self, bottom_shapes):
+        bp = self.lp.batch_norm_param
+        self.channels = bottom_shapes[0][1] if len(bottom_shapes[0]) > 1 else 1
+        if bp.HasField("use_global_stats"):
+            self.use_global_stats = bp.use_global_stats
+        else:
+            self.use_global_stats = (self.phase == pb.TEST)
+        self.maf = bp.moving_average_fraction
+        self.eps = bp.eps
+        self.top_shapes = [tuple(bottom_shapes[0])]
+        return self.top_shapes
+
+    def num_params(self):
+        return 3
+
+    def param_specs(self):
+        # BN statistics never receive solver updates
+        # (batch_norm_layer.cpp:39 forces lr_mult 0).
+        specs = super().param_specs()
+        for s in specs:
+            s.lr_mult = 0.0
+            s.decay_mult = 0.0
+        return specs
+
+    def init_params(self, key):
+        c = self.channels
+        return [jnp.zeros((c,)), jnp.zeros((c,)), jnp.zeros((1,))]
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        mean_b, var_b, sf = params
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        if self.use_global_stats:
+            scale = jnp.where(sf[0] == 0, 0.0, 1.0 / jnp.maximum(sf[0], 1e-30))
+            mean = mean_b * scale
+            var = var_b * scale
+            y = (x - mean.reshape(bshape)) * lax.rsqrt(
+                var.reshape(bshape) + self.eps)
+            return [y], None
+        axes = (0,) + tuple(range(2, x.ndim))
+        m = x.shape[0] * int(np.prod(x.shape[2:]))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
+        y = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + self.eps)
+        # Moving-average update (batch_norm_layer.cpp:120-130): the stored
+        # stats are sums discounted by scale_factor.
+        bias_corr = m / (m - 1.0) if m > 1 else 1.0
+        new_mean = self.maf * mean_b + lax.stop_gradient(mean)
+        new_var = self.maf * var_b + bias_corr * lax.stop_gradient(var)
+        new_sf = self.maf * sf + 1.0
+        return [y], [new_mean, new_var, new_sf]
+
+
+@register_layer("MVN")
+class MVNLayer(Layer):
+    """Mean-variance normalization (reference mvn_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        mp = self.lp.mvn_param
+        self.normalize_variance = mp.normalize_variance
+        self.across_channels = mp.across_channels
+        self.eps = mp.eps
+        self.top_shapes = [tuple(bottom_shapes[0])]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        axes = tuple(range(1, x.ndim)) if self.across_channels \
+            else tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if self.normalize_variance:
+            var = jnp.mean(jnp.square(y), axis=axes, keepdims=True)
+            y = y / (jnp.sqrt(var) + self.eps)
+        return [y], None
+
+
+@register_layer("Crop")
+class CropLayer(Layer):
+    """Crop bottom[0] to bottom[1]'s shape from `axis` on, at `offset`
+    (reference crop_layer.cpp)."""
+
+    def setup(self, bottom_shapes):
+        cp = self.lp.crop_param
+        a, b = bottom_shapes[0], bottom_shapes[1]
+        axis = cp.axis % len(a)
+        offsets = list(cp.offset)
+        self.starts = []
+        out = list(a)
+        for i in range(len(a)):
+            off = 0
+            if i >= axis:
+                j = i - axis
+                off = (offsets[j] if j < len(offsets)
+                       else (offsets[0] if len(offsets) == 1 else 0))
+                out[i] = b[i]
+                assert off + b[i] <= a[i], \
+                    f"crop exceeds bounds on axis {i}"
+            self.starts.append(off)
+        self.out_shape = tuple(out)
+        self.top_shapes = [self.out_shape]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        return [lax.dynamic_slice(x, self.starts, self.out_shape)], None
+
+
+@register_layer("Im2col")
+class Im2colLayer(Layer):
+    """Explicit im2col as a layer (reference im2col_layer.cpp). On TPU this
+    exists only for parity/testing; real convs never materialize columns."""
+
+    def setup(self, bottom_shapes):
+        cp = self.lp.convolution_param
+        self.kernel, self.stride, self.pad, self.dilation = conv_spatial_params(cp)
+        n, c, h, w = bottom_shapes[0]
+        oh = (h + 2 * self.pad[0]
+              - (self.dilation[0] * (self.kernel[0] - 1) + 1)) // self.stride[0] + 1
+        ow = (w + 2 * self.pad[1]
+              - (self.dilation[1] * (self.kernel[1] - 1) + 1)) // self.stride[1] + 1
+        self.top_shapes = [(n, c * self.kernel[0] * self.kernel[1], oh, ow)]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=self.kernel, window_strides=self.stride,
+            padding=[(p, p) for p in self.pad], rhs_dilation=self.dilation,
+            dimension_numbers=DIMNUMS_2D)
+        return [patches.reshape(self.top_shapes[0])], None

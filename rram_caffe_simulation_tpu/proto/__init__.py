@@ -1,0 +1,5 @@
+"""Wire-compatible Caffe proto schema (see caffe.proto in this directory).
+
+Regenerate with:  protoc --python_out=. caffe.proto
+"""
+from . import caffe_pb2 as pb  # noqa: F401

@@ -1,0 +1,82 @@
+"""Serialization: prototxt text I/O, BlobProto <-> numpy, .caffemodel
+weights (reference: src/caffe/util/io.{hpp,cpp}, blob.cpp FromProto/ToProto).
+
+Binary compatibility contract: files written by the reference load here and
+vice versa, because the proto schema in ../proto/caffe.proto keeps the
+reference's field numbers.
+"""
+from __future__ import annotations
+
+import numpy as np
+from google.protobuf import text_format
+
+from ..proto import pb
+
+
+def read_proto_text(path: str, message):
+    with open(path, "r") as f:
+        text_format.Parse(f.read(), message)
+    return message
+
+
+def write_proto_text(path: str, message) -> None:
+    with open(path, "w") as f:
+        f.write(text_format.MessageToString(message))
+
+
+def read_proto_binary(path: str, message):
+    with open(path, "rb") as f:
+        message.ParseFromString(f.read())
+    return message
+
+
+def write_proto_binary(path: str, message) -> None:
+    with open(path, "wb") as f:
+        f.write(message.SerializeToString())
+
+
+def read_net_param(path: str) -> "pb.NetParameter":
+    net = pb.NetParameter()
+    if path.endswith((".caffemodel", ".binaryproto", ".pb")):
+        return read_proto_binary(path, net)
+    return read_proto_text(path, net)
+
+
+def read_solver_param(path: str) -> "pb.SolverParameter":
+    return read_proto_text(path, pb.SolverParameter())
+
+
+def blob_shape(proto: "pb.BlobProto") -> tuple[int, ...]:
+    if proto.HasField("shape"):
+        return tuple(int(d) for d in proto.shape.dim)
+    legacy = (proto.num, proto.channels, proto.height, proto.width)
+    return tuple(int(d) for d in legacy)
+
+
+def blob_to_array(proto: "pb.BlobProto") -> np.ndarray:
+    shape = blob_shape(proto)
+    if len(proto.double_data):
+        arr = np.asarray(proto.double_data, dtype=np.float64)
+    else:
+        arr = np.asarray(proto.data, dtype=np.float32)
+    return arr.reshape(shape)
+
+
+def array_to_blob(arr, proto: "pb.BlobProto | None" = None) -> "pb.BlobProto":
+    if proto is None:
+        proto = pb.BlobProto()
+    arr = np.asarray(arr)
+    proto.shape.dim[:] = arr.shape
+    proto.ClearField("data")
+    proto.ClearField("double_data")
+    if arr.dtype == np.float64:
+        proto.double_data.extend(arr.reshape(-1).tolist())
+    else:
+        proto.data.extend(np.asarray(arr, dtype=np.float32).reshape(-1).tolist())
+    return proto
+
+
+def read_blob_from_file(path: str) -> np.ndarray:
+    """Read a single serialized BlobProto (e.g. a mean file or an infogain
+    H matrix, reference io.hpp ReadProtoFromBinaryFile + Blob::FromProto)."""
+    return blob_to_array(read_proto_binary(path, pb.BlobProto()))

@@ -1,0 +1,23 @@
+"""Test harness config.
+
+The reference runs every test over a {CPU, GPU} x {float, double} matrix
+(test_caffe_main.hpp:31-72). Here the backend matrix is handled by JAX: tests
+run on the CPU backend with an 8-device virtual mesh so every sharding path
+compiles and executes exactly as it would across a real TPU slice.
+"""
+import os
+import sys
+
+# Force CPU: the session presets JAX_PLATFORMS=axon (real TPU); tests run on
+# a deterministic 8-device virtual CPU mesh instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)  # float64 available for grad checks

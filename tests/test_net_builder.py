@@ -1,0 +1,153 @@
+"""Graph-level tests in the style of the reference's test_net.cpp: nets are
+built from inline prototxt strings."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.net import Net
+
+LENET = """
+name: "LeNet"
+layer {
+  name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: 4 dim: 1 dim: 28 dim: 28 } shape { dim: 4 } }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param {
+    num_output: 20 kernel_size: 5 stride: 1
+    weight_filler { type: "xavier" } bias_filler { type: "constant" }
+  }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 64 weight_filler { type: "xavier" } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } }
+}
+layer {
+  name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss"
+}
+layer {
+  name: "accuracy" type: "Accuracy" bottom: "ip2" bottom: "label" top: "accuracy"
+  include { phase: TEST }
+}
+"""
+
+
+def parse_net(text):
+    np_ = pb.NetParameter()
+    text_format.Parse(text, np_)
+    return np_
+
+
+def make_batch():
+    rng = np.random.RandomState(0)
+    return {
+        "data": jnp.asarray(rng.randn(4, 1, 28, 28), dtype=jnp.float32),
+        "label": jnp.asarray(rng.randint(0, 10, size=(4,))),
+    }
+
+
+def test_lenet_builds_and_runs():
+    net = Net(parse_net(LENET), phase=pb.TRAIN)
+    # TRAIN net: accuracy layer filtered out
+    assert "accuracy" not in net.layer_by_name
+    params = net.init(jax.random.PRNGKey(0))
+    assert params["conv1"][0].shape == (20, 1, 5, 5)
+    assert params["conv1"][1].shape == (20,)
+    # pool1 output 12x12 -> ip1 K = 20*12*12
+    assert params["ip1"][0].shape == (64, 20 * 12 * 12)
+    blobs, loss = net.apply(params, make_batch())
+    assert blobs["conv1"].shape == (4, 20, 24, 24)
+    assert blobs["pool1"].shape == (4, 20, 12, 12)
+    assert blobs["ip2"].shape == (4, 10)
+    assert np.isfinite(float(loss))
+    # untrained softmax loss ~ log(10)
+    assert abs(float(loss) - np.log(10)) < 1.0
+
+
+def test_lenet_test_phase_has_accuracy():
+    net = Net(parse_net(LENET), phase=pb.TEST)
+    assert "accuracy" in net.layer_by_name
+    params = net.init(jax.random.PRNGKey(0))
+    blobs, _ = net.apply(params, make_batch())
+    assert 0.0 <= float(blobs["accuracy"]) <= 1.0
+
+
+def test_lenet_grads_flow():
+    net = Net(parse_net(LENET), phase=pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    batch = make_batch()
+    grads = jax.grad(lambda p: net.apply(p, batch)[1])(params)
+    for lname in ("conv1", "ip1", "ip2"):
+        for g in grads[lname]:
+            assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+def test_fork_failure_param_bookkeeping():
+    """reference net.cpp:482-493: failure params = all InnerProduct params,
+    fc_params_ids = indices of the 2-D weights within that list."""
+    net = Net(parse_net(LENET), phase=pb.TRAIN)
+    refs = net.failure_param_refs
+    assert [r.layer_name for r in refs] == ["ip1", "ip1", "ip2", "ip2"]
+    assert net.fc_params_ids == [0, 2]
+
+
+def test_shared_params():
+    text = """
+    name: "shared"
+    layer { name: "in" type: "Input" top: "x"
+            input_param { shape { dim: 2 dim: 8 } } }
+    layer { name: "a" type: "InnerProduct" bottom: "x" top: "a"
+            param { name: "w" } param { name: "b" }
+            inner_product_param { num_output: 8 } }
+    layer { name: "b" type: "InnerProduct" bottom: "a" top: "b"
+            param { name: "w" } param { name: "b" }
+            inner_product_param { num_output: 8 } }
+    """
+    net = Net(parse_net(text), phase=pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    assert "a" in params
+    # layer b owns nothing; both layers read layer a's blobs
+    refs = net.learnable_params
+    assert refs[2].owner_layer == "a" and refs[2].layer_name == "b"
+    x = jnp.ones((2, 8))
+    blobs, _ = net.apply(params, {"x": x})
+    assert blobs["b"].shape == (2, 8)
+
+
+def test_inplace_blobs():
+    """ReLU in-place (top == bottom) must not clobber graph semantics."""
+    text = """
+    layer { name: "in" type: "Input" top: "x"
+            input_param { shape { dim: 2 dim: 4 } } }
+    layer { name: "r" type: "ReLU" bottom: "x" top: "x" }
+    layer { name: "p" type: "Power" bottom: "x" top: "y"
+            power_param { scale: 2.0 } }
+    """
+    net = Net(parse_net(text), phase=pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    x = jnp.asarray([[-1.0, 2.0, -3.0, 4.0], [0.5, -0.5, 1.5, -1.5]])
+    blobs, _ = net.apply(params, {"x": x})
+    np.testing.assert_allclose(np.asarray(blobs["y"]),
+                               2 * np.maximum(np.asarray(x), 0))
+
+
+def test_unknown_bottom_raises():
+    text = """
+    layer { name: "r" type: "ReLU" bottom: "nope" top: "y" }
+    """
+    with pytest.raises(ValueError, match="unknown bottom"):
+        Net(parse_net(text), phase=pb.TRAIN)
