@@ -1,0 +1,96 @@
+"""Headline benchmark: images/sec/chip under RRAM noise (BASELINE.json
+metric), measured on CIFAR-10-quick training with the Gaussian fault engine
+fused into every step, Monte-Carlo fault-config axis vmapped on-chip.
+
+Counting: each of the N simultaneously-trained fault configs consumes the
+shared batch every step (the reference trains one config per GPU process —
+run_different_mean.sh — so per-config images are the comparable unit of
+work). vs_baseline divides by the reference's best published training
+throughput, 267 img/s (CaffeNet w/ cuDNN on K40,
+docs/performance_hardware.md:23-25).
+
+Prints exactly ONE JSON line on stdout.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_IMG_S = 267.0  # reference: CaffeNet+cuDNN on K40
+
+BATCH = 100          # matches the fault engine's per-write decrement
+N_CONFIGS = int(os.environ.get("BENCH_CONFIGS", "64"))
+STEPS = int(os.environ.get("BENCH_STEPS", "30"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from google.protobuf import text_format
+
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.utils.io import read_net_param
+
+    sp = pb.SolverParameter()
+    sp.net_param.CopyFrom(read_net_param(os.path.join(
+        REPO, "models", "cifar10_quick",
+        "cifar10_quick_train_test.prototxt")))
+    sp.base_lr = 0.001
+    sp.lr_policy = "fixed"
+    sp.momentum = 0.9
+    sp.weight_decay = 0.004
+    sp.type = "SGD"
+    sp.max_iter = 10 ** 9
+    sp.display = 0
+    sp.random_seed = 1
+    sp.snapshot_prefix = "/tmp/bench"
+    # reference RRAM operating point (usage.md; solvers/
+    # cifar10_vgg11_template.prototxt:36-39): lifetimes ~ N(1e8, 3e7)
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 1e8
+    sp.failure_pattern.std = 3e7
+
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randn(BATCH, 3, 32, 32).astype(np.float32),
+             "label": rng.randint(0, 10, BATCH).astype(np.int32)}
+    solver = Solver(sp, train_feed=lambda: batch)
+    runner = SweepRunner(solver, n_configs=N_CONFIGS)
+
+    runner.step(1)  # compile + warmup
+    jax.block_until_ready(runner.params)
+
+    t0 = time.perf_counter()
+    runner.step(STEPS)
+    jax.block_until_ready(runner.params)
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    img_s_chip = N_CONFIGS * BATCH * STEPS / dt / n_chips
+    configs_per_hour = N_CONFIGS * STEPS / dt * 3600.0 / 5000.0
+    # (configs/hour normalized to a 5k-iteration CIFAR-quick training run)
+
+    print(json.dumps({
+        "metric": "images/sec/chip under RRAM noise (CIFAR-10-quick, "
+                  f"{N_CONFIGS}-config Monte-Carlo sweep)",
+        "value": round(img_s_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
+        "extra": {
+            "fault_configs_swept_per_hour_5k_iters":
+                round(configs_per_hour, 2),
+            "steps_timed": STEPS, "batch": BATCH,
+            "n_configs": N_CONFIGS, "chips": n_chips,
+            "seconds": round(dt, 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

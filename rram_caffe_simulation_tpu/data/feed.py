@@ -1,0 +1,169 @@
+"""Per-net batch feeds: host iterators producing the batch dict Net.apply
+consumes for data-source tops.
+
+Replaces the reference's threaded prefetch pipeline (data_reader.cpp:73,
+base_data_layer.cpp:76-120): one feed per net, pulling from the layer's
+configured source, applying DataTransformer semantics, round-robin across
+epoch boundaries (rand_skip/shuffle where the reference has them).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from ..proto import pb
+
+
+def build_feed(net) -> Callable[[], Dict[str, np.ndarray]]:
+    """Compose one callable feeding every data-source layer of `net`."""
+    sub_feeds = []
+    for layer in net.layers:
+        if not layer.is_data_source:
+            continue
+        builder = FEED_BUILDERS.get(layer.type_name)
+        if builder is None:
+            raise NotImplementedError(
+                f"no automatic feed for layer type {layer.type_name!r} "
+                f"(layer {layer.name!r}); pass train_feed/test_feeds to "
+                "Solver or use MemoryData.set_input_arrays")
+        sub_feeds.append(builder(layer))
+
+    def feed() -> Dict[str, np.ndarray]:
+        batch: Dict[str, np.ndarray] = {}
+        for f in sub_feeds:
+            batch.update(f())
+        return batch
+    return feed
+
+
+# ---------------------------------------------------------------------------
+
+def _hdf5_feed(layer):
+    """HDF5Data semantics (reference hdf5_data_layer.cpp): source file lists
+    .h5 paths; iterate rows in order, advancing files round-robin; optional
+    shuffle of the file order."""
+    import h5py
+    hp = layer.lp.hdf5_data_param
+    with open(hp.source) as f:
+        files = [ln.strip() for ln in f if ln.strip()]
+    tops = list(layer.lp.top)
+    batch_size = hp.batch_size
+    state = {"file": 0, "row": 0, "data": None}
+    if hp.shuffle:
+        np.random.RandomState(0).shuffle(files)
+
+    def load(idx):
+        with h5py.File(files[idx], "r") as h5:
+            state["data"] = {t: np.asarray(h5[t]) for t in tops}
+        state["row"] = 0
+
+    def feed():
+        if state["data"] is None:
+            load(state["file"])
+        out = {t: [] for t in tops}
+        need = batch_size
+        while need > 0:
+            data = state["data"]
+            n = next(iter(data.values())).shape[0]
+            take = min(need, n - state["row"])
+            for t in tops:
+                out[t].append(data[t][state["row"]:state["row"] + take])
+            state["row"] += take
+            need -= take
+            if state["row"] >= n:
+                state["file"] = (state["file"] + 1) % len(files)
+                load(state["file"])
+        return {t: np.concatenate(v, axis=0) for t, v in out.items()}
+    return feed
+
+
+def _memory_feed(layer):
+    """MemoryData (memory_data_layer.cpp): arrays set via
+    layer.set_input_arrays(data, labels) from the API; cycles in batch
+    chunks."""
+    state = {"pos": 0}
+
+    def set_input_arrays(data, labels):
+        layer._memory_data = (np.asarray(data, np.float32),
+                              np.asarray(labels, np.float32))
+        state["pos"] = 0
+    layer.set_input_arrays = set_input_arrays
+
+    n = layer.lp.memory_data_param.batch_size
+    tops = list(layer.lp.top)
+
+    def feed():
+        if not hasattr(layer, "_memory_data"):
+            raise RuntimeError(
+                f"MemoryData layer {layer.name!r}: call set_input_arrays "
+                "before stepping")
+        data, labels = layer._memory_data
+        total = data.shape[0]
+        idx = [(state["pos"] + i) % total for i in range(n)]
+        state["pos"] = (state["pos"] + n) % total
+        return {tops[0]: data[idx], tops[1]: labels[idx]}
+    return feed
+
+
+def _data_feed(layer):
+    """Data layer (LMDB/LevelDB) via the db module's cursor."""
+    from .db import open_db
+    from .transformer import DataTransformer
+    dp = layer.lp.data_param
+    cursor = open_db(dp.source, dp.backend).cursor()
+    transformer = DataTransformer(layer.lp.transform_param,
+                                  phase=layer.phase)
+    tops = list(layer.lp.top)
+    batch_size = dp.batch_size
+
+    def feed():
+        from .db import datum_to_array
+        datas, labels = [], []
+        for _ in range(batch_size):
+            datum = pb.Datum()
+            datum.ParseFromString(cursor.next_value())
+            arr, label = datum_to_array(datum)
+            datas.append(transformer.transform(arr))
+            labels.append(label)
+        out = {tops[0]: np.stack(datas)}
+        if len(tops) > 1:
+            out[tops[1]] = np.asarray(labels, np.float32)
+        return out
+    return feed
+
+
+def _image_feed(layer):
+    """ImageData (image_data_layer.cpp): source lists `path label` lines."""
+    from .image import load_image
+    from .transformer import DataTransformer
+    ip = layer.lp.image_data_param
+    with open(ip.source) as f:
+        entries = [ln.strip().rsplit(" ", 1) for ln in f if ln.strip()]
+    if ip.shuffle:
+        np.random.RandomState(0).shuffle(entries)
+    transformer = DataTransformer(layer.lp.transform_param,
+                                  phase=layer.phase)
+    tops = list(layer.lp.top)
+    state = {"pos": int(ip.rand_skip)}
+
+    def feed():
+        datas, labels = [], []
+        for _ in range(ip.batch_size):
+            path, label = entries[state["pos"] % len(entries)]
+            state["pos"] += 1
+            arr = load_image(ip.root_folder + path, ip.is_color,
+                             ip.new_height, ip.new_width)
+            datas.append(transformer.transform(arr))
+            labels.append(float(label))
+        return {tops[0]: np.stack(datas),
+                tops[1]: np.asarray(labels, np.float32)}
+    return feed
+
+
+FEED_BUILDERS = {
+    "HDF5Data": _hdf5_feed,
+    "MemoryData": _memory_feed,
+    "Data": _data_feed,
+    "ImageData": _image_feed,
+}

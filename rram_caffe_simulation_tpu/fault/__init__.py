@@ -1,0 +1,22 @@
+"""RRAM crossbar fault simulation: the fork's raison d'être, as pure JAX.
+
+Reference: include/caffe/failure_maker.hpp, src/caffe/failure_maker.{cpp,cu},
+include/caffe/strategy.hpp, src/caffe/strategy.cpp.
+
+TPU design: fault state is a pytree {lifetimes, stuck} keyed per fault-target
+parameter; `fail()` is a pure (params, state, diffs) -> (params', state')
+transform fused into the jitted train step, and the whole step vmaps over a
+leading fault-config axis for Monte-Carlo crossbar sweeps (replacing the
+reference's one-process-per-config workflow).
+"""
+from .engine import (FaultState, init_fault_state, fail, broken_fraction,
+                     fault_state_to_proto, fault_state_from_proto)
+from .strategies import (threshold_diffs, remap_fc_neurons, sort_fc_neurons,
+                         GeneticStrategy, build_strategies)
+
+__all__ = [
+    "FaultState", "init_fault_state", "fail", "broken_fraction",
+    "fault_state_to_proto", "fault_state_from_proto",
+    "threshold_diffs", "remap_fc_neurons", "sort_fc_neurons",
+    "GeneticStrategy", "build_strategies",
+]

@@ -1,0 +1,151 @@
+"""RRAM cell-endurance fault engine.
+
+Reference semantics (failure_maker.cpp:4-84, failure_maker.cu:6-60):
+
+- Construction (GaussianFailureMaker ctor, failure_maker.cpp:4-53): for every
+  failure-prone parameter, draw per-cell *lifetimes* ~ N(mean, std) and
+  per-cell *stuck values* in {-1, 0, +1} with probabilities
+  FailureProbParameter{neg, zero, pos} (defaults 10/20/10,
+  failure_maker.cpp:17-21) via one uniform draw against the cumulative
+  splits.
+- Per-iteration Fail (failure_maker.cu:23-40 FailKernel): for each cell,
+  if lifetime <= 0 the cell is broken and the weight is clamped to its stuck
+  value; otherwise, if |grad| >= 1e-20 the lifetime is decremented by the
+  batch size (hard-coded 100 in the reference, FIXME at failure_maker.cpp:75
+  — here it is the `decrement` argument), and a cell whose lifetime just
+  expired is clamped immediately.
+
+Here the whole engine is a pure function over a FaultState pytree so it jits,
+vmaps over a leading Monte-Carlo config axis, and checkpoints (the reference
+does NOT snapshot fail_iterations_ — resume re-draws fresh lifetimes; we fix
+that, see fault_state_to_proto).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..proto import pb
+
+# A FaultState is {"lifetimes": {key: f32[...]}, "stuck": {key: f32[...]}}
+# with one entry per fault-target parameter, keyed "layer/slot" in
+# failure_learnable_params order (net.cpp:482-493).
+FaultState = Dict[str, Dict[str, jax.Array]]
+
+EPSILON = 1e-20  # reference failure_maker.cpp:56 / failure_maker.cu:25
+
+
+def param_key(layer_name: str, slot: int) -> str:
+    return f"{layer_name}/{slot}"
+
+
+def _stuck_splits(pattern: "pb.FailurePatternParameter") -> Tuple[float, float]:
+    """Cumulative probability splits for the stuck-value draw
+    (failure_maker.cpp:10-24)."""
+    if pattern.HasField("failure_prob"):
+        p = pattern.failure_prob
+        probs = [p.neg, p.zero, p.pos]
+        if min(probs) < 0:
+            raise ValueError("failure_prob entries must be >= 0")
+    else:
+        probs = [10, 20, 10]
+    total = float(sum(probs))
+    return probs[0] / total, (probs[0] + probs[1]) / total
+
+
+def init_fault_state(key: jax.Array, param_shapes: Dict[str, tuple],
+                     pattern: "pb.FailurePatternParameter") -> FaultState:
+    """Draw lifetimes and stuck values for every fault-target param.
+
+    Mirrors the GaussianFailureMaker constructor (failure_maker.cpp:4-53):
+    lifetimes ~ N(mean, std) kept as float (the reference also keeps float,
+    see its FIXME about int conversion), stuck values from one uniform draw
+    against the cumulative splits (FailureThresholdKernel,
+    failure_maker.cu:6-16).
+    """
+    split1, split2 = _stuck_splits(pattern)
+    mean, std = float(pattern.mean), float(pattern.std)
+    lifetimes, stuck = {}, {}
+    for name, shape in param_shapes.items():
+        key, k_life, k_stuck = jax.random.split(key, 3)
+        lifetimes[name] = mean + std * jax.random.normal(
+            k_life, shape, dtype=jnp.float32)
+        u = jax.random.uniform(k_stuck, shape, dtype=jnp.float32)
+        stuck[name] = jnp.where(
+            u < split1, -1.0,
+            jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+    return {"lifetimes": lifetimes, "stuck": stuck}
+
+
+def fail(fault_params: Dict[str, jax.Array], state: FaultState,
+         fault_diffs: Dict[str, jax.Array],
+         decrement: float = 100.0) -> Tuple[Dict[str, jax.Array], FaultState]:
+    """One fault step over the fault-target params (FailKernel,
+    failure_maker.cu:23-40). Pure: returns (clamped params, new state).
+
+    `fault_diffs` are the final update values the solver just applied (the
+    reference reads blob diff after ComputeUpdate/ApplyStrategy, solver.cpp
+    :299-305), not the raw gradients.
+    """
+    new_params, new_life = {}, {}
+    for name, data in fault_params.items():
+        life = state["lifetimes"][name]
+        stuck = state["stuck"][name]
+        diff = fault_diffs[name]
+        alive = life > 0
+        written = jnp.abs(diff) >= EPSILON
+        life2 = jnp.where(alive & written, life - decrement, life)
+        broken = life2 <= 0
+        new_params[name] = jnp.where(broken, stuck, data)
+        new_life[name] = life2
+    return new_params, {"lifetimes": new_life, "stuck": state["stuck"]}
+
+
+def broken_fraction(state: FaultState) -> jax.Array:
+    """Broken-cell census (reference FailureMaker::Fail CPU-side census,
+    failure_maker.hpp:38-54 — which forced a GPU->CPU sync every iteration;
+    here it is a reduction the caller fetches only when logging)."""
+    broken = 0
+    total = 0
+    for life in state["lifetimes"].values():
+        broken = broken + jnp.sum(life <= 0)
+        total += life.size
+    return broken / max(total, 1)
+
+
+def stuck_zero_flags(state: FaultState, name: str) -> jax.Array:
+    """1.0 where a cell is broken AND stuck at 0 — the remapping strategy's
+    flag matrix (strategy.cpp:36-45 GetFailFlagMat; note it tests
+    `iters < 0`, not `<= 0`)."""
+    life = state["lifetimes"][name]
+    stuck = state["stuck"][name]
+    return jnp.where((life < 0) & (stuck == 0), 1.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing: the reference never snapshots fault state (SURVEY §5.4 gap);
+# we serialize it as BlobProtos inside a NetParameter-shaped container so the
+# wire format stays protobuf.
+
+def fault_state_to_proto(state: FaultState) -> "pb.NetParameter":
+    from ..utils.io import array_to_blob
+    out = pb.NetParameter(name="fault_state")
+    for name in sorted(state["lifetimes"]):
+        lp = out.layer.add()
+        lp.name = name
+        lp.type = "FaultState"
+        array_to_blob(np.asarray(state["lifetimes"][name]), lp.blobs.add())
+        array_to_blob(np.asarray(state["stuck"][name]), lp.blobs.add())
+    return out
+
+
+def fault_state_from_proto(proto: "pb.NetParameter") -> FaultState:
+    from ..utils.io import blob_to_array
+    lifetimes, stuck = {}, {}
+    for lp in proto.layer:
+        lifetimes[lp.name] = jnp.asarray(blob_to_array(lp.blobs[0]))
+        stuck[lp.name] = jnp.asarray(blob_to_array(lp.blobs[1]))
+    return {"lifetimes": lifetimes, "stuck": stuck}

@@ -1,0 +1,251 @@
+"""Mitigation strategies against RRAM cell failures.
+
+Reference: include/caffe/strategy.hpp, src/caffe/strategy.cpp. Three
+strategies, applied between ComputeUpdate and ApplyUpdate each iteration
+(solver.cpp:299-305 — the ordering contract):
+
+- Threshold (strategy.cpp:7-33): zero any update with |diff| <= threshold *
+  global_lr * param_lr — models a limited write-endurance budget by skipping
+  small writes (which also stops the fault engine's lifetime decrement for
+  those cells, failure_maker.cu:31-33).
+- Remapping (strategy.cpp:36-137): every `period` iters after `start`, rank
+  hidden FC neurons by their count of broken-stuck-at-0 cells and permute
+  neuron rows/cols so the most-broken physical neurons host the
+  most-prunable logical neurons (per a prune_order file).
+- Genetic (strategy.cpp:140-288): random neuron-pair swap search minimizing
+  the count of (unprunable AND failed) cells against a loaded prune-mask
+  net; a swap is kept if its local distance decreases.
+
+TPU design: threshold and remapping are pure jnp transforms fused into the
+jitted train step (remapping is argsort + gather, gated by lax.cond), so
+both vmap over the Monte-Carlo fault-config axis. Genetic is an episodic
+host-side search (sequential data-dependent swaps) between jitted steps.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import FaultState, stuck_zero_flags
+from ..proto import pb
+
+EPSILON = 1e-20  # reference strategy.cpp:163
+
+
+# ---------------------------------------------------------------------------
+# Threshold strategy (in-jit)
+
+def threshold_diffs(fault_diffs: Dict[str, jax.Array], rate,
+                    lr_mults: Dict[str, float],
+                    threshold: float) -> Dict[str, jax.Array]:
+    """Zero small updates (ThresholdFailureStrategy::Apply, strategy.cpp:7-33).
+
+    The per-param cutoff is threshold * global_rate * lr_mult. (The reference
+    indexes params_lr()[i] with i over the *failure* param list — an index
+    bug that reads unrelated layers' multipliers; here each fault param uses
+    its own lr_mult.)
+    """
+    out = {}
+    for name, diff in fault_diffs.items():
+        cutoff = threshold * rate * lr_mults.get(name, 1.0)
+        out[name] = jnp.where(jnp.abs(diff) <= cutoff, 0.0, diff)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Remapping strategy (in-jit, episodic via lax.cond in the solver step)
+
+def sort_fc_neurons(state: FaultState,
+                    weight_keys: Sequence[str]) -> List[jax.Array]:
+    """Rank hidden FC neurons by broken-stuck-0 cell count
+    (SortFCNeurons, strategy.cpp:48-88). For hidden group i (between FC i-1
+    and FC i), neuron j's count = row-j sum of FC i-1's flag matrix + col-j
+    sum of FC i's. Returns one ascending-order index array per hidden group.
+    """
+    flags = [stuck_zero_flags(state, k) for k in weight_keys]
+    orders = []
+    for i in range(1, len(flags)):
+        zero_nums = flags[i - 1].sum(axis=1) + flags[i].sum(axis=0)
+        orders.append(jnp.argsort(zero_nums))
+    return orders
+
+
+def remap_fc_neurons(data: Dict[str, jax.Array], diffs: Dict[str, jax.Array],
+                     state: FaultState,
+                     fc_pairs: Sequence[Tuple[str, Optional[str]]],
+                     prune_orders: Sequence[np.ndarray]):
+    """Permute hidden FC neurons (RemappingFailureStrategy::Apply,
+    strategy.cpp:89-137): physical slot order[j] (j-th least broken) receives
+    logical neuron prune_order[j]. Rows of the incoming weight W_{i-1}, the
+    bias b_{i-1}, and columns of the outgoing weight W_i move together; the
+    fault state stays put (lifetimes/stuck values belong to physical cells).
+
+    Note: the reference permutes the bias by indexing remapped_weight
+    (strategy.cpp:117-118) — an indexing slip; the intended (and here
+    implemented) source is the saved bias.
+
+    `fc_pairs` = [(weight_key, bias_key_or_None), ...] in FC stack order;
+    `prune_orders` = one logical-neuron ordering per hidden group (loaded
+    from prune_order_file). Returns (new_data, new_diffs).
+    """
+    weight_keys = [w for w, _ in fc_pairs]
+    orders = sort_fc_neurons(state, weight_keys)
+    data = dict(data)
+    diffs = dict(diffs)
+    for i in range(1, len(fc_pairs)):
+        order = orders[i - 1]
+        prune = jnp.asarray(prune_orders[i - 1], dtype=jnp.int32)
+        n = data[weight_keys[i - 1]].shape[0]
+        # perm[dest] = src: dest row order[j] <- src row prune[j]
+        perm = jnp.zeros((n,), dtype=jnp.int32).at[order].set(prune)
+        w_in, b_in = fc_pairs[i - 1]
+        w_out = weight_keys[i]
+        for d in (data, diffs):
+            d[w_in] = d[w_in][perm, :]
+            if b_in is not None and b_in in d:
+                d[b_in] = d[b_in][perm]
+            d[w_out] = d[w_out][:, perm]
+    return data, diffs
+
+
+# ---------------------------------------------------------------------------
+# Genetic strategy (host-side episodic search)
+
+@dataclasses.dataclass
+class GeneticStrategy:
+    """Random neuron-pair swap search (GeneticFailureStrategy,
+    strategy.cpp:140-288). Operates on host numpy copies between jitted
+    steps; the prune mask net supplies which weights are prunable.
+
+    State: `prune_weights` — one [out, in] mask array per FC layer (nonzero =
+    unprunable weight), mutated by kept swaps exactly as the reference
+    mutates its loaded prune net.
+    """
+    fc_pairs: List[Tuple[str, Optional[str]]]
+    prune_weights: List[np.ndarray]
+    start: int
+    period: int
+    switch_time: int
+    seed: int = 0
+
+    def __post_init__(self):
+        self.times = 0
+        self._rng = np.random.RandomState(self.seed)
+        if len(self.fc_pairs) < 2:
+            # reference strategy.cpp:174 computes rand() % (size-1): with a
+            # single FC fault target there is no neuron pair to swap.
+            raise ValueError(
+                "genetic strategy needs >= 2 fault-target FC layers")
+
+    def overall_dist(self, state_np: Dict[str, np.ndarray]) -> int:
+        """Count of unprunable-AND-failed cells (CalculateOverallDist,
+        strategy.cpp:140-158; failure test is lifetime < 0)."""
+        dist = 0
+        for (wkey, _), prune in zip(self.fc_pairs, self.prune_weights):
+            life = state_np[wkey]
+            dist += int(np.sum((prune < EPSILON) & (life < 0)))
+        return dist
+
+    def due(self) -> bool:
+        """start/period gating (strategy.cpp:160-163); call once per
+        iteration — increments the reference's times_ counter."""
+        self.times += 1
+        return not (self.times < self.start or
+                    (self.times - self.start) % self.period)
+
+    def apply(self, data: Dict[str, np.ndarray], diffs: Dict[str, np.ndarray],
+              lifetimes: Dict[str, np.ndarray]) -> None:
+        """One episodic application; mutates data/diffs/prune masks in
+        place. Caller gates via due()."""
+        n_fc = len(self.fc_pairs)
+        i = 0
+        attempts = 0
+        while i < self.switch_time and attempts < 100 * self.switch_time:
+            attempts += 1
+            layer = self._rng.randint(1, n_fc)  # hidden group index
+            w_in_key, b_in_key = self.fc_pairs[layer - 1]
+            w_out_key = self.fc_pairs[layer][0]
+            n = data[w_in_key].shape[0]
+            a = self._rng.randint(n)
+            b = self._rng.randint(n)
+            if a == b:  # same neuron: retry (bounded, unlike strategy.cpp:180)
+                continue
+            i += 1
+            life_in = lifetimes[w_in_key]
+            life_out = lifetimes[w_out_key]
+            prune_in = self.prune_weights[layer - 1]
+            prune_out = self.prune_weights[layer]
+            # local distance of {a,b} before vs after swapping their rows/cols
+            # (strategy.cpp:195-225): failed cells stay physical, prune mask
+            # moves with the logical neuron.
+            def local(pa, pb):
+                return (np.sum((prune_in[pa] < EPSILON) & (life_in[a] < 0)) +
+                        np.sum((prune_in[pb] < EPSILON) & (life_in[b] < 0)) +
+                        np.sum((prune_out[:, pa] < EPSILON) & (life_out[:, a] < 0)) +
+                        np.sum((prune_out[:, pb] < EPSILON) & (life_out[:, b] < 0)))
+
+            if local(b, a) < local(a, b):
+                for d in (data, diffs):
+                    d[w_in_key][[a, b]] = d[w_in_key][[b, a]]
+                    if b_in_key is not None and b_in_key in d:
+                        d[b_in_key][[a, b]] = d[b_in_key][[b, a]]
+                    d[w_out_key][:, [a, b]] = d[w_out_key][:, [b, a]]
+                prune_in[[a, b]] = prune_in[[b, a]]
+                prune_out[:, [a, b]] = prune_out[:, [b, a]]
+
+
+# ---------------------------------------------------------------------------
+# Strategy construction from SolverParameter.failure_strategy
+
+@dataclasses.dataclass
+class StrategyConfig:
+    """Parsed failure_strategy entries (FailureStrategyParameter,
+    caffe.proto:270-291), partitioned by where they execute."""
+    threshold: Optional[float] = None           # in-jit, every iteration
+    remap_start: int = 0                        # in-jit via lax.cond
+    remap_period: int = 0
+    prune_orders: Optional[List[np.ndarray]] = None
+    genetic: Optional[GeneticStrategy] = None   # host-side episodic
+
+
+def load_prune_orders(path: str) -> List[np.ndarray]:
+    """Load the prune_order_file written by prune_order.py (one line of
+    space-separated neuron indices per hidden FC group)."""
+    orders = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                orders.append(np.asarray([int(x) for x in line.split()],
+                                         dtype=np.int32))
+    return orders
+
+
+def build_strategies(solver_param: "pb.SolverParameter", fc_pairs,
+                     prune_net_loader=None) -> StrategyConfig:
+    """Build the strategy set from SolverParameter.failure_strategy entries
+    (Solver ctor, solver.cpp:134-148; CreateStrategy strategy.hpp:33)."""
+    cfg = StrategyConfig()
+    for sp in solver_param.failure_strategy:
+        if sp.type == "threshold":
+            cfg.threshold = float(sp.threshold)
+        elif sp.type == "remapping":
+            cfg.remap_start = int(sp.start)
+            cfg.remap_period = max(int(sp.period), 1)
+            cfg.prune_orders = load_prune_orders(sp.prune_order_file)
+        elif sp.type == "genetic":
+            if prune_net_loader is None:
+                raise ValueError("genetic strategy requires a prune net")
+            prune_weights = prune_net_loader(sp.prune_net_file,
+                                             sp.prune_model_file)
+            cfg.genetic = GeneticStrategy(
+                fc_pairs=list(fc_pairs), prune_weights=prune_weights,
+                start=int(sp.start), period=max(int(sp.period), 1),
+                switch_time=int(sp.switch_time))
+        elif sp.type:
+            raise ValueError(f"unknown failure strategy {sp.type!r}")
+    return cfg

@@ -1,0 +1,24 @@
+"""Mesh-based parallelism: the TPU-native replacement for the reference's
+single-node multi-GPU P2PSync (include/caffe/parallel.hpp,
+src/caffe/parallel.cpp).
+
+The reference's entire component — binary tree of CUDA P2P links, param
+broadcast at on_start (parallel.cpp:287), gradient tree-reduction at
+on_gradients_ready (:325), 1/N scaling at the root (:377), per-GPU worker
+threads and blocking-queue handshakes — collapses into XLA GSPMD over a
+`jax.sharding.Mesh`: params replicated over the data axis, batches sharded,
+gradients psum'd over ICI by the partitioner. Per-replica RNG
+(parallel.cpp:276-282) is `fold_in` over the device index; the DataReader's
+round-robin queue-per-solver (data_reader.cpp:79-93) is batch sharding.
+
+Beyond parity: a `config` mesh axis vmaps the whole train step over a
+leading Monte-Carlo fault-configuration axis, replacing the reference's
+one-process-per-config sweep (run_different_mean.sh fans 3 configs over 3
+GPUs; here thousands of crossbar configs ride one TPU batch).
+"""
+from .mesh import make_mesh, data_sharding, replicated
+from .dp import make_dp_step, shard_batch
+from .sweep import SweepRunner, stack_fault_states
+
+__all__ = ["make_mesh", "data_sharding", "replicated", "make_dp_step",
+           "shard_batch", "SweepRunner", "stack_fault_states"]

@@ -1,0 +1,50 @@
+"""Synchronous data parallelism over a device mesh.
+
+Capability parity with P2PSync (parallel.cpp): replicated params, batch
+sharded over the "data" axis, gradients summed across replicas by the GSPMD
+partitioner (the psum XLA inserts = the reference's tree-reduction +
+caffe_gpu_add, parallel.cpp:325-377). Caffe's semantics sum per-replica
+gradient contributions and the root scales by 1/solver_count
+(parallel.cpp:372-375) because each replica computed a per-replica-batch
+normalized loss; here the loss layers normalize by the global batch dim, so
+the psum'd gradient is already the global-batch gradient — identical math,
+zero hand-written communication.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import replicated
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place each batch array with its leading dim sharded over `axis`
+    (the DataReader round-robin equivalent, data_reader.cpp:79-93: each
+    replica sees a disjoint shard)."""
+    out = {}
+    for k, v in batch.items():
+        sh = NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+        out[k] = jax.device_put(v, sh)
+    return out
+
+
+def make_dp_step(solver, mesh: Mesh):
+    """Jit the solver's train step for data-parallel execution.
+
+    Params/history/fault state are replicated (place them with
+    `place_state` once); the batch arrives sharded over the mesh's data
+    axis via `shard_batch`. GSPMD inserts the gradient all-reduce.
+    Returns (jitted_step, place_state).
+    """
+    step = solver.make_train_step()
+    repl = replicated(mesh)
+
+    def place_state(params, history, fault_state):
+        sharding = jax.tree.map(lambda _: repl,
+                                (params, history, fault_state))
+        return jax.device_put((params, history, fault_state), sharding)
+
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2),
+                     out_shardings=(repl, repl, repl, repl, repl))
+    return jitted, place_state

@@ -1,0 +1,49 @@
+"""Device mesh construction and sharding helpers.
+
+Axes convention:
+- "data":   data parallelism (batch dim sharded, params replicated) — the
+            P2PSync replacement (parallel.cpp).
+- "config": Monte-Carlo fault-config parallelism (fault state + per-config
+            params sharded on their leading config axis).
+
+Multi-host: jax.devices() spans hosts once jax.distributed.initialize() has
+run; the same mesh code then lays shardings over ICI within a slice and DCN
+across slices (XLA picks the collective algorithm per axis).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: Optional[dict] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh. `shape` maps axis name -> size, e.g.
+    {"config": 4, "data": 2}; defaults to all devices on one "data" axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {"data": len(devices)}
+    sizes = list(shape.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, axis: str = "data",
+                  ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) dim over `axis`, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def config_sharding(mesh: Mesh, axis: str = "config",
+                    ndim: int = 1) -> NamedSharding:
+    """Shard the leading (fault-config) dim over `axis`."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))

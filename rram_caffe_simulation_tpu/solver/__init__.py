@@ -1,0 +1,16 @@
+"""Caffe-exact optimizers and the training loop.
+
+Reference: include/caffe/{solver,sgd_solvers,solver_factory}.hpp,
+src/caffe/solver.cpp, src/caffe/solvers/*. The six SGD-family algorithms are
+pure per-parameter update rules (updates.py), learning-rate schedules are
+traced functions of the iteration (lr_policies.py), and Solver (solver.py)
+fuses forward/backward + ComputeUpdate -> ApplyStrategy -> ApplyUpdate ->
+Fail into one jitted TPU step, preserving the fork's ordering contract
+(solver.cpp:299-305).
+"""
+from .lr_policies import learning_rate_fn, current_step_fn
+from .updates import UPDATE_RULES, history_slots
+from .solver import Solver
+
+__all__ = ["Solver", "learning_rate_fn", "current_step_fn",
+           "UPDATE_RULES", "history_slots"]

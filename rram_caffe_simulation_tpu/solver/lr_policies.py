@@ -1,0 +1,53 @@
+"""Learning-rate schedules as traced functions of the iteration.
+
+Reference: SGDSolver::GetLearningRate (sgd_solver.cpp:27-91). Every policy is
+a pure function of `iter`, so the rate computes inside the jitted step with
+no host round-trip; the reference's stateful `current_step_` counter for
+step/multistep becomes a closed-form count (identical along any
+monotonically increasing iteration sequence, which is also what the
+reference snapshots and restores).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..proto import pb
+
+
+def current_step_fn(param: "pb.SolverParameter"):
+    """Closed-form current_step_ (snapshotted in SolverState.current_step)."""
+    policy = param.lr_policy
+    if policy == "step":
+        stepsize = max(int(param.stepsize), 1)
+        return lambda it: it // stepsize
+    if policy == "multistep":
+        steps = jnp.asarray(list(param.stepvalue), dtype=jnp.int32)
+        if steps.size == 0:
+            return lambda it: jnp.zeros((), jnp.int32)
+        return lambda it: jnp.sum(it >= steps).astype(jnp.int32)
+    return lambda it: jnp.zeros((), jnp.int32)
+
+
+def learning_rate_fn(param: "pb.SolverParameter"):
+    """rate(iter) for the seven reference policies (sgd_solver.cpp:27-91)."""
+    policy = param.lr_policy
+    base = jnp.float32(param.base_lr)
+    gamma = jnp.float32(param.gamma)
+    power = jnp.float32(param.power)
+
+    if policy == "fixed":
+        return lambda it: base
+    if policy in ("step", "multistep"):
+        step = current_step_fn(param)
+        return lambda it: base * gamma ** step(it).astype(jnp.float32)
+    if policy == "exp":
+        return lambda it: base * gamma ** it.astype(jnp.float32)
+    if policy == "inv":
+        return lambda it: base * (1.0 + gamma * it) ** (-power)
+    if policy == "poly":
+        max_iter = jnp.float32(param.max_iter)
+        return lambda it: base * (1.0 - it / max_iter) ** power
+    if policy == "sigmoid":
+        stepsize = jnp.float32(param.stepsize)
+        return lambda it: base / (1.0 + jnp.exp(-gamma * (it - stepsize)))
+    raise ValueError(f"Unknown lr policy: {policy!r}")

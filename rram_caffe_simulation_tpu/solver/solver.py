@@ -1,0 +1,599 @@
+"""Solver: the training loop, fused into one jitted TPU step.
+
+Reference: src/caffe/solver.cpp (Step solver.cpp:238, Solve :328, Test :386,
+Snapshot :461, Restore :521) and src/caffe/solvers/sgd_solver.cpp
+(ComputeUpdate :102, ApplyUpdate :119, Normalize/Regularize/
+ComputeUpdateValue :123-247).
+
+The fork's per-iteration ordering contract (solver.cpp:299-305) is preserved
+exactly, but fused into a single XLA computation:
+
+    ForwardBackward -> ComputeUpdate -> ApplyStrategy -> ApplyUpdate -> Fail
+
+so one host dispatch per iteration trains and injects faults, and the whole
+step vmaps over a leading Monte-Carlo fault-config axis (parallel package).
+Episodic host-side work (genetic strategy) splits the step at the
+strategy boundary on its trigger iterations only.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fault import engine as fault_engine
+from ..fault import strategies as fault_strategies
+from ..net import Net
+from ..proto import pb
+from ..utils import io as uio
+from . import updates as U
+from .lr_policies import current_step_fn, learning_rate_fn
+
+
+def _resolve_solver_type(param: "pb.SolverParameter") -> str:
+    """SolverParameter.type string, upgrading the legacy solver_type enum
+    (solver_factory.hpp:73; upgrade_proto.hpp:80)."""
+    if param.HasField("solver_type") and not param.HasField("type"):
+        return U.LEGACY_SOLVER_TYPES[param.solver_type]
+    t = param.type
+    # accept both "SGD" and legacy-style "SGDSolver"
+    return t[:-6] if t.endswith("Solver") else t
+
+
+def _train_net_param(param: "pb.SolverParameter") -> "pb.NetParameter":
+    """Resolve the train net source (Solver::InitTrainNet, solver.cpp:95-130:
+    exactly one of net / net_param / train_net / train_net_param)."""
+    sources = [param.HasField("net"), param.HasField("net_param"),
+               param.HasField("train_net"), param.HasField("train_net_param")]
+    if sum(sources) != 1:
+        raise ValueError("specify exactly one train net source "
+                         f"(got {sum(sources)})")
+    if param.HasField("train_net_param"):
+        return pb.NetParameter.FromString(
+            param.train_net_param.SerializeToString())
+    if param.HasField("net_param"):
+        return pb.NetParameter.FromString(param.net_param.SerializeToString())
+    return uio.read_net_param(param.train_net if param.HasField("train_net")
+                              else param.net)
+
+
+class Solver:
+    """Owns the train/test nets, parameter + history + fault state, and the
+    jitted train step. API mirrors the reference Solver (solver.hpp):
+    step(n), solve(), test_all(), snapshot(), restore(path)."""
+
+    def __init__(self, param, train_feed: Optional[Callable] = None,
+                 test_feeds=None):
+        if isinstance(param, str):
+            param = uio.read_solver_param(param)
+        self.param = param
+        self.type = _resolve_solver_type(param)
+        if self.type not in U.UPDATE_RULES:
+            raise ValueError(f"unknown solver type {self.type!r}")
+        self.iter = 0
+        self.losses: list = []
+        self.smoothed_loss = 0.0
+        self._requested_action = None
+
+        seed = param.random_seed if param.random_seed >= 0 else (
+            int(time.time()) & 0x7FFFFFFF)
+        self._key = jax.random.PRNGKey(seed)
+
+        # --- nets (InitTrainNet/InitTestNets, solver.cpp:95-230) ---
+        net_param = _train_net_param(param)
+        self.net = Net(net_param, pb.TRAIN,
+                       stages=tuple(param.train_state.stage),
+                       level=param.train_state.level)
+        self.test_nets = self._init_test_nets(param)
+
+        # --- parameters & solver history ---
+        self._key, k_init = jax.random.split(self._key)
+        self.params = self.net.init(k_init)
+        self._owner_refs = [r for r in self.net.learnable_params
+                            if r.key == (r.layer_name, r.slot)]
+        # de-dup (a shared owner appears once per consuming layer)
+        seen = set()
+        self._owner_refs = [r for r in self._owner_refs
+                            if not (r.key in seen or seen.add(r.key))]
+        self.history = U.init_history(self.type, self._flat(self.params))
+
+        # --- RRAM fault engine + strategies (InitFailurePattern,
+        # solver.cpp:15-41,134-148) ---
+        self.fault_state = None
+        self.fail_decrement = 100.0  # reference hard-codes batch size 100
+        # (failure_maker.cpp:75 FIXME); override via attribute for other nets
+        self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
+                            for r in self.net.failure_param_refs]
+        self.fc_pairs = self._fc_pairs()
+        if (param.HasField("failure_pattern") and self._fault_keys
+                and param.failure_pattern.type == "gaussian"):
+            # Like FailureMaker::CreateMaker (failure_maker.hpp:23-30), any
+            # other type (e.g. "none") means no fault engine.
+            self._key, k_fault = jax.random.split(self._key)
+            shapes = {k: self._flat(self.params)[k].shape
+                      for k in self._fault_keys}
+            self.fault_state = fault_engine.init_fault_state(
+                k_fault, shapes, param.failure_pattern)
+        self.strategies = fault_strategies.build_strategies(
+            param, self.fc_pairs, prune_net_loader=self._load_prune_net)
+
+        # --- data feeds ---
+        self.train_feed = train_feed or self._default_feed(self.net)
+        if test_feeds is None:
+            test_feeds = [self._default_feed(tn) for tn in self.test_nets]
+        self.test_feeds = test_feeds
+
+        self._lr_fn = learning_rate_fn(param)
+        self._step_fn = None       # jit cache
+        self._test_fns = [None] * len(self.test_nets)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+
+    def _init_test_nets(self, param):
+        """InitTestNets (solver.cpp:156-230): test nets come from
+        test_net_param entries, then test_net files, then the shared
+        net/net_param (one instance per remaining test_iter entry);
+        test_state[i] indexes across ALL instances in that order."""
+        sources = []
+        for tp in param.test_net_param:
+            sources.append(pb.NetParameter.FromString(
+                tp.SerializeToString()))
+        for path in param.test_net:
+            sources.append(uio.read_net_param(path))
+        if len(param.test_iter) > len(sources) and (
+                param.HasField("net") or param.HasField("net_param")):
+            for _ in range(len(param.test_iter) - len(sources)):
+                sources.append(_train_net_param(param))
+        if param.test_state and len(param.test_state) != len(sources):
+            raise ValueError(
+                f"test_state must have one entry per test net "
+                f"({len(param.test_state)} != {len(sources)})")
+        out = []
+        for i, net_param in enumerate(sources):
+            state = (param.test_state[i] if i < len(param.test_state)
+                     else pb.NetState())
+            out.append(Net(net_param, pb.TEST, stages=tuple(state.stage),
+                           level=state.level))
+        return out
+
+    def _fc_pairs(self):
+        """[(weight_key, bias_key|None)] per fault-target FC layer, in
+        failure_learnable_params order (net.cpp:485-493 fc_params_ids_)."""
+        refs = self.net.failure_param_refs
+        pairs = []
+        for i in self.net.fc_params_ids:
+            w = refs[i]
+            wkey = fault_engine.param_key(w.layer_name, w.slot)
+            bkey = None
+            if i + 1 < len(refs) and refs[i + 1].layer_name == w.layer_name:
+                bkey = fault_engine.param_key(refs[i + 1].layer_name,
+                                              refs[i + 1].slot)
+            pairs.append((wkey, bkey))
+        return pairs
+
+    def _load_prune_net(self, net_file: str, model_file: str):
+        """Load the genetic strategy's prune-mask FC weights
+        (GeneticFailureStrategy ctor, strategy.hpp:145-180)."""
+        net = Net(uio.read_net_param(net_file), pb.TEST)
+        params = net.init(jax.random.PRNGKey(0))
+        params = net.copy_trained_from(params, model_file)
+        out = []
+        for i in net.fc_params_ids:
+            r = net.failure_param_refs[i]
+            out.append(np.asarray(params[r.layer_name][r.slot]))
+        return out
+
+    def _default_feed(self, net):
+        if not net.data_source_tops:
+            return lambda: {}
+        from ..data.feed import build_feed
+        return build_feed(net)
+
+    # ------------------------------------------------------------------
+    # flat param views
+
+    def _flat(self, params) -> Dict[str, Any]:
+        return {fault_engine.param_key(r.layer_name, r.slot):
+                params[r.layer_name][r.slot] for r in self._owner_refs}
+
+    def _unflat(self, flat, like) -> Dict[str, list]:
+        out = {ln: list(vals) for ln, vals in like.items()}
+        for r in self._owner_refs:
+            out[r.layer_name][r.slot] = flat[
+                fault_engine.param_key(r.layer_name, r.slot)]
+        return out
+
+    # ------------------------------------------------------------------
+    # the jitted train step
+
+    def make_train_step(self):
+        """Build the pure step function
+        (params, history, fault_state, batch, it, rng, do_remap)
+          -> (params', history', fault_state', loss, outputs)
+        — ForwardBackward + ComputeUpdate + ApplyStrategy + ApplyUpdate +
+        Fail in one traced computation (solver.cpp:238-321)."""
+        net = self.net
+        param = self.param
+        solver_type = self.type
+        rule = U.UPDATE_RULES[solver_type]
+        hp = U.Hyper(param)
+        lr_fn = self._lr_fn
+        iter_size = max(param.iter_size, 1)
+        clip = float(param.clip_gradients)
+        weight_decay = float(param.weight_decay)
+        reg_type = param.regularization_type
+        owner_refs = list(self._owner_refs)
+        fault_keys = list(self._fault_keys)
+        fc_pairs = self.fc_pairs
+        strategies = self.strategies
+        decrement = self.fail_decrement
+        lr_mults = {fault_engine.param_key(r.layer_name, r.slot): r.lr_mult
+                    for r in owner_refs}
+        decay_mults = {fault_engine.param_key(r.layer_name, r.slot):
+                       r.decay_mult for r in owner_refs}
+        flat = self._flat
+        unflat = self._unflat
+        has_fault = self.fault_state is not None
+
+        def forward_backward(params, batch, it, rng):
+            def loss_fn(p):
+                blobs, loss, newp = net.apply(
+                    p, batch, rng=rng, iteration=it, with_updates=True)
+                outputs = {name: blobs[name] for name in net.output_names}
+                return loss, (outputs, newp)
+            (loss, (outputs, newp)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, outputs, newp, grads
+
+        def step(params, history, fault_state, batch, it, rng, do_remap):
+            # -- ForwardBackward x iter_size (solver.cpp:265-269) --
+            if iter_size == 1:
+                loss, outputs, newp, grads = forward_backward(
+                    params, batch, it, rng)
+            else:
+                def body(carry, sub):
+                    p, g_acc, loss_acc, i = carry
+                    l, outs, p2, g = forward_backward(
+                        p, sub, it, jax.random.fold_in(rng, i))
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (p2, g_acc, loss_acc + l, i + 1), outs
+                zero_g = jax.tree.map(jnp.zeros_like, params)
+                (newp, grads, loss, _), outs_seq = jax.lax.scan(
+                    body, (params, zero_g, 0.0, 0), batch)
+                outputs = jax.tree.map(lambda x: x[-1], outs_seq)
+                loss = loss / iter_size
+            data = flat(newp)      # BatchNorm stats already advanced
+            g = flat(grads)
+
+            # -- ComputeUpdate (sgd_solver.cpp:102-117) --
+            rate = lr_fn(it)
+            if clip >= 0:
+                # ClipGradients (sgd_solver.cpp:82-100): global L2 rescale
+                sumsq = sum(jnp.sum(v * v) for v in g.values())
+                l2 = jnp.sqrt(sumsq)
+                scale = jnp.where(l2 > clip, clip / jnp.maximum(l2, 1e-30),
+                                  1.0)
+                g = {k: v * scale for k, v in g.items()}
+            upd = {}
+            new_hist = {}
+            t = it + 1
+            for r in owner_refs:
+                k = fault_engine.param_key(r.layer_name, r.slot)
+                diff = g[k]
+                if iter_size != 1:   # Normalize (sgd_solver.cpp:123)
+                    diff = diff / iter_size
+                # Regularize (sgd_solver.cpp:149-215)
+                local_decay = weight_decay * decay_mults[k]
+                if local_decay:
+                    if reg_type == "L2":
+                        diff = diff + local_decay * data[k]
+                    elif reg_type == "L1":
+                        diff = diff + local_decay * jnp.sign(data[k])
+                    else:
+                        raise ValueError(
+                            f"unknown regularization {reg_type!r}")
+                local_rate = rate * lr_mults[k]
+                upd[k], new_hist[k] = rule(diff, history[k], local_rate,
+                                           hp, t)
+
+            # -- ApplyStrategy (solver.cpp:302; strategy.cpp) --
+            if strategies.threshold is not None and fault_keys:
+                fd = {k: upd[k] for k in fault_keys}
+                fd = fault_strategies.threshold_diffs(
+                    fd, rate, lr_mults, strategies.threshold)
+                upd.update(fd)
+            if strategies.prune_orders is not None and has_fault:
+                def remap(dd):
+                    return fault_strategies.remap_fc_neurons(
+                        dd[0], dd[1], fault_state, fc_pairs,
+                        strategies.prune_orders)
+                data, upd = jax.lax.cond(do_remap, remap,
+                                         lambda dd: dd, (data, upd))
+
+            # -- ApplyUpdate (sgd_solver.cpp:119; blob.cpp:156) --
+            data = {k: data[k] - upd[k] for k in data}
+
+            # -- Fail (solver.cpp:305; failure_maker.cu:23-40) --
+            if has_fault:
+                fp = {k: data[k] for k in fault_keys}
+                fd = {k: upd[k] for k in fault_keys}
+                fp, fault_state = fault_engine.fail(
+                    fp, fault_state, fd, decrement)
+                data.update(fp)
+
+            return (unflat(data, newp), new_hist, fault_state, loss,
+                    outputs)
+
+        return step
+
+    def _compiled_step(self):
+        if self._step_fn is None:
+            self._step_fn = jax.jit(self.make_train_step(),
+                                    donate_argnums=(0, 1, 2))
+        return self._step_fn
+
+    # ------------------------------------------------------------------
+    # host loop
+
+    def _next_batch(self):
+        iter_size = max(self.param.iter_size, 1)
+        if iter_size == 1:
+            return {k: jnp.asarray(v)
+                    for k, v in self.train_feed().items()}
+        subs = [self.train_feed() for _ in range(iter_size)]
+        if not subs[0]:
+            return {}
+        return {k: jnp.stack([jnp.asarray(s[k]) for s in subs])
+                for k in subs[0]}
+
+    def _remap_due(self) -> bool:
+        s = self.strategies
+        if s.prune_orders is None or self.fault_state is None:
+            return False
+        # times_ gating (strategy.cpp:91-93): Apply is called every
+        # iteration, so times_ == iter + 1 at the check.
+        times = self.iter + 1
+        return times >= s.remap_start and (
+            (times - s.remap_start) % s.remap_period == 0)
+
+    def step(self, iters: int):
+        """Run `iters` training iterations (Solver::Step, solver.cpp:238)."""
+        step_fn = self._compiled_step()
+        param = self.param
+        start_iter = self.iter
+        average_loss = max(param.average_loss, 1)
+        genetic = self.strategies.genetic
+        for _ in range(iters):
+            if (param.test_interval and
+                    self.iter % param.test_interval == 0 and
+                    (self.iter > 0 or param.test_initialization)):
+                self.test_all()
+            if genetic is not None and genetic.due():
+                self._apply_genetic(genetic)
+            batch = self._next_batch()
+            rng = jax.random.fold_in(self._key, self.iter)
+            (self.params, self.history, self.fault_state, loss,
+             outputs) = step_fn(
+                self.params, self.history, self.fault_state, batch,
+                jnp.int32(self.iter), rng, self._remap_due())
+            self._update_smoothed_loss(float(loss), start_iter, average_loss)
+            display = param.display and self.iter % param.display == 0
+            if display:
+                lr = float(self._lr_fn(jnp.int32(self.iter)))
+                print(f"Iteration {self.iter}, lr = {lr:g}", flush=True)
+                print(f"Iteration {self.iter}, loss = "
+                      f"{self.smoothed_loss:g}", flush=True)
+                for j, name in enumerate(self.net.output_names):
+                    vals = np.ravel(np.asarray(outputs[name]))
+                    w = self.net.loss_weights.get(name, 0.0)
+                    for v in vals:
+                        extra = (f" (* {w:g} = {w * float(v):g} loss)"
+                                 if w else "")
+                        print(f"    Train net output #{j}: {name} = "
+                              f"{float(v):g}{extra}", flush=True)
+            self.iter += 1
+            if (param.snapshot and self.iter % param.snapshot == 0):
+                self.snapshot()
+            if self._requested_action == "stop":
+                break
+
+    def _apply_genetic(self, genetic):
+        """Episodic host-side genetic strategy between jitted steps (the
+        reference interleaves it mid-step, but the update values it would
+        also permute are consumed immediately by ApplyUpdate, so swapping
+        the weights before the next step is equivalent)."""
+        flat = self._flat(self.params)
+        data = {k: np.array(flat[k]) for k, _ in self._iter_fc_keys()}
+        diffs = {k: np.zeros_like(v) for k, v in data.items()}
+        lifetimes = {k: np.asarray(self.fault_state["lifetimes"][k])
+                     for k in self._fault_keys}
+        genetic.apply(data, diffs, lifetimes)
+        flat = dict(flat)
+        for k, v in data.items():
+            flat[k] = jnp.asarray(v)
+        self.params = self._unflat(flat, self.params)
+
+    def _iter_fc_keys(self):
+        for w, b in self.fc_pairs:
+            yield w, 0
+            if b is not None:
+                yield b, 1
+
+    def _update_smoothed_loss(self, loss, start_iter, average_loss):
+        """UpdateSmoothedLoss (solver.cpp:533-547)."""
+        if len(self.losses) < average_loss:
+            self.losses.append(loss)
+            size = len(self.losses)
+            self.smoothed_loss = ((self.smoothed_loss * (size - 1) + loss)
+                                  / size)
+        else:
+            idx = (self.iter - start_iter) % average_loss
+            self.smoothed_loss += (loss - self.losses[idx]) / average_loss
+            self.losses[idx] = loss
+
+    def solve(self, resume_file: Optional[str] = None):
+        """Solver::Solve (solver.cpp:328-375)."""
+        print(f"Solving {self.net.name}", flush=True)
+        if resume_file:
+            self.restore(resume_file)
+        self.step(self.param.max_iter - self.iter)
+        if (self.param.snapshot_after_train and
+                (not self.param.snapshot or
+                 self.iter % self.param.snapshot != 0)):
+            self.snapshot()
+        if self.param.display and self.iter % self.param.display == 0:
+            print(f"Iteration {self.iter}, loss = {self.smoothed_loss:g}",
+                  flush=True)
+        if (self.param.test_interval and
+                self.iter % self.param.test_interval == 0):
+            self.test_all()
+        print("Optimization Done.", flush=True)
+
+    # ------------------------------------------------------------------
+    # evaluation (Solver::Test, solver.cpp:386-459)
+
+    def _test_fn(self, idx):
+        if self._test_fns[idx] is None:
+            net = self.test_nets[idx]
+
+            def run(params, batch, rng):
+                blobs, loss = net.apply(params, batch, rng=rng)
+                out = {n: blobs[n] for n in net.output_names}
+                if self.param.test_compute_loss:
+                    out["__loss"] = loss
+                return out
+            self._test_fns[idx] = jax.jit(run)
+        return self._test_fns[idx]
+
+    def test(self, idx: int = 0):
+        net = self.test_nets[idx]
+        feed = self.test_feeds[idx]
+        fn = self._test_fn(idx)
+        test_iter = (self.param.test_iter[idx]
+                     if idx < len(self.param.test_iter) else 1)
+        totals: Dict[str, np.ndarray] = {}
+        loss_total = 0.0
+        for i in range(test_iter):
+            batch = {k: jnp.asarray(v) for k, v in feed().items()}
+            rng = jax.random.fold_in(self._key, (self.iter << 16) + i)
+            out = fn(self.params, batch, rng)
+            if "__loss" in out:
+                loss_total += float(out.pop("__loss"))
+            for k, v in out.items():
+                v = np.ravel(np.asarray(v))
+                totals[k] = totals.get(k, 0.0) + v
+        print(f"Iteration {self.iter}, Testing net (#{idx})", flush=True)
+        if self.param.test_compute_loss:
+            print(f"Test loss: {loss_total / test_iter:g}", flush=True)
+        scores = {}
+        i = 0
+        for name in net.output_names:
+            mean = totals[name] / test_iter
+            w = net.loss_weights.get(name, 0.0)
+            for v in np.ravel(mean):
+                extra = f" (* {w:g} = {w * float(v):g} loss)" if w else ""
+                print(f"    Test net output #{i}: {name} = {float(v):g}"
+                      f"{extra}", flush=True)
+                i += 1
+            scores[name] = float(np.ravel(mean)[0])
+        return scores
+
+    def test_all(self):
+        return [self.test(i) for i in range(len(self.test_nets))]
+
+    # ------------------------------------------------------------------
+    # snapshot / restore (solver.cpp:461-532, sgd_solver.cpp:250-356)
+
+    def snapshot_filename(self, ext: str) -> str:
+        return f"{self.param.snapshot_prefix}_iter_{self.iter}{ext}"
+
+    def _history_blob_list(self):
+        """History in reference order: first bank for every param, then the
+        second bank (AdamPreSolve/AdaDeltaPreSolve append after PreSolve)."""
+        slots = U.history_slots(self.type)
+        keys = [fault_engine.param_key(r.layer_name, r.slot)
+                for r in self._owner_refs]
+        return [np.asarray(self.history[k][s]) for s in slots for k in keys]
+
+    def _set_history_from_list(self, blobs):
+        slots = U.history_slots(self.type)
+        keys = [fault_engine.param_key(r.layer_name, r.slot)
+                for r in self._owner_refs]
+        if len(blobs) != len(slots) * len(keys):
+            raise ValueError(
+                f"Incorrect length of history blobs: {len(blobs)} != "
+                f"{len(slots) * len(keys)}")
+        i = 0
+        for s in slots:
+            for k in keys:
+                self.history[k] = dict(self.history[k])
+                self.history[k][s] = jnp.asarray(blobs[i]).reshape(
+                    self.history[k][s].shape)
+                i += 1
+
+    def snapshot(self):
+        os.makedirs(os.path.dirname(self.param.snapshot_prefix) or ".",
+                    exist_ok=True)
+        use_hdf5 = (self.param.snapshot_format ==
+                    pb.SolverParameter.HDF5)
+        if use_hdf5:
+            model_name = self.snapshot_filename(".caffemodel.h5")
+            uio.write_net_hdf5(self.net.to_proto(self.params), model_name)
+            state_name = self.snapshot_filename(".solverstate.h5")
+            uio.write_solver_state_hdf5(
+                state_name, self.iter, model_name,
+                int(current_step_fn(self.param)(jnp.int32(self.iter))),
+                self._history_blob_list())
+        else:
+            model_name = self.snapshot_filename(".caffemodel")
+            uio.write_proto_binary(model_name, self.net.to_proto(self.params))
+            state = pb.SolverState(
+                iter=self.iter, learned_net=model_name,
+                current_step=int(current_step_fn(self.param)(
+                    jnp.int32(self.iter))))
+            for arr in self._history_blob_list():
+                uio.array_to_blob(arr, state.history.add())
+            state_name = self.snapshot_filename(".solverstate")
+            uio.write_proto_binary(state_name, state)
+        if self.fault_state is not None:
+            # NEW vs reference: persist RRAM fault state so resume continues
+            # the same crossbar degradation (the reference re-draws,
+            # SURVEY §5.4 gap).
+            uio.write_proto_binary(
+                self.snapshot_filename(".faultstate"),
+                fault_engine.fault_state_to_proto(self.fault_state))
+        print(f"Snapshotting to {model_name}", flush=True)
+        return model_name
+
+    def restore(self, state_file: str):
+        if state_file.endswith(".h5"):
+            it, learned_net, cur_step, hist = uio.read_solver_state_hdf5(
+                state_file)
+        else:
+            state = uio.read_proto_binary(state_file, pb.SolverState())
+            it, learned_net, cur_step = (state.iter, state.learned_net,
+                                         state.current_step)
+            hist = [uio.blob_to_array(b) for b in state.history]
+        self.iter = int(it)
+        if learned_net:
+            self.params = self.net.copy_trained_from(self.params, learned_net)
+        self._set_history_from_list(hist)
+        fault_file = state_file
+        if fault_file.endswith(".h5"):
+            fault_file = fault_file[:-len(".h5")]
+        if fault_file.endswith(".solverstate"):
+            fault_file = fault_file[:-len(".solverstate")] + ".faultstate"
+        if self.fault_state is not None and os.path.exists(fault_file):
+            self.fault_state = fault_engine.fault_state_from_proto(
+                uio.read_proto_binary(fault_file, pb.NetParameter()))
+
+    # observability -----------------------------------------------------
+    def broken_fraction(self) -> float:
+        if self.fault_state is None:
+            return 0.0
+        return float(fault_engine.broken_fraction(self.fault_state))

@@ -1,0 +1,122 @@
+"""The six SGD-family per-parameter update rules, numerically exact to the
+reference (src/caffe/solvers/*_solver.cpp CPU paths).
+
+Each rule is a pure function
+    rule(diff, slots, local_rate, hp, t) -> (update_value, new_slots)
+where `diff` is the regularized gradient, `slots` the per-param history
+pytree (one array per named slot), `local_rate` = global rate * lr_mult, and
+`t` = iter + 1 (Adam's bias-correction step count, adam_solver.cpp:41).
+The solver then applies `data -= update_value` (blob.cpp:156 Update) —
+after the RRAM strategy pass edits the update values (solver.cpp:299-305).
+
+Multi-slot history serializes to the reference .solverstate layout: the
+history list is [slot0 of every param] + [slot1 of every param]
+(AdamSolver::AdamPreSolve / AdaDeltaPreSolve append the second bank after
+SGDSolver::PreSolve's first).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Hyper:
+    """Update-rule hyperparameters pulled from SolverParameter."""
+
+    def __init__(self, param):
+        self.momentum = jnp.float32(param.momentum)
+        self.momentum2 = jnp.float32(param.momentum2)   # Adam beta2
+        self.delta = jnp.float32(param.delta)
+        self.rms_decay = jnp.float32(param.rms_decay)
+
+
+def sgd(diff, slots, local_rate, hp, t):
+    """history = local_rate*diff + momentum*history; update = history
+    (sgd_solver.cpp:217-247 ComputeUpdateValue)."""
+    h = local_rate * diff + hp.momentum * slots["h"]
+    return h, {"h": h}
+
+
+def nesterov(diff, slots, local_rate, hp, t):
+    """update = (1+m)*h_new - m*h_old (nesterov_solver.cpp:9-35)."""
+    h_old = slots["h"]
+    h = local_rate * diff + hp.momentum * h_old
+    return (1.0 + hp.momentum) * h - hp.momentum * h_old, {"h": h}
+
+
+def adagrad(diff, slots, local_rate, hp, t):
+    """h += diff^2; update = local_rate * diff / (sqrt(h) + delta)
+    (adagrad_solver.cpp:9-46)."""
+    h = slots["h"] + diff * diff
+    return local_rate * diff / (jnp.sqrt(h) + hp.delta), {"h": h}
+
+
+def rmsprop(diff, slots, local_rate, hp, t):
+    """h = rms_decay*h + (1-rms_decay)*diff^2; update = local_rate * diff /
+    (sqrt(h) + delta) (rmsprop_solver.cpp:10-46)."""
+    h = hp.rms_decay * slots["h"] + (1.0 - hp.rms_decay) * diff * diff
+    return local_rate * diff / (jnp.sqrt(h) + hp.delta), {"h": h}
+
+
+def adadelta(diff, slots, local_rate, hp, t):
+    """h1 tracks gradient RMS, h2 update RMS; v = diff *
+    sqrt((delta+h2)/(delta+h1)); update = local_rate * v
+    (adadelta_solver.cpp:19-77; momentum plays the decay role)."""
+    m = hp.momentum
+    h1 = m * slots["h"] + (1.0 - m) * diff * diff
+    v = diff * jnp.sqrt((hp.delta + slots["h2"]) / (hp.delta + h1))
+    h2 = m * slots["h2"] + (1.0 - m) * v * v
+    return local_rate * v, {"h": h1, "h2": h2}
+
+
+def adam(diff, slots, local_rate, hp, t):
+    """m,v moments with sqrt(1-b2^t)/(1-b1^t) correction
+    (adam_solver.cpp:19-80; momentum=beta1, momentum2=beta2, delta=eps)."""
+    b1, b2 = hp.momentum, hp.momentum2
+    m = b1 * slots["h"] + (1.0 - b1) * diff
+    v = b2 * slots["h2"] + (1.0 - b2) * diff * diff
+    tf = t.astype(jnp.float32) if hasattr(t, "astype") else jnp.float32(t)
+    correction = jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+    return (local_rate * correction * m / (jnp.sqrt(v) + hp.delta),
+            {"h": m, "h2": v})
+
+
+UPDATE_RULES = {
+    "SGD": sgd,
+    "Nesterov": nesterov,
+    "AdaGrad": adagrad,
+    "RMSProp": rmsprop,
+    "AdaDelta": adadelta,
+    "Adam": adam,
+}
+
+# slot names per solver type; "h2" is the second history bank appended after
+# the first in the reference's .solverstate history list.
+HISTORY_SLOTS = {
+    "SGD": ("h",),
+    "Nesterov": ("h",),
+    "AdaGrad": ("h",),
+    "RMSProp": ("h",),
+    "AdaDelta": ("h", "h2"),
+    "Adam": ("h", "h2"),
+}
+
+# Legacy SolverParameter.solver_type enum -> type string
+# (upgrade_proto.hpp:80 UpgradeSolverAsNeeded).
+LEGACY_SOLVER_TYPES = ["SGD", "Nesterov", "AdaGrad", "RMSProp", "AdaDelta",
+                       "Adam"]
+
+
+def history_slots(solver_type: str) -> Tuple[str, ...]:
+    return HISTORY_SLOTS[solver_type]
+
+
+def init_history(solver_type: str,
+                 param_arrays: Dict[str, jax.Array]) -> Dict[str, Dict]:
+    """Zero history banks shaped like each learnable param
+    (SGDSolver::PreSolve, sgd_solver.cpp:93-105)."""
+    slots = HISTORY_SLOTS[solver_type]
+    return {key: {s: jnp.zeros_like(arr) for s in slots}
+            for key, arr in param_arrays.items()}

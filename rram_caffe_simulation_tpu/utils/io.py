@@ -37,6 +37,8 @@ def write_proto_binary(path: str, message) -> None:
 
 def read_net_param(path: str) -> "pb.NetParameter":
     net = pb.NetParameter()
+    if path.endswith((".h5", ".hdf5")):
+        return read_net_hdf5(path)
     if path.endswith((".caffemodel", ".binaryproto", ".pb")):
         return read_proto_binary(path, net)
     return read_proto_text(path, net)
@@ -80,3 +82,59 @@ def read_blob_from_file(path: str) -> np.ndarray:
     """Read a single serialized BlobProto (e.g. a mean file or an infogain
     H matrix, reference io.hpp ReadProtoFromBinaryFile + Blob::FromProto)."""
     return blob_to_array(read_proto_binary(path, pb.BlobProto()))
+
+
+# ---------------------------------------------------------------------------
+# HDF5 snapshot formats (reference: net.cpp:883-930 ToHDF5 layout
+# /data/<layer>/<param_index>, net.cpp:821-860 CopyTrainedLayersFromHDF5;
+# sgd_solver.cpp:283-356 solver state fields iter/learned_net/current_step +
+# /history/<i>).
+
+def write_net_hdf5(net_param: "pb.NetParameter", path: str,
+                   write_diff: bool = False) -> None:
+    import h5py
+    with h5py.File(path, "w") as f:
+        data = f.create_group("data")
+        for lp in net_param.layer:
+            g = data.create_group(lp.name)
+            for i, b in enumerate(lp.blobs):
+                g.create_dataset(str(i), data=blob_to_array(b))
+
+
+def read_net_hdf5(path: str) -> "pb.NetParameter":
+    import h5py
+    out = pb.NetParameter()
+    with h5py.File(path, "r") as f:
+        for name in f["data"]:
+            lp = out.layer.add()
+            lp.name = name
+            g = f["data"][name]
+            for i in sorted(g, key=int):
+                array_to_blob(np.asarray(g[i]), lp.blobs.add())
+    return out
+
+
+def write_solver_state_hdf5(path: str, iteration: int, learned_net: str,
+                            current_step: int, history) -> None:
+    import h5py
+    with h5py.File(path, "w") as f:
+        f.create_dataset("iter", data=np.int64(iteration))
+        f.create_dataset("learned_net",
+                         data=np.bytes_(learned_net.encode()))
+        f.create_dataset("current_step", data=np.int64(current_step))
+        g = f.create_group("history")
+        for i, arr in enumerate(history):
+            g.create_dataset(str(i), data=np.asarray(arr))
+
+
+def read_solver_state_hdf5(path: str):
+    import h5py
+    with h5py.File(path, "r") as f:
+        it = int(np.asarray(f["iter"]))
+        learned = np.asarray(f["learned_net"]).item()
+        if isinstance(learned, bytes):
+            learned = learned.decode()
+        cur = int(np.asarray(f["current_step"]))
+        g = f["history"]
+        hist = [np.asarray(g[i]) for i in sorted(g, key=int)]
+    return it, learned, cur, hist

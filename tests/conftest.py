@@ -8,8 +8,10 @@ compiles and executes exactly as it would across a real TPU slice.
 import os
 import sys
 
-# Force CPU: the session presets JAX_PLATFORMS=axon (real TPU); tests run on
-# a deterministic 8-device virtual CPU mesh instead.
+# Force CPU: the session presets JAX_PLATFORMS=axon (real TPU) and its
+# sitecustomize registers the axon backend in every process, so the env var
+# alone is not enough — the config update below overrides it. Tests run on a
+# deterministic 8-device virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -20,4 +22,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)  # float64 available for grad checks
