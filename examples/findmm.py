@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Print per-parameter min/max of a trained model — parity with the
+reference's examples/cifar10/findmm.py."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from rram_caffe_simulation_tpu import api as caffe  # noqa: E402
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(f"usage: {argv[0]} <net.prototxt> <weights.caffemodel>")
+        return 1
+    net = caffe.Net(argv[1], argv[2], caffe.TEST)
+    for name, blobs in net.params.items():
+        for i, blob in enumerate(blobs):
+            print(f"{name}[{i}]: min = {blob.data.min():g}, "
+                  f"max = {blob.data.max():g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Magnitude-prune FC layers of a pretrained model and emit the neuron
+ordering file consumed by the remapping strategy — parity with the
+reference's gaussian_failure/prune_order.py (same CLI, same output format:
+one line of space-separated neuron indices per hidden FC group, ascending
+by zero-weight count after pruning).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("proto")
+    p.add_argument("model")
+    p.add_argument("prune_ratio", type=float)
+    p.add_argument("output_file")
+    args = p.parse_args(argv)
+    print(f"proto: {args.proto}; model: {args.model}; "
+          f"prune_ratio: {args.prune_ratio}; "
+          f"output_file: {args.output_file}")
+
+    from rram_caffe_simulation_tpu import api as caffe
+
+    net = caffe.Net(args.proto, args.model, caffe.TEST)
+    fc_weights = []
+    for key, value in net.params.items():
+        # the reference selects layers by "fc" name prefix
+        # (prune_order.py:33); we use the fault-target flag, which matches
+        # InnerProduct layers regardless of naming
+        layer = net.layer_dict[key]
+        if getattr(layer, "fault_target", False):
+            weights = value[0].data
+            flat = weights.flatten()
+            rank = np.argsort(np.abs(flat))
+            flat[rank[:int(rank.size * args.prune_ratio)]] = 0
+            np.copyto(weights, flat.reshape(weights.shape))
+            fc_weights.append(weights)
+
+    with open(args.output_file, "w") as wf:
+        for i in range(1, len(fc_weights)):
+            zero_nums = ((fc_weights[i - 1] == 0).sum(axis=1) +
+                         (fc_weights[i] == 0).sum(axis=0))
+            indexes = np.argsort(zero_nums)
+            wf.write(" ".join(str(x) for x in indexes))
+            wf.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Mean-lifetime grid sweep — replaces the reference's
+run_different_mean.sh (which fanned 3 configs across 3 GPUs as separate
+processes): here one invocation trains every config simultaneously on the
+vmapped fault axis of a single TPU.
+
+    python run_different_mean.py 1e8 2e8 4e8 [--std 3e7] [--max-iter N]
+"""
+import argparse
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("means", nargs="+", type=float)
+    p.add_argument("--std", type=float, default=3e7)
+    p.add_argument("--max-iter", type=int, default=0)
+    p.add_argument("--tag", default="")
+    args = p.parse_args(argv)
+
+    from run_gaussian_exp import main as run
+    run_args = [str(args.means[0]), str(args.std), "0", "-y",
+                "--tag", args.tag or "_meansweep",
+                "--sweep-means", ",".join(str(m) for m in args.means)]
+    if args.max_iter:
+        run_args += ["--max-iter", str(args.max_iter)]
+    return run(run_args)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, HERE)
+    sys.exit(main())

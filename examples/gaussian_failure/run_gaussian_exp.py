@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""RRAM fault experiment runner — CLI-compatible with the reference's
+examples/cifar10/gaussian_failure/run_gaussian_exp.py (same positional
+mean/std/device and -t/-r/-g/--prob/--tag flags, same solver patching and
+snapshot-dir layout, same tee'd log), plus the TPU-native --sweep mode that
+replaces the one-process-per-config GPU fan-out (run_different_mean.sh)
+with a single vmapped Monte-Carlo sweep.
+"""
+import argparse
+import contextlib
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, ROOT)
+
+from google.protobuf import text_format  # noqa: E402
+
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("mean", type=float)
+    p.add_argument("std", type=float)
+    p.add_argument("device_id", type=int,
+                   help="kept for CLI parity; TPU devices come from the mesh")
+    p.add_argument("-t", "--threshold", default=-1, type=float)
+    p.add_argument("-r", "--remapping", default="",
+                   help="<prune_order_file>[,<period>[,<start>]]")
+    p.add_argument("-g", "--genetic", default="",
+                   help="<prune_prototxt>,<prune_model>[,<switch_time>"
+                        "[,<period>[,<start>]]]")
+    p.add_argument("--tag", default="", help="suffix tag")
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--prob", type=int, default=-1,
+                   help="probability percentage for +-1 (0~100)")
+    p.add_argument("-y", "--yes", action="store_true")
+    p.add_argument("--template",
+                   default=os.path.join(
+                       ROOT, "models/cifar10_vgg11/"
+                       "cifar10_vgg11_template.prototxt"))
+    p.add_argument("--max-iter", type=int, default=0,
+                   help="override template max_iter (testing)")
+    p.add_argument("--sweep-means", default="",
+                   help="comma list of lifetime means: train ALL configs "
+                        "simultaneously via the vmapped fault axis")
+    p.add_argument("--sweep-stds", default="")
+    return p.parse_args(argv)
+
+
+def build_solver_param(args) -> "pb.SolverParameter":
+    """Patch the template exactly like the reference runner
+    (run_gaussian_exp.py:45-103)."""
+    message = pb.SolverParameter()
+    with open(args.template) as f:
+        text_format.Merge(f.read(), message)
+    message.failure_pattern.type = "gaussian"
+    message.failure_pattern.mean = args.mean
+    message.failure_pattern.std = args.std
+    message.device_id = args.device_id
+    if args.max_iter:
+        message.max_iter = args.max_iter
+    if args.threshold > 0:
+        message.failure_strategy.add(type="threshold",
+                                     threshold=args.threshold)
+    if args.remapping:
+        stra = args.remapping.split(",")
+        sp = message.failure_strategy.add(type="remapping",
+                                          prune_order_file=stra[0])
+        if len(stra) > 1:
+            sp.period = int(stra[1])
+        if len(stra) > 2:
+            sp.start = int(stra[2])
+    if args.genetic:
+        stra = args.genetic.split(",")
+        sp = message.failure_strategy.add(type="genetic",
+                                          prune_net_file=stra[0],
+                                          prune_model_file=stra[1])
+        if len(stra) > 2:
+            sp.switch_time = int(stra[2])
+        if len(stra) > 3:
+            sp.period = int(stra[3])
+        if len(stra) > 4:
+            sp.start = int(stra[4])
+    if args.prob >= 0:
+        assert args.prob < 50
+        fp = message.failure_pattern.failure_prob
+        fp.neg = fp.pos = args.prob
+        fp.zero = 100 - 2 * args.prob
+    return message
+
+
+class Tee:
+    def __init__(self, path):
+        self.f = open(path, "w")
+
+    def write(self, s):
+        sys.__stdout__.write(s)
+        self.f.write(s)
+
+    def flush(self):
+        sys.__stdout__.flush()
+        self.f.flush()
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    strategy_suffix = ""
+    if args.threshold > 0:
+        strategy_suffix += f"_threshold_{args.threshold}"
+    if args.remapping:
+        strategy_suffix += f"_remapping_{args.remapping.split(',')[0]}"
+    if args.genetic:
+        strategy_suffix += f"_genetic_{args.genetic}"
+    message = build_solver_param(args)
+
+    snapshot_prefix = (f"snapshot_{args.mean}_{args.std}"
+                       f"{strategy_suffix}{args.tag}")
+    if os.path.exists(snapshot_prefix):
+        if not args.yes:
+            yes = input(f"{snapshot_prefix} already exists, remove? (y/n): ")
+            if yes.lower() not in {"y", "yes"}:
+                sys.exit()
+        shutil.rmtree(snapshot_prefix)
+    os.makedirs(snapshot_prefix)
+    message.snapshot_prefix = snapshot_prefix + "/"
+
+    solver_dir = os.path.join(HERE, "solvers")
+    os.makedirs(solver_dir, exist_ok=True)
+    solver_fname = os.path.join(
+        solver_dir,
+        f"solver_{args.mean}_{args.std}{strategy_suffix}{args.tag}"
+        ".prototxt")
+    with open(solver_fname, "w") as f:
+        f.write(text_format.MessageToString(message))
+    print(f"New solver prototxt write to {solver_fname}.")
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    tee = Tee(os.path.join(snapshot_prefix, "log"))
+    with contextlib.redirect_stdout(tee):
+        # log the solver config so plot_pic-style scrapers find
+        # test_interval (plot_pic.py:16)
+        print(text_format.MessageToString(message))
+        if args.sweep_means:
+            from rram_caffe_simulation_tpu.parallel import SweepRunner
+            import numpy as np
+            means = [float(x) for x in args.sweep_means.split(",")]
+            stds = ([float(x) for x in args.sweep_stds.split(",")]
+                    if args.sweep_stds else None)
+            solver = Solver(message)
+            runner = SweepRunner(solver, n_configs=len(means),
+                                 means=np.asarray(means, np.float32),
+                                 stds=(np.asarray(stds, np.float32)
+                                       if stds else None))
+            interval = message.display or 100
+            for start in range(0, message.max_iter, interval):
+                loss, _ = runner.step(min(interval,
+                                          message.max_iter - start))
+                fracs = runner.broken_fractions()
+                for ci, m in enumerate(means):
+                    print(f"config {ci} (mean={m:g}): Iteration "
+                          f"{runner.iter}, loss = {loss[ci]:.5g}, "
+                          f"broken = {fracs[ci]:.4f}")
+        else:
+            solver = Solver(message)
+            solver.solve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

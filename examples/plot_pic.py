@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Accuracy/loss table + plot from a training log — parity with the
+reference's examples/cifar10/plot_pic.py (same regex scrape of
+`accuracy = X ... loss = Y` pairs, same table format, matplotlib plot when
+DISPLAY is available)."""
+import argparse
+import os
+import re
+import sys
+
+import numpy as np
+
+p = argparse.ArgumentParser()
+p.add_argument("log", help="the log file")
+p.add_argument("-n", "--no-plot", help="do not plot", action="store_true")
+args = p.parse_args()
+
+with open(args.log) as f:
+    content = f.read()
+
+m = re.search(r"test_interval: (\d+)", content)
+assert m is not None, "log must contain the solver config"
+test_interval = int(m.group(1))
+
+pattern = re.compile(r"accuracy = (?P<acc>[\d.]+).*?loss = (?P<loss>[\d.]+)",
+                     re.DOTALL)
+acc_list, loss_list = [], []
+for match in pattern.finditer(content):
+    acc_list.append(float(match.group("acc")))
+    loss_list.append(float(match.group("loss")))
+
+print("iter     accuracy    loss")
+for it, acc, loss in zip(np.arange(len(acc_list)) * test_interval,
+                         acc_list, loss_list):
+    print(f"{it:<8}    {acc:<12}    {loss:<12}")
+
+if not args.no_plot and os.environ.get("DISPLAY"):
+    from matplotlib import pyplot as plt
+    fig, ax1 = plt.subplots()
+    xs = np.arange(len(acc_list)) * test_interval
+    ax1.plot(xs, acc_list, "b-", label="accuracy")
+    ax2 = ax1.twinx()
+    ax2.plot(xs, loss_list, "r-", label="loss")
+    ax1.set_xlabel("iteration")
+    ax1.set_ylabel("accuracy")
+    ax2.set_ylabel("loss")
+    plt.show()

@@ -1,0 +1,45 @@
+"""pycaffe-compatible front door (reference: python/caffe/__init__.py,
+pycaffe.py, _caffe.cpp).
+
+    from rram_caffe_simulation_tpu import api as caffe
+    net = caffe.Net("deploy.prototxt", "weights.caffemodel", caffe.TEST)
+    out = net.forward(data=x)
+    solver = caffe.SGDSolver("solver.prototxt"); solver.step(100)
+
+Mode/device selection (set_mode_cpu/set_mode_gpu/set_device,
+common.hpp:102-160) are accepted no-ops: the device is the JAX platform.
+"""
+from ..proto import pb
+from .pynet import Net, Blob
+from .pysolver import (SGDSolver, NesterovSolver, AdaGradSolver,
+                       RMSPropSolver, AdaDeltaSolver, AdamSolver,
+                       get_solver)
+from .net_spec import NetSpec, layers, params, to_proto
+from . import io  # noqa: F401
+
+TRAIN = pb.TRAIN
+TEST = pb.TEST
+
+
+def set_mode_cpu():
+    """No-op shim (caffe.set_mode_cpu): backend comes from JAX platform."""
+
+
+def set_mode_gpu():
+    """No-op shim: the accelerator backend is already the default."""
+
+
+def set_device(device_id: int):
+    """No-op shim: device placement is mesh-driven (parallel package)."""
+
+
+def set_random_seed(seed: int):
+    import numpy as np
+    np.random.seed(seed)
+
+
+__all__ = ["Net", "Blob", "SGDSolver", "NesterovSolver", "AdaGradSolver",
+           "RMSPropSolver", "AdaDeltaSolver", "AdamSolver", "get_solver",
+           "NetSpec", "layers", "params", "to_proto", "io",
+           "TRAIN", "TEST", "set_mode_cpu", "set_mode_gpu", "set_device",
+           "set_random_seed"]

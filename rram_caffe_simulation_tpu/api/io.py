@@ -1,0 +1,176 @@
+"""caffe.io: array/image transforms and BlobProto conversion (reference:
+python/caffe/io.py — blobproto_to_array :19, array_to_blobproto :36,
+load_image :279, resize_image :300, oversample :334, Transformer :98)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..proto import pb
+from ..utils.io import blob_to_array as blobproto_to_array_impl
+
+
+def blobproto_to_array(blob: "pb.BlobProto", return_diff: bool = False):
+    if return_diff:
+        shape = blobproto_to_array_impl(blob).shape
+        return np.asarray(blob.diff, np.float32).reshape(shape)
+    return blobproto_to_array_impl(blob)
+
+
+def array_to_blobproto(arr: np.ndarray, diff=None) -> "pb.BlobProto":
+    from ..utils.io import array_to_blob
+    blob = array_to_blob(arr)
+    if diff is not None:
+        blob.diff.extend(np.asarray(diff).astype(float).flat)
+    return blob
+
+
+def arraylist_to_blobprotovector_str(arraylist) -> bytes:
+    vec = pb.BlobProtoVector()
+    vec.blobs.extend([array_to_blobproto(a) for a in arraylist])
+    return vec.SerializeToString()
+
+
+def blobprotovector_str_to_arraylist(s: bytes):
+    vec = pb.BlobProtoVector.FromString(s)
+    return [blobproto_to_array(b) for b in vec.blobs]
+
+
+def datum_to_array(datum: "pb.Datum") -> np.ndarray:
+    from ..data.db import datum_to_array as impl
+    return impl(datum)[0]
+
+
+def array_to_datum(arr: np.ndarray, label=None) -> "pb.Datum":
+    from ..data.db import array_to_datum as impl
+    return impl(arr, 0 if label is None else label)
+
+
+def load_image(filename: str, color: bool = True) -> np.ndarray:
+    """Load image as float [0,1] HxWxC RGB (io.py:279 skimage semantics)."""
+    from PIL import Image
+    img = Image.open(filename).convert("RGB" if color else "L")
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if not color:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize_image(im: np.ndarray, new_dims, interp_order: int = 1):
+    """Resize HxWxC image (io.py:300)."""
+    from PIL import Image
+    resample = Image.BILINEAR if interp_order == 1 else Image.NEAREST
+    scale = im.max() if im.max() > 0 else 1.0
+    chans = []
+    for c in range(im.shape[2]):
+        img = Image.fromarray((im[:, :, c] / scale * 255).astype(np.uint8))
+        img = img.resize((new_dims[1], new_dims[0]), resample)
+        chans.append(np.asarray(img, np.float32) / 255.0 * scale)
+    return np.stack(chans, axis=2)
+
+
+def oversample(images, crop_dims):
+    """10-crop oversampling: 4 corners + center, mirrored (io.py:334)."""
+    im_shape = np.array(images[0].shape[:2])
+    crop_dims = np.array(crop_dims)
+    im_center = im_shape / 2.0
+    h_indices = (0, im_shape[0] - crop_dims[0])
+    w_indices = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), dtype=int)
+    curr = 0
+    for i in h_indices:
+        for j in w_indices:
+            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            curr += 1
+    crops_ix[4] = np.tile(im_center, 2) + np.concatenate(
+        [-crop_dims / 2.0, crop_dims / 2.0])
+    crops_ix = np.tile(crops_ix, (2, 1))   # 10 crops: 5 + 5 mirrored
+    all_crops = np.empty((10 * len(images), crop_dims[0], crop_dims[1],
+                          images[0].shape[-1]), dtype=np.float32)
+    ix = 0
+    for im in images:
+        for crop in crops_ix:
+            all_crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
+            ix += 1
+        all_crops[ix - 5:ix] = all_crops[ix - 5:ix, :, ::-1, :]  # mirror
+    return all_crops
+
+
+class Transformer:
+    """Preprocessing pipeline keyed by input blob name (io.py:98):
+    transpose, channel_swap, raw_scale, mean, input_scale."""
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.transpose = {}
+        self.channel_swap = {}
+        self.raw_scale = {}
+        self.mean = {}
+        self.input_scale = {}
+
+    def _check(self, in_):
+        if in_ not in self.inputs:
+            raise Exception(f"{in_} is not one of the net inputs: "
+                            f"{self.inputs}")
+
+    def set_transpose(self, in_, order):
+        self._check(in_)
+        self.transpose[in_] = order
+
+    def set_channel_swap(self, in_, order):
+        self._check(in_)
+        self.channel_swap[in_] = order
+
+    def set_raw_scale(self, in_, scale):
+        self._check(in_)
+        self.raw_scale[in_] = scale
+
+    def set_mean(self, in_, mean):
+        self._check(in_)
+        self.mean[in_] = mean
+
+    def set_input_scale(self, in_, scale):
+        self._check(in_)
+        self.input_scale[in_] = scale
+
+    def preprocess(self, in_, data):
+        """io.py:127 order: resize -> transpose -> channel_swap ->
+        raw_scale -> mean subtract -> input_scale."""
+        self._check(in_)
+        data = np.asarray(data, np.float32)
+        in_dims = self.inputs[in_][2:]
+        if data.shape[:2] != tuple(in_dims):
+            data = resize_image(data, in_dims)
+        if in_ in self.transpose:
+            data = data.transpose(self.transpose[in_])
+        if in_ in self.channel_swap:
+            data = data[np.asarray(self.channel_swap[in_]), :, :]
+        if in_ in self.raw_scale:
+            data = data * self.raw_scale[in_]
+        if in_ in self.mean:
+            mean = self.mean[in_]
+            if mean.ndim == 1:
+                mean = mean[:, None, None]
+            data = data - mean
+        if in_ in self.input_scale:
+            data = data * self.input_scale[in_]
+        return data
+
+    def deprocess(self, in_, data):
+        """Invert preprocess (io.py:161)."""
+        self._check(in_)
+        data = np.asarray(data, np.float32).copy().squeeze()
+        if in_ in self.input_scale:
+            data = data / self.input_scale[in_]
+        if in_ in self.mean:
+            mean = self.mean[in_]
+            if mean.ndim == 1:
+                mean = mean[:, None, None]
+            data = data + mean
+        if in_ in self.raw_scale:
+            data = data / self.raw_scale[in_]
+        if in_ in self.channel_swap:
+            order = np.argsort(self.channel_swap[in_])
+            data = data[order, :, :]
+        if in_ in self.transpose:
+            data = data.transpose(np.argsort(self.transpose[in_]))
+        return data

@@ -1,0 +1,212 @@
+"""pycaffe Net facade: dict-like blobs/params with mutable numpy views,
+kwargs forward/backward.
+
+Reference surface: python/caffe/pycaffe.py (_Net_forward :78, _Net_backward
+:127, _Net_forward_all :175, blobs/params properties) and _caffe.cpp
+(Net_Init_Load :301, numpy zero-copy blob views).
+
+Functional-core note: the JAX net is pure; this facade keeps host numpy
+mirrors (net surgery mutates Blob.data in place, exactly like pycaffe) and
+feeds them through the jitted apply on every forward.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..net import Net as CoreNet
+from ..proto import pb
+from ..utils.io import read_net_param
+
+
+class Blob:
+    """Mutable host mirror of a blob (data + diff), pycaffe-style."""
+
+    def __init__(self, arr):
+        # own a writable copy (np views of jax arrays are read-only,
+        # and pycaffe semantics require in-place mutation / net surgery)
+        self.data = np.array(arr, dtype=np.float32)
+        self.diff = np.zeros_like(self.data)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def num(self):
+        return self.data.shape[0]
+
+    @property
+    def channels(self):
+        return self.data.shape[1] if self.data.ndim > 1 else 1
+
+    @property
+    def count(self):
+        return self.data.size
+
+    def reshape(self, *shape):
+        self.data = np.zeros(shape, np.float32)
+        self.diff = np.zeros(shape, np.float32)
+
+
+class Net:
+    """caffe.Net(model_file, weights_file=None, phase=TEST)."""
+
+    def __init__(self, model_file, *args, phase: Optional[int] = None,
+                 weights: Optional[str] = None, stages=(), level=0):
+        # positional compat: Net(proto, phase) or Net(proto, weights, phase)
+        if len(args) == 1:
+            phase = args[0]
+        elif len(args) == 2:
+            weights, phase = args
+        if phase is None:
+            phase = pb.TEST
+        net_param = (model_file if isinstance(model_file, pb.NetParameter)
+                     else read_net_param(model_file))
+        self._net = CoreNet(net_param, phase, stages=stages, level=level)
+        self._params_tree = self._net.init(jax.random.PRNGKey(0))
+        if weights:
+            self.copy_from(weights)
+
+        self.params = OrderedDict()
+        for layer in self._net.layers:
+            arrs = self._params_tree.get(layer.name)
+            if arrs:
+                self.params[layer.name] = [Blob(a) for a in arrs
+                                           if a is not None]
+        self.blobs = OrderedDict()
+        for name, shape in self._net.blob_shapes.items():
+            self.blobs[name] = Blob(np.zeros(shape, np.float32))
+
+        self._forward_fn = None
+        self._backward_fn = None
+        self._key = jax.random.PRNGKey(0)
+
+    # ------------------------------------------------------------------
+    @property
+    def layer_dict(self):
+        return self._net.layer_by_name
+
+    @property
+    def inputs(self):
+        return list(self._net.data_source_tops)
+
+    @property
+    def outputs(self):
+        return list(self._net.output_names)
+
+    def bottom_names(self):
+        return {l.name: list(l.lp.bottom) for l in self._net.layers}
+
+    def top_names(self):
+        return {l.name: list(l.lp.top) for l in self._net.layers}
+
+    # ------------------------------------------------------------------
+    def _tree_from_mirrors(self):
+        tree = {ln: list(vals) for ln, vals in self._params_tree.items()}
+        for ln, blobs in self.params.items():
+            slots = [i for i, a in enumerate(tree[ln]) if a is not None]
+            for slot, blob in zip(slots, blobs):
+                tree[ln][slot] = jnp.asarray(blob.data)
+        return tree
+
+    def _feeds(self):
+        return {name: jnp.asarray(self.blobs[name].data)
+                for name in self._net.data_source_tops}
+
+    def forward(self, blobs=None, start=None, end=None, **kwargs):
+        """Run forward, optionally writing kwargs into input blobs first
+        (pycaffe.py:78 _Net_forward). Returns {output_name: data} plus any
+        extra names requested via `blobs`."""
+        for k, v in kwargs.items():
+            self.blobs[k].data[...] = v
+        if self._forward_fn is None:
+            def run(tree, feeds, rng):
+                out, loss = self._net.apply(tree, feeds, rng=rng)
+                return out
+            self._forward_fn = jax.jit(run)
+        out = self._forward_fn(self._tree_from_mirrors(), self._feeds(),
+                               self._key)
+        for name, v in out.items():
+            self.blobs[name].data = np.array(v)
+        wanted = set(self.outputs) | set(blobs or [])
+        return {n: self.blobs[n].data for n in wanted}
+
+    def backward(self, diffs=None, start=None, end=None, **kwargs):
+        """Gradients of the weighted loss w.r.t. params and inputs
+        (pycaffe.py:127). Fills Blob.diff mirrors; returns input diffs."""
+        if self._backward_fn is None:
+            def run(tree, feeds, rng):
+                def loss_fn(t, f):
+                    _, loss = self._net.apply(t, f, rng=rng)
+                    return loss
+                return jax.grad(loss_fn, argnums=(0, 1))(tree, feeds)
+            self._backward_fn = jax.jit(run)
+        gtree, gfeeds = self._backward_fn(self._tree_from_mirrors(),
+                                          self._feeds(), self._key)
+        for ln, blobs in self.params.items():
+            slots = [i for i, a in enumerate(self._params_tree[ln])
+                     if a is not None]
+            for slot, blob in zip(slots, blobs):
+                g = gtree[ln][slot]
+                blob.diff = (np.array(g) if g is not None
+                             else np.zeros_like(blob.data))
+        out = {}
+        for name, g in gfeeds.items():
+            self.blobs[name].diff = np.array(g)
+            out[name] = self.blobs[name].diff
+        return out
+
+    def forward_all(self, blobs=None, **kwargs):
+        """Batch-chunked forward over full input arrays
+        (pycaffe.py:175 _Net_forward_all)."""
+        first_in = next(iter(self._net.data_source_tops))
+        batch_size = self._net.data_source_tops[first_in][0]
+        total = len(next(iter(kwargs.values())))
+        collected = {}
+        for ofs in range(0, total, batch_size):
+            chunk = {}
+            for k, v in kwargs.items():
+                part = np.asarray(v[ofs:ofs + batch_size])
+                if len(part) < batch_size:   # pad the tail chunk
+                    pad = [(0, batch_size - len(part))] + [(0, 0)] * (
+                        part.ndim - 1)
+                    part = np.pad(part, pad)
+                chunk[k] = part
+            out = self.forward(blobs=blobs, **chunk)
+            n = min(batch_size, total - ofs)
+            for name, v in out.items():
+                collected.setdefault(name, []).append(v[:n].copy())
+        return {k: np.concatenate(v) for k, v in collected.items()}
+
+    # ------------------------------------------------------------------
+    def copy_from(self, weights_file: str):
+        self._params_tree = self._net.copy_trained_from(self._params_tree,
+                                                        weights_file)
+        if hasattr(self, "params"):
+            for ln, blobs in self.params.items():
+                slots = [i for i, a in enumerate(self._params_tree[ln])
+                         if a is not None]
+                for slot, blob in zip(slots, blobs):
+                    blob.data = np.array(self._params_tree[ln][slot])
+
+    def save(self, path: str):
+        """Serialize current (possibly surgered) weights."""
+        from ..utils.io import write_proto_binary, write_net_hdf5
+        tree = jax.tree.map(np.asarray, self._tree_from_mirrors())
+        proto = self._net.to_proto(tree)
+        if path.endswith((".h5", ".hdf5")):
+            write_net_hdf5(proto, path)
+        else:
+            write_proto_binary(path, proto)
+
+    def share_with(self, other: "Net"):
+        """ShareTrainedLayersWith (net.cpp:697): alias the other net's
+        param mirrors by layer name."""
+        for ln, blobs in other.params.items():
+            if ln in self.params:
+                self.params[ln] = blobs

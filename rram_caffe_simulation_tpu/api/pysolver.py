@@ -1,0 +1,98 @@
+"""pycaffe Solver facade (reference: _caffe.cpp:367-380 solver bindings,
+pycaffe solver.net / solver.test_nets / solver.step)."""
+from __future__ import annotations
+
+from ..proto import pb
+from ..solver import Solver as CoreSolver
+from ..utils.io import read_solver_param
+
+
+class _PySolver:
+    type_override = None
+
+    def __init__(self, solver_file):
+        param = (solver_file if isinstance(solver_file, pb.SolverParameter)
+                 else read_solver_param(solver_file))
+        if self.type_override:
+            param.type = self.type_override
+        self._solver = CoreSolver(param)
+
+    @property
+    def net(self):
+        """Train net as a pycaffe-style Net sharing the solver's params."""
+        return self._wrap(self._solver.net)
+
+    @property
+    def test_nets(self):
+        return [self._wrap(n) for n in self._solver.test_nets]
+
+    def _wrap(self, core_net):
+        from collections import OrderedDict
+        import numpy as np
+        from .pynet import Blob
+
+        class _View:
+            pass
+        view = _View()
+        view.params = OrderedDict()
+        for ln, arrs in self._solver.params.items():
+            view.params[ln] = [Blob(np.asarray(a)) for a in arrs
+                               if a is not None]
+        view.blobs = OrderedDict()
+        for name, shape in core_net.blob_shapes.items():
+            view.blobs[name] = Blob(np.zeros(shape, np.float32))
+        return view
+
+    @property
+    def iter(self):
+        return self._solver.iter
+
+    def step(self, n: int):
+        self._solver.step(n)
+
+    def solve(self, resume_file=None):
+        self._solver.solve(resume_file)
+
+    def snapshot(self):
+        return self._solver.snapshot()
+
+    def restore(self, state_file: str):
+        self._solver.restore(state_file)
+
+
+class SGDSolver(_PySolver):
+    type_override = "SGD"
+
+
+class NesterovSolver(_PySolver):
+    type_override = "Nesterov"
+
+
+class AdaGradSolver(_PySolver):
+    type_override = "AdaGrad"
+
+
+class RMSPropSolver(_PySolver):
+    type_override = "RMSProp"
+
+
+class AdaDeltaSolver(_PySolver):
+    type_override = "AdaDelta"
+
+
+class AdamSolver(_PySolver):
+    type_override = "Adam"
+
+
+def get_solver(solver_file) -> _PySolver:
+    """caffe.get_solver: dispatch on SolverParameter.type
+    (solver_factory.hpp:73)."""
+    param = (solver_file if isinstance(solver_file, pb.SolverParameter)
+             else read_solver_param(solver_file))
+    cls = {"SGD": SGDSolver, "Nesterov": NesterovSolver,
+           "AdaGrad": AdaGradSolver, "RMSProp": RMSPropSolver,
+           "AdaDelta": AdaDeltaSolver, "Adam": AdamSolver}[
+               param.type or "SGD"]
+    inst = cls.__new__(cls)
+    _PySolver.__init__(inst, param)
+    return inst

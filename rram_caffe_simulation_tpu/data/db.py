@@ -1,15 +1,18 @@
-"""LMDB/LevelDB Datum database access (reference: src/caffe/util/db_lmdb.cpp,
-db_leveldb.cpp, data_reader.cpp).
+"""Datum database access (reference: src/caffe/util/db.{hpp,cpp},
+db_lmdb.cpp, db_leveldb.cpp, data_reader.cpp).
 
-This environment ships no lmdb/leveldb bindings; access is gated behind a
-clear error until a pure-python reader lands. Datum decode itself
-(datum_to_array) is self-contained and used by the converters/tests.
+Backed by the pure-Python LMDB implementation in lmdb_py (this environment
+ships no lmdb/leveldb bindings). LevelDB files are not supported — convert
+with the shipped converters (tools/convert_*.py), which write LMDB.
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
 from ..proto import pb
+from . import lmdb_py
 
 
 def datum_to_array(datum: "pb.Datum") -> tuple[np.ndarray, int]:
@@ -33,18 +36,43 @@ def array_to_datum(arr: np.ndarray, label: int = 0) -> "pb.Datum":
     return d
 
 
-def open_db(source: str, backend):
-    try:
-        import lmdb  # noqa: F401
-    except ImportError:
+class LMDB:
+    """DB interface matching the reference's db.hpp:13-46 surface."""
+
+    def __init__(self, source: str):
+        self.env = lmdb_py.Environment(source)
+
+    def cursor(self) -> "lmdb_py.Cursor":
+        return lmdb_py.Cursor(self.env)
+
+    def __len__(self):
+        return len(self.env)
+
+    def close(self):
+        self.env.close()
+
+
+def open_db(source: str, backend=None) -> LMDB:
+    """GetDB (db.hpp:48). LevelDB sources raise — LMDB only."""
+    mdb = source if os.path.isfile(source) else os.path.join(source,
+                                                             "data.mdb")
+    if not os.path.exists(mdb):
+        kind = ("LevelDB" if os.path.exists(
+            os.path.join(source, "CURRENT")) else "unknown")
         raise NotImplementedError(
-            f"Datum DB source {source!r}: no lmdb/leveldb bindings in this "
-            "environment. Use Input/MemoryData/HDF5Data layers or the "
-            "ndarray dataset loaders in rram_caffe_simulation_tpu.data."
-        ) from None
-    raise NotImplementedError("LMDB cursor support pending")
+            f"Datum DB source {source!r} is not LMDB ({kind}); convert "
+            "with the shipped dataset converters (they write LMDB)")
+    return LMDB(source)
 
 
-def infer_datum_shape(source: str, backend) -> tuple[int, int, int]:
+def infer_datum_shape(source: str, backend=None) -> tuple[int, int, int]:
+    """Peek the first Datum for shape inference (DataLayer setup,
+    data_layer.cpp DataLayerSetUp)."""
     db = open_db(source, backend)
-    raise NotImplementedError  # unreachable until open_db works
+    try:
+        cur = db.cursor()
+        datum = pb.Datum()
+        datum.ParseFromString(cur.value())
+        return (datum.channels, datum.height, datum.width)
+    finally:
+        db.close()

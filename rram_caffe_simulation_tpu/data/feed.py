@@ -16,17 +16,23 @@ from ..proto import pb
 
 
 def build_feed(net) -> Callable[[], Dict[str, np.ndarray]]:
-    """Compose one callable feeding every data-source layer of `net`."""
+    """Compose one callable feeding every data-source layer of `net`.
+    Layers with no automatic source (Input) raise at first *pull*, so nets
+    whose batches are supplied explicitly still construct."""
     sub_feeds = []
     for layer in net.layers:
         if not layer.is_data_source:
             continue
         builder = FEED_BUILDERS.get(layer.type_name)
         if builder is None:
-            raise NotImplementedError(
-                f"no automatic feed for layer type {layer.type_name!r} "
-                f"(layer {layer.name!r}); pass train_feed/test_feeds to "
-                "Solver or use MemoryData.set_input_arrays")
+            def missing(layer=layer):
+                raise NotImplementedError(
+                    f"no automatic feed for layer type "
+                    f"{layer.type_name!r} (layer {layer.name!r}); pass "
+                    "train_feed/test_feeds to Solver or use "
+                    "MemoryData.set_input_arrays")
+            sub_feeds.append(missing)
+            continue
         sub_feeds.append(builder(layer))
 
     def feed() -> Dict[str, np.ndarray]:
@@ -139,7 +145,8 @@ def _image_feed(layer):
     from .transformer import DataTransformer
     ip = layer.lp.image_data_param
     with open(ip.source) as f:
-        entries = [ln.strip().rsplit(" ", 1) for ln in f if ln.strip()]
+        # any-whitespace split, like the reference's `infile >> name >> label`
+        entries = [ln.rsplit(None, 1) for ln in f if ln.strip()]
     if ip.shuffle:
         np.random.RandomState(0).shuffle(entries)
     transformer = DataTransformer(layer.lp.transform_param,

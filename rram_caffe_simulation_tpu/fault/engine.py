@@ -53,6 +53,8 @@ def _stuck_splits(pattern: "pb.FailurePatternParameter") -> Tuple[float, float]:
     else:
         probs = [10, 20, 10]
     total = float(sum(probs))
+    if total <= 0:
+        raise ValueError("failure_prob entries must sum to > 0")
     return probs[0] / total, (probs[0] + probs[1]) / total
 
 

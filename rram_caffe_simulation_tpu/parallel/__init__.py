@@ -16,9 +16,10 @@ leading Monte-Carlo fault-configuration axis, replacing the reference's
 one-process-per-config sweep (run_different_mean.sh fans 3 configs over 3
 GPUs; here thousands of crossbar configs ride one TPU batch).
 """
-from .mesh import make_mesh, data_sharding, replicated
+from .mesh import make_mesh, data_sharding, config_sharding, replicated
 from .dp import make_dp_step, shard_batch
 from .sweep import SweepRunner, stack_fault_states
 
-__all__ = ["make_mesh", "data_sharding", "replicated", "make_dp_step",
-           "shard_batch", "SweepRunner", "stack_fault_states"]
+__all__ = ["make_mesh", "data_sharding", "config_sharding", "replicated",
+           "make_dp_step", "shard_batch", "SweepRunner",
+           "stack_fault_states"]

@@ -13,20 +13,17 @@ zero hand-written communication.
 from __future__ import annotations
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from .mesh import replicated
+from .mesh import data_sharding, replicated
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     """Place each batch array with its leading dim sharded over `axis`
     (the DataReader round-robin equivalent, data_reader.cpp:79-93: each
     replica sees a disjoint shard)."""
-    out = {}
-    for k, v in batch.items():
-        sh = NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
-        out[k] = jax.device_put(v, sh)
-    return out
+    return {k: jax.device_put(v, data_sharding(mesh, axis, ndim=v.ndim))
+            for k, v in batch.items()}
 
 
 def make_dp_step(solver, mesh: Mesh):

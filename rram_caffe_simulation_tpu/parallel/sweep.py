@@ -89,15 +89,15 @@ class SweepRunner:
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
+        self._eval_fns = {}
         self._place()
 
     def _place(self):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .mesh import config_sharding
         if "config" not in self.mesh.axis_names:
             return
         shard0 = lambda x: jax.device_put(
-            x, NamedSharding(self.mesh, P("config",
-                                          *([None] * (x.ndim - 1)))))
+            x, config_sharding(self.mesh, ndim=x.ndim))
         self.params = jax.tree.map(shard0, self.params)
         self.history = jax.tree.map(shard0, self.history)
         self.fault_states = jax.tree.map(shard0, self.fault_states)
@@ -135,12 +135,15 @@ class SweepRunner:
 
     def evaluate(self, batch, net=None) -> Dict[str, np.ndarray]:
         """Per-config forward metrics on a shared eval batch (test-net
-        outputs, e.g. accuracy), vmapped over config params."""
+        outputs, e.g. accuracy), vmapped over config params. The jitted
+        evaluator is cached per net."""
         net = net or (self.solver.test_nets[0] if self.solver.test_nets
                       else self.solver.net)
-
-        def run(p):
-            blobs, _ = net.apply(p, batch)
-            return {n: blobs[n] for n in net.output_names}
-        out = jax.jit(jax.vmap(run))(self.params)
+        if id(net) not in self._eval_fns:
+            def run(p, b):
+                blobs, _ = net.apply(p, b)
+                return {n: blobs[n] for n in net.output_names}
+            self._eval_fns[id(net)] = jax.jit(
+                jax.vmap(run, in_axes=(0, None)))
+        out = self._eval_fns[id(net)](self.params, batch)
         return {k: np.asarray(v) for k, v in out.items()}

@@ -480,7 +480,8 @@ class Solver:
         loss_total = 0.0
         for i in range(test_iter):
             batch = {k: jnp.asarray(v) for k, v in feed().items()}
-            rng = jax.random.fold_in(self._key, (self.iter << 16) + i)
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self._key, self.iter), i)
             out = fn(self.params, batch, rng)
             if "__loss" in out:
                 loss_total += float(out.pop("__loss"))

@@ -1,0 +1,8 @@
+"""Command-line tools (reference: tools/*.cpp).
+
+- caffe_cli:            train / test / time / device_query (tools/caffe.cpp)
+- convert_mnist_data:   MNIST idx files -> LMDB (examples/mnist/convert_mnist_data.cpp)
+- convert_cifar_data:   CIFAR-10 binaries -> LMDB (examples/cifar10/convert_cifar_data.cpp)
+- convert_imageset:     image list -> LMDB (tools/convert_imageset.cpp)
+- compute_image_mean:   LMDB -> mean.binaryproto (tools/compute_image_mean.cpp)
+"""

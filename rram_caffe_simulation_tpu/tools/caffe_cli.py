@@ -1,0 +1,201 @@
+"""The `caffe` command (reference: tools/caffe.cpp — RegisterBrewFunction
+registry at caffe.cpp:63, train :180, test :261, time :334, device_query
+:137). Flags mirror the gflags set (caffe.cpp:29-54); --gpu maps to TPU
+device selection (all chips = the mesh).
+
+Usage: python -m rram_caffe_simulation_tpu.tools.caffe_cli <command> [flags]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time as _time
+
+import numpy as np
+
+BREW = {}
+
+
+def register(fn):
+    BREW[fn.__name__] = fn
+    return fn
+
+
+@register
+def device_query(args):
+    """caffe.cpp:137 — query and print device info."""
+    import jax
+    for d in jax.devices():
+        print(f"Device: {d.platform} id {d.id}: {d.device_kind}")
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            print(f"  bytes_in_use: {stats.get('bytes_in_use')}")
+            print(f"  bytes_limit:  {stats.get('bytes_limit')}")
+    return 0
+
+
+def _install_signal_actions(solver, args):
+    """SignalHandler (util/signal_handler.cpp; flags caffe.cpp:51-54):
+    SIGINT/SIGHUP -> stop/snapshot/none."""
+    def make(effect):
+        def handler(signum, frame):
+            if effect == "stop":
+                solver._requested_action = "stop"
+            elif effect == "snapshot":
+                solver.snapshot()
+        return handler
+    if args.sigint_effect != "none":
+        signal.signal(signal.SIGINT, make(args.sigint_effect))
+    if args.sighup_effect != "none":
+        try:
+            signal.signal(signal.SIGHUP, make(args.sighup_effect))
+        except (AttributeError, ValueError):
+            pass
+
+
+@register
+def train(args):
+    """caffe.cpp:180 — train / finetune / resume."""
+    from ..solver import Solver
+    if not args.solver:
+        sys.exit("Need a solver definition to train (--solver)")
+    if args.snapshot and args.weights:
+        sys.exit("Give a snapshot to resume OR weights to finetune, "
+                 "not both")
+    solver = Solver(args.solver)
+    if args.weights:
+        for w in args.weights.split(","):
+            solver.params = solver.net.copy_trained_from(solver.params, w)
+    _install_signal_actions(solver, args)
+    solver.solve(resume_file=args.snapshot or None)
+    return 0
+
+
+@register
+def test(args):
+    """caffe.cpp:261 — score a model over --iterations batches."""
+    import jax
+    import jax.numpy as jnp
+    from ..net import Net
+    from ..proto import pb
+    from ..utils.io import read_net_param
+    if not args.model or not args.weights:
+        sys.exit("test needs --model and --weights")
+    net = Net(read_net_param(args.model), pb.TEST,
+              stages=tuple(args.stage.split(",")) if args.stage else (),
+              level=args.level)
+    params = net.init(jax.random.PRNGKey(0))
+    params = net.copy_trained_from(params, args.weights)
+    from ..data.feed import build_feed
+    feed = build_feed(net) if net.data_source_tops else (lambda: {})
+    fn = jax.jit(lambda p, b: net.apply(p, b))
+    totals = {}
+    for i in range(args.iterations):
+        batch = {k: jnp.asarray(v) for k, v in feed().items()}
+        blobs, loss = fn(params, batch)
+        line = []
+        for name in net.output_names:
+            v = np.ravel(np.asarray(blobs[name]))
+            totals[name] = totals.get(name, 0.0) + v
+            line.append(f"{name} = {float(v[0]):g}")
+        print(f"Batch {i}, " + ", ".join(line))
+    for name, tot in totals.items():
+        mean = tot / args.iterations
+        for v in np.ravel(mean):
+            print(f"{name} = {float(v):g}")
+    return 0
+
+
+@register
+def time(args):
+    """caffe.cpp:334 — per-layer and whole-net forward/backward timing.
+
+    XLA fuses the whole graph, so per-layer wall times are measured by
+    jitting each layer's apply in isolation (upper bound on its standalone
+    cost); the fused whole-net number is the one that matters on TPU."""
+    import jax
+    import jax.numpy as jnp
+    from ..net import Net
+    from ..proto import pb
+    from ..utils.io import read_net_param
+    if not args.model:
+        sys.exit("time needs --model")
+    net = Net(read_net_param(args.model),
+              pb.TRAIN if args.phase == "TRAIN" else pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {name: jnp.asarray(rng.randn(*shape), jnp.float32)
+             for name, shape in net.data_source_tops.items()}
+
+    fwd = jax.jit(lambda p, b: net.apply(p, b)[1])
+    grad = jax.jit(jax.grad(lambda p, b: net.apply(p, b)[1]))
+    fwd(params, batch)                      # compile
+    g = grad(params, batch)
+    jax.block_until_ready(g)
+    iters = args.iterations
+
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fwd(params, batch))
+    t_fwd = (_time.perf_counter() - t0) / iters * 1e3
+
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(grad(params, batch))
+    t_bwd = (_time.perf_counter() - t0) / iters * 1e3
+
+    print(f"Average Forward pass: {t_fwd:.3f} ms.")
+    print(f"Average Forward-Backward: {t_bwd:.3f} ms.")
+    print(f"Total Time: {t_bwd * iters:.3f} ms.")
+
+    # per-layer isolation timings
+    blobs = {}
+    for name, shape in net.data_source_tops.items():
+        blobs[name] = batch[name]
+    print("Per-layer isolated forward times:")
+    for layer in net.layers:
+        if layer.is_data_source:
+            continue
+        bottoms = [blobs[b] for b in layer.lp.bottom]
+        lparams = net._gather_layer_params(params, layer)
+        from ..core.registry import LayerContext
+        ctx = LayerContext(phase=net.phase, rng=jax.random.PRNGKey(0))
+        run = jax.jit(lambda lp, bt: layer.apply(lp, bt, ctx)[0])
+        tops = run(lparams, bottoms)
+        jax.block_until_ready(tops)
+        t0 = _time.perf_counter()
+        for _ in range(max(iters // 5, 1)):
+            jax.block_until_ready(run(lparams, bottoms))
+        dt = (_time.perf_counter() - t0) / max(iters // 5, 1) * 1e3
+        print(f"  {layer.name:20s} forward: {dt:.3f} ms.")
+        for t, v in zip(layer.lp.top, tops):
+            blobs[t] = v
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="caffe", description="command line brew",
+        epilog="commands: " + ", ".join(sorted(BREW)))
+    p.add_argument("command", choices=sorted(BREW))
+    p.add_argument("--solver", default="")
+    p.add_argument("--model", default="")
+    p.add_argument("--snapshot", default="")
+    p.add_argument("--weights", default="")
+    p.add_argument("--iterations", type=int, default=50)
+    p.add_argument("--gpu", default="",
+                   help="accepted for compat; devices come from the mesh")
+    p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
+    p.add_argument("--level", type=int, default=0)
+    p.add_argument("--stage", default="")
+    p.add_argument("--sigint_effect", default="stop",
+                   choices=["stop", "snapshot", "none"])
+    p.add_argument("--sighup_effect", default="snapshot",
+                   choices=["stop", "snapshot", "none"])
+    args = p.parse_args(argv)
+    return BREW[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

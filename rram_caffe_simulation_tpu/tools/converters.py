@@ -1,0 +1,130 @@
+"""Dataset converters writing Datum LMDBs, keyed "%08d" like the reference
+(examples/mnist/convert_mnist_data.cpp:95 "%08d", examples/cifar10/
+convert_cifar_data.cpp, tools/convert_imageset.cpp).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+from ..data import lmdb_py
+from ..data.db import array_to_datum
+from ..proto import pb
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx(path: str) -> np.ndarray:
+    """MNIST idx format: magic u32 (0x0801 labels / 0x0803 images), dims."""
+    with _open(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def convert_mnist(images_path: str, labels_path: str, out_dir: str) -> int:
+    images = read_idx(images_path)
+    labels = read_idx(labels_path)
+    assert images.shape[0] == labels.shape[0]
+    with lmdb_py.BulkWriter(out_dir) as w:
+        for i in range(images.shape[0]):
+            datum = array_to_datum(images[i][None], int(labels[i]))
+            w.put(b"%08d" % i, datum.SerializeToString())
+    return images.shape[0]
+
+
+def convert_cifar10(batch_files, out_dir: str) -> int:
+    """CIFAR-10 binary batches: per record 1 label byte + 3072 image bytes
+    (3x32x32, channel-major)."""
+    n = 0
+    with lmdb_py.BulkWriter(out_dir) as w:
+        for path in batch_files:
+            raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3073)
+            for rec in raw:
+                img = rec[1:].reshape(3, 32, 32)
+                datum = array_to_datum(img, int(rec[0]))
+                w.put(b"%08d" % n, datum.SerializeToString())
+                n += 1
+    return n
+
+
+def convert_imageset(root_folder: str, list_file: str, out_dir: str,
+                     resize_height: int = 0, resize_width: int = 0,
+                     gray: bool = False, shuffle: bool = False) -> int:
+    """images listed as `relpath label` -> LMDB (tools/convert_imageset.cpp)."""
+    from ..data.image import load_image
+    with open(list_file) as f:
+        entries = [ln.rsplit(None, 1) for ln in f if ln.strip()]
+    if shuffle:
+        np.random.RandomState(0).shuffle(entries)
+    with lmdb_py.BulkWriter(out_dir) as w:
+        for i, (rel, label) in enumerate(entries):
+            arr = load_image(os.path.join(root_folder, rel), not gray,
+                             resize_height, resize_width)
+            datum = array_to_datum(arr, int(label))
+            key = f"{i:08d}_{rel}".encode()
+            w.put(key, datum.SerializeToString())
+    return len(entries)
+
+
+def compute_image_mean(db_dir: str, out_file: str) -> np.ndarray:
+    """Mean over all Datums -> BlobProto file (tools/compute_image_mean.cpp)."""
+    from ..data.db import LMDB, datum_to_array
+    from ..utils.io import array_to_blob, write_proto_binary
+    db = LMDB(db_dir)
+    total = None
+    count = 0
+    for _, v in db.env.items():
+        datum = pb.Datum()
+        datum.ParseFromString(v)
+        arr, _ = datum_to_array(datum)
+        arr = arr.astype(np.float64)
+        total = arr if total is None else total + arr
+        count += 1
+    db.close()
+    mean = (total / max(count, 1)).astype(np.float32)
+    blob = array_to_blob(mean[None])
+    write_proto_binary(out_file, blob)
+    return mean
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(prog="convert", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser("mnist")
+    m.add_argument("images"); m.add_argument("labels"); m.add_argument("out")
+    c = sub.add_parser("cifar10")
+    c.add_argument("out"); c.add_argument("batches", nargs="+")
+    i = sub.add_parser("imageset")
+    i.add_argument("root"); i.add_argument("listfile"); i.add_argument("out")
+    i.add_argument("--resize_height", type=int, default=0)
+    i.add_argument("--resize_width", type=int, default=0)
+    i.add_argument("--gray", action="store_true")
+    i.add_argument("--shuffle", action="store_true")
+    mm = sub.add_parser("mean")
+    mm.add_argument("db"); mm.add_argument("out")
+    a = p.parse_args(argv)
+    if a.cmd == "mnist":
+        n = convert_mnist(a.images, a.labels, a.out)
+    elif a.cmd == "cifar10":
+        n = convert_cifar10(a.batches, a.out)
+    elif a.cmd == "imageset":
+        n = convert_imageset(a.root, a.listfile, a.out,
+                             a.resize_height, a.resize_width, a.gray,
+                             a.shuffle)
+    else:
+        compute_image_mean(a.db, a.out)
+        n = 1
+    print(f"Processed {n} records.", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

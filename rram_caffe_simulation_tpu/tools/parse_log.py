@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Training-log analytics (reference: tools/extra/parse_log.py and
+examples/cifar10/plot_pic.py — both regex-scrape the human-readable log).
+
+Our Solver emits the same line shapes ("Iteration N, loss = X",
+"Test net output #i: name = v"), so this parser works on logs from either
+framework.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import re
+import sys
+
+
+TRAIN_ITER = re.compile(r"Iteration (\d+), loss = ([\d.eE+-]+)")
+TRAIN_LR = re.compile(r"Iteration (\d+), lr = ([\d.eE+-]+)")
+TEST_BEGIN = re.compile(r"Iteration (\d+), Testing net \(#(\d+)\)")
+OUTPUT = re.compile(r"(Train|Test) net output #(\d+): (\S+) = ([\d.eE+-]+)")
+
+
+def parse_log(path: str):
+    """Returns (train_rows, test_rows): dicts keyed iteration with loss/lr
+    and named outputs."""
+    train: dict[int, dict] = {}
+    test: dict[int, dict] = {}
+    cur_test_iter = None
+    with open(path) as f:
+        for line in f:
+            m = TRAIN_ITER.search(line)
+            if m:
+                train.setdefault(int(m.group(1)), {})["loss"] = float(
+                    m.group(2))
+                continue
+            m = TRAIN_LR.search(line)
+            if m:
+                train.setdefault(int(m.group(1)), {})["lr"] = float(
+                    m.group(2))
+                continue
+            m = TEST_BEGIN.search(line)
+            if m:
+                cur_test_iter = int(m.group(1))
+                test.setdefault(cur_test_iter, {})
+                continue
+            m = OUTPUT.search(line)
+            if m:
+                kind, _, name, val = m.groups()
+                target = (test.setdefault(cur_test_iter, {})
+                          if kind == "Test" and cur_test_iter is not None
+                          else train.setdefault(
+                              max(train) if train else 0, {}))
+                target[name] = float(val)
+    return train, test
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("log")
+    p.add_argument("--out-prefix", default="",
+                   help="write <prefix>.train.csv / <prefix>.test.csv")
+    args = p.parse_args(argv)
+    train, test = parse_log(args.log)
+
+    def dump(rows, fh):
+        keys = sorted({k for r in rows.values() for k in r})
+        w = csv.writer(fh)
+        w.writerow(["iteration"] + keys)
+        for it in sorted(rows):
+            w.writerow([it] + [rows[it].get(k, "") for k in keys])
+
+    if args.out_prefix:
+        with open(args.out_prefix + ".train.csv", "w") as f:
+            dump(train, f)
+        with open(args.out_prefix + ".test.csv", "w") as f:
+            dump(test, f)
+    else:
+        print("# train")
+        dump(train, sys.stdout)
+        print("# test")
+        dump(test, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""Data pipeline tests: pure-Python LMDB round-trip, Datum codec,
+transformer semantics, converters, and an end-to-end Data-layer training
+run (reference test_db.cpp + test_data_layer.cpp +
+test_data_transformer.cpp territory)."""
+import os
+
+import numpy as np
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.data import lmdb_py
+from rram_caffe_simulation_tpu.data.db import (array_to_datum,
+                                               datum_to_array, open_db,
+                                               infer_datum_shape)
+from rram_caffe_simulation_tpu.data.transformer import DataTransformer
+
+
+def test_lmdb_roundtrip_small(tmp_path):
+    path = str(tmp_path / "db")
+    items = {b"%08d" % i: os.urandom(50 + i) for i in range(100)}
+    with lmdb_py.BulkWriter(path) as w:
+        for k, v in items.items():
+            w.put(k, v)
+    env = lmdb_py.Environment(path)
+    assert len(env) == 100
+    got = dict(env.items())
+    assert got == items
+    # in-order iteration
+    assert list(got.keys()) == sorted(items.keys())
+    # point lookups
+    assert env.get(b"%08d" % 42) == items[b"%08d" % 42]
+    assert env.get(b"nope") is None
+    env.close()
+
+
+def test_lmdb_overflow_values(tmp_path):
+    """Values > in-page node capacity go to overflow pages (CIFAR Datums
+    are ~3KB, always overflow)."""
+    path = str(tmp_path / "db")
+    rng = np.random.RandomState(0)
+    items = {b"%08d" % i: rng.bytes(3073 + i * 13) for i in range(50)}
+    with lmdb_py.BulkWriter(path) as w:
+        for k, v in items.items():
+            w.put(k, v)
+    env = lmdb_py.Environment(path)
+    assert dict(env.items()) == items
+    env.close()
+
+
+def test_lmdb_multilevel_tree(tmp_path):
+    """Enough keys to force branch pages (depth >= 2)."""
+    path = str(tmp_path / "db")
+    items = {b"key%010d" % i: (b"v" * (i % 37 + 1)) for i in range(5000)}
+    with lmdb_py.BulkWriter(path) as w:
+        for k, v in items.items():
+            w.put(k, v)
+    env = lmdb_py.Environment(path)
+    assert env.depth >= 2
+    assert len(env) == 5000
+    assert dict(env.items()) == items
+    for probe in (0, 1, 999, 2500, 4999):
+        assert env.get(b"key%010d" % probe) == items[b"key%010d" % probe]
+    env.close()
+
+
+def test_cursor_wraps(tmp_path):
+    path = str(tmp_path / "db")
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(3):
+            w.put(b"%d" % i, b"v%d" % i)
+    cur = open_db(path).cursor()
+    seen = [cur.next_value() for _ in range(7)]
+    assert seen == [b"v0", b"v1", b"v2", b"v0", b"v1", b"v2", b"v0"]
+
+
+def test_datum_codec():
+    arr = np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4)
+    d = array_to_datum(arr, 7)
+    back, label = datum_to_array(pb.Datum.FromString(d.SerializeToString()))
+    np.testing.assert_array_equal(arr, back)
+    assert label == 7
+
+
+def test_transformer_semantics():
+    tp = pb.TransformationParameter(scale=0.5, crop_size=4)
+    tp.mean_value.append(10.0)
+    t = DataTransformer(tp, phase=pb.TEST)
+    arr = np.full((1, 8, 8), 20, np.uint8)
+    out = t.transform(arr)
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out, (20 - 10) * 0.5)
+
+
+def test_data_layer_end_to_end(tmp_path):
+    """Write an LMDB of labeled Datums, train a Data-layer net on it
+    (the reference's 3-thread pipeline collapsed into a feed)."""
+    db_dir = str(tmp_path / "train_db")
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(db_dir) as w:
+        for i in range(64):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            # learnable mapping: label = brightness quartile
+            label = int(img.mean() // 64)
+            w.put(b"%08d" % i, array_to_datum(img, label).SerializeToString())
+    assert infer_datum_shape(db_dir, None) == (1, 8, 8)
+
+    solver_txt = f"""
+    base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+    max_iter: 20 display: 0 random_seed: 3 snapshot_prefix: "{tmp_path}/s"
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(solver_txt, sp)
+    net_txt = f"""
+    name: "dbnet"
+    layer {{ name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{db_dir}" batch_size: 16 }}
+      transform_param {{ scale: 0.00390625 }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param {{ num_output: 4
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" top: "loss" }}
+    """
+    text_format.Parse(net_txt, sp.net_param)
+    from rram_caffe_simulation_tpu.solver import Solver
+    s = Solver(sp)
+    l0 = None
+    s.step(20)
+    assert s.iter == 20
+    assert np.isfinite(s.smoothed_loss)
+
+
+def test_mnist_converter(tmp_path):
+    """Synthetic idx files -> LMDB -> Datums match."""
+    import gzip, struct
+    from rram_caffe_simulation_tpu.tools.converters import convert_mnist
+    rng = np.random.RandomState(1)
+    images = rng.randint(0, 255, (10, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (10,), dtype=np.uint8)
+    img_path = str(tmp_path / "imgs.idx")
+    lbl_path = str(tmp_path / "lbls.idx")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 0x0803, 10, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 0x0801, 10))
+        f.write(labels.tobytes())
+    out = str(tmp_path / "mnist_db")
+    assert convert_mnist(img_path, lbl_path, out) == 10
+    env = lmdb_py.Environment(out)
+    for i, (k, v) in enumerate(env.items()):
+        arr, label = datum_to_array(pb.Datum.FromString(v))
+        np.testing.assert_array_equal(arr[0], images[i])
+        assert label == labels[i]
+
+
+def test_compute_image_mean(tmp_path):
+    from rram_caffe_simulation_tpu.tools.converters import compute_image_mean
+    from rram_caffe_simulation_tpu.utils.io import read_blob_from_file
+    db_dir = str(tmp_path / "db")
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (20, 3, 5, 5), dtype=np.uint8)
+    with lmdb_py.BulkWriter(db_dir) as w:
+        for i in range(20):
+            w.put(b"%08d" % i, array_to_datum(imgs[i], 0).SerializeToString())
+    mean = compute_image_mean(db_dir, str(tmp_path / "mean.binaryproto"))
+    np.testing.assert_allclose(mean, imgs.astype(np.float64).mean(0),
+                               atol=1e-4)
+    loaded = read_blob_from_file(str(tmp_path / "mean.binaryproto"))
+    np.testing.assert_allclose(loaded[0], mean, atol=1e-5)
