@@ -15,7 +15,11 @@ from .pysolver import (SGDSolver, NesterovSolver, AdaGradSolver,
                        RMSPropSolver, AdaDeltaSolver, AdamSolver,
                        get_solver)
 from .net_spec import NetSpec, layers, params, to_proto
+from .classifier import Classifier
+from .detector import Detector
 from . import io  # noqa: F401
+from . import draw  # noqa: F401
+from . import coord_map  # noqa: F401
 
 TRAIN = pb.TRAIN
 TEST = pb.TEST
@@ -40,6 +44,7 @@ def set_random_seed(seed: int):
 
 __all__ = ["Net", "Blob", "SGDSolver", "NesterovSolver", "AdaGradSolver",
            "RMSPropSolver", "AdaDeltaSolver", "AdamSolver", "get_solver",
-           "NetSpec", "layers", "params", "to_proto", "io",
+           "NetSpec", "layers", "params", "to_proto", "io", "draw",
+           "coord_map", "Classifier", "Detector",
            "TRAIN", "TEST", "set_mode_cpu", "set_mode_gpu", "set_device",
            "set_random_seed"]

@@ -1,0 +1,59 @@
+"""Classifier: oversampled image classification (reference:
+python/caffe/classifier.py — same constructor surface and predict
+semantics: resize to image_dims, center crop or 10-crop oversample,
+average oversampled predictions)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import io as caffe_io
+from .pynet import Net
+
+
+class Classifier(Net):
+    def __init__(self, model_file, pretrained_file, image_dims=None,
+                 mean=None, input_scale=None, raw_scale=None,
+                 channel_swap=None):
+        super().__init__(model_file, weights=pretrained_file)
+        in_ = self.inputs[0]
+        self.transformer = caffe_io.Transformer(
+            {in_: self.blobs[in_].data.shape})
+        self.transformer.set_transpose(in_, (2, 0, 1))
+        if mean is not None:
+            self.transformer.set_mean(in_, mean)
+        if input_scale is not None:
+            self.transformer.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            self.transformer.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            self.transformer.set_channel_swap(in_, channel_swap)
+        self.crop_dims = np.array(self.blobs[in_].data.shape[2:])
+        if not image_dims:
+            image_dims = self.crop_dims
+        self.image_dims = np.array(image_dims)
+
+    def predict(self, inputs, oversample=True):
+        """inputs: iterable of HxWxC images in [0,1]. Returns (N, classes)
+        prediction matrix (classifier.py:54-99)."""
+        in_ = self.inputs[0]
+        imgs = np.zeros((len(inputs), self.image_dims[0],
+                         self.image_dims[1], inputs[0].shape[2]),
+                        dtype=np.float32)
+        for i, im in enumerate(inputs):
+            imgs[i] = caffe_io.resize_image(im, self.image_dims)
+        if oversample:
+            imgs = caffe_io.oversample(imgs, self.crop_dims)
+        else:
+            center = np.array(self.image_dims) / 2.0
+            crop = np.tile(center, (1, 2))[0] + np.concatenate(
+                [-self.crop_dims / 2.0, self.crop_dims / 2.0])
+            crop = crop.astype(int)
+            imgs = imgs[:, crop[0]:crop[2], crop[1]:crop[3], :]
+        data = np.asarray([self.transformer.preprocess(in_, im)
+                           for im in imgs])
+        out = self.forward_all(**{in_: data})
+        predictions = out[self.outputs[0]]
+        if oversample:
+            predictions = predictions.reshape(
+                (len(predictions) // 10, 10, -1)).mean(axis=1)
+        return predictions

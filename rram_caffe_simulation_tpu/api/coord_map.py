@@ -1,0 +1,130 @@
+"""Receptive-field / coordinate-offset algebra for FCNs over net_spec
+graphs (reference: python/caffe/coord_map.py — same public surface:
+coord_map_from_to, crop, compose, inverse; maps are (axis, scale, shift)
+with conv/pool contributing scale 1/stride, shift (pad-(ks-1)/2)/stride,
+deconv the inverse, crop an offset)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .net_spec import layers as L
+
+PASS_THROUGH_LAYERS = ["AbsVal", "BatchNorm", "Bias", "BNLL", "Dropout",
+                       "Eltwise", "ELU", "Log", "LRN", "Exp", "MVN",
+                       "Power", "ReLU", "PReLU", "Scale", "Sigmoid",
+                       "Split", "TanH", "Threshold"]
+
+
+class UndefinedMapException(Exception):
+    """Layer without a defined coordinate mapping."""
+
+
+class AxisMismatchException(Exception):
+    """Composed mappings disagree on the axis."""
+
+
+def conv_params(fn):
+    """Canonical (axis, stride, effective kernel, pad) from
+    convolution_param / pooling_param kwargs of a net_spec Function."""
+    params = fn.params.get("convolution_param",
+                           fn.params.get("pooling_param", fn.params))
+    axis = params.get("axis", 1)
+    ks = np.array(params["kernel_size"], ndmin=1)
+    dilation = np.array(params.get("dilation", 1), ndmin=1)
+    if {"pad_h", "pad_w", "kernel_h", "kernel_w", "stride_h",
+            "stride_w"} & set(params):
+        raise AssertionError(
+            "coordinate mapping does not support legacy _h/_w params")
+    return (axis, np.array(params.get("stride", 1), ndmin=1),
+            (ks - 1) * dilation + 1,
+            np.array(params.get("pad", 0), ndmin=1))
+
+
+def crop_params(fn):
+    params = fn.params.get("crop_param", fn.params)
+    axis = params.get("axis", 2)
+    offset = np.array(params.get("offset", 0), ndmin=1)
+    return axis, offset
+
+
+def coord_map(fn):
+    """(axis, scale, shift) for one layer (coord_map.py:57-78)."""
+    if fn.type_name in ("Convolution", "Pooling", "Im2col"):
+        axis, stride, ks, pad = conv_params(fn)
+        return axis, 1 / stride, (pad - (ks - 1) / 2) / stride
+    if fn.type_name == "Deconvolution":
+        axis, stride, ks, pad = conv_params(fn)
+        return axis, stride, (ks - 1) / 2 - pad
+    if fn.type_name in PASS_THROUGH_LAYERS:
+        return None, 1, 0
+    if fn.type_name == "Crop":
+        axis, offset = crop_params(fn)
+        return axis - 1, 1, -offset
+    raise UndefinedMapException
+
+
+def compose(base_map, next_map):
+    ax1, a1, b1 = base_map
+    ax2, a2, b2 = next_map
+    if ax1 is None:
+        ax = ax2
+    elif ax2 is None or ax1 == ax2:
+        ax = ax1
+    else:
+        raise AxisMismatchException
+    return ax, a1 * a2, a1 * b2 + b1
+
+
+def inverse(cm):
+    ax, a, b = cm
+    return ax, 1 / a, -b / a
+
+
+def coord_map_from_to(top_from, top_to):
+    """Walk both tops back to a common ancestor, composing maps
+    (coord_map.py:112-166)."""
+    def collect_bottoms(top):
+        bottoms = top.fn.inputs
+        if top.fn.type_name == "Crop":
+            bottoms = bottoms[:1]
+        return bottoms
+
+    from_maps = {top_from: (None, 1, 0)}
+    frontier = {top_from}
+    while frontier:
+        top = frontier.pop()
+        try:
+            for bottom in collect_bottoms(top):
+                from_maps[bottom] = compose(from_maps[top],
+                                            coord_map(top.fn))
+                frontier.add(bottom)
+        except UndefinedMapException:
+            pass
+
+    to_maps = {top_to: (None, 1, 0)}
+    frontier = {top_to}
+    while frontier:
+        top = frontier.pop()
+        if top in from_maps:
+            return compose(to_maps[top], inverse(from_maps[top]))
+        try:
+            for bottom in collect_bottoms(top):
+                to_maps[bottom] = compose(to_maps[top], coord_map(top.fn))
+                frontier.add(bottom)
+        except UndefinedMapException:
+            continue
+    raise RuntimeError("Could not compute map between tops; are they "
+                       "connected by spatial layers?")
+
+
+def crop(top_from, top_to):
+    """Emit the Crop layer aligning top_from to top_to
+    (coord_map.py:169-185)."""
+    ax, a, b = coord_map_from_to(top_from, top_to)
+    assert (np.asarray(a) == 1).all(), f"scale mismatch on crop (a = {a})"
+    assert (np.asarray(b) <= 0).all(), f"cannot crop negative offset ({b})"
+    assert (np.round(b) == b).all(), f"cannot crop noninteger offset ({b})"
+    return L.Crop(top_from, top_to,
+                  crop_param=dict(axis=ax + 1,
+                                  offset=list(-np.round(np.atleast_1d(b))
+                                              .astype(int))))

@@ -1,0 +1,132 @@
+"""Detector: R-CNN-style windowed detection (reference:
+python/caffe/detector.py — detect_windows crops each proposal, preprocesses
+and batches through the net; detect_selective_search is the file-list
+convenience wrapper)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import io as caffe_io
+from .pynet import Net
+
+
+class Detector(Net):
+    def __init__(self, model_file, pretrained_file, mean=None,
+                 input_scale=None, raw_scale=None, channel_swap=None,
+                 context_pad=None):
+        super().__init__(model_file, weights=pretrained_file)
+        in_ = self.inputs[0]
+        self.transformer = caffe_io.Transformer(
+            {in_: self.blobs[in_].data.shape})
+        self.transformer.set_transpose(in_, (2, 0, 1))
+        if mean is not None:
+            self.transformer.set_mean(in_, mean)
+        if input_scale is not None:
+            self.transformer.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            self.transformer.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            self.transformer.set_channel_swap(in_, channel_swap)
+        self.configure_crop(context_pad)
+
+    def detect_windows(self, images_windows):
+        """[(image_fname, window_array)] -> list of {window, prediction}
+        (detector.py:49-95)."""
+        window_inputs = []
+        for image_fname, windows in images_windows:
+            image = caffe_io.load_image(image_fname)
+            for window in windows:
+                window_inputs.append(self.crop(image, window))
+        in_ = self.inputs[0]
+        sample = self.transformer.preprocess(in_, window_inputs[0])
+        caffe_in = np.zeros((len(window_inputs),) + sample.shape,
+                            dtype=np.float32)
+        for ix, window_in in enumerate(window_inputs):
+            caffe_in[ix] = self.transformer.preprocess(in_, window_in)
+        out = self.forward_all(**{in_: caffe_in})
+        predictions = out[self.outputs[0]]
+        detections = []
+        ix = 0
+        for image_fname, windows in images_windows:
+            for window in windows:
+                detections.append({
+                    "window": window,
+                    "prediction": predictions[ix],
+                    "filename": image_fname,
+                })
+                ix += 1
+        return detections
+
+    def detect_selective_search(self, image_fnames):
+        """Windows from selective search would come from an external
+        proposal source; the reference shells out to a MATLAB package
+        (detector.py:97-119). Provide windows explicitly via
+        detect_windows."""
+        raise NotImplementedError(
+            "supply proposal windows explicitly via detect_windows "
+            "(the reference depends on an external MATLAB selective-search "
+            "package)")
+
+    def crop(self, im, window):
+        """Crop a window from the image, with context padding when
+        configured (detector.py:121-184)."""
+        window = np.round(np.asarray(window)).astype(int)
+        crop = im[window[0]:window[2], window[1]:window[3]]
+        if self.context_pad:
+            box = window.copy().astype(float)
+            crop_size = self.blobs[self.inputs[0]].data.shape[-1]
+            scale = crop_size / (crop_size - 2.0 * self.context_pad)
+            half_h = (box[2] - box[0] + 1) / 2.0
+            half_w = (box[3] - box[1] + 1) / 2.0
+            center = (box[0] + half_h, box[1] + half_w)
+            scaled_dims = scale * np.array((-half_h, -half_w,
+                                            half_h, half_w))
+            box = np.round(np.tile(center, 2) + scaled_dims).astype(int)
+            full_h = box[2] - box[0] + 1
+            full_w = box[3] - box[1] + 1
+            scale_h = crop_size / float(full_h)
+            scale_w = crop_size / float(full_w)
+            pad_y = int(max(0, -box[0]) * scale_h)
+            pad_x = int(max(0, -box[1]) * scale_w)
+            im_h, im_w = im.shape[:2]
+            box = np.clip(box, 0.0, [im_h - 1, im_w - 1,
+                                     im_h - 1, im_w - 1]).astype(int)
+            clip_h = box[2] - box[0] + 1
+            clip_w = box[3] - box[1] + 1
+            crop_h = int(np.round(clip_h * scale_h))
+            crop_w = int(np.round(clip_w * scale_w))
+            if pad_y + crop_h > crop_size:
+                crop_h = crop_size - pad_y
+            if pad_x + crop_w > crop_size:
+                crop_w = crop_size - pad_x
+            crop = np.ones((crop_size, crop_size, im.shape[2]),
+                           dtype=np.float32) * self.crop_mean
+            context_crop = im[box[0]:box[2] + 1, box[1]:box[3] + 1]
+            context_crop = caffe_io.resize_image(context_crop,
+                                                 (crop_h, crop_w))
+            crop[pad_y:pad_y + crop_h, pad_x:pad_x + crop_w] = context_crop
+        return crop
+
+    def configure_crop(self, context_pad):
+        """Derive the deprocessed mean image for context padding
+        (detector.py:186-211)."""
+        in_ = self.inputs[0]
+        self.context_pad = context_pad
+        if self.context_pad:
+            transpose = self.transformer.transpose.get(in_)
+            channel_order = self.transformer.channel_swap.get(in_)
+            raw_scale = self.transformer.raw_scale.get(in_)
+            mean = self.transformer.mean.get(in_)
+            if mean is not None:
+                inv_transpose = [transpose[t] for t in transpose]
+                crop_mean = mean.copy().transpose(inv_transpose)
+                if channel_order is not None:
+                    channel_order_inverse = [channel_order.index(i)
+                                             for i in range(crop_mean.shape[2])]
+                    crop_mean = crop_mean[:, :, channel_order_inverse]
+                if raw_scale is not None:
+                    crop_mean /= raw_scale
+                self.crop_mean = crop_mean
+            else:
+                self.crop_mean = np.zeros(
+                    self.blobs[in_].data.shape[2:] + (3,), dtype=np.float32)

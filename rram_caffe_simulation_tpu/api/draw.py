@@ -1,0 +1,96 @@
+"""Net visualization (reference: python/caffe/draw.py — net -> graphviz).
+
+Emits DOT text directly (no pydot/graphviz-binary dependency); rendering to
+an image needs the `dot` binary if present, else the .dot file is the
+artifact.
+"""
+from __future__ import annotations
+
+import subprocess
+
+from ..proto import pb
+
+LAYER_STYLE = {"shape": "record", "fillcolor": "#6495ED",
+               "style": "filled"}
+NEURON_STYLE = {"fillcolor": "#90EE90"}
+BLOB_STYLE = {"shape": "octagon", "fillcolor": "#E0E0E0",
+              "style": "filled"}
+NEURON_TYPES = {"ReLU", "PReLU", "ELU", "Sigmoid", "TanH", "AbsVal", "BNLL",
+                "Power", "Exp", "Log", "Threshold", "Dropout"}
+
+
+def _layer_label(lp, rankdir, verbose=True):
+    sep = r"\n" if rankdir in ("TB", "BT") else " "
+    label = f"{lp.name}{sep}({lp.type})"
+    if not verbose:
+        return label
+    if lp.type == "Convolution":
+        cp = lp.convolution_param
+        k = cp.kernel_size[0] if cp.kernel_size else cp.kernel_h
+        s = cp.stride[0] if cp.stride else (cp.stride_h or 1)
+        p = cp.pad[0] if cp.pad else cp.pad_h
+        label += f"{sep}kernel: {k} stride: {s} pad: {p}"
+    elif lp.type == "Pooling":
+        pool = pb.PoolingParameter.PoolMethod.Name(lp.pooling_param.pool)
+        label += (f"{sep}pool: {pool} kernel: {lp.pooling_param.kernel_size}"
+                  f" stride: {lp.pooling_param.stride}")
+    elif lp.type == "InnerProduct":
+        label += f"{sep}num_output: {lp.inner_product_param.num_output}"
+    return label
+
+
+def net_to_dot(net_param: "pb.NetParameter", rankdir: str = "LR",
+               phase=None) -> str:
+    """NetParameter -> DOT source (draw.py:123 get_pydot_graph
+    equivalent)."""
+    lines = [f'digraph "{net_param.name or "Net"}" {{',
+             f'  rankdir={rankdir};']
+    seen_blobs = set()
+    for lp in net_param.layer:
+        if phase is not None:
+            included = True
+            for rule in lp.include:
+                if rule.HasField("phase") and rule.phase != phase:
+                    included = False
+            for rule in lp.exclude:
+                if rule.HasField("phase") and rule.phase == phase:
+                    included = False
+            if not included:
+                continue
+        style = dict(LAYER_STYLE)
+        if lp.type in NEURON_TYPES:
+            style.update(NEURON_STYLE)
+        attrs = ",".join(f'{k}="{v}"' for k, v in style.items())
+        lines.append(f'  "layer_{lp.name}" [label="'
+                     f'{_layer_label(lp, rankdir)}",{attrs}];')
+        for b in lp.bottom:
+            lines.append(f'  "blob_{b}" -> "layer_{lp.name}";')
+            seen_blobs.add(b)
+        for t in lp.top:
+            lines.append(f'  "layer_{lp.name}" -> "blob_{t}";')
+            seen_blobs.add(t)
+    for b in sorted(seen_blobs):
+        attrs = ",".join(f'{k}="{v}"' for k, v in BLOB_STYLE.items())
+        lines.append(f'  "blob_{b}" [label="{b}",{attrs}];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_net_to_file(net_param: "pb.NetParameter", filename: str,
+                     rankdir: str = "LR", phase=None) -> None:
+    """Write DOT (always) and render via `dot` when the binary and a
+    non-.dot extension are given (draw.py:228 draw_net_to_file)."""
+    dot = net_to_dot(net_param, rankdir, phase)
+    if filename.endswith(".dot"):
+        with open(filename, "w") as f:
+            f.write(dot)
+        return
+    ext = filename.rsplit(".", 1)[-1]
+    try:
+        subprocess.run(["dot", f"-T{ext}", "-o", filename],
+                       input=dot.encode(), check=True)
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        with open(filename + ".dot", "w") as f:
+            f.write(dot)
+        raise RuntimeError(
+            f"graphviz `dot` unavailable; wrote {filename}.dot instead")

@@ -56,16 +56,16 @@ def load_image(filename: str, color: bool = True) -> np.ndarray:
 
 
 def resize_image(im: np.ndarray, new_dims, interp_order: int = 1):
-    """Resize HxWxC image (io.py:300)."""
-    from PIL import Image
-    resample = Image.BILINEAR if interp_order == 1 else Image.NEAREST
-    scale = im.max() if im.max() > 0 else 1.0
-    chans = []
-    for c in range(im.shape[2]):
-        img = Image.fromarray((im[:, :, c] / scale * 255).astype(np.uint8))
-        img = img.resize((new_dims[1], new_dims[0]), resample)
-        chans.append(np.asarray(img, np.float32) / 255.0 * scale)
-    return np.stack(chans, axis=2)
+    """Resize HxWxC image in float precision (io.py:300 — the reference
+    interpolates floats via skimage; uint8 round-trips would quantize and
+    wrap negative mean-subtracted values)."""
+    from scipy.ndimage import zoom
+    im = np.asarray(im, np.float32)
+    factors = (new_dims[0] / im.shape[0], new_dims[1] / im.shape[1], 1.0)
+    out = zoom(im, factors, order=interp_order, mode="nearest")
+    # guard against off-by-one output sizes from rounding
+    return np.ascontiguousarray(out[:new_dims[0], :new_dims[1], :],
+                                dtype=np.float32)
 
 
 def oversample(images, crop_dims):

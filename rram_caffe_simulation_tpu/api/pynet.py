@@ -107,11 +107,22 @@ class Net:
 
     # ------------------------------------------------------------------
     def _tree_from_mirrors(self):
+        """Device tree from the numpy mirrors. Re-uploads only arrays whose
+        host bytes changed since the last call (a host-side compare is far
+        cheaper than an unconditional H2D of every weight)."""
+        if not hasattr(self, "_dev_cache"):
+            self._dev_cache = {}
         tree = {ln: list(vals) for ln, vals in self._params_tree.items()}
         for ln, blobs in self.params.items():
             slots = [i for i, a in enumerate(tree[ln]) if a is not None]
             for slot, blob in zip(slots, blobs):
-                tree[ln][slot] = jnp.asarray(blob.data)
+                key = (ln, slot)
+                cached = self._dev_cache.get(key)
+                if (cached is None or cached[0].shape != blob.data.shape
+                        or not np.array_equal(cached[0], blob.data)):
+                    self._dev_cache[key] = (blob.data.copy(),
+                                            jnp.asarray(blob.data))
+                tree[ln][slot] = self._dev_cache[key][1]
         return tree
 
     def _feeds(self):
@@ -119,35 +130,69 @@ class Net:
                 for name in self._net.data_source_tops}
 
     def forward(self, blobs=None, start=None, end=None, **kwargs):
-        """Run forward, optionally writing kwargs into input blobs first
+        """Run forward — optionally a [start, end] layer range from staged
+        intermediate blobs — writing kwargs into input blobs first
         (pycaffe.py:78 _Net_forward). Returns {output_name: data} plus any
         extra names requested via `blobs`."""
         for k, v in kwargs.items():
             self.blobs[k].data[...] = v
         if self._forward_fn is None:
-            def run(tree, feeds, rng):
-                out, loss = self._net.apply(tree, feeds, rng=rng)
+            self._forward_fn = {}
+        key = (start, end)
+        if key not in self._forward_fn:
+            def run(tree, feeds, rng, start=start, end=end):
+                out, loss = self._net.apply(tree, feeds, rng=rng,
+                                            start=start, end=end)
                 return out
-            self._forward_fn = jax.jit(run)
-        out = self._forward_fn(self._tree_from_mirrors(), self._feeds(),
-                               self._key)
+            self._forward_fn[key] = jax.jit(run)
+        feeds = self._feeds()
+        if start is not None:
+            # feed every blob the range consumes but does not produce,
+            # from the host mirrors the caller staged
+            run_layers = self._net.layer_range(start, end)
+            produced = {t for l in run_layers for t in l.lp.top}
+            for l in run_layers:
+                for b in l.lp.bottom:
+                    if b not in produced and b not in feeds:
+                        feeds[b] = jnp.asarray(self.blobs[b].data)
+        out = self._forward_fn[key](self._tree_from_mirrors(), feeds,
+                                    self._key)
         for name, v in out.items():
             self.blobs[name].data = np.array(v)
-        wanted = set(self.outputs) | set(blobs or [])
+        if end is not None:
+            run_layers = self._net.layer_range(start, end)
+            wanted = set(run_layers[-1].lp.top) | set(blobs or [])
+        else:
+            wanted = set(self.outputs) | set(blobs or [])
         return {n: self.blobs[n].data for n in wanted}
 
     def backward(self, diffs=None, start=None, end=None, **kwargs):
-        """Gradients of the weighted loss w.r.t. params and inputs
-        (pycaffe.py:127). Fills Blob.diff mirrors; returns input diffs."""
+        """Gradients w.r.t. params and inputs (pycaffe.py:127). With no
+        kwargs, differentiates the weighted loss (Caffe's default: loss
+        tops seeded with their loss_weight). kwargs seed specific top
+        diffs instead: backward(prob=dprob) computes the VJP with dprob as
+        the cotangent on blob 'prob'. Fills Blob.diff mirrors; returns
+        input diffs (plus any names in `diffs`)."""
+        if start is not None or end is not None:
+            raise NotImplementedError(
+                "partial-range backward is not supported; seed top diffs "
+                "via kwargs instead")
         if self._backward_fn is None:
-            def run(tree, feeds, rng):
+            self._backward_fn = {}
+        seed_names = tuple(sorted(kwargs))
+        if seed_names not in self._backward_fn:
+            def run(tree, feeds, rng, seeds):
                 def loss_fn(t, f):
-                    _, loss = self._net.apply(t, f, rng=rng)
+                    blobs, loss = self._net.apply(t, f, rng=rng)
+                    if seed_names:
+                        return sum((blobs[n] * seeds[n]).sum()
+                                   for n in seed_names)
                     return loss
                 return jax.grad(loss_fn, argnums=(0, 1))(tree, feeds)
-            self._backward_fn = jax.jit(run)
-        gtree, gfeeds = self._backward_fn(self._tree_from_mirrors(),
-                                          self._feeds(), self._key)
+            self._backward_fn[seed_names] = jax.jit(run)
+        seeds = {k: jnp.asarray(v) for k, v in kwargs.items()}
+        gtree, gfeeds = self._backward_fn[seed_names](
+            self._tree_from_mirrors(), self._feeds(), self._key, seeds)
         for ln, blobs in self.params.items():
             slots = [i for i, a in enumerate(self._params_tree[ln])
                      if a is not None]
@@ -159,6 +204,12 @@ class Net:
         for name, g in gfeeds.items():
             self.blobs[name].diff = np.array(g)
             out[name] = self.blobs[name].diff
+        if diffs:
+            missing = [d for d in diffs if d not in out]
+            if missing:
+                raise NotImplementedError(
+                    f"diffs for intermediate blobs {missing} are not "
+                    "tracked; only input-blob and param diffs are computed")
         return out
 
     def forward_all(self, blobs=None, **kwargs):

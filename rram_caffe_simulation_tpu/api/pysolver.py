@@ -1,63 +1,167 @@
 """pycaffe Solver facade (reference: _caffe.cpp:367-380 solver bindings,
-pycaffe solver.net / solver.test_nets / solver.step)."""
+pycaffe solver.net / solver.test_nets / solver.step).
+
+solver.net is a live view: its param Blob mirrors are synced INTO the core
+solver before every step (so net surgery via solver.net.params takes
+effect) and refreshed FROM the solver afterwards; forward()/backward() run
+on the solver's current weights. Batch data flows through the solver's
+train_feed (or MemoryData.set_input_arrays), matching the core design —
+writing solver.net.blobs['data'] feeds forward() only, not step().
+"""
 from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
 
 from ..proto import pb
 from ..solver import Solver as CoreSolver
+from ..solver.solver import _resolve_solver_type
 from ..utils.io import read_solver_param
+
+
+class _SolverNetView:
+    """Live pycaffe-style view over a core net + the solver's params."""
+
+    def __init__(self, solver: "CoreSolver", core_net):
+        from .pynet import Blob
+        self._solver = solver
+        self._net = core_net
+        self.params = OrderedDict()
+        self._slots = {}
+        for ln, arrs in solver.params.items():
+            if ln not in core_net.layer_by_name:
+                continue
+            slots = [i for i, a in enumerate(arrs) if a is not None]
+            self._slots[ln] = slots
+            self.params[ln] = [Blob(np.asarray(arrs[i])) for i in slots]
+        self.blobs = OrderedDict()
+        for name, shape in core_net.blob_shapes.items():
+            self.blobs[name] = Blob(np.zeros(shape, np.float32))
+        self._forward_fn = None
+
+    @property
+    def layer_dict(self):
+        return self._net.layer_by_name
+
+    @property
+    def inputs(self):
+        return list(self._net.data_source_tops)
+
+    @property
+    def outputs(self):
+        return list(self._net.output_names)
+
+    # -- sync with the solver's functional state -----------------------
+    def push(self):
+        """Write mutated param mirrors into the solver (pre-step)."""
+        import jax.numpy as jnp
+        params = {ln: list(v) for ln, v in self._solver.params.items()}
+        dirty = False
+        for ln, blobs in self.params.items():
+            for slot, blob in zip(self._slots[ln], blobs):
+                if not np.array_equal(np.asarray(params[ln][slot]),
+                                      blob.data):
+                    params[ln][slot] = jnp.asarray(blob.data)
+                    dirty = True
+        if dirty:
+            self._solver.params = params
+
+    def pull(self):
+        """Refresh param mirrors from the solver (post-step)."""
+        for ln, blobs in self.params.items():
+            for slot, blob in zip(self._slots[ln], blobs):
+                blob.data = np.array(self._solver.params[ln][slot])
+
+    # -- execution on current solver weights ---------------------------
+    def forward(self, blobs=None, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        for k, v in kwargs.items():
+            self.blobs[k].data[...] = v
+        self.push()
+        if self._forward_fn is None:
+            def run(params, feeds):
+                out, loss = self._net.apply(params, feeds)
+                return out, loss
+            self._forward_fn = jax.jit(run)
+        feeds = {name: jnp.asarray(self.blobs[name].data)
+                 for name in self._net.data_source_tops}
+        out, _ = self._forward_fn(self._solver.params, feeds)
+        for name, v in out.items():
+            self.blobs[name].data = np.array(v)
+        wanted = set(self.outputs) | set(blobs or [])
+        return {n: self.blobs[n].data for n in wanted}
+
+    def save(self, path: str):
+        self.push()
+        from ..utils.io import write_proto_binary, write_net_hdf5
+        import jax
+        tree = jax.tree.map(np.asarray, self._solver.params)
+        proto = self._net.to_proto(tree)
+        if path.endswith((".h5", ".hdf5")):
+            write_net_hdf5(proto, path)
+        else:
+            write_proto_binary(path, proto)
+
+    def copy_from(self, weights_file: str):
+        self._solver.params = self._net.copy_trained_from(
+            self._solver.params, weights_file)
+        self.pull()
 
 
 class _PySolver:
     type_override = None
 
-    def __init__(self, solver_file):
-        param = (solver_file if isinstance(solver_file, pb.SolverParameter)
-                 else read_solver_param(solver_file))
+    def __init__(self, param):
+        if not isinstance(param, pb.SolverParameter):
+            param = read_solver_param(param)
         if self.type_override:
             param.type = self.type_override
         self._solver = CoreSolver(param)
+        self._net_view = None
+        self._test_views = None
 
     @property
     def net(self):
-        """Train net as a pycaffe-style Net sharing the solver's params."""
-        return self._wrap(self._solver.net)
+        if self._net_view is None:
+            self._net_view = _SolverNetView(self._solver, self._solver.net)
+        return self._net_view
 
     @property
     def test_nets(self):
-        return [self._wrap(n) for n in self._solver.test_nets]
-
-    def _wrap(self, core_net):
-        from collections import OrderedDict
-        import numpy as np
-        from .pynet import Blob
-
-        class _View:
-            pass
-        view = _View()
-        view.params = OrderedDict()
-        for ln, arrs in self._solver.params.items():
-            view.params[ln] = [Blob(np.asarray(a)) for a in arrs
-                               if a is not None]
-        view.blobs = OrderedDict()
-        for name, shape in core_net.blob_shapes.items():
-            view.blobs[name] = Blob(np.zeros(shape, np.float32))
-        return view
+        if self._test_views is None:
+            self._test_views = [_SolverNetView(self._solver, n)
+                                for n in self._solver.test_nets]
+        return self._test_views
 
     @property
     def iter(self):
         return self._solver.iter
 
     def step(self, n: int):
+        if self._net_view is not None:
+            self._net_view.push()
         self._solver.step(n)
+        if self._net_view is not None:
+            self._net_view.pull()
 
     def solve(self, resume_file=None):
+        if self._net_view is not None:
+            self._net_view.push()
         self._solver.solve(resume_file)
+        if self._net_view is not None:
+            self._net_view.pull()
 
     def snapshot(self):
+        if self._net_view is not None:
+            self._net_view.push()
         return self._solver.snapshot()
 
     def restore(self, state_file: str):
         self._solver.restore(state_file)
+        if self._net_view is not None:
+            self._net_view.pull()
 
 
 class SGDSolver(_PySolver):
@@ -85,14 +189,16 @@ class AdamSolver(_PySolver):
 
 
 def get_solver(solver_file) -> _PySolver:
-    """caffe.get_solver: dispatch on SolverParameter.type
-    (solver_factory.hpp:73)."""
+    """caffe.get_solver: dispatch on the resolved solver type — including
+    the legacy solver_type enum and "-Solver"-suffixed strings
+    (solver_factory.hpp:73; upgrade_proto.hpp:80)."""
     param = (solver_file if isinstance(solver_file, pb.SolverParameter)
              else read_solver_param(solver_file))
+    resolved = _resolve_solver_type(param)
     cls = {"SGD": SGDSolver, "Nesterov": NesterovSolver,
            "AdaGrad": AdaGradSolver, "RMSProp": RMSPropSolver,
-           "AdaDelta": AdaDeltaSolver, "Adam": AdamSolver}[
-               param.type or "SGD"]
-    inst = cls.__new__(cls)
-    _PySolver.__init__(inst, param)
-    return inst
+           "AdaDelta": AdaDeltaSolver, "Adam": AdamSolver}.get(resolved)
+    if cls is None:
+        raise ValueError(f"unknown solver type {resolved!r}")
+    param.type = resolved
+    return cls(param)

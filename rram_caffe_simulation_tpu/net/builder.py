@@ -242,22 +242,48 @@ class Net:
         return [params[owner][slot] for owner, slot in slots]
 
     # ------------------------------------------------------------------
+    def layer_range(self, start: Optional[str] = None,
+                    end: Optional[str] = None):
+        """Layer sublist from `start` through `end` inclusive (the
+        pycaffe _Net_forward start/end contract, pycaffe.py:78-105)."""
+        names = [l.name for l in self.layers]
+        i = names.index(start) if start is not None else 0
+        j = names.index(end) + 1 if end is not None else len(self.layers)
+        return self.layers[i:j]
+
     def apply(self, params, batch: Optional[dict] = None, rng=None,
-              iteration=None, with_updates: bool = False):
-        """Run the net. Returns (blobs, loss) or (blobs, loss, new_params)
-        when with_updates (BatchNorm moving stats) is requested.
+              iteration=None, with_updates: bool = False,
+              start: Optional[str] = None, end: Optional[str] = None):
+        """Run the net (or the [start, end] layer range). `batch` feeds
+        data-source tops — plus, for partial runs, any bottom consumed but
+        not produced inside the range. Returns (blobs, loss) or
+        (blobs, loss, new_params) when with_updates (BatchNorm moving
+        stats) is requested.
         """
         batch = batch or {}
         ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration)
+        run_layers = self.layer_range(start, end)
+        produced_in_range = {t for l in run_layers for t in l.lp.top}
         blobs: dict[str, Any] = {}
         for name, shape in self.data_source_tops.items():
-            if name not in batch:
+            if name in batch:
+                blobs[name] = batch[name]
+            elif any(not l.is_data_source for l in run_layers
+                     if name in l.lp.bottom):
                 raise ValueError(f"batch missing data blob {name!r}")
-            blobs[name] = batch[name]
         updates: dict[str, list] = {}
-        for layer in self.layers:
+        for layer in run_layers:
             if layer.is_data_source:
                 continue
+            for b in layer.lp.bottom:
+                if b not in blobs:
+                    if b in batch:
+                        blobs[b] = batch[b]
+                    else:
+                        raise ValueError(
+                            f"partial run needs blob {b!r} supplied "
+                            f"(consumed by {layer.name!r} but not produced "
+                            "in range)")
             bottoms = [blobs[b] for b in layer.lp.bottom]
             lparams = self._gather_layer_params(params, layer)
             tops, new_params = layer.apply(lparams, bottoms, ctx)
@@ -267,7 +293,8 @@ class Net:
                 blobs[t] = v
         loss = jnp.asarray(0.0, dtype=jnp.float32)
         for blob_name, w in self.loss_weights.items():
-            loss = loss + w * jnp.sum(blobs[blob_name])
+            if blob_name in blobs:  # absent on partial runs ending earlier
+                loss = loss + w * jnp.sum(blobs[blob_name])
         if with_updates:
             new_params = {ln: list(vals) for ln, vals in params.items()}
             for ln, vals in updates.items():

@@ -7,3 +7,5 @@ from . import vision  # noqa: F401
 from . import common  # noqa: F401
 from . import neuron  # noqa: F401
 from . import losses  # noqa: F401
+from . import recurrent  # noqa: F401
+from . import extra  # noqa: F401

@@ -1,0 +1,166 @@
+"""SPP, Filter, and Python layers (reference: src/caffe/layers/
+spp_layer.cpp, filter_layer.cpp, include/caffe/layers/python_layer.hpp).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+from .vision import PoolingLayer
+
+
+@register_layer("SPP")
+class SPPLayer(Layer):
+    """Spatial pyramid pooling (spp_layer.cpp): pyramid_height levels, level
+    l pools into 2^l x 2^l bins (kernel = ceil(dim/bins), stride = kernel,
+    pad = (remainder+1)//2 — spp_layer.cpp:22-42), each level flattened and
+    all concatenated. Implemented exactly as the reference does: internal
+    PoolingLayers per level."""
+
+    def setup(self, bottom_shapes):
+        spp = self.lp.spp_param
+        n, c, h, w = bottom_shapes[0]
+        self.levels = []
+        total = 0
+        for l in range(spp.pyramid_height):
+            bins = 2 ** l
+            lp = pb.LayerParameter(name=f"{self.name}_pool{l}",
+                                   type="Pooling")
+            lp.top.append("t")
+            pp = lp.pooling_param
+            pp.pool = {pb.SPPParameter.MAX: pb.PoolingParameter.MAX,
+                       pb.SPPParameter.AVE: pb.PoolingParameter.AVE,
+                       pb.SPPParameter.STOCHASTIC:
+                           pb.PoolingParameter.STOCHASTIC}[spp.pool]
+            pp.kernel_h = math.ceil(h / bins)
+            pp.kernel_w = math.ceil(w / bins)
+            pp.stride_h = pp.kernel_h
+            pp.stride_w = pp.kernel_w
+            pp.pad_h = (pp.kernel_h * bins - h + 1) // 2
+            pp.pad_w = (pp.kernel_w * bins - w + 1) // 2
+            pool = PoolingLayer(lp, self.phase)
+            out = pool.setup([bottom_shapes[0]])[0]
+            assert out[2] == bins and out[3] == bins, \
+                f"SPP level {l}: got {out[2:]} bins, want {bins}"
+            self.levels.append(pool)
+            total += c * bins * bins
+        self.top_shapes = [(n, total)]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        x = bottoms[0]
+        n = x.shape[0]
+        parts = []
+        for pool in self.levels:
+            tops_l, _ = pool.apply([], [x], ctx)
+            parts.append(tops_l[0].reshape(n, -1))
+        return [jnp.concatenate(parts, axis=1)], None
+
+
+@register_layer("Filter")
+class FilterLayer(Layer):
+    """Batch-item filtering by a selector blob (filter_layer.cpp: forwards
+    only items whose selector is nonzero).
+
+    XLA deviation (documented): the reference emits a *dynamically sized*
+    batch; under jit all shapes are static, so the selected items are
+    packed to the front of a full-size batch and the remainder zero-filled.
+    Downstream consumers can read the count from the selector sum. This
+    preserves the selected items' values and order.
+    """
+
+    def setup(self, bottom_shapes):
+        # last bottom is the selector (N,) or (N,1)
+        self.top_shapes = [tuple(s) for s in bottom_shapes[:-1]]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        sel = bottoms[-1].reshape(bottoms[-1].shape[0])
+        keep = sel != 0
+        # stable pack-to-front permutation: indices of kept items first
+        order = jnp.argsort(~keep, stable=True)
+        n_keep = jnp.sum(keep)
+        tops = []
+        for b in bottoms[:-1]:
+            packed = b[order]
+            mask_shape = (b.shape[0],) + (1,) * (b.ndim - 1)
+            valid = (jnp.arange(b.shape[0]) < n_keep).reshape(mask_shape)
+            tops.append(jnp.where(valid, packed, 0))
+        return tops, None
+
+
+@register_layer("Python")
+class PythonLayer(Layer):
+    """User-extensible layer (python_layer.hpp:14): prototxt
+    `type: "Python"` with python_param {module, layer, param_str}
+    instantiates a user class with Caffe's setup/reshape/forward contract.
+
+    The user object receives pycaffe-style bottom/top wrappers with mutable
+    numpy `.data`. Forward runs host-side through jax.pure_callback, so it
+    composes with jit but is opaque to autodiff (gradients treated as zero
+    — the reference's PythonLayer backward is likewise only invoked when
+    the user implements it; hook custom_vjp in a later round)."""
+
+    def setup(self, bottom_shapes):
+        import importlib
+        ppar = self.lp.python_param
+        module = importlib.import_module(ppar.module)
+        cls = getattr(module, ppar.layer)
+        self.obj = cls()
+        self.obj.param_str = ppar.param_str
+
+        class _B:
+            def __init__(self, shape):
+                self.data = np.zeros(shape, np.float32)
+                self.diff = np.zeros(shape, np.float32)
+                self._shape = list(shape)
+
+            def reshape(self, *shape):
+                self._shape = list(shape)
+                self.data = np.zeros(shape, np.float32)
+                self.diff = np.zeros(shape, np.float32)
+
+            @property
+            def shape(self):
+                return self._shape
+
+            def count(self):
+                return self.data.size
+
+        bottoms = [_B(s) for s in bottom_shapes]
+        n_top = max(len(self.lp.top), 1)
+        tops = [_B((1,)) for _ in range(n_top)]
+        self.obj.setup(bottoms, tops)
+        self.obj.reshape(bottoms, tops)
+        self._B = _B
+        self.bottom_shapes = [tuple(s) for s in bottom_shapes]
+        self.top_shapes = [tuple(t.shape) for t in tops]
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        def host_forward(*arrs):
+            bs = [self._B(a.shape) for a in arrs]
+            for b, a in zip(bs, arrs):
+                b.data[...] = np.asarray(a)
+            ts = [self._B(s) for s in self.top_shapes]
+            self.obj.reshape(bs, ts)
+            self.obj.forward(bs, ts)
+            return tuple(np.asarray(t.data, np.float32) for t in ts)
+
+        if not any(isinstance(b, jax.core.Tracer) for b in bottoms):
+            # eager path: run host-side directly — works on backends with
+            # no host-callback support (e.g. tunneled PJRT plugins)
+            return [jnp.asarray(t) for t in host_forward(*bottoms)], None
+        out_spec = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                         for s in self.top_shapes)
+        tops = jax.pure_callback(host_forward, out_spec, *bottoms)
+        return list(tops), None
+
+    def default_loss_weight(self, top_index: int):
+        # honor loss_weight from the prototxt only (layer.hpp default)
+        return 0.0

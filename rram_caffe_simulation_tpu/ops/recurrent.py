@@ -1,0 +1,207 @@
+"""Recurrent layers (reference: src/caffe/layers/{recurrent,rnn,lstm,
+lstm_unit}_layer.cpp).
+
+The reference unrolls T timesteps into an internal Net
+(recurrent_layer.hpp:151 unrolled_net_, subclass hook FillUnrolledNet);
+here the unroll is a `lax.scan`, which XLA compiles to a rolled loop — same
+math, no T-times graph duplication, differentiable through time
+automatically.
+
+Semantics preserved exactly:
+- bottoms: x (T,N,...), cont (T,N) sequence-continuation indicator
+  (recurrent_layer.cpp:34: cont_t = 0 at sequence starts), optional
+  x_static (N,...), optional initial recurrent state(s) when
+  expose_hidden (recurrent_layer.hpp:41).
+- RNN (rnn_layer.cpp:98-227): h_t = tanh(W_hh (cont_t * h_{t-1}) +
+  W_xh x_t + b_h [+ W_xh_static x_static]); o_t = tanh(W_ho h_t + b_o).
+  Param blob order [W_xh, b_h, (W_xh_static), W_hh, W_ho, b_o] follows the
+  unrolled net's creation order, so .caffemodel weights load unchanged.
+- LSTM (lstm_layer.cpp:107-244, lstm_unit_layer.cpp:41-66): gate_input =
+  W_hc (cont_t*h_{t-1}) + W_xc x_t + b_c [+ W_xc_static x_static], gates
+  ordered [i, f, o, g]; c_t = cont_t*f*c_{t-1} + i*g; h_t = o*tanh(c_t).
+  Params [W_xc, b_c, (W_xc_static), W_hc].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+
+
+class RecurrentLayer(Layer):
+    """Base: bottom/top bookkeeping shared by RNN and LSTM
+    (recurrent_layer.cpp:20-136)."""
+
+    # subclass contract
+    n_recur_blobs = 1          # h only (LSTM: c and h)
+
+    def setup(self, bottom_shapes):
+        rp = self.lp.recurrent_param
+        self.D = int(rp.num_output)
+        assert self.D > 0, "num_output must be positive"
+        self.expose_hidden = rp.expose_hidden
+        x_shape = bottom_shapes[0]
+        self.T, self.N = int(x_shape[0]), int(x_shape[1])
+        self.I = 1
+        for d in x_shape[2:]:
+            self.I *= int(d)
+        n_hidden_exposed = (self.n_recur_blobs if self.expose_hidden else 0)
+        self.static_input = len(bottom_shapes) > 2 + n_hidden_exposed
+        if self.static_input:
+            self.S = 1
+            for d in bottom_shapes[2][1:]:
+                self.S *= int(d)
+        tops = [(self.T, self.N, self.D)]
+        if self.expose_hidden:
+            tops += [(1, self.N, self.D)] * self.n_recur_blobs
+        self.top_shapes = tops
+        return tops
+
+    def _fillers(self):
+        rp = self.lp.recurrent_param
+        return make_filler(rp.weight_filler), make_filler(rp.bias_filler)
+
+
+@register_layer("RNN")
+class RNNLayer(RecurrentLayer):
+    n_recur_blobs = 1
+
+    def num_params(self):
+        return 6 if self.static_input else 5
+
+    def init_params(self, key):
+        wf, bf = self._fillers()
+        keys = jax.random.split(key, 4)
+        params = [wf(keys[0], (self.D, self.I)),      # W_xh
+                  bf(keys[1], (self.D,))]             # b_h
+        if self.static_input:
+            key_s = jax.random.fold_in(key, 99)
+            params.append(wf(key_s, (self.D, self.S)))  # W_xh_static
+        params += [wf(keys[2], (self.D, self.D)),     # W_hh
+                   wf(keys[3], (self.D, self.D))]     # W_ho
+        params.append(bf(jax.random.fold_in(key, 100), (self.D,)))  # b_o
+        return params
+
+    def apply(self, params, bottoms, ctx):
+        x, cont = bottoms[0], bottoms[1]
+        i = 2
+        x_static = None
+        if self.static_input:
+            x_static = bottoms[i]
+            i += 1
+        T_, N_ = x.shape[0], x.shape[1]
+        if self.expose_hidden and len(bottoms) > i:
+            h0 = bottoms[i].reshape(N_, self.D)
+        else:
+            h0 = jnp.zeros((N_, self.D), x.dtype)
+        if self.static_input:
+            W_xh, b_h, W_xs, W_hh, W_ho, b_o = params
+            static_term = x_static.reshape(N_, self.S) @ W_xs.T
+        else:
+            W_xh, b_h, W_hh, W_ho, b_o = params
+            static_term = 0.0
+        xt = x.reshape(T_, N_, self.I) @ W_xh.T + b_h
+
+        def step(h_prev, inp):
+            x_t, cont_t = inp
+            h_conted = h_prev * cont_t[:, None]
+            h = jnp.tanh(h_conted @ W_hh.T + x_t + static_term)
+            o = jnp.tanh(h @ W_ho.T + b_o)
+            return h, o
+
+        h_final, o_seq = jax.lax.scan(step, h0, (xt, cont.astype(x.dtype)))
+        tops = [o_seq]
+        if self.expose_hidden:
+            tops.append(h_final[None])
+        return tops, None
+
+
+@register_layer("LSTM")
+class LSTMLayer(RecurrentLayer):
+    n_recur_blobs = 2   # c and h (recur order: c_0, h_0 — lstm_layer.cpp:41)
+
+    def num_params(self):
+        return 4 if self.static_input else 3
+
+    def init_params(self, key):
+        wf, bf = self._fillers()
+        keys = jax.random.split(key, 3)
+        params = [wf(keys[0], (4 * self.D, self.I)),   # W_xc
+                  bf(keys[1], (4 * self.D,))]          # b_c
+        if self.static_input:
+            params.append(wf(jax.random.fold_in(key, 99),
+                             (4 * self.D, self.S)))    # W_xc_static
+        params.append(wf(keys[2], (4 * self.D, self.D)))  # W_hc
+        return params
+
+    def apply(self, params, bottoms, ctx):
+        x, cont = bottoms[0], bottoms[1]
+        i = 2
+        x_static = None
+        if self.static_input:
+            x_static = bottoms[i]
+            i += 1
+        T_, N_ = x.shape[0], x.shape[1]
+        if self.expose_hidden and len(bottoms) > i + 1:
+            c0 = bottoms[i].reshape(N_, self.D)
+            h0 = bottoms[i + 1].reshape(N_, self.D)
+        else:
+            c0 = jnp.zeros((N_, self.D), x.dtype)
+            h0 = jnp.zeros((N_, self.D), x.dtype)
+        if self.static_input:
+            W_xc, b_c, W_xs, W_hc = params
+            static_term = x_static.reshape(N_, self.S) @ W_xs.T
+        else:
+            W_xc, b_c, W_hc = params
+            static_term = 0.0
+        xt = x.reshape(T_, N_, self.I) @ W_xc.T + b_c
+
+        D = self.D
+
+        def step(carry, inp):
+            c_prev, h_prev = carry
+            x_t, cont_t = inp
+            h_conted = h_prev * cont_t[:, None]
+            gates = h_conted @ W_hc.T + x_t + static_term
+            c, h = _lstm_unit(c_prev, gates, cont_t, D)
+            return (c, h), h
+
+        (c_final, h_final), h_seq = jax.lax.scan(
+            step, (c0, h0), (xt, cont.astype(x.dtype)))
+        tops = [h_seq]
+        if self.expose_hidden:
+            tops += [c_final[None], h_final[None]]
+        return tops, None
+
+
+def _lstm_unit(c_prev, gates, cont_t, D):
+    """LSTMUnit math (lstm_unit_layer.cpp:41-66), gate order [i, f, o, g];
+    f is cont-scaled so c_prev is forgotten at sequence starts."""
+    i = jax.nn.sigmoid(gates[:, 0 * D:1 * D])
+    f = cont_t[:, None] * jax.nn.sigmoid(gates[:, 1 * D:2 * D])
+    o = jax.nn.sigmoid(gates[:, 2 * D:3 * D])
+    g = jnp.tanh(gates[:, 3 * D:4 * D])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return c, h
+
+
+@register_layer("LSTMUnit")
+class LSTMUnitLayer(Layer):
+    """Standalone single-step LSTM unit (lstm_unit_layer.cpp): bottoms
+    c_prev (1,N,D), gate_input (1,N,4D), cont (1,N); tops c, h."""
+
+    def setup(self, bottom_shapes):
+        self.D = int(bottom_shapes[0][2])
+        self.top_shapes = [tuple(bottom_shapes[0])] * 2
+        return self.top_shapes
+
+    def apply(self, params, bottoms, ctx):
+        c_prev, gates, cont = bottoms
+        n = c_prev.shape[1]
+        c, h = _lstm_unit(c_prev.reshape(n, self.D),
+                          gates.reshape(n, 4 * self.D),
+                          cont.reshape(n), self.D)
+        return [c[None], h[None]], None

@@ -128,11 +128,22 @@ def time(args):
     batch = {name: jnp.asarray(rng.randn(*shape), jnp.float32)
              for name, shape in net.data_source_tops.items()}
 
-    fwd = jax.jit(lambda p, b: net.apply(p, b)[1])
-    grad = jax.jit(jax.grad(lambda p, b: net.apply(p, b)[1]))
-    fwd(params, batch)                      # compile
-    g = grad(params, batch)
-    jax.block_until_ready(g)
+    # time the OUTPUT blobs, not just the loss scalar — otherwise XLA
+    # dead-code-eliminates everything on loss-less deploy nets
+    def outputs_of(p, b):
+        blobs, loss = net.apply(p, b)
+        return {n: blobs[n] for n in net.output_names}, loss
+
+    fwd = jax.jit(lambda p, b: outputs_of(p, b)[0])
+
+    def bwd_scalar(p, b):
+        outs, loss = outputs_of(p, b)
+        if net.loss_weights:
+            return loss
+        return sum(jnp.sum(v) for v in outs.values())  # keep graph alive
+    grad = jax.jit(jax.grad(bwd_scalar))
+    jax.block_until_ready(fwd(params, batch))   # compile
+    jax.block_until_ready(grad(params, batch))
     iters = args.iterations
 
     t0 = _time.perf_counter()

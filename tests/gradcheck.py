@@ -26,7 +26,7 @@ def check_gradient(fn, args, check_args=None, stepsize=1e-4, threshold=1e-3,
     f = lambda *a: jnp.asarray(fn(*a), dtype=jnp.float64)
     analytic = jax.grad(f, argnums=tuple(check_args))(*args)
     for gi, ai in enumerate(check_args):
-        a = np.asarray(args[ai], dtype=np.float64)
+        a = np.array(args[ai], dtype=np.float64)  # writable copy
         g = np.asarray(analytic[gi], dtype=np.float64)
         flat = a.reshape(-1)
         gflat = g.reshape(-1)

@@ -1,0 +1,92 @@
+"""Classifier/draw/coord_map tests (reference: python/caffe/test/
+test_coord_map.py + classifier/draw usage)."""
+import numpy as np
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu import api as caffe
+from rram_caffe_simulation_tpu.api import layers as L
+from rram_caffe_simulation_tpu.api.coord_map import (coord_map_from_to,
+                                                     crop)
+from rram_caffe_simulation_tpu.proto import pb
+
+
+def test_coord_map_conv_pool():
+    """Mirror of test_coord_map.py::test_conv — composition of conv+pool
+    downsampling."""
+    n = caffe.NetSpec()
+    n.data = L.Input(input_param=dict(shape=[dict(dim=[1, 1, 100, 100])]))
+    n.conv = L.Convolution(n.data, num_output=10, kernel_size=5, stride=2,
+                           pad=0)
+    n.pool = L.Pooling(n.conv, kernel_size=2, stride=2, pad=0)
+    ax, a, b = coord_map_from_to(n.pool, n.data)
+    # total scale = 4, offset = (5-1)/2 * 1 + (2-1)/2 * 2 = 2 + 1 = 3
+    assert np.all(np.asarray(a) == 4)
+    assert np.all(np.asarray(b) == 3)
+
+
+def test_coord_map_pass_through_and_identity():
+    n = caffe.NetSpec()
+    n.data = L.Input(input_param=dict(shape=[dict(dim=[1, 1, 32, 32])]))
+    n.relu = L.ReLU(n.data)
+    ax, a, b = coord_map_from_to(n.relu, n.data)
+    assert a == 1 and b == 0
+
+
+def test_coord_map_crop_emission():
+    """FCN-style: upsampling deconv then crop to input alignment
+    (test_coord_map.py crop checks)."""
+    n = caffe.NetSpec()
+    n.data = L.Input(input_param=dict(shape=[dict(dim=[1, 1, 64, 64])]))
+    n.conv = L.Convolution(n.data, num_output=4, kernel_size=4, stride=2,
+                           pad=1)
+    n.up = L.Deconvolution(n.conv, convolution_param=dict(
+        num_output=4, kernel_size=4, stride=2, pad=0))
+    cropped = crop(n.up, n.data)
+    lp = cropped.fn
+    assert lp.type_name == "Crop"
+    assert lp.params["crop_param"]["axis"] == 2
+    assert lp.params["crop_param"]["offset"] == [1]
+
+
+def test_draw_dot():
+    npm = pb.NetParameter()
+    text_format.Parse("""
+    name: "tiny"
+    layer { name: "data" type: "Input" top: "data"
+      input_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+    layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+      convolution_param { num_output: 2 kernel_size: 3 } }
+    layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+    """, npm)
+    dot = caffe.draw.net_to_dot(npm)
+    assert 'digraph "tiny"' in dot
+    assert '"layer_conv"' in dot and '"blob_conv"' in dot
+    assert "kernel: 3" in dot
+
+
+def test_classifier_predict(tmp_path):
+    """End-to-end Classifier: save a tiny net's weights, oversampled
+    predict over raw images."""
+    npm = pb.NetParameter()
+    text_format.Parse("""
+    name: "cls"
+    layer { name: "data" type: "Input" top: "data"
+      input_param { shape { dim: 10 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param { num_output: 4
+        weight_filler { type: "xavier" } } }
+    layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+    """, npm)
+    seed_net = caffe.Net(npm, caffe.TEST)
+    weights = str(tmp_path / "w.caffemodel")
+    seed_net.save(weights)
+
+    clf = caffe.Classifier(npm, weights, image_dims=(12, 12))
+    imgs = [np.random.RandomState(i).rand(16, 16, 3).astype(np.float32)
+            for i in range(3)]
+    preds = clf.predict(imgs, oversample=True)
+    assert preds.shape == (3, 4)
+    np.testing.assert_allclose(preds.sum(1), 1.0, rtol=1e-4)
+    preds2 = clf.predict(imgs, oversample=False)
+    assert preds2.shape == (3, 4)

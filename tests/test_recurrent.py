@@ -1,0 +1,212 @@
+"""Recurrent + extra layer tests (reference: test_rnn_layer.cpp,
+test_lstm_layer.cpp — gradient checks + cont-reset semantics;
+test_spp_layer.cpp; test_filter_layer.cpp)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu import ops  # noqa: F401  (registers layers)
+from rram_caffe_simulation_tpu.core.registry import (LayerContext,
+                                                     create_layer)
+from gradcheck import check_gradient
+
+T, N, I, D = 3, 2, 4, 5
+
+
+def make_layer(text, phase=pb.TRAIN):
+    lp = pb.LayerParameter()
+    text_format.Parse(text, lp)
+    return create_layer(lp, phase)
+
+
+def rnn_layer(expose=False):
+    return make_layer(f"""
+      name: "rnn" type: "RNN" bottom: "x" bottom: "cont" top: "o"
+      recurrent_param {{ num_output: {D} expose_hidden: {str(expose).lower()}
+        weight_filler {{ type: "uniform" min: -0.2 max: 0.2 }}
+        bias_filler {{ type: "constant" value: 0.1 }} }}
+    """)
+
+
+def lstm_layer():
+    return make_layer(f"""
+      name: "lstm" type: "LSTM" bottom: "x" bottom: "cont" top: "h"
+      recurrent_param {{ num_output: {D}
+        weight_filler {{ type: "uniform" min: -0.2 max: 0.2 }}
+        bias_filler {{ type: "constant" value: 0.1 }} }}
+    """)
+
+
+def data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0  # sequence start (reference test convention)
+    return jnp.asarray(x), jnp.asarray(cont)
+
+
+def test_rnn_shapes_and_reference_math():
+    layer = rnn_layer()
+    x, cont = data()
+    layer.setup([(T, N, I), (T, N)])
+    params = layer.init_params(jax.random.PRNGKey(1))
+    assert [p.shape for p in params] == [(D, I), (D,), (D, D), (D, D), (D,)]
+    tops, _ = layer.apply(params, [x, cont], LayerContext(phase=pb.TRAIN))
+    assert tops[0].shape == (T, N, D)
+    # hand-rolled reference recurrence (rnn_layer.cpp:98-227)
+    W_xh, b_h, W_hh, W_ho, b_o = [np.asarray(p) for p in params]
+    h = np.zeros((N, D))
+    outs = []
+    for t in range(T):
+        h = np.tanh((np.asarray(cont)[t][:, None] * h) @ W_hh.T
+                    + np.asarray(x)[t] @ W_xh.T + b_h)
+        outs.append(np.tanh(h @ W_ho.T + b_o))
+    np.testing.assert_allclose(np.asarray(tops[0]), np.stack(outs),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_shapes_and_reference_math():
+    layer = lstm_layer()
+    x, cont = data()
+    layer.setup([(T, N, I), (T, N)])
+    params = layer.init_params(jax.random.PRNGKey(1))
+    assert [p.shape for p in params] == [(4 * D, I), (4 * D,), (4 * D, D)]
+    tops, _ = layer.apply(params, [x, cont], LayerContext(phase=pb.TRAIN))
+    assert tops[0].shape == (T, N, D)
+    # hand-rolled reference recurrence (lstm_layer.cpp + lstm_unit_layer.cpp)
+    W_xc, b_c, W_hc = [np.asarray(p, np.float64) for p in params]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    c = np.zeros((N, D))
+    h = np.zeros((N, D))
+    outs = []
+    for t in range(T):
+        ct = np.asarray(cont)[t][:, None]
+        gates = (ct * h) @ W_hc.T + np.asarray(x)[t] @ W_xc.T + b_c
+        i = sig(gates[:, :D])
+        f = ct * sig(gates[:, D:2 * D])
+        o = sig(gates[:, 2 * D:3 * D])
+        g = np.tanh(gates[:, 3 * D:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h.copy())
+    np.testing.assert_allclose(np.asarray(tops[0]), np.stack(outs),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_cont_reset():
+    """cont=0 mid-sequence resets the carried state exactly (the reference's
+    TestLSTMLayer cont semantics)."""
+    layer = lstm_layer()
+    layer.setup([(T, N, I), (T, N)])
+    params = layer.init_params(jax.random.PRNGKey(1))
+    x, _ = data()
+    cont_reset = jnp.asarray(np.array(
+        [[0, 0], [1, 1], [0, 0]], np.float32))  # t=2 starts a new sequence
+    tops, _ = layer.apply(params, [x, cont_reset],
+                          LayerContext(phase=pb.TRAIN))
+    # a fresh run on just timestep 2 must match
+    tops2, _ = layer.apply(params, [x[2:], jnp.zeros((1, N))],
+                           LayerContext(phase=pb.TRAIN))
+    np.testing.assert_allclose(np.asarray(tops[0][2]),
+                               np.asarray(tops2[0][0]), rtol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["RNN", "LSTM"])
+def test_recurrent_gradients(kind):
+    layer = rnn_layer() if kind == "RNN" else lstm_layer()
+    layer.setup([(T, N, I), (T, N)])
+    params = layer.init_params(jax.random.PRNGKey(2))
+    x, cont = data()
+
+    def loss(x_, *ps):
+        tops, _ = layer.apply(list(ps), [x_, cont],
+                              LayerContext(phase=pb.TRAIN))
+        return jnp.sum(tops[0] * jnp.cos(jnp.arange(tops[0].size)
+                                         .reshape(tops[0].shape)))
+    check_gradient(loss, [x] + list(params), stepsize=1e-5, threshold=2e-3)
+
+
+def test_lstm_unit_matches_lstm_step():
+    unit = make_layer("""
+      name: "u" type: "LSTMUnit" bottom: "c" bottom: "g" bottom: "cont"
+      top: "c1" top: "h1"
+    """)
+    rng = np.random.RandomState(0)
+    c_prev = rng.randn(1, N, D).astype(np.float32)
+    gates = rng.randn(1, N, 4 * D).astype(np.float32)
+    cont = np.ones((1, N), np.float32)
+    unit.setup([(1, N, D), (1, N, 4 * D), (1, N)])
+    tops, _ = unit.apply([], [jnp.asarray(c_prev), jnp.asarray(gates),
+                              jnp.asarray(cont)],
+                         LayerContext(phase=pb.TRAIN))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    i = sig(gates[0, :, :D])
+    f = sig(gates[0, :, D:2 * D])
+    o = sig(gates[0, :, 2 * D:3 * D])
+    g = np.tanh(gates[0, :, 3 * D:])
+    c = f * c_prev[0] + i * g
+    np.testing.assert_allclose(np.asarray(tops[0][0]), c, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(tops[1][0]), o * np.tanh(c),
+                               rtol=1e-5)
+
+
+def test_spp_layer():
+    layer = make_layer("""
+      name: "spp" type: "SPP" bottom: "x" top: "y"
+      spp_param { pyramid_height: 3 pool: MAX }
+    """)
+    shapes = layer.setup([(2, 3, 9, 9)])
+    # 3 levels: 1 + 4 + 16 bins = 21 per channel
+    assert shapes[0] == (2, 3 * 21)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 9, 9),
+                    jnp.float32)
+    tops, _ = layer.apply([], [x], LayerContext(phase=pb.TEST))
+    assert tops[0].shape == (2, 63)
+    # level 0 = global max per channel
+    np.testing.assert_allclose(np.asarray(tops[0][:, :3]),
+                               np.asarray(x.max(axis=(2, 3))), rtol=1e-6)
+
+
+def test_filter_layer():
+    layer = make_layer("""
+      name: "f" type: "Filter" bottom: "x" bottom: "sel" top: "y"
+    """)
+    layer.setup([(4, 3), (4,)])
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    sel = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    tops, _ = layer.apply([], [x, sel], LayerContext(phase=pb.TEST))
+    out = np.asarray(tops[0])
+    np.testing.assert_array_equal(out[0], np.asarray(x[0]))
+    np.testing.assert_array_equal(out[1], np.asarray(x[2]))
+    np.testing.assert_array_equal(out[2:], 0.0)
+
+
+# a module-level Python layer class for the PythonLayer test
+class DoublerLayer:
+    def setup(self, bottom, top):
+        pass
+
+    def reshape(self, bottom, top):
+        top[0].reshape(*bottom[0].shape)
+
+    def forward(self, bottom, top):
+        top[0].data[...] = bottom[0].data * 2.0
+
+
+def test_python_layer():
+    layer = make_layer("""
+      name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "test_recurrent" layer: "DoublerLayer" }
+    """)
+    shapes = layer.setup([(2, 3)])
+    assert shapes[0] == (2, 3)
+    x = jnp.asarray(np.ones((2, 3), np.float32))
+    tops, _ = layer.apply([], [x], LayerContext(phase=pb.TEST))
+    np.testing.assert_allclose(np.asarray(tops[0]), 2.0)
+    # composes under jit
+    f = jax.jit(lambda v: layer.apply(
+        [], [v], LayerContext(phase=pb.TEST))[0][0])
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0)
