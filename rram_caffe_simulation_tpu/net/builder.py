@@ -79,31 +79,6 @@ def filter_net(net_param: "pb.NetParameter", state: "pb.NetState") -> "pb.NetPar
     return out
 
 
-def _upgrade_legacy_inputs(net_param: "pb.NetParameter") -> None:
-    """Rewrite deprecated NetParameter.input/input_shape/input_dim into an
-    Input layer (reference util/upgrade_proto.cpp UpgradeNetInput)."""
-    if not net_param.input:
-        return
-    lp = pb.LayerParameter(name="input", type="Input")
-    lp.top.extend(net_param.input)
-    for i in range(len(net_param.input)):
-        shape = lp.input_param.shape.add()
-        if net_param.input_shape:
-            src = net_param.input_shape[min(i, len(net_param.input_shape) - 1)]
-            shape.dim.extend(src.dim)
-        else:
-            shape.dim.extend(net_param.input_dim[4 * i: 4 * i + 4])
-    # prepend
-    layers = list(net_param.layer)
-    del net_param.layer[:]
-    net_param.layer.add().CopyFrom(lp)
-    for l in layers:
-        net_param.layer.add().CopyFrom(l)
-    del net_param.input[:]
-    del net_param.input_shape[:]
-    del net_param.input_dim[:]
-
-
 class Net:
     """Functional network built from a NetParameter.
 
@@ -124,8 +99,13 @@ class Net:
         state.phase = phase
         state.level = level
         state.stage.extend(s for s in stages if s not in state.stage)
+        from ..utils.upgrade import upgrade_net_as_needed
         net_param = pb.NetParameter.FromString(net_param.SerializeToString())
-        _upgrade_legacy_inputs(net_param)
+        # Handles V0/V1 `layers`, deprecated transform/input fields, and
+        # 3-param BatchNorm, so in-memory legacy messages (e.g. a
+        # SolverParameter.net_param authored against an old schema) work
+        # the same as files read through utils.io.
+        upgrade_net_as_needed(net_param)
         self.param_proto = filter_net(net_param, state)
         self.name = net_param.name
         self.phase = int(state.phase)

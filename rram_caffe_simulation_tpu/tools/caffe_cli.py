@@ -185,11 +185,69 @@ def time(args):
     return 0
 
 
+@register
+def upgrade_net_proto_text(args):
+    """tools/upgrade_net_proto_text.cpp — migrate a legacy prototxt to the
+    current schema. Usage: upgrade_net_proto_text IN OUT."""
+    from ..proto import pb
+    from ..utils.io import read_proto_text, write_proto_text
+    from ..utils.upgrade import net_needs_upgrade, upgrade_net_as_needed
+    if len(args.args) != 2:
+        sys.exit("usage: upgrade_net_proto_text <in.prototxt> <out.prototxt>")
+    net = read_proto_text(args.args[0], pb.NetParameter())
+    if not net_needs_upgrade(net):
+        print(f"File already in latest proto format: {args.args[0]}")
+    elif not upgrade_net_as_needed(net, source=args.args[0]):
+        print("Encountered one or more problems upgrading the net "
+              "(see log); continuing anyway.")
+    write_proto_text(args.args[1], net)
+    print(f"Wrote upgraded NetParameter text proto to {args.args[1]}")
+    return 0
+
+
+@register
+def upgrade_net_proto_binary(args):
+    """tools/upgrade_net_proto_binary.cpp — migrate a legacy .caffemodel.
+    Usage: upgrade_net_proto_binary IN OUT."""
+    from ..proto import pb
+    from ..utils.io import read_proto_binary, write_proto_binary
+    from ..utils.upgrade import net_needs_upgrade, upgrade_net_as_needed
+    if len(args.args) != 2:
+        sys.exit("usage: upgrade_net_proto_binary <in> <out>")
+    net = read_proto_binary(args.args[0], pb.NetParameter())
+    if not net_needs_upgrade(net):
+        print(f"File already in latest proto format: {args.args[0]}")
+    elif not upgrade_net_as_needed(net, source=args.args[0]):
+        print("Encountered one or more problems upgrading the net "
+              "(see log); continuing anyway.")
+    write_proto_binary(args.args[1], net)
+    print(f"Wrote upgraded NetParameter binary proto to {args.args[1]}")
+    return 0
+
+
+@register
+def upgrade_solver_proto_text(args):
+    """tools/upgrade_solver_proto_text.cpp — migrate a legacy solver
+    prototxt. Usage: upgrade_solver_proto_text IN OUT."""
+    from ..proto import pb
+    from ..utils.io import read_proto_text, write_proto_text
+    from ..utils.upgrade import upgrade_solver_as_needed
+    if len(args.args) != 2:
+        sys.exit("usage: upgrade_solver_proto_text <in> <out>")
+    sp = read_proto_text(args.args[0], pb.SolverParameter())
+    upgrade_solver_as_needed(sp, source=args.args[0])
+    write_proto_text(args.args[1], sp)
+    print(f"Wrote upgraded SolverParameter text proto to {args.args[1]}")
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="caffe", description="command line brew",
         epilog="commands: " + ", ".join(sorted(BREW)))
     p.add_argument("command", choices=sorted(BREW))
+    p.add_argument("args", nargs="*",
+                   help="positional args for the upgrade_* commands")
     p.add_argument("--solver", default="")
     p.add_argument("--model", default="")
     p.add_argument("--snapshot", default="")
@@ -205,6 +263,8 @@ def main(argv=None):
     p.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
     args = p.parse_args(argv)
+    if args.args and not args.command.startswith("upgrade_"):
+        p.error(f"unrecognized arguments: {' '.join(args.args)}")
     return BREW[args.command](args)
 
 

@@ -36,16 +36,27 @@ def write_proto_binary(path: str, message) -> None:
 
 
 def read_net_param(path: str) -> "pb.NetParameter":
+    """Read a net definition or weights file in any supported format,
+    migrating legacy (V0/V1/input-field/...) schemas to the current one
+    (reference io.hpp ReadNetParamsFrom{Text,Binary}FileOrDie, which always
+    run UpgradeNetAsNeeded)."""
+    from .upgrade import upgrade_net_as_needed
     net = pb.NetParameter()
     if path.endswith((".h5", ".hdf5")):
         return read_net_hdf5(path)
     if path.endswith((".caffemodel", ".binaryproto", ".pb")):
-        return read_proto_binary(path, net)
-    return read_proto_text(path, net)
+        read_proto_binary(path, net)
+    else:
+        read_proto_text(path, net)
+    upgrade_net_as_needed(net, source=path)
+    return net
 
 
 def read_solver_param(path: str) -> "pb.SolverParameter":
-    return read_proto_text(path, pb.SolverParameter())
+    from .upgrade import upgrade_solver_as_needed
+    sp = read_proto_text(path, pb.SolverParameter())
+    upgrade_solver_as_needed(sp, source=path)
+    return sp
 
 
 def blob_shape(proto: "pb.BlobProto") -> tuple[int, ...]:

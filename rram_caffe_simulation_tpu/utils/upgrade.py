@@ -1,0 +1,461 @@
+"""Legacy prototxt / caffemodel format migration.
+
+Pre-1.0 Caffe serialized nets in two older formats: V0 (a flat
+``V0LayerParameter`` bag nested inside each ``layers`` entry) and V1
+(``NetParameter.layers`` with an enum layer type). Published zoo weights are
+mostly V1. This module migrates any of those, plus the smaller deprecations
+(per-data-layer transform fields, net-level ``input`` fields, 3-param
+BatchNorm, solver_type enum), to the current schema so that
+``read_net_param``/``read_solver_param`` always hand the framework a modern
+message.
+
+Behavioral contract follows reference src/caffe/util/upgrade_proto.cpp
+(upgrade_proto.hpp:14 UpgradeNetAsNeeded, :55 UpgradeV1Net, :80
+UpgradeSolverAsNeeded); the implementation here is table-driven rather than
+a field-by-field port.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..proto import pb
+
+log = logging.getLogger("caffe_tpu.upgrade")
+
+V1 = pb.V1LayerParameter
+
+# V1 enum -> current string type (reference upgrade_proto.cpp:877
+# UpgradeV1LayerType).
+V1_TYPE_NAMES = {
+    V1.NONE: "",
+    V1.ABSVAL: "AbsVal",
+    V1.ACCURACY: "Accuracy",
+    V1.ARGMAX: "ArgMax",
+    V1.BNLL: "BNLL",
+    V1.CONCAT: "Concat",
+    V1.CONTRASTIVE_LOSS: "ContrastiveLoss",
+    V1.CONVOLUTION: "Convolution",
+    V1.DECONVOLUTION: "Deconvolution",
+    V1.DATA: "Data",
+    V1.DROPOUT: "Dropout",
+    V1.DUMMY_DATA: "DummyData",
+    V1.EUCLIDEAN_LOSS: "EuclideanLoss",
+    V1.ELTWISE: "Eltwise",
+    V1.EXP: "Exp",
+    V1.FLATTEN: "Flatten",
+    V1.HDF5_DATA: "HDF5Data",
+    V1.HDF5_OUTPUT: "HDF5Output",
+    V1.HINGE_LOSS: "HingeLoss",
+    V1.IM2COL: "Im2col",
+    V1.IMAGE_DATA: "ImageData",
+    V1.INFOGAIN_LOSS: "InfogainLoss",
+    V1.INNER_PRODUCT: "InnerProduct",
+    V1.LRN: "LRN",
+    V1.MEMORY_DATA: "MemoryData",
+    V1.MULTINOMIAL_LOGISTIC_LOSS: "MultinomialLogisticLoss",
+    V1.MVN: "MVN",
+    V1.POOLING: "Pooling",
+    V1.POWER: "Power",
+    V1.RELU: "ReLU",
+    V1.SIGMOID: "Sigmoid",
+    V1.SIGMOID_CROSS_ENTROPY_LOSS: "SigmoidCrossEntropyLoss",
+    V1.SILENCE: "Silence",
+    V1.SOFTMAX: "Softmax",
+    V1.SOFTMAX_LOSS: "SoftmaxWithLoss",
+    V1.SPLIT: "Split",
+    V1.SLICE: "Slice",
+    V1.TANH: "TanH",
+    V1.WINDOW_DATA: "WindowData",
+    V1.THRESHOLD: "Threshold",
+}
+
+# V0 short type name -> V1 enum (reference upgrade_proto.cpp:552
+# UpgradeV0LayerType).
+V0_TYPE_ENUMS = {
+    "accuracy": V1.ACCURACY,
+    "bnll": V1.BNLL,
+    "concat": V1.CONCAT,
+    "conv": V1.CONVOLUTION,
+    "data": V1.DATA,
+    "dropout": V1.DROPOUT,
+    "euclidean_loss": V1.EUCLIDEAN_LOSS,
+    "flatten": V1.FLATTEN,
+    "hdf5_data": V1.HDF5_DATA,
+    "hdf5_output": V1.HDF5_OUTPUT,
+    "im2col": V1.IM2COL,
+    "images": V1.IMAGE_DATA,
+    "infogain_loss": V1.INFOGAIN_LOSS,
+    "innerproduct": V1.INNER_PRODUCT,
+    "lrn": V1.LRN,
+    "multinomial_logistic_loss": V1.MULTINOMIAL_LOGISTIC_LOSS,
+    "pool": V1.POOLING,
+    "relu": V1.RELU,
+    "sigmoid": V1.SIGMOID,
+    "softmax": V1.SOFTMAX,
+    "softmax_loss": V1.SOFTMAX_LOSS,
+    "split": V1.SPLIT,
+    "tanh": V1.TANH,
+    "window_data": V1.WINDOW_DATA,
+}
+
+# Routing of V0 scalar fields into per-type param submessages. Each V0 field
+# maps {v0 type name: (submessage attr on V1LayerParameter, field name)}.
+# `None` as field name means "repeated: use .append" (the N-d conv fields).
+_V0_ROUTES = {
+    "num_output": {"conv": ("convolution_param", "num_output"),
+                   "innerproduct": ("inner_product_param", "num_output")},
+    "biasterm": {"conv": ("convolution_param", "bias_term"),
+                 "innerproduct": ("inner_product_param", "bias_term")},
+    "weight_filler": {"conv": ("convolution_param", "weight_filler"),
+                      "innerproduct": ("inner_product_param", "weight_filler")},
+    "bias_filler": {"conv": ("convolution_param", "bias_filler"),
+                    "innerproduct": ("inner_product_param", "bias_filler")},
+    "pad": {"conv": ("convolution_param", "pad+"),
+            "pool": ("pooling_param", "pad")},
+    "kernelsize": {"conv": ("convolution_param", "kernel_size+"),
+                   "pool": ("pooling_param", "kernel_size")},
+    "group": {"conv": ("convolution_param", "group")},
+    "stride": {"conv": ("convolution_param", "stride+"),
+               "pool": ("pooling_param", "stride")},
+    "pool": {"pool": ("pooling_param", "pool")},
+    "dropout_ratio": {"dropout": ("dropout_param", "dropout_ratio")},
+    "local_size": {"lrn": ("lrn_param", "local_size")},
+    "alpha": {"lrn": ("lrn_param", "alpha")},
+    "beta": {"lrn": ("lrn_param", "beta")},
+    "k": {"lrn": ("lrn_param", "k")},
+    "source": {"data": ("data_param", "source"),
+               "hdf5_data": ("hdf5_data_param", "source"),
+               "images": ("image_data_param", "source"),
+               "window_data": ("window_data_param", "source"),
+               "infogain_loss": ("infogain_loss_param", "source")},
+    "batchsize": {"data": ("data_param", "batch_size"),
+                  "hdf5_data": ("hdf5_data_param", "batch_size"),
+                  "images": ("image_data_param", "batch_size"),
+                  "window_data": ("window_data_param", "batch_size")},
+    "rand_skip": {"data": ("data_param", "rand_skip"),
+                  "images": ("image_data_param", "rand_skip")},
+    "shuffle_images": {"images": ("image_data_param", "shuffle")},
+    "new_height": {"images": ("image_data_param", "new_height")},
+    "new_width": {"images": ("image_data_param", "new_width")},
+    "concat_dim": {"concat": ("concat_param", "concat_dim")},
+    "det_fg_threshold": {"window_data": ("window_data_param", "fg_threshold")},
+    "det_bg_threshold": {"window_data": ("window_data_param", "bg_threshold")},
+    "det_fg_fraction": {"window_data": ("window_data_param", "fg_fraction")},
+    "det_context_pad": {"window_data": ("window_data_param", "context_pad")},
+    "det_crop_mode": {"window_data": ("window_data_param", "crop_mode")},
+}
+
+# V0 fields that always land on transform_param regardless of layer type.
+_V0_TRANSFORM_FIELDS = {"scale": "scale", "meanfile": "mean_file",
+                        "cropsize": "crop_size", "mirror": "mirror"}
+
+# Message-valued V1 fields to carry over verbatim during V1 -> current
+# (everything sharing a name between V1LayerParameter and LayerParameter).
+_V1_PARAM_MESSAGES = [
+    "accuracy_param", "argmax_param", "concat_param",
+    "contrastive_loss_param", "convolution_param", "data_param",
+    "dropout_param", "dummy_data_param", "eltwise_param", "exp_param",
+    "hdf5_data_param", "hdf5_output_param", "hinge_loss_param",
+    "image_data_param", "infogain_loss_param", "inner_product_param",
+    "lrn_param", "memory_data_param", "mvn_param", "pooling_param",
+    "power_param", "relu_param", "sigmoid_param", "softmax_param",
+    "slice_param", "tanh_param", "threshold_param", "window_data_param",
+    "transform_param", "loss_param",
+]
+
+
+# ---------------------------------------------------------------------------
+# Need-detection predicates (reference upgrade_proto.cpp:15-19).
+
+def net_needs_v0_upgrade(net) -> bool:
+    return any(v1.HasField("layer") for v1 in net.layers)
+
+
+def net_needs_v1_upgrade(net) -> bool:
+    return len(net.layers) > 0
+
+
+def net_needs_data_upgrade(net) -> bool:
+    checks = {V1.DATA: "data_param", V1.IMAGE_DATA: "image_data_param",
+              V1.WINDOW_DATA: "window_data_param"}
+    for v1 in net.layers:
+        attr = checks.get(v1.type)
+        if attr is None:
+            continue
+        lp = getattr(v1, attr)
+        if any(lp.HasField(f) for f in
+               ("scale", "mean_file", "crop_size", "mirror")):
+            return True
+    return False
+
+
+def net_needs_input_upgrade(net) -> bool:
+    return len(net.input) > 0
+
+
+def net_needs_batchnorm_upgrade(net) -> bool:
+    return any(lp.type == "BatchNorm" and len(lp.param) == 3
+               for lp in net.layer)
+
+
+def net_needs_upgrade(net) -> bool:
+    return (net_needs_v0_upgrade(net) or net_needs_v1_upgrade(net)
+            or net_needs_data_upgrade(net) or net_needs_input_upgrade(net)
+            or net_needs_batchnorm_upgrade(net))
+
+
+# ---------------------------------------------------------------------------
+# V0 -> V1
+
+def _fold_padding_layers(net):
+    """V0 nets could express conv padding as a standalone "padding" layer.
+    Drop those layers and push their pad value into the consuming conv/pool
+    layer, rewiring the consumer's bottom to the padding layer's input
+    (reference upgrade_proto.cpp:140 UpgradeV0PaddingLayers)."""
+    out = pb.NetParameter()
+    out.CopyFrom(net)
+    del out.layers[:]
+    producer = {name: None for name in net.input}  # blob -> producing V1 entry
+    for v1 in net.layers:
+        is_padding = v1.layer.type == "padding"
+        if not is_padding:
+            kept = out.layers.add()
+            kept.CopyFrom(v1)
+        for j, blob in enumerate(v1.bottom):
+            if blob not in producer:
+                raise ValueError(f"unknown bottom blob '{blob}'")
+            src = producer[blob]
+            if src is not None and src.layer.type == "padding":
+                if v1.layer.type not in ("conv", "pool"):
+                    raise ValueError(
+                        "padding layer feeds non-conv/pool layer "
+                        f"'{v1.layer.name}' ({v1.layer.type})")
+                kept.layer.pad = src.layer.pad
+                kept.bottom[j] = src.bottom[0]
+        for blob in v1.top:
+            producer[blob] = v1
+    return out
+
+
+def _upgrade_v0_layer(v1_in, v1_out) -> bool:
+    """One V0 entry -> V1 entry. Returns False when some field could not be
+    routed (matching the reference's is_fully_compatible flag)."""
+    ok = True
+    v1_out.bottom.extend(v1_in.bottom)
+    v1_out.top.extend(v1_in.top)
+    v0 = v1_in.layer
+    if v0.HasField("name"):
+        v1_out.name = v0.name
+    if v0.HasField("type"):
+        enum = V0_TYPE_ENUMS.get(v0.type)
+        if enum is None:
+            raise ValueError(f"unknown V0 layer type '{v0.type}'")
+        v1_out.type = enum
+    for b in v0.blobs:
+        v1_out.blobs.add().CopyFrom(b)
+    v1_out.blobs_lr.extend(v0.blobs_lr)
+    v1_out.weight_decay.extend(v0.weight_decay)
+
+    for field, routes in _V0_ROUTES.items():
+        if not v0.HasField(field):
+            continue
+        route = routes.get(v0.type)
+        if route is None:
+            log.error("V0 field %s is not valid for layer type %s",
+                      field, v0.type)
+            ok = False
+            continue
+        sub_attr, target = route
+        sub = getattr(v1_out, sub_attr)
+        value = getattr(v0, field)
+        if field == "pool":  # enum value; same numbering in both schemas
+            value = int(value)
+        if target.endswith("+"):
+            getattr(sub, target[:-1]).append(value)
+        elif field in ("weight_filler", "bias_filler"):  # message-valued
+            getattr(sub, target).CopyFrom(value)
+        else:
+            setattr(sub, target, value)
+
+    for field, target in _V0_TRANSFORM_FIELDS.items():
+        if v0.HasField(field):
+            setattr(v1_out.transform_param, target, getattr(v0, field))
+    if v0.HasField("hdf5_output_param"):
+        if v0.type == "hdf5_output":
+            v1_out.hdf5_output_param.CopyFrom(v0.hdf5_output_param)
+        else:
+            log.error("hdf5_output_param on layer type %s", v0.type)
+            ok = False
+    return ok
+
+
+def upgrade_v0_net(net) -> bool:
+    folded = _fold_padding_layers(net)
+    upgraded = []
+    ok = True
+    for v1 in folded.layers:
+        nv1 = pb.V1LayerParameter()
+        ok &= _upgrade_v0_layer(v1, nv1)
+        upgraded.append(nv1)
+    del net.layers[:]
+    for nv1 in upgraded:
+        net.layers.add().CopyFrom(nv1)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Deprecated per-data-layer transform fields -> transform_param
+# (reference upgrade_proto.cpp:662 UpgradeNetDataTransformation).
+
+def upgrade_net_data_transformation(net) -> None:
+    attrs = {V1.DATA: "data_param", V1.IMAGE_DATA: "image_data_param",
+             V1.WINDOW_DATA: "window_data_param"}
+    for v1 in net.layers:
+        attr = attrs.get(v1.type)
+        if attr is None:
+            continue
+        lp = getattr(v1, attr)
+        for f in ("scale", "mean_file", "crop_size", "mirror"):
+            if lp.HasField(f):
+                setattr(v1.transform_param, f, getattr(lp, f))
+                lp.ClearField(f)
+
+
+# ---------------------------------------------------------------------------
+# V1 -> current
+
+def _upgrade_v1_layer(v1, lp) -> bool:
+    ok = True
+    lp.bottom.extend(v1.bottom)
+    lp.top.extend(v1.top)
+    if v1.HasField("name"):
+        lp.name = v1.name
+    for r in v1.include:
+        lp.include.add().CopyFrom(r)
+    for r in v1.exclude:
+        lp.exclude.add().CopyFrom(r)
+    if v1.HasField("type"):
+        lp.type = V1_TYPE_NAMES[v1.type]
+    for b in v1.blobs:
+        lp.blobs.add().CopyFrom(b)
+    # param names / share modes / lr & decay multipliers each extend the
+    # ParamSpec list positionally.
+    for seq, target in ((v1.param, "name"),
+                        (v1.blob_share_mode, "share_mode"),
+                        (v1.blobs_lr, "lr_mult"),
+                        (v1.weight_decay, "decay_mult")):
+        for i, value in enumerate(seq):
+            while len(lp.param) <= i:
+                lp.param.add()
+            setattr(lp.param[i], target, value)
+    lp.loss_weight.extend(v1.loss_weight)
+    for attr in _V1_PARAM_MESSAGES:
+        if v1.HasField(attr):
+            getattr(lp, attr).CopyFrom(getattr(v1, attr))
+    if v1.HasField("layer"):
+        log.error("V1 entry still holds a V0 layer — ignoring it")
+        ok = False
+    return ok
+
+
+def upgrade_v1_net(net) -> bool:
+    if len(net.layer) > 0:
+        raise ValueError(
+            "NetParameter mixes 'layers' (V1) and 'layer' (current) fields; "
+            "refusing to upgrade an inconsistent definition")
+    ok = True
+    for v1 in net.layers:
+        ok &= _upgrade_v1_layer(v1, net.layer.add())
+    del net.layers[:]
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Net-level input fields -> Input layer
+# (reference upgrade_proto.cpp:971 UpgradeNetInput).
+
+def upgrade_net_input(net) -> None:
+    has_shape = len(net.input_shape) > 0
+    has_dim = len(net.input_dim) > 0
+    if has_shape or has_dim:
+        lp = pb.LayerParameter(name="input", type="Input")
+        for i, blob in enumerate(net.input):
+            lp.top.append(blob)
+            shape = lp.input_param.shape.add()
+            if has_shape:
+                # Clamp: some hand-written prototxts list fewer shapes than
+                # input names, reusing the last shape for the rest.
+                shape.CopyFrom(net.input_shape[min(i, len(net.input_shape) - 1)])
+            else:
+                shape.dim.extend(net.input_dim[4 * i:4 * i + 4])
+        # The input layer must come first so its tops exist before use.
+        existing = [pb.LayerParameter() for _ in net.layer]
+        for dst, src in zip(existing, net.layer):
+            dst.CopyFrom(src)
+        del net.layer[:]
+        net.layer.add().CopyFrom(lp)
+        for src in existing:
+            net.layer.add().CopyFrom(src)
+    # A bare `input` without shapes (legacy caffemodel) is simply dropped.
+    del net.input[:]
+    del net.input_shape[:]
+    del net.input_dim[:]
+
+
+def upgrade_net_batchnorm(net) -> None:
+    """Old BatchNorm definitions declared 3 ParamSpecs (mean/var/bias-count);
+    the modern layer owns its statistics and takes none."""
+    for lp in net.layer:
+        if lp.type == "BatchNorm" and len(lp.param) == 3:
+            del lp.param[:]
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+
+def upgrade_net_as_needed(net, source: str = "") -> bool:
+    """Migrate `net` in place through every needed upgrade stage. Returns
+    False when some legacy field could not be mapped (the net is still
+    usable, matching the reference's continue-anyway behavior)."""
+    ok = True
+    if net_needs_v0_upgrade(net):
+        log.info("upgrading V0 (padding-era) net%s",
+                 f" from {source}" if source else "")
+        ok &= upgrade_v0_net(net)
+    if net_needs_data_upgrade(net):
+        upgrade_net_data_transformation(net)
+    if net_needs_v1_upgrade(net):
+        log.info("upgrading V1 'layers' net%s",
+                 f" from {source}" if source else "")
+        ok &= upgrade_v1_net(net)
+    if net_needs_input_upgrade(net):
+        upgrade_net_input(net)
+    if net_needs_batchnorm_upgrade(net):
+        upgrade_net_batchnorm(net)
+    return ok
+
+
+SOLVER_TYPE_NAMES = {
+    pb.SolverParameter.SGD: "SGD",
+    pb.SolverParameter.NESTEROV: "Nesterov",
+    pb.SolverParameter.ADAGRAD: "AdaGrad",
+    pb.SolverParameter.RMSPROP: "RMSProp",
+    pb.SolverParameter.ADADELTA: "AdaDelta",
+    pb.SolverParameter.ADAM: "Adam",
+}
+
+
+def upgrade_solver_as_needed(sp, source: str = "") -> bool:
+    """Migrate the deprecated solver_type enum to the string `type` field
+    (reference upgrade_proto.cpp:1039 UpgradeSolverType)."""
+    if not sp.HasField("solver_type"):
+        return True
+    if sp.HasField("type"):
+        raise ValueError(
+            "solver specifies both deprecated solver_type (enum) and type "
+            "(string); remove one")
+    sp.type = SOLVER_TYPE_NAMES[sp.solver_type]
+    sp.ClearField("solver_type")
+    log.info("upgraded deprecated solver_type enum%s",
+             f" in {source}" if source else "")
+    return True

@@ -1,0 +1,272 @@
+"""Legacy proto format migration (reference util/upgrade_proto.cpp;
+fixture style follows src/caffe/test/test_upgrade_proto.cpp)."""
+import numpy as np
+import jax
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import upgrade as up
+from rram_caffe_simulation_tpu.utils.io import (
+    read_net_param, read_solver_param, write_proto_binary, array_to_blob)
+from rram_caffe_simulation_tpu.net import Net
+
+
+V1_NET_TXT = """
+name: "v1net"
+input: "data"
+input: "label"
+input_dim: 2 input_dim: 3 input_dim: 8 input_dim: 8
+input_dim: 2 input_dim: 1 input_dim: 1 input_dim: 1
+layers {
+  name: "ip1"
+  type: INNER_PRODUCT
+  bottom: "data"
+  top: "ip1"
+  blobs_lr: 1
+  blobs_lr: 2
+  weight_decay: 1
+  weight_decay: 0
+  inner_product_param { num_output: 4 weight_filler { type: "xavier" } }
+}
+layers {
+  name: "relu1" type: RELU bottom: "ip1" top: "ip1"
+}
+layers {
+  name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label" top: "loss"
+}
+"""
+
+V0_NET_TXT = """
+name: "v0net"
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 8 input_dim: 8
+layers {
+  layer { name: "pad1" type: "padding" pad: 2 }
+  bottom: "data" top: "pad1"
+}
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 3 kernelsize: 5 stride: 1
+    weight_filler { type: "gaussian" std: 0.01 }
+    blobs_lr: 1. blobs_lr: 2.
+  }
+  bottom: "pad1" top: "conv1"
+}
+layers {
+  layer { name: "pool1" type: "pool" pool: MAX kernelsize: 2 stride: 2 }
+  bottom: "conv1" top: "pool1"
+}
+layers {
+  layer { name: "ip1" type: "innerproduct" num_output: 10 }
+  bottom: "pool1" top: "ip1"
+}
+"""
+
+
+def _parse_net(txt):
+    net = pb.NetParameter()
+    text_format.Parse(txt, net)
+    return net
+
+
+class TestV1Upgrade:
+    def test_layers_become_layer(self):
+        net = _parse_net(V1_NET_TXT)
+        assert up.net_needs_upgrade(net)
+        assert up.upgrade_net_as_needed(net)
+        assert len(net.layers) == 0
+        types = [lp.type for lp in net.layer]
+        # input fields become a leading Input layer
+        assert types == ["Input", "InnerProduct", "ReLU", "SoftmaxWithLoss"]
+
+    def test_blobs_lr_to_param_specs(self):
+        net = _parse_net(V1_NET_TXT)
+        up.upgrade_net_as_needed(net)
+        ip = next(lp for lp in net.layer if lp.name == "ip1")
+        assert len(ip.param) == 2
+        assert ip.param[0].lr_mult == 1 and ip.param[1].lr_mult == 2
+        assert ip.param[0].decay_mult == 1 and ip.param[1].decay_mult == 0
+
+    def test_input_layer_shape(self):
+        net = _parse_net(V1_NET_TXT)
+        up.upgrade_net_as_needed(net)
+        inp = net.layer[0]
+        assert list(inp.input_param.shape[0].dim) == [2, 3, 8, 8]
+        assert list(inp.input_param.shape[1].dim) == [2, 1, 1, 1]
+        assert list(inp.top) == ["data", "label"]
+
+    def test_mixed_layer_layers_rejected(self):
+        net = _parse_net(V1_NET_TXT)
+        net.layer.add(name="x", type="ReLU")
+        with pytest.raises(ValueError, match="inconsistent"):
+            up.upgrade_v1_net(net)
+
+    def test_upgraded_net_builds_and_runs(self):
+        net_param = _parse_net(V1_NET_TXT)
+        up.upgrade_net_as_needed(net_param)
+        # Drop the loss layer's missing label input by feeding it.
+        net = Net(net_param, pb.TRAIN)
+        params = net.init(jax.random.PRNGKey(0))
+        data = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        label = np.array([1, 3], dtype=np.int32)
+        blobs, loss = net.apply(params, {"data": data, "label": label})
+        assert np.isfinite(float(loss))
+
+
+class TestV0Upgrade:
+    def test_padding_layer_folded(self):
+        net = _parse_net(V0_NET_TXT)
+        assert up.net_needs_v0_upgrade(net)
+        up.upgrade_net_as_needed(net)
+        names = [lp.name for lp in net.layer]
+        assert "pad1" not in names
+        conv = next(lp for lp in net.layer if lp.name == "conv1")
+        assert list(conv.convolution_param.pad) == [2]
+        assert list(conv.bottom) == ["data"]
+
+    def test_field_routing(self):
+        net = _parse_net(V0_NET_TXT)
+        up.upgrade_net_as_needed(net)
+        conv = next(lp for lp in net.layer if lp.name == "conv1")
+        assert conv.type == "Convolution"
+        assert conv.convolution_param.num_output == 3
+        assert list(conv.convolution_param.kernel_size) == [5]
+        assert conv.convolution_param.weight_filler.type == "gaussian"
+        assert conv.param[0].lr_mult == 1 and conv.param[1].lr_mult == 2
+        pool = next(lp for lp in net.layer if lp.name == "pool1")
+        assert pool.type == "Pooling"
+        assert pool.pooling_param.kernel_size == 2
+        assert pool.pooling_param.pool == pb.PoolingParameter.MAX
+        ip = next(lp for lp in net.layer if lp.name == "ip1")
+        assert ip.type == "InnerProduct"
+        assert ip.inner_product_param.num_output == 10
+
+    def test_v0_net_builds(self):
+        net_param = _parse_net(V0_NET_TXT)
+        up.upgrade_net_as_needed(net_param)
+        net = Net(net_param, pb.TEST)
+        params = net.init(jax.random.PRNGKey(0))
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        blobs, _ = net.apply(params, {"data": x})
+        # pad 2 -> 12x12 conv k5 -> 8x8, pool k2 s2 -> 4x4, ip -> 10
+        assert blobs["ip1"].shape == (1, 10)
+
+
+class TestDataTransformUpgrade:
+    def test_deprecated_fields_move(self):
+        net = pb.NetParameter()
+        v1 = net.layers.add()
+        v1.name, v1.type = "d", pb.V1LayerParameter.DATA
+        v1.top.append("data")
+        v1.data_param.source = "/db"
+        v1.data_param.batch_size = 4
+        v1.data_param.scale = 0.5
+        v1.data_param.crop_size = 16
+        v1.data_param.mirror = True
+        assert up.net_needs_data_upgrade(net)
+        up.upgrade_net_as_needed(net)
+        lp = net.layer[0]
+        assert lp.transform_param.scale == 0.5
+        assert lp.transform_param.crop_size == 16
+        assert lp.transform_param.mirror is True
+        assert not lp.data_param.HasField("scale")
+        assert lp.data_param.source == "/db"  # non-transform fields stay
+
+
+class TestBatchNormUpgrade:
+    def test_three_param_specs_cleared(self):
+        net = pb.NetParameter()
+        lp = net.layer.add(name="bn", type="BatchNorm")
+        for _ in range(3):
+            lp.param.add(lr_mult=0)
+        assert up.net_needs_batchnorm_upgrade(net)
+        up.upgrade_net_as_needed(net)
+        assert len(net.layer[0].param) == 0
+
+    def test_modern_batchnorm_untouched(self):
+        net = pb.NetParameter()
+        net.layer.add(name="bn", type="BatchNorm")
+        assert not up.net_needs_batchnorm_upgrade(net)
+
+
+class TestLegacyCaffemodel:
+    def test_v1_caffemodel_weights_load(self, tmp_path):
+        """A V1-serialized .caffemodel (the format of most published zoo
+        weights) must round-trip into copy_trained_from with nonzero
+        weights — the headline legacy-compat contract."""
+        rng = np.random.RandomState(7)
+        w = rng.randn(4, 192).astype(np.float32)  # ip over 3*8*8 input
+        b = rng.randn(4).astype(np.float32)
+
+        weights = pb.NetParameter(name="v1net")
+        v1 = weights.layers.add()
+        v1.name, v1.type = "ip1", pb.V1LayerParameter.INNER_PRODUCT
+        array_to_blob(w, v1.blobs.add())
+        array_to_blob(b, v1.blobs.add())
+        path = str(tmp_path / "legacy.caffemodel")
+        write_proto_binary(path, weights)
+
+        net_param = _parse_net(V1_NET_TXT)
+        net = Net(net_param, pb.TRAIN)
+        params = net.init(jax.random.PRNGKey(0))
+        loaded = net.copy_trained_from(params, path)
+        np.testing.assert_allclose(np.asarray(loaded["ip1"][0]), w)
+        np.testing.assert_allclose(np.asarray(loaded["ip1"][1]), b)
+
+    def test_bare_input_field_stripped(self):
+        # Legacy caffemodels carry `input` names with no dims; upgrading
+        # must strip them without fabricating an Input layer.
+        net = pb.NetParameter()
+        net.input.append("data")
+        net.layer.add(name="r", type="ReLU")
+        up.upgrade_net_as_needed(net)
+        assert len(net.input) == 0
+        assert [lp.type for lp in net.layer] == ["ReLU"]
+
+
+class TestSolverUpgrade:
+    def test_enum_to_string(self, tmp_path):
+        p = tmp_path / "solver.prototxt"
+        p.write_text("base_lr: 0.1\nlr_policy: 'fixed'\nsolver_type: ADAM\n"
+                     "max_iter: 1\nsnapshot_prefix: '/tmp/x'\n")
+        sp = read_solver_param(str(p))
+        assert sp.type == "Adam"
+        assert not sp.HasField("solver_type")
+
+    def test_conflicting_types_rejected(self):
+        sp = pb.SolverParameter()
+        sp.solver_type = pb.SolverParameter.ADAM
+        sp.type = "SGD"
+        with pytest.raises(ValueError, match="both"):
+            up.upgrade_solver_as_needed(sp)
+
+    def test_all_enum_values(self):
+        for enum, name in up.SOLVER_TYPE_NAMES.items():
+            sp = pb.SolverParameter()
+            sp.solver_type = enum
+            up.upgrade_solver_as_needed(sp)
+            assert sp.type == name
+
+
+class TestReferenceZooPrototxts:
+    """The real upstream V1-era prototxt must parse + upgrade."""
+
+    FIXTURE = "/root/reference/examples/mnist/lenet_consolidated_solver.prototxt"
+
+    def test_consolidated_solver_v1_net_upgrades(self):
+        import os
+        if not os.path.exists(self.FIXTURE):
+            pytest.skip("reference fixture absent")
+        sp = pb.SolverParameter()
+        text_format.Parse(open(self.FIXTURE).read(), sp)
+        net = sp.net_param
+        assert up.net_needs_v1_upgrade(net)
+        assert up.upgrade_net_as_needed(net)
+        assert len(net.layers) == 0
+        types = {lp.type for lp in net.layer}
+        assert {"Convolution", "Pooling", "InnerProduct",
+                "SoftmaxWithLoss"} <= types
+        # blobs_lr entries migrated to ParamSpec multipliers
+        conv = next(lp for lp in net.layer if lp.type == "Convolution")
+        assert [p.lr_mult for p in conv.param] == [1, 2]
