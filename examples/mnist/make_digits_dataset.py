@@ -1,0 +1,87 @@
+"""Build an MNIST-style dataset in idx format + LMDB without network access.
+
+The real MNIST files are not shipped in this image (the reference fetches
+them with data/mnist/get_mnist.sh, which needs the network), so this uses
+scikit-learn's bundled `load_digits` corpus — 1,797 real handwritten digit
+images — upscaled from 8x8 to the 28x28 LeNet geometry and augmented with
+small integer shifts. The images are written as idx files and then pushed
+through the framework's own MNIST converter (tools/converters.py
+convert_mnist, parity with reference examples/mnist/convert_mnist_data.cpp)
+so the full converter -> LMDB -> Data-layer path is exercised.
+
+Usage: python examples/mnist/make_digits_dataset.py [out_dir]
+"""
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def write_idx(path: str, arr: np.ndarray) -> None:
+    """Inverse of tools/converters.py read_idx."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x0800 | arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def upscale_28(img8: np.ndarray) -> np.ndarray:
+    """8x8 (0..16) -> 28x28 (0..255) by 3x nearest-neighbour + 2px border."""
+    big = np.kron(img8, np.ones((3, 3)))          # 24x24
+    out = np.zeros((28, 28))
+    out[2:26, 2:26] = big
+    return np.clip(out * (255.0 / 16.0), 0, 255).astype(np.uint8)
+
+
+def build(out_dir: str, shifts: int = 4, seed: int = 0):
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    rng = np.random.RandomState(seed)
+    n = len(d.images)
+    order = rng.permutation(n)
+    split = int(n * 0.85)
+    tr_idx, te_idx = order[:split], order[split:]
+
+    def render(idx, augment):
+        imgs, labels = [], []
+        for i in idx:
+            base = upscale_28(d.images[i])
+            imgs.append(base)
+            labels.append(d.target[i])
+            for _ in range(shifts if augment else 0):
+                dy, dx = rng.randint(-2, 3, size=2)
+                imgs.append(np.roll(np.roll(base, dy, 0), dx, 1))
+                labels.append(d.target[i])
+        return np.stack(imgs), np.asarray(labels, np.uint8)
+
+    os.makedirs(out_dir, exist_ok=True)
+    tr_imgs, tr_labels = render(tr_idx, augment=True)
+    te_imgs, te_labels = render(te_idx, augment=False)
+    # shuffle the augmented training set so LMDB order is not class-banded
+    perm = rng.permutation(len(tr_imgs))
+    tr_imgs, tr_labels = tr_imgs[perm], tr_labels[perm]
+    paths = {}
+    for name, arr in (("train-images-idx3", tr_imgs),
+                      ("train-labels-idx1", tr_labels),
+                      ("t10k-images-idx3", te_imgs),
+                      ("t10k-labels-idx1", te_labels)):
+        paths[name] = os.path.join(out_dir, f"{name}-ubyte")
+        write_idx(paths[name], arr)
+
+    from rram_caffe_simulation_tpu.tools.converters import convert_mnist
+    n_tr = convert_mnist(paths["train-images-idx3"], paths["train-labels-idx1"],
+                         os.path.join(out_dir, "digits_train_lmdb"))
+    n_te = convert_mnist(paths["t10k-images-idx3"], paths["t10k-labels-idx1"],
+                         os.path.join(out_dir, "digits_test_lmdb"))
+    print(f"digits dataset: {n_tr} train / {n_te} test images -> {out_dir}")
+    return n_tr, n_te
+
+
+if __name__ == "__main__":
+    build(sys.argv[1] if len(sys.argv) > 1
+          else os.path.dirname(os.path.abspath(__file__)))

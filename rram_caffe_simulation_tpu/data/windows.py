@@ -1,0 +1,162 @@
+"""R-CNN-style window cropping: context-padded warp/square crops.
+
+Geometry contract follows reference src/caffe/layers/window_data_layer.cpp
+(load_batch, :300-430) and is shared by the WindowData feed and the
+Detector API. The formulation here is independent: a crop is described by a
+CropPlan (source box + destination placement) computed in one pass, then
+executed with PIL resize + numpy pasting.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CropPlan:
+    """Where to read in the source image and where to paste in the output
+    canvas. All boxes are [lo, hi) half-open numpy-style bounds."""
+    src_y: tuple      # rows of the source image to crop
+    src_x: tuple
+    dst_y: tuple      # rows of the out_size canvas receiving the resize
+    dst_x: tuple
+
+    @property
+    def src_hw(self):
+        return (self.src_y[1] - self.src_y[0], self.src_x[1] - self.src_x[0])
+
+    @property
+    def dst_hw(self):
+        return (self.dst_y[1] - self.dst_y[0], self.dst_x[1] - self.dst_x[0])
+
+
+def plan_window_crop(box, image_hw, out_size: int, context_pad: int = 0,
+                     square: bool = False) -> CropPlan:
+    """Compute the crop/paste plan for one window.
+
+    `box` = (x1, y1, x2, y2) inclusive pixel coordinates; `image_hw` the
+    source image size. With context_pad > 0 the box is grown so that after
+    warping to out_size x out_size the original box occupies the central
+    (out_size - 2*context_pad)^2 region; `square` first grows the box to
+    the tightest square. Region outside the image stays unwritten
+    (zero-padded by the caller), with the paste offset scaled accordingly.
+    """
+    x1, y1, x2, y2 = (float(v) for v in box)
+    im_h, im_w = image_hw
+    if context_pad > 0 or square:
+        grow = out_size / float(out_size - 2 * context_pad)
+        half_w = (x2 - x1 + 1) / 2.0
+        half_h = (y2 - y1 + 1) / 2.0
+        cx, cy = x1 + half_w, y1 + half_h
+        if square:
+            half_w = half_h = max(half_w, half_h)
+        x1 = round(cx - half_w * grow)
+        x2 = round(cx + half_w * grow)
+        y1 = round(cy - half_h * grow)
+        y2 = round(cy + half_h * grow)
+
+    # extent of the (possibly grown) box beyond the image, per edge
+    over_l, over_t = max(0, -int(x1)), max(0, -int(y1))
+    over_r, over_b = max(0, int(x2) - im_w + 1), max(0, int(y2) - im_h + 1)
+    full_w, full_h = int(x2 - x1 + 1), int(y2 - y1 + 1)
+    sx1, sy1 = int(x1) + over_l, int(y1) + over_t
+    sx2, sy2 = int(x2) - over_r, int(y2) - over_b
+
+    # resize scale of the *unclipped* box onto the canvas
+    scale_x = out_size / float(full_w)
+    scale_y = out_size / float(full_h)
+    dst_x1 = int(round(over_l * scale_x))
+    dst_y1 = int(round(over_t * scale_y))
+    dst_w = int(round((sx2 - sx1 + 1) * scale_x))
+    dst_h = int(round((sy2 - sy1 + 1) * scale_y))
+    # rounding may spill past the canvas edge; trim like the reference does
+    dst_w = min(dst_w, out_size - dst_x1)
+    dst_h = min(dst_h, out_size - dst_y1)
+    return CropPlan(src_y=(sy1, sy2 + 1), src_x=(sx1, sx2 + 1),
+                    dst_y=(dst_y1, dst_y1 + dst_h),
+                    dst_x=(dst_x1, dst_x1 + dst_w))
+
+
+def _resize_hwc(patch: np.ndarray, hw) -> np.ndarray:
+    """Bilinear resize of an HxWxC uint8/float patch via PIL."""
+    from PIL import Image
+    h, w = hw
+    if patch.shape[:2] == (h, w):
+        return patch.astype(np.float32)
+    chans = [np.asarray(Image.fromarray(patch[..., c].astype(np.float32),
+                                        mode="F").resize((w, h),
+                                                         Image.BILINEAR))
+             for c in range(patch.shape[-1])]
+    return np.stack(chans, axis=-1)
+
+
+def extract_window(img_chw: np.ndarray, box, out_size: int,
+                   context_pad: int = 0, square: bool = False,
+                   mirror: bool = False):
+    """Crop `box` out of a (C,H,W) image into an out_size x out_size canvas.
+
+    Returns (canvas, mask): canvas is (C, out_size, out_size) float32 with
+    the warped patch pasted and zeros elsewhere; mask is (out_size,
+    out_size) bool marking patch pixels, so the caller can mean-subtract
+    only where image data exists (reference leaves padding at exact 0,
+    window_data_layer.cpp:404-425). `mirror` flips canvas and mask
+    together, padding included."""
+    c, im_h, im_w = img_chw.shape
+    plan = plan_window_crop(box, (im_h, im_w), out_size, context_pad, square)
+    patch = img_chw[:, plan.src_y[0]:plan.src_y[1],
+                    plan.src_x[0]:plan.src_x[1]].transpose(1, 2, 0)
+    resized = _resize_hwc(patch, plan.dst_hw)
+    canvas = np.zeros((c, out_size, out_size), np.float32)
+    mask = np.zeros((out_size, out_size), bool)
+    canvas[:, plan.dst_y[0]:plan.dst_y[1], plan.dst_x[0]:plan.dst_x[1]] = \
+        resized.transpose(2, 0, 1)
+    mask[plan.dst_y[0]:plan.dst_y[1], plan.dst_x[0]:plan.dst_x[1]] = True
+    if mirror:
+        canvas = canvas[:, :, ::-1]
+        mask = mask[:, ::-1]
+    return canvas, mask
+
+
+@dataclasses.dataclass
+class WindowRecord:
+    image_index: int
+    label: int
+    overlap: float
+    box: tuple  # (x1, y1, x2, y2) inclusive
+
+
+def parse_window_file(source: str, root_folder: str = ""):
+    """Parse the R-CNN window list format (window_data_layer.cpp:90-160):
+
+        # <image_index>
+        <image_path>
+        <channels> <height> <width>
+        <num_windows>
+        <label> <overlap> <x1> <y1> <x2> <y2>   (x num_windows)
+
+    Returns (images, windows): images = [(path, (c, h, w))], windows =
+    [WindowRecord]. Tokenized with free whitespace, like the C++ `>>`.
+    """
+    with open(source) as f:
+        toks = f.read().split()
+    images, windows = [], []
+    i = 0
+    while i < len(toks):
+        if toks[i] != "#":
+            raise ValueError(f"window file {source}: expected '#', got "
+                             f"{toks[i]!r}")
+        image_index = int(toks[i + 1])
+        path = root_folder + toks[i + 2]
+        chw = tuple(int(t) for t in toks[i + 3:i + 6])
+        n_windows = int(toks[i + 6])
+        i += 7
+        if image_index != len(images):
+            raise ValueError(f"non-sequential image index {image_index}")
+        images.append((path, chw))
+        for _ in range(n_windows):
+            label, overlap = int(toks[i]), float(toks[i + 1])
+            box = tuple(int(t) for t in toks[i + 2:i + 6])
+            windows.append(WindowRecord(image_index, label, overlap, box))
+            i += 6
+    return images, windows

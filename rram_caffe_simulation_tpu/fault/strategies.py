@@ -225,10 +225,30 @@ def load_prune_orders(path: str) -> List[np.ndarray]:
     return orders
 
 
+def _check_prune_orders(orders, hidden_sizes) -> None:
+    """Each row must be a full permutation of its hidden group; a short or
+    duplicated row would silently map leftover physical slots to logical
+    neuron 0, duplicating that row across the weight matrix."""
+    if hidden_sizes is None:
+        return
+    if len(orders) != len(hidden_sizes):
+        raise ValueError(
+            f"prune_order_file has {len(orders)} rows but the net has "
+            f"{len(hidden_sizes)} hidden FC groups")
+    for i, (row, n) in enumerate(zip(orders, hidden_sizes)):
+        if len(row) != n or not np.array_equal(np.sort(row), np.arange(n)):
+            raise ValueError(
+                f"prune_order row {i} is not a permutation of 0..{n - 1} "
+                f"(got {len(row)} entries)")
+
+
 def build_strategies(solver_param: "pb.SolverParameter", fc_pairs,
-                     prune_net_loader=None) -> StrategyConfig:
+                     prune_net_loader=None,
+                     hidden_sizes=None) -> StrategyConfig:
     """Build the strategy set from SolverParameter.failure_strategy entries
-    (Solver ctor, solver.cpp:134-148; CreateStrategy strategy.hpp:33)."""
+    (Solver ctor, solver.cpp:134-148; CreateStrategy strategy.hpp:33).
+    `hidden_sizes` = output width of each hidden FC group, for validating
+    remapping prune orders."""
     cfg = StrategyConfig()
     for sp in solver_param.failure_strategy:
         if sp.type == "threshold":
@@ -237,6 +257,7 @@ def build_strategies(solver_param: "pb.SolverParameter", fc_pairs,
             cfg.remap_start = int(sp.start)
             cfg.remap_period = max(int(sp.period), 1)
             cfg.prune_orders = load_prune_orders(sp.prune_order_file)
+            _check_prune_orders(cfg.prune_orders, hidden_sizes)
         elif sp.type == "genetic":
             if prune_net_loader is None:
                 raise ValueError("genetic strategy requires a prune net")

@@ -72,6 +72,13 @@ class FilterLayer(Layer):
     packed to the front of a full-size batch and the remainder zero-filled.
     Downstream consumers can read the count from the selector sum. This
     preserves the selected items' values and order.
+
+    CAVEAT: a loss/Accuracy layer fed directly from Filter output
+    normalizes over the full padded batch, so its value diverges from the
+    reference's dynamically shrunk batch by a factor of n_keep/batch.
+    Route Filter output through computation whose per-item values you
+    consume (the reference examples do), or rescale the loss host-side by
+    batch/n_keep using the selector sum.
     """
 
     def setup(self, bottom_shapes):
@@ -101,10 +108,12 @@ class PythonLayer(Layer):
     instantiates a user class with Caffe's setup/reshape/forward contract.
 
     The user object receives pycaffe-style bottom/top wrappers with mutable
-    numpy `.data`. Forward runs host-side through jax.pure_callback, so it
-    composes with jit but is opaque to autodiff (gradients treated as zero
-    — the reference's PythonLayer backward is likewise only invoked when
-    the user implements it; hook custom_vjp in a later round)."""
+    numpy `.data`/`.diff`. Forward runs host-side through jax.pure_callback
+    wrapped in jax.custom_vjp: the backward pass calls the user object's
+    `backward(top, propagate_down, bottom)` host-side (python_layer.hpp:40
+    delegates exactly so), reading the filled bottom `.diff`s. A user class
+    without a `backward` method contributes zero gradients, matching a
+    user-side no-op Backward in the reference."""
 
     def setup(self, bottom_shapes):
         import importlib
@@ -152,13 +161,46 @@ class PythonLayer(Layer):
             self.obj.forward(bs, ts)
             return tuple(np.asarray(t.data, np.float32) for t in ts)
 
+        def host_backward(*arrs):
+            """arrs = bottom datas + top diffs; returns bottom diffs."""
+            n_b = len(self.bottom_shapes)
+            bs = [self._B(a.shape) for a in arrs[:n_b]]
+            for b, a in zip(bs, arrs[:n_b]):
+                b.data[...] = np.asarray(a)
+            ts = [self._B(s) for s in self.top_shapes]
+            self.obj.reshape(bs, ts)
+            for t, g in zip(ts, arrs[n_b:]):
+                t.diff[...] = np.asarray(g)
+            self.obj.backward(ts, [True] * n_b, bs)
+            return tuple(np.asarray(b.diff, np.float32) for b in bs)
+
         if not any(isinstance(b, jax.core.Tracer) for b in bottoms):
             # eager path: run host-side directly — works on backends with
             # no host-callback support (e.g. tunneled PJRT plugins)
             return [jnp.asarray(t) for t in host_forward(*bottoms)], None
+
         out_spec = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
                          for s in self.top_shapes)
-        tops = jax.pure_callback(host_forward, out_spec, *bottoms)
+        in_spec = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                        for s in self.bottom_shapes)
+        has_backward = callable(getattr(self.obj, "backward", None))
+
+        @jax.custom_vjp
+        def run(*bs):
+            return jax.pure_callback(host_forward, out_spec, *bs)
+
+        def run_fwd(*bs):
+            return run(*bs), bs
+
+        def run_bwd(saved_bottoms, top_diffs):
+            if not has_backward:
+                return tuple(jnp.zeros(s, jnp.float32)
+                             for s in self.bottom_shapes)
+            return jax.pure_callback(host_backward, in_spec,
+                                     *saved_bottoms, *top_diffs)
+
+        run.defvjp(run_fwd, run_bwd)
+        tops = run(*[b.astype(jnp.float32) for b in bottoms])
         return list(tops), None
 
     def default_loss_weight(self, top_index: int):

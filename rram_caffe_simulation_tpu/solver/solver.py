@@ -118,8 +118,12 @@ class Solver:
                       for k in self._fault_keys}
             self.fault_state = fault_engine.init_fault_state(
                 k_fault, shapes, param.failure_pattern)
+        flat0 = self._flat(self.params)
+        hidden_sizes = [int(flat0[w].shape[0])
+                        for w, _ in self.fc_pairs[:-1]]
         self.strategies = fault_strategies.build_strategies(
-            param, self.fc_pairs, prune_net_loader=self._load_prune_net)
+            param, self.fc_pairs, prune_net_loader=self._load_prune_net,
+            hidden_sizes=hidden_sizes)
 
         # --- data feeds ---
         self.train_feed = train_feed or self._default_feed(self.net)
@@ -149,6 +153,13 @@ class Solver:
                 param.HasField("net") or param.HasField("net_param")):
             for _ in range(len(param.test_iter) - len(sources)):
                 sources.append(_train_net_param(param))
+        if len(param.test_iter) != len(sources):
+            # Reference InitTestNets CHECK-fails on the count mismatch
+            # (solver.cpp:156-180); silently building fewer test nets than
+            # test_iter entries would skip evaluations the config asked for.
+            raise ValueError(
+                f"test_iter has {len(param.test_iter)} entries but "
+                f"{len(sources)} test nets could be sourced")
         if param.test_state and len(param.test_state) != len(sources):
             raise ValueError(
                 f"test_state must have one entry per test net "
@@ -362,11 +373,20 @@ class Solver:
             (times - s.remap_start) % s.remap_period == 0)
 
     def step(self, iters: int):
-        """Run `iters` training iterations (Solver::Step, solver.cpp:238)."""
+        """Run `iters` training iterations (Solver::Step, solver.cpp:238).
+
+        The loss returned by the jitted step stays on-device; the smoothing
+        ring buffer holds device scalars and is only materialized at
+        display boundaries (and on exit), so the hot loop never blocks on
+        a device->host transfer (the reference syncs every iteration by
+        construction; on TPU that would serialize dispatch)."""
         step_fn = self._compiled_step()
         param = self.param
         start_iter = self.iter
         average_loss = max(param.average_loss, 1)
+        # Step() restarts the smoothing window on entry (solver.cpp:238-247)
+        self.losses = []
+        self.smoothed_loss = 0.0
         genetic = self.strategies.genetic
         for _ in range(iters):
             if (param.test_interval and
@@ -381,9 +401,10 @@ class Solver:
              outputs) = step_fn(
                 self.params, self.history, self.fault_state, batch,
                 jnp.int32(self.iter), rng, self._remap_due())
-            self._update_smoothed_loss(float(loss), start_iter, average_loss)
+            self._record_loss(loss, start_iter, average_loss)
             display = param.display and self.iter % param.display == 0
             if display:
+                self._materialize_smoothed_loss()
                 lr = float(self._lr_fn(jnp.int32(self.iter)))
                 print(f"Iteration {self.iter}, lr = {lr:g}", flush=True)
                 print(f"Iteration {self.iter}, loss = "
@@ -401,6 +422,7 @@ class Solver:
                 self.snapshot()
             if self._requested_action == "stop":
                 break
+        self._materialize_smoothed_loss()
 
     def _apply_genetic(self, genetic):
         """Episodic host-side genetic strategy between jitted steps (the
@@ -424,17 +446,24 @@ class Solver:
             if b is not None:
                 yield b, 1
 
-    def _update_smoothed_loss(self, loss, start_iter, average_loss):
-        """UpdateSmoothedLoss (solver.cpp:533-547)."""
+    def _record_loss(self, loss, start_iter, average_loss):
+        """UpdateSmoothedLoss (solver.cpp:533-547), deferred: the running
+        average over the window equals the mean of the ring buffer, so the
+        buffer stores device scalars and the mean is computed lazily in
+        _materialize_smoothed_loss."""
         if len(self.losses) < average_loss:
             self.losses.append(loss)
-            size = len(self.losses)
-            self.smoothed_loss = ((self.smoothed_loss * (size - 1) + loss)
-                                  / size)
         else:
             idx = (self.iter - start_iter) % average_loss
-            self.smoothed_loss += (loss - self.losses[idx]) / average_loss
             self.losses[idx] = loss
+
+    def _materialize_smoothed_loss(self) -> float:
+        """Fetch the ring buffer from device and refresh smoothed_loss
+        (the only device->host sync in the train loop: one transfer of the
+        on-device mean, not one per buffered scalar)."""
+        if self.losses:
+            self.smoothed_loss = float(jnp.stack(self.losses).mean())
+        return self.smoothed_loss
 
     def solve(self, resume_file: Optional[str] = None):
         """Solver::Solve (solver.cpp:328-375)."""

@@ -74,8 +74,9 @@ def convert_imageset(root_folder: str, list_file: str, out_dir: str,
     return len(entries)
 
 
-def compute_image_mean(db_dir: str, out_file: str) -> np.ndarray:
-    """Mean over all Datums -> BlobProto file (tools/compute_image_mean.cpp)."""
+def compute_image_mean(db_dir: str, out_file: str) -> tuple[np.ndarray, int]:
+    """Mean over all Datums -> BlobProto file (tools/compute_image_mean.cpp).
+    Returns (mean array, record count)."""
     from ..data.db import LMDB, datum_to_array
     from ..utils.io import array_to_blob, write_proto_binary
     db = LMDB(db_dir)
@@ -92,7 +93,7 @@ def compute_image_mean(db_dir: str, out_file: str) -> np.ndarray:
     mean = (total / max(count, 1)).astype(np.float32)
     blob = array_to_blob(mean[None])
     write_proto_binary(out_file, blob)
-    return mean
+    return mean, count
 
 
 def main(argv=None):
@@ -121,8 +122,7 @@ def main(argv=None):
                              a.resize_height, a.resize_width, a.gray,
                              a.shuffle)
     else:
-        compute_image_mean(a.db, a.out)
-        n = 1
+        _, n = compute_image_mean(a.db, a.out)
     print(f"Processed {n} records.", file=sys.stderr)
 
 

@@ -175,16 +175,19 @@ def net_needs_v1_upgrade(net) -> bool:
     return len(net.layers) > 0
 
 
+# Data-reading V1 layer types with deprecated in-param transform fields.
+_DATA_PARAM_ATTRS = {V1.DATA: "data_param", V1.IMAGE_DATA: "image_data_param",
+                     V1.WINDOW_DATA: "window_data_param"}
+_DEPRECATED_TRANSFORM_FIELDS = ("scale", "mean_file", "crop_size", "mirror")
+
+
 def net_needs_data_upgrade(net) -> bool:
-    checks = {V1.DATA: "data_param", V1.IMAGE_DATA: "image_data_param",
-              V1.WINDOW_DATA: "window_data_param"}
     for v1 in net.layers:
-        attr = checks.get(v1.type)
+        attr = _DATA_PARAM_ATTRS.get(v1.type)
         if attr is None:
             continue
         lp = getattr(v1, attr)
-        if any(lp.HasField(f) for f in
-               ("scale", "mean_file", "crop_size", "mirror")):
+        if any(lp.HasField(f) for f in _DEPRECATED_TRANSFORM_FIELDS):
             return True
     return False
 
@@ -308,14 +311,12 @@ def upgrade_v0_net(net) -> bool:
 # (reference upgrade_proto.cpp:662 UpgradeNetDataTransformation).
 
 def upgrade_net_data_transformation(net) -> None:
-    attrs = {V1.DATA: "data_param", V1.IMAGE_DATA: "image_data_param",
-             V1.WINDOW_DATA: "window_data_param"}
     for v1 in net.layers:
-        attr = attrs.get(v1.type)
+        attr = _DATA_PARAM_ATTRS.get(v1.type)
         if attr is None:
             continue
         lp = getattr(v1, attr)
-        for f in ("scale", "mean_file", "crop_size", "mirror"):
+        for f in _DEPRECATED_TRANSFORM_FIELDS:
             if lp.HasField(f):
                 setattr(v1.transform_param, f, getattr(lp, f))
                 lp.ClearField(f)

@@ -164,7 +164,8 @@ def test_compute_image_mean(tmp_path):
     with lmdb_py.BulkWriter(db_dir) as w:
         for i in range(20):
             w.put(b"%08d" % i, array_to_datum(imgs[i], 0).SerializeToString())
-    mean = compute_image_mean(db_dir, str(tmp_path / "mean.binaryproto"))
+    mean, count = compute_image_mean(db_dir, str(tmp_path / "mean.binaryproto"))
+    assert count == 20
     np.testing.assert_allclose(mean, imgs.astype(np.float64).mean(0),
                                atol=1e-4)
     loaded = read_blob_from_file(str(tmp_path / "mean.binaryproto"))

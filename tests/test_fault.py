@@ -228,3 +228,36 @@ def test_threshold_strategy_in_solver(tmp_path):
     np.testing.assert_array_equal(np.asarray(s.params["fc1"][0]), w0)
     np.testing.assert_array_equal(
         np.asarray(s.fault_state["lifetimes"]["fc1/0"]), life0)
+
+
+def test_prune_order_validation(tmp_path):
+    """A short or non-permutation prune_order row must fail loudly at build
+    time instead of silently duplicating row 0 across the weight matrix."""
+    from rram_caffe_simulation_tpu.fault.strategies import (
+        build_strategies, load_prune_orders)
+    from rram_caffe_simulation_tpu.proto import pb
+
+    def solver_param(order_line):
+        f = tmp_path / "order.txt"
+        f.write_text(order_line + "\n")
+        sp = pb.SolverParameter()
+        st = sp.failure_strategy.add()
+        st.type = "remapping"
+        st.prune_order_file = str(f)
+        return sp
+
+    fc_pairs = [("fc1/0", "fc1/1"), ("fc2/0", "fc2/1")]
+    # valid permutation of 4 passes
+    cfg = build_strategies(solver_param("2 0 3 1"), fc_pairs,
+                           hidden_sizes=[4])
+    assert cfg.prune_orders is not None
+    # short row
+    with pytest.raises(ValueError, match="permutation"):
+        build_strategies(solver_param("2 0 3"), fc_pairs, hidden_sizes=[4])
+    # duplicate entry
+    with pytest.raises(ValueError, match="permutation"):
+        build_strategies(solver_param("2 0 3 3"), fc_pairs, hidden_sizes=[4])
+    # wrong row count
+    with pytest.raises(ValueError, match="rows"):
+        build_strategies(solver_param("0 1 2 3"), fc_pairs,
+                         hidden_sizes=[4, 8])

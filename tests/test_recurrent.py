@@ -210,3 +210,47 @@ def test_python_layer():
     f = jax.jit(lambda v: layer.apply(
         [], [v], LayerContext(phase=pb.TEST))[0][0])
     np.testing.assert_allclose(np.asarray(f(x)), 2.0)
+
+
+class DoublerWithBackward(DoublerLayer):
+    """User layer implementing the optional backward contract
+    (python_layer.hpp:40: backward(top, propagate_down, bottom))."""
+
+    def backward(self, top, propagate_down, bottom):
+        bottom[0].diff[...] = top[0].diff * 2.0
+
+
+def test_python_layer_backward():
+    layer = make_layer("""
+      name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "test_recurrent" layer: "DoublerWithBackward" }
+    """)
+    layer.setup([(2, 3)])
+    x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    def loss(v):
+        tops, _ = layer.apply([], [v], LayerContext(phase=pb.TEST))
+        return jnp.sum(tops[0] ** 2)
+
+    g = jax.grad(loss)(x)
+    # d/dx sum((2x)^2) = 8x, routed through the user's host-side backward
+    np.testing.assert_allclose(np.asarray(g), 8.0 * np.asarray(x), rtol=1e-6)
+    g_jit = jax.jit(jax.grad(loss))(x)
+    np.testing.assert_allclose(np.asarray(g_jit), 8.0 * np.asarray(x),
+                               rtol=1e-6)
+
+
+def test_python_layer_no_backward_zero_grads():
+    layer = make_layer("""
+      name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "test_recurrent" layer: "DoublerLayer" }
+    """)
+    layer.setup([(2, 3)])
+    x = jnp.asarray(np.ones((2, 3), np.float32))
+
+    def loss(v):
+        tops, _ = layer.apply([], [v], LayerContext(phase=pb.TEST))
+        return jnp.sum(tops[0])
+
+    g = jax.grad(loss)(x)
+    np.testing.assert_allclose(np.asarray(g), 0.0)
