@@ -1,9 +1,10 @@
 """Datum database access (reference: src/caffe/util/db.{hpp,cpp},
 db_lmdb.cpp, db_leveldb.cpp, data_reader.cpp).
 
-Backed by the pure-Python LMDB implementation in lmdb_py (this environment
-ships no lmdb/leveldb bindings). LevelDB files are not supported — convert
-with the shipped converters (tools/convert_*.py), which write LMDB.
+Backed by the pure-Python LMDB and LevelDB implementations in lmdb_py /
+leveldb_py (this environment ships no native bindings). open_db dispatches
+on the on-disk layout, so prototxts using either backend — the reference
+DataParameter defaults to LEVELDB — work unchanged.
 """
 from __future__ import annotations
 
@@ -52,17 +53,69 @@ class LMDB:
         self.env.close()
 
 
-def open_db(source: str, backend=None) -> LMDB:
-    """GetDB (db.hpp:48). LevelDB sources raise — LMDB only."""
+class LevelDBCursor:
+    """Sequential wrap-around cursor over a leveldb_py.Database, matching
+    the LMDBCursor surface (db_leveldb.hpp SeekToFirst/Next/valid)."""
+
+    def __init__(self, db: "leveldb_py.Database"):
+        self._db = db
+        self.seek_to_first()
+
+    def seek_to_first(self):
+        self._it = self._db.items()
+        self._cur = next(self._it, None)
+
+    def valid(self) -> bool:
+        return self._cur is not None
+
+    def next(self):
+        self._cur = next(self._it, None)
+        if self._cur is None:
+            self.seek_to_first()
+
+    def key(self) -> bytes:
+        return self._cur[0]
+
+    def value(self) -> bytes:
+        return self._cur[1]
+
+    def next_value(self) -> bytes:
+        v = self.value()
+        self.next()
+        return v
+
+
+class LevelDB:
+    """DB interface over a LevelDB directory (db_leveldb.cpp)."""
+
+    def __init__(self, source: str):
+        from . import leveldb_py
+        self.env = leveldb_py.Database(source)
+
+    def cursor(self) -> LevelDBCursor:
+        return LevelDBCursor(self.env)
+
+    def __len__(self):
+        return len(self.env)
+
+    def close(self):
+        self.env.close()
+
+
+def open_db(source: str, backend=None):
+    """GetDB (db.hpp:48), dispatching on the on-disk layout: an LMDB
+    data.mdb or a LevelDB CURRENT file. The `backend` proto enum is
+    advisory — files win, so a prototxt that says LEVELDB but points at a
+    converted LMDB still loads (and vice versa)."""
     mdb = source if os.path.isfile(source) else os.path.join(source,
                                                              "data.mdb")
-    if not os.path.exists(mdb):
-        kind = ("LevelDB" if os.path.exists(
-            os.path.join(source, "CURRENT")) else "unknown")
-        raise NotImplementedError(
-            f"Datum DB source {source!r} is not LMDB ({kind}); convert "
-            "with the shipped dataset converters (they write LMDB)")
-    return LMDB(source)
+    if os.path.exists(mdb):
+        return LMDB(source)
+    if os.path.exists(os.path.join(source, "CURRENT")):
+        return LevelDB(source)
+    raise FileNotFoundError(
+        f"Datum DB source {source!r} is neither LMDB nor LevelDB; create "
+        "one with the shipped dataset converters")
 
 
 def infer_datum_shape(source: str, backend=None) -> tuple[int, int, int]:

@@ -3,11 +3,16 @@ consumes for data-source tops.
 
 Replaces the reference's threaded prefetch pipeline (data_reader.cpp:73,
 base_data_layer.cpp:76-120): one feed per net, pulling from the layer's
-configured source, applying DataTransformer semantics, round-robin across
-epoch boundaries (rand_skip/shuffle where the reference has them).
+configured source, applying DataTransformer semantics, per-epoch reshuffle
+where the reference has it, wrapped in a background prefetch thread with
+double buffering + async jax.device_put (the H2D overlap the reference
+gets from async_gpu_push, syncedmem.cpp:149).
 """
 from __future__ import annotations
 
+import queue
+import threading
+import zlib
 from typing import Callable, Dict
 
 import numpy as np
@@ -15,7 +20,58 @@ import numpy as np
 from ..proto import pb
 
 
-def build_feed(net) -> Callable[[], Dict[str, np.ndarray]]:
+class PrefetchingFeed:
+    """Background producer thread filling a bounded batch queue
+    (base_data_layer.hpp:71 PREFETCH_COUNT double buffering). The producer
+    also jax.device_put's each array so the H2D transfer overlaps the
+    previous step's compute; consumers see ready device arrays."""
+
+    def __init__(self, feed: Callable[[], Dict[str, np.ndarray]],
+                 depth: int = 3, device_put: bool = True):
+        self._feed = feed
+        self._depth = max(int(depth), 1)
+        self._device_put = device_put
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._thread: threading.Thread | None = None
+
+    def _produce(self):
+        while True:
+            try:
+                batch = self._feed()
+                if self._device_put:
+                    import jax
+                    batch = {k: jax.device_put(np.asarray(v))
+                             for k, v in batch.items()}
+            except BaseException as e:   # surface in the consumer
+                self._q.put(e)
+                return
+            self._q.put(batch)
+
+    def __call__(self) -> Dict[str, np.ndarray]:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._produce,
+                                            daemon=True,
+                                            name="feed-prefetch")
+            self._thread.start()
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+
+# Layer types whose feeds do real I/O and benefit from prefetch; MemoryData
+# is excluded (its arrays arrive via set_input_arrays after construction).
+_PREFETCHABLE = {"Data", "ImageData", "HDF5Data", "WindowData"}
+
+
+def _feed_rng(layer) -> np.random.RandomState:
+    """Deterministic per-layer RNG (the reference seeds each prefetch
+    thread from the global RNG, base_data_layer.cpp:60)."""
+    return np.random.RandomState(
+        (zlib.crc32(layer.name.encode()) ^ 0x5EED) & 0x7FFFFFFF)
+
+
+def build_feed(net, prefetch: bool = True) -> Callable[[], Dict[str, np.ndarray]]:
     """Compose one callable feeding every data-source layer of `net`.
     Layers with no automatic source (Input) raise at first *pull*, so nets
     whose batches are supplied explicitly still construct."""
@@ -33,7 +89,12 @@ def build_feed(net) -> Callable[[], Dict[str, np.ndarray]]:
                     "MemoryData.set_input_arrays")
             sub_feeds.append(missing)
             continue
-        sub_feeds.append(builder(layer))
+        f = builder(layer)
+        if prefetch and layer.type_name in _PREFETCHABLE:
+            depth = (layer.lp.data_param.prefetch
+                     if layer.type_name == "Data" else 3)
+            f = PrefetchingFeed(f, depth=depth)
+        sub_feeds.append(f)
 
     def feed() -> Dict[str, np.ndarray]:
         batch: Dict[str, np.ndarray] = {}
@@ -56,8 +117,9 @@ def _hdf5_feed(layer):
     tops = list(layer.lp.top)
     batch_size = hp.batch_size
     state = {"file": 0, "row": 0, "data": None}
+    rng = _feed_rng(layer)
     if hp.shuffle:
-        np.random.RandomState(0).shuffle(files)
+        rng.shuffle(files)
 
     def load(idx):
         with h5py.File(files[idx], "r") as h5:
@@ -79,6 +141,11 @@ def _hdf5_feed(layer):
             need -= take
             if state["row"] >= n:
                 state["file"] = (state["file"] + 1) % len(files)
+                if state["file"] == 0 and hp.shuffle:
+                    # reshuffle the file order each epoch, like the
+                    # reference re-permutes file_permutation_ on wrap
+                    # (hdf5_data_layer.cpp:172-180)
+                    rng.shuffle(files)
                 load(state["file"])
         return {t: np.concatenate(v, axis=0) for t, v in out.items()}
     return feed
@@ -147,8 +214,9 @@ def _image_feed(layer):
     with open(ip.source) as f:
         # any-whitespace split, like the reference's `infile >> name >> label`
         entries = [ln.rsplit(None, 1) for ln in f if ln.strip()]
+    rng = _feed_rng(layer)
     if ip.shuffle:
-        np.random.RandomState(0).shuffle(entries)
+        rng.shuffle(entries)
     transformer = DataTransformer(layer.lp.transform_param,
                                   phase=layer.phase)
     tops = list(layer.lp.top)
@@ -157,7 +225,12 @@ def _image_feed(layer):
     def feed():
         datas, labels = [], []
         for _ in range(ip.batch_size):
-            path, label = entries[state["pos"] % len(entries)]
+            if state["pos"] >= len(entries):
+                state["pos"] = 0
+                if ip.shuffle:
+                    # ShuffleImages each epoch (image_data_layer.cpp:140)
+                    rng.shuffle(entries)
+            path, label = entries[state["pos"]]
             state["pos"] += 1
             arr = load_image(ip.root_folder + path, ip.is_color,
                              ip.new_height, ip.new_width)
@@ -168,9 +241,79 @@ def _image_feed(layer):
     return feed
 
 
+def _window_feed(layer):
+    """WindowData (window_data_layer.cpp load_batch): per batch, sample
+    fg_fraction foreground windows (overlap >= fg_threshold) and fill the
+    rest with background windows (overlap < bg_threshold, label forced 0);
+    each window is cropped with context padding in warp/square mode,
+    random-mirrored, and mean/scale-normalized only where image pixels
+    exist (padding stays exact 0)."""
+    from .image import load_image
+    from .windows import extract_window, parse_window_file
+    wp = layer.lp.window_data_param
+    tp = layer.lp.transform_param
+    images, windows = parse_window_file(wp.source, wp.root_folder)
+    fg = [w for w in windows if w.overlap >= wp.fg_threshold]
+    bg = [w for w in windows if w.overlap < wp.bg_threshold]
+    if not fg or not bg:
+        raise ValueError(
+            f"window file {wp.source}: need both foreground and background "
+            f"windows (got {len(fg)} fg / {len(bg)} bg)")
+    crop = int(tp.crop_size or wp.crop_size)
+    mean_values = None
+    mean_patch = None
+    if tp.mean_file or wp.mean_file:
+        from ..utils.io import read_blob_from_file
+        mean = read_blob_from_file(tp.mean_file or wp.mean_file)[0]
+        off = (mean.shape[-1] - crop) // 2
+        mean_patch = mean[:, off:off + crop, off:off + crop]
+    elif tp.mean_value:
+        mean_values = np.asarray(tp.mean_value, np.float32).reshape(-1, 1, 1)
+    scale = tp.scale if tp.HasField("scale") else wp.scale
+    use_square = wp.crop_mode == "square"
+    n_fg = int(wp.batch_size * wp.fg_fraction)
+    counts = {True: n_fg, False: wp.batch_size - n_fg}
+    rng = _feed_rng(layer)
+    tops = list(layer.lp.top)
+    img_cache: dict = {}
+
+    def get_image(idx):
+        if wp.cache_images:
+            if idx not in img_cache:
+                img_cache[idx] = load_image(images[idx][0]).astype(np.float32)
+            return img_cache[idx]
+        return load_image(images[idx][0]).astype(np.float32)
+
+    def feed():
+        datas = np.zeros((wp.batch_size, 3, crop, crop), np.float32)
+        labels = np.zeros((wp.batch_size,), np.float32)
+        item = 0
+        for is_fg in (False, True):   # bg first, like the reference
+            pool = fg if is_fg else bg
+            for _ in range(counts[is_fg]):
+                w = pool[rng.randint(len(pool))]
+                mirror = bool(tp.mirror) and rng.randint(2) == 1
+                img = get_image(w.image_index)
+                canvas, mask = extract_window(
+                    img, w.box, crop, context_pad=wp.context_pad,
+                    square=use_square, mirror=mirror)
+                if mean_patch is not None:
+                    canvas = np.where(mask, (canvas - mean_patch) * scale, 0)
+                elif mean_values is not None:
+                    canvas = np.where(mask, (canvas - mean_values) * scale, 0)
+                else:
+                    canvas = canvas * scale
+                datas[item] = canvas
+                labels[item] = w.label if is_fg else 0
+                item += 1
+        return {tops[0]: datas, tops[1]: labels}
+    return feed
+
+
 FEED_BUILDERS = {
     "HDF5Data": _hdf5_feed,
     "MemoryData": _memory_feed,
     "Data": _data_feed,
     "ImageData": _image_feed,
+    "WindowData": _window_feed,
 }

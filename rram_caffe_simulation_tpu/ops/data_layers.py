@@ -15,6 +15,7 @@ import zlib
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.fillers import make_filler
 from ..core.registry import Layer, register_layer
@@ -122,7 +123,7 @@ class WindowDataLayer(DataSourceLayer):
 
     def output_shapes(self):
         wp = self.lp.window_data_param
-        crop = wp.crop_size
+        crop = self.lp.transform_param.crop_size or wp.crop_size
         assert crop > 0, "WindowData requires crop_size"
         return [(wp.batch_size, 3, crop, crop), (wp.batch_size,)]
 
@@ -173,14 +174,42 @@ class DummyDataLayer(Layer):
 
 @register_layer("HDF5Output")
 class HDF5OutputLayer(Layer):
-    """Sink layer: persists its bottoms to HDF5. In the traced graph it is a
-    no-op; the solver/CLI collects flagged blobs and writes them host-side
-    (reference hdf5_output_layer.cpp writes synchronously in Forward)."""
+    """Sink layer persisting its two bottoms to an HDF5 file, written
+    host-side through an ordered io_callback during forward (reference
+    hdf5_output_layer.cpp:30-74 writes synchronously in Forward_cpu).
+
+    Deviation (documented): the reference re-saves only the latest batch
+    to the `data`/`label` datasets; here successive forwards APPEND rows
+    (resizable datasets), which is what feature-extraction consumers
+    actually want. The file is truncated at layer construction."""
 
     def setup(self, bottom_shapes):
+        import os
         self.file_name = self.lp.hdf5_output_param.file_name
+        if self.file_name and os.path.exists(self.file_name):
+            os.remove(self.file_name)
         self.top_shapes = []
         return []
 
+    def _save(self, data, label):
+        import h5py
+        with h5py.File(self.file_name, "a") as f:
+            for name, arr in (("data", np.asarray(data)),
+                              ("label", np.asarray(label))):
+                if name in f:
+                    ds = f[name]
+                    n0 = ds.shape[0]
+                    ds.resize(n0 + arr.shape[0], axis=0)
+                    ds[n0:] = arr
+                else:
+                    f.create_dataset(name, data=arr,
+                                     maxshape=(None,) + arr.shape[1:])
+
     def apply(self, params, bottoms, ctx):
+        from jax.experimental import io_callback
+        # stop_gradient keeps the callback out of the autodiff graph (the
+        # reference Backward is a no-op)
+        io_callback(self._save, None,
+                    jax.lax.stop_gradient(bottoms[0]),
+                    jax.lax.stop_gradient(bottoms[1]), ordered=True)
         return [], None

@@ -1,6 +1,7 @@
-"""Dataset converters writing Datum LMDBs, keyed "%08d" like the reference
+"""Dataset converters writing Datum databases (LMDB by default, LevelDB
+with backend="leveldb"), keyed "%08d" like the reference
 (examples/mnist/convert_mnist_data.cpp:95 "%08d", examples/cifar10/
-convert_cifar_data.cpp, tools/convert_imageset.cpp).
+convert_cifar_data.cpp, tools/convert_imageset.cpp --backend flag).
 """
 from __future__ import annotations
 
@@ -16,6 +17,13 @@ from ..data.db import array_to_datum
 from ..proto import pb
 
 
+def _bulk_writer(out_dir: str, backend: str = "lmdb"):
+    if backend == "leveldb":
+        from ..data import leveldb_py
+        return leveldb_py.BulkWriter(out_dir)
+    return lmdb_py.BulkWriter(out_dir)
+
+
 def _open(path: str):
     return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
 
@@ -29,22 +37,24 @@ def read_idx(path: str) -> np.ndarray:
         return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
 
 
-def convert_mnist(images_path: str, labels_path: str, out_dir: str) -> int:
+def convert_mnist(images_path: str, labels_path: str, out_dir: str,
+                  backend: str = "lmdb") -> int:
     images = read_idx(images_path)
     labels = read_idx(labels_path)
     assert images.shape[0] == labels.shape[0]
-    with lmdb_py.BulkWriter(out_dir) as w:
+    with _bulk_writer(out_dir, backend) as w:
         for i in range(images.shape[0]):
             datum = array_to_datum(images[i][None], int(labels[i]))
             w.put(b"%08d" % i, datum.SerializeToString())
     return images.shape[0]
 
 
-def convert_cifar10(batch_files, out_dir: str) -> int:
+def convert_cifar10(batch_files, out_dir: str,
+                    backend: str = "lmdb") -> int:
     """CIFAR-10 binary batches: per record 1 label byte + 3072 image bytes
     (3x32x32, channel-major)."""
     n = 0
-    with lmdb_py.BulkWriter(out_dir) as w:
+    with _bulk_writer(out_dir, backend) as w:
         for path in batch_files:
             raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3073)
             for rec in raw:
@@ -57,14 +67,15 @@ def convert_cifar10(batch_files, out_dir: str) -> int:
 
 def convert_imageset(root_folder: str, list_file: str, out_dir: str,
                      resize_height: int = 0, resize_width: int = 0,
-                     gray: bool = False, shuffle: bool = False) -> int:
+                     gray: bool = False, shuffle: bool = False,
+                     backend: str = "lmdb") -> int:
     """images listed as `relpath label` -> LMDB (tools/convert_imageset.cpp)."""
     from ..data.image import load_image
     with open(list_file) as f:
         entries = [ln.rsplit(None, 1) for ln in f if ln.strip()]
     if shuffle:
         np.random.RandomState(0).shuffle(entries)
-    with lmdb_py.BulkWriter(out_dir) as w:
+    with _bulk_writer(out_dir, backend) as w:
         for i, (rel, label) in enumerate(entries):
             arr = load_image(os.path.join(root_folder, rel), not gray,
                              resize_height, resize_width)
@@ -112,15 +123,19 @@ def main(argv=None):
     i.add_argument("--shuffle", action="store_true")
     mm = sub.add_parser("mean")
     mm.add_argument("db"); mm.add_argument("out")
+    for s in (m, c, i):
+        s.add_argument("--backend", choices=["lmdb", "leveldb"],
+                       default="lmdb")
     a = p.parse_args(argv)
     if a.cmd == "mnist":
-        n = convert_mnist(a.images, a.labels, a.out)
+        n = convert_mnist(a.images, a.labels, a.out, a.backend)
     elif a.cmd == "cifar10":
-        n = convert_cifar10(a.batches, a.out)
+        n = convert_cifar10(a.batches, a.out, a.backend)
     elif a.cmd == "imageset":
         n = convert_imageset(a.root, a.listfile, a.out,
                              a.resize_height, a.resize_width, a.gray,
-                             a.shuffle)
+                             a.shuffle,
+                             backend=a.backend)
     else:
         _, n = compute_image_mean(a.db, a.out)
     print(f"Processed {n} records.", file=sys.stderr)

@@ -1,0 +1,236 @@
+"""WindowData pipeline: crop geometry, window-file parsing, batch
+sampling, prefetch wrapper, HDF5Output sink (reference
+window_data_layer.cpp, hdf5_output_layer.cpp, base_data_layer.cpp)."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from google.protobuf import text_format
+
+import rram_caffe_simulation_tpu.ops  # noqa: F401 — populate layer registry
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.data.windows import (
+    plan_window_crop, extract_window, parse_window_file)
+from rram_caffe_simulation_tpu.data.feed import (
+    build_feed, PrefetchingFeed, FEED_BUILDERS)
+
+
+class TestCropGeometry:
+    def test_plain_warp_full_image_box(self):
+        # a box covering the whole image warps to the whole canvas
+        plan = plan_window_crop((0, 0, 9, 9), (10, 10), out_size=8)
+        assert plan.src_y == (0, 10) and plan.src_x == (0, 10)
+        assert plan.dst_y == (0, 8) and plan.dst_x == (0, 8)
+
+    def test_context_pad_centers_box(self):
+        # 20x20 box in a big image, out 10, pad 1: grown by 10/8 = 1.25
+        plan = plan_window_crop((40, 40, 59, 59), (200, 200), out_size=10,
+                                context_pad=1)
+        # grown half-size = 10 * 1.25 = 12.5 around center (50, 50)
+        assert plan.src_x == (38, 63) and plan.src_y == (38, 63)
+        assert plan.dst_x == (0, 10) and plan.dst_y == (0, 10)
+
+    def test_clip_at_image_edge_offsets_paste(self):
+        # box at the top-left corner grown beyond the image: the clipped
+        # part must paste at a proportional offset, not at 0
+        plan = plan_window_crop((0, 0, 9, 9), (50, 50), out_size=12,
+                                context_pad=3)
+        assert plan.src_x[0] == 0 and plan.src_y[0] == 0
+        assert plan.dst_x[0] > 0 and plan.dst_y[0] > 0
+        assert plan.dst_x[1] <= 12 and plan.dst_y[1] <= 12
+
+    def test_square_mode_uses_long_side(self):
+        plan_w = plan_window_crop((10, 20, 49, 29), (100, 100), out_size=8,
+                                  square=True)   # 40 wide x 10 tall
+        h = plan_w.src_y[1] - plan_w.src_y[0]
+        w = plan_w.src_x[1] - plan_w.src_x[0]
+        assert abs(h - w) <= 1   # tightest square (rounding tolerance)
+
+    def test_extract_window_values(self):
+        img = np.arange(2 * 8 * 8, dtype=np.float32).reshape(2, 8, 8)
+        canvas, mask = extract_window(img, (2, 2, 5, 5), out_size=4)
+        assert canvas.shape == (2, 4, 4) and mask.all()
+        np.testing.assert_allclose(canvas, img[:, 2:6, 2:6])
+
+    def test_mirror_flips_canvas_and_mask(self):
+        img = np.arange(64, dtype=np.float32).reshape(1, 8, 8)
+        c0, m0 = extract_window(img, (0, 0, 3, 3), out_size=6,
+                                context_pad=1)
+        c1, m1 = extract_window(img, (0, 0, 3, 3), out_size=6,
+                                context_pad=1, mirror=True)
+        np.testing.assert_allclose(c1, c0[:, :, ::-1])
+        np.testing.assert_array_equal(m1, m0[:, ::-1])
+
+
+WINDOW_FILE = """# 0
+img0.png
+3 32 48
+3
+1 0.9 2 2 20 20
+2 0.6 5 5 30 25
+0 0.1 0 0 10 10
+# 1
+img1.png
+3 32 48
+2
+3 0.75 1 1 16 16
+0 0.0 20 4 40 28
+"""
+
+
+@pytest.fixture
+def window_dir(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(3)
+    for name in ("img0.png", "img1.png"):
+        arr = rng.randint(0, 255, (32, 48, 3), np.uint8)
+        Image.fromarray(arr).save(tmp_path / name)
+    src = tmp_path / "windows.txt"
+    src.write_text(WINDOW_FILE)
+    return tmp_path
+
+
+class TestWindowFile:
+    def test_parse(self, window_dir):
+        images, windows = parse_window_file(
+            str(window_dir / "windows.txt"), str(window_dir) + "/")
+        assert len(images) == 2 and images[0][1] == (3, 32, 48)
+        assert len(windows) == 5
+        assert windows[0].label == 1 and windows[0].box == (2, 2, 20, 20)
+        assert windows[4].overlap == 0.0
+
+
+def _window_layer(window_dir, extra=""):
+    from rram_caffe_simulation_tpu.core.registry import create_layer
+    lp = pb.LayerParameter()
+    text_format.Parse(f"""
+      name: "w" type: "WindowData" top: "data" top: "label"
+      window_data_param {{
+        source: "{window_dir}/windows.txt"
+        root_folder: "{window_dir}/"
+        batch_size: 8 crop_size: 12 context_pad: 2
+        fg_threshold: 0.5 bg_threshold: 0.3 fg_fraction: 0.5
+        {extra}
+      }}
+      transform_param {{ mirror: true scale: 0.5 }}
+    """, lp)
+    layer = create_layer(lp, pb.TRAIN)
+    layer.setup([])
+    return layer
+
+
+class TestWindowFeed:
+    def test_batch_composition(self, window_dir):
+        layer = _window_layer(window_dir)
+        assert layer.top_shapes == [(8, 3, 12, 12), (8,)]
+        feed = FEED_BUILDERS["WindowData"](layer)
+        batch = feed()
+        assert batch["data"].shape == (8, 3, 12, 12)
+        labels = batch["label"]
+        # bg first half (label 0), fg second half (labels >= 1)
+        assert (labels[:4] == 0).all()
+        assert (labels[4:] >= 1).all()
+        # scale applied; pixel range bounded by 255 * 0.5
+        assert np.abs(batch["data"]).max() <= 127.5 + 1e-5
+
+    def test_feeds_net_training_iters(self, window_dir):
+        from rram_caffe_simulation_tpu.net import Net
+        netp = pb.NetParameter()
+        text_format.Parse(f"""
+          name: "wnet"
+          layer {{ name: "w" type: "WindowData" top: "data" top: "label"
+            window_data_param {{
+              source: "{window_dir}/windows.txt"
+              root_folder: "{window_dir}/"
+              batch_size: 4 crop_size: 12 context_pad: 1
+              fg_threshold: 0.5 bg_threshold: 0.3 fg_fraction: 0.5 }} }}
+          layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+            inner_product_param {{ num_output: 4
+              weight_filler {{ type: "xavier" }} }} }}
+          layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+            bottom: "label" top: "loss" }}
+        """, netp)
+        net = Net(netp, pb.TRAIN)
+        params = net.init(jax.random.PRNGKey(0))
+        feed = build_feed(net)
+        fn = jax.jit(lambda p, b: net.apply(p, b)[1])
+        for _ in range(3):
+            batch = {k: jnp.asarray(v) for k, v in feed().items()}
+            loss = fn(params, batch)
+        assert np.isfinite(float(loss))
+
+
+class TestPrefetchingFeed:
+    def test_order_and_values(self):
+        calls = {"n": 0}
+
+        def base():
+            calls["n"] += 1
+            return {"x": np.full((2,), calls["n"], np.float32)}
+
+        pf = PrefetchingFeed(base, depth=3)
+        got = [int(pf()["x"][0]) for _ in range(5)]
+        assert got == [1, 2, 3, 4, 5]   # order preserved
+
+    def test_producer_exception_surfaces(self):
+        def bad():
+            raise RuntimeError("boom")
+        pf = PrefetchingFeed(bad, depth=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            pf()
+
+
+class TestHDF5Output:
+    def test_rows_appended_across_forwards(self, tmp_path):
+        import h5py
+        from rram_caffe_simulation_tpu.net import Net
+        out = tmp_path / "feat.h5"
+        netp = pb.NetParameter()
+        text_format.Parse(f"""
+          name: "sink"
+          layer {{ name: "in" type: "Input" top: "data" top: "label"
+            input_param {{ shape {{ dim: 3 dim: 4 }} shape {{ dim: 3 }} }} }}
+          layer {{ name: "out" type: "HDF5Output" bottom: "data"
+            bottom: "label"
+            hdf5_output_param {{ file_name: "{out}" }} }}
+        """, netp)
+        net = Net(netp, pb.TEST)
+        params = net.init(jax.random.PRNGKey(0))
+        fn = jax.jit(lambda b: net.apply(params, b))
+        for i in range(3):
+            data = np.full((3, 4), i, np.float32)
+            label = np.full((3,), i, np.float32)
+            blobs, _ = fn({"data": jnp.asarray(data),
+                           "label": jnp.asarray(label)})
+            jax.block_until_ready(blobs)
+        with h5py.File(out, "r") as f:
+            assert f["data"].shape == (9, 4)
+            np.testing.assert_allclose(f["label"][:],
+                                       [0, 0, 0, 1, 1, 1, 2, 2, 2])
+
+
+class TestEpochReshuffle:
+    def test_imagedata_reshuffles_per_epoch(self, tmp_path):
+        from PIL import Image
+        from rram_caffe_simulation_tpu.core.registry import create_layer
+        for i in range(6):
+            Image.fromarray(
+                np.full((4, 4, 3), i * 30, np.uint8)).save(
+                    tmp_path / f"i{i}.png")
+        src = tmp_path / "list.txt"
+        src.write_text("".join(f"i{i}.png {i}\n" for i in range(6)))
+        lp = pb.LayerParameter()
+        text_format.Parse(f"""
+          name: "im" type: "ImageData" top: "data" top: "label"
+          image_data_param {{ source: "{src}" root_folder: "{tmp_path}/"
+                             batch_size: 6 shuffle: true }}
+        """, lp)
+        layer = create_layer(lp, pb.TRAIN)
+        layer.setup([])
+        feed = FEED_BUILDERS["ImageData"](layer)
+        e1 = feed()["label"].tolist()
+        e2 = feed()["label"].tolist()
+        assert sorted(e1) == sorted(e2) == [0, 1, 2, 3, 4, 5]
+        assert e1 != e2   # epoch order differs (seeded shuffle)
