@@ -1,18 +1,31 @@
 """Receptive-field / coordinate-offset algebra for FCNs over net_spec
-graphs (reference: python/caffe/coord_map.py — same public surface:
-coord_map_from_to, crop, compose, inverse; maps are (axis, scale, shift)
-with conv/pool contributing scale 1/stride, shift (pad-(ks-1)/2)/stride,
-deconv the inverse, crop an offset)."""
+graphs (same capability as reference python/caffe/coord_map.py: relate the
+spatial coordinate systems of two blobs so a Crop layer can align them).
+
+Design: each layer induces a 1-D affine transform on spatial coordinates,
+modelled here as an `AffineMap` value object (axis, scale, shift) with
+composition / inversion methods; a single generic ancestor walk collects
+the transform from a blob down to every reachable ancestor, and
+`coord_map_from_to` joins the two walks at any common ancestor.  Public
+surface kept source-compatible: `coord_map_from_to(top_from, top_to)`
+returns an (axis, scale, shift) tuple and `crop(top_from, top_to)` emits
+the aligning Crop layer.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 from .net_spec import layers as L
 
-PASS_THROUGH_LAYERS = ["AbsVal", "BatchNorm", "Bias", "BNLL", "Dropout",
-                       "Eltwise", "ELU", "Log", "LRN", "Exp", "MVN",
-                       "Power", "ReLU", "PReLU", "Scale", "Sigmoid",
-                       "Split", "TanH", "Threshold"]
+# Layer types that leave spatial geometry untouched (elementwise /
+# channelwise ops).  The set itself is part of the compat contract.
+_ELEMENTWISE = frozenset({
+    "AbsVal", "BatchNorm", "Bias", "BNLL", "Dropout", "Eltwise", "ELU",
+    "Exp", "Log", "LRN", "MVN", "Power", "PReLU", "ReLU", "Scale",
+    "Sigmoid", "Split", "TanH", "Threshold",
+})
+# Back-compat alias (reference exposes a PASS_THROUGH_LAYERS list).
+PASS_THROUGH_LAYERS = sorted(_ELEMENTWISE)
 
 
 class UndefinedMapException(Exception):
@@ -20,111 +33,156 @@ class UndefinedMapException(Exception):
 
 
 class AxisMismatchException(Exception):
-    """Composed mappings disagree on the axis."""
+    """Composed mappings disagree on the spatial axis."""
 
 
-def conv_params(fn):
-    """Canonical (axis, stride, effective kernel, pad) from
-    convolution_param / pooling_param kwargs of a net_spec Function."""
-    params = fn.params.get("convolution_param",
-                           fn.params.get("pooling_param", fn.params))
-    axis = params.get("axis", 1)
-    ks = np.array(params["kernel_size"], ndmin=1)
-    dilation = np.array(params.get("dilation", 1), ndmin=1)
-    if {"pad_h", "pad_w", "kernel_h", "kernel_w", "stride_h",
-            "stride_w"} & set(params):
+class AffineMap:
+    """y = scale * x + shift on spatial coordinates, tagged with the first
+    spatial axis it applies to (None = axis-agnostic identity)."""
+
+    __slots__ = ("axis", "scale", "shift")
+
+    def __init__(self, axis, scale, shift):
+        self.axis, self.scale, self.shift = axis, scale, shift
+
+    @classmethod
+    def identity(cls):
+        return cls(None, 1, 0)
+
+    def _join_axis(self, other):
+        if self.axis is None:
+            return other.axis
+        if other.axis is None or other.axis == self.axis:
+            return self.axis
+        raise AxisMismatchException(f"{self.axis} vs {other.axis}")
+
+    def of(self, inner: "AffineMap") -> "AffineMap":
+        """Composition self∘inner: apply `inner` first, then self."""
+        return AffineMap(self._join_axis(inner),
+                         self.scale * inner.scale,
+                         self.scale * inner.shift + self.shift)
+
+    def inv(self) -> "AffineMap":
+        return AffineMap(self.axis, 1 / self.scale,
+                         -self.shift / self.scale)
+
+    def as_tuple(self):
+        return self.axis, self.scale, self.shift
+
+
+def _arr(value):
+    return np.atleast_1d(np.asarray(value))
+
+
+def _sliding_window_geometry(fn):
+    """(axis, stride, footprint, pad) of a conv-like net_spec Function.
+
+    The footprint is the dilated extent `dilation*(kernel-1)+1` — the span
+    of input pixels one output pixel sees."""
+    p = fn.params.get("convolution_param",
+                      fn.params.get("pooling_param", fn.params))
+    legacy = {"kernel_h", "kernel_w", "stride_h", "stride_w",
+              "pad_h", "pad_w"} & p.keys()
+    if legacy:
         raise AssertionError(
-            "coordinate mapping does not support legacy _h/_w params")
-    return (axis, np.array(params.get("stride", 1), ndmin=1),
-            (ks - 1) * dilation + 1,
-            np.array(params.get("pad", 0), ndmin=1))
+            f"anisotropic legacy geometry {sorted(legacy)} has no 1-D "
+            "coordinate map")
+    footprint = _arr(p.get("dilation", 1)) * (_arr(p["kernel_size"]) - 1) + 1
+    return p.get("axis", 1), _arr(p.get("stride", 1)), footprint, \
+        _arr(p.get("pad", 0))
 
 
-def crop_params(fn):
-    params = fn.params.get("crop_param", fn.params)
-    axis = params.get("axis", 2)
-    offset = np.array(params.get("offset", 0), ndmin=1)
-    return axis, offset
+def _layer_map(fn) -> AffineMap:
+    """AffineMap induced by one layer, mapping top coords into bottom
+    coords' frame (downsamplers shrink scale, Deconvolution inverts)."""
+    t = fn.type_name
+    if t in _ELEMENTWISE:
+        return AffineMap.identity()
+    if t in ("Convolution", "Pooling", "Im2col"):
+        ax, stride, fp, pad = _sliding_window_geometry(fn)
+        return AffineMap(ax, 1 / stride, (pad - (fp - 1) / 2) / stride)
+    if t == "Deconvolution":
+        ax, stride, fp, pad = _sliding_window_geometry(fn)
+        return AffineMap(ax, stride, (fp - 1) / 2 - pad)
+    if t == "Crop":
+        p = fn.params.get("crop_param", fn.params)
+        # crop_param.axis counts from the blob's full axis list (channel
+        # included); maps count spatial axes only, hence the -1.
+        return AffineMap(p.get("axis", 2) - 1, 1, -_arr(p.get("offset", 0)))
+    raise UndefinedMapException(t)
 
 
-def coord_map(fn):
-    """(axis, scale, shift) for one layer (coord_map.py:57-78)."""
-    if fn.type_name in ("Convolution", "Pooling", "Im2col"):
-        axis, stride, ks, pad = conv_params(fn)
-        return axis, 1 / stride, (pad - (ks - 1) / 2) / stride
-    if fn.type_name == "Deconvolution":
-        axis, stride, ks, pad = conv_params(fn)
-        return axis, stride, (ks - 1) / 2 - pad
-    if fn.type_name in PASS_THROUGH_LAYERS:
-        return None, 1, 0
-    if fn.type_name == "Crop":
-        axis, offset = crop_params(fn)
-        return axis - 1, 1, -offset
-    raise UndefinedMapException
-
-
-def compose(base_map, next_map):
-    ax1, a1, b1 = base_map
-    ax2, a2, b2 = next_map
-    if ax1 is None:
-        ax = ax2
-    elif ax2 is None or ax1 == ax2:
-        ax = ax1
-    else:
-        raise AxisMismatchException
-    return ax, a1 * a2, a1 * b2 + b1
-
-
-def inverse(cm):
-    ax, a, b = cm
-    return ax, 1 / a, -b / a
+def _walk_to_ancestors(top):
+    """{ancestor_top: AffineMap} for every ancestor reachable through
+    mapped layers, with the map taking `top` coords into that ancestor's
+    frame.  A Crop layer only aligns to its first bottom, so the walk
+    ignores its reference bottom."""
+    reached = {top: AffineMap.identity()}
+    stack = [top]
+    while stack:
+        t = stack.pop()
+        try:
+            step = _layer_map(t.fn)
+        except UndefinedMapException:
+            continue
+        bottoms = t.fn.inputs
+        if t.fn.type_name == "Crop":
+            bottoms = bottoms[:1]
+        for b in bottoms:
+            reached[b] = reached[t].of(step)
+            stack.append(b)
+    return reached
 
 
 def coord_map_from_to(top_from, top_to):
-    """Walk both tops back to a common ancestor, composing maps
-    (coord_map.py:112-166)."""
-    def collect_bottoms(top):
-        bottoms = top.fn.inputs
-        if top.fn.type_name == "Crop":
-            bottoms = bottoms[:1]
-        return bottoms
-
-    from_maps = {top_from: (None, 1, 0)}
-    frontier = {top_from}
-    while frontier:
-        top = frontier.pop()
-        try:
-            for bottom in collect_bottoms(top):
-                from_maps[bottom] = compose(from_maps[top],
-                                            coord_map(top.fn))
-                frontier.add(bottom)
-        except UndefinedMapException:
-            pass
-
-    to_maps = {top_to: (None, 1, 0)}
-    frontier = {top_to}
-    while frontier:
-        top = frontier.pop()
-        if top in from_maps:
-            return compose(to_maps[top], inverse(from_maps[top]))
-        try:
-            for bottom in collect_bottoms(top):
-                to_maps[bottom] = compose(to_maps[top], coord_map(top.fn))
-                frontier.add(bottom)
-        except UndefinedMapException:
-            continue
-    raise RuntimeError("Could not compute map between tops; are they "
-                       "connected by spatial layers?")
+    """(axis, scale, shift) taking coordinates of top_from into top_to's
+    frame, joined at any common ancestor blob."""
+    down_from = _walk_to_ancestors(top_from)
+    down_to = _walk_to_ancestors(top_to)
+    for blob, to_map in down_to.items():
+        if blob in down_from:
+            return to_map.of(down_from[blob].inv()).as_tuple()
+    raise RuntimeError("no common ancestor connects the tops through "
+                       "spatially mapped layers")
 
 
 def crop(top_from, top_to):
-    """Emit the Crop layer aligning top_from to top_to
-    (coord_map.py:169-185)."""
-    ax, a, b = coord_map_from_to(top_from, top_to)
-    assert (np.asarray(a) == 1).all(), f"scale mismatch on crop (a = {a})"
-    assert (np.asarray(b) <= 0).all(), f"cannot crop negative offset ({b})"
-    assert (np.round(b) == b).all(), f"cannot crop noninteger offset ({b})"
+    """Emit the Crop layer aligning top_from onto top_to's grid."""
+    ax, scale, shift = coord_map_from_to(top_from, top_to)
+    scale, shift = np.asarray(scale), np.asarray(shift)
+    if not (scale == 1).all():
+        raise AssertionError(f"resolutions differ (scale {scale}); crop "
+                             "cannot align them")
+    if not (shift <= 0).all():
+        raise AssertionError(f"alignment needs padding, not cropping "
+                             f"(shift {shift})")
+    if not (np.round(shift) == shift).all():
+        raise AssertionError(f"fractional offset {shift} cannot be cropped")
+    offsets = [int(v) for v in -np.round(np.atleast_1d(shift))]
     return L.Crop(top_from, top_to,
-                  crop_param=dict(axis=ax + 1,
-                                  offset=list(-np.round(np.atleast_1d(b))
-                                              .astype(int))))
+                  crop_param=dict(axis=ax + 1, offset=offsets))
+
+
+# ---------------------------------------------------------------------------
+# Source-compat shims for the reference module's tuple-based helpers.
+
+def coord_map(fn):
+    return _layer_map(fn).as_tuple()
+
+
+def compose(base_map, next_map):
+    return AffineMap(*base_map).of(AffineMap(*next_map)).as_tuple()
+
+
+def inverse(cm):
+    return AffineMap(*cm).inv().as_tuple()
+
+
+def conv_params(fn):
+    ax, stride, fp, pad = _sliding_window_geometry(fn)
+    return ax, stride, fp, pad
+
+
+def crop_params(fn):
+    p = fn.params.get("crop_param", fn.params)
+    return p.get("axis", 2), _arr(p.get("offset", 0))

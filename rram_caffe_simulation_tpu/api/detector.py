@@ -1,13 +1,68 @@
-"""Detector: R-CNN-style windowed detection (reference:
-python/caffe/detector.py — detect_windows crops each proposal, preprocesses
-and batches through the net; detect_selective_search is the file-list
-convenience wrapper)."""
+"""Detector: R-CNN-style windowed detection (same capability as reference
+python/caffe/detector.py — crop each proposal window out of its image,
+preprocess, and batch through the net).
+
+Context-pad geometry, re-derived: with a `crop_size` network input and
+`context_pad` pixels of context requested on every side, the proposal
+window must land on the central ``crop_size - 2*context_pad`` square of
+the input.  Equivalently, the region of IMAGE space that fills the whole
+input is the window grown about its center by
+``crop_size / (crop_size - 2*context_pad)``.  Whatever part of that grown
+region falls outside the image is filled with the (deprocessed) data
+mean, so the net sees mean-neutral padding.  The geometry is implemented
+by two pure helpers, `grow_window` and `render_region`, unit-tested
+against hand-computed crops in tests/test_api_extras.py.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 from . import io as caffe_io
 from .pynet import Net
+
+
+def grow_window(window, factor):
+    """Scale an inclusive (ymin, xmin, ymax, xmax) box about its center.
+
+    The box spans ``ymax - ymin + 1`` pixels; growing multiplies that span
+    by `factor` while keeping the center fixed, then rounds to integer
+    pixel coordinates (which may fall outside the image)."""
+    y0, x0, y1, x1 = np.asarray(window, dtype=np.float64)
+    cy, cx = (y0 + y1) / 2, (x0 + x1) / 2
+    ry = (y1 - y0 + 1) / 2 * factor
+    rx = (x1 - x0 + 1) / 2 * factor
+    return np.round([cy - ry, cx - rx, cy + ry, cx + rx]).astype(int)
+
+
+def render_region(image, region, out_size, fill):
+    """Render an inclusive image-space `region` (possibly hanging off the
+    image) onto an ``out_size x out_size`` canvas.
+
+    The affine that maps the full region onto the canvas is applied only
+    to the part of the region the image actually covers; everything else
+    keeps the `fill` color (per-channel vector or full canvas array)."""
+    im_h, im_w = image.shape[:2]
+    span_y = region[2] - region[0] + 1
+    span_x = region[3] - region[1] + 1
+    to_canvas_y = out_size / float(span_y)
+    to_canvas_x = out_size / float(span_x)
+
+    # Visible part of the region, in image coordinates.
+    vy0, vx0 = max(region[0], 0), max(region[1], 0)
+    vy1, vx1 = min(region[2], im_h - 1), min(region[3], im_w - 1)
+
+    # Where that visible part lands on the canvas: offset = how far the
+    # region start hangs off the image, carried through the affine.
+    oy = int((vy0 - region[0]) * to_canvas_y)
+    ox = int((vx0 - region[1]) * to_canvas_x)
+    h = min(int(round((vy1 - vy0 + 1) * to_canvas_y)), out_size - oy)
+    w = min(int(round((vx1 - vx0 + 1) * to_canvas_x)), out_size - ox)
+
+    canvas = np.empty((out_size, out_size, image.shape[2]), np.float32)
+    canvas[:] = fill
+    canvas[oy:oy + h, ox:ox + w] = caffe_io.resize_image(
+        image[vy0:vy1 + 1, vx0:vx1 + 1], (h, w))
+    return canvas
 
 
 class Detector(Net):
@@ -30,103 +85,99 @@ class Detector(Net):
         self.configure_crop(context_pad)
 
     def detect_windows(self, images_windows):
-        """[(image_fname, window_array)] -> list of {window, prediction}
-        (detector.py:49-95)."""
-        window_inputs = []
-        for image_fname, windows in images_windows:
-            image = caffe_io.load_image(image_fname)
-            for window in windows:
-                window_inputs.append(self.crop(image, window))
+        """[(image_fname, window_array)] -> list of {window, prediction}."""
         in_ = self.inputs[0]
-        sample = self.transformer.preprocess(in_, window_inputs[0])
-        caffe_in = np.zeros((len(window_inputs),) + sample.shape,
-                            dtype=np.float32)
-        for ix, window_in in enumerate(window_inputs):
-            caffe_in[ix] = self.transformer.preprocess(in_, window_in)
-        out = self.forward_all(**{in_: caffe_in})
-        predictions = out[self.outputs[0]]
-        detections = []
-        ix = 0
-        for image_fname, windows in images_windows:
-            for window in windows:
-                detections.append({
-                    "window": window,
-                    "prediction": predictions[ix],
-                    "filename": image_fname,
-                })
-                ix += 1
-        return detections
+        crops = []
+        for fname, windows in images_windows:
+            image = caffe_io.load_image(fname)
+            crops.extend(
+                (fname, window,
+                 self.transformer.preprocess(in_, self.crop(image, window)))
+                for window in windows)
+        batch = np.stack([c[2] for c in crops]).astype(np.float32)
+        scores = self.forward_all(**{in_: batch})[self.outputs[0]]
+        return [{"window": window, "prediction": scores[i],
+                 "filename": fname}
+                for i, (fname, window, _) in enumerate(crops)]
 
     def detect_selective_search(self, image_fnames):
         """Windows from selective search would come from an external
-        proposal source; the reference shells out to a MATLAB package
-        (detector.py:97-119). Provide windows explicitly via
-        detect_windows."""
+        proposal source; the reference shells out to a MATLAB package.
+        Provide windows explicitly via detect_windows (see
+        load_windows_file for the windows-from-file path)."""
         raise NotImplementedError(
             "supply proposal windows explicitly via detect_windows "
             "(the reference depends on an external MATLAB selective-search "
             "package)")
 
     def crop(self, im, window):
-        """Crop a window from the image, with context padding when
-        configured (detector.py:121-184)."""
+        """Cut `window` out of `im`; with context_pad configured, render
+        the grown window into a mean-filled square instead."""
         window = np.round(np.asarray(window)).astype(int)
-        crop = im[window[0]:window[2], window[1]:window[3]]
-        if self.context_pad:
-            box = window.copy().astype(float)
-            crop_size = self.blobs[self.inputs[0]].data.shape[-1]
-            scale = crop_size / (crop_size - 2.0 * self.context_pad)
-            half_h = (box[2] - box[0] + 1) / 2.0
-            half_w = (box[3] - box[1] + 1) / 2.0
-            center = (box[0] + half_h, box[1] + half_w)
-            scaled_dims = scale * np.array((-half_h, -half_w,
-                                            half_h, half_w))
-            box = np.round(np.tile(center, 2) + scaled_dims).astype(int)
-            full_h = box[2] - box[0] + 1
-            full_w = box[3] - box[1] + 1
-            scale_h = crop_size / float(full_h)
-            scale_w = crop_size / float(full_w)
-            pad_y = int(max(0, -box[0]) * scale_h)
-            pad_x = int(max(0, -box[1]) * scale_w)
-            im_h, im_w = im.shape[:2]
-            box = np.clip(box, 0.0, [im_h - 1, im_w - 1,
-                                     im_h - 1, im_w - 1]).astype(int)
-            clip_h = box[2] - box[0] + 1
-            clip_w = box[3] - box[1] + 1
-            crop_h = int(np.round(clip_h * scale_h))
-            crop_w = int(np.round(clip_w * scale_w))
-            if pad_y + crop_h > crop_size:
-                crop_h = crop_size - pad_y
-            if pad_x + crop_w > crop_size:
-                crop_w = crop_size - pad_x
-            crop = np.ones((crop_size, crop_size, im.shape[2]),
-                           dtype=np.float32) * self.crop_mean
-            context_crop = im[box[0]:box[2] + 1, box[1]:box[3] + 1]
-            context_crop = caffe_io.resize_image(context_crop,
-                                                 (crop_h, crop_w))
-            crop[pad_y:pad_y + crop_h, pad_x:pad_x + crop_w] = context_crop
-        return crop
+        if not self.context_pad:
+            return im[window[0]:window[2], window[1]:window[3]]
+        input_size = self.blobs[self.inputs[0]].data.shape[-1]
+        factor = input_size / float(input_size - 2 * self.context_pad)
+        region = grow_window(window, factor)
+        return render_region(im, region, input_size, self.crop_fill)
 
     def configure_crop(self, context_pad):
-        """Derive the deprocessed mean image for context padding
-        (detector.py:186-211)."""
+        """Set context padding and derive the fill color: the data mean
+        expressed in raw-image (H, W, C) space, obtained by deprocessing a
+        zero blob through the transformer (so every configured transform —
+        transpose, channel swap, raw_scale — is inverted in one place)."""
+        self.context_pad = context_pad or 0
+        if not self.context_pad:
+            return
         in_ = self.inputs[0]
-        self.context_pad = context_pad
-        if self.context_pad:
-            transpose = self.transformer.transpose.get(in_)
-            channel_order = self.transformer.channel_swap.get(in_)
-            raw_scale = self.transformer.raw_scale.get(in_)
-            mean = self.transformer.mean.get(in_)
-            if mean is not None:
-                inv_transpose = [transpose[t] for t in transpose]
-                crop_mean = mean.copy().transpose(inv_transpose)
-                if channel_order is not None:
-                    channel_order_inverse = [channel_order.index(i)
-                                             for i in range(crop_mean.shape[2])]
-                    crop_mean = crop_mean[:, :, channel_order_inverse]
-                if raw_scale is not None:
-                    crop_mean /= raw_scale
-                self.crop_mean = crop_mean
-            else:
-                self.crop_mean = np.zeros(
-                    self.blobs[in_].data.shape[2:] + (3,), dtype=np.float32)
+        blob_shape = self.blobs[in_].data.shape
+        raw_mean = self.transformer.deprocess(
+            in_, np.zeros(blob_shape[1:], np.float32))
+        input_size = blob_shape[-1]
+        if raw_mean.ndim == 3 and raw_mean.shape[:2] == (input_size,
+                                                         input_size):
+            self.crop_fill = raw_mean.astype(np.float32)
+        elif raw_mean.ndim == 3:
+            # spatially varying mean of a different size: fall back to its
+            # per-channel average as a uniform fill
+            self.crop_fill = np.asarray(raw_mean, np.float32).reshape(
+                -1, raw_mean.shape[-1]).mean(axis=0)
+        else:
+            # single-channel blob (deprocess squeezed the channel axis)
+            self.crop_fill = float(np.mean(raw_mean))
+        # back-compat attribute name used by the reference API surface
+        self.crop_mean = self.crop_fill
+
+
+def load_windows_file(path):
+    """Parse the R-CNN windows-file format the reference examples feed to
+    detect_windows: repeated blocks of
+
+        # <image index>
+        <image path>
+        <n channels>
+        <height>
+        <width>
+        <num windows>
+        <label> <overlap> <ymin> <xmin> <ymax> <xmax>   (x num windows)
+
+    Returns [(image_path, windows array of shape (n, 4))], dropping the
+    label/overlap columns (Detector scores windows; it does not train)."""
+    images_windows = []
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+    i = 0
+    while i < len(lines):
+        if not lines[i].startswith("#"):
+            i += 1
+            continue
+        path_line = lines[i + 1]
+        n_windows = int(lines[i + 5])
+        rows = []
+        for j in range(n_windows):
+            fields = lines[i + 6 + j].split()
+            rows.append([float(v) for v in fields[2:6]])
+        images_windows.append(
+            (path_line, np.asarray(rows, dtype=np.float64).reshape(-1, 4)))
+        i += 6 + n_windows
+    return images_windows

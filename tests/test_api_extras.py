@@ -90,3 +90,108 @@ def test_classifier_predict(tmp_path):
     np.testing.assert_allclose(preds.sum(1), 1.0, rtol=1e-4)
     preds2 = clf.predict(imgs, oversample=False)
     assert preds2.shape == (3, 4)
+
+
+# --- Detector context-pad geometry (hand-computed contract) ------------------
+
+def test_grow_window_hand_computed():
+    from rram_caffe_simulation_tpu.api.detector import grow_window
+    # span (4, 5) about center (3.5, 5.0), doubled: radii (4, 5)
+    np.testing.assert_array_equal(grow_window((2, 3, 5, 7), 2.0),
+                                  [0, 0, 8, 10])
+    # factor 1 keeps an odd-span box fixed
+    np.testing.assert_array_equal(grow_window((1, 1, 3, 3), 1.0),
+                                  [0, 0, 4, 4])
+
+
+def test_render_region_interior():
+    """Region fully inside the image: no fill pixels survive."""
+    from rram_caffe_simulation_tpu.api.detector import render_region
+    im = np.full((10, 12, 3), 3.0, np.float32)
+    out = render_region(im, np.array([0, 0, 9, 9]), 5, np.array([9., 9., 9.]))
+    np.testing.assert_array_equal(out, np.full((5, 5, 3), 3.0))
+
+
+def test_render_region_offsets_and_fill():
+    """Region hanging off the top-left: offset = overhang * scale; the
+    remainder keeps the fill color."""
+    from rram_caffe_simulation_tpu.api.detector import render_region
+    im = np.full((10, 12, 3), 3.0, np.float32)
+    out = render_region(im, np.array([-2, -2, 7, 7]), 5, np.array([9., 9., 9.]))
+    # scale 5/10 = 0.5 -> visible 8x8 patch lands at (1,1) size 4x4
+    np.testing.assert_array_equal(out[1:5, 1:5], np.full((4, 4, 3), 3.0))
+    mask = np.ones((5, 5), bool)
+    mask[1:5, 1:5] = False
+    assert (out[mask] == 9.0).all()
+
+
+def test_render_region_identity_passthrough():
+    """Region == canvas size and inside the image: exact pixel copy."""
+    from rram_caffe_simulation_tpu.api.detector import render_region
+    rng = np.random.RandomState(0)
+    im = rng.rand(8, 8, 3).astype(np.float32)
+    out = render_region(im, np.array([2, 1, 6, 5]), 5, np.zeros(3))
+    np.testing.assert_allclose(out, im[2:7, 1:6], atol=1e-6)
+
+
+def test_load_windows_file(tmp_path):
+    from rram_caffe_simulation_tpu.api.detector import load_windows_file
+    wf = tmp_path / "window_file.txt"
+    wf.write_text("""# 0
+/images/a.jpg
+3
+480
+640
+2
+1 0.8 10 20 110 220
+0 0.1 5 5 50 50
+# 1
+/images/b.jpg
+3
+100
+100
+1
+2 1.0 0 0 99 99
+""")
+    parsed = load_windows_file(str(wf))
+    assert [p for p, _ in parsed] == ["/images/a.jpg", "/images/b.jpg"]
+    np.testing.assert_array_equal(parsed[0][1],
+                                  [[10, 20, 110, 220], [5, 5, 50, 50]])
+    assert parsed[1][1].shape == (1, 4)
+
+
+def test_detector_end_to_end(tmp_path):
+    """Windows-file -> Detector.detect_windows through a tiny net, with
+    context padding on (exercises crop/configure_crop/render paths)."""
+    from PIL import Image
+    npm = pb.NetParameter()
+    text_format.Parse("""
+    name: "det"
+    layer { name: "data" type: "Input" top: "data"
+      input_param { shape { dim: 4 dim: 3 dim: 12 dim: 12 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param { num_output: 3
+        weight_filler { type: "xavier" } } }
+    layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+    """, npm)
+    seed = caffe.Net(npm, caffe.TEST)
+    weights = str(tmp_path / "det.caffemodel")
+    seed.save(weights)
+
+    img_path = str(tmp_path / "scene.png")
+    Image.fromarray(
+        (np.random.RandomState(3).rand(40, 48, 3) * 255).astype(np.uint8)
+    ).save(img_path)
+
+    wf = tmp_path / "windows.txt"
+    wf.write_text("# 0\n%s\n3\n40\n48\n2\n1 0.9 4 6 20 30\n0 0.2 0 0 39 47\n"
+                  % img_path)
+
+    from rram_caffe_simulation_tpu.api.detector import load_windows_file
+    det = caffe.Detector(npm, weights, context_pad=2,
+                         mean=np.array([0.4, 0.4, 0.4]))
+    dets = det.detect_windows(load_windows_file(str(wf)))
+    assert len(dets) == 2
+    for d in dets:
+        assert d["prediction"].shape == (3,)
+        np.testing.assert_allclose(d["prediction"].sum(), 1.0, rtol=1e-4)
