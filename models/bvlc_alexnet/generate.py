@@ -1,0 +1,140 @@
+"""Generate bvlc_alexnet train_val/deploy/solver prototxts with the
+framework's net_spec DSL.
+
+Architecture per the published BVLC AlexNet recipe (reference:
+models/bvlc_alexnet/readme.md — 57.1% top-1 / 80.2% top-5 ILSVRC12 center
+crop): 5 conv (grouped conv2/4/5, LRN after conv1/conv2) + 3 FC with
+dropout, SoftmaxWithLoss + TEST-phase Accuracy. Layer/blob names match the
+published model so zoo `.caffemodel` weights load by name through
+copy_trained_from.
+
+Run:  python models/bvlc_alexnet/generate.py  (rewrites the prototxts
+in-place next to this file).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L, params as P  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+WEIGHT_PARAM = [dict(lr_mult=1, decay_mult=1), dict(lr_mult=2, decay_mult=0)]
+
+
+def conv_relu(n, name, bottom, nout, ks, stride=1, pad=0, group=1,
+              bias_value=0.0):
+    n[name] = L.Convolution(
+        bottom, num_output=nout, kernel_size=ks, stride=stride, pad=pad,
+        group=group, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant", value=bias_value))
+    n["relu" + name[4:]] = L.ReLU(n[name], in_place=True)
+    return n[name]
+
+
+def fc_relu_drop(n, idx, bottom, nout, std=0.005):
+    n[f"fc{idx}"] = L.InnerProduct(
+        bottom, num_output=nout, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=std),
+        bias_filler=dict(type="constant", value=0.1))
+    n[f"relu{idx}"] = L.ReLU(n[f"fc{idx}"], in_place=True)
+    n[f"drop{idx}"] = L.Dropout(n[f"fc{idx}"], dropout_ratio=0.5,
+                                in_place=True)
+    return n[f"fc{idx}"]
+
+
+def body(n, data):
+    """conv1..fc8; returns the fc8 top."""
+    conv_relu(n, "conv1", data, 96, 11, stride=4)
+    n.norm1 = L.LRN(n.conv1, local_size=5, alpha=0.0001, beta=0.75)
+    n.pool1 = L.Pooling(n.norm1, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    conv_relu(n, "conv2", n.pool1, 256, 5, pad=2, group=2, bias_value=0.1)
+    n.norm2 = L.LRN(n.conv2, local_size=5, alpha=0.0001, beta=0.75)
+    n.pool2 = L.Pooling(n.norm2, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    conv_relu(n, "conv3", n.pool2, 384, 3, pad=1)
+    conv_relu(n, "conv4", n.conv3, 384, 3, pad=1, group=2, bias_value=0.1)
+    conv_relu(n, "conv5", n.conv4, 256, 3, pad=1, group=2, bias_value=0.1)
+    n.pool5 = L.Pooling(n.conv5, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    fc_relu_drop(n, 6, n.pool5, 4096)
+    fc_relu_drop(n, 7, n.fc6, 4096)
+    n.fc8 = L.InnerProduct(
+        n.fc7, num_output=1000, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant", value=0.0))
+    return n.fc8
+
+
+def train_val():
+    n = NetSpec()
+    n.data, n.label = L.Data(
+        ntop=2, name="data",
+        include=dict(phase=pb.TRAIN),
+        transform_param=dict(mirror=True, crop_size=227,
+                             mean_file="data/ilsvrc12/imagenet_mean.binaryproto"),
+        data_param=dict(source="examples/imagenet/ilsvrc12_train_lmdb",
+                        batch_size=256, backend=P.Data.LMDB))
+    fc8 = body(n, n.data)
+    n.accuracy = L.Accuracy(fc8, n.label, include=dict(phase=pb.TEST))
+    n.loss = L.SoftmaxWithLoss(fc8, n.label)
+    proto = n.to_proto()
+    proto.name = "AlexNet"
+    # TEST-phase twin of the data layer (Caffe's include-based overlay):
+    # inserted after generation so both phases share every named blob.
+    test_data = pb.LayerParameter()
+    test_data.name = "data"
+    test_data.type = "Data"
+    test_data.top.extend(["data", "label"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.mirror = False
+    test_data.transform_param.crop_size = 227
+    test_data.transform_param.mean_file = (
+        "data/ilsvrc12/imagenet_mean.binaryproto")
+    test_data.data_param.source = "examples/imagenet/ilsvrc12_val_lmdb"
+    test_data.data_param.batch_size = 50
+    test_data.data_param.backend = pb.DataParameter.LMDB
+    proto.layer.insert(1, test_data)
+    return proto
+
+
+def deploy():
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[10, 3, 227, 227])))
+    fc8 = body(n, n.data)
+    n.prob = L.Softmax(fc8)
+    proto = n.to_proto()
+    proto.name = "AlexNet"
+    return proto
+
+
+SOLVER = """\
+net: "models/bvlc_alexnet/train_val.prototxt"
+test_iter: 1000
+test_interval: 1000
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 20
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/bvlc_alexnet/caffe_alexnet_train"
+"""
+
+
+def main():
+    with open(os.path.join(HERE, "train_val.prototxt"), "w") as f:
+        f.write(str(train_val()))
+    with open(os.path.join(HERE, "deploy.prototxt"), "w") as f:
+        f.write(str(deploy()))
+    with open(os.path.join(HERE, "solver.prototxt"), "w") as f:
+        f.write(SOLVER)
+    print("wrote train_val.prototxt, deploy.prototxt, solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

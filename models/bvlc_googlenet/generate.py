@@ -1,0 +1,213 @@
+"""Generate bvlc_googlenet train_val/deploy/solver prototxts with the
+framework's net_spec DSL.
+
+GoogLeNet (Inception v1) per the published BVLC recipe (reference:
+models/bvlc_googlenet/readme.md — 68.7% top-1 / 88.9% top-5 ILSVRC12):
+stem (7x7/2 conv, LRN, 1x1+3x3 conv, LRN) + 9 inception modules with
+concat towers + two auxiliary SoftmaxWithLoss heads (weight 0.3) off
+inception_4a/4d + main classifier. This net is the framework's
+layer-coverage stress test: LRN, grouped concat towers, multi-loss,
+TEST-phase top-1/top-5 Accuracy.
+
+Layer/blob names ("conv1/7x7_s2", "inception_3a/output", ...) match the
+published model so zoo `.caffemodel` weights load by name.
+
+Run:  python models/bvlc_googlenet/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L, params as P  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+WEIGHT_PARAM = [dict(lr_mult=1, decay_mult=1), dict(lr_mult=2, decay_mult=0)]
+
+# (1x1, 3x3reduce, 3x3, 5x5reduce, 5x5, pool_proj) per module.
+INCEPTION = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+def conv_relu(n, name, relu_name, bottom, nout, ks, stride=1, pad=0,
+              w_std=None):
+    filler = (dict(type="gaussian", std=w_std) if w_std
+              else dict(type="xavier"))
+    n[name] = L.Convolution(
+        bottom, num_output=nout, kernel_size=ks, stride=stride, pad=pad,
+        param=WEIGHT_PARAM, weight_filler=filler,
+        bias_filler=dict(type="constant", value=0.2))
+    n[relu_name] = L.ReLU(n[name], in_place=True)
+    return n[name]
+
+
+def inception(n, tag, bottom):
+    p = f"inception_{tag}"
+    c1, r3, c3, r5, c5, pp = INCEPTION[tag]
+    conv_relu(n, f"{p}/1x1", f"{p}/relu_1x1", bottom, c1, 1)
+    conv_relu(n, f"{p}/3x3_reduce", f"{p}/relu_3x3_reduce", bottom, r3, 1)
+    conv_relu(n, f"{p}/3x3", f"{p}/relu_3x3", n[f"{p}/3x3_reduce"], c3, 3,
+              pad=1)
+    conv_relu(n, f"{p}/5x5_reduce", f"{p}/relu_5x5_reduce", bottom, r5, 1)
+    conv_relu(n, f"{p}/5x5", f"{p}/relu_5x5", n[f"{p}/5x5_reduce"], c5, 5,
+              pad=2)
+    n[f"{p}/pool"] = L.Pooling(bottom, pool=P.Pooling.MAX, kernel_size=3,
+                               stride=1, pad=1)
+    conv_relu(n, f"{p}/pool_proj", f"{p}/relu_pool_proj", n[f"{p}/pool"],
+              pp, 1)
+    n[f"{p}/output"] = L.Concat(n[f"{p}/1x1"], n[f"{p}/3x3"],
+                                n[f"{p}/5x5"], n[f"{p}/pool_proj"])
+    return n[f"{p}/output"]
+
+
+def aux_head(n, idx, bottom, label, deploy):
+    """Auxiliary classifier head loss{idx} (train/val only)."""
+    p = f"loss{idx}"
+    n[f"{p}/ave_pool"] = L.Pooling(bottom, pool=P.Pooling.AVE,
+                                   kernel_size=5, stride=3)
+    conv_relu(n, f"{p}/conv", f"{p}/relu_conv", n[f"{p}/ave_pool"], 128, 1)
+    n[f"{p}/fc"] = L.InnerProduct(
+        n[f"{p}/conv"], num_output=1024, param=WEIGHT_PARAM,
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant", value=0.2))
+    n[f"{p}/relu_fc"] = L.ReLU(n[f"{p}/fc"], in_place=True)
+    n[f"{p}/drop_fc"] = L.Dropout(n[f"{p}/fc"], dropout_ratio=0.7,
+                                  in_place=True)
+    n[f"{p}/classifier"] = L.InnerProduct(
+        n[f"{p}/fc"], num_output=1000, param=WEIGHT_PARAM,
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant", value=0.0))
+    n[f"{p}/loss"] = L.SoftmaxWithLoss(n[f"{p}/classifier"], label,
+                                       loss_weight=0.3)
+    n[f"{p}/top-1"] = L.Accuracy(n[f"{p}/classifier"], label,
+                                 include=dict(phase=pb.TEST))
+    n[f"{p}/top-5"] = L.Accuracy(n[f"{p}/classifier"], label, top_k=5,
+                                 include=dict(phase=pb.TEST))
+
+
+def body(n, data, label=None, deploy=False):
+    conv_relu(n, "conv1/7x7_s2", "conv1/relu_7x7", data, 64, 7, stride=2,
+              pad=3)
+    n["pool1/3x3_s2"] = L.Pooling(n["conv1/7x7_s2"], pool=P.Pooling.MAX,
+                                  kernel_size=3, stride=2)
+    n["pool1/norm1"] = L.LRN(n["pool1/3x3_s2"], local_size=5, alpha=0.0001,
+                             beta=0.75)
+    conv_relu(n, "conv2/3x3_reduce", "conv2/relu_3x3_reduce",
+              n["pool1/norm1"], 64, 1)
+    conv_relu(n, "conv2/3x3", "conv2/relu_3x3", n["conv2/3x3_reduce"],
+              192, 3, pad=1)
+    n["conv2/norm2"] = L.LRN(n["conv2/3x3"], local_size=5, alpha=0.0001,
+                             beta=0.75)
+    n["pool2/3x3_s2"] = L.Pooling(n["conv2/norm2"], pool=P.Pooling.MAX,
+                                  kernel_size=3, stride=2)
+    x = inception(n, "3a", n["pool2/3x3_s2"])
+    x = inception(n, "3b", x)
+    n["pool3/3x3_s2"] = L.Pooling(x, pool=P.Pooling.MAX, kernel_size=3,
+                                  stride=2)
+    x = inception(n, "4a", n["pool3/3x3_s2"])
+    if not deploy:
+        aux_head(n, 1, x, label, deploy)
+    x = inception(n, "4b", x)
+    x = inception(n, "4c", x)
+    x = inception(n, "4d", x)
+    if not deploy:
+        aux_head(n, 2, x, label, deploy)
+    x = inception(n, "4e", x)
+    n["pool4/3x3_s2"] = L.Pooling(x, pool=P.Pooling.MAX, kernel_size=3,
+                                  stride=2)
+    x = inception(n, "5a", n["pool4/3x3_s2"])
+    x = inception(n, "5b", x)
+    n["pool5/7x7_s1"] = L.Pooling(x, pool=P.Pooling.AVE, kernel_size=7,
+                                  stride=1)
+    n["pool5/drop_7x7_s1"] = L.Dropout(n["pool5/7x7_s1"],
+                                       dropout_ratio=0.4, in_place=True)
+    n["loss3/classifier"] = L.InnerProduct(
+        n["pool5/7x7_s1"], num_output=1000, param=WEIGHT_PARAM,
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant", value=0.0))
+    return n["loss3/classifier"]
+
+
+def train_val():
+    n = NetSpec()
+    n.data, n.label = L.Data(
+        ntop=2, name="data",
+        include=dict(phase=pb.TRAIN),
+        transform_param=dict(mirror=True, crop_size=224,
+                             mean_value=[104, 117, 123]),
+        data_param=dict(source="examples/imagenet/ilsvrc12_train_lmdb",
+                        batch_size=32, backend=P.Data.LMDB))
+    cls = body(n, n.data, n.label)
+    n["loss3/loss3"] = L.SoftmaxWithLoss(cls, n.label, loss_weight=1.0)
+    n["loss3/top-1"] = L.Accuracy(cls, n.label,
+                                  include=dict(phase=pb.TEST))
+    n["loss3/top-5"] = L.Accuracy(cls, n.label, top_k=5,
+                                  include=dict(phase=pb.TEST))
+    proto = n.to_proto()
+    proto.name = "GoogleNet"
+    test_data = pb.LayerParameter()
+    test_data.name = "data"
+    test_data.type = "Data"
+    test_data.top.extend(["data", "label"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.mirror = False
+    test_data.transform_param.crop_size = 224
+    test_data.transform_param.mean_value.extend([104, 117, 123])
+    test_data.data_param.source = "examples/imagenet/ilsvrc12_val_lmdb"
+    test_data.data_param.batch_size = 50
+    test_data.data_param.backend = pb.DataParameter.LMDB
+    proto.layer.insert(1, test_data)
+    return proto
+
+
+def deploy():
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[10, 3, 224, 224])))
+    cls = body(n, n.data, deploy=True)
+    n.prob = L.Softmax(cls)
+    proto = n.to_proto()
+    proto.name = "GoogleNet"
+    return proto
+
+
+SOLVER = """\
+net: "models/bvlc_googlenet/train_val.prototxt"
+test_iter: 1000
+test_interval: 4000
+test_initialization: false
+display: 40
+average_loss: 40
+base_lr: 0.01
+lr_policy: "poly"
+power: 0.5
+max_iter: 2400000
+momentum: 0.9
+weight_decay: 0.0002
+snapshot: 40000
+snapshot_prefix: "models/bvlc_googlenet/bvlc_googlenet"
+"""
+
+
+def main():
+    with open(os.path.join(HERE, "train_val.prototxt"), "w") as f:
+        f.write(str(train_val()))
+    with open(os.path.join(HERE, "deploy.prototxt"), "w") as f:
+        f.write(str(deploy()))
+    with open(os.path.join(HERE, "quick_solver.prototxt"), "w") as f:
+        f.write(SOLVER)
+    print("wrote train_val.prototxt, deploy.prototxt, quick_solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

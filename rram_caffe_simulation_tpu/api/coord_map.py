@@ -18,14 +18,14 @@ import numpy as np
 from .net_spec import layers as L
 
 # Layer types that leave spatial geometry untouched (elementwise /
-# channelwise ops).  The set itself is part of the compat contract.
-_ELEMENTWISE = frozenset({
+# channelwise ops).  Mutable on purpose: the reference exposes a
+# PASS_THROUGH_LAYERS list users append custom geometry-preserving layer
+# types to, and _layer_map consults this list live.
+PASS_THROUGH_LAYERS = [
     "AbsVal", "BatchNorm", "Bias", "BNLL", "Dropout", "Eltwise", "ELU",
     "Exp", "Log", "LRN", "MVN", "Power", "PReLU", "ReLU", "Scale",
     "Sigmoid", "Split", "TanH", "Threshold",
-})
-# Back-compat alias (reference exposes a PASS_THROUGH_LAYERS list).
-PASS_THROUGH_LAYERS = sorted(_ELEMENTWISE)
+]
 
 
 class UndefinedMapException(Exception):
@@ -96,7 +96,7 @@ def _layer_map(fn) -> AffineMap:
     """AffineMap induced by one layer, mapping top coords into bottom
     coords' frame (downsamplers shrink scale, Deconvolution inverts)."""
     t = fn.type_name
-    if t in _ELEMENTWISE:
+    if t in PASS_THROUGH_LAYERS:
         return AffineMap.identity()
     if t in ("Convolution", "Pooling", "Im2col"):
         ax, stride, fp, pad = _sliding_window_geometry(fn)

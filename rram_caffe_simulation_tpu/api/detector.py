@@ -28,9 +28,12 @@ def grow_window(window, factor):
     by `factor` while keeping the center fixed, then rounds to integer
     pixel coordinates (which may fall outside the image)."""
     y0, x0, y1, x1 = np.asarray(window, dtype=np.float64)
-    cy, cx = (y0 + y1) / 2, (x0 + x1) / 2
-    ry = (y1 - y0 + 1) / 2 * factor
-    rx = (x1 - x0 + 1) / 2 * factor
+    ry = (y1 - y0 + 1) / 2
+    rx = (x1 - x0 + 1) / 2
+    # the box's center is half a span past its min corner (an inclusive
+    # box of span s pixels is centered at y0 + s/2)
+    cy, cx = y0 + ry, x0 + rx
+    ry, rx = ry * factor, rx * factor
     return np.round([cy - ry, cx - rx, cy + ry, cx + rx]).astype(int)
 
 
@@ -47,21 +50,28 @@ def render_region(image, region, out_size, fill):
     to_canvas_y = out_size / float(span_y)
     to_canvas_x = out_size / float(span_x)
 
-    # Visible part of the region, in image coordinates.
-    vy0, vx0 = max(region[0], 0), max(region[1], 0)
-    vy1, vx1 = min(region[2], im_h - 1), min(region[3], im_w - 1)
+    # Visible part of the region, in image coordinates. A region lying
+    # entirely off the image degrades to a one-pixel sliver at the nearest
+    # border (matching the reference's clip-then-crop behavior) instead of
+    # producing an empty slice.
+    vy0 = min(max(region[0], 0), im_h - 1)
+    vx0 = min(max(region[1], 0), im_w - 1)
+    vy1 = max(min(region[2], im_h - 1), vy0)
+    vx1 = max(min(region[3], im_w - 1), vx0)
 
     # Where that visible part lands on the canvas: offset = how far the
-    # region start hangs off the image, carried through the affine.
-    oy = int((vy0 - region[0]) * to_canvas_y)
-    ox = int((vx0 - region[1]) * to_canvas_x)
+    # region start hangs off the image, carried through the affine (clamped
+    # to the canvas for regions past the far image border).
+    oy = min(max(round((vy0 - region[0]) * to_canvas_y), 0), out_size)
+    ox = min(max(round((vx0 - region[1]) * to_canvas_x), 0), out_size)
     h = min(int(round((vy1 - vy0 + 1) * to_canvas_y)), out_size - oy)
     w = min(int(round((vx1 - vx0 + 1) * to_canvas_x)), out_size - ox)
 
     canvas = np.empty((out_size, out_size, image.shape[2]), np.float32)
     canvas[:] = fill
-    canvas[oy:oy + h, ox:ox + w] = caffe_io.resize_image(
-        image[vy0:vy1 + 1, vx0:vx1 + 1], (h, w))
+    if h > 0 and w > 0:
+        canvas[oy:oy + h, ox:ox + w] = caffe_io.resize_image(
+            image[vy0:vy1 + 1, vx0:vx1 + 1], (h, w))
     return canvas
 
 
@@ -159,10 +169,13 @@ def load_windows_file(path):
         <height>
         <width>
         <num windows>
-        <label> <overlap> <ymin> <xmin> <ymax> <xmax>   (x num windows)
+        <label> <overlap> <x1> <y1> <x2> <y2>   (x num windows)
 
-    Returns [(image_path, windows array of shape (n, 4))], dropping the
-    label/overlap columns (Detector scores windows; it does not train)."""
+    Returns [(image_path, windows array of shape (n, 4))] with windows
+    reordered to the Detector's (ymin, xmin, ymax, xmax) convention,
+    dropping the label/overlap columns (Detector scores windows; it does
+    not train). Field order per reference window_data_layer.cpp:51,118-120
+    ("class_index overlap x1 y1 x2 y2")."""
     images_windows = []
     with open(path) as f:
         lines = [ln.strip() for ln in f]
@@ -176,7 +189,8 @@ def load_windows_file(path):
         rows = []
         for j in range(n_windows):
             fields = lines[i + 6 + j].split()
-            rows.append([float(v) for v in fields[2:6]])
+            x1, y1, x2, y2 = (float(v) for v in fields[2:6])
+            rows.append([y1, x1, y2, x2])
         images_windows.append(
             (path_line, np.asarray(rows, dtype=np.float64).reshape(-1, 4)))
         i += 6 + n_windows

@@ -75,6 +75,10 @@ class Layer:
     type_name = "?"
     # Data-source layers produce tops from the host pipeline, not bottoms.
     is_data_source = False
+    # Loss layers may omit `top:` in the prototxt; the net auto-names the
+    # missing tops (reference layer.hpp AutoTopBlobs / net.cpp AppendTop
+    # with a NULL layer_param).
+    auto_top_blobs = False
 
     def __init__(self, layer_param, phase: int):
         self.lp = layer_param

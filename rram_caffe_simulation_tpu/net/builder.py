@@ -137,6 +137,15 @@ class Net:
                 bottom_shapes.append(produced[b])
                 consumed.add(b)
             top_shapes = layer.setup(bottom_shapes)
+            # AutoTopBlobs (reference net.cpp Init: append anonymous tops
+            # up to the layer's needed count for loss layers that omit
+            # `top:` in the prototxt)
+            if layer.auto_top_blobs and len(lp.top) < len(top_shapes):
+                for i in range(len(lp.top), len(top_shapes)):
+                    auto = "(automatic)"
+                    if auto in produced:
+                        auto = f"(automatic)_{lp.name}_{i}"
+                    lp.top.append(auto)
             for t, shape in zip(lp.top, top_shapes):
                 produced[t] = tuple(shape)
             if layer.is_data_source:

@@ -29,6 +29,8 @@ def _softmax(x, axis):
 class _LossLayer(Layer):
     """Base: first top defaults to loss_weight 1 (reference loss_layer.cpp:9)."""
 
+    auto_top_blobs = True
+
     def default_loss_weight(self, top_index: int) -> float:
         return 1.0 if top_index == 0 else 0.0
 

@@ -265,7 +265,7 @@ class LRNLayer(Layer):
                 window_strides=(1, 1, 1, 1),
                 padding=((0, 0), (0, 0), (half, half), (half, half)))
             scale = self.k + (self.alpha / (self.size * self.size)) * ssum
-        return [x * lax.pow(scale, -self.beta)], None
+        return [x * lax.pow(scale, jnp.asarray(-self.beta, scale.dtype))], None
 
 
 @register_layer("BatchNorm")

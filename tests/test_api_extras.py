@@ -96,12 +96,15 @@ def test_classifier_predict(tmp_path):
 
 def test_grow_window_hand_computed():
     from rram_caffe_simulation_tpu.api.detector import grow_window
-    # span (4, 5) about center (3.5, 5.0), doubled: radii (4, 5)
+    # inclusive spans (4, 5): center (2+2, 3+2.5) = (4, 5.5); doubled radii
+    # (4, 5) -> y [0, 8], x round([0.5, 10.5]) = [0, 10]
     np.testing.assert_array_equal(grow_window((2, 3, 5, 7), 2.0),
                                   [0, 0, 8, 10])
-    # factor 1 keeps an odd-span box fixed
+    # factor 1: center y0 + span/2 = 2.5, radius 1.5 -> [1, 4] (the grown
+    # region's upper edge is one past the inclusive ymax; the reference's
+    # center convention, detector.py:146-151)
     np.testing.assert_array_equal(grow_window((1, 1, 3, 3), 1.0),
-                                  [0, 0, 4, 4])
+                                  [1, 1, 4, 4])
 
 
 def test_render_region_interior():
@@ -155,9 +158,21 @@ def test_load_windows_file(tmp_path):
 """)
     parsed = load_windows_file(str(wf))
     assert [p for p, _ in parsed] == ["/images/a.jpg", "/images/b.jpg"]
+    # file stores x1 y1 x2 y2 (window_data_layer.cpp:51); Detector wants
+    # (ymin, xmin, ymax, xmax)
     np.testing.assert_array_equal(parsed[0][1],
-                                  [[10, 20, 110, 220], [5, 5, 50, 50]])
+                                  [[20, 10, 220, 110], [5, 5, 50, 50]])
     assert parsed[1][1].shape == (1, 4)
+
+
+def test_render_region_fully_outside():
+    """A region entirely off the image degrades to a border sliver scaled
+    over the canvas (plus fill), instead of crashing on an empty slice."""
+    from rram_caffe_simulation_tpu.api.detector import render_region
+    im = np.full((40, 48, 3), 3.0, np.float32)
+    out = render_region(im, np.array([50, 50, 60, 60]), 8, np.zeros(3))
+    assert out.shape == (8, 8, 3)
+    assert np.isfinite(out).all()
 
 
 def test_detector_end_to_end(tmp_path):
@@ -184,7 +199,9 @@ def test_detector_end_to_end(tmp_path):
     ).save(img_path)
 
     wf = tmp_path / "windows.txt"
-    wf.write_text("# 0\n%s\n3\n40\n48\n2\n1 0.9 4 6 20 30\n0 0.2 0 0 39 47\n"
+    # rows are x1 y1 x2 y2 on the 48-wide x 40-high image: an interior
+    # window and the full-image window
+    wf.write_text("# 0\n%s\n3\n40\n48\n2\n1 0.9 6 4 30 20\n0 0.2 0 0 47 39\n"
                   % img_path)
 
     from rram_caffe_simulation_tpu.api.detector import load_windows_file

@@ -151,3 +151,24 @@ def test_unknown_bottom_raises():
     """
     with pytest.raises(ValueError, match="unknown bottom"):
         Net(parse_net(text), phase=pb.TRAIN)
+
+
+def test_loss_layer_auto_top():
+    """A loss layer may omit `top:`; the net auto-names it and it still
+    carries loss_weight 1 (reference layer.hpp AutoTopBlobs / net.cpp
+    AppendTop with NULL layer_param)."""
+    net_param = parse_net("""
+    layer { name: "data" type: "Input" top: "data" top: "label"
+      input_param { shape { dim: 4 dim: 8 } shape { dim: 4 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+    """)
+    net = Net(net_param, pb.TRAIN)
+    assert net.loss_weights == {"(automatic)": 1.0}
+    params = net.init(jax.random.PRNGKey(0))
+    batch = {"data": jnp.zeros((4, 8), jnp.float32),
+             "label": jnp.zeros((4,), jnp.int32)}
+    blobs, loss = net.apply(params, batch)
+    assert float(loss) > 0.5  # ~ln(3) at init
+    assert "(automatic)" in blobs

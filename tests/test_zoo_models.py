@@ -1,0 +1,126 @@
+"""Smoke tests for the shipped model-zoo nets (reference: models/bvlc_alexnet,
+models/bvlc_googlenet — the published BVLC zoo definitions the framework must
+be able to build and train).
+
+GoogleNet is the layer-coverage stress test: LRN, concat towers, multi-loss
+with weighted auxiliary heads, TEST-phase top-k accuracy (VERDICT round 1,
+item 7)."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rram_caffe_simulation_tpu.data.db import array_to_datum
+from rram_caffe_simulation_tpu.data.lmdb_py import BulkWriter
+from rram_caffe_simulation_tpu.net import Net
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import io as uio
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _tiny_ilsvrc_lmdb(path, n=4):
+    """A 4-image stand-in for the ILSVRC12 LMDBs the zoo train_val protos
+    reference (256x256x3 Datums, like convert_imageset output)."""
+    rng = np.random.RandomState(0)
+    w = BulkWriter(str(path))
+    for i in range(n):
+        arr = rng.randint(0, 256, size=(3, 256, 256), dtype=np.uint8)
+        datum = array_to_datum(arr, label=int(rng.randint(1000)))
+        w.put(f"{i:08d}".encode(), datum.SerializeToString())
+    w.close()
+    return str(path)
+
+
+def _load_train_net(model, tmp_path, batch=2):
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", model, "train_val.prototxt"))
+    db = _tiny_ilsvrc_lmdb(tmp_path / "ilsvrc_lmdb")
+    for lp in npar.layer:
+        if lp.type == "Data":
+            lp.data_param.source = db
+            lp.data_param.batch_size = batch
+            # mean file isn't shipped; per-channel values suffice here
+            if lp.transform_param.HasField("mean_file"):
+                lp.transform_param.ClearField("mean_file")
+                lp.transform_param.mean_value.extend([104, 117, 123])
+    return Net(npar, pb.TRAIN)
+
+
+def _synthetic_batch(crop, batch=2):
+    rng = np.random.RandomState(1)
+    return {
+        "data": jnp.asarray(rng.randn(batch, 3, crop, crop), jnp.float32),
+        "label": jnp.asarray(rng.randint(0, 1000, size=(batch,))),
+    }
+
+
+@pytest.mark.parametrize("model,crop", [("bvlc_alexnet", 227),
+                                        ("bvlc_googlenet", 224)])
+def test_deploy_forward(model, crop):
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", model, "deploy.prototxt"))
+    npar.layer[0].input_param.shape[0].dim[0] = 2
+    net = Net(npar, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    blobs, _ = net.apply(params, _synthetic_batch(crop))
+    prob = np.asarray(blobs["prob"])
+    assert prob.shape == (2, 1000)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+    assert np.all(prob >= 0)
+
+
+def test_alexnet_train_backward(tmp_path):
+    net = _load_train_net("bvlc_alexnet", tmp_path)
+    params = net.init(jax.random.PRNGKey(0))
+    batch = _synthetic_batch(227)
+
+    def loss_fn(p):
+        _, loss = net.apply(p, batch, rng=jax.random.PRNGKey(1))
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    # grouped convs (conv2/4/5) and both FC dropout stages must all get grads
+    for lname in ["conv1", "conv2", "conv5", "fc6", "fc8"]:
+        g = np.asarray(grads[lname][0])
+        assert np.abs(g).sum() > 0, lname
+
+
+def test_googlenet_train_backward(tmp_path):
+    net = _load_train_net("bvlc_googlenet", tmp_path)
+    # three weighted losses: two aux heads at 0.3 + main at 1.0
+    assert len(net.loss_weights) == 3
+    params = net.init(jax.random.PRNGKey(0))
+    batch = _synthetic_batch(224)
+
+    def loss_fn(p):
+        _, loss = net.apply(p, batch, rng=jax.random.PRNGKey(1))
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    # gradient reaches the stem through all three loss heads, and each
+    # classifier head sees its own gradient
+    for lname in ["conv1/7x7_s2", "inception_3a/1x1", "inception_5b/1x1",
+                  "loss1/classifier", "loss2/classifier",
+                  "loss3/classifier"]:
+        g = np.asarray(grads[lname][0])
+        assert np.abs(g).sum() > 0, lname
+
+
+def test_googlenet_test_phase_has_topk(tmp_path):
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", "bvlc_googlenet", "train_val.prototxt"))
+    db = _tiny_ilsvrc_lmdb(tmp_path / "ilsvrc_lmdb")
+    for lp in npar.layer:
+        if lp.type == "Data":
+            lp.data_param.source = db
+            lp.data_param.batch_size = 2
+    net = Net(npar, pb.TEST)
+    names = {l.name for l in net.layers}
+    for head in ("loss1", "loss2", "loss3"):
+        assert f"{head}/top-1" in names
+        assert f"{head}/top-5" in names
