@@ -1,0 +1,122 @@
+"""Generate the siamese LeNet train/test prototxt with the net_spec DSL.
+
+Same capability as reference examples/siamese/mnist_siamese_train_test.prototxt:
+a 2-channel pair Datum is sliced into the two images, each runs through a
+LeNet-style tower whose weights are SHARED by param name (conv1_w, ...,
+feat_w), and a ContrastiveLoss (margin 1) pulls same-class embeddings
+together and pushes different-class ones apart. The twin tower exercises
+the net builder's named-param sharing table.
+
+Run:  python examples/siamese/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L, params as P  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def shared_param(stem):
+    """lr_mults per reference siamese recipe; sharing is by param name."""
+    return [dict(name=f"{stem}_w", lr_mult=1),
+            dict(name=f"{stem}_b", lr_mult=2)]
+
+
+def tower(n, data, suffix=""):
+    """LeNet embedding tower; `suffix` distinguishes blob/layer names while
+    param names stay identical so both towers share weights."""
+    s = suffix
+
+    n["conv1" + s] = L.Convolution(
+        data, num_output=20, kernel_size=5, stride=1,
+        param=shared_param("conv1"),
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"))
+    n["pool1" + s] = L.Pooling(n["conv1" + s], pool=P.Pooling.MAX,
+                               kernel_size=2, stride=2)
+    n["conv2" + s] = L.Convolution(
+        n["pool1" + s], num_output=50, kernel_size=5, stride=1,
+        param=shared_param("conv2"),
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"))
+    n["pool2" + s] = L.Pooling(n["conv2" + s], pool=P.Pooling.MAX,
+                               kernel_size=2, stride=2)
+    n["ip1" + s] = L.InnerProduct(
+        n["pool2" + s], num_output=500, param=shared_param("ip1"),
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"))
+    n["relu1" + s] = L.ReLU(n["ip1" + s], in_place=True)
+    n["ip2" + s] = L.InnerProduct(
+        n["ip1" + s], num_output=10, param=shared_param("ip2"),
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"))
+    n["feat" + s] = L.InnerProduct(
+        n["ip2" + s], num_output=2, param=shared_param("feat"),
+        weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"))
+    return n["feat" + s]
+
+
+def train_test(train_source, test_source, batch=64):
+    n = NetSpec()
+    n.pair_data, n.sim = L.Data(
+        ntop=2, name="pair_data",
+        include=dict(phase=pb.TRAIN),
+        transform_param=dict(scale=0.00390625),
+        data_param=dict(source=train_source, batch_size=batch,
+                        backend=P.Data.LMDB))
+    n.data, n.data_p = L.Slice(n.pair_data, ntop=2, name="slice_pair",
+                               slice_param=dict(slice_dim=1))
+    feat = tower(n, n.data)
+    feat_p = tower(n, n.data_p, suffix="_p")
+    n.loss = L.ContrastiveLoss(feat, feat_p, n.sim,
+                               contrastive_loss_param=dict(margin=1.0))
+    proto = n.to_proto()
+    proto.name = "mnist_siamese_train_test"
+    test_data = pb.LayerParameter()
+    test_data.name = "pair_data"
+    test_data.type = "Data"
+    test_data.top.extend(["pair_data", "sim"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.scale = 0.00390625
+    test_data.data_param.source = test_source
+    test_data.data_param.batch_size = batch
+    test_data.data_param.backend = pb.DataParameter.LMDB
+    proto.layer.insert(1, test_data)
+    return proto
+
+
+SOLVER = """\
+net: "examples/siamese/mnist_siamese_train_test.prototxt"
+test_iter: 4
+test_interval: 500
+base_lr: 0.01
+momentum: 0.9
+weight_decay: 0.0000
+lr_policy: "inv"
+gamma: 0.0001
+power: 0.75
+display: 100
+max_iter: 2000
+snapshot: 2000
+snapshot_prefix: "examples/siamese/snapshots/mnist_siamese"
+"""
+
+
+def main():
+    proto = train_test("examples/siamese/siamese_train_lmdb",
+                       "examples/siamese/siamese_test_lmdb")
+    with open(os.path.join(HERE, "mnist_siamese_train_test.prototxt"),
+              "w") as f:
+        f.write(str(proto))
+    with open(os.path.join(HERE, "mnist_siamese_solver.prototxt"), "w") as f:
+        f.write(SOLVER)
+    print("wrote mnist_siamese_train_test.prototxt, mnist_siamese_solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,7 @@ def inception(n, tag, bottom):
     return n[f"{p}/output"]
 
 
-def aux_head(n, idx, bottom, label, deploy):
+def aux_head(n, idx, bottom, label):
     """Auxiliary classifier head loss{idx} (train/val only)."""
     p = f"loss{idx}"
     n[f"{p}/ave_pool"] = L.Pooling(bottom, pool=P.Pooling.AVE,
@@ -117,12 +117,12 @@ def body(n, data, label=None, deploy=False):
                                   stride=2)
     x = inception(n, "4a", n["pool3/3x3_s2"])
     if not deploy:
-        aux_head(n, 1, x, label, deploy)
+        aux_head(n, 1, x, label)
     x = inception(n, "4b", x)
     x = inception(n, "4c", x)
     x = inception(n, "4d", x)
     if not deploy:
-        aux_head(n, 2, x, label, deploy)
+        aux_head(n, 2, x, label)
     x = inception(n, "4e", x)
     n["pool4/3x3_s2"] = L.Pooling(x, pool=P.Pooling.MAX, kernel_size=3,
                                   stride=2)

@@ -143,7 +143,7 @@ class Net:
             if layer.auto_top_blobs and len(lp.top) < len(top_shapes):
                 for i in range(len(lp.top), len(top_shapes)):
                     auto = "(automatic)"
-                    if auto in produced:
+                    if auto in produced or auto in lp.top:
                         auto = f"(automatic)_{lp.name}_{i}"
                     lp.top.append(auto)
             for t, shape in zip(lp.top, top_shapes):

@@ -49,6 +49,28 @@ def convert_mnist(images_path: str, labels_path: str, out_dir: str,
     return images.shape[0]
 
 
+def convert_mnist_siamese(images_path: str, labels_path: str, out_dir: str,
+                          backend: str = "lmdb", seed: int = 0) -> int:
+    """Pair each image with a uniformly random partner into one 2-channel
+    Datum whose label says whether the two digits are the same class
+    (reference examples/siamese/convert_mnist_siamese_data.cpp:52-85:
+    channels=2, label 1 = similar pair, 0 = dissimilar)."""
+    images = read_idx(images_path)
+    labels = read_idx(labels_path)
+    assert images.shape[0] == labels.shape[0]
+    rng = np.random.RandomState(seed)
+    n = images.shape[0]
+    partners = rng.randint(0, n, size=n)
+    with _bulk_writer(out_dir, backend) as w:
+        for i in range(n):
+            j = int(partners[i])
+            pair = np.stack([images[i], images[j]])  # (2, H, W)
+            sim = int(labels[i] == labels[j])
+            datum = array_to_datum(pair, sim)
+            w.put(b"%08d" % i, datum.SerializeToString())
+    return n
+
+
 def convert_cifar10(batch_files, out_dir: str,
                     backend: str = "lmdb") -> int:
     """CIFAR-10 binary batches: per record 1 label byte + 3072 image bytes
@@ -113,6 +135,9 @@ def main(argv=None):
     sub = p.add_subparsers(dest="cmd", required=True)
     m = sub.add_parser("mnist")
     m.add_argument("images"); m.add_argument("labels"); m.add_argument("out")
+    ms = sub.add_parser("mnist_siamese")
+    ms.add_argument("images"); ms.add_argument("labels"); ms.add_argument("out")
+    ms.add_argument("--seed", type=int, default=0)
     c = sub.add_parser("cifar10")
     c.add_argument("out"); c.add_argument("batches", nargs="+")
     i = sub.add_parser("imageset")
@@ -123,12 +148,15 @@ def main(argv=None):
     i.add_argument("--shuffle", action="store_true")
     mm = sub.add_parser("mean")
     mm.add_argument("db"); mm.add_argument("out")
-    for s in (m, c, i):
+    for s in (m, ms, c, i):
         s.add_argument("--backend", choices=["lmdb", "leveldb"],
                        default="lmdb")
     a = p.parse_args(argv)
     if a.cmd == "mnist":
         n = convert_mnist(a.images, a.labels, a.out, a.backend)
+    elif a.cmd == "mnist_siamese":
+        n = convert_mnist_siamese(a.images, a.labels, a.out, a.backend,
+                                  seed=a.seed)
     elif a.cmd == "cifar10":
         n = convert_cifar10(a.batches, a.out, a.backend)
     elif a.cmd == "imageset":

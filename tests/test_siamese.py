@@ -1,0 +1,101 @@
+"""Siamese workflow units: pair converter + shared-weight twin towers +
+ContrastiveLoss training step (reference examples/siamese/)."""
+import os
+import struct
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from rram_caffe_simulation_tpu.data.db import datum_to_array
+from rram_caffe_simulation_tpu.data import lmdb_py
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.net import Net
+from rram_caffe_simulation_tpu.tools.converters import convert_mnist_siamese
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _write_idx(path, arr):
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x0800 | arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def test_convert_mnist_siamese(tmp_path):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, size=(20, 8, 8), dtype=np.uint8)
+    labels = np.arange(20, dtype=np.uint8) % 3
+    _write_idx(tmp_path / "imgs", images)
+    _write_idx(tmp_path / "lbls", labels)
+    out = str(tmp_path / "pairs_lmdb")
+    n = convert_mnist_siamese(str(tmp_path / "imgs"), str(tmp_path / "lbls"),
+                              out, seed=1)
+    assert n == 20
+    env = lmdb_py.Environment(out)
+    partners = np.random.RandomState(1).randint(0, 20, size=20)
+    count = 0
+    for key, value in env.items():
+        i = int(key.decode())
+        datum = pb.Datum()
+        datum.ParseFromString(value)
+        arr, label = datum_to_array(datum)
+        assert arr.shape == (2, 8, 8)  # the pair rides the channel axis
+        np.testing.assert_array_equal(arr[0], images[i])
+        np.testing.assert_array_equal(arr[1], images[partners[i]])
+        assert label == int(labels[i] == labels[partners[i]])
+        count += 1
+    assert count == 20
+    env.close()
+
+
+def test_siamese_towers_share_weights_and_train():
+    """Both towers must resolve to ONE set of owner params (by param name),
+    and a contrastive step must move embeddings of a dissimilar pair
+    apart."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "siamese_gen", os.path.join(REPO, "examples", "siamese",
+                                    "generate.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    proto = gen.train_test("unused_train", "unused_test", batch=4)
+    # swap the Data layers for an Input so no LMDB is needed
+    keep = [lp for lp in proto.layer if lp.type != "Data"]
+    inp = pb.LayerParameter()
+    inp.name = "pair_data"
+    inp.type = "Input"
+    inp.top.extend(["pair_data", "sim"])
+    s1 = inp.input_param.shape.add()
+    s1.dim.extend([4, 2, 28, 28])
+    s2 = inp.input_param.shape.add()
+    s2.dim.extend([4])
+    del proto.layer[:]
+    proto.layer.append(inp)
+    proto.layer.extend(keep)
+
+    net = Net(proto, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    # tower 2's layers own no parameters; they alias tower 1's by name
+    owners = {(r.owner_layer, r.owner_slot) for r in net.learnable_params}
+    assert ("conv1_p", 0) not in owners
+    assert ("conv1", 0) in owners
+
+    rng = np.random.RandomState(0)
+    batch = {"pair_data": jnp.asarray(rng.rand(4, 2, 28, 28), jnp.float32),
+             "sim": jnp.zeros((4,), jnp.float32)}  # all dissimilar
+
+    def loss_fn(p):
+        _, loss = net.apply(p, batch)
+        return loss
+
+    loss0, grads = jax.value_and_grad(loss_fn)(params)
+    # gradient flows through BOTH towers into the single shared copy
+    assert np.abs(np.asarray(grads["conv1"][0])).sum() > 0
+    assert all(np.abs(np.asarray(g)).sum() == 0
+               for g in grads.get("conv1_p", [np.zeros(1)]))
+    params2 = jax.tree.map(lambda a, b: a - 0.1 * b, params, grads)
+    loss1 = float(loss_fn(params2))
+    assert loss1 < float(loss0)  # margin loss pushes dissimilar pairs apart
