@@ -112,12 +112,19 @@ class EuclideanLossLayer(_LossLayer):
     """sum((a-b)^2) / (2 * batch) (reference euclidean_loss_layer.cpp:20-27)."""
 
     def setup(self, bottom_shapes):
+        a, b = bottom_shapes[0], bottom_shapes[1]
+        if int(np.prod(a)) != int(np.prod(b)):
+            # reference euclidean_loss_layer.cpp:12 CHECK_EQ(count, count);
+            # silent numpy broadcasting here would compute a different loss
+            raise ValueError(
+                f"EuclideanLoss {self.name!r}: inputs must have the same "
+                f"count, got {a} vs {b}")
         self.num = bottom_shapes[0][0]
         self.top_shapes = [()]
         return self.top_shapes
 
     def apply(self, params, bottoms, ctx):
-        d = bottoms[0] - bottoms[1]
+        d = bottoms[0] - bottoms[1].reshape(bottoms[0].shape)
         return [jnp.sum(d * d) / (2.0 * self.num)], None
 
 

@@ -186,6 +186,76 @@ def time(args):
 
 
 @register
+def extract_features(args):
+    """tools/extract_features.cpp:63-180 — forward a trained net over N
+    mini-batches and dump named blobs to Datum databases (float_data,
+    %010d keys, one DB per blob).
+
+    Usage: extract_features <weights> <net.prototxt>
+           <blob1[,blob2,...]> <db1[,db2,...]> <num_mini_batches>
+           [lmdb|leveldb]
+    """
+    import jax
+    from ..data.feed import build_feed
+    from ..net import Net
+    from ..proto import pb
+    from ..utils.io import read_net_param
+    a = args.args
+    if len(a) < 5:
+        sys.exit("usage: extract_features <weights> <net.prototxt> "
+                 "<blob1[,...]> <db1[,...]> <num_mini_batches> "
+                 "[lmdb|leveldb]")
+    weights, proto, blob_arg, db_arg, n_batches = a[:5]
+    db_type = a[5] if len(a) > 5 else "lmdb"
+    blob_names = blob_arg.split(",")
+    db_names = db_arg.split(",")
+    if len(blob_names) != len(db_names):
+        sys.exit("the number of blobs and datasets must be equal")
+    net = Net(read_net_param(proto), pb.TEST)
+    for b in blob_names:
+        if b not in net.blob_shapes:
+            sys.exit(f"Unknown feature blob name {b} in the network")
+    params = net.init(jax.random.PRNGKey(0))
+    params = net.copy_trained_from(params, weights)
+    feed = build_feed(net)
+
+    if db_type == "leveldb":
+        from ..data.leveldb_py import BulkWriter
+    else:
+        from ..data.lmdb_py import BulkWriter
+    writers = [BulkWriter(name) for name in db_names]
+
+    def _named_blobs(p, b):
+        blobs, _ = net.apply(p, b)
+        return {n: blobs[n] for n in blob_names}
+    fwd = jax.jit(_named_blobs)
+    print("Extracting Features", file=sys.stderr)
+    index = 0
+    for _ in range(int(n_batches)):
+        batch = feed()
+        out = fwd(params, batch)
+        feats = {n: np.asarray(v) for n, v in out.items()}
+        batch_size = next(iter(feats.values())).shape[0]
+        for n_img in range(batch_size):
+            for bname, w in zip(blob_names, writers):
+                f = feats[bname][n_img]
+                datum = pb.Datum()
+                if f.ndim >= 3:
+                    datum.channels, datum.height, datum.width = f.shape[-3:]
+                else:
+                    datum.channels, datum.height, datum.width = f.size, 1, 1
+                datum.float_data.extend(np.ravel(f).tolist())
+                w.put(b"%010d" % index, datum.SerializeToString())
+            index += 1
+    for bname, w in zip(blob_names, writers):
+        w.close()
+        print(f"Extracted features of {index} query images for feature "
+              f"blob {bname}", file=sys.stderr)
+    print("Successfully extracted the features!", file=sys.stderr)
+    return 0
+
+
+@register
 def upgrade_net_proto_text(args):
     """tools/upgrade_net_proto_text.cpp — migrate a legacy prototxt to the
     current schema. Usage: upgrade_net_proto_text IN OUT."""
@@ -247,7 +317,7 @@ def main(argv=None):
         epilog="commands: " + ", ".join(sorted(BREW)))
     p.add_argument("command", choices=sorted(BREW))
     p.add_argument("args", nargs="*",
-                   help="positional args for the upgrade_* commands")
+                   help="positional args for the upgrade_* and extract_features commands")
     p.add_argument("--solver", default="")
     p.add_argument("--model", default="")
     p.add_argument("--snapshot", default="")
@@ -263,7 +333,9 @@ def main(argv=None):
     p.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
     args = p.parse_args(argv)
-    if args.args and not args.command.startswith("upgrade_"):
+    takes_positional = (args.command.startswith("upgrade_")
+                        or args.command == "extract_features")
+    if args.args and not takes_positional:
         p.error(f"unrecognized arguments: {' '.join(args.args)}")
     return BREW[args.command](args)
 

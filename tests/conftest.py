@@ -1,26 +1,72 @@
 """Test harness config.
 
 The reference runs every test over a {CPU, GPU} x {float, double} matrix
-(test_caffe_main.hpp:31-72). Here the backend matrix is handled by JAX: tests
-run on the CPU backend with an 8-device virtual mesh so every sharding path
-compiles and executes exactly as it would across a real TPU slice.
+(test_caffe_main.hpp:31-72). Here the backend matrix is handled by JAX:
+by default tests run on the CPU backend with an 8-device virtual mesh so
+every sharding path compiles and executes exactly as it would across a
+real TPU slice, and `pytest -m tpu --tpu` runs the @pytest.mark.tpu
+on-device numerics subset against the real TPU backend at f32 (the
+CPU/GPU -> CPU/TPU half of the reference's matrix).
 """
 import os
 import sys
 
-# Force CPU: the session presets JAX_PLATFORMS=axon (real TPU) and its
-# sitecustomize registers the axon backend in every process, so the env var
-# alone is not enough — the config update below overrides it. Tests run on a
-# deterministic 8-device virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+import pytest
+
+# --tpu must steer the platform BEFORE jax initializes, which happens at
+# collection time — so branch on argv here rather than in an option hook.
+RUN_ON_TPU = "--tpu" in sys.argv
+
+if not RUN_ON_TPU:
+    # Force CPU: the session presets JAX_PLATFORMS=axon (real TPU) and its
+    # sitecustomize registers the axon backend in every process, so the env
+    # var alone is not enough — the config update below overrides it. Tests
+    # run on a deterministic 8-device virtual CPU mesh.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)  # float64 available for grad checks
+if not RUN_ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)  # f64 for gradient checks
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--tpu", action="store_true", default=False,
+        help="run on the real TPU backend (use with `-m tpu`); "
+             "without it, @pytest.mark.tpu tests are skipped")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: on-device numerics tests (need --tpu and a chip)")
+    # The argv sniff above must agree with pytest's parsed option: with
+    # --tpu hidden in addopts or a programmatic pytest.main() list, the env
+    # setup would silently run the "on-device" suite on the forced-CPU
+    # mesh. Fail loudly instead.
+    if bool(config.getoption("--tpu")) != RUN_ON_TPU:
+        raise pytest.UsageError(
+            "--tpu must be passed on the pytest command line itself (it "
+            "steers JAX platform selection before pytest parses options)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--tpu"):
+        skip = pytest.mark.skip(
+            reason="--tpu run executes only @pytest.mark.tpu tests "
+                   "(CPU-matrix tests assume the virtual 8-device mesh)")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(reason="needs --tpu (real TPU backend)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)
