@@ -1,0 +1,92 @@
+"""Sweep-scaling measurement: CIFAR-10-quick RRAM fault sweep throughput
+vs n_configs on the available chips (BASELINE north star: 1000-config
+5k-iter sweep < 10 min on a v4-8).
+
+Measures steady-state vmapped-step wall time at the reference operating
+point (batch 100, lifetimes ~ N(mean, std)) for a ladder of config counts,
+prints configs/hour for the 5k-iter contract, and the projection to 8
+chips (the config axis is embarrassingly parallel: zero cross-config
+collectives, so 8 chips run 8x the configs at the same step time, minus
+the measured data-sharding overhead).
+
+    python examples/gaussian_failure/bench_sweep.py [--iters 60] [--configs 16,64,128]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..", "..")
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=60)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--configs", default="16,64,128")
+    p.add_argument("--chunk", type=int, default=10,
+                   help="iterations scanned per device dispatch")
+    p.add_argument("--mean", type=float, default=1e8)
+    p.add_argument("--std", type=float, default=3e7)
+    p.add_argument("--contract-iters", type=int, default=5000,
+                   help="iters per config in the sweep contract")
+    args = p.parse_args(argv)
+
+    os.chdir(REPO)
+    import jax
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.utils.io import read_solver_param
+
+    results = []
+    for n_cfg in [int(c) for c in args.configs.split(",")]:
+        param = read_solver_param(
+            "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt")
+        param.failure_pattern.type = "gaussian"
+        param.failure_pattern.mean = args.mean
+        param.failure_pattern.std = args.std
+        param.random_seed = 7
+        param.display = 0
+        solver = Solver(param)
+        runner = SweepRunner(solver, n_configs=n_cfg)
+        runner.step(max(args.warmup, args.chunk), chunk=args.chunk)
+        jax.block_until_ready(runner.params)
+        t0 = time.perf_counter()
+        loss, _ = runner.step(args.iters, chunk=args.chunk)
+        jax.block_until_ready(runner.params)
+        dt = time.perf_counter() - t0
+        steps_per_s = args.iters / dt
+        cfg_hours = n_cfg * steps_per_s * 3600 / args.contract_iters
+        img_s = n_cfg * steps_per_s * 100
+        results.append({
+            "n_configs": n_cfg, "steps_per_s": round(steps_per_s, 2),
+            "img_per_s_per_chip": round(img_s),
+            "configs_per_hour_per_chip": round(cfg_hours, 1),
+            "minutes_for_1000_configs_1chip":
+                round(1000 / cfg_hours * 60, 1),
+            "loss_finite": bool(np.isfinite(loss).all()),
+        })
+        print(json.dumps(results[-1]))
+
+    best = max(results, key=lambda r: r["configs_per_hour_per_chip"])
+    proj = {
+        "projection": "v4-8 (8 chips, config axis sharded)",
+        "basis_n_configs_per_chip": best["n_configs"],
+        "minutes_for_1000_configs_8chips":
+            round(1000 / (8 * best["configs_per_hour_per_chip"]) * 60, 1),
+        "target_minutes": 10,
+    }
+    proj["meets_target"] = (
+        proj["minutes_for_1000_configs_8chips"] < proj["target_minutes"])
+    print(json.dumps(proj))
+    return results, proj
+
+
+if __name__ == "__main__":
+    main()

@@ -113,12 +113,13 @@ class EuclideanLossLayer(_LossLayer):
 
     def setup(self, bottom_shapes):
         a, b = bottom_shapes[0], bottom_shapes[1]
-        if int(np.prod(a)) != int(np.prod(b)):
-            # reference euclidean_loss_layer.cpp:12 CHECK_EQ(count, count);
-            # silent numpy broadcasting here would compute a different loss
+        # reference euclidean_loss_layer.cpp:12 CHECK_EQ on the per-sample
+        # count; silent numpy broadcasting (or a total-count-only check
+        # letting (8,3) pair with (4,6)) would mix samples across entries
+        if a[0] != b[0] or int(np.prod(a[1:])) != int(np.prod(b[1:])):
             raise ValueError(
-                f"EuclideanLoss {self.name!r}: inputs must have the same "
-                f"count, got {a} vs {b}")
+                f"EuclideanLoss {self.name!r}: inputs must agree in batch "
+                f"size and per-sample count, got {a} vs {b}")
         self.num = bottom_shapes[0][0]
         self.top_shapes = [()]
         return self.top_shapes

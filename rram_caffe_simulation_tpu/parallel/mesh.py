@@ -38,9 +38,11 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def data_sharding(mesh: Mesh, axis: str = "data",
-                  ndim: int = 1) -> NamedSharding:
-    """Shard the leading (batch) dim over `axis`, replicate the rest."""
-    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+                  ndim: int = 1, lead: int = 0) -> NamedSharding:
+    """Shard the batch dim (axis `lead`, usually 0) over `axis`, replicate
+    the rest. `lead` > 0 skips leading stacking axes (e.g. a scan chunk)."""
+    return NamedSharding(mesh, P(*([None] * lead), axis,
+                                 *([None] * (ndim - lead - 1))))
 
 
 def config_sharding(mesh: Mesh, axis: str = "config",

@@ -56,7 +56,7 @@ class SweepRunner:
     """
 
     def __init__(self, solver, n_configs: int, mesh=None, means=None,
-                 stds=None):
+                 stds=None, preload: bool = True):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -89,18 +89,148 @@ class SweepRunner:
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
+        self._vstep = vstep
+        self._chunk_fns = {}
         self._eval_fns = {}
+        self._dataset = None
+        self._ds_batch = 0
+        self._ds_n = 0
+        if preload:
+            self._try_preload()
         self._place()
+        # One feed instance for every host path (chunked or not) so the
+        # cursor advances consistently across mixed step() calls. The
+        # default feed is built RAW (no prefetch device_put): chunked
+        # stacking needs host arrays, and a device_put'd batch would pay a
+        # D2H round-trip before re-upload.
+        if solver.custom_train_feed:
+            self._feed = solver.train_feed
+        elif self._dataset is None:
+            from ..data.feed import build_feed
+            self._feed = build_feed(solver.net, prefetch=False)
+        else:
+            self._feed = None
+
+    def _host_batch(self):
+        """One training batch as host arrays, with iter_size sub-batches
+        stacked on a leading axis (mirrors Solver._next_batch)."""
+        iter_size = max(self.solver.param.iter_size, 1)
+        if iter_size == 1:
+            return {k: np.asarray(v) for k, v in self._feed().items()}
+        subs = [self._feed() for _ in range(iter_size)]
+        return {k: np.stack([np.asarray(s[k]) for s in subs])
+                for k in subs[0]}
+
+    def _try_preload(self):
+        """Upload the whole training set to device once when it's small and
+        the transform is deterministic; batches are then gathered on-device
+        by iteration index, removing per-step host->device transfers (see
+        feed.materialize_data_source). Skipped when the caller supplied a
+        custom train_feed (its batches are authoritative, not the DB) or
+        uses iter_size accumulation (the host feed path stacks those)."""
+        from ..data.feed import materialize_data_source
+        if getattr(self.solver, "custom_train_feed", False):
+            return
+        if max(self.solver.param.iter_size, 1) > 1:
+            return
+        src_layers = [l for l in self.solver.net.layers if l.is_data_source]
+        if len(src_layers) != 1 or src_layers[0].type_name != "Data":
+            return
+        arrays = materialize_data_source(src_layers[0])
+        if arrays is None:
+            return
+        self._ds_batch = int(src_layers[0].lp.data_param.batch_size)
+        self._ds_n = next(iter(arrays.values())).shape[0]
+        # host arrays here; _place() device_puts them with the mesh layout
+        self._dataset = arrays
+
+    def _chunk_fn(self, k: int):
+        """One dispatch = k scanned sweep iterations. On a tunneled/remote
+        runtime each dispatch pays a fixed round-trip; scanning k steps
+        under one jit amortizes it (measured: the per-dispatch overhead,
+        not compute, capped the single-chip sweep rate). With a preloaded
+        device dataset the batch is gathered on-device by iteration index
+        instead of riding the host->device path each step."""
+        key = (k, self._dataset is not None)
+        if key not in self._chunk_fns:
+            n = self.n
+
+            def inner(params, history, fault, batch_t, it_t, remap_t):
+                rngs = jax.vmap(
+                    lambda i: jax.random.fold_in(
+                        jax.random.fold_in(self.solver._key, it_t), i))(
+                            jnp.arange(n))
+                return self._vstep(params, history, fault, batch_t, it_t,
+                                   rngs, remap_t)
+
+            if self._dataset is None:
+                def one(carry, xs):
+                    params, history, fault = carry
+                    batch_t, it_t, remap_t = xs
+                    p2, h2, f2, loss, outputs = inner(
+                        params, history, fault, batch_t, it_t, remap_t)
+                    return (p2, h2, f2), (loss, outputs)
+
+                def run(params, history, fault, batches, its, remaps):
+                    (p, h, f), (losses, outputs) = jax.lax.scan(
+                        one, (params, history, fault),
+                        (batches, its, remaps))
+                    return p, h, f, losses, outputs
+            else:
+                B, N = self._ds_batch, self._ds_n
+
+                def one(carry, xs):
+                    params, history, fault = carry
+                    it_t, remap_t = xs
+                    # sequential wrap-around order == the host cursor feed
+                    idx = (it_t * B + jnp.arange(B)) % N
+                    batch_t = {name: arr[idx]
+                               for name, arr in self._dataset.items()}
+                    if self._batch_sharding is not None:
+                        batch_t = {
+                            name: jax.lax.with_sharding_constraint(
+                                v, self._batch_sharding(v.ndim))
+                            for name, v in batch_t.items()}
+                    p2, h2, f2, loss, outputs = inner(
+                        params, history, fault, batch_t, it_t, remap_t)
+                    return (p2, h2, f2), (loss, outputs)
+
+                def run(params, history, fault, its, remaps):
+                    (p, h, f), (losses, outputs) = jax.lax.scan(
+                        one, (params, history, fault), (its, remaps))
+                    return p, h, f, losses, outputs
+
+            self._chunk_fns[key] = jax.jit(run, donate_argnums=(0, 1, 2))
+        return self._chunk_fns[key]
 
     def _place(self):
-        from .mesh import config_sharding
-        if "config" not in self.mesh.axis_names:
-            return
-        shard0 = lambda x: jax.device_put(
-            x, config_sharding(self.mesh, ndim=x.ndim))
-        self.params = jax.tree.map(shard0, self.params)
-        self.history = jax.tree.map(shard0, self.history)
-        self.fault_states = jax.tree.map(shard0, self.fault_states)
+        from .mesh import config_sharding, data_sharding
+        has_config = "config" in self.mesh.axis_names
+        has_data = "data" in self.mesh.axis_names
+        # The shared batch rides the orthogonal "data" axis: its batch dim
+        # is split across data-axis devices and replicated across
+        # config-axis devices, so a (config, data) mesh trains
+        # n_configs x (batch/data) shards with no host duplication.
+        self._batch_sharding = (
+            (lambda ndim, lead=0: data_sharding(self.mesh, ndim=ndim,
+                                                lead=lead))
+            if has_config and has_data else None)
+        if has_config:
+            shard0 = lambda x: jax.device_put(
+                x, config_sharding(self.mesh, ndim=x.ndim))
+            self.params = jax.tree.map(shard0, self.params)
+            self.history = jax.tree.map(shard0, self.history)
+            self.fault_states = jax.tree.map(shard0, self.fault_states)
+        if self._dataset is not None:
+            # rows sharded over "data" when present (HBM cost scales down
+            # with the mesh instead of replicating the whole dataset);
+            # otherwise replicated explicitly.
+            if self._batch_sharding is not None:
+                put = lambda v: jax.device_put(
+                    jnp.asarray(v), data_sharding(self.mesh, ndim=v.ndim))
+            else:
+                put = jnp.asarray
+            self._dataset = {k: put(v) for k, v in self._dataset.items()}
 
     def _remap_due(self) -> bool:
         """Same start/period gating as Solver._remap_due — remapping stays
@@ -112,21 +242,74 @@ class SweepRunner:
         return times >= st.remap_start and (
             (times - st.remap_start) % st.remap_period == 0)
 
-    def step(self, iters: int = 1):
+    def step(self, iters: int = 1, chunk: int = 1):
+        """Run `iters` sweep iterations; `chunk` > 1 scans that many
+        iterations per device dispatch (fresh host batch per iteration
+        either way). Returns (last-iter per-config loss, last-iter
+        outputs)."""
         s = self.solver
-        for _ in range(iters):
-            batch = s._next_batch()
-            rngs = jax.vmap(
-                lambda i: jax.random.fold_in(
-                    jax.random.fold_in(s._key, self.iter), i))(
-                        jnp.arange(self.n))
-            (self.params, self.history, self.fault_states, loss,
-             outputs) = self._step(self.params, self.history,
-                                   self.fault_states, batch,
-                                   jnp.int32(self.iter), rngs,
-                                   self._remap_due())
-            self.iter += 1
-        return np.asarray(loss), jax.tree.map(np.asarray, outputs)
+        if self._dataset is not None:
+            done = 0
+            while done < iters:
+                k = min(max(chunk, 1), iters - done)
+                its, remaps = [], []
+                for _ in range(k):
+                    its.append(self.iter)
+                    remaps.append(self._remap_due())
+                    self.iter += 1
+                (self.params, self.history, self.fault_states, losses,
+                 outputs) = self._chunk_fn(k)(
+                    self.params, self.history, self.fault_states,
+                    jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
+                done += k
+            return (np.asarray(losses)[-1],
+                    jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
+        if chunk <= 1:
+            for _ in range(iters):
+                batch = self._placed(self._host_batch())
+                rngs = jax.vmap(
+                    lambda i: jax.random.fold_in(
+                        jax.random.fold_in(s._key, self.iter), i))(
+                            jnp.arange(self.n))
+                (self.params, self.history, self.fault_states, loss,
+                 outputs) = self._step(self.params, self.history,
+                                       self.fault_states, batch,
+                                       jnp.int32(self.iter), rngs,
+                                       self._remap_due())
+                self.iter += 1
+            return np.asarray(loss), jax.tree.map(np.asarray, outputs)
+
+        done = 0
+        while done < iters:
+            k = min(chunk, iters - done)
+            subs, its, remaps = [], [], []
+            for _ in range(k):
+                subs.append(self._host_batch())
+                its.append(self.iter)
+                remaps.append(self._remap_due())
+                self.iter += 1
+            batches = self._placed(
+                {kk: np.stack([sb[kk] for sb in subs]) for kk in subs[0]},
+                stacked=True)
+            (self.params, self.history, self.fault_states, losses,
+             outputs) = self._chunk_fn(k)(
+                self.params, self.history, self.fault_states, batches,
+                jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
+            done += k
+        return (np.asarray(losses)[-1],
+                jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
+
+    def _placed(self, batch, stacked: bool = False):
+        """Device-place a host batch; under a (config, data) mesh the batch
+        dim shards over "data". Leading chunk and iter_size axes (when
+        present) stay unsharded in front of it."""
+        if self._batch_sharding is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        lead = (1 if stacked else 0) + (
+            1 if max(self.solver.param.iter_size, 1) > 1 else 0)
+        return {k: jax.device_put(
+            jnp.asarray(v), self._batch_sharding(jnp.asarray(v).ndim, lead))
+            for k, v in batch.items()}
 
     def broken_fractions(self) -> np.ndarray:
         """Per-config broken-cell census."""
@@ -147,3 +330,48 @@ class SweepRunner:
                 jax.vmap(run, in_axes=(0, None)))
         out = self._eval_fns[id(net)](self.params, batch)
         return {k: np.asarray(v) for k, v in out.items()}
+
+
+def sequential_sweep(solver_param, configs, iters, eval_iters: int = 0):
+    """Per-config fallback driver: one full Solver per fault config, run
+    sequentially — the vmap-free path that supports EVERY strategy,
+    including genetic (host-side search, excluded from SweepRunner).
+
+    Semantics match the reference's sweep workflow of one `caffe train`
+    process per config (run_different_mean.sh), minus the process
+    boundary. `configs` is a list of dicts applied onto a copy of
+    `solver_param` before each run: keys "mean"/"std" override
+    failure_pattern, "seed" overrides random_seed; anything else must be a
+    SolverParameter field name.
+
+    Returns a list of per-config records: {"config", "loss" (final
+    smoothed), "scores" (test-net outputs if eval_iters), "broken"}.
+    """
+    from ..fault import engine as fault_engine
+    from ..proto import pb
+    from ..solver import Solver
+
+    results = []
+    for i, cfg in enumerate(configs):
+        param = pb.SolverParameter.FromString(
+            solver_param.SerializeToString())
+        for k, v in cfg.items():
+            if k == "mean":
+                param.failure_pattern.mean = float(v)
+            elif k == "std":
+                param.failure_pattern.std = float(v)
+            elif k == "seed":
+                param.random_seed = int(v)
+            else:
+                setattr(param, k, v)
+        solver = Solver(param)
+        solver.step(iters)
+        rec = {"config": dict(cfg),
+               "loss": solver._materialize_smoothed_loss()}
+        if solver.fault_state is not None:
+            rec["broken"] = float(
+                fault_engine.broken_fraction(solver.fault_state))
+        if eval_iters and solver.test_nets:
+            rec["scores"] = solver.test(0)
+        results.append(rec)
+    return results

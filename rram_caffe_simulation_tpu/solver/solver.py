@@ -126,6 +126,7 @@ class Solver:
             hidden_sizes=hidden_sizes)
 
         # --- data feeds ---
+        self.custom_train_feed = train_feed is not None
         self.train_feed = train_feed or self._default_feed(self.net)
         if test_feeds is None:
             test_feeds = [self._default_feed(tn) for tn in self.test_nets]
