@@ -36,6 +36,8 @@ def main(argv=None):
     p.add_argument("--contract-iters", type=int, default=5000,
                    help="iters per config in the sweep contract")
     args = p.parse_args(argv)
+    # a trailing partial chunk would jit-compile inside the timed window
+    args.iters = max(args.iters // args.chunk, 1) * args.chunk
 
     os.chdir(REPO)
     import jax

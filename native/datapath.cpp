@@ -1,0 +1,303 @@
+// Native data path: LMDB page walk + Datum protobuf decode + transform.
+//
+// The reference's input pipeline is native C++ (util/db_lmdb.cpp over
+// liblmdb, Datum decode via C++ protobuf, data_transformer.cpp); this is
+// the TPU framework's native equivalent, exposed over a plain C ABI and
+// loaded via ctypes (pybind11 is not available in the build image). The
+// Python reader (data/lmdb_py.py) stays as the portable fallback and the
+// writer; this library accelerates the hot read+decode+transform path.
+//
+// LMDB 0.9 on-disk layout implemented here (struct layout per lmdb's
+// public docs, mirroring data/lmdb_py.py):
+//   page header 16B: pgno u64 | pad u16 | flags u16 | lower u16 | upper u16
+//   node header 8B:  lo u16 | hi u16 | flags u16 | ksize u16
+//     leaf:   datasize = lo | hi<<16; F_BIGDATA(0x01) -> overflow pgno u64
+//     branch: child pgno = lo | hi<<16 | flags<<32
+//   meta at +16 on pages 0/1: magic u32 | version u32 | addr u64 |
+//     mapsize u64 | free_db[48] | main_db[48] | last_pg u64 | txnid u64
+//   db record 48B: pad u32 | flags u16 | depth u16 | branch u64 | leaf u64 |
+//     overflow u64 | entries u64 | root u64
+//
+// Datum wire format (proto/caffe.proto message Datum):
+//   1: channels varint   2: height varint   3: width varint
+//   4: data bytes        5: label varint    6: float_data (packed/repeated)
+//   7: encoded varint
+//
+// Transform semantics (data_transformer.cpp:19-150 order): subtract
+// full-size mean (blob or per-channel value), center-crop (TEST), scale.
+// Random TRAIN crop/mirror stay on the Python path.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kPage = 4096;
+constexpr uint32_t kMagic = 0xBEEFC0DE;
+constexpr uint32_t kVersion = 1;
+constexpr uint16_t kPBranch = 0x01;
+constexpr uint16_t kPLeaf = 0x02;
+constexpr uint16_t kPMeta = 0x08;
+constexpr uint16_t kFBigData = 0x01;
+constexpr uint64_t kInvalid = ~0ULL;
+
+inline uint16_t rd16(const uint8_t* p) { uint16_t v; std::memcpy(&v, p, 2); return v; }
+inline uint32_t rd32(const uint8_t* p) { uint32_t v; std::memcpy(&v, p, 4); return v; }
+inline uint64_t rd64(const uint8_t* p) { uint64_t v; std::memcpy(&v, p, 8); return v; }
+
+struct Record { uint64_t off; uint64_t len; };
+
+struct Env {
+  int fd = -1;
+  const uint8_t* mm = nullptr;
+  size_t size = 0;
+  std::vector<Record> records;   // in key order
+  std::string error;
+};
+
+thread_local std::string g_error;
+
+bool walk(Env* e, uint64_t root) {
+  if (root == kInvalid) return true;   // empty DB
+  std::vector<std::pair<uint64_t, uint32_t>> stack{{root, 0}};
+  while (!stack.empty()) {
+    auto [pgno, idx] = stack.back();
+    stack.pop_back();
+    if ((pgno + 1) * kPage > e->size) { e->error = "page out of range"; return false; }
+    const uint8_t* pg = e->mm + pgno * kPage;
+    uint16_t flags = rd16(pg + 10), lower = rd16(pg + 12);
+    uint32_t n = (lower - 16) / 2;
+    if (flags & kPLeaf) {
+      for (uint32_t i = 0; i < n; ++i) {
+        uint16_t ptr = rd16(pg + 16 + 2 * i);
+        const uint8_t* node = pg + ptr;
+        uint16_t lo = rd16(node), hi = rd16(node + 2),
+                 nflags = rd16(node + 4), ksize = rd16(node + 6);
+        uint64_t datasize = uint64_t(lo) | (uint64_t(hi) << 16);
+        if (nflags & kFBigData) {
+          uint64_t ovf = rd64(node + 8 + ksize);
+          e->records.push_back({ovf * kPage + 16, datasize});
+        } else {
+          e->records.push_back({uint64_t(node - e->mm) + 8 + ksize, datasize});
+        }
+      }
+    } else if (flags & kPBranch) {
+      if (idx < n) {
+        stack.push_back({pgno, idx + 1});
+        uint16_t ptr = rd16(pg + 16 + 2 * idx);
+        const uint8_t* node = pg + ptr;
+        uint64_t child = uint64_t(rd16(node)) | (uint64_t(rd16(node + 2)) << 16) |
+                         (uint64_t(rd16(node + 4)) << 32);
+        stack.push_back({child, 0});
+      }
+    } else {
+      e->error = "unexpected page flags";
+      return false;
+    }
+  }
+  return true;
+}
+
+// --- Datum decode ---------------------------------------------------------
+
+struct Datum {
+  int64_t channels = 0, height = 0, width = 0, label = 0, encoded = 0;
+  const uint8_t* data = nullptr;
+  uint64_t data_len = 0;
+  const uint8_t* float_data = nullptr;   // packed floats
+  uint64_t float_count = 0;
+};
+
+inline bool varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = v; return true; }
+    shift += 7;
+  }
+  return false;
+}
+
+bool decode_datum(const uint8_t* p, uint64_t len, Datum* d) {
+  const uint8_t* end = p + len;
+  while (p < end) {
+    uint64_t tag;
+    if (!varint(p, end, &tag)) return false;
+    uint32_t field = uint32_t(tag >> 3), wire = uint32_t(tag & 7);
+    uint64_t v;
+    switch (wire) {
+      case 0:  // varint
+        if (!varint(p, end, &v)) return false;
+        if (field == 1) d->channels = int64_t(v);
+        else if (field == 2) d->height = int64_t(v);
+        else if (field == 3) d->width = int64_t(v);
+        else if (field == 5) d->label = int64_t(v);
+        else if (field == 7) d->encoded = int64_t(v);
+        break;
+      case 2:  // length-delimited
+        if (!varint(p, end, &v) || p + v > end) return false;
+        if (field == 4) { d->data = p; d->data_len = v; }
+        else if (field == 6) { d->float_data = p; d->float_count = v / 4; }
+        p += v;
+        break;
+      case 5:  // fixed32 (non-packed repeated float_data)
+        if (p + 4 > end) return false;
+        if (field == 6 && d->float_data == nullptr) d->float_data = p;
+        if (field == 6) d->float_count += 1;
+        p += 4;
+        break;
+      case 1:  // fixed64
+        if (p + 8 > end) return false;
+        p += 8;
+        break;
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* dp_last_error() { return g_error.c_str(); }
+
+void* dp_open(const char* path) {
+  std::string p(path);
+  struct stat st;
+  if (stat(p.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) p += "/data.mdb";
+  int fd = open(p.c_str(), O_RDONLY);
+  if (fd < 0) { g_error = "cannot open " + p; return nullptr; }
+  if (fstat(fd, &st) != 0) { close(fd); g_error = "fstat failed"; return nullptr; }
+  void* mm = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (mm == MAP_FAILED) { close(fd); g_error = "mmap failed"; return nullptr; }
+  auto* e = new Env{fd, static_cast<const uint8_t*>(mm),
+                    size_t(st.st_size), {}, ""};
+  // pick the newer meta page, mirroring lmdb_py.Environment
+  uint64_t best_txn = 0, root = kInvalid, entries = 0;
+  bool ok = false;
+  for (int m = 0; m < 2; ++m) {
+    const uint8_t* pg = e->mm + m * kPage;
+    if (!(rd16(pg + 10) & kPMeta)) continue;
+    if (rd32(pg + 16) != kMagic || rd32(pg + 20) != kVersion) continue;
+    const uint8_t* main_db = pg + 16 + 24 + 48;
+    uint64_t ent = rd64(main_db + 32), rt = rd64(main_db + 40);
+    uint64_t txn = rd64(main_db + 48 + 8);
+    // ties prefer meta page 0, like lmdb_py (m0 if m0.txnid >= m1.txnid)
+    if (!ok || txn > best_txn) { best_txn = txn; root = rt; entries = ent; }
+    ok = true;
+  }
+  if (!ok) { g_error = "no valid LMDB meta page"; delete e; return nullptr; }
+  e->records.reserve(entries);
+  if (!walk(e, root)) { g_error = e->error; delete e; return nullptr; }
+  return e;
+}
+
+void dp_close(void* env) {
+  auto* e = static_cast<Env*>(env);
+  if (!e) return;
+  munmap(const_cast<uint8_t*>(e->mm), e->size);
+  close(e->fd);
+  delete e;
+}
+
+long dp_count(void* env) {
+  return long(static_cast<Env*>(env)->records.size());
+}
+
+// Shape of record 0: dims_out = {channels, height, width}; returns 0 on
+// success, -1 on error (empty DB / undecodable / encoded image).
+long dp_shape(void* env, long* dims_out) {
+  auto* e = static_cast<Env*>(env);
+  if (e->records.empty()) { g_error = "empty DB"; return -1; }
+  Datum d;
+  if (!decode_datum(e->mm + e->records[0].off, e->records[0].len, &d)) {
+    g_error = "cannot decode first Datum";
+    return -1;
+  }
+  if (d.encoded) { g_error = "encoded (JPEG) Datums need the Python path"; return -1; }
+  dims_out[0] = d.channels; dims_out[1] = d.height; dims_out[2] = d.width;
+  return 0;
+}
+
+// Decode `n` records starting at index `start` (wrapping) into out
+// (n, c, h', w') float32 and out_labels (n) float32, applying
+// (x - mean) then center-crop `crop` (0 = none) then * scale.
+// dims = {c, h, w} the caller sized `out` for (from dp_shape); EVERY
+// record must match or the call fails — never trusts record contents to
+// bound the write.
+// mean_mode: 0 none, 1 per-channel (mean has c floats),
+//            2 full blob (c*h*w floats, indexed pre-crop).
+// Returns 0 on success, -1 on error (g_error says why).
+long dp_read_batch(void* env, long start, long n, long crop,
+                   const long* dims,
+                   const float* mean, int mean_mode, float scale,
+                   float* out, float* out_labels) {
+  auto* e = static_cast<Env*>(env);
+  const long total = long(e->records.size());
+  if (total == 0) { g_error = "empty DB"; return -1; }
+  const long c0 = dims[0], h0 = dims[1], w0 = dims[2];
+  if (crop && (crop > h0 || crop > w0)) {
+    g_error = "crop larger than record";
+    return -1;
+  }
+  float* dst = out;
+  for (long i = 0; i < n; ++i) {
+    const Record& r = e->records[(start + i) % total];
+    Datum d;
+    if (!decode_datum(e->mm + r.off, r.len, &d)) {
+      g_error = "cannot decode Datum";
+      return -1;
+    }
+    if (d.encoded) { g_error = "encoded Datum"; return -1; }
+    if (d.channels != c0 || d.height != h0 || d.width != w0) {
+      g_error = "record shape differs from the expected dims";
+      return -1;
+    }
+    const long hw = h0 * w0;
+    const long oh = crop ? crop : h0, ow = crop ? crop : w0;
+    const long hoff = crop ? (h0 - crop) / 2 : 0;
+    const long woff = crop ? (w0 - crop) / 2 : 0;
+    const bool from_bytes = d.data_len > 0;
+    if (from_bytes && d.data_len != uint64_t(c0 * hw)) {
+      g_error = "data size mismatch";
+      return -1;
+    }
+    if (!from_bytes && d.float_count != uint64_t(c0 * hw)) {
+      g_error = "float_data size mismatch";
+      return -1;
+    }
+    for (long ch = 0; ch < c0; ++ch) {
+      const float mv = (mean_mode == 1) ? mean[ch] : 0.0f;
+      for (long y = 0; y < oh; ++y) {
+        const long src_row = (ch * h0 + y + hoff) * w0 + woff;
+        for (long x = 0; x < ow; ++x) {
+          float v;
+          if (from_bytes) {
+            v = float(d.data[src_row + x]);
+          } else {
+            std::memcpy(&v, d.float_data + 4 * (src_row + x), 4);
+          }
+          if (mean_mode == 2) v -= mean[src_row + x];
+          else v -= mv;
+          *dst++ = v * scale;
+        }
+      }
+    }
+    out_labels[i] = float(d.label);
+  }
+  return 0;
+}
+
+}  // extern "C"

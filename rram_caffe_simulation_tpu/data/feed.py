@@ -125,10 +125,30 @@ def materialize_data_source(layer, max_bytes: int = 1 << 31):
     tp = layer.lp.transform_param
     if tp.mirror or (tp.crop_size and layer.phase == pb.TRAIN):
         return None  # random mirror / random crop: host feed only
+    tops = list(layer.lp.top)
+    reader = _native_reader(layer)
+    if reader is not None:
+        # size check BEFORE allocating: count x record shape is known
+        c, h, w = reader.shape
+        side = reader.crop or 0
+        oh, ow = (side, side) if side else (h, w)
+        expected = reader.count * c * oh * ow * 4
+        if expected > max_bytes:
+            reader.close()
+            return None
+        try:  # native fused decode of the whole DB in one call
+            data, labels = reader.read(reader.count, start=0)
+            out = {tops[0]: data}
+            if len(tops) > 1:
+                out[tops[1]] = labels
+            return out
+        except (RuntimeError, MemoryError):
+            pass
+        finally:
+            reader.close()
     db = open_db(dp.source, dp.backend)
     transformer = DataTransformer(layer.lp.transform_param,
                                   phase=layer.phase)
-    tops = list(layer.lp.top)
     cursor = db.cursor()
     datas, labels = [], []
     total = 0
@@ -221,8 +241,72 @@ def _memory_feed(layer):
     return feed
 
 
+def _native_reader(layer):
+    """NativeDatumReader for a Data layer's source + transform, or None
+    when the native path doesn't apply (LevelDB, random TRAIN crop/mirror,
+    encoded record 0, no compiler)."""
+    dp = layer.lp.data_param
+    tp = layer.lp.transform_param
+    if dp.backend != pb.DataParameter.LMDB:
+        return None
+    if tp.mirror or (tp.crop_size and layer.phase == pb.TRAIN):
+        return None
+    try:
+        from .native import NativeDatumReader
+        from .transformer import DataTransformer
+        t = DataTransformer(tp, phase=layer.phase)
+        mean = None if t.mean is None else np.asarray(t.mean, np.float32)
+        return NativeDatumReader(dp.source, mean=mean,
+                                 scale=float(tp.scale),
+                                 crop=int(tp.crop_size))
+    except (RuntimeError, ValueError, OSError):
+        return None
+
+
+def _native_data_feed(layer):
+    """Fused native read+decode+transform (data/native.py over
+    native/datapath.cpp); None when not applicable. A mid-stream decode
+    failure (shape change, encoded record past the probe) permanently
+    falls back to the Python feed at the SAME cursor position instead of
+    crashing training."""
+    reader = _native_reader(layer)
+    if reader is None:
+        return None
+    tops = list(layer.lp.top)
+    batch_size = layer.lp.data_param.batch_size
+    state = {"reader": reader, "fallback": None, "batches": 0}
+
+    def feed():
+        if state["fallback"] is not None:
+            return state["fallback"]()
+        r = state["reader"]
+        try:
+            data, labels = r.read(batch_size)
+        except RuntimeError:
+            py = _python_data_feed(layer)
+            for _ in range(state["batches"]):  # catch the cursor up
+                py()
+            state["fallback"] = py
+            state["reader"].close()
+            return py()
+        state["batches"] += 1
+        out = {tops[0]: data}
+        if len(tops) > 1:
+            out[tops[1]] = labels
+        return out
+    return feed
+
+
 def _data_feed(layer):
-    """Data layer (LMDB/LevelDB) via the db module's cursor."""
+    """Data layer (LMDB/LevelDB): native fused path when possible, else the
+    pure-Python cursor + DataTransformer."""
+    native = _native_data_feed(layer)
+    if native is not None:
+        return native
+    return _python_data_feed(layer)
+
+
+def _python_data_feed(layer):
     from .db import open_db
     from .transformer import DataTransformer
     dp = layer.lp.data_param

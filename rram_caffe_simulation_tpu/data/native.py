@@ -1,0 +1,154 @@
+"""ctypes loader for the native C++ data path (native/datapath.cpp):
+LMDB page walk + Datum decode + transform in one call per batch.
+
+The reference's input pipeline is native (db_lmdb.cpp, C++ protobuf Datum,
+data_transformer.cpp); this is the TPU framework's equivalent. pybind11 is
+not available in the build image, so the library exposes a C ABI and is
+compiled on demand with the system g++ (cached next to the source, falling
+back to a temp dir for read-only installs). Every entry point degrades
+gracefully: `load()` returns None when no compiler or the build fails, and
+callers keep using the pure-Python reader.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "native", "datapath.cpp")
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _compile(src: str) -> str | None:
+    out_dir = os.path.dirname(src)
+    if not os.access(out_dir, os.W_OK):
+        out_dir = os.path.join(tempfile.gettempdir(), "rram_tpu_native")
+        os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "_datapath.so")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def load():
+    """The shared library, or None when unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_SRC):
+            return None
+        path = _compile(_SRC)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.dp_open.restype = ctypes.c_void_p
+        lib.dp_open.argtypes = [ctypes.c_char_p]
+        lib.dp_close.argtypes = [ctypes.c_void_p]
+        lib.dp_count.restype = ctypes.c_long
+        lib.dp_count.argtypes = [ctypes.c_void_p]
+        lib.dp_shape.restype = ctypes.c_long
+        lib.dp_shape.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_long)]
+        lib.dp_read_batch.restype = ctypes.c_long
+        lib.dp_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        lib.dp_last_error.restype = ctypes.c_char_p
+        _LIB = lib
+        return _LIB
+
+
+class NativeDatumReader:
+    """Sequential wrap-around batch reader over an LMDB of Datums with the
+    deterministic transform fused (mean subtract, center crop, scale) —
+    the native twin of data/feed._data_feed + DataTransformer for the
+    no-random-augmentation case."""
+
+    def __init__(self, source: str, mean: np.ndarray | None = None,
+                 scale: float = 1.0, crop: int = 0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native data path unavailable")
+        self._lib = lib
+        self._env = lib.dp_open(source.encode())
+        if not self._env:
+            raise RuntimeError(
+                f"dp_open: {lib.dp_last_error().decode()}")
+        self.count = int(lib.dp_count(self._env))
+        dims = (ctypes.c_long * 3)()
+        if lib.dp_shape(self._env, dims) != 0:
+            lib.dp_close(self._env)
+            self._env = None
+            raise RuntimeError(
+                f"dp_shape: {lib.dp_last_error().decode()}")
+        self.shape = (int(dims[0]), int(dims[1]), int(dims[2]))
+        self._dims = dims                    # keeps the c_long array alive
+        self.crop = int(crop)
+        self.scale = float(scale)
+        if mean is None:
+            self._mean = np.zeros(0, np.float32)
+            self._mean_mode = 0
+        elif mean.size == self.shape[0]:
+            self._mean = np.ascontiguousarray(mean.ravel(), np.float32)
+            self._mean_mode = 1
+        else:
+            if mean.size != int(np.prod(self.shape)):
+                raise ValueError(
+                    f"mean size {mean.size} matches neither channels "
+                    f"{self.shape[0]} nor full blob {self.shape}")
+            self._mean = np.ascontiguousarray(mean.ravel(), np.float32)
+            self._mean_mode = 2
+        self.pos = 0
+
+    def read(self, n: int, start: int | None = None):
+        """(data (n,c,h',w') float32, labels (n,) float32); advances the
+        cursor when `start` is omitted."""
+        if start is None:
+            start = self.pos
+            self.pos = (self.pos + n) % max(self.count, 1)
+        c, h, w = self.shape
+        oh = ow = self.crop if self.crop else 0
+        oh, ow = (oh, ow) if self.crop else (h, w)
+        data = np.empty((n, c, oh, ow), np.float32)
+        labels = np.empty((n,), np.float32)
+        rc = self._lib.dp_read_batch(
+            self._env, start, n, self.crop, self._dims,
+            self._mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._mean_mode, self.scale,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(
+                f"dp_read_batch: {self._lib.dp_last_error().decode()}")
+        return data, labels
+
+    def close(self):
+        if self._env:
+            self._lib.dp_close(self._env)
+            self._env = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -181,9 +181,12 @@ class SweepRunner:
 
                 def one(carry, xs):
                     params, history, fault = carry
-                    it_t, remap_t = xs
-                    # sequential wrap-around order == the host cursor feed
-                    idx = (it_t * B + jnp.arange(B)) % N
+                    it_t, start_t, remap_t = xs
+                    # sequential wrap-around order == the host cursor
+                    # feed; start_t = (it*B) % N is computed on the host
+                    # in arbitrary precision (it*B overflows int32 after
+                    # ~21M iterations at batch 100)
+                    idx = (start_t + jnp.arange(B)) % N
                     batch_t = {name: arr[idx]
                                for name, arr in self._dataset.items()}
                     if self._batch_sharding is not None:
@@ -195,9 +198,10 @@ class SweepRunner:
                         params, history, fault, batch_t, it_t, remap_t)
                     return (p2, h2, f2), (loss, outputs)
 
-                def run(params, history, fault, its, remaps):
+                def run(params, history, fault, its, starts, remaps):
                     (p, h, f), (losses, outputs) = jax.lax.scan(
-                        one, (params, history, fault), (its, remaps))
+                        one, (params, history, fault),
+                        (its, starts, remaps))
                     return p, h, f, losses, outputs
 
             self._chunk_fns[key] = jax.jit(run, donate_argnums=(0, 1, 2))
@@ -252,15 +256,18 @@ class SweepRunner:
             done = 0
             while done < iters:
                 k = min(max(chunk, 1), iters - done)
-                its, remaps = [], []
+                its, starts, remaps = [], [], []
                 for _ in range(k):
                     its.append(self.iter)
+                    starts.append((self.iter * self._ds_batch) % self._ds_n)
                     remaps.append(self._remap_due())
                     self.iter += 1
                 (self.params, self.history, self.fault_states, losses,
                  outputs) = self._chunk_fn(k)(
                     self.params, self.history, self.fault_states,
-                    jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
+                    jnp.asarray(its, jnp.int32),
+                    jnp.asarray(starts, jnp.int32),
+                    jnp.asarray(remaps))
                 done += k
             return (np.asarray(losses)[-1],
                     jax.tree.map(lambda x: np.asarray(x)[-1], outputs))

@@ -1,0 +1,106 @@
+"""Native C++ data path (native/datapath.cpp via data/native.py) must match
+the pure-Python LMDB reader + DataTransformer bit-for-bit on every
+deterministic-transform configuration, and the feed must fall back
+gracefully when the native path doesn't apply."""
+import numpy as np
+import pytest
+
+from rram_caffe_simulation_tpu.data import feed as feed_mod
+from rram_caffe_simulation_tpu.data import native
+from rram_caffe_simulation_tpu.data.db import datum_to_array, open_db
+from rram_caffe_simulation_tpu.data.transformer import DataTransformer
+from rram_caffe_simulation_tpu.proto import pb
+
+import os
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LMDB = os.path.join(REPO, "examples", "cifar10", "cifar10_test_lmdb")
+MEAN_FILE = os.path.join(REPO, "examples", "cifar10", "mean.binaryproto")
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="no C++ toolchain for native path")
+
+
+def _python_batch(tp, phase, n, skip=0):
+    t = DataTransformer(tp, phase=phase)
+    cur = open_db(LMDB, pb.DataParameter.LMDB).cursor()
+    for _ in range(skip):
+        cur.next_value()
+    datas, labels = [], []
+    for _ in range(n):
+        d = pb.Datum()
+        d.ParseFromString(cur.next_value())
+        arr, lab = datum_to_array(d)
+        datas.append(t.transform(arr))
+        labels.append(lab)
+    return np.stack(datas), np.asarray(labels, np.float32)
+
+
+@pytest.mark.parametrize("config", [
+    dict(),                                        # raw
+    dict(scale=0.00390625),                        # scale
+    dict(mean_value=[104, 117, 123]),              # per-channel mean
+    dict(mean_file=MEAN_FILE, scale=0.5),          # full mean blob
+    dict(crop_size=28, scale=2.0),                 # TEST center crop
+])
+def test_native_matches_python(config):
+    tp = pb.TransformationParameter()
+    for k, v in config.items():
+        if k == "mean_value":
+            tp.mean_value.extend(v)
+        else:
+            setattr(tp, k, v)
+    t = DataTransformer(tp, phase=pb.TEST)
+    mean = None if t.mean is None else np.asarray(t.mean, np.float32)
+    r = native.NativeDatumReader(LMDB, mean=mean, scale=float(tp.scale),
+                                 crop=int(tp.crop_size))
+    got_d, got_l = r.read(16)
+    want_d, want_l = _python_batch(tp, pb.TEST, 16)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(got_l, want_l)
+    r.close()
+
+
+def test_native_wraps_like_cursor():
+    r = native.NativeDatumReader(LMDB)
+    n = r.count
+    got_d, got_l = r.read(n + 7)          # wraps past the end
+    np.testing.assert_allclose(got_d[n:], got_d[:7], rtol=0)
+    np.testing.assert_array_equal(got_l[n:], got_l[:7])
+    r.close()
+
+
+def _data_layer(mirror=False, crop=0, phase=pb.TEST):
+    lp = pb.LayerParameter()
+    lp.name = "data"
+    lp.type = "Data"
+    lp.top.extend(["data", "label"])
+    lp.data_param.source = LMDB
+    lp.data_param.batch_size = 4
+    lp.data_param.backend = pb.DataParameter.LMDB
+    lp.transform_param.mirror = mirror
+    lp.transform_param.crop_size = crop
+    import rram_caffe_simulation_tpu.ops  # noqa: F401 (registers layers)
+    from rram_caffe_simulation_tpu.core.registry import create_layer
+    return create_layer(lp, phase)
+
+
+def test_feed_uses_native_and_falls_back():
+    assert feed_mod._native_data_feed(_data_layer()) is not None
+    # random mirror: python path only
+    assert feed_mod._native_data_feed(_data_layer(mirror=True)) is None
+    # random TRAIN crop: python path only; TEST center crop is native
+    assert feed_mod._native_data_feed(
+        _data_layer(crop=28, phase=pb.TRAIN)) is None
+    assert feed_mod._native_data_feed(
+        _data_layer(crop=28, phase=pb.TEST)) is not None
+
+
+def test_materialize_uses_native_and_matches():
+    layer = _data_layer()
+    arrays = feed_mod.materialize_data_source(layer)
+    assert arrays is not None
+    want_d, want_l = _python_batch(pb.TransformationParameter(), pb.TEST,
+                                   arrays["data"].shape[0])
+    np.testing.assert_allclose(arrays["data"], want_d, rtol=1e-6)
+    np.testing.assert_array_equal(arrays["label"], want_l)
