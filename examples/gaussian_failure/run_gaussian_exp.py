@@ -48,6 +48,10 @@ def parse_args(argv=None):
                    help="comma list of lifetime means: train ALL configs "
                         "simultaneously via the vmapped fault axis")
     p.add_argument("--sweep-stds", default="")
+    p.add_argument("--hw-sigma", type=float, default=0.0,
+                   help="hardware-aware forward: relative conductance "
+                        "noise on fault-target weights each read "
+                        "(framework extension, RRAMForwardParameter)")
     return p.parse_args(argv)
 
 
@@ -63,6 +67,8 @@ def build_solver_param(args) -> "pb.SolverParameter":
     message.device_id = args.device_id
     if args.max_iter:
         message.max_iter = args.max_iter
+    if args.hw_sigma:
+        message.rram_forward.sigma = args.hw_sigma
     if args.threshold > 0:
         message.failure_strategy.add(type="threshold",
                                      threshold=args.threshold)

@@ -50,6 +50,15 @@ class LayerContext:
     rng: Optional[jax.Array] = None
     # Net-level iteration counter, traced; used by BatchNorm moving averages.
     iteration: Optional[jax.Array] = None
+    # Hardware-aware ADC model (RRAMForwardParameter.adc_bits, static):
+    # when nonzero, crossbar (InnerProduct) layers quantize their output
+    # with straight-through gradients (fault/hw_aware.quantize_ste).
+    adc_bits: int = 0
+    # Hardware-aware crossbar engine (RRAMForwardParameter.sigma on the
+    # Pallas path): maps fault-target layer name -> (broken, stuck, seed,
+    # sigma); the layer computes its matmul through the fused
+    # fault/hw_aware.crossbar_matmul kernel (noise drawn in VMEM).
+    crossbar: Optional[dict] = None
 
 
 @dataclasses.dataclass

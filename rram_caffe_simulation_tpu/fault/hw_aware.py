@@ -1,0 +1,208 @@
+"""Hardware-aware forward: crossbar conductance noise + stuck-cell clamp +
+ADC quantization injected into the forward pass with straight-through
+gradients.
+
+This is the TPU framework's extension beyond the reference (SURVEY §7 build
+plan item 3: "differentiable Pallas noise-injection kernel — conductance
+variation sigma, ADC/DAC quantization, stuck masks fused into the GEMM —
+with custom_vjp straight-through for hardware-aware training"). The
+reference only injects faults into STORED weights after the update
+(failure_maker.cu:23-40); here every forward READ can additionally see the
+analog crossbar's conductance variation, so training converges to
+noise-robust weights.
+
+Two implementations with one contract:
+
+- `perturb_weight` / `quantize_ste`: pure JAX, jit/vmap-safe everywhere
+  (the Monte-Carlo sweep vmaps them per config). Straight-through is the
+  `x + stop_gradient(f(x) - x)` identity, so d(w_eff)/dw == 1 while the
+  forward sees the perturbed value.
+- `crossbar_matmul`: a fused Pallas TPU kernel computing
+  y = x @ where(broken, stuck, w * (1 + sigma*eps)) with the noise drawn
+  IN-KERNEL (pltpu PRNG + Box-Muller) per weight tile — the noisy weight
+  matrix never materializes in HBM. custom_vjp backward uses the CLEAN
+  masked weights (noise treated as a forward-only perturbation, the
+  standard QAT straight-through choice); with sigma == 0 forward and
+  backward match the pure path exactly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def perturb_weight(w, broken, stuck, key, sigma: float):
+    """Forward-read value of a crossbar weight array: multiplicative
+    Gaussian conductance variation on live cells, stuck value on broken
+    ones. Straight-through: gradients pass to `w` unchanged."""
+    noisy = w * (1.0 + sigma * jax.random.normal(key, w.shape, w.dtype)) \
+        if sigma else w
+    w_eff = jnp.where(broken, stuck.astype(w.dtype), noisy)
+    return w + jax.lax.stop_gradient(w_eff - w)
+
+
+def quantize_ste(x, bits: int, max_abs=None):
+    """Symmetric uniform quantization (ADC model) with straight-through
+    gradients. `max_abs` defaults to the per-call dynamic range."""
+    if not bits:
+        return x
+    if bits < 2:
+        # bits == 1 would give zero symmetric levels -> scale = inf -> NaN
+        raise ValueError(f"quantize_ste needs bits >= 2, got {bits}")
+    if max_abs is None:
+        max_abs = jnp.max(jnp.abs(x))
+    levels = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(max_abs, 1e-12) / levels
+    q = jnp.clip(jnp.round(x / scale), -levels, levels) * scale
+    return x + jax.lax.stop_gradient(q - x)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused kernel
+
+def _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref, sigma, eps):
+    noisy = w_ref[:] * (1.0 + sigma * eps)
+    w_eff = jnp.where(broken_ref[:] > 0, stuck_ref[:], noisy)
+    o_ref[:] += jnp.dot(x_ref[:], w_eff,
+                        preferred_element_type=jnp.float32)
+
+
+def _crossbar_kernel(seed_ref, x_ref, w_ref, broken_ref, stuck_ref,
+                     sigma_ref, o_ref):
+    """One (bm, bn) output tile, accumulating over the K grid axis; the
+    weight tile is perturbed in VMEM before hitting the MXU. The PRNG is
+    seeded per (j, k) tile so every x-tile sees the SAME weight noise."""
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    w = w_ref[:]
+    # Seed and tile index are SEPARATE seed words: with a single word
+    # `seed + j*nk + k`, seed s+1 tile t would replay seed s tile t+1 —
+    # sequential Monte-Carlo seeds would share almost all their noise.
+    pltpu.prng_seed(seed_ref[0], j * nk + k)
+
+    def uniform01(shape):
+        # map raw 32-bit draws to [0,1) regardless of signed/unsigned
+        # interpretation: scale then take the fractional part
+        b = pltpu.prng_random_bits(shape)
+        u = b.astype(jnp.float32) * (1.0 / 4294967296.0)
+        return u - jnp.floor(u)
+
+    # Box-Muller -> N(0,1) per weight element
+    u1 = jnp.maximum(uniform01(w.shape), 1e-12)
+    u2 = uniform01(w.shape)
+    eps = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
+    _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
+                sigma_ref[0], eps)
+
+
+def _crossbar_kernel_hostnoise(x_ref, w_ref, broken_ref, stuck_ref,
+                               eps_ref, sigma_ref, o_ref):
+    """Interpret-mode twin for off-TPU hosts: identical math, but the
+    Gaussian draw arrives as an input (pltpu's in-kernel PRNG has no CPU
+    interpret lowering)."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
+                sigma_ref[0], eps_ref[:])
+
+
+def _pallas_forward(x, w, broken, stuck, seed, sigma,
+                    bm=128, bn=128, bk=128):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, kdim = x.shape
+    _, n = w.shape
+
+    def pad(a, r, c):
+        return jnp.pad(a, ((0, -a.shape[0] % r), (0, -a.shape[1] % c)))
+
+    xp = pad(x, bm, bk)
+    wp = pad(w, bk, bn)
+    bp = pad(broken, bk, bn)
+    sp = pad(stuck, bk, bn)
+    gm, gk = xp.shape[0] // bm, xp.shape[1] // bk
+    gn = wp.shape[1] // bn
+    on_tpu = jax.default_backend() == "tpu"
+    wspec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
+    common = dict(
+        grid=(gm, gn, gk),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]),
+                                       jnp.float32),
+    )
+    sig = jnp.asarray([sigma], jnp.float32)
+    if on_tpu:
+        out = pl.pallas_call(
+            _crossbar_kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
+                      pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                      wspec, wspec, wspec,
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],  # sigma
+            **common,
+        )(jnp.asarray([seed], jnp.int32), xp, wp, bp, sp, sig)
+    else:
+        eps = jax.random.normal(jax.random.PRNGKey(seed), wp.shape,
+                                jnp.float32)
+        out = pl.pallas_call(
+            _crossbar_kernel_hostnoise,
+            in_specs=[pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                      wspec, wspec, wspec, wspec,
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            interpret=True,
+            **common,
+        )(xp, wp, bp, sp, eps, sig)
+    return out[:m, :n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def crossbar_matmul(x, w, broken, stuck, seed, sigma):
+    """y = x @ where(broken, stuck, w * (1 + sigma*eps)) as one fused
+    Pallas kernel (noise generated in VMEM, never materialized in HBM).
+
+    x: (M, K) f32; w: (K, N) f32; broken: (K, N) bool; stuck: (K, N) f32;
+    seed: python int (static under jit); sigma: python float (static).
+    Backward is straight-through against the CLEAN masked weights."""
+    return _pallas_forward(x, w, broken.astype(jnp.float32),
+                           stuck.astype(jnp.float32), seed, sigma)
+
+
+def _cm_fwd(x, w, broken, stuck, seed, sigma):
+    y = crossbar_matmul(x, w, broken, stuck, seed, sigma)
+    return y, (x, w, broken, stuck)
+
+
+def _cm_bwd(sigma, res, g):
+    x, w, broken, stuck = res
+    w_masked = jnp.where(broken, stuck.astype(w.dtype), w)
+    dx = g @ w_masked.T
+    dw = x.T @ g
+    # stuck cells take no gradient (their stored value is clamped by the
+    # fault engine anyway; matches d/dw of where(broken, stuck, w))
+    dw = jnp.where(broken, 0.0, dw)
+    return dx, dw, None, None, None
+
+
+crossbar_matmul.defvjp(_cm_fwd, _cm_bwd)
+
+
+def reference_crossbar_matmul(x, w, broken, stuck, key, sigma: float):
+    """Pure-JAX semantic reference for crossbar_matmul (exact match at
+    sigma == 0; same distribution otherwise, different noise stream)."""
+    return x @ perturb_weight(w, broken, stuck, key, sigma)

@@ -242,15 +242,20 @@ class Net:
 
     def apply(self, params, batch: Optional[dict] = None, rng=None,
               iteration=None, with_updates: bool = False,
-              start: Optional[str] = None, end: Optional[str] = None):
+              start: Optional[str] = None, end: Optional[str] = None,
+              adc_bits: int = 0, crossbar: Optional[dict] = None):
         """Run the net (or the [start, end] layer range). `batch` feeds
         data-source tops — plus, for partial runs, any bottom consumed but
         not produced inside the range. Returns (blobs, loss) or
         (blobs, loss, new_params) when with_updates (BatchNorm moving
-        stats) is requested.
+        stats) is requested. `adc_bits` (static) turns on the hardware-aware
+        ADC output quantization in crossbar (InnerProduct) layers;
+        `crossbar` routes named InnerProduct layers through the fused
+        Pallas conductance-noise kernel (see LayerContext.crossbar).
         """
         batch = batch or {}
-        ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration)
+        ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration,
+                           adc_bits=adc_bits, crossbar=crossbar)
         run_layers = self.layer_range(start, end)
         produced_in_range = {t for l in run_layers for t in l.lp.top}
         blobs: dict[str, Any] = {}

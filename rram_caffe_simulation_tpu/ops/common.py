@@ -55,8 +55,29 @@ class InnerProductLayer(Layer):
     def apply(self, params, bottoms, ctx):
         x = bottoms[0].reshape((-1, self.K))
         w = params[0]
-        y = jnp.dot(x, w if self.transpose else w.T,
-                    preferred_element_type=bottoms[0].dtype)
+        cb = getattr(ctx, "crossbar", None)
+        cb = cb.get(self.name) if cb else None
+        if cb is not None:
+            # Fused Pallas crossbar read: stuck mask + conductance noise +
+            # matmul in one kernel, noise drawn in VMEM (never in HBM).
+            # broken/stuck are shaped like the STORED weight.
+            from ..fault.hw_aware import crossbar_matmul
+            broken, stuck, seed, sigma = cb
+            y = crossbar_matmul(
+                x.astype(jnp.float32),
+                (w if self.transpose else w.T).astype(jnp.float32),
+                broken if self.transpose else broken.T,
+                (stuck if self.transpose else stuck.T).astype(jnp.float32),
+                seed, sigma).astype(bottoms[0].dtype)
+        else:
+            y = jnp.dot(x, w if self.transpose else w.T,
+                        preferred_element_type=bottoms[0].dtype)
+        if getattr(ctx, "adc_bits", 0):
+            # Hardware-aware ADC: the crossbar's bitline currents (the
+            # matmul output, pre-bias — the bias lives in digital) are
+            # read through a adc_bits-wide converter.
+            from ..fault.hw_aware import quantize_ste
+            y = quantize_ste(y, ctx.adc_bits)
         if self.bias_term:
             y = y + params[1]
         return [y.reshape(self.out_shape[:-1] + (self.num_output,))], None

@@ -84,7 +84,10 @@ class SweepRunner:
         self.params = jax.tree.map(bcast, solver.params)
         self.history = jax.tree.map(bcast, solver.history)
 
-        base = solver.make_train_step()
+        # Force the pure-JAX hardware-aware engine: the Monte-Carlo config
+        # axis vmaps the whole step, and perturb_weight vmaps cleanly
+        # where the Pallas crossbar kernel would not.
+        base = solver.make_train_step(hw_engine="jax")
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
@@ -330,8 +333,14 @@ class SweepRunner:
         net = net or (self.solver.test_nets[0] if self.solver.test_nets
                       else self.solver.net)
         if id(net) not in self._eval_fns:
+            sp = self.solver.param
+            # Same ADC model as training and Solver.test (solver.py): the
+            # chip quantizes every crossbar output in every phase.
+            adc_bits = (int(sp.rram_forward.adc_bits)
+                        if sp.HasField("rram_forward") else 0)
+
             def run(p, b):
-                blobs, _ = net.apply(p, b)
+                blobs, _ = net.apply(p, b, adc_bits=adc_bits)
                 return {n: blobs[n] for n in net.output_names}
             self._eval_fns[id(net)] = jax.jit(
                 jax.vmap(run, in_axes=(0, None)))

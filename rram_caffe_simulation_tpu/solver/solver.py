@@ -118,6 +118,21 @@ class Solver:
                       for k in self._fault_keys}
             self.fault_state = fault_engine.init_fault_state(
                 k_fault, shapes, param.failure_pattern)
+        if (param.HasField("rram_forward")
+                and (param.rram_forward.sigma or param.rram_forward.adc_bits)
+                and self.fault_state is None):
+            # The hardware-aware forward is defined over the fault-target
+            # weights; silently training without it would report results
+            # for a configuration the user did not ask for.
+            raise ValueError(
+                "rram_forward is configured but no fault engine is active "
+                "— it requires failure_pattern { type: 'gaussian' } and at "
+                "least one fault-target (InnerProduct) layer")
+        if (param.HasField("rram_forward")
+                and param.rram_forward.adc_bits == 1):
+            raise ValueError(
+                "rram_forward.adc_bits = 1 gives a symmetric quantizer "
+                "zero levels (2^(bits-1)-1 == 0); use adc_bits >= 2")
         flat0 = self._flat(self.params)
         hidden_sizes = [int(flat0[w].shape[0])
                         for w, _ in self.fc_pairs[:-1]]
@@ -223,12 +238,19 @@ class Solver:
     # ------------------------------------------------------------------
     # the jitted train step
 
-    def make_train_step(self):
+    def make_train_step(self, hw_engine: str = "auto"):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs)
         — ForwardBackward + ComputeUpdate + ApplyStrategy + ApplyUpdate +
-        Fail in one traced computation (solver.cpp:238-321)."""
+        Fail in one traced computation (solver.cpp:238-321).
+
+        `hw_engine` selects how the hardware-aware forward (rram_forward)
+        reads fault-target weights, mirroring the reference's Caffe-vs-
+        cuDNN engine choice (layer_factory.cpp:38): "pallas" = the fused
+        crossbar_matmul kernel (noise drawn in VMEM); "jax" = pure
+        perturb_weight (vmappable — the sweep path forces this); "auto" =
+        pallas on the TPU backend, jax elsewhere."""
         net = self.net
         param = self.param
         solver_type = self.type
@@ -251,11 +273,56 @@ class Solver:
         flat = self._flat
         unflat = self._unflat
         has_fault = self.fault_state is not None
+        # Hardware-aware forward (RRAMForwardParameter, framework
+        # extension): fault-target weights are READ through the crossbar's
+        # conductance variation each forward, straight-through gradients.
+        hw_sigma = (float(param.rram_forward.sigma)
+                    if param.HasField("rram_forward") and has_fault else 0.0)
+        adc_bits = (int(param.rram_forward.adc_bits)
+                    if param.HasField("rram_forward") and has_fault else 0)
+        use_pallas = bool(hw_sigma) and (
+            hw_engine == "pallas" or
+            (hw_engine == "auto" and jax.default_backend() == "tpu"))
+        # Weight (2-D crossbar) keys go through the fused kernel on the
+        # pallas engine; biases always take the pure perturbation.
+        crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
 
-        def forward_backward(params, batch, it, rng):
+        def forward_backward(params, batch, it, rng, fault_state):
             def loss_fn(p):
+                clean = flat(p)
+                crossbar = None
+                if hw_sigma:
+                    from ..fault import hw_aware
+                    fp = dict(clean)
+                    crossbar = {} if use_pallas else None
+                    for i, k in enumerate(fault_keys):
+                        noise_key = jax.random.fold_in(
+                            jax.random.fold_in(rng, 0x4A7), i)
+                        if k in crossbar_keys:
+                            seed = jax.random.randint(
+                                noise_key, (), 0, jnp.iinfo(jnp.int32).max)
+                            crossbar[k.rsplit("/", 1)[0]] = (
+                                fault_state["lifetimes"][k] <= 0,
+                                fault_state["stuck"][k], seed, hw_sigma)
+                        else:
+                            fp[k] = hw_aware.perturb_weight(
+                                fp[k], fault_state["lifetimes"][k] <= 0,
+                                fault_state["stuck"][k], noise_key,
+                                hw_sigma)
+                    p = unflat(fp, p)
                 blobs, loss, newp = net.apply(
-                    p, batch, rng=rng, iteration=it, with_updates=True)
+                    p, batch, rng=rng, iteration=it, with_updates=True,
+                    adc_bits=adc_bits, crossbar=crossbar)
+                if hw_sigma:
+                    # Conductance noise is a READ effect only: net.apply
+                    # copies the (perturbed) input tree into new_params, so
+                    # the stored fault-target weights must be restored to
+                    # their clean values before ApplyUpdate — otherwise
+                    # sigma*eps compounds into the parameters each step.
+                    fn = flat(newp)
+                    for k in fault_keys:
+                        fn[k] = clean[k]
+                    newp = unflat(fn, newp)
                 outputs = {name: blobs[name] for name in net.output_names}
                 return loss, (outputs, newp)
             (loss, (outputs, newp)), grads = jax.value_and_grad(
@@ -266,12 +333,13 @@ class Solver:
             # -- ForwardBackward x iter_size (solver.cpp:265-269) --
             if iter_size == 1:
                 loss, outputs, newp, grads = forward_backward(
-                    params, batch, it, rng)
+                    params, batch, it, rng, fault_state)
             else:
                 def body(carry, sub):
                     p, g_acc, loss_acc, i = carry
                     l, outs, p2, g = forward_backward(
-                        p, sub, it, jax.random.fold_in(rng, i))
+                        p, sub, it, jax.random.fold_in(rng, i),
+                        fault_state)
                     g_acc = jax.tree.map(jnp.add, g_acc, g)
                     return (p2, g_acc, loss_acc + l, i + 1), outs
                 zero_g = jax.tree.map(jnp.zeros_like, params)
@@ -491,8 +559,17 @@ class Solver:
         if self._test_fns[idx] is None:
             net = self.test_nets[idx]
 
+            # Test-phase inference reads through the same ADC model (the
+            # chip quantizes every crossbar output, train or test); the
+            # per-read conductance noise is averaged out over test_iter so
+            # only its bias term would matter — we evaluate at sigma=0.
+            adc_bits = (int(self.param.rram_forward.adc_bits)
+                        if self.param.HasField("rram_forward")
+                        and self.fault_state is not None else 0)
+
             def run(params, batch, rng):
-                blobs, loss = net.apply(params, batch, rng=rng)
+                blobs, loss = net.apply(params, batch, rng=rng,
+                                        adc_bits=adc_bits)
                 out = {n: blobs[n] for n in net.output_names}
                 if self.param.test_compute_loss:
                     out["__loss"] = loss

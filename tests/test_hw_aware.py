@@ -1,0 +1,257 @@
+"""Hardware-aware forward (fault/hw_aware.py): straight-through noise/
+quantization semantics, solver integration, vmap-under-sweep, and the
+fused Pallas crossbar kernel against the pure-JAX reference."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.fault import hw_aware
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+
+from test_fault import FAULT_NET
+
+
+def test_perturb_weight_ste():
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    broken = jnp.zeros_like(w, bool).at[0, 0].set(True)
+    stuck = jnp.ones_like(w)
+    key = jax.random.PRNGKey(1)
+
+    w_eff = hw_aware.perturb_weight(w, broken, stuck, key, 0.1)
+    assert float(w_eff[0, 0]) == 1.0                   # stuck wins
+    assert not np.allclose(np.asarray(w_eff), np.asarray(w))  # noise on
+    # relative noise magnitude ~ sigma
+    rel = np.asarray((w_eff - w) / w)[~np.asarray(broken)]
+    assert 0.03 < rel.std() < 0.3
+
+    # straight-through: d(sum(w_eff))/dw == 1 everywhere
+    g = jax.grad(lambda ww: jnp.sum(
+        hw_aware.perturb_weight(ww, broken, stuck, key, 0.1)))(w)
+    np.testing.assert_array_equal(np.asarray(g), 1.0)
+
+    # sigma=0: only the clamp remains
+    w0 = hw_aware.perturb_weight(w, broken, stuck, key, 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(w0), np.asarray(jnp.where(broken, 1.0, w)))
+
+
+def test_quantize_ste():
+    x = jnp.linspace(-1.0, 1.0, 64)
+    q = hw_aware.quantize_ste(x, bits=4)
+    assert len(np.unique(np.asarray(q).round(6))) <= 15  # 2^(4-1)-1 levels*2+1
+    g = jax.grad(lambda v: jnp.sum(hw_aware.quantize_ste(v, 4)))(x)
+    np.testing.assert_array_equal(np.asarray(g), 1.0)
+    np.testing.assert_array_equal(np.asarray(hw_aware.quantize_ste(x, 0)),
+                                  np.asarray(x))
+
+
+def _hw_solver(tmp_path, sigma):
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 1e6
+    sp.failure_pattern.std = 10.0
+    sp.rram_forward.sigma = sigma
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data, "target": target})
+
+
+def test_solver_hw_aware_trains(tmp_path):
+    """With conductance noise in the forward, training still converges
+    (straight-through gradients reach the stored weights)."""
+    s = _hw_solver(tmp_path, sigma=0.05)
+    s.step(1)
+    l0 = s._materialize_smoothed_loss()
+    s.step(60)
+    l1 = s._materialize_smoothed_loss()
+    assert l1 < l0 * 0.7
+
+    # sigma=0 config must match a no-rram_forward solver bit-for-bit
+    s_zero = _hw_solver(tmp_path, sigma=0.0)
+    sp2 = pb.SolverParameter.FromString(s_zero.param.SerializeToString())
+    sp2.ClearField("rram_forward")
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    s_none = Solver(sp2, train_feed=lambda: {"data": data,
+                                             "target": target})
+    s_zero.step(3)
+    s_none.step(3)
+    np.testing.assert_array_equal(
+        np.asarray(s_zero._flat(s_zero.params)["fc1/0"]),
+        np.asarray(s_none._flat(s_none.params)["fc1/0"]))
+
+
+def test_read_noise_never_enters_stored_weights(tmp_path):
+    """Conductance noise is a READ effect: with lr == 0 (zero update) and
+    nothing broken, the stored weights after several sigma > 0 steps must
+    equal the initial weights bit-for-bit — regression for the noise
+    leaking back through net.apply's with_updates params copy."""
+    from rram_caffe_simulation_tpu.solver.lr_policies import learning_rate_fn
+    s = _hw_solver(tmp_path, sigma=0.2)
+    s.param.base_lr = 0.0
+    s._lr_fn = learning_rate_fn(s.param)
+    w0 = np.asarray(s._flat(s.params)["fc1/0"]).copy()
+    s.step(5)
+    np.testing.assert_array_equal(
+        np.asarray(s._flat(s.params)["fc1/0"]), w0)
+
+
+def test_rram_forward_requires_fault_engine(tmp_path):
+    """rram_forward without an active fault engine must fail loudly, not
+    silently train without the hardware model."""
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.rram_forward.sigma = 0.05
+    with pytest.raises(ValueError, match="rram_forward"):
+        Solver(sp, train_feed=lambda: {})
+
+
+def test_adc_bits_quantizes_crossbar_output(tmp_path):
+    """RRAMForwardParameter.adc_bits reaches the InnerProduct forward: the
+    pre-bias matmul output collapses onto 2^(bits-1)-1 symmetric levels,
+    and the solver's first-step loss differs from the unquantized run."""
+    from rram_caffe_simulation_tpu.net import Net
+    s = _hw_solver(tmp_path, sigma=0.0)
+    netp = pb.NetParameter()
+    text_format.Parse(FAULT_NET, netp)
+    net = Net(netp, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    batch = {"data": np.random.RandomState(0).randn(8, 6).astype(np.float32),
+             "target": np.zeros((8, 2), np.float32)}
+    blobs_q, _ = net.apply(params, batch, adc_bits=3)
+    blobs_f, _ = net.apply(params, batch)
+    name = [n for n in blobs_q if "fc" in n or "ip" in n][0]
+    assert not np.allclose(np.asarray(blobs_q[name]),
+                           np.asarray(blobs_f[name]))
+
+    sq = _hw_solver(tmp_path, sigma=0.0)
+    sq.param.rram_forward.adc_bits = 4
+    sq.step(1)
+    sf = _hw_solver(tmp_path, sigma=0.0)
+    sf.step(1)
+    assert (float(sq._materialize_smoothed_loss())
+            != float(sf._materialize_smoothed_loss()))
+
+
+def test_solver_pallas_engine(tmp_path):
+    """hw_engine='pallas' routes fault-target weights through the fused
+    crossbar kernel inside the production train step (interpret mode off
+    TPU). Training converges, and with lr == 0 the stored weights stay
+    bit-clean — the kernel is read-only on the parameters."""
+    s = _hw_solver(tmp_path, sigma=0.05)
+    s._step_fn = jax.jit(s.make_train_step(hw_engine="pallas"),
+                         donate_argnums=(0, 1, 2))
+    s.step(1)
+    l0 = s._materialize_smoothed_loss()
+    s.step(40)
+    l1 = s._materialize_smoothed_loss()
+    assert np.isfinite(l1) and l1 < l0 * 0.8
+
+    from rram_caffe_simulation_tpu.solver.lr_policies import learning_rate_fn
+    s2 = _hw_solver(tmp_path, sigma=0.2)
+    s2.param.base_lr = 0.0
+    s2._lr_fn = learning_rate_fn(s2.param)
+    s2._step_fn = jax.jit(s2.make_train_step(hw_engine="pallas"),
+                          donate_argnums=(0, 1, 2))
+    w0 = np.asarray(s2._flat(s2.params)["fc1/0"]).copy()
+    s2.step(3)
+    np.testing.assert_array_equal(
+        np.asarray(s2._flat(s2.params)["fc1/0"]), w0)
+
+
+def test_quantize_ste_rejects_one_bit(tmp_path):
+    with pytest.raises(ValueError, match="bits"):
+        hw_aware.quantize_ste(jnp.ones(4), bits=1)
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 1e6
+    sp.rram_forward.adc_bits = 1
+    with pytest.raises(ValueError, match="adc_bits"):
+        Solver(sp, train_feed=lambda: {})
+
+
+def test_sweep_evaluate_applies_adc_bits(tmp_path):
+    """SweepRunner.evaluate must see the same ADC model as training."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    s = _hw_solver(tmp_path, sigma=0.0)
+    s.param.rram_forward.adc_bits = 3
+    runner = SweepRunner(s, n_configs=2)
+    batch = {"data": np.random.RandomState(5).randn(8, 6).astype(np.float32),
+             "target": np.zeros((8, 2), np.float32)}
+    out_q = runner.evaluate(batch)
+
+    s2 = _hw_solver(tmp_path, sigma=0.0)
+    runner2 = SweepRunner(s2, n_configs=2)
+    out_f = runner2.evaluate(batch)
+    name = sorted(out_q)[0]
+    assert not np.allclose(out_q[name], out_f[name])
+
+
+def test_hw_aware_under_sweep_vmap(tmp_path):
+    """The pure perturbation path must vmap over the config axis."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    s = _hw_solver(tmp_path, sigma=0.05)
+    runner = SweepRunner(s, n_configs=4)
+    loss, _ = runner.step(3)
+    assert loss.shape == (4,)
+    assert np.isfinite(loss).all()
+    # per-config noise streams differ -> diverged losses even with equal
+    # fault states at mean 1e6 (nothing broken yet)
+    assert len(set(np.round(loss, 7).tolist())) > 1
+
+
+def test_crossbar_matmul_pallas_matches_reference():
+    """sigma=0: the fused Pallas kernel equals x @ where(broken,stuck,w)
+    exactly, forward and backward; sigma>0: output distribution matches
+    the pure reference. Runs in interpret mode off-TPU (real-TPU
+    compilation is covered by `pytest -m tpu --tpu`)."""
+    rng = np.random.RandomState(0)
+    m, k, n = 48, 72, 40                # deliberately non-multiples of 128
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    w = jnp.asarray(rng.randn(k, n), jnp.float32)
+    broken = jnp.asarray(rng.rand(k, n) < 0.1)
+    stuck = jnp.asarray(rng.choice([-1.0, 0.0, 1.0], size=(k, n)),
+                        jnp.float32)
+
+    want = x @ jnp.where(broken, stuck, w)
+    got = hw_aware.crossbar_matmul(x, w, broken, stuck, 7, 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    def loss(xx, ww):
+        return jnp.sum(hw_aware.crossbar_matmul(xx, ww, broken, stuck,
+                                                7, 0.0) ** 2)
+    dx, dw = jax.grad(loss, argnums=(0, 1))(x, w)
+    def ref_loss(xx, ww):
+        return jnp.sum((xx @ jnp.where(broken, stuck, ww)) ** 2)
+    rdx, rdw = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(rdw),
+                               rtol=1e-3, atol=1e-3)
+
+    # sigma>0: E[y] ~ masked matmul, spread ~ sigma
+    got_n = hw_aware.crossbar_matmul(x, w, broken, stuck, 7, 0.05)
+    assert not np.allclose(np.asarray(got_n), np.asarray(want))
+    rel_err = np.abs(np.asarray(got_n) - np.asarray(want)) / (
+        np.abs(np.asarray(want)) + 1.0)
+    assert rel_err.mean() < 0.2
