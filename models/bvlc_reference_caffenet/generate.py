@@ -1,0 +1,99 @@
+"""Generate bvlc_reference_caffenet train_val/deploy/solver prototxts with
+the framework's net_spec DSL.
+
+CaffeNet per the published BVLC recipe (reference:
+models/bvlc_reference_caffenet/readme.md — 57.4% top-1 / 80.4% top-5
+ILSVRC12 center crop): AlexNet with pool-before-norm. Layer/blob names
+match the published model so zoo `.caffemodel` weights load by name.
+
+Run:  python models/bvlc_reference_caffenet/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from zoo_common import WEIGHT_PARAM, caffenet_trunk  # noqa: E402
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L, params as P  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def head(n, bottom):
+    n.fc8 = L.InnerProduct(
+        bottom, num_output=1000, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant", value=0))
+    return n.fc8
+
+
+def train_val():
+    n = NetSpec()
+    n.data, n.label = L.Data(
+        ntop=2, name="data", include=dict(phase=pb.TRAIN),
+        transform_param=dict(mirror=True, crop_size=227,
+                             mean_file="data/ilsvrc12/imagenet_mean.binaryproto"),
+        data_param=dict(source="examples/imagenet/ilsvrc12_train_lmdb",
+                        batch_size=256, backend=P.Data.LMDB))
+    fc8 = head(n, caffenet_trunk(n, n.data))
+    n.accuracy = L.Accuracy(fc8, n.label, include=dict(phase=pb.TEST))
+    n.loss = L.SoftmaxWithLoss(fc8, n.label)
+    proto = n.to_proto()
+    proto.name = "CaffeNet"
+    test_data = pb.LayerParameter()
+    test_data.name = "data"
+    test_data.type = "Data"
+    test_data.top.extend(["data", "label"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.mirror = False
+    test_data.transform_param.crop_size = 227
+    test_data.transform_param.mean_file = (
+        "data/ilsvrc12/imagenet_mean.binaryproto")
+    test_data.data_param.source = "examples/imagenet/ilsvrc12_val_lmdb"
+    test_data.data_param.batch_size = 50
+    test_data.data_param.backend = pb.DataParameter.LMDB
+    proto.layer.insert(1, test_data)
+    return proto
+
+
+def deploy():
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[10, 3, 227, 227])))
+    fc8 = head(n, caffenet_trunk(n, n.data))
+    n.prob = L.Softmax(fc8)
+    proto = n.to_proto()
+    proto.name = "CaffeNet"
+    return proto
+
+
+SOLVER = """\
+net: "models/bvlc_reference_caffenet/train_val.prototxt"
+test_iter: 1000
+test_interval: 1000
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 20
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/bvlc_reference_caffenet/caffenet_train"
+"""
+
+
+def main():
+    with open(os.path.join(HERE, "train_val.prototxt"), "w") as f:
+        f.write(str(train_val()))
+    with open(os.path.join(HERE, "deploy.prototxt"), "w") as f:
+        f.write(str(deploy()))
+    with open(os.path.join(HERE, "solver.prototxt"), "w") as f:
+        f.write(SOLVER)
+    print("wrote train_val.prototxt, deploy.prototxt, solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Generate the bvlc_reference_rcnn_ilsvrc13 deploy prototxt with the
+framework's net_spec DSL.
+
+R-CNN ILSVRC13 (reference models/bvlc_reference_rcnn_ilsvrc13/
+deploy.prototxt): the CaffeNet trunk ending in `fc-rcnn`, a 200-way
+detection scoring layer with NO softmax — the outputs are the pure
+inner-product scores the R-CNN pipeline's per-class SVMs were calibrated
+on (consumed by api.Detector over window proposals). Deploy-only, like the
+published model (weights were converted from the R-CNN release; there is
+no train_val).
+
+Run:  python models/bvlc_reference_rcnn_ilsvrc13/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from zoo_common import WEIGHT_PARAM, caffenet_trunk  # noqa: E402
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def deploy():
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[10, 3, 227, 227])))
+    trunk = caffenet_trunk(n, n.data)
+    n["fc-rcnn"] = L.InnerProduct(
+        trunk, num_output=200, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant", value=0))
+    proto = n.to_proto()
+    proto.name = "R-CNN-ilsvrc13"
+    return proto
+
+
+def main():
+    with open(os.path.join(HERE, "deploy.prototxt"), "w") as f:
+        f.write(str(deploy()))
+    print("wrote deploy.prototxt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Generate finetune_flickr_style train_val/deploy/solver prototxts with
+the framework's net_spec DSL.
+
+The fine-tuning exemplar (reference models/finetune_flickr_style/): the
+CaffeNet trunk fed from ImageData file lists, with a fresh 20-way
+`fc8_flickr` head at 10x/20x learning rate (every other layer fine-tunes
+at its stock rate from the CaffeNet weights passed via --weights). Shows
+the name-matched `copy_trained_from` workflow: fc8_flickr is NOT in the
+donor model, so it alone starts from its filler.
+
+Run:  python models/finetune_flickr_style/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from zoo_common import caffenet_trunk  # noqa: E402
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MEAN = "data/ilsvrc12/imagenet_mean.binaryproto"
+
+
+def head(n, bottom):
+    # 10x/20x lr: this head starts from random while the trunk is trained
+    n.fc8_flickr = L.InnerProduct(
+        bottom, num_output=20,
+        param=[dict(lr_mult=10, decay_mult=1),
+               dict(lr_mult=20, decay_mult=0)],
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant", value=0))
+    return n.fc8_flickr
+
+
+def train_val():
+    n = NetSpec()
+    n.data, n.label = L.ImageData(
+        ntop=2, name="data", include=dict(phase=pb.TRAIN),
+        transform_param=dict(mirror=True, crop_size=227, mean_file=MEAN),
+        image_data_param=dict(source="data/flickr_style/train.txt",
+                              batch_size=50, new_height=256, new_width=256))
+    fc8 = head(n, caffenet_trunk(n, n.data))
+    n.accuracy = L.Accuracy(fc8, n.label, include=dict(phase=pb.TEST))
+    n.loss = L.SoftmaxWithLoss(fc8, n.label)
+    proto = n.to_proto()
+    proto.name = "FlickrStyleCaffeNet"
+    test_data = pb.LayerParameter()
+    test_data.name = "data"
+    test_data.type = "ImageData"
+    test_data.top.extend(["data", "label"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.mirror = False
+    test_data.transform_param.crop_size = 227
+    test_data.transform_param.mean_file = MEAN
+    test_data.image_data_param.source = "data/flickr_style/test.txt"
+    test_data.image_data_param.batch_size = 50
+    test_data.image_data_param.new_height = 256
+    test_data.image_data_param.new_width = 256
+    proto.layer.insert(1, test_data)
+    return proto
+
+
+def deploy():
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[10, 3, 227, 227])))
+    fc8 = head(n, caffenet_trunk(n, n.data))
+    n.prob = L.Softmax(fc8)
+    proto = n.to_proto()
+    proto.name = "FlickrStyleCaffeNet"
+    return proto
+
+
+SOLVER = """\
+net: "models/finetune_flickr_style/train_val.prototxt"
+test_iter: 100
+test_interval: 1000
+# fine-tuning: lower lr and stepsize than training from scratch
+base_lr: 0.001
+lr_policy: "step"
+gamma: 0.1
+stepsize: 20000
+display: 20
+max_iter: 100000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/finetune_flickr_style/finetune_flickr_style"
+"""
+
+
+def main():
+    with open(os.path.join(HERE, "train_val.prototxt"), "w") as f:
+        f.write(str(train_val()))
+    with open(os.path.join(HERE, "deploy.prototxt"), "w") as f:
+        f.write(str(deploy()))
+    with open(os.path.join(HERE, "solver.prototxt"), "w") as f:
+        f.write(SOLVER)
+    print("wrote train_val.prototxt, deploy.prototxt, solver.prototxt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Shared CaffeNet body for the zoo generators.
+
+CaffeNet (reference models/bvlc_reference_caffenet/train_val.prototxt) is
+AlexNet with pooling BEFORE local response normalization (pool1->norm1,
+pool2->norm2, where AlexNet norms first) and bias 1 on conv2/4/5 + fc6/7.
+bvlc_reference_caffenet, bvlc_reference_rcnn_ilsvrc13, and
+finetune_flickr_style all share this trunk; each generator supplies its own
+head (fc8 / fc-rcnn / fc8_flickr).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from rram_caffe_simulation_tpu.api.net_spec import layers as L, params as P  # noqa: E402
+
+WEIGHT_PARAM = [dict(lr_mult=1, decay_mult=1), dict(lr_mult=2, decay_mult=0)]
+
+
+def caffenet_trunk(n, data):
+    """conv1 .. drop7 with CaffeNet's pool-then-norm ordering; returns the
+    fc7 top (post relu/dropout, in-place)."""
+
+    def conv_relu(name, bottom, nout, ks, stride=1, pad=0, group=1, bias=0):
+        n[name] = L.Convolution(
+            bottom, num_output=nout, kernel_size=ks, stride=stride, pad=pad,
+            group=group, param=WEIGHT_PARAM,
+            weight_filler=dict(type="gaussian", std=0.01),
+            bias_filler=dict(type="constant", value=bias))
+        n["relu" + name[4:]] = L.ReLU(n[name], in_place=True)
+
+    conv_relu("conv1", data, 96, 11, stride=4)
+    n.pool1 = L.Pooling(n.conv1, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    n.norm1 = L.LRN(n.pool1, local_size=5, alpha=0.0001, beta=0.75)
+    conv_relu("conv2", n.norm1, 256, 5, pad=2, group=2, bias=1)
+    n.pool2 = L.Pooling(n.conv2, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    n.norm2 = L.LRN(n.pool2, local_size=5, alpha=0.0001, beta=0.75)
+    conv_relu("conv3", n.norm2, 384, 3, pad=1)
+    conv_relu("conv4", n.conv3, 384, 3, pad=1, group=2, bias=1)
+    conv_relu("conv5", n.conv4, 256, 3, pad=1, group=2, bias=1)
+    n.pool5 = L.Pooling(n.conv5, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    for idx, bottom in ((6, n.pool5), (7, None)):
+        n[f"fc{idx}"] = L.InnerProduct(
+            bottom if bottom is not None else n.fc6,
+            num_output=4096, param=WEIGHT_PARAM,
+            weight_filler=dict(type="gaussian", std=0.005),
+            bias_filler=dict(type="constant", value=1))
+        n[f"relu{idx}"] = L.ReLU(n[f"fc{idx}"], in_place=True)
+        n[f"drop{idx}"] = L.Dropout(n[f"fc{idx}"], dropout_ratio=0.5,
+                                    in_place=True)
+    return n.fc7

@@ -111,6 +111,83 @@ def test_googlenet_train_backward(tmp_path):
         assert np.abs(g).sum() > 0, lname
 
 
+def test_caffenet_deploy_and_ordering():
+    """CaffeNet is AlexNet with pool BEFORE norm; deploy must build,
+    forward to a softmax, and keep the published layer ordering."""
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", "bvlc_reference_caffenet",
+                     "deploy.prototxt"))
+    names = [lp.name for lp in npar.layer]
+    assert names.index("pool1") < names.index("norm1")
+    assert names.index("pool2") < names.index("norm2")
+    npar.layer[0].input_param.shape[0].dim[0] = 2
+    net = Net(npar, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    blobs, _ = net.apply(params, _synthetic_batch(227))
+    prob = np.asarray(blobs["prob"])
+    assert prob.shape == (2, 1000)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_rcnn_deploy_raw_scores():
+    """R-CNN ILSVRC13: 200-way fc-rcnn output with NO softmax (the scores
+    feed per-class SVMs, reference models/bvlc_reference_rcnn_ilsvrc13)."""
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", "bvlc_reference_rcnn_ilsvrc13",
+                     "deploy.prototxt"))
+    assert all(lp.type != "Softmax" for lp in npar.layer)
+    npar.layer[0].input_param.shape[0].dim[0] = 2
+    net = Net(npar, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    blobs, _ = net.apply(params, _synthetic_batch(227))
+    scores = np.asarray(blobs["fc-rcnn"])
+    assert scores.shape == (2, 200)
+    assert (scores < 0).any()  # raw inner-product scores, not probabilities
+
+
+def test_flickr_finetune_head_and_weight_copy(tmp_path):
+    """finetune_flickr_style: fc8_flickr at 10x/20x lr, and name-matched
+    copy_trained_from fills the CaffeNet trunk but leaves the new head at
+    its filler init (the reference fine-tuning contract)."""
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", "finetune_flickr_style",
+                     "train_val.prototxt"))
+    fc8 = next(lp for lp in npar.layer if lp.name == "fc8_flickr")
+    assert [p.lr_mult for p in fc8.param] == [10, 20]
+
+    # swap ImageData for Input so the net builds without image files
+    for lp in list(npar.layer):
+        if lp.type == "ImageData":
+            npar.layer.remove(lp)
+    inp = pb.LayerParameter()
+    inp.name = "data"
+    inp.type = "Input"
+    inp.top.extend(["data", "label"])
+    s = inp.input_param.shape.add()
+    s.dim.extend([2, 3, 227, 227])
+    s2 = inp.input_param.shape.add()
+    s2.dim.extend([2])
+    npar.layer.insert(0, inp)
+    net = Net(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+
+    # donor: CaffeNet deploy net with marker weights
+    dpar = uio.read_net_param(
+        os.path.join(REPO, "models", "bvlc_reference_caffenet",
+                     "deploy.prototxt"))
+    donor = Net(dpar, pb.TEST)
+    dparams = donor.init(jax.random.PRNGKey(1))
+    dparams["conv1"][0] = jnp.full_like(dparams["conv1"][0], 0.125)
+    model_path = str(tmp_path / "caffenet.caffemodel")
+    uio.write_proto_binary(model_path, donor.to_proto(dparams))
+
+    head_before = np.asarray(params["fc8_flickr"][0]).copy()
+    params = net.copy_trained_from(params, model_path)
+    np.testing.assert_array_equal(np.asarray(params["conv1"][0]), 0.125)
+    np.testing.assert_array_equal(np.asarray(params["fc8_flickr"][0]),
+                                  head_before)
+
+
 def test_googlenet_test_phase_has_topk(tmp_path):
     npar = uio.read_net_param(
         os.path.join(REPO, "models", "bvlc_googlenet", "train_val.prototxt"))
