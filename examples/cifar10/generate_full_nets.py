@@ -1,0 +1,172 @@
+"""Generate the CIFAR-10 "full" family (reference examples/cifar10/):
+cifar10_full (ReLU + WITHIN_CHANNEL LRN), the sigmoid variant, and the
+sigmoid+BatchNorm variant, plus their solvers — the nets the reference
+ships beyond quick. Sources point at the in-repo sample LMDBs; ~81%
+(full) needs the complete 60k-image set (reference examples/cifar10/
+readme.md).
+
+Run:  python examples/cifar10/generate_full_nets.py
+"""
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, ROOT)
+
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L, params as P  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+WEIGHT_PARAM = [dict(lr_mult=1), dict(lr_mult=2)]
+BN_PARAM = [dict(lr_mult=0)] * 3  # moving mean/var/scale-bias are not learned
+
+
+def data_layers(proto_name):
+    """TRAIN + TEST Data layers over the in-repo sample LMDBs."""
+    out = []
+    for phase, split in ((pb.TRAIN, "train"), (pb.TEST, "test")):
+        lp = pb.LayerParameter()
+        lp.name = "cifar"
+        lp.type = "Data"
+        lp.top.extend(["data", "label"])
+        lp.include.add().phase = phase
+        lp.transform_param.mean_file = "examples/cifar10/mean.binaryproto"
+        lp.data_param.source = f"examples/cifar10/cifar10_{split}_lmdb"
+        lp.data_param.batch_size = 100
+        lp.data_param.backend = pb.DataParameter.LMDB
+        out.append(lp)
+    return out
+
+
+def conv(n, name, bottom, std):
+    n[name] = L.Convolution(
+        bottom, num_output=32 if name != "conv3" else 64, pad=2,
+        kernel_size=5, stride=1, param=WEIGHT_PARAM,
+        weight_filler=dict(type="gaussian", std=std),
+        bias_filler=dict(type="constant"))
+    return n[name]
+
+
+def head(n, bottom):
+    n.ip1 = L.InnerProduct(
+        bottom, num_output=10,
+        param=[dict(lr_mult=1, decay_mult=250), dict(lr_mult=2, decay_mult=0)],
+        weight_filler=dict(type="gaussian", std=0.01),
+        bias_filler=dict(type="constant"))
+    n.accuracy = L.Accuracy(n.ip1, n.label, include=dict(phase=pb.TEST))
+    n.loss = L.SoftmaxWithLoss(n.ip1, n.label)
+
+
+def full_net():
+    """conv-pool-relu-LRN x2 (WITHIN_CHANNEL) + conv-relu-pool + ip."""
+    n = NetSpec()
+    n.data, n.label = L.Input(
+        ntop=2, name="cifar",
+        input_param=dict(shape=[dict(dim=[100, 3, 32, 32]),
+                                dict(dim=[100])]))
+    conv(n, "conv1", n.data, 0.0001)
+    n.pool1 = L.Pooling(n.conv1, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    n.relu1 = L.ReLU(n.pool1, in_place=True)
+    n.norm1 = L.LRN(n.pool1, local_size=3, alpha=5e-5, beta=0.75,
+                    norm_region=P.LRN.WITHIN_CHANNEL)
+    conv(n, "conv2", n.norm1, 0.01)
+    n.relu2 = L.ReLU(n.conv2, in_place=True)
+    n.pool2 = L.Pooling(n.conv2, pool=P.Pooling.AVE, kernel_size=3, stride=2)
+    n.norm2 = L.LRN(n.pool2, local_size=3, alpha=5e-5, beta=0.75,
+                    norm_region=P.LRN.WITHIN_CHANNEL)
+    conv(n, "conv3", n.norm2, 0.01)
+    n.relu3 = L.ReLU(n.conv3, in_place=True)
+    n.pool3 = L.Pooling(n.conv3, pool=P.Pooling.AVE, kernel_size=3, stride=2)
+    head(n, n.pool3)
+    return finish(n, "CIFAR10_full")
+
+
+def sigmoid_net(with_bn):
+    """conv-pool-[bn]-sigmoid stacks (the BN ablation pair the reference
+    ships to show sigmoid nets only train with normalization)."""
+    n = NetSpec()
+    n.data, n.label = L.Input(
+        ntop=2, name="cifar",
+        input_param=dict(shape=[dict(dim=[100, 3, 32, 32]),
+                                dict(dim=[100])]))
+    conv(n, "conv1", n.data, 0.0001)
+    n.pool1 = L.Pooling(n.conv1, pool=P.Pooling.MAX, kernel_size=3, stride=2)
+    act1_in = n.pool1
+    if with_bn:
+        n.bn1 = L.BatchNorm(n.pool1, param=BN_PARAM)
+        act1_in = n.bn1
+    n.Sigmoid1 = L.Sigmoid(act1_in, in_place=True)
+    conv(n, "conv2", act1_in, 0.01)
+    act2_in = n.conv2
+    if with_bn:
+        n.bn2 = L.BatchNorm(n.conv2, param=BN_PARAM)
+        act2_in = n.bn2
+    n.Sigmoid2 = L.Sigmoid(act2_in, in_place=True)
+    n.pool2 = L.Pooling(act2_in, pool=P.Pooling.AVE, kernel_size=3, stride=2)
+    conv(n, "conv3", n.pool2, 0.01)
+    act3_in = n.conv3
+    if with_bn:
+        n.bn3 = L.BatchNorm(n.conv3, param=BN_PARAM)
+        act3_in = n.bn3
+    n.Sigmoid3 = L.Sigmoid(act3_in, in_place=True)
+    n.pool3 = L.Pooling(act3_in, pool=P.Pooling.AVE, kernel_size=3, stride=2)
+    head(n, n.pool3)
+    return finish(n, "CIFAR10_full_sigmoid" + ("_bn" if with_bn else ""))
+
+
+def finish(n, name):
+    proto = n.to_proto()
+    proto.name = name
+    # swap the Input scaffold for the TRAIN/TEST Data layer pair
+    del proto.layer[0]
+    for lp in reversed(data_layers(name)):
+        proto.layer.insert(0, lp)
+    return proto
+
+
+def solver(net_file, prefix, base_lr=0.001, max_iter=60000, momentum=0.9):
+    return f"""\
+net: "examples/cifar10/{net_file}"
+test_iter: 100
+test_interval: 1000
+base_lr: {base_lr}
+momentum: {momentum}
+weight_decay: 0.004
+lr_policy: "fixed"
+display: 200
+max_iter: {max_iter}
+snapshot: 10000
+snapshot_format: HDF5
+snapshot_prefix: "examples/cifar10/{prefix}"
+"""
+
+
+def main():
+    out = {
+        "cifar10_full_train_test.prototxt": str(full_net()),
+        "cifar10_full_sigmoid_train_test.prototxt": str(sigmoid_net(False)),
+        "cifar10_full_sigmoid_train_test_bn.prototxt": str(sigmoid_net(True)),
+        "cifar10_full_solver.prototxt":
+            solver("cifar10_full_train_test.prototxt", "cifar10_full"),
+        # the two continuation solvers of the reference's 3-stage schedule
+        "cifar10_full_solver_lr1.prototxt":
+            solver("cifar10_full_train_test.prototxt", "cifar10_full",
+                   base_lr=0.0001, max_iter=65000),
+        "cifar10_full_solver_lr2.prototxt":
+            solver("cifar10_full_train_test.prototxt", "cifar10_full",
+                   base_lr=0.00001, max_iter=70000),
+        "cifar10_full_sigmoid_solver.prototxt":
+            solver("cifar10_full_sigmoid_train_test.prototxt",
+                   "cifar10_full_sigmoid"),
+        "cifar10_full_sigmoid_solver_bn.prototxt":
+            solver("cifar10_full_sigmoid_train_test_bn.prototxt",
+                   "cifar10_full_sigmoid_bn"),
+    }
+    for fname, text in out.items():
+        with open(os.path.join(HERE, fname), "w") as f:
+            f.write(text)
+    print("wrote", ", ".join(sorted(out)))
+
+
+if __name__ == "__main__":
+    main()

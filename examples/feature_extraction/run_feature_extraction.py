@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Feature-extraction example (reference examples/feature_extraction):
+train (or load) CIFAR-10-quick, then dump pool3 + ip1 features of the
+test LMDB to float-Datum LMDBs via the extract_features CLI subcommand,
+and verify the round-trip.
+
+    python examples/feature_extraction/run_feature_extraction.py \
+        [--weights snapshot.caffemodel.h5] [--iters 200] [--batches 5]
+"""
+import argparse
+import os
+import shutil
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, ROOT)
+
+from rram_caffe_simulation_tpu.data.db import open_db  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+from rram_caffe_simulation_tpu.tools import caffe_cli  # noqa: E402
+from rram_caffe_simulation_tpu.utils import io as uio  # noqa: E402
+
+
+def train_quick(iters):
+    """A short CIFAR-quick run on the sample LMDB to get weights."""
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.solver import Solver
+    sp = pb.SolverParameter()
+    with open(os.path.join(ROOT, "models", "cifar10_quick",
+                           "cifar10_quick_lmdb_solver.prototxt")) as f:
+        text_format.Merge(f.read(), sp)
+    sp.max_iter = iters
+    sp.display = max(iters // 4, 1)
+    sp.ClearField("test_interval")
+    sp.ClearField("test_iter")
+    sp.snapshot = 0
+    sp.snapshot_after_train = True
+    sp.snapshot_prefix = os.path.join(HERE, "quick")
+    solver = Solver(sp)
+    solver.solve()
+    return solver.snapshot_filename(".caffemodel.h5")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--weights", default="")
+    p.add_argument("--iters", type=int, default=200)
+    p.add_argument("--batches", type=int, default=5)
+    args = p.parse_args(argv)
+    os.chdir(ROOT)  # prototxt data sources are repo-root relative
+
+    weights = args.weights or train_quick(args.iters)
+    proto = os.path.join("models", "cifar10_quick",
+                         "cifar10_quick_lmdb_train_test.prototxt")
+    dbs = [os.path.join(HERE, "features_pool3_lmdb"),
+           os.path.join(HERE, "features_ip1_lmdb")]
+    for db in dbs:
+        shutil.rmtree(db, ignore_errors=True)
+
+    rc = caffe_cli.main([
+        "extract_features", weights, proto, "pool3,ip1", ",".join(dbs),
+        str(args.batches), "lmdb"])
+    assert rc in (0, None), rc
+
+    # round-trip check: N batches x batch_size float Datums per blob
+    npar = uio.read_net_param(proto)
+    batch = next(lp.data_param.batch_size for lp in npar.layer
+                 if lp.type == "Data" and
+                 any(r.phase == pb.TEST for r in lp.include))
+    for db_path, blob in zip(dbs, ("pool3", "ip1")):
+        db = open_db(db_path, "lmdb")
+        cur = db.cursor()
+        total = len(db)
+        dims = None
+        for n in range(total):  # the cursor wraps like DataReader's
+            datum = pb.Datum.FromString(cur.value())
+            assert cur.key().decode() == f"{n:010d}"
+            vec = np.asarray(datum.float_data, np.float32)
+            assert vec.size == datum.channels * datum.height * datum.width
+            dims = (datum.channels, datum.height, datum.width)
+            cur.next()
+        n = total
+        print(f"{blob}: {n} feature vectors of {dims} in {db_path}")
+        assert n == args.batches * batch
+    print("feature extraction OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

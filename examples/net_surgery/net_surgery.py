@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Net surgery example (reference examples/net_surgery.ipynb): editing
+model parameters in place through the pycaffe-style API, and casting a
+classifier's inner-product layers into convolutions for dense,
+fully-convolutional inference.
+
+Part 1 — designer filters: a one-conv net's randomly initialized filters
+are overwritten with a Gaussian blur and a Sobel edge detector; the
+blurred response loses high-frequency energy, the Sobel response picks up
+the vertical edge.
+
+Part 2 — the full-conv cast (reference bvlc_caffenet_full_conv.prototxt):
+CaffeNet's fc6/fc7/fc8 become fc6-conv (6x6)/fc7-conv (1x1)/fc8-conv
+(1x1); the fc weights transplant by flat reshape (innerproduct and
+convolution weights have identical memory layout over the same receptive
+field). At the original 227x227 input the conv-cast net reproduces the
+classifier's probabilities EXACTLY (pinned to 1e-5); at 451x451 it emits
+an 8x8 map of class scores in one forward.
+
+    python examples/net_surgery/net_surgery.py
+"""
+import os
+import sys
+
+import numpy as np
+from google.protobuf import text_format
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "models"))
+
+from rram_caffe_simulation_tpu import api  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+CONV_NET = """
+name: "ConvSurgery"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 1 dim: 32 dim: 32 } } }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 2 kernel_size: 5 pad: 2
+    weight_filler { type: "gaussian" std: 0.01 } } }
+"""
+
+
+def gaussian_kernel(size=5, sigma=1.5):
+    ax = np.arange(size) - size // 2
+    g = np.exp(-(ax[:, None] ** 2 + ax[None, :] ** 2) / (2 * sigma ** 2))
+    return g / g.sum()
+
+
+def designer_filters():
+    """Part 1: overwrite filters in net.params and observe the responses."""
+    npar = pb.NetParameter()
+    text_format.Parse(CONV_NET, npar)
+    net = api.Net(npar, pb.TEST)
+
+    # an image with a vertical edge + noise
+    rng = np.random.RandomState(0)
+    im = np.zeros((1, 1, 32, 32), np.float32)
+    im[..., 16:] = 1.0
+    im += rng.randn(*im.shape).astype(np.float32) * 0.1
+
+    # surgery: filter 0 = Gaussian blur, filter 1 = Sobel x
+    net.params["conv"][0].data[0, 0] = gaussian_kernel()
+    sobel = np.zeros((5, 5), np.float32)
+    sobel[1:4, 1:4] = [[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]]
+    net.params["conv"][0].data[1, 0] = sobel
+    net.params["conv"][1].data[:] = 0
+
+    out = net.forward(data=im)["conv"]
+    blur, edge = out[0, 0], out[0, 1]
+    hf = lambda a: np.abs(np.diff(a, axis=1)).mean()  # noqa: E731
+    print(f"high-frequency energy: input {hf(im[0, 0]):.4f} "
+          f"-> blurred {hf(blur):.4f}")
+    assert hf(blur) < hf(im[0, 0]) * 0.6, "blur must suppress noise"
+    edge_col = np.abs(edge[:, 14:18]).mean()
+    flat_col = np.abs(edge[:, 4:8]).mean()
+    print(f"sobel response: edge band {edge_col:.3f} vs flat {flat_col:.3f}")
+    assert edge_col > 5 * flat_col, "sobel must localize the edge"
+
+
+def full_conv_proto():
+    """bvlc_caffenet_full_conv: the CaffeNet trunk with conv fc layers,
+    451x451 input (generated, like the zoo prototxts)."""
+    from zoo_common import WEIGHT_PARAM, caffenet_trunk
+    from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L
+
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[1, 3, 451, 451])))
+    caffenet_trunk(n, n.data)
+    proto = n.to_proto()
+    proto.name = "CaffeNetConv"
+    # drop fc6..drop7; rebuild as convolutions
+    keep = [lp for lp in proto.layer
+            if not (lp.name.startswith(("fc", "relu6", "relu7", "drop")))]
+    del proto.layer[:]
+    proto.layer.extend(keep)
+
+    m = NetSpec()
+    # a scaffold Input named pool5 grafts the head onto the trunk's last
+    # blob; the Input layer itself is dropped below
+    m.pool5 = L.Input(input_param=dict(shape=dict(dim=[1, 256, 6, 6])))
+    m["fc6-conv"] = L.Convolution(
+        m.pool5, num_output=4096, kernel_size=6, param=WEIGHT_PARAM)
+    m["relu6"] = L.ReLU(m["fc6-conv"], in_place=True)
+    m["fc7-conv"] = L.Convolution(
+        m["fc6-conv"], num_output=4096, kernel_size=1, param=WEIGHT_PARAM)
+    m["relu7"] = L.ReLU(m["fc7-conv"], in_place=True)
+    m["fc8-conv"] = L.Convolution(
+        m["fc7-conv"], num_output=1000, kernel_size=1, param=WEIGHT_PARAM)
+    m.prob = L.Softmax(m["fc8-conv"])
+    head = m.to_proto()
+    proto.layer.extend(lp for lp in head.layer if lp.type != "Input")
+    return proto
+
+
+def transplant(dst, src):
+    """fc -> conv weight transplant: identical flat layout, reshaped."""
+    for conv_name, fc_name in (("fc6-conv", "fc6"), ("fc7-conv", "fc7"),
+                               ("fc8-conv", "fc8")):
+        for i in (0, 1):
+            dst.params[conv_name][i].data[:] = (
+                src.params[fc_name][i].data.reshape(
+                    dst.params[conv_name][i].data.shape))
+
+
+def full_conv_cast():
+    """Part 2: conv-cast CaffeNet == the classifier at 227, dense at 451."""
+    fc_net = api.Net(os.path.join(ROOT, "models", "bvlc_reference_caffenet",
+                                  "deploy.prototxt"), pb.TEST)
+    proto = full_conv_proto()
+    with open(os.path.join(HERE, "bvlc_caffenet_full_conv.prototxt"),
+              "w") as f:
+        f.write(str(proto))
+
+    # numeric-contract check at 227: the conv net must reproduce the
+    # classifier's probabilities bit-for-near-bit
+    for shape in proto.layer[0].input_param.shape:
+        shape.dim[2] = shape.dim[3] = 227
+    conv_net = api.Net(proto, pb.TEST)
+    # trunk weights share names; heads transplant by reshape
+    for lname in ("conv1", "conv2", "conv3", "conv4", "conv5"):
+        for i in (0, 1):
+            conv_net.params[lname][i].data[:] = fc_net.params[lname][i].data
+    transplant(conv_net, fc_net)
+
+    rng = np.random.RandomState(1)
+    im = rng.rand(1, 3, 227, 227).astype(np.float32) * 255
+    probs_fc = fc_net.forward(data=im[:1])["prob"]
+    probs_conv = conv_net.forward(data=im)["prob"]
+    np.testing.assert_allclose(probs_conv[0, :, 0, 0], probs_fc[0],
+                               atol=1e-5)
+    print("227x227: conv-cast probabilities match the classifier (1e-5)")
+
+    # dense inference at 451: one forward -> a map of class scores
+    proto451 = full_conv_proto()
+    conv451 = api.Net(proto451, pb.TEST)
+    for lname in ("conv1", "conv2", "conv3", "conv4", "conv5"):
+        for i in (0, 1):
+            conv451.params[lname][i].data[:] = fc_net.params[lname][i].data
+    transplant(conv451, fc_net)
+    im451 = rng.rand(1, 3, 451, 451).astype(np.float32) * 255
+    out = conv451.forward(data=im451)["prob"]
+    print(f"451x451: dense class-probability map {out.shape[2]}x"
+          f"{out.shape[3]} in one forward")
+    assert out.shape[1] == 1000 and out.shape[2] >= 8
+
+
+def main():
+    designer_filters()
+    full_conv_cast()
+    print("net surgery OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+"""Batch image classification CLI (reference python/classify.py parity).
+
+Loads a deploy net + weights, preprocesses one image file, a directory of
+images, or a saved .npy batch, runs (optionally oversampled) prediction
+through api.Classifier, and saves the probability matrix as .npy.
+
+    python -m rram_caffe_simulation_tpu.tools.classify \
+        input.jpg out.npy \
+        --model-def models/bvlc_reference_caffenet/deploy.prototxt \
+        --pretrained-model caffenet.caffemodel \
+        --mean-file ilsvrc12_mean.npy --raw-scale 255 --channel-swap 2,1,0
+"""
+import argparse
+import glob
+import os
+import time
+
+import numpy as np
+
+from ..api import io as caffe_io
+from ..api.classifier import Classifier
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input_file", help="image, directory of images, or .npy")
+    p.add_argument("output_file", help="output .npy of (N, classes) probs")
+    p.add_argument("--model-def", required=True)
+    p.add_argument("--pretrained-model", required=True)
+    p.add_argument("--center-only", action="store_true",
+                   help="single center crop instead of 10-crop oversample")
+    p.add_argument("--images-dim", default="256,256",
+                   help="H,W to resize inputs to before cropping")
+    p.add_argument("--mean-file", default="",
+                   help=".npy of the (C,H,W) training mean")
+    p.add_argument("--input-scale", type=float, default=None)
+    p.add_argument("--raw-scale", type=float, default=255.0)
+    p.add_argument("--channel-swap", default="2,1,0",
+                   help="e.g. 2,1,0 maps RGB loading to BGR nets")
+    p.add_argument("--ext", default="jpg",
+                   help="extension glob for directory inputs")
+    return p
+
+
+def load_inputs(path, ext):
+    path = os.path.expanduser(path)
+    if path.endswith(".npy"):
+        return np.load(path)
+    if os.path.isdir(path):
+        return np.array([caffe_io.load_image(f) for f in
+                         sorted(glob.glob(os.path.join(path, "*." + ext)))])
+    return np.array([caffe_io.load_image(path)])
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    mean = np.load(args.mean_file) if args.mean_file else None
+    channel_swap = ([int(s) for s in args.channel_swap.split(",")]
+                    if args.channel_swap else None)
+    image_dims = [int(s) for s in args.images_dim.split(",")]
+
+    net = Classifier(args.model_def, args.pretrained_model,
+                     image_dims=image_dims, mean=mean,
+                     input_scale=args.input_scale, raw_scale=args.raw_scale,
+                     channel_swap=channel_swap)
+    inputs = load_inputs(args.input_file, args.ext)
+    print(f"Classifying {len(inputs)} inputs.")
+    start = time.time()
+    predictions = net.predict(inputs, oversample=not args.center_only)
+    print(f"Done in {time.time() - start:.2f} s.")
+    np.save(os.path.expanduser(args.output_file), predictions)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

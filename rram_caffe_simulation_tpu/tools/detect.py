@@ -1,0 +1,102 @@
+"""Windowed detection CLI (reference python/detect.py parity).
+
+Scores proposal windows with api.Detector and writes one row per window
+(filename, ymin, xmin, ymax, xmax, plus the per-class scores) to a CSV or
+an .npz bundle. Window sources:
+
+- ``--crop-mode=list``: a CSV of `filename,ymin,xmin,ymax,xmax` rows;
+- a windows file in the R-CNN block format (api.load_windows_file) when
+  the input ends in `.txt` and --crop-mode=windows (the format the
+  reference's WindowDataLayer reads).
+
+Selective-search proposals are NOT generated here — the reference shells
+out to a MATLAB package for that; provide windows from your proposal
+source in either format above.
+
+    python -m rram_caffe_simulation_tpu.tools.detect \
+        windows.csv out.csv \
+        --model-def models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt \
+        --pretrained-model rcnn.caffemodel --context-pad 16
+"""
+import argparse
+import csv
+import os
+import time
+
+import numpy as np
+
+from ..api.detector import Detector, load_windows_file
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input_file", help="window CSV or R-CNN windows file")
+    p.add_argument("output_file", help=".csv or .npz of window scores")
+    p.add_argument("--model-def", required=True)
+    p.add_argument("--pretrained-model", required=True)
+    p.add_argument("--crop-mode", default="list",
+                   choices=["list", "windows"])
+    p.add_argument("--mean-file", default="")
+    p.add_argument("--input-scale", type=float, default=None)
+    p.add_argument("--raw-scale", type=float, default=255.0)
+    p.add_argument("--channel-swap", default="2,1,0")
+    p.add_argument("--context-pad", type=int, default=16)
+    return p
+
+
+def load_window_csv(path):
+    """`filename,ymin,xmin,ymax,xmax` rows -> [(fname, windows)]."""
+    per_image = {}
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row:
+                continue
+            per_image.setdefault(row[0], []).append(
+                [float(v) for v in row[1:5]])
+    return [(fname, np.asarray(wins)) for fname, wins in per_image.items()]
+
+
+def save(path, detections):
+    path = os.path.expanduser(path)
+    if path.endswith(".npz"):
+        np.savez(path,
+                 filenames=np.array([d["filename"] for d in detections]),
+                 windows=np.stack([d["window"] for d in detections]),
+                 predictions=np.stack([d["prediction"]
+                                       for d in detections]))
+        return
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        n_cls = len(detections[0]["prediction"]) if detections else 0
+        w.writerow(["filename", "ymin", "xmin", "ymax", "xmax"] +
+                   [f"score_{i}" for i in range(n_cls)])
+        for d in detections:
+            w.writerow([d["filename"], *np.asarray(d["window"]).tolist(),
+                        *np.asarray(d["prediction"]).tolist()])
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    mean = np.load(args.mean_file) if args.mean_file else None
+    channel_swap = ([int(s) for s in args.channel_swap.split(",")]
+                    if args.channel_swap else None)
+    detector = Detector(args.model_def, args.pretrained_model, mean=mean,
+                        input_scale=args.input_scale,
+                        raw_scale=args.raw_scale, channel_swap=channel_swap,
+                        context_pad=args.context_pad)
+    if args.crop_mode == "windows":
+        images_windows = load_windows_file(args.input_file)
+    else:
+        images_windows = load_window_csv(args.input_file)
+    n_windows = sum(len(w) for _, w in images_windows)
+    print(f"Scoring {n_windows} windows from {len(images_windows)} images.")
+    start = time.time()
+    detections = detector.detect_windows(images_windows)
+    print(f"Processed {n_windows} windows in {time.time() - start:.3f} s.")
+    save(args.output_file, detections)
+    print(f"Saved to {args.output_file}.")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,41 @@
+"""Net-graph rendering CLI (reference python/draw_net.py parity).
+
+Reads a net prototxt and renders its layer graph via api.draw (graphviz
+DOT; rendered to an image when the `dot` binary is available, else the
+.dot source is written).
+
+    python -m rram_caffe_simulation_tpu.tools.draw_net \
+        models/bvlc_googlenet/train_val.prototxt googlenet.png \
+        --rankdir BT --phase TRAIN
+"""
+import argparse
+
+from ..api.draw import draw_net_to_file
+from ..proto import pb
+from ..utils import io as uio
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input_net_proto_file")
+    p.add_argument("output_image_file",
+                   help=".png/.pdf/.svg (needs graphviz) or .dot")
+    p.add_argument("--rankdir", default="LR",
+                   help="LR (horizontal), TB, BT (bottom-up like the "
+                        "reference examples)")
+    p.add_argument("--phase", default="ALL", choices=["TRAIN", "TEST", "ALL"],
+                   help="restrict include/exclude-filtered layers")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    net_param = uio.read_net_param(args.input_net_proto_file)
+    phase = {"TRAIN": pb.TRAIN, "TEST": pb.TEST, "ALL": None}[args.phase]
+    print(f"Drawing net to {args.output_image_file}")
+    draw_net_to_file(net_param, args.output_image_file, args.rankdir, phase)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,110 @@
+"""The python-facade CLI scripts (reference python/classify.py, detect.py,
+draw_net.py parity): end-to-end over tiny nets and synthetic images."""
+import os
+
+import numpy as np
+import jax
+import pytest
+from PIL import Image
+
+from rram_caffe_simulation_tpu.net import Net as CoreNet
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.tools import classify, detect, draw_net
+from rram_caffe_simulation_tpu.utils import io as uio
+from google.protobuf import text_format
+
+DEPLOY = """
+name: "TinyDeploy"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+@pytest.fixture()
+def deploy_files(tmp_path):
+    npar = pb.NetParameter()
+    text_format.Parse(DEPLOY, npar)
+    proto_path = str(tmp_path / "deploy.prototxt")
+    uio.write_proto_text(proto_path, npar)
+    net = CoreNet(npar, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    model_path = str(tmp_path / "weights.caffemodel")
+    uio.write_proto_binary(model_path, net.to_proto(params))
+    return proto_path, model_path
+
+
+def _png(path, size=(20, 24), seed=0):
+    rng = np.random.RandomState(seed)
+    Image.fromarray(rng.randint(0, 255, size=(size[1], size[0], 3),
+                                dtype=np.uint8)).save(path)
+    return str(path)
+
+
+def test_classify_cli(tmp_path, deploy_files):
+    proto_path, model_path = deploy_files
+    img = _png(tmp_path / "in.png")
+    out = str(tmp_path / "out.npy")
+    rc = classify.main([
+        img, out, "--model-def", proto_path,
+        "--pretrained-model", model_path,
+        "--images-dim", "18,18", "--center-only", "--ext", "png"])
+    assert rc == 0
+    probs = np.load(out)
+    assert probs.shape == (1, 5)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+    # directory input + oversample (10 crops averaged per image)
+    d = tmp_path / "imgs"
+    d.mkdir()
+    _png(d / "a.png", seed=1)
+    _png(d / "b.png", seed=2)
+    rc = classify.main([
+        str(d), out, "--model-def", proto_path,
+        "--pretrained-model", model_path,
+        "--images-dim", "18,18", "--ext", "png"])
+    assert rc == 0
+    assert np.load(out).shape == (2, 5)
+
+
+def test_detect_cli(tmp_path, deploy_files):
+    proto_path, model_path = deploy_files
+    img = _png(tmp_path / "scene.png", size=(40, 40))
+    csv_in = tmp_path / "windows.csv"
+    csv_in.write_text(f"{img},0,0,20,20\n{img},10,10,36,36\n")
+    out = str(tmp_path / "det.csv")
+    rc = detect.main([
+        str(csv_in), out, "--model-def", proto_path,
+        "--pretrained-model", model_path, "--context-pad", "2"])
+    assert rc == 0
+    rows = open(out).read().strip().splitlines()
+    assert len(rows) == 3  # header + 2 windows
+    assert rows[0].split(",")[:5] == ["filename", "ymin", "xmin", "ymax",
+                                     "xmax"]
+    assert len(rows[1].split(",")) == 5 + 5  # window + 5 class scores
+
+    # npz output path
+    out_npz = str(tmp_path / "det.npz")
+    rc = detect.main([
+        str(csv_in), out_npz, "--model-def", proto_path,
+        "--pretrained-model", model_path])
+    data = np.load(out_npz)
+    assert data["predictions"].shape == (2, 5)
+    assert data["windows"].shape == (2, 4)
+
+
+def test_draw_net_cli(tmp_path, deploy_files):
+    proto_path, _ = deploy_files
+    out = str(tmp_path / "net.dot")
+    rc = draw_net.main([proto_path, out, "--rankdir", "BT"])
+    assert rc == 0
+    dot = open(out).read()
+    for lname in ("conv1", "fc", "prob"):
+        assert lname in dot

@@ -1,0 +1,128 @@
+"""Fast regressions for the runnable example workflows (reference
+examples/hdf5_classification, examples/net_surgery): small operating
+points of the same scripts the readmes document."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load(rel, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_hdf5_classification_gap(tmp_path):
+    """The nonlinear net must beat logistic regression on the two-cluster
+    task — the reference example's central claim — at a reduced operating
+    point (fewer iters/samples) so the CPU suite stays fast."""
+    ex = _load("examples/hdf5_classification/run_hdf5_classification.py",
+               "run_hdf5_classification")
+    X, y = ex.make_dataset(n=3000)
+    data_dir = str(tmp_path)
+    ex.write_hdf5(data_dir, X, y, split=2250)
+
+    import contextlib
+    import io as _io
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        acc_lin = ex.solve("LogisticRegressionNet", 0, data_dir,
+                           max_iter=600)
+        acc_relu = ex.solve("NonlinearNet", 40, data_dir, max_iter=600)
+    assert acc_relu > acc_lin + 0.03, (acc_lin, acc_relu)
+    assert acc_lin > 0.6  # the linear model still beats chance
+
+
+def test_net_surgery_designer_filters():
+    """Part 1 of the example: in-place filter surgery through the pycaffe
+    params mirrors changes the forward response as designed."""
+    ex = _load("examples/net_surgery/net_surgery.py", "net_surgery")
+    ex.designer_filters()  # has its own asserts
+
+
+def test_net_surgery_fc_conv_cast_miniature():
+    """The fc->conv flat-reshape transplant contract on a miniature net
+    (the full CaffeNet cast runs in the example itself): an InnerProduct
+    over an 8-channel 4x4 blob equals a 4x4 Convolution with the
+    reshaped weights."""
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu import api
+    from rram_caffe_simulation_tpu.proto import pb
+
+    fc_net = api.Net(_parse("""
+name: "FC"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 8 dim: 4 dim: 4 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "out"
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.1 } } }
+"""), pb.TEST)
+    conv_net = api.Net(_parse("""
+name: "Conv"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 8 dim: 4 dim: 4 } } }
+layer { name: "fc-conv" type: "Convolution" bottom: "data" top: "out"
+  convolution_param { num_output: 10 kernel_size: 4 } }
+"""), pb.TEST)
+    for i in (0, 1):
+        conv_net.params["fc-conv"][i].data[:] = (
+            fc_net.params["fc"][i].data.reshape(
+                conv_net.params["fc-conv"][i].data.shape))
+    x = np.random.RandomState(0).randn(2, 8, 4, 4).astype(np.float32)
+    out_fc = fc_net.forward(data=x)["out"]
+    out_conv = conv_net.forward(data=x)["out"]
+    np.testing.assert_allclose(out_conv[..., 0, 0], out_fc, atol=1e-5)
+
+
+def _parse(text):
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    npar = pb.NetParameter()
+    text_format.Parse(text, npar)
+    return npar
+
+
+import pytest
+
+
+@pytest.mark.parametrize("net_file", [
+    "cifar10_full_train_test.prototxt",
+    "cifar10_full_sigmoid_train_test.prototxt",
+    "cifar10_full_sigmoid_train_test_bn.prototxt",
+])
+def test_cifar10_full_family_trains(net_file, tmp_path):
+    """The reference's CIFAR-10 'full' family (WITHIN_CHANNEL LRN net,
+    sigmoid net, sigmoid+BN net) builds against the sample LMDBs and takes
+    solver steps with a finite, decreasing-or-stable loss."""
+    import numpy as np
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    cwd = os.getcwd()
+    os.chdir(REPO)  # prototxt sources are repo-root relative
+    try:
+        sp = pb.SolverParameter()
+        with open(os.path.join("examples", "cifar10",
+                               "cifar10_full_solver.prototxt")) as f:
+            text_format.Merge(f.read(), sp)
+        sp.net = os.path.join("examples", "cifar10", net_file)
+        sp.max_iter = 8
+        sp.display = 0
+        sp.snapshot = 0
+        sp.random_seed = 4
+        sp.ClearField("test_interval")
+        sp.ClearField("test_iter")
+        sp.snapshot_prefix = str(tmp_path / "snap")
+        s = Solver(sp)
+        s.step(8)
+        assert np.isfinite(s._materialize_smoothed_loss())
+    finally:
+        os.chdir(cwd)
