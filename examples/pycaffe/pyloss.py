@@ -1,0 +1,34 @@
+"""Python EuclideanLossLayer (reference examples/pycaffe/layers/pyloss.py
+parity): the same numeric contract as the built-in EuclideanLoss layer,
+implemented entirely host-side through the PythonLayer extension point —
+the class interface for developing layers in Python.
+
+Under jit the forward runs via pure_callback and the backward via the
+custom_vjp bridge calling this class's backward() (ops/extra.py
+PythonLayer), so the layer still composes with jax.grad and the Solver.
+"""
+import numpy as np
+
+
+class EuclideanLossLayer:
+    def setup(self, bottom, top):
+        if len(bottom) != 2:
+            raise Exception("Need two inputs to compute distance.")
+
+    def reshape(self, bottom, top):
+        if bottom[0].data.size != bottom[1].data.size:
+            raise Exception("Inputs must have the same dimension.")
+        self.diff = np.zeros_like(bottom[0].data, dtype=np.float32)
+        top[0].reshape(1)
+
+    def forward(self, bottom, top):
+        self.diff[...] = bottom[0].data - bottom[1].data
+        top[0].data[...] = np.sum(self.diff ** 2) / bottom[0].shape[0] / 2.0
+
+    def backward(self, top, propagate_down, bottom):
+        for i in range(2):
+            if not propagate_down[i]:
+                continue
+            sign = 1 if i == 0 else -1
+            bottom[i].diff[...] = (sign * self.diff * top[0].diff.reshape(())
+                                   / bottom[i].shape[0])

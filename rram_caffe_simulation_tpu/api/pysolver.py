@@ -72,6 +72,16 @@ class _SolverNetView:
         for ln, blobs in self.params.items():
             for slot, blob in zip(self._slots[ln], blobs):
                 blob.data = np.array(self._solver.params[ln][slot])
+        # pycaffe exposes the last iteration's net outputs in net.blobs
+        # after solver.step; mirror them (only the output blobs exist
+        # post-step — intermediate activations are not retained by the
+        # functional core)
+        if self._net is self._solver.net:
+            for name, v in self._solver.last_outputs.items():
+                if name in self.blobs:
+                    self.blobs[name].data = np.array(
+                        v, dtype=np.float32).reshape(
+                            self.blobs[name].data.shape)
 
     # -- execution on current solver weights ---------------------------
     def forward(self, blobs=None, **kwargs):

@@ -169,6 +169,11 @@ class PythonLayer(Layer):
                 b.data[...] = np.asarray(a)
             ts = [self._B(s) for s in self.top_shapes]
             self.obj.reshape(bs, ts)
+            # Caffe calls Backward on the same object right after Forward;
+            # user layers legitimately cache forward state (e.g. the stock
+            # pyloss example caches self.diff). Replay forward on these
+            # bottoms so that cached state is fresh before backward runs.
+            self.obj.forward(bs, ts)
             for t, g in zip(ts, arrs[n_b:]):
                 t.diff[...] = np.asarray(g)
             self.obj.backward(ts, [True] * n_b, bs)

@@ -148,6 +148,7 @@ class Solver:
         self.test_feeds = test_feeds
 
         self._lr_fn = learning_rate_fn(param)
+        self.last_outputs = {}     # net outputs of the most recent step
         self._step_fn = None       # jit cache
         self._test_fns = [None] * len(self.test_nets)
 
@@ -470,6 +471,9 @@ class Solver:
              outputs) = step_fn(
                 self.params, self.history, self.fault_state, batch,
                 jnp.int32(self.iter), rng, self._remap_due())
+            # last step's net outputs, device-resident (pycaffe exposes
+            # them as net.blobs after solver.step; the api view pulls them)
+            self.last_outputs = outputs
             self._record_loss(loss, start_iter, average_loss)
             display = param.display and self.iter % param.display == 0
             if display:

@@ -92,6 +92,19 @@ def _parse(text):
 import pytest
 
 
+def test_pycaffe_example(tmp_path):
+    """The pycaffe extension-point example end-to-end: python loss ==
+    built-in loss (fwd+bwd), linreg trains through the solver facade,
+    net_spec prototxt round-trips."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "pycaffe", "run_pycaffe.py")],
+        capture_output=True, text=True, timeout=480)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pycaffe examples OK" in r.stdout
+
+
 @pytest.mark.parametrize("net_file", [
     "cifar10_full_train_test.prototxt",
     "cifar10_full_sigmoid_train_test.prototxt",
