@@ -1,0 +1,105 @@
+// C++ deployment example (reference examples/cpp_classification/
+// classification.cpp parity): a native host program that loads a deploy
+// net + weights and classifies one image, printing the top-5
+// (confidence, label) pairs in the reference's output format.
+//
+// The reference links libcaffe and runs the C++ Net directly; here the
+// native host embeds the framework through the CPython API — the same
+// pattern a C++ serving process uses to drive the TPU runtime (JAX/XLA
+// owns the device; C++ owns the process, I/O, and the results). The
+// image decode/preprocess/forward all run in the embedded interpreter;
+// the predictions cross back over the C API as plain C doubles/strings.
+//
+// Build and run (see run_cpp_classification.sh):
+//   g++ -O2 classification.cpp -o classification \
+//       $(python3-config --includes) $(python3-config --embed --ldflags)
+//   ./classification deploy.prototxt net.caffemodel mean.binaryproto \
+//       labels.txt img.jpg
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+static const char* kClassifySource = R"PY(
+import os
+import sys
+
+sys.path.insert(0, os.environ.get("RRAM_TPU_ROOT", "."))
+if os.environ.get("CLASSIFY_PLATFORM"):
+    os.environ["JAX_PLATFORMS"] = os.environ["CLASSIFY_PLATFORM"]
+    import jax
+    jax.config.update("jax_platforms", os.environ["CLASSIFY_PLATFORM"])
+
+import numpy as np
+
+
+def classify(model_file, trained_file, mean_file, label_file, image_file):
+    """Top-5 [(confidence, label)] of one image, reference
+    classification.cpp semantics: BGR net, raw scale 255, per-channel
+    mean from the binaryproto (SetMean averages it to a channel color),
+    single center-crop forward."""
+    from rram_caffe_simulation_tpu import api
+    from rram_caffe_simulation_tpu.proto import pb
+
+    blob = pb.BlobProto()
+    with open(mean_file, "rb") as f:
+        blob.ParseFromString(f.read())
+    mean_arr = api.io.blobproto_to_array(blob)
+    mean_arr = mean_arr.reshape(mean_arr.shape[-3:])      # (C, H, W)
+    channel_mean = mean_arr.mean(axis=(1, 2))             # like SetMean
+
+    net = api.Classifier(model_file, trained_file,
+                         mean=channel_mean, raw_scale=255.0,
+                         channel_swap=(2, 1, 0))
+    image = api.io.load_image(image_file)
+    probs = net.predict([image], oversample=False)[0]
+    with open(label_file) as f:
+        labels = [line.strip() for line in f if line.strip()]
+    top = np.argsort(probs)[::-1][:5]
+    return [(float(probs[i]),
+             labels[i] if i < len(labels) else str(int(i)))
+            for i in top]
+)PY";
+
+static int fail(const char* msg) {
+  if (PyErr_Occurred()) PyErr_Print();
+  std::fprintf(stderr, "%s\n", msg);
+  Py_Finalize();
+  return 1;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    std::fprintf(stderr,
+                 "Usage: %s deploy.prototxt network.caffemodel"
+                 " mean.binaryproto labels.txt img.jpg\n",
+                 argv[0]);
+    return 1;
+  }
+  Py_Initialize();
+
+  PyObject* module = PyImport_AddModule("__main__");
+  PyObject* globals = PyModule_GetDict(module);
+  if (!PyRun_String(kClassifySource, Py_file_input, globals, globals))
+    return fail("failed to initialize the embedded framework");
+
+  PyObject* fn = PyDict_GetItemString(globals, "classify");
+  if (!fn) return fail("classify() not defined");
+
+  std::printf("---------- Prediction for %s ----------\n", argv[5]);
+  PyObject* result = PyObject_CallFunction(fn, "sssss", argv[1], argv[2],
+                                           argv[3], argv[4], argv[5]);
+  if (!result) return fail("classification failed");
+
+  for (Py_ssize_t i = 0; i < PyList_Size(result); ++i) {
+    PyObject* pair = PyList_GetItem(result, i);
+    double confidence = PyFloat_AsDouble(PyTuple_GetItem(pair, 0));
+    const char* label = PyUnicode_AsUTF8(PyTuple_GetItem(pair, 1));
+    // reference output format: "0.5009 - \"n03482405 hamper\""
+    std::printf("%.4f - \"%s\"\n", confidence, label);
+  }
+  Py_DECREF(result);
+  Py_Finalize();
+  return 0;
+}

@@ -1,0 +1,12 @@
+#!/bin/sh
+# Build the native classification host and run it on the given arguments
+# (defaults: compile only). RRAM_TPU_ROOT must point at the repo root so
+# the embedded interpreter can import the framework.
+set -e
+HERE=$(dirname "$(readlink -f "$0")")
+g++ -O2 "$HERE/classification.cpp" -o "$HERE/classification" \
+    $(python3-config --includes) $(python3-config --embed --ldflags)
+echo "built $HERE/classification"
+if [ "$#" -ge 5 ]; then
+    RRAM_TPU_ROOT="${RRAM_TPU_ROOT:-$HERE/../..}" "$HERE/classification" "$@"
+fi

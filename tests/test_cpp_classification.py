@@ -1,0 +1,85 @@
+"""The native C++ classification host (reference examples/
+cpp_classification): compile with the system toolchain, embed the
+framework, classify a generated image, and check the reference output
+format end-to-end."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import pytest
+from google.protobuf import text_format
+from PIL import Image
+
+from rram_caffe_simulation_tpu.api.io import array_to_blobproto
+from rram_caffe_simulation_tpu.net import Net
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import io as uio
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+DEPLOY = """
+name: "Tiny"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.01 } } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_cpp_classification_host(tmp_path):
+    src_dir = os.path.join(REPO, "examples", "cpp_classification")
+    binary = str(tmp_path / "classification")
+    cfg = subprocess.run(
+        ["python3-config", "--includes"], capture_output=True, text=True)
+    ldf = subprocess.run(
+        ["python3-config", "--embed", "--ldflags"], capture_output=True,
+        text=True)
+    if cfg.returncode or ldf.returncode:
+        pytest.skip("python3-config --embed unavailable")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(src_dir, "classification.cpp"),
+         "-o", binary] + cfg.stdout.split() + ldf.stdout.split(),
+        check=True)
+
+    npar = pb.NetParameter()
+    text_format.Parse(DEPLOY, npar)
+    proto_path = str(tmp_path / "deploy.prototxt")
+    uio.write_proto_text(proto_path, npar)
+    net = Net(npar, pb.TEST)
+    params = net.init(jax.random.PRNGKey(0))
+    model_path = str(tmp_path / "net.caffemodel")
+    uio.write_proto_binary(model_path, net.to_proto(params))
+    mean_path = str(tmp_path / "mean.binaryproto")
+    with open(mean_path, "wb") as f:
+        f.write(array_to_blobproto(
+            np.full((1, 3, 16, 16), 120.0, np.float32)).SerializeToString())
+    label_path = str(tmp_path / "labels.txt")
+    with open(label_path, "w") as f:
+        f.write("\n".join(f"n{i:08d} class_{i}" for i in range(5)))
+    img_path = str(tmp_path / "cat.png")
+    Image.fromarray(np.random.RandomState(0).randint(
+        0, 255, size=(20, 20, 3), dtype=np.uint8)).save(img_path)
+
+    env = dict(os.environ, RRAM_TPU_ROOT=os.path.abspath(REPO),
+               CLASSIFY_PLATFORM="cpu")
+    r = subprocess.run(
+        [binary, proto_path, model_path, mean_path, label_path, img_path],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("---------- Prediction for")
+    preds = [ln for ln in lines[1:] if " - " in ln]
+    assert len(preds) == 5
+    confs = [float(ln.split(" - ")[0]) for ln in preds]
+    assert confs == sorted(confs, reverse=True)
+    assert abs(sum(confs) - 1.0) < 1e-3  # softmax top-5 of 5 classes
+    assert 'class_' in preds[0]
