@@ -1,0 +1,58 @@
+"""Net summarization tool (reference tools/extra/summarize.py parity):
+one row per layer with type, connectivity, and — beyond the reference,
+which string-matches the prototxt — real inferred output shapes and
+parameter counts from the net builder, per phase.
+
+    python -m rram_caffe_simulation_tpu.tools.summarize \
+        models/bvlc_googlenet/train_val.prototxt [--phase TEST]
+"""
+import argparse
+
+import numpy as np
+
+from ..net import Net
+from ..proto import pb
+from ..utils import io as uio
+
+
+def summarize(net_param, phase):
+    import jax
+
+    net = Net(net_param, phase)
+    params = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
+    rows = [("LAYER", "TYPE", "BOTTOMS", "TOPS", "TOP SHAPES", "PARAMS")]
+    total = 0
+    owned = {(r.layer_name, r.slot) for r in net.learnable_params
+             if r.key == (r.layer_name, r.slot)}
+    for layer in net.layers:
+        shapes = " ".join("x".join(map(str, s)) or "scalar"
+                          for s in layer.top_shapes) or "-"
+        n_params = sum(
+            int(np.prod(a.shape))
+            for slot, a in enumerate(params.get(layer.name, []))
+            if a is not None and (layer.name, slot) in owned)
+        total += n_params
+        rows.append((layer.name, layer.type_name,
+                     ",".join(layer.lp.bottom) or "-",
+                     ",".join(layer.lp.top) or "-",
+                     shapes, str(n_params) if n_params else "-"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.append(f"Total learnable parameters: {total:,}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prototxt")
+    p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
+    args = p.parse_args(argv)
+    net_param = uio.read_net_param(args.prototxt)
+    phase = pb.TRAIN if args.phase == "TRAIN" else pb.TEST
+    print(summarize(net_param, phase))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

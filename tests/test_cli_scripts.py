@@ -100,6 +100,22 @@ def test_detect_cli(tmp_path, deploy_files):
     assert data["windows"].shape == (2, 4)
 
 
+def test_summarize_cli(capsys):
+    """summarize (reference tools/extra/summarize.py): real inferred
+    shapes + the canonical LeNet parameter count."""
+    from rram_caffe_simulation_tpu.tools import summarize
+    rc = summarize.main([os.path.join(REPO, "models", "lenet",
+                                      "lenet_train_test.prototxt"),
+                         "--phase", "TEST"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Total learnable parameters: 431,080" in out
+    assert "64x20x24x24" in out  # conv1 inferred output shape
+
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
 def test_draw_net_cli(tmp_path, deploy_files):
     proto_path, _ = deploy_files
     out = str(tmp_path / "net.dot")
