@@ -418,19 +418,93 @@ class Solver:
                                     donate_argnums=(0, 1, 2))
         return self._step_fn
 
+    def enable_data_parallel(self, mesh=None, devices=None):
+        """Switch the train loop to synchronous data parallelism over a
+        device mesh (the P2PSync replacement, parallel.cpp / caffe train
+        --gpu 0,1,..). Caffe's weak-scaling contract holds: each replica
+        consumes a full prototxt batch per step, so the effective batch is
+        N x batch_size (docs/multigpu.md:11) and the feed advances N
+        batches per iteration (the DataReader round-robin,
+        data_reader.cpp:79-93). Params/history/fault state are replicated;
+        GSPMD inserts the gradient all-reduce. Call before the first
+        step(); multi-host works the same way once
+        jax.distributed.initialize() has run."""
+        from ..parallel import dp
+        from ..parallel.mesh import make_mesh
+        if mesh is None:
+            mesh = make_mesh({"data": len(devices or jax.devices())},
+                             devices=devices)
+        n = mesh.shape["data"]
+        if n > 1:
+            # Rebuild the graph at the N x global batch: parameters are
+            # batch-independent, but the functional net's blob shapes are
+            # static (the reference instead builds one batch-B net per
+            # GPU; one global-batch computation is the GSPMD equivalent).
+            scaled = pb.NetParameter.FromString(
+                self.net.param_proto.SerializeToString())
+            for lp in scaled.layer:
+                if lp.type == "Input":
+                    for shp in lp.input_param.shape:
+                        if shp.dim:
+                            shp.dim[0] *= n
+                for field in ("data_param", "memory_data_param",
+                              "image_data_param", "window_data_param",
+                              "hdf5_data_param"):
+                    if lp.HasField(field):
+                        fp = getattr(lp, field)
+                        fp.batch_size *= n
+            self.net = Net(scaled, pb.TRAIN,
+                           stages=tuple(self.param.train_state.stage),
+                           level=self.param.train_state.level)
+            if self.custom_train_feed:
+                # user feed yields per-replica batches: pull N per step
+                # (the DataReader round-robin)
+                self._dp_pulls = n
+            else:
+                self.train_feed = self._default_feed(self.net)
+                self._dp_pulls = 1
+        step, place_state = dp.make_dp_step(self, mesh)
+        self.params, self.history, self.fault_state = place_state(
+            self.params, self.history, self.fault_state)
+        self._step_fn = step
+        self._dp_mesh = mesh
+        return mesh
+
     # ------------------------------------------------------------------
     # host loop
 
     def _next_batch(self):
         iter_size = max(self.param.iter_size, 1)
+        n_rep = getattr(self, "_dp_pulls", 1)
+
+        def pull():
+            if n_rep == 1:
+                return {k: jnp.asarray(v)
+                        for k, v in self.train_feed().items()}
+            reps = [self.train_feed() for _ in range(n_rep)]
+            if not reps[0]:
+                return {}
+            return {k: np.concatenate([np.asarray(r[k]) for r in reps])
+                    for k in reps[0]}
+
         if iter_size == 1:
-            return {k: jnp.asarray(v)
-                    for k, v in self.train_feed().items()}
-        subs = [self.train_feed() for _ in range(iter_size)]
-        if not subs[0]:
-            return {}
-        return {k: jnp.stack([jnp.asarray(s[k]) for s in subs])
-                for k in subs[0]}
+            batch = pull()
+        else:
+            subs = [pull() for _ in range(iter_size)]
+            if not subs[0]:
+                return {}
+            batch = {k: jnp.stack([jnp.asarray(s[k]) for s in subs])
+                     for k in subs[0]}
+        if getattr(self, "_dp_mesh", None) is not None and batch:
+            from ..parallel.mesh import data_sharding
+            # batch dim sharded over "data" (iter_size stacking adds a
+            # leading axis; the batch dim is then axis 1 -> lead=1)
+            batch = {
+                k: jax.device_put(v, data_sharding(
+                    self._dp_mesh, "data", ndim=np.ndim(v),
+                    lead=0 if iter_size == 1 else 1))
+                for k, v in batch.items()}
+        return batch
 
     def _remap_due(self) -> bool:
         s = self.strategies

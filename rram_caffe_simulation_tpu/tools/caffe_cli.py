@@ -67,6 +67,16 @@ def train(args):
     if args.weights:
         for w in args.weights.split(","):
             solver.params = solver.net.copy_trained_from(solver.params, w)
+    if args.gpu and args.gpu != "0":
+        # caffe train --gpu 0,1,.. / all (caffe.cpp:248: P2PSync) -> sync
+        # data parallelism over a device mesh, N x batch weak scaling
+        import jax
+        devs = (jax.devices() if args.gpu == "all" else
+                [jax.devices()[int(i)] for i in args.gpu.split(",")])
+        if len(devs) > 1:
+            mesh = solver.enable_data_parallel(devices=devs)
+            print(f"Data-parallel over {len(devs)} devices "
+                  f"(mesh {dict(mesh.shape)})", flush=True)
     _install_signal_actions(solver, args)
     solver.solve(resume_file=args.snapshot or None)
     return 0
@@ -324,7 +334,10 @@ def main(argv=None):
     p.add_argument("--weights", default="")
     p.add_argument("--iterations", type=int, default=50)
     p.add_argument("--gpu", default="",
-                   help="accepted for compat; devices come from the mesh")
+                   help="device ids '0,1,..' or 'all' (reference "
+                        "caffe.cpp --gpu): >1 device trains sync "
+                        "data-parallel over a mesh, N x batch weak "
+                        "scaling like P2PSync")
     p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
     p.add_argument("--level", type=int, default=0)
     p.add_argument("--stage", default="")

@@ -23,6 +23,105 @@ def test_mesh_construction():
     assert mesh2.axis_names == ("config", "data")
 
 
+def _cycling_feed(batch=8):
+    """Deterministic feed producing a DIFFERENT batch per call."""
+    state = {"i": 0}
+
+    def feed():
+        rng = np.random.RandomState(100 + state["i"])
+        state["i"] += 1
+        return {"data": rng.randn(batch, 6).astype(np.float32),
+                "target": rng.randn(batch, 2).astype(np.float32)}
+    return feed
+
+
+def test_enable_data_parallel_weak_scaling(tmp_path):
+    """Solver.enable_data_parallel (the caffe train --gpu path): each
+    replica consumes a full prototxt batch (docs/multigpu.md:11 weak
+    scaling, feed advanced N times per step like the DataReader
+    round-robin), and the result equals a single-device solver fed the
+    same concatenated 4x batch."""
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 1e9
+    sp.failure_pattern.std = 1.0
+
+    s_dp = Solver(pb.SolverParameter.FromString(sp.SerializeToString()),
+                  train_feed=_cycling_feed())
+    mesh = s_dp.enable_data_parallel(
+        devices=jax.devices()[:4])
+    assert dict(mesh.shape) == {"data": 4}
+    s_dp.step(3)
+
+    # single device, same global math: each step sees the 4-batch concat
+    # (net rebuilt at the 32 global batch, like enable_data_parallel does)
+    base = _cycling_feed()
+
+    def concat_feed():
+        reps = [base() for _ in range(4)]
+        return {k: np.concatenate([r[k] for r in reps]) for k in reps[0]}
+    sp_one = pb.SolverParameter.FromString(sp.SerializeToString())
+    for lp in sp_one.net_param.layer:
+        if lp.type == "Input":
+            for shp in lp.input_param.shape:
+                shp.dim[0] *= 4
+    s_one = Solver(sp_one, train_feed=concat_feed)
+    s_one.step(3)
+
+    np.testing.assert_allclose(
+        np.asarray(s_dp._flat(s_dp.params)["fc1/0"]),
+        np.asarray(s_one._flat(s_one.params)["fc1/0"]), atol=1e-5)
+
+
+def test_caffe_cli_train_gpu_data_parallel(tmp_path, capsys):
+    """caffe train --gpu 0,1,2,3 (reference caffe.cpp:248 P2PSync run):
+    the default LMDB feed is rebuilt at the scaled global batch and the
+    run trains data-parallel end-to-end."""
+    import os
+    from google.protobuf import text_format as tf
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+    from rram_caffe_simulation_tpu.utils.io import (read_net_param,
+                                                    read_solver_param)
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        sp = read_solver_param(os.path.join(
+            "models", "cifar10_quick", "cifar10_quick_lmdb_solver.prototxt"))
+        sp.max_iter = 3
+        sp.display = 1
+        sp.snapshot = 0
+        sp.ClearField("test_interval")
+        sp.ClearField("test_iter")
+        sp.random_seed = 2
+        sp.snapshot_prefix = str(tmp_path / "snap")
+        # shrink the batch so 4 replicas stay cheap on the CPU mesh
+        npar = read_net_param(sp.net)
+        for lp in npar.layer:
+            if lp.type == "Data":
+                lp.data_param.batch_size = 8
+        sp.ClearField("net")
+        sp.net_param.CopyFrom(npar)
+        solver_path = str(tmp_path / "solver.prototxt")
+        with open(solver_path, "w") as f:
+            f.write(tf.MessageToString(sp))
+        rc = caffe_cli.main(["train", "--solver", solver_path,
+                             "--gpu", "0,1,2,3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Data-parallel over 4 devices" in out
+        assert "Optimization Done" in out
+    finally:
+        os.chdir(cwd)
+
+
 def test_dp_matches_single_device(tmp_path):
     """Sharded-batch training == single-device training (P2PSync semantic
     parity: summed grads over replicas = full-batch gradient)."""
