@@ -118,9 +118,13 @@ def main(argv=None):
     if args.threshold > 0:
         strategy_suffix += f"_threshold_{args.threshold}"
     if args.remapping:
-        strategy_suffix += f"_remapping_{args.remapping.split(',')[0]}"
+        strategy_suffix += ("_remapping_" + os.path.basename(
+            args.remapping.split(",")[0]))
     if args.genetic:
-        strategy_suffix += f"_genetic_{args.genetic}"
+        # the reference embedded the raw -g string (its files were local
+        # names); basename the paths so the snapshot dir stays valid
+        strategy_suffix += "_genetic_" + ",".join(
+            os.path.basename(p) for p in args.genetic.split(","))
     message = build_solver_param(args)
 
     snapshot_prefix = (f"snapshot_{args.mean}_{args.std}"
