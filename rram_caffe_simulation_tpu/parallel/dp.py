@@ -18,11 +18,14 @@ from jax.sharding import Mesh
 from .mesh import data_sharding, replicated
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Place each batch array with its leading dim sharded over `axis`
+def shard_batch(batch, mesh: Mesh, axis: str = "data", lead: int = 0):
+    """Place each batch array with its batch dim sharded over `axis`
     (the DataReader round-robin equivalent, data_reader.cpp:79-93: each
-    replica sees a disjoint shard)."""
-    return {k: jax.device_put(v, data_sharding(mesh, axis, ndim=v.ndim))
+    replica sees a disjoint shard). `lead` skips leading stacking axes
+    (e.g. the iter_size sub-batch axis)."""
+    import numpy as np
+    return {k: jax.device_put(v, data_sharding(mesh, axis,
+                                               ndim=np.ndim(v), lead=lead))
             for k, v in batch.items()}
 
 

@@ -434,6 +434,11 @@ class Solver:
         if mesh is None:
             mesh = make_mesh({"data": len(devices or jax.devices())},
                              devices=devices)
+        if "data" not in mesh.axis_names:
+            raise ValueError(
+                f"enable_data_parallel needs a mesh with a 'data' axis "
+                f"(got axes {mesh.axis_names}); build one with "
+                "make_mesh({'data': N})")
         n = mesh.shape["data"]
         if n > 1:
             # Rebuild the graph at the N x global batch: parameters are
@@ -496,14 +501,11 @@ class Solver:
             batch = {k: jnp.stack([jnp.asarray(s[k]) for s in subs])
                      for k in subs[0]}
         if getattr(self, "_dp_mesh", None) is not None and batch:
-            from ..parallel.mesh import data_sharding
+            from ..parallel.dp import shard_batch
             # batch dim sharded over "data" (iter_size stacking adds a
             # leading axis; the batch dim is then axis 1 -> lead=1)
-            batch = {
-                k: jax.device_put(v, data_sharding(
-                    self._dp_mesh, "data", ndim=np.ndim(v),
-                    lead=0 if iter_size == 1 else 1))
-                for k, v in batch.items()}
+            batch = shard_batch(batch, self._dp_mesh,
+                                lead=0 if iter_size == 1 else 1)
         return batch
 
     def _remap_due(self) -> bool:

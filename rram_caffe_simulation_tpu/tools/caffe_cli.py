@@ -77,6 +77,11 @@ def train(args):
             mesh = solver.enable_data_parallel(devices=devs)
             print(f"Data-parallel over {len(devs)} devices "
                   f"(mesh {dict(mesh.shape)})", flush=True)
+        else:
+            # single non-default device: honor the selection (the
+            # reference's Caffe::SetDevice)
+            jax.config.update("jax_default_device", devs[0])
+            print(f"Using device {devs[0]}", flush=True)
     _install_signal_actions(solver, args)
     solver.solve(resume_file=args.snapshot or None)
     return 0

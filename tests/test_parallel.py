@@ -79,6 +79,12 @@ def test_enable_data_parallel_weak_scaling(tmp_path):
         np.asarray(s_one._flat(s_one.params)["fc1/0"]), atol=1e-5)
 
 
+def test_enable_data_parallel_rejects_dataless_mesh(tmp_path):
+    s = fault_solver(tmp_path, mean=1e9, std=1.0)
+    with pytest.raises(ValueError, match="'data' axis"):
+        s.enable_data_parallel(mesh=make_mesh({"config": 8}))
+
+
 def test_caffe_cli_train_gpu_data_parallel(tmp_path, capsys):
     """caffe train --gpu 0,1,2,3 (reference caffe.cpp:248 P2PSync run):
     the default LMDB feed is rebuilt at the scaled global batch and the
