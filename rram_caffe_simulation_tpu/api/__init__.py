@@ -24,6 +24,41 @@ from . import coord_map  # noqa: F401
 TRAIN = pb.TRAIN
 TEST = pb.TEST
 
+# package version; the wire format tracks the reference 1.0.0-rc3 schema
+__version__ = "0.2.0"
+
+
+class Layer:
+    """Base class for user Python layers (reference caffe.Layer,
+    python_layer.hpp:14): subclass and override setup/reshape/forward
+    (and optionally backward). The prototxt hook is
+    `type: "Python"` + python_param {module, layer}; instantiation and
+    the blob wrappers come from ops/extra.PythonLayer. Deriving from
+    this class is optional — any object with the four methods works —
+    but reference-written layers do `class X(caffe.Layer)`."""
+
+    #: python_param.param_str, assigned before setup
+    param_str = ""
+
+    def setup(self, bottom, top):
+        pass
+
+    def reshape(self, bottom, top):
+        pass
+
+    def forward(self, bottom, top):
+        raise NotImplementedError
+
+    def backward(self, top, propagate_down, bottom):
+        pass
+
+
+def layer_type_list():
+    """All registered layer type names (reference
+    LayerRegistry::LayerTypeList via _caffe.cpp layer_type_list)."""
+    from ..core.registry import LAYER_REGISTRY
+    return sorted(LAYER_REGISTRY)
+
 
 def set_mode_cpu():
     """No-op shim (caffe.set_mode_cpu): backend comes from JAX platform."""
@@ -47,4 +82,4 @@ __all__ = ["Net", "Blob", "SGDSolver", "NesterovSolver", "AdaGradSolver",
            "NetSpec", "layers", "params", "to_proto", "io", "draw",
            "coord_map", "Classifier", "Detector",
            "TRAIN", "TEST", "set_mode_cpu", "set_mode_gpu", "set_device",
-           "set_random_seed"]
+           "set_random_seed", "Layer", "layer_type_list", "__version__"]

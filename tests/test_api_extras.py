@@ -212,3 +212,38 @@ def test_detector_end_to_end(tmp_path):
     for d in dets:
         assert d["prediction"].shape == (3,)
         np.testing.assert_allclose(d["prediction"].sum(), 1.0, rtol=1e-4)
+
+
+# module-level so python_param can import it by module name
+class ScaleByThree(caffe.Layer):
+    """Reference-style user layer: class X(caffe.Layer)."""
+
+    def reshape(self, bottom, top):
+        top[0].reshape(*bottom[0].shape)
+
+    def forward(self, bottom, top):
+        top[0].data[...] = bottom[0].data * 3.0
+
+
+def test_caffe_layer_base_and_type_list():
+    """caffe.Layer subclasses drive the PythonLayer hook, and
+    layer_type_list mirrors the registry (reference _caffe.cpp
+    layer_type_list)."""
+    import jax.numpy as jnp
+    from rram_caffe_simulation_tpu.net import Net
+
+    types = caffe.layer_type_list()
+    for t in ("Convolution", "InnerProduct", "Python", "SoftmaxWithLoss"):
+        assert t in types
+
+    npar = pb.NetParameter()
+    text_format.Parse("""
+layer { name: "data" type: "Input" top: "x"
+  input_param { shape { dim: 2 dim: 3 } } }
+layer { name: "py" type: "Python" bottom: "x" top: "y"
+  python_param { module: "test_api_extras" layer: "ScaleByThree" } }
+""", npar)
+    net = Net(npar, pb.TEST)
+    params = net.init(__import__("jax").random.PRNGKey(0))
+    blobs, _ = net.apply(params, {"x": jnp.ones((2, 3))})
+    np.testing.assert_allclose(np.asarray(blobs["y"]), 3.0)
