@@ -9,3 +9,4 @@ from . import neuron  # noqa: F401
 from . import losses  # noqa: F401
 from . import recurrent  # noqa: F401
 from . import extra  # noqa: F401
+from . import attention  # noqa: F401

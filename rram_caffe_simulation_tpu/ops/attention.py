@@ -1,0 +1,72 @@
+"""Multi-head self-attention layer — TPU framework extension (the
+reference has no attention anywhere; SURVEY §5.7). Prototxt surface:
+
+    layer {
+      name: "attn" type: "Attention" bottom: "x" top: "y"
+      attention_param { num_heads: 8 causal: true }
+    }
+
+over a (N, S, E) bottom. Parameters are a fused QKV in-projection
+(3E x E + bias) and an out-projection (E x E + bias), stored in Caffe's
+(out, in) orientation so `.caffemodel` round-trips like every other
+layer. The core attention math lives in parallel/sequence.py; under a
+mesh with a "seq" axis the same layer computation can be sharded with
+ring_attention_sharded / ulysses_attention_sharded (tested equal to this
+single-device path in tests/test_sequence_parallel.py).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.fillers import make_filler
+from ..core.registry import Layer, register_layer
+from ..proto import pb
+
+
+@register_layer("Attention")
+class AttentionLayer(Layer):
+
+    def setup(self, bottom_shapes):
+        ap = self.lp.attention_param
+        n, s, e = bottom_shapes[0]
+        self.heads = max(int(ap.num_heads), 1)
+        if e % self.heads:
+            raise ValueError(
+                f"Attention embed dim {e} not divisible by num_heads "
+                f"{self.heads} (layer {self.name!r})")
+        self.causal = bool(ap.causal)
+        self.embed = e
+        self.top_shapes = [(n, s, e)]
+        return self.top_shapes
+
+    def num_params(self):
+        return 4  # qkv weight, qkv bias, out weight, out bias
+
+    def init_params(self, key):
+        ap = self.lp.attention_param
+        if ap.HasField("weight_filler"):
+            wf = make_filler(ap.weight_filler)
+        else:
+            wf = make_filler(pb.FillerParameter(type="xavier"))
+        bf = make_filler(ap.bias_filler if ap.HasField("bias_filler")
+                         else pb.FillerParameter(type="constant"))
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        e = self.embed
+        return [wf(k1, (3 * e, e)), bf(k2, (3 * e,)),
+                wf(k3, (e, e)), bf(k4, (e,))]
+
+    def apply(self, params, bottoms, ctx):
+        from ..parallel.sequence import attention
+        x = bottoms[0]
+        n, s, e = x.shape
+        h = self.heads
+        w_qkv, b_qkv, w_out, b_out = params
+        qkv = jnp.einsum("nse,fe->nsf", x, w_qkv) + b_qkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split_heads(t):  # (N, S, E) -> (N, H, S, E/H)
+            return t.reshape(n, s, h, e // h).transpose(0, 2, 1, 3)
+
+        o = attention(split_heads(q), split_heads(k), split_heads(v),
+                      causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(n, s, e)
+        return [jnp.einsum("nse,fe->nsf", o, w_out) + b_out], None

@@ -1,0 +1,126 @@
+"""Sequence/context parallelism (parallel/sequence.py): ring attention
+and all-to-all (Ulysses) attention over the 8-virtual-device mesh must
+equal single-device attention exactly — values AND gradients — causal
+and non-causal. The long-context extension the reference never had
+(SURVEY §5.7)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rram_caffe_simulation_tpu.parallel import make_mesh
+from rram_caffe_simulation_tpu.parallel.sequence import (
+    attention, ring_attention_sharded, ulysses_attention_sharded)
+
+B, H, S, D = 2, 8, 64, 16
+
+
+@pytest.fixture()
+def qkv():
+    rng = np.random.RandomState(0)
+    return tuple(jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sharded_fn", [ring_attention_sharded,
+                                        ulysses_attention_sharded])
+def test_matches_single_device(qkv, causal, sharded_fn):
+    q, k, v = qkv
+    mesh = make_mesh({"seq": 8})
+    want = attention(q, k, v, causal=causal)
+    got = jax.jit(lambda a, b, c: sharded_fn(a, b, c, mesh,
+                                             causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sharded_fn", [ring_attention_sharded,
+                                        ulysses_attention_sharded])
+def test_gradients_match(qkv, sharded_fn):
+    """Backward through the collectives (ppermute / all_to_all transpose)
+    equals the single-device gradient — the property that makes the
+    sharded path trainable."""
+    q, k, v = qkv
+    mesh = make_mesh({"seq": 8})
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    def loss_shard(q, k, v):
+        return jnp.sum(sharded_fn(q, k, v, mesh, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_sh = jax.jit(jax.grad(loss_shard, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_sh, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_ring_memory_is_blockwise(qkv):
+    """The ring path never materializes the full (S, S) score matrix per
+    device: per-step scores are (S, S/P). Verified structurally on the
+    jaxpr (no (S, S)-shaped intermediates)."""
+    q, k, v = qkv
+    mesh = make_mesh({"seq": 8})
+    jaxpr = jax.make_jaxpr(
+        lambda a, b, c: ring_attention_sharded(a, b, c, mesh))(q, k, v)
+    shapes = {tuple(v.aval.shape) for eqn in jaxpr.eqns
+              for v in eqn.outvars if hasattr(v.aval, "shape")}
+    assert not any(s[-2:] == (S, S) for s in shapes if len(s) >= 2)
+
+
+def test_causal_first_block_row():
+    """Causal semantics across shards: the very first query position only
+    sees key 0 regardless of which device holds which block."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 2, 32, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 32, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 32, 8), jnp.float32)
+    mesh = make_mesh({"seq": 8})
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                               np.asarray(v[:, :, 0]), rtol=1e-5)
+
+
+def test_attention_layer_in_net():
+    """The registered Attention layer (extension id 147): builds from
+    prototxt, trains under jax.grad, respects causality, and round-trips
+    through to_proto/copy_trained_from like every other layer."""
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.net import Net
+    from rram_caffe_simulation_tpu.proto import pb
+
+    npar = pb.NetParameter()
+    text_format.Parse("""
+name: "AttnNet"
+layer { name: "data" type: "Input" top: "x" top: "target"
+  input_param { shape { dim: 2 dim: 12 dim: 16 }
+                shape { dim: 2 dim: 12 dim: 16 } } }
+layer { name: "attn" type: "Attention" bottom: "x" top: "y"
+  attention_param { num_heads: 4 causal: true } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "y" bottom: "target"
+  top: "loss" }
+""", npar)
+    net = Net(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {"x": jnp.asarray(rng.randn(2, 12, 16), jnp.float32),
+             "target": jnp.asarray(rng.randn(2, 12, 16), jnp.float32)}
+    loss, grads = jax.value_and_grad(
+        lambda p: net.apply(p, batch)[1])(params)
+    assert np.isfinite(float(loss))
+    assert all(np.abs(np.asarray(g)).sum() > 0 for g in grads["attn"])
+
+    # causality: output position 0 must not depend on later inputs
+    blobs, _ = net.apply(params, batch, end="attn")
+    x2 = batch["x"].at[:, 5:].set(0.0)
+    blobs2, _ = net.apply(params, {**batch, "x": x2}, end="attn")
+    np.testing.assert_allclose(np.asarray(blobs["y"][:, 0]),
+                               np.asarray(blobs2["y"][:, 0]), rtol=1e-5)
+
+    # serialization round-trip
+    proto = net.to_proto(params)
+    params2 = net.copy_trained_from(net.init(jax.random.PRNGKey(1)), proto)
+    for a, b in zip(params["attn"], params2["attn"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
