@@ -31,15 +31,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def attention(q, k, v, causal: bool = False, q_offset=0, k_offset=0):
-    """Single-device scaled dot-product attention over (B, H, S, D).
-    `q_offset`/`k_offset` give the global position of element 0 of the
-    local S axes (used by the sharded paths for causal masking)."""
+def attention(q, k, v, causal: bool = False):
+    """Single-device scaled dot-product attention over (B, H, S, D)."""
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
     if causal:
-        q_pos = q_offset + jnp.arange(q.shape[2])
-        k_pos = k_offset + jnp.arange(k.shape[2])
+        q_pos = jnp.arange(q.shape[2])
+        k_pos = jnp.arange(k.shape[2])
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     # guard fully-masked rows (exp of -inf rowmax would be nan)
@@ -62,11 +60,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     q_pos = idx * s_loc + jnp.arange(s_loc)
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
-    def body(step, carry):
-        o, m, l, kc, vc = carry
+    def accumulate(o, m, l, kc, vc, owner):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) * scale
         if causal:
-            owner = (idx - step) % n_dev
             k_pos = owner * s_loc + jnp.arange(s_loc)
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
@@ -76,9 +72,26 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         p = jnp.exp(scores - safe_m[..., None])        # 0 where masked
         l = l * corr + p.sum(-1)
         o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        return o, m_new, l
+
+    def body(step, carry):
+        o, m, l, kc, vc = carry
+        owner = (idx - step) % n_dev
+        if causal:
+            # a block with owner > idx is entirely in the future: every
+            # score would be masked and p == 0. Skip its einsum/exp via
+            # cond (at runtime ~half the ring steps on each device),
+            # identical output.
+            o, m, l = jax.lax.cond(
+                owner <= idx,
+                lambda args: accumulate(*args, owner),
+                lambda args: args[:3],
+                (o, m, l, kc, vc))
+        else:
+            o, m, l = accumulate(o, m, l, kc, vc, owner)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return o, m_new, l, kc, vc
+        return o, m, l, kc, vc
 
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
@@ -125,4 +138,10 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "seq",
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str = "seq",
                               causal: bool = False):
+    n_dev = mesh.shape[axis]
+    if q.shape[1] % n_dev:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({q.shape[1]}) divisible "
+            f"by the '{axis}' mesh axis size ({n_dev}); use "
+            "ring_attention_sharded for head counts that don't divide")
     return _sharded(ulysses_attention, mesh, axis, causal)(q, k, v)

@@ -124,3 +124,11 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "y" bottom: "target"
     params2 = net.copy_trained_from(net.init(jax.random.PRNGKey(1)), proto)
     for a, b in zip(params["attn"], params2["attn"]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ulysses_head_divisibility_error():
+    rng = np.random.RandomState(1)
+    q = k = v = jnp.asarray(rng.randn(1, 6, 64, 8), jnp.float32)
+    mesh = make_mesh({"seq": 8})
+    with pytest.raises(ValueError, match="num_heads"):
+        ulysses_attention_sharded(q, k, v, mesh)
