@@ -1,0 +1,73 @@
+"""Multi-host (multi-process) training setup.
+
+The reference's distributed story stops at single-node CUDA P2P
+(parallel.cpp; docs/multigpu.md:7 "only for training", no multi-node).
+Here multi-host IS the single-host code path: once
+`jax.distributed.initialize` has run, `jax.devices()` spans every host,
+the same `make_mesh` lays the "data" axis across them, and the GSPMD
+gradient all-reduce rides ICI within a slice and DCN across slices.
+`Solver.enable_data_parallel` then assembles each step's global batch
+from per-process feeds via `make_array_from_process_local_data` (the
+DataReader round-robin across hosts).
+
+Typical launch (one process per host, same command everywhere):
+
+    from rram_caffe_simulation_tpu.parallel import multihost
+    multihost.initialize()          # TPU pods: autodetects from the env
+    solver = Solver(param)
+    solver.enable_data_parallel()   # mesh over ALL hosts' devices
+    solver.solve()
+
+Validated in-tree by tests/test_multihost.py: two spawned processes with
+gloo CPU collectives train data-parallel and produce weights identical
+to the single-process run on the same global batch stream.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None):
+    """jax.distributed.initialize with env-var fallbacks
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID — on TPU pods all
+    three autodetect from the runtime and may stay None). On CPU hosts
+    the gloo collectives implementation is selected so the same code
+    tests off-TPU."""
+    # NB: must not touch the backend here — jax.distributed.initialize
+    # has to run before anything (even jax.devices) initializes XLA.
+    platforms = (os.environ.get("JAX_PLATFORMS", "") or
+                 str(getattr(jax.config, "jax_platforms", "") or ""))
+    if "cpu" in platforms:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jax: option absent, mpi-only, etc.
+            pass
+    coordinator_address = (coordinator_address or
+                           os.environ.get("COORDINATOR_ADDRESS"))
+    if num_processes is None and os.environ.get("NUM_PROCESSES"):
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PROCESS_ID"):
+        process_id = int(os.environ["PROCESS_ID"])
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id,
+                               local_device_ids=local_device_ids)
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def local_replica_count(mesh, axis: str = "data") -> int:
+    """How many of the mesh's `axis` replicas this process feeds (the
+    per-host share of the weak-scaled global batch)."""
+    n = mesh.shape[axis]
+    assert n % jax.process_count() == 0, (
+        f"'{axis}' axis ({n}) must divide evenly over "
+        f"{jax.process_count()} processes")
+    return n // jax.process_count()

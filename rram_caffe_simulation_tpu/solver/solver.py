@@ -462,10 +462,16 @@ class Solver:
                            stages=tuple(self.param.train_state.stage),
                            level=self.param.train_state.level)
             if self.custom_train_feed:
-                # user feed yields per-replica batches: pull N per step
-                # (the DataReader round-robin)
-                self._dp_pulls = n
+                # user feed yields per-replica batches: pull this
+                # process's share per step (the DataReader round-robin;
+                # multi-host splits the pulls across processes)
+                self._dp_pulls = n // jax.process_count()
             else:
+                if jax.process_count() > 1:
+                    raise NotImplementedError(
+                        "multi-host enable_data_parallel needs a custom "
+                        "per-process train_feed (the default feed would "
+                        "read the same records on every host)")
                 self.train_feed = self._default_feed(self.net)
                 self._dp_pulls = 1
         step, place_state = dp.make_dp_step(self, mesh)
@@ -502,10 +508,22 @@ class Solver:
                      for k in subs[0]}
         if getattr(self, "_dp_mesh", None) is not None and batch:
             from ..parallel.dp import shard_batch
+            from ..parallel.mesh import data_sharding
             # batch dim sharded over "data" (iter_size stacking adds a
             # leading axis; the batch dim is then axis 1 -> lead=1)
-            batch = shard_batch(batch, self._dp_mesh,
-                                lead=0 if iter_size == 1 else 1)
+            lead = 0 if iter_size == 1 else 1
+            if jax.process_count() > 1:
+                # multi-host: this process holds only its shard of the
+                # global batch; assemble the global array from the
+                # process-local data (the cross-host DataReader)
+                batch = {
+                    k: jax.make_array_from_process_local_data(
+                        data_sharding(self._dp_mesh, "data",
+                                      ndim=np.ndim(v), lead=lead),
+                        np.asarray(v))
+                    for k, v in batch.items()}
+            else:
+                batch = shard_batch(batch, self._dp_mesh, lead=lead)
         return batch
 
     def _remap_due(self) -> bool:

@@ -1,0 +1,11 @@
+"""Shared, side-effect-free definitions for the multihost test pair
+(test_multihost.py parent + multihost_worker.py subprocesses)."""
+import numpy as np
+
+
+def global_feed_batch(step: int, replica: int):
+    """Deterministic replica-batch of the global stream: replica r of
+    step s is the same array in every process."""
+    rng = np.random.RandomState(1000 * step + replica)
+    return {"data": rng.randn(8, 6).astype(np.float32),
+            "target": rng.randn(8, 2).astype(np.float32)}

@@ -1,0 +1,82 @@
+"""Worker process for tests/test_multihost.py: joins a 2-process gloo
+CPU cluster (2 local virtual devices each -> 4 global), trains the
+shared FAULT_NET solver data-parallel over the global mesh with its
+per-process share of the global feed stream, and saves the resulting
+fc1 weights for the parent to compare."""
+import argparse
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+sys.path.insert(0, HERE)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from google.protobuf import text_format  # noqa: E402
+
+
+from multihost_common import global_feed_batch  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--out", required=True)
+    p.add_argument("--steps", type=int, default=3)
+    args = p.parse_args()
+
+    from rram_caffe_simulation_tpu.parallel import multihost
+    multihost.initialize(args.coordinator, args.num_processes,
+                         args.process_id)
+    assert jax.process_count() == args.num_processes
+
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from test_fault import FAULT_NET
+
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = args.out + ".snap"
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 1e9
+    sp.failure_pattern.std = 1.0
+
+    # this process feeds replicas [2*pid, 2*pid+1] of each step's
+    # 4-replica global batch, pulled in order by the solver
+    state = {"step": 0, "sub": 0}
+    pid = args.process_id
+
+    def feed():
+        batch = global_feed_batch(state["step"], 2 * pid + state["sub"])
+        state["sub"] += 1
+        if state["sub"] == 2:
+            state["sub"] = 0
+            state["step"] += 1
+        return batch
+
+    solver = Solver(sp, train_feed=feed)
+    mesh = solver.enable_data_parallel()
+    assert dict(mesh.shape) == {"data": 4}
+    solver.step(args.steps)
+    w = np.asarray(jax.device_get(solver._flat(solver.params)["fc1/0"]))
+    np.save(args.out, w)
+    print(f"worker {pid} done, loss "
+          f"{solver._materialize_smoothed_loss():.6f}")
+
+
+if __name__ == "__main__":
+    main()
