@@ -124,3 +124,30 @@ def test_draw_net_cli(tmp_path, deploy_files):
     dot = open(out).read()
     for lname in ("conv1", "fc", "prob"):
         assert lname in dot
+
+
+def test_time_cli_with_dropout(tmp_path, deploy_files, capsys):
+    """caffe_cli time on a TRAIN-phase net containing Dropout (regression:
+    the timer must supply a PRNG key to stochastic layers)."""
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+    npar = pb.NetParameter()
+    text_format.Parse("""
+name: "DropNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 8 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }
+layer { name: "drop" type: "Dropout" bottom: "fc" top: "fc" }
+layer { name: "out" type: "InnerProduct" bottom: "fc" top: "out"
+  inner_product_param { num_output: 2
+    weight_filler { type: "xavier" } } }
+""", npar)
+    proto_path = str(tmp_path / "drop.prototxt")
+    uio.write_proto_text(proto_path, npar)
+    rc = caffe_cli.main(["time", "--model", proto_path,
+                         "--iterations", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Average Forward pass:" in out
+    assert "drop" in out  # per-layer row present
