@@ -378,6 +378,15 @@ def sequential_sweep(solver_param, configs, iters, eval_iters: int = 0):
                 param.failure_pattern.std = float(v)
             elif k == "seed":
                 param.random_seed = int(v)
+            elif k == "prob":
+                # percentage for stuck +-1 each, like the runner's --prob
+                fp = param.failure_pattern.failure_prob
+                fp.neg = fp.pos = int(v)
+                fp.zero = 100 - 2 * int(v)
+            elif k == "threshold":
+                sp = param.failure_strategy.add()
+                sp.type = "threshold"
+                sp.threshold = float(v)
             else:
                 setattr(param, k, v)
         solver = Solver(param)

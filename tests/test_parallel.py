@@ -225,6 +225,45 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc2" bottom: "target" }
 """
 
 
+def test_sequential_sweep_prob_and_threshold_grids(tmp_path):
+    """The prob / threshold grid keys (run_sweeps.py surface): prob
+    rewrites the stuck-value distribution, threshold attaches the write-
+    skip strategy, per config."""
+    from rram_caffe_simulation_tpu.parallel.sweep import sequential_sweep
+
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+layer { name: "x" type: "DummyData" top: "x"
+  dummy_data_param { shape { dim: 8 dim: 6 }
+                     data_filler { type: "gaussian" } } }
+layer { name: "y" type: "DummyData" top: "y"
+  dummy_data_param { shape { dim: 8 dim: 2 }
+                     data_filler { type: "gaussian" } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "x" top: "fc1"
+  inner_product_param { num_output: 2
+    weight_filler { type: "gaussian" std: 0.3 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc1" bottom: "y" }
+""", sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 4
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 50.0   # batch decrement 100 -> all break
+    sp.failure_pattern.std = 5.0
+
+    res = sequential_sweep(sp, [{"prob": 50}, {"prob": 0},
+                                {"threshold": 1e9}], iters=4)
+    assert len(res) == 3
+    assert all(np.isfinite(r["loss"]) for r in res)
+    assert all(r["broken"] > 0.99 for r in res[:2])
+    # threshold 1e9 zeroes every write: no cell is ever written, so no
+    # lifetime decrements -> nothing breaks
+    assert res[2]["broken"] == 0.0
+
+
 def test_sequential_sweep_supports_genetic(tmp_path):
     """The per-config fallback driver must run strategies the vmapped
     sweep can't — genetic host-side search included (VERDICT r1 weak #6:
