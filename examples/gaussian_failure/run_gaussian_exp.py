@@ -52,6 +52,10 @@ def parse_args(argv=None):
                    help="hardware-aware forward: relative conductance "
                         "noise on fault-target weights each read "
                         "(framework extension, RRAMForwardParameter)")
+    p.add_argument("--conv-also", action="store_true",
+                   help="fault Convolution params too (framework "
+                        "extension; the reference faults only "
+                        "InnerProduct, net.cpp:485-493)")
     return p.parse_args(argv)
 
 
@@ -69,6 +73,8 @@ def build_solver_param(args) -> "pb.SolverParameter":
         message.max_iter = args.max_iter
     if args.hw_sigma:
         message.rram_forward.sigma = args.hw_sigma
+    if args.conv_also:
+        message.failure_pattern.conv_also = True
     if args.threshold > 0:
         message.failure_strategy.add(type="threshold",
                                      threshold=args.threshold)

@@ -108,6 +108,18 @@ class Solver:
         # (failure_maker.cpp:75 FIXME); override via attribute for other nets
         self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
                             for r in self.net.failure_param_refs]
+        if (param.HasField("failure_pattern")
+                and param.failure_pattern.conv_also):
+            # Extension (FailurePatternParameter.conv_also): conv params
+            # are crossbar cells too. The reference's fault-prone set is
+            # InnerProduct-only (net.cpp:485-493).
+            for r in self._owner_refs:
+                layer = self.net.layer_by_name.get(r.layer_name)
+                if (layer is not None and layer.type_name in
+                        ("Convolution", "Deconvolution")):
+                    k = fault_engine.param_key(r.layer_name, r.slot)
+                    if k not in self._fault_keys:
+                        self._fault_keys.append(k)
         self.fc_pairs = self._fc_pairs()
         if (param.HasField("failure_pattern") and self._fault_keys
                 and param.failure_pattern.type == "gaussian"):

@@ -261,3 +261,70 @@ def test_prune_order_validation(tmp_path):
     with pytest.raises(ValueError, match="rows"):
         build_strategies(solver_param("0 1 2 3"), fc_pairs,
                          hidden_sizes=[4, 8])
+
+
+CONV_FAULT_NET = """
+name: "ConvFaultNet"
+layer { name: "data" type: "Input" top: "data" top: "target"
+  input_param { shape { dim: 4 dim: 2 dim: 8 dim: 8 }
+                shape { dim: 4 dim: 2 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 stride: 2
+    weight_filler { type: "gaussian" std: 0.3 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc1" type: "InnerProduct" bottom: "conv1" top: "fc1"
+  inner_product_param { num_output: 2
+    weight_filler { type: "gaussian" std: 0.3 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc1" bottom: "target"
+  top: "loss" }
+"""
+
+
+def _conv_fault_solver(tmp_path, conv_also):
+    sp = pb.SolverParameter()
+    text_format.Parse(CONV_FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.display = 0
+    sp.random_seed = 9
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 150.0   # decrement 100/write -> break fast
+    sp.failure_pattern.std = 10.0
+    sp.failure_pattern.conv_also = conv_also
+    rng = np.random.RandomState(4)
+    data = rng.randn(4, 2, 8, 8).astype(np.float32)
+    target = rng.randn(4, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data, "target": target})
+
+
+def test_conv_also_extends_fault_targets(tmp_path):
+    """FailurePatternParameter.conv_also (framework extension, SURVEY §7
+    item 3): conv cells get lifetimes and clamp to stuck values; without
+    the flag the reference's InnerProduct-only set is preserved."""
+    s = _conv_fault_solver(tmp_path, conv_also=True)
+    assert "conv1/0" in s._fault_keys and "fc1/0" in s._fault_keys
+    s.step(5)
+    w = np.asarray(s._flat(s.params)["conv1/0"])
+    assert np.isin(w, [-1.0, 0.0, 1.0]).all()  # every conv cell stuck
+
+    s2 = _conv_fault_solver(tmp_path, conv_also=False)
+    assert "conv1/0" not in s2._fault_keys
+    s2.step(5)
+    w2 = np.asarray(s2._flat(s2.params)["conv1/0"])
+    assert not np.isin(w2, [-1.0, 0.0, 1.0]).all()  # conv untouched
+    wfc = np.asarray(s2._flat(s2.params)["fc1/0"])
+    # fc still faulted (cells with exactly-zero grads are never written,
+    # hence never decremented — so "most", not "all")
+    assert np.isin(wfc, [-1.0, 0.0, 1.0]).mean() > 0.5
+
+
+def test_conv_also_under_sweep(tmp_path):
+    """conv faults vmap over the Monte-Carlo config axis like fc faults."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    s = _conv_fault_solver(tmp_path, conv_also=True)
+    runner = SweepRunner(s, n_configs=3, means=[150.0, 1e6, 1e6])
+    loss, _ = runner.step(4)
+    assert np.isfinite(np.asarray(loss)).all()
+    frac = runner.broken_fractions()
+    assert frac[0] > 0.9 and frac[1] < 0.1
