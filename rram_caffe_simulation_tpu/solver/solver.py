@@ -139,7 +139,8 @@ class Solver:
             raise ValueError(
                 "rram_forward is configured but no fault engine is active "
                 "— it requires failure_pattern { type: 'gaussian' } and at "
-                "least one fault-target (InnerProduct) layer")
+                "least one fault-target layer (InnerProduct, or Convolution "
+                "with failure_pattern { conv_also: true })")
         if (param.HasField("rram_forward")
                 and param.rram_forward.adc_bits == 1):
             raise ValueError(
@@ -807,8 +808,20 @@ class Solver:
         if fault_file.endswith(".solverstate"):
             fault_file = fault_file[:-len(".solverstate")] + ".faultstate"
         if self.fault_state is not None and os.path.exists(fault_file):
-            self.fault_state = fault_engine.fault_state_from_proto(
+            restored = fault_engine.fault_state_from_proto(
                 uio.read_proto_binary(fault_file, pb.NetParameter()))
+            saved, live = set(restored["lifetimes"]), set(self._fault_keys)
+            if saved != live:
+                # e.g. failure_pattern.conv_also toggled across the
+                # snapshot boundary: adopting the file's key set would
+                # either KeyError at the next traced step (missing conv
+                # keys) or silently drop saved degradation (extra keys).
+                raise ValueError(
+                    f"fault state in {fault_file} covers params "
+                    f"{sorted(saved)} but this solver's fault targets are "
+                    f"{sorted(live)}; resume with the same failure_pattern "
+                    "(including conv_also) the snapshot was taken under")
+            self.fault_state = restored
 
     # observability -----------------------------------------------------
     def broken_fraction(self) -> float:

@@ -23,8 +23,10 @@ def check_gradient(fn, args, check_args=None, stepsize=1e-4, threshold=1e-3,
     args = [jnp.asarray(a, dtype=jnp.float64) for a in args]
     if check_args is None:
         check_args = range(len(args))
-    f = lambda *a: jnp.asarray(fn(*a), dtype=jnp.float64)
-    analytic = jax.grad(f, argnums=tuple(check_args))(*args)
+    # jit once: the FD loop below re-evaluates f twice per element, and an
+    # eager scan-based layer (LSTM/RNN) costs seconds per dispatch
+    f = jax.jit(lambda *a: jnp.asarray(fn(*a), dtype=jnp.float64))
+    analytic = jax.jit(jax.grad(f, argnums=tuple(check_args)))(*args)
     for gi, ai in enumerate(check_args):
         a = np.array(args[ai], dtype=np.float64)  # writable copy
         g = np.asarray(analytic[gi], dtype=np.float64)
