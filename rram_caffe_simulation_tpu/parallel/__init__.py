@@ -19,7 +19,8 @@ GPUs; here thousands of crossbar configs ride one TPU batch).
 from .mesh import make_mesh, data_sharding, config_sharding, replicated
 from .dp import make_dp_step, shard_batch
 from .sweep import SweepRunner, stack_fault_states
+from .tp import tp_param_specs
 
 __all__ = ["make_mesh", "data_sharding", "config_sharding", "replicated",
            "make_dp_step", "shard_batch", "SweepRunner",
-           "stack_fault_states"]
+           "stack_fault_states", "tp_param_specs"]

@@ -1,0 +1,143 @@
+"""Tensor (model) parallelism: Megatron-style parameter sharding expressed
+as GSPMD sharding annotations over a mesh "model" axis.
+
+The reference implements no tensor parallelism (SURVEY §2c: DP over CUDA
+P2P only, parallel.cpp). On TPU the Caffe-era zoo is exactly the workload
+TP was invented for: AlexNet/CaffeNet fc6 is a 4096x9216 matrix holding
+37M of the net's 60M params, and VGG-11's fc1024 towers dominate the RRAM
+fault-sweep nets. Sharding those weights over a "model" mesh axis keeps
+each chip's HBM share at 1/P and lets XLA place the all-gather /
+reduce-scatter pattern on ICI — no hand-written collectives, per the
+GSPMD recipe (annotate params, let the partitioner insert comms).
+
+Sharding rule, walked in graph order over InnerProduct layers:
+
+- alternate COLUMN-parallel (output dim sharded, bias sharded) with
+  ROW-parallel (input dim sharded, bias replicated): the activation
+  between the pair stays feature-sharded, so a (col, row) pair costs a
+  single reduce at the row layer's output — the Megatron MLP block;
+- a dim is sharded only if the axis size divides it; otherwise the layer
+  is replicated and the alternation resets (a row-parallel layer must
+  consume a feature-sharded activation to pay off);
+- Convolution/BN/Scale/everything else is replicated — their params are
+  small, and replicated conv + batch-sharded data is already the right
+  TPU layout for them.
+
+Composition: the mesh may also carry a "data" axis (DP, P2PSync
+semantics) — `Solver.enable_model_parallel` shards the batch over it —
+and the fault engine's per-cell state (lifetimes/stuck, same shape as
+the weights) is sharded identically to its weight, so clamp/decrement
+stay local to the shard that owns the cells.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def tp_param_specs(net, n_shards: int, axis: str = "model") -> dict:
+    """PartitionSpec per owned param slot: {layer_name: [spec_or_None]}.
+
+    Entries are None exactly where `net.init`'s params dict has None
+    (shared slots owned elsewhere), so the two trees line up.
+    """
+    from ..ops.neuron import _Elementwise
+    specs: dict[str, list] = {}
+    col_prev = False  # previous FC ended column-parallel
+    for layer in net.layers:
+        n = layer.num_params()
+        if n == 0:
+            # only elementwise layers (ReLU/Dropout/...) keep the
+            # feature axis intact between a (col, row) FC pair; a
+            # Pooling/Flatten/Concat in between re-mixes features, so a
+            # row annotation after it would cost a reshard, not save one
+            if not isinstance(layer, _Elementwise):
+                col_prev = False
+            continue
+        slots = net._layer_slots[layer.name]
+        owned = [i for i in range(n) if slots[i] == (layer.name, i)]
+        if not owned:
+            if not isinstance(layer, _Elementwise):
+                col_prev = False
+            continue
+        layer_specs: list = [None] * n
+        for i in owned:
+            layer_specs[i] = P()
+        if isinstance(layer, _Elementwise):
+            # parameterized elementwise (PReLU): small replicated params,
+            # chain preserved
+            specs[layer.name] = layer_specs
+            continue
+        if layer.type_name == "InnerProduct" and 0 in owned:
+            w = layer.weight_shape      # (N, K), or (K, N) if transpose
+            out_ax = 1 if layer.transpose else 0
+            in_ax = 1 - out_ax
+            can_col = w[out_ax] % n_shards == 0
+            can_row = w[in_ax] % n_shards == 0
+            if col_prev and can_row:
+                wspec = [None, None]
+                wspec[in_ax] = axis
+                layer_specs[0] = P(*wspec)          # row-parallel
+                col_prev = False                    # bias stays replicated
+            elif can_col:
+                wspec = [None, None]
+                wspec[out_ax] = axis
+                layer_specs[0] = P(*wspec)          # column-parallel
+                if layer.bias_term and 1 in owned:
+                    layer_specs[1] = P(axis)
+                col_prev = True
+            else:
+                col_prev = False
+        else:
+            # non-FC layers break the feature-sharded activation chain
+            col_prev = False
+        specs[layer.name] = layer_specs
+    return specs
+
+
+def flat_specs(solver, layer_specs: dict) -> dict:
+    """Re-key layer/slot specs by the solver's flat param keys
+    ("layer/slot"), covering history and fault-state mirrors."""
+    from ..fault import engine as fault_engine
+    out = {}
+    for r in solver._owner_refs:
+        spec = layer_specs.get(r.layer_name, [None] * (r.slot + 1))[r.slot]
+        out[fault_engine.param_key(r.layer_name, r.slot)] = (
+            spec if spec is not None else P())
+    return out
+
+
+def place_state(solver, mesh: Mesh, layer_specs: dict):
+    """device_put params/history/fault_state with their TP shardings.
+    Returns (params, history, fault_state, out_shardings_tuple) where the
+    tuple mirrors the train step's (params', history', fault', loss, outs)
+    outputs (loss/outputs entries are the replicated prefix)."""
+    repl = NamedSharding(mesh, P())
+
+    def nsh(spec):
+        return NamedSharding(mesh, spec)
+
+    pspecs = {ln: [nsh(s) if s is not None else None for s in sl]
+              for ln, sl in layer_specs.items()}
+    params = {ln: [jax.device_put(a, sh) if a is not None else None
+                   for a, sh in zip(arrs, pspecs[ln])]
+              for ln, arrs in solver.params.items()}
+
+    fspecs = flat_specs(solver, layer_specs)
+    history = {k: {slot: jax.device_put(v, nsh(fspecs.get(k, P())))
+                   for slot, v in d.items()}
+               for k, d in solver.history.items()}
+    hshard = {k: {slot: nsh(fspecs.get(k, P())) for slot in d}
+              for k, d in solver.history.items()}
+
+    fault_state = solver.fault_state
+    fshard = None
+    if fault_state is not None:
+        fault_state = {part: {k: jax.device_put(v, nsh(fspecs.get(k, P())))
+                              for k, v in d.items()}
+                       for part, d in fault_state.items()}
+        fshard = {part: {k: nsh(fspecs.get(k, P())) for k in d}
+                  for part, d in fault_state.items()}
+
+    out_shardings = (pspecs, hshard, fshard, repl, repl)
+    return params, history, fault_state, out_shardings

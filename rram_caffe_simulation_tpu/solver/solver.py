@@ -452,46 +452,90 @@ class Solver:
                 f"enable_data_parallel needs a mesh with a 'data' axis "
                 f"(got axes {mesh.axis_names}); build one with "
                 "make_mesh({'data': N})")
-        n = mesh.shape["data"]
-        if n > 1:
-            # Rebuild the graph at the N x global batch: parameters are
-            # batch-independent, but the functional net's blob shapes are
-            # static (the reference instead builds one batch-B net per
-            # GPU; one global-batch computation is the GSPMD equivalent).
-            scaled = pb.NetParameter.FromString(
-                self.net.param_proto.SerializeToString())
-            for lp in scaled.layer:
-                if lp.type == "Input":
-                    for shp in lp.input_param.shape:
-                        if shp.dim:
-                            shp.dim[0] *= n
-                for field in ("data_param", "memory_data_param",
-                              "image_data_param", "window_data_param",
-                              "hdf5_data_param"):
-                    if lp.HasField(field):
-                        fp = getattr(lp, field)
-                        fp.batch_size *= n
-            self.net = Net(scaled, pb.TRAIN,
-                           stages=tuple(self.param.train_state.stage),
-                           level=self.param.train_state.level)
-            if self.custom_train_feed:
-                # user feed yields per-replica batches: pull this
-                # process's share per step (the DataReader round-robin;
-                # multi-host splits the pulls across processes)
-                self._dp_pulls = n // jax.process_count()
-            else:
-                if jax.process_count() > 1:
-                    raise NotImplementedError(
-                        "multi-host enable_data_parallel needs a custom "
-                        "per-process train_feed (the default feed would "
-                        "read the same records on every host)")
-                self.train_feed = self._default_feed(self.net)
-                self._dp_pulls = 1
+        self._scale_replica_batch(mesh.shape["data"])
         step, place_state = dp.make_dp_step(self, mesh)
         self.params, self.history, self.fault_state = place_state(
             self.params, self.history, self.fault_state)
         self._step_fn = step
         self._dp_mesh = mesh
+        return mesh
+
+    def _scale_replica_batch(self, n: int):
+        """Rebuild the graph at the n x global batch: parameters are
+        batch-independent, but the functional net's blob shapes are
+        static (the reference instead builds one batch-B net per
+        GPU; one global-batch computation is the GSPMD equivalent)."""
+        if n <= 1:
+            return
+        scaled = pb.NetParameter.FromString(
+            self.net.param_proto.SerializeToString())
+        for lp in scaled.layer:
+            if lp.type == "Input":
+                for shp in lp.input_param.shape:
+                    if shp.dim:
+                        shp.dim[0] *= n
+            for field in ("data_param", "memory_data_param",
+                          "image_data_param", "window_data_param",
+                          "hdf5_data_param"):
+                if lp.HasField(field):
+                    fp = getattr(lp, field)
+                    fp.batch_size *= n
+        self.net = Net(scaled, pb.TRAIN,
+                       stages=tuple(self.param.train_state.stage),
+                       level=self.param.train_state.level)
+        if self.custom_train_feed:
+            # user feed yields per-replica batches: pull this
+            # process's share per step (the DataReader round-robin;
+            # multi-host splits the pulls across processes)
+            self._dp_pulls = n // jax.process_count()
+        else:
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "multi-host data parallelism needs a custom "
+                    "per-process train_feed (the default feed would "
+                    "read the same records on every host)")
+            self.train_feed = self._default_feed(self.net)
+            self._dp_pulls = 1
+
+    def enable_model_parallel(self, mesh=None, devices=None):
+        """Switch to tensor (model) parallelism: the big InnerProduct
+        weights are sharded over the mesh's "model" axis (Megatron-style
+        column/row alternation, parallel/tp.py) so each chip holds 1/P of
+        fc6-class matrices in HBM and XLA places the all-gather /
+        reduce-scatter pattern on ICI. The reference has no TP (SURVEY
+        §2c) — this is a TPU-first extension for the zoo's FC-heavy nets.
+
+        The mesh may also carry a "data" axis: the batch dim is then
+        sharded over it with the same weak-scaling contract as
+        enable_data_parallel (effective batch = n_data x batch_size).
+        Fault-engine state (per-cell lifetimes/stuck) shards with its
+        weight, so RRAM clamp/decrement stay shard-local. Call before the
+        first step()."""
+        from ..parallel import tp
+        from ..parallel.mesh import make_mesh
+        if mesh is None:
+            mesh = make_mesh({"model": len(devices or jax.devices())},
+                             devices=devices)
+        if "model" not in mesh.axis_names:
+            raise ValueError(
+                f"enable_model_parallel needs a mesh with a 'model' axis "
+                f"(got axes {mesh.axis_names}); build one with "
+                "make_mesh({'model': N})")
+        n_data = dict(mesh.shape).get("data", 1)
+        if n_data > 1:
+            self._scale_replica_batch(n_data)
+        layer_specs = tp.tp_param_specs(self.net, mesh.shape["model"])
+        (self.params, self.history, self.fault_state,
+         out_shardings) = tp.place_state(self, mesh, layer_specs)
+        # "jax" engine: the pallas crossbar kernel has no GSPMD
+        # partitioning rule for a model-sharded weight operand; the pure
+        # perturb_weight path partitions like any elementwise op.
+        step = self.make_train_step(hw_engine="jax")
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2),
+                                out_shardings=out_shardings)
+        self._tp_layer_specs = layer_specs
+        if n_data > 1:
+            self._dp_mesh = mesh  # _next_batch shards the batch over "data"
         return mesh
 
     # ------------------------------------------------------------------

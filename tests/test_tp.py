@@ -1,0 +1,208 @@
+"""Tensor (model) parallelism tests on the 8-device virtual CPU mesh.
+
+The reference has no TP of any kind (SURVEY §2c) — this covers the TPU
+framework's Megatron-style parameter sharding (parallel/tp.py +
+Solver.enable_model_parallel): spec construction (column/row alternation,
+divisibility gating, transpose), numerical equality with single-device
+training, fault-engine composition (sharded per-cell state), and the
+combined model x data mesh.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.net import Net
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.parallel import make_mesh, tp_param_specs
+
+
+MLP_NET = """
+name: "MlpNet"
+layer { name: "data" type: "Input" top: "data" top: "target"
+  input_param { shape { dim: 8 dim: 12 } shape { dim: 8 dim: 3 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 16
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layer { name: "relu1" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 8
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layer { name: "relu2" type: "ReLU" bottom: "fc2" top: "fc2" }
+layer { name: "fc3" type: "InnerProduct" bottom: "fc2" top: "fc3"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc3" bottom: "target"
+  top: "loss" }
+"""
+
+
+def mlp_solver(fault=False):
+    sp = pb.SolverParameter()
+    text_format.Parse(MLP_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.momentum = 0.9
+    sp.type = "SGD"
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 11
+    sp.snapshot_prefix = "/tmp/tp_test"
+    if fault:
+        sp.failure_pattern.type = "gaussian"
+        sp.failure_pattern.mean = 40.0
+        sp.failure_pattern.std = 5.0
+    return sp
+
+
+def _feed(batch=8):
+    state = {"i": 0}
+
+    def feed():
+        rng = np.random.RandomState(300 + state["i"])
+        state["i"] += 1
+        return {"data": rng.randn(batch, 12).astype(np.float32),
+                "target": rng.randn(batch, 3).astype(np.float32)}
+    return feed
+
+
+def _tree_allclose(a, b, **kw):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), **kw)
+
+
+def test_tp_specs_alternate_col_row():
+    """fc1 (16x12) is column-parallel over 4 shards, fc2 (8x16)
+    row-parallel consuming the feature-sharded activation, fc3 (3x8)
+    column again (3 % 4 != 0 output -> but 8 % 4 == 0 input is only
+    shardable in row position after a col layer; alternation reset at
+    fc2's row end means fc3 tries col: 3 % 4 != 0 -> replicated)."""
+    netp = pb.NetParameter()
+    text_format.Parse(MLP_NET, netp)
+    net = Net(netp, pb.TRAIN)
+    specs = tp_param_specs(net, 4)
+    assert specs["fc1"][0] == P("model", None)   # column: out dim
+    assert specs["fc1"][1] == P("model")         # bias sharded with out
+    assert specs["fc2"][0] == P(None, "model")   # row: in dim
+    assert specs["fc2"][1] == P()                # bias replicated
+    assert specs["fc3"][0] == P()                # 3 not divisible
+    assert specs["fc3"][1] == P()
+
+
+def test_tp_specs_transpose_weight():
+    """transpose: true stores W as (K, N); the sharded dim must follow
+    the logical output/input role, not the storage axis."""
+    netp = pb.NetParameter()
+    text_format.Parse("""
+    name: "t"
+    layer { name: "data" type: "Input" top: "data"
+      input_param { shape { dim: 4 dim: 12 } } }
+    layer { name: "fct" type: "InnerProduct" bottom: "data" top: "fct"
+      inner_product_param { num_output: 16 transpose: true
+        weight_filler { type: "xavier" } } }
+    layer { name: "fc2" type: "InnerProduct" bottom: "fct" top: "fc2"
+      inner_product_param { num_output: 8
+        weight_filler { type: "xavier" } } }
+    """, netp)
+    net = Net(netp, pb.TRAIN)
+    specs = tp_param_specs(net, 4)
+    assert specs["fct"][0] == P(None, "model")   # (K, N): out is axis 1
+    assert specs["fc2"][0] == P(None, "model")   # row after col: in axis 1
+
+
+def test_tp_specs_chain_broken_by_non_elementwise():
+    """A feature-re-mixing layer (Flatten) between two FCs breaks the
+    (col, row) pairing: the second FC must restart column-parallel, not
+    annotate row against an activation whose feature dim moved."""
+    netp = pb.NetParameter()
+    text_format.Parse("""
+    name: "b"
+    layer { name: "data" type: "Input" top: "data"
+      input_param { shape { dim: 4 dim: 12 } } }
+    layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+      inner_product_param { num_output: 16
+        weight_filler { type: "xavier" } } }
+    layer { name: "flat" type: "Flatten" bottom: "fc1" top: "flat" }
+    layer { name: "fc2" type: "InnerProduct" bottom: "flat" top: "fc2"
+      inner_product_param { num_output: 8
+        weight_filler { type: "xavier" } } }
+    """, netp)
+    net = Net(netp, pb.TRAIN)
+    specs = tp_param_specs(net, 4)
+    assert specs["fc1"][0] == P("model", None)   # column
+    assert specs["fc2"][0] == P("model", None)   # column again, NOT row
+
+
+def test_model_parallel_matches_single_device():
+    """3 steps of model-parallel SGD == 3 steps single-device, and the
+    fc1 weight is actually laid out in 8 shards."""
+    feed_a, feed_b = _feed(), _feed()
+    ref = Solver(mlp_solver(), train_feed=feed_a)
+    ref.step(3)
+
+    tp_solver = Solver(mlp_solver(), train_feed=feed_b)
+    mesh = tp_solver.enable_model_parallel(
+        make_mesh({"model": 8}))
+    assert mesh.shape["model"] == 8
+    w = tp_solver.params["fc1"][0]
+    assert w.sharding.spec == P("model", None)
+    assert len({s.device for s in w.addressable_shards}) == 8
+    tp_solver.step(3)
+
+    _tree_allclose(ref.params, tp_solver.params, rtol=1e-5, atol=1e-6)
+    _tree_allclose(ref.history, tp_solver.history, rtol=1e-5, atol=1e-6)
+
+
+def test_model_parallel_with_fault_engine():
+    """RRAM fault state shards with its weight and the clamp semantics
+    survive: end params equal the single-device fault run bit-for-bit
+    shapes, stuck cells clamped to {-1, 0, +1}."""
+    feed_a, feed_b = _feed(), _feed()
+    ref = Solver(mlp_solver(fault=True), train_feed=feed_a)
+    ref.step(4)
+
+    s = Solver(mlp_solver(fault=True), train_feed=feed_b)
+    s.enable_model_parallel(make_mesh({"model": 8}))
+    lt = s.fault_state["lifetimes"]["fc1/0"]
+    assert lt.sharding.spec == P("model", None)
+    s.step(4)
+
+    _tree_allclose(ref.params, s.params, rtol=1e-5, atol=1e-6)
+    _tree_allclose(ref.fault_state, s.fault_state, rtol=1e-5, atol=1e-6)
+    broken = np.asarray(s.fault_state["lifetimes"]["fc1/0"]) <= 0
+    if broken.any():
+        w = np.asarray(s.params["fc1"][0])
+        stuck = np.asarray(s.fault_state["stuck"]["fc1/0"])
+        np.testing.assert_allclose(w[broken], stuck[broken])
+
+
+def test_model_times_data_mesh():
+    """{"data": 2, "model": 4}: weak-scaling DP composed with TP — the
+    feed is pulled twice per step (2x effective batch) and the result
+    equals a single-device solver fed the same concatenated batches."""
+    feed_tp = _feed()
+    s = Solver(mlp_solver(), train_feed=feed_tp)
+    mesh = s.enable_model_parallel(make_mesh({"data": 2, "model": 4}))
+    assert dict(mesh.shape) == {"data": 2, "model": 4}
+    s.step(3)
+
+    feed_ref = _feed()
+    def cat_feed():
+        a, b = feed_ref(), feed_ref()
+        return {k: np.concatenate([a[k], b[k]]) for k in a}
+    spr = mlp_solver()
+    for shp in spr.net_param.layer[0].input_param.shape:
+        shp.dim[0] *= 2
+    ref = Solver(spr, train_feed=cat_feed)
+    ref.step(3)
+
+    _tree_allclose(ref.params, s.params, rtol=1e-5, atol=1e-6)
+
+
+def test_model_parallel_requires_model_axis():
+    s = Solver(mlp_solver(), train_feed=_feed())
+    with pytest.raises(ValueError, match="model"):
+        s.enable_model_parallel(make_mesh({"data": 8}))
