@@ -1,0 +1,256 @@
+"""Classification web demo on the Python standard library.
+
+Reference: examples/web_demo/app.py (a Flask+Tornado app serving the
+pycaffe Classifier with an upload form and a URL field; readme.md lists
+flask/tornado/pillow in requirements.txt). This image ships no flask, so
+the same surface is rebuilt on `http.server`:
+
+  GET  /                 the demo page (URL field + file upload form)
+  GET  /classify_url?imageurl=...    fetch and classify an image URL
+  POST /classify_upload  classify an uploaded image (multipart form)
+
+Results render as the reference's table of the top-5 (label,
+probability) pairs with the classified image embedded base64 in the
+page, and classification errors come back as a friendly banner rather
+than a stack trace. The reference's "maximally accurate / maximally
+specific" second table needs its ImageNet bet pickle (not shipped and
+not derivable) and is omitted.
+
+Run:
+  python examples/web_demo/app.py --model-def models/.../deploy.prototxt \
+      --pretrained-model weights.caffemodel --labels labels.txt --port 5000
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import html
+import io
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, REPO)
+
+ALLOWED_EXT = {"png", "jpg", "jpeg", "bmp", "gif"}
+
+PAGE = """<!doctype html>
+<html><head><title>rram-caffe-simulation-tpu demo</title></head>
+<body style="font-family: sans-serif; max-width: 40em; margin: 2em auto">
+<h1>Classification demo</h1>
+<p>TPU-native framework serving <code>{model}</code>.</p>
+{banner}
+<form action="/classify_url" method="get">
+  <input type="text" name="imageurl" size="40"
+         placeholder="http://... image URL">
+  <input type="submit" value="Classify URL">
+</form>
+<form action="/classify_upload" method="post"
+      enctype="multipart/form-data">
+  <input type="file" name="imagefile">
+  <input type="submit" value="Classify Upload">
+</form>
+{result}
+</body></html>
+"""
+
+
+def render_result(image_b64, preds, seconds):
+    rows = "\n".join(
+        f"<tr><td>{html.escape(name)}</td><td>{prob:.5f}</td>"
+        f"<td><meter value='{prob:.5f}'></meter></td></tr>"
+        for name, prob in preds)
+    return (f"<h2>Top predictions ({seconds:.3f} s)</h2>"
+            f"<table border='1' cellpadding='4'>"
+            f"<tr><th>label</th><th>probability</th><th></th></tr>"
+            f"{rows}</table>"
+            f"<p><img src='data:image/png;base64,{image_b64}' "
+            f"style='max-width: 16em'></p>")
+
+
+class DemoClassifier:
+    """api.Classifier plus a label list; returns top-5 (label, prob)."""
+
+    def __init__(self, model_def, pretrained_model, labels_file=None,
+                 mean_file=None, image_dim=256, raw_scale=255.0,
+                 channel_swap=(2, 1, 0)):
+        from rram_caffe_simulation_tpu.api import Classifier
+        mean = None
+        if mean_file:
+            mean = np.load(mean_file).mean(1).mean(1)
+        self.model_def = model_def
+        self.net = Classifier(model_def, pretrained_model,
+                              image_dims=(image_dim, image_dim),
+                              raw_scale=raw_scale, mean=mean,
+                              channel_swap=channel_swap)
+        n_classes = None
+        self.labels = None
+        if labels_file:
+            with open(labels_file) as f:
+                # synset files are "id name, synonym..."; plain files are
+                # one label per line — take everything after the first
+                # token if it looks like a synset id, else the whole line
+                lines = [l.strip() for l in f if l.strip()]
+            self.labels = [
+                " ".join(l.split(" ")[1:]).split(",")[0]
+                if l.split(" ")[0].startswith("n") and
+                l.split(" ")[0][1:].isdigit() else l
+                for l in lines]
+
+    def classify(self, image):
+        """image: HxWxC float array in [0,1]. -> (ok, payload, seconds)"""
+        try:
+            t0 = time.time()
+            scores = self.net.predict([image], oversample=True).flatten()
+            dt = time.time() - t0
+            top = (-scores).argsort()[:5]
+            names = (self.labels if self.labels is not None
+                     else [f"class {i}" for i in range(len(scores))])
+            preds = [(names[i] if i < len(names) else f"class {i}",
+                      float(scores[i])) for i in top]
+            return True, preds, dt
+        except Exception as err:  # surface as a banner, not a 500
+            return False, (f"Something went wrong when classifying the "
+                           f"image ({err}). Maybe try another one?"), 0.0
+
+
+def decode_image(data: bytes):
+    """bytes -> (HxWxC float [0,1] array, png base64 for re-display)."""
+    from PIL import Image
+    im = Image.open(io.BytesIO(data)).convert("RGB")
+    buf = io.BytesIO()
+    scale = 256.0 / max(im.width, im.height, 256)
+    im.resize((max(1, int(im.width * scale)),
+               max(1, int(im.height * scale)))).save(buf, "PNG")
+    arr = np.asarray(im, dtype=np.float32) / 255.0
+    return arr, base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def parse_multipart(body: bytes, content_type: str):
+    """Extract (filename, payload) of the first file field in a
+    multipart/form-data body."""
+    for token in content_type.split(";"):
+        token = token.strip()
+        if token.startswith("boundary="):
+            boundary = token[len("boundary="):].strip('"').encode()
+            break
+    else:
+        raise ValueError("multipart body without boundary")
+    # parts are delimited by \r\n--boundary; the payload's own bytes may
+    # legitimately end in CR/LF/'-', so strip exactly the one trailing
+    # \r\n that belongs to the delimiter
+    for part in body.split(b"--" + boundary):
+        if b"\r\n\r\n" not in part:
+            continue
+        head, _, payload = part.partition(b"\r\n\r\n")
+        if b"filename=" in head:
+            if payload.endswith(b"\r\n"):
+                payload = payload[:-2]
+            name = ""
+            for line in head.split(b"\r\n"):
+                if not line.lower().startswith(b"content-disposition"):
+                    continue
+                for piece in line.split(b";"):
+                    piece = piece.strip()
+                    if piece.startswith(b"filename="):
+                        name = piece[len(b"filename="):].strip(b'"') \
+                            .decode("utf-8", "replace")
+            return name, payload
+    raise ValueError("no file field in upload")
+
+
+def make_server(clf: DemoClassifier, port: int = 5000,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+
+    class Handler(BaseHTTPRequestHandler):
+        def _page(self, banner="", result="", status=200):
+            doc = PAGE.format(model=html.escape(clf.model_def),
+                              banner=banner, result=result).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(doc)))
+            self.end_headers()
+            self.wfile.write(doc)
+
+        def _classify(self, data: bytes):
+            try:
+                image, b64 = decode_image(data)
+            except Exception:
+                return self._page(banner="<p><b>Cannot open image.</b></p>")
+            ok, payload, dt = clf.classify(image)
+            if not ok:
+                return self._page(
+                    banner=f"<p><b>{html.escape(payload)}</b></p>")
+            self._page(result=render_result(b64, payload, dt))
+
+        def do_GET(self):
+            url = urllib.parse.urlparse(self.path)
+            if url.path == "/":
+                return self._page()
+            if url.path == "/classify_url":
+                q = urllib.parse.parse_qs(url.query)
+                target = (q.get("imageurl") or [""])[0]
+                try:
+                    with urllib.request.urlopen(target, timeout=10) as r:
+                        data = r.read()
+                except Exception:
+                    return self._page(
+                        banner="<p><b>Cannot open that URL.</b></p>")
+                return self._classify(data)
+            self.send_error(404)
+
+        def do_POST(self):
+            if self.path != "/classify_upload":
+                return self.send_error(404)
+            length = int(self.headers.get("Content-Length", "0"))
+            ctype = self.headers.get("Content-Type", "")
+            body = self.rfile.read(length)
+            try:
+                name, data = parse_multipart(body, ctype)
+            except ValueError as err:
+                return self._page(
+                    banner=f"<p><b>{html.escape(str(err))}</b></p>")
+            ext = name.rsplit(".", 1)[-1].lower() if "." in name else ""
+            if ext not in ALLOWED_EXT:
+                return self._page(banner=(
+                    "<p><b>Only image uploads are allowed "
+                    f"({', '.join(sorted(ALLOWED_EXT))}).</b></p>"))
+            self._classify(data)
+
+        def log_message(self, fmt, *args):  # quiet by default
+            if os.environ.get("WEB_DEMO_LOG"):
+                super().log_message(fmt, *args)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model-def", required=True)
+    p.add_argument("--pretrained-model", required=True)
+    p.add_argument("--labels", default="",
+                   help="label file: one per line, or synset format")
+    p.add_argument("--mean-file", default="", help=".npy pixel mean")
+    p.add_argument("--image-dim", type=int, default=256)
+    p.add_argument("--raw-scale", type=float, default=255.0)
+    p.add_argument("--port", type=int, default=5000)
+    args = p.parse_args(argv)
+    clf = DemoClassifier(args.model_def, args.pretrained_model,
+                         labels_file=args.labels or None,
+                         mean_file=args.mean_file or None,
+                         image_dim=args.image_dim,
+                         raw_scale=args.raw_scale)
+    srv = make_server(clf, port=args.port)
+    print(f"Serving on http://{srv.server_address[0]}:"
+          f"{srv.server_address[1]}/")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

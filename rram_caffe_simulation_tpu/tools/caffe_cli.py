@@ -104,11 +104,15 @@ def test(args):
     params = net.copy_trained_from(params, args.weights)
     from ..data.feed import build_feed
     feed = build_feed(net) if net.data_source_tops else (lambda: {})
-    fn = jax.jit(lambda p, b: net.apply(p, b))
+    # stochastic layers (random-filler DummyData; Dropout is a TEST-phase
+    # no-op) need a key even when scoring — fold in the batch index so
+    # draws differ per iteration like the reference's persistent RNG
+    fn = jax.jit(lambda p, b, k: net.apply(p, b, rng=k))
+    key = jax.random.PRNGKey(0)
     totals = {}
     for i in range(args.iterations):
         batch = {k: jnp.asarray(v) for k, v in feed().items()}
-        blobs, loss = fn(params, batch)
+        blobs, loss = fn(params, batch, jax.random.fold_in(key, i))
         line = []
         for name in net.output_names:
             v = np.ravel(np.asarray(blobs[name]))
@@ -354,6 +358,60 @@ def upgrade_solver_proto_text(args):
     return 0
 
 
+# ---------------------------------------------------------------------------
+# deprecated pre-1.0 tool shims (reference tools/train_net.cpp,
+# finetune_net.cpp, test_net.cpp, net_speed_benchmark.cpp, device_query.cpp
+# — each warns and forwards to the consolidated `caffe` command, still
+# accepting the old positional argv)
+
+def _deprecated(old, new, args, usage, min_args, max_args):
+    print(f"{old} is deprecated; use: caffe {new}", file=sys.stderr)
+    if not (min_args <= len(args.args) <= max_args):
+        sys.exit(f"usage: {old} {usage}")
+
+
+@register
+def train_net(args):
+    """tools/train_net.cpp — train_net SOLVER [RESUME.solverstate]."""
+    _deprecated("train_net", "train --solver=...", args,
+                "<solver.prototxt> [resume.solverstate]", 1, 2)
+    args.solver = args.args[0]
+    if len(args.args) == 2:
+        args.snapshot = args.args[1]
+    return train(args)
+
+
+@register
+def finetune_net(args):
+    """tools/finetune_net.cpp — finetune_net SOLVER WEIGHTS."""
+    _deprecated("finetune_net", "train --solver=... --weights=...", args,
+                "<solver.prototxt> <weights.caffemodel>", 2, 2)
+    args.solver, args.weights = args.args
+    return train(args)
+
+
+@register
+def test_net(args):
+    """tools/test_net.cpp — test_net NET WEIGHTS [ITERATIONS]."""
+    _deprecated("test_net", "test --model=... --weights=...", args,
+                "<net.prototxt> <weights.caffemodel> [iterations]", 2, 3)
+    args.model, args.weights = args.args[:2]
+    if len(args.args) == 3:
+        args.iterations = int(args.args[2])
+    return test(args)
+
+
+@register
+def net_speed_benchmark(args):
+    """tools/net_speed_benchmark.cpp — net_speed_benchmark NET [ITERS]."""
+    _deprecated("net_speed_benchmark", "time --model=...", args,
+                "<net.prototxt> [iterations]", 1, 2)
+    args.model = args.args[0]
+    if len(args.args) == 2:
+        args.iterations = int(args.args[1])
+    return time(args)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="caffe", description="command line brew",
@@ -384,7 +442,10 @@ def main(argv=None):
                    choices=["stop", "snapshot", "none"])
     args = p.parse_args(argv)
     takes_positional = (args.command.startswith("upgrade_")
-                        or args.command == "extract_features")
+                        or args.command == "extract_features"
+                        or args.command in ("train_net", "finetune_net",
+                                            "test_net",
+                                            "net_speed_benchmark"))
     if args.args and not takes_positional:
         p.error(f"unrecognized arguments: {' '.join(args.args)}")
     return BREW[args.command](args)

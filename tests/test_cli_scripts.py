@@ -151,3 +151,69 @@ layer { name: "out" type: "InnerProduct" bottom: "fc" top: "out"
     out = capsys.readouterr().out
     assert "Average Forward pass:" in out
     assert "drop" in out  # per-layer row present
+
+
+DUMMY_SCORE_NET = """
+name: "DummyScore"
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param {
+    shape { dim: 4 dim: 6 } shape { dim: 4 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 1 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc" bottom: "label"
+  top: "loss" }
+"""
+
+
+def test_deprecated_tool_shims(tmp_path, capsys):
+    """The pre-1.0 tool names (reference tools/train_net.cpp,
+    finetune_net.cpp, test_net.cpp, net_speed_benchmark.cpp) still work
+    as positional-argv shims that warn and forward to the consolidated
+    command."""
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+
+    npar = pb.NetParameter()
+    text_format.Parse(DUMMY_SCORE_NET, npar)
+    net_path = str(tmp_path / "net.prototxt")
+    uio.write_proto_text(net_path, npar)
+
+    sp = pb.SolverParameter()
+    sp.net = net_path
+    sp.base_lr = 0.01
+    sp.lr_policy = "fixed"
+    sp.max_iter = 2
+    sp.display = 0
+    sp.snapshot_prefix = str(tmp_path / "shim")
+    solver_path = str(tmp_path / "solver.prototxt")
+    uio.write_proto_text(solver_path, sp)
+
+    # train_net SOLVER -> trains and snapshots at max_iter
+    rc = caffe_cli.main(["train_net", solver_path])
+    assert rc == 0
+    weights = str(tmp_path / "shim_iter_2.caffemodel")
+    assert os.path.exists(weights)
+    err = capsys.readouterr().err
+    assert "deprecated" in err
+
+    # finetune_net SOLVER WEIGHTS -> trains from the snapshot
+    rc = caffe_cli.main(["finetune_net", solver_path, weights])
+    assert rc == 0
+
+    # test_net NET WEIGHTS ITERATIONS -> scores
+    rc = caffe_cli.main(["test_net", net_path, weights, "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "loss = " in out
+
+    # net_speed_benchmark NET ITERS -> per-layer timing
+    rc = caffe_cli.main(["net_speed_benchmark", net_path, "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Average Forward pass:" in out
+
+    # bad argv -> usage error, not a stack trace
+    with pytest.raises(SystemExit):
+        caffe_cli.main(["train_net"])

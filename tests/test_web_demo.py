@@ -1,0 +1,163 @@
+"""examples/web_demo parity: the stdlib http.server rebuild of the
+reference's Flask demo (examples/web_demo/app.py), driven over a real
+socket — form page, multipart upload, file:// URL classification, and
+the error banners."""
+import io
+import os
+import sys
+import threading
+import urllib.request
+import uuid
+
+import numpy as np
+import jax
+import pytest
+from PIL import Image
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.net import Net as CoreNet
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import io as uio
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples", "web_demo"))
+import app as web_app  # noqa: E402
+
+
+DEPLOY = """
+name: "DemoNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+@pytest.fixture(scope="module")
+def demo_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("webdemo")
+    npar = pb.NetParameter()
+    text_format.Parse(DEPLOY, npar)
+    proto = str(tmp / "deploy.prototxt")
+    uio.write_proto_text(proto, npar)
+    net = CoreNet(npar, pb.TEST)
+    weights = str(tmp / "w.caffemodel")
+    uio.write_proto_binary(
+        weights, net.to_proto(net.init(jax.random.PRNGKey(0))))
+    labels = str(tmp / "labels.txt")
+    with open(labels, "w") as f:
+        f.write("aardvark\nbobcat\ncrane\n")
+
+    clf = web_app.DemoClassifier(proto, weights, labels_file=labels,
+                                 image_dim=20)
+    srv = web_app.make_server(clf, port=0)  # OS-assigned port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, tmp
+    srv.shutdown()
+
+
+def _png_bytes(seed=0):
+    rng = np.random.RandomState(seed)
+    im = Image.fromarray(
+        rng.randint(0, 255, size=(24, 20, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    im.save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, r.read().decode()
+
+
+def test_index_serves_forms(demo_server):
+    base, _ = demo_server
+    status, body = _get(base + "/")
+    assert status == 200
+    assert "classify_url" in body and "classify_upload" in body
+
+
+def test_upload_classifies(demo_server):
+    base, _ = demo_server
+    boundary = uuid.uuid4().hex
+    payload = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="imagefile"; '
+        f'filename="t.png"\r\n'
+        f"Content-Type: image/png\r\n\r\n").encode() + _png_bytes() + (
+        f"\r\n--{boundary}--\r\n").encode()
+    req = urllib.request.Request(
+        base + "/classify_upload", data=payload, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req) as r:
+        body = r.read().decode()
+    assert "Top predictions" in body
+    assert any(l in body for l in ("aardvark", "bobcat", "crane"))
+    assert "data:image/png;base64," in body  # image echoed back
+
+
+def test_classify_file_url(demo_server):
+    base, tmp = demo_server
+    img = tmp / "input.png"
+    img.write_bytes(_png_bytes(seed=3))
+    status, body = _get(base + "/classify_url?imageurl=file://" + str(img))
+    assert status == 200
+    assert "Top predictions" in body
+
+
+def test_bad_url_banner(demo_server):
+    base, _ = demo_server
+    status, body = _get(
+        base + "/classify_url?imageurl=file:///nonexistent.png")
+    assert status == 200
+    assert "Cannot open that URL" in body
+
+
+def test_parse_multipart_preserves_trailing_bytes():
+    """Payload bytes that happen to end in CR/LF/'-' are file content,
+    not delimiter — only the single \\r\\n before the boundary goes."""
+    tail = b"\x00\x01\r\n-"  # legitimate final bytes of a binary file
+    boundary = "bnd123"
+    body = (f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="imagefile"; '
+            f'filename="t.bmp"\r\n\r\n').encode() + tail + (
+            f"\r\n--{boundary}--\r\n").encode()
+    name, payload = web_app.parse_multipart(
+        body, f"multipart/form-data; boundary={boundary}")
+    assert name == "t.bmp"
+    assert payload == tail
+
+
+def test_disallowed_extension_banner(demo_server):
+    base, _ = demo_server
+    boundary = uuid.uuid4().hex
+    payload = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="imagefile"; '
+        f'filename="evil.exe"\r\n\r\n').encode() + b"MZ" + (
+        f"\r\n--{boundary}--\r\n").encode()
+    req = urllib.request.Request(
+        base + "/classify_upload", data=payload, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req) as r:
+        body = r.read().decode()
+    assert "Only image uploads are allowed" in body
+
+
+def test_bad_upload_banner(demo_server):
+    base, _ = demo_server
+    req = urllib.request.Request(
+        base + "/classify_upload", data=b"not multipart", method="POST",
+        headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req) as r:
+        body = r.read().decode()
+    assert "boundary" in body or "no file field" in body
