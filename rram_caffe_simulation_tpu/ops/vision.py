@@ -321,11 +321,16 @@ class BatchNormLayer(Layer):
         var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
         y = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + self.eps)
         # Moving-average update (batch_norm_layer.cpp:120-130): the stored
-        # stats are sums discounted by scale_factor.
+        # stats are sums discounted by scale_factor. Accumulate in >=f32:
+        # under a bf16 compute_dtype the steady-state increment (~1e-3 of
+        # the stat) is below bf16's half-ulp and the average would freeze.
+        acc = jnp.promote_types(x.dtype, jnp.float32)
         bias_corr = m / (m - 1.0) if m > 1 else 1.0
-        new_mean = self.maf * mean_b + lax.stop_gradient(mean)
-        new_var = self.maf * var_b + bias_corr * lax.stop_gradient(var)
-        new_sf = self.maf * sf + 1.0
+        new_mean = (self.maf * mean_b.astype(acc)
+                    + lax.stop_gradient(mean).astype(acc))
+        new_var = (self.maf * var_b.astype(acc)
+                   + bias_corr * lax.stop_gradient(var).astype(acc))
+        new_sf = self.maf * sf.astype(acc) + 1.0
         return [y], [new_mean, new_var, new_sf]
 
 

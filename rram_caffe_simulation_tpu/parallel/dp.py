@@ -37,7 +37,8 @@ def make_dp_step(solver, mesh: Mesh):
     axis via `shard_batch`. GSPMD inserts the gradient all-reduce.
     Returns (jitted_step, place_state).
     """
-    step = solver.make_train_step()
+    step = solver.make_train_step(
+        compute_dtype=getattr(solver, "compute_dtype", None))
     repl = replicated(mesh)
 
     def place_state(params, history, fault_state):

@@ -56,7 +56,7 @@ class SweepRunner:
     """
 
     def __init__(self, solver, n_configs: int, mesh=None, means=None,
-                 stds=None, preload: bool = True):
+                 stds=None, preload: bool = True, compute_dtype=None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -86,8 +86,13 @@ class SweepRunner:
 
         # Force the pure-JAX hardware-aware engine: the Monte-Carlo config
         # axis vmaps the whole step, and perturb_weight vmaps cleanly
-        # where the Pallas crossbar kernel would not.
-        base = solver.make_train_step(hw_engine="jax")
+        # where the Pallas crossbar kernel would not. compute_dtype (e.g.
+        # "bfloat16") halves the sweep's activation HBM traffic while
+        # masters/updates/fault state stay f32 (see make_train_step).
+        if compute_dtype is None:
+            compute_dtype = getattr(solver, "compute_dtype", None)
+        base = solver.make_train_step(hw_engine="jax",
+                                      compute_dtype=compute_dtype)
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))

@@ -67,10 +67,13 @@ class Solver:
     step(n), solve(), test_all(), snapshot(), restore(path)."""
 
     def __init__(self, param, train_feed: Optional[Callable] = None,
-                 test_feeds=None):
+                 test_feeds=None, compute_dtype=None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
         self.param = param
+        # forward/backward dtype for the train step (e.g. "bfloat16");
+        # masters/updates/fault state stay f32 — see make_train_step
+        self.compute_dtype = compute_dtype
         self.type = _resolve_solver_type(param)
         if self.type not in U.UPDATE_RULES:
             raise ValueError(f"unknown solver type {self.type!r}")
@@ -252,7 +255,8 @@ class Solver:
     # ------------------------------------------------------------------
     # the jitted train step
 
-    def make_train_step(self, hw_engine: str = "auto"):
+    def make_train_step(self, hw_engine: str = "auto",
+                        compute_dtype=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs)
@@ -264,7 +268,18 @@ class Solver:
         cuDNN engine choice (layer_factory.cpp:38): "pallas" = the fused
         crossbar_matmul kernel (noise drawn in VMEM); "jax" = pure
         perturb_weight (vmappable — the sweep path forces this); "auto" =
-        pallas on the TPU backend, jax elsewhere."""
+        pallas on the TPU backend, jax elsewhere.
+
+        `compute_dtype` (e.g. "bfloat16") runs forward/backward in that
+        dtype — MXU-native matmuls, halved HBM traffic on the
+        activation-heavy Monte-Carlo sweep — while keeping f32 master
+        params, f32 updates/momentum, and f32 fault state (lifetimes at
+        the 1e8 operating point do not survive a bf16 mantissa). The
+        cast lives inside the loss so autodiff returns f32 grads, loss
+        layers upcast internally for stable log/exp, and masters are
+        delta-merged so a pass-through parameter is preserved BIT-EXACT
+        (no bf16 round-trip of the weights; only genuinely self-updated
+        state like BatchNorm moving stats takes the cast delta)."""
         net = self.net
         param = self.param
         solver_type = self.type
@@ -301,8 +316,16 @@ class Solver:
         # pallas engine; biases always take the pure perturbation.
         crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
 
+        cdtype = jnp.dtype(compute_dtype) if compute_dtype else None
+
+        def _to_run(tree):
+            return jax.tree.map(
+                lambda a: a.astype(cdtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
         def forward_backward(params, batch, it, rng, fault_state):
             def loss_fn(p):
+                p_master = p
                 clean = flat(p)
                 crossbar = None
                 if hw_sigma:
@@ -324,8 +347,12 @@ class Solver:
                                 fault_state["stuck"][k], noise_key,
                                 hw_sigma)
                     p = unflat(fp, p)
+                run_batch = batch
+                if cdtype is not None:
+                    p = _to_run(p)
+                    run_batch = _to_run(batch)
                 blobs, loss, newp = net.apply(
-                    p, batch, rng=rng, iteration=it, with_updates=True,
+                    p, run_batch, rng=rng, iteration=it, with_updates=True,
                     adc_bits=adc_bits, crossbar=crossbar)
                 if hw_sigma:
                     # Conductance noise is a READ effect only: net.apply
@@ -335,8 +362,20 @@ class Solver:
                     # sigma*eps compounds into the parameters each step.
                     fn = flat(newp)
                     for k in fault_keys:
-                        fn[k] = clean[k]
+                        fn[k] = (clean[k] if cdtype is None
+                                 else clean[k].astype(fn[k].dtype))
                     newp = unflat(fn, newp)
+                if cdtype is not None:
+                    # Merge back onto the f32 masters: a parameter the
+                    # net merely passed through satisfies run == cast(m),
+                    # so m survives bit-exact; self-updated state (BN
+                    # moving stats) keeps its advance as an f32 delta.
+                    newp = jax.tree.map(
+                        lambda m, n: m + (n.astype(m.dtype) -
+                                          m.astype(cdtype).astype(m.dtype))
+                        if jnp.issubdtype(m.dtype, jnp.floating) else n,
+                        p_master, newp)
+                    loss = loss.astype(jnp.float32)
                 outputs = {name: blobs[name] for name in net.output_names}
                 return loss, (outputs, newp)
             (loss, (outputs, newp)), grads = jax.value_and_grad(
@@ -427,8 +466,9 @@ class Solver:
 
     def _compiled_step(self):
         if self._step_fn is None:
-            self._step_fn = jax.jit(self.make_train_step(),
-                                    donate_argnums=(0, 1, 2))
+            self._step_fn = jax.jit(
+                self.make_train_step(compute_dtype=self.compute_dtype),
+                donate_argnums=(0, 1, 2))
         return self._step_fn
 
     def enable_data_parallel(self, mesh=None, devices=None):
@@ -530,7 +570,8 @@ class Solver:
         # "jax" engine: the pallas crossbar kernel has no GSPMD
         # partitioning rule for a model-sharded weight operand; the pure
         # perturb_weight path partitions like any elementwise op.
-        step = self.make_train_step(hw_engine="jax")
+        step = self.make_train_step(hw_engine="jax",
+                                    compute_dtype=self.compute_dtype)
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2),
                                 out_shardings=out_shardings)
         self._tp_layer_specs = layer_specs

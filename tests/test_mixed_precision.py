@@ -1,0 +1,120 @@
+"""compute_dtype (mixed precision) contract: bf16 forward/backward with
+f32 masters, f32 updates, f32 fault state (Solver.make_train_step /
+SweepRunner compute_dtype). The reference is f32-only; this is the
+TPU-first throughput mode (bench.py default), so its invariants need
+pinning: no bf16 round-trip of master weights, identical fault
+dynamics, and a training trajectory that tracks f32."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.parallel import SweepRunner
+
+
+NET = """
+name: "MpNet"
+layer { name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 } shape { dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "bn" type: "BatchNorm" bottom: "conv1" top: "conv1" }
+layer { name: "relu" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+
+
+def make_sp(lr, fault=True):
+    sp = pb.SolverParameter()
+    text_format.Parse(NET, sp.net_param)
+    sp.base_lr = lr
+    sp.lr_policy = "fixed"
+    sp.momentum = 0.9
+    sp.type = "SGD"
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 5
+    sp.snapshot_prefix = "/tmp/mp_test"
+    if fault:
+        sp.failure_pattern.type = "gaussian"
+        sp.failure_pattern.mean = 200.0
+        sp.failure_pattern.std = 20.0
+    return sp
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    return {"data": rng.randn(8, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, 8).astype(np.int32)}
+
+
+def test_bf16_masters_never_round_trip():
+    """At lr=0 a bf16 step must leave every non-self-updating master
+    param BIT-exact f32 (the delta-merge contract) — a naive cast-back
+    would quantize the weights each step."""
+    batch = _batch()
+    s = Solver(make_sp(0.0), train_feed=lambda: batch)
+    r = SweepRunner(s, n_configs=4, compute_dtype="bfloat16")
+    p0 = jax.tree.map(np.asarray, r.params)
+    r.step(2)
+    for ln, arrs in r.params.items():
+        for i, a in enumerate(arrs):
+            if a is None:
+                continue
+            # master precision preserved (f32, or f64 under the test
+            # matrix's x64 mode) — never narrowed to the compute dtype
+            assert a.dtype == p0[ln][i].dtype, (ln, i, a.dtype)
+            if ln != "bn":  # BN moving stats legitimately advance
+                np.testing.assert_array_equal(
+                    np.asarray(a), p0[ln][i],
+                    err_msg=f"{ln}/{i} master drifted at lr=0")
+
+
+def test_bf16_bn_stats_still_advance():
+    batch = _batch()
+    s = Solver(make_sp(0.0), train_feed=lambda: batch)
+    r = SweepRunner(s, n_configs=2, compute_dtype="bfloat16")
+    bn0 = [np.asarray(a) for a in r.params["bn"]]
+    r.step(2)
+    moved = any(not np.array_equal(np.asarray(a), b)
+                for a, b in zip(r.params["bn"], bn0))
+    assert moved, "BatchNorm moving stats froze under compute_dtype"
+
+
+def test_bf16_tracks_f32_training():
+    """30 sweep steps: the bf16 parameter trajectory stays within a few
+    percent of f32 (same seeds, same fault draws)."""
+    mass = {}
+    for dt in (None, "bfloat16"):
+        batch = _batch()
+        s = Solver(make_sp(0.05), train_feed=lambda: batch)
+        r = SweepRunner(s, n_configs=4, compute_dtype=dt)
+        r.step(30)
+        mass[dt] = sum(float(jnp.sum(jnp.abs(a)))
+                       for a in jax.tree.leaves(r.params))
+        # fault dynamics must be identical: state is f32 in both modes
+        # and the decrement threshold sees f32 updates
+        bf = np.mean([np.asarray(v <= 0).mean()
+                      for v in r.fault_states["lifetimes"].values()])
+        mass[f"broken_{dt}"] = float(bf)
+    rel = abs(mass[None] - mass["bfloat16"]) / abs(mass[None])
+    assert rel < 0.05, f"bf16 trajectory diverged: rel={rel}"
+    assert mass["broken_None"] == mass["broken_bfloat16"]
+
+
+def test_bf16_single_solver_step():
+    """compute_dtype works on the plain (non-sweep) Solver path too."""
+    batch = _batch()
+    s = Solver(make_sp(0.05), train_feed=lambda: batch,
+               compute_dtype="bfloat16")
+    s.step(3)
+    assert np.isfinite(s.smoothed_loss)
+    assert all(a.dtype != jnp.bfloat16
+               for a in jax.tree.leaves(s.params))
