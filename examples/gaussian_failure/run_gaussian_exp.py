@@ -175,7 +175,8 @@ def main(argv=None):
             means = [float(x) for x in args.sweep_means.split(",")]
             stds = ([float(x) for x in args.sweep_stds.split(",")]
                     if args.sweep_stds else None)
-            solver = Solver(message)
+            solver = Solver(message,
+                            compute_dtype=args.compute_dtype or None)
             runner = SweepRunner(solver, n_configs=len(means),
                                  means=np.asarray(means, np.float32),
                                  stds=(np.asarray(stds, np.float32)

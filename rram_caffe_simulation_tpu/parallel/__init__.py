@@ -20,7 +20,9 @@ from .mesh import make_mesh, data_sharding, config_sharding, replicated
 from .dp import make_dp_step, shard_batch
 from .sweep import SweepRunner, stack_fault_states
 from .tp import tp_param_specs
+from .pp import pipeline_apply, stack_stage_params
 
 __all__ = ["make_mesh", "data_sharding", "config_sharding", "replicated",
            "make_dp_step", "shard_batch", "SweepRunner",
-           "stack_fault_states", "tp_param_specs"]
+           "stack_fault_states", "tp_param_specs", "pipeline_apply",
+           "stack_stage_params"]

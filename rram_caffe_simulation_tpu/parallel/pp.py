@@ -1,0 +1,98 @@
+"""Pipeline (stage) parallelism: GPipe-style microbatch rotation over a
+mesh "stage" axis.
+
+The reference has no pipeline parallelism (SURVEY §2c: DP only). This is
+the TPU-first scale-out primitive for models DEEPER than one chip's HBM:
+a stack of S homomorphic stages (same activation shape in/out — repeated
+MLP/conv blocks, unrolled recurrent cells) is laid out one stage per
+device along a "stage" mesh axis, and M microbatches flow through the
+pipe in M + S - 1 ticks. Each tick every device applies its stage to its
+current activation, then the activations rotate one hop along the ring
+via `lax.ppermute` (ICI neighbor traffic, never host). Stage parameters
+never move — only the (microbatch-sized) activations do.
+
+Differentiation: `jax.grad` through the scan + ppermute gives exact
+gradients (the VJP of ppermute is the reverse rotation — the backward
+pipe), so `pipeline_apply` composes with the framework's loss layers and
+solver updates like any pure function. Values and gradients are pinned
+equal to the equivalent sequential stack by tests/test_pp.py on the
+8-virtual-device mesh.
+
+Scope (documented, not hidden): stages must share one activation
+shape — the rotating buffer is a single array. Heterogeneous Caffe
+graphs (conv->pool->fc) pipeline at the granularity of their repeated
+blocks, not arbitrary cut points; that is the same contract the
+scaling-book pipeline pattern and GPipe's partitioner assume for the
+balanced case.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree_stage0, pytree_stage1, ...] -> one pytree with a leading
+    stage axis, ready to shard over the "stage" mesh axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _pipe_local(stage_fn, params_local, xs_local, axis, n_stage, n_micro):
+    """Per-device body (inside shard_map): params_local is THIS stage's
+    params (leading stage axis stripped to size 1), xs_local the full
+    microbatch stack (replicated)."""
+    idx = jax.lax.axis_index(axis)
+    params_local = jax.tree.map(lambda a: a[0], params_local)
+    fwd = functools.partial(stage_fn, params_local)
+    right = [(s, (s + 1) % n_stage) for s in range(n_stage)]
+
+    mb_shape = xs_local.shape[1:]
+    zeros = jnp.zeros(mb_shape, xs_local.dtype)
+
+    def tick(carry, t):
+        # feed the pipe head; everyone else uses what rotated in
+        head = jax.lax.dynamic_index_in_dim(
+            xs_local, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+        inp = jnp.where(idx == 0, head, carry)
+        out = fwd(inp)
+        # tail's finished microbatch for this tick (valid once t >= S-1)
+        done = jnp.where(idx == n_stage - 1, out, zeros)
+        nxt = jax.lax.ppermute(out, axis, right)
+        return nxt, done
+
+    _, dones = jax.lax.scan(tick, zeros, jnp.arange(n_micro + n_stage - 1))
+    # microbatch m finishes at tick m + S - 1 on the last stage;
+    # psum replicates the tail's results (all other stages emitted 0)
+    return jax.lax.psum(dones[n_stage - 1:], axis)
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh,
+                   axis: str = "stage"):
+    """Run `microbatches` (leading axis M) through S pipelined stages.
+
+    stage_fn(params, x) -> y with y.shape == x.shape; `stacked_params`
+    carries a leading stage axis of size mesh.shape[axis] (see
+    stack_stage_params). Returns the (M, ...) outputs of the final
+    stage. Jit- and grad-compatible."""
+    n_stage = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n_stage:
+        # an even multiple would pass shard_map's divisibility check and
+        # silently run only every (lead/n_stage)-th stage
+        raise ValueError(
+            f"stacked_params carry {lead} stages but the '{axis}' mesh "
+            f"axis has {n_stage} devices; they must match 1:1")
+    body = functools.partial(_pipe_local, stage_fn, axis=axis,
+                             n_stage=n_stage, n_micro=n_micro)
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        # ppermute-in-scan trips the varying-axis checker the same way
+        # ring attention does (sequence.py); correctness is pinned
+        # against the sequential stack in tests/test_pp.py
+        check_vma=False)(stacked_params, microbatches)

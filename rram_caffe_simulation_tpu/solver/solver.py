@@ -309,14 +309,16 @@ class Solver:
                     if param.HasField("rram_forward") and has_fault else 0.0)
         adc_bits = (int(param.rram_forward.adc_bits)
                     if param.HasField("rram_forward") and has_fault else 0)
-        use_pallas = bool(hw_sigma) and (
+        cdtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        # the Pallas crossbar custom_vjp is f32-typed end to end; under a
+        # lower compute_dtype the pure perturb path partitions/casts
+        # cleanly, so compute_dtype forces the "jax" engine
+        use_pallas = bool(hw_sigma) and cdtype is None and (
             hw_engine == "pallas" or
             (hw_engine == "auto" and jax.default_backend() == "tpu"))
         # Weight (2-D crossbar) keys go through the fused kernel on the
         # pallas engine; biases always take the pure perturbation.
         crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
-
-        cdtype = jnp.dtype(compute_dtype) if compute_dtype else None
 
         def _to_run(tree):
             return jax.tree.map(
