@@ -177,11 +177,11 @@ def main(argv=None):
                     if args.sweep_stds else None)
             solver = Solver(message,
                             compute_dtype=args.compute_dtype or None)
+            # SweepRunner inherits the solver's compute_dtype
             runner = SweepRunner(solver, n_configs=len(means),
                                  means=np.asarray(means, np.float32),
                                  stds=(np.asarray(stds, np.float32)
-                                       if stds else None),
-                                 compute_dtype=args.compute_dtype or None)
+                                       if stds else None))
             interval = message.display or 100
             for start in range(0, message.max_iter, interval):
                 loss, _ = runner.step(min(interval,

@@ -1,0 +1,154 @@
+"""Generate ResNet-50 train_val/deploy prototxts with the net_spec DSL.
+
+SURVEY §7 build-plan item 7 names ResNet-50 as the scale-out net for the
+noise-in-the-loop (hardware-aware) configuration — the reference zoo
+itself predates ResNet, so this follows the published He et al. Caffe
+layout (the deep-residual-networks release): conv1 7x7/2-64 +
+BN/Scale/ReLU, 3x3/2 max pool, four bottleneck stages of [3, 4, 6, 3]
+blocks (branch2a/b/c 1x1-3x3-1x1 with a branch1 projection and stride 2
+at each stage entry except res2a's), Eltwise sum + ReLU per block,
+global average pool, fc1000. Layer/blob names match that release
+(res2a_branch1, bn2a_branch2b, scale3d_branch2c, ...) so published
+ResNet-50 `.caffemodel` weights load by name via copy_trained_from.
+
+Run:  python models/resnet50/generate.py  (rewrites the prototxts
+in-place next to this file).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from rram_caffe_simulation_tpu.api.net_spec import NetSpec, layers as L  # noqa: E402
+from rram_caffe_simulation_tpu.proto import pb  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (stage index, blocks, bottleneck width, output width, entry stride)
+# — the [3, 4, 6, 3] ResNet-50 recipe
+STAGES = [(2, 3, 64, 256, 1), (3, 4, 128, 512, 2),
+          (4, 6, 256, 1024, 2), (5, 3, 512, 2048, 2)]
+
+CONV_PARAM = [dict(lr_mult=1, decay_mult=1)]  # release uses bias_term: false
+BN_PARAM = [dict(lr_mult=0)] * 3
+SCALE_PARAM = [dict(lr_mult=1, decay_mult=0), dict(lr_mult=2, decay_mult=0)]
+
+
+def conv_bn_scale(n, tag, bottom, nout, ks, stride=1, pad=0, relu=False):
+    """conv{tag} -> bn{tag} -> scale{tag} (-> relu), release naming."""
+    n["res" + tag] = L.Convolution(
+        bottom, num_output=nout, kernel_size=ks, stride=stride, pad=pad,
+        bias_term=False, param=CONV_PARAM,
+        weight_filler=dict(type="msra"))
+    n["bn" + tag] = L.BatchNorm(n["res" + tag], in_place=True,
+                                param=BN_PARAM)
+    n["scale" + tag] = L.Scale(n["res" + tag], in_place=True,
+                               bias_term=True, param=SCALE_PARAM)
+    if relu:
+        n["res" + tag + "_relu"] = L.ReLU(n["res" + tag], in_place=True)
+    return n["res" + tag]
+
+
+def bottleneck(n, stage, block, bottom, width, nout, stride):
+    """res{stage}{block}: branch2a/b/c + identity-or-projection branch1."""
+    tag = f"{stage}{block}"
+    if block == "a":
+        shortcut = conv_bn_scale(n, tag + "_branch1", bottom, nout, 1,
+                                 stride=stride)
+    else:
+        shortcut = bottom
+    b2a = conv_bn_scale(n, tag + "_branch2a", bottom, width, 1,
+                        stride=stride if block == "a" else 1, relu=True)
+    b2b = conv_bn_scale(n, tag + "_branch2b", b2a, width, 3, pad=1,
+                        relu=True)
+    b2c = conv_bn_scale(n, tag + "_branch2c", b2b, nout, 1)
+    n[f"res{tag}"] = L.Eltwise(shortcut, b2c)
+    n[f"res{tag}_relu"] = L.ReLU(n[f"res{tag}"], in_place=True)
+    return n[f"res{tag}"]
+
+
+def body(n, data):
+    n.conv1 = L.Convolution(
+        data, num_output=64, kernel_size=7, stride=2, pad=3,
+        bias_term=False, param=CONV_PARAM,
+        weight_filler=dict(type="msra"))
+    n.bn_conv1 = L.BatchNorm(n.conv1, in_place=True, param=BN_PARAM)
+    n.scale_conv1 = L.Scale(n.conv1, in_place=True, bias_term=True,
+                            param=SCALE_PARAM)
+    n.conv1_relu = L.ReLU(n.conv1, in_place=True)
+    n.pool1 = L.Pooling(n.conv1, pool=pb.PoolingParameter.MAX,
+                        kernel_size=3, stride=2)
+    top = n.pool1
+    for stage, blocks, width, nout, stride in STAGES:
+        for bi in range(blocks):
+            block = chr(ord("a") + bi)
+            top = bottleneck(n, stage, block, top, width, nout,
+                             stride if bi == 0 else 1)
+    n.pool5 = L.Pooling(top, pool=pb.PoolingParameter.AVE,
+                        kernel_size=7, stride=1)
+    n.fc1000 = L.InnerProduct(
+        n.pool5, num_output=1000,
+        param=[dict(lr_mult=1, decay_mult=1),
+               dict(lr_mult=2, decay_mult=0)],
+        weight_filler=dict(type="msra"),
+        bias_filler=dict(type="constant"))
+    return n.fc1000
+
+
+def train_val():
+    n = NetSpec()
+    n.data, n.label = L.Data(
+        ntop=2, include=dict(phase=pb.TRAIN),
+        transform_param=dict(mirror=True, crop_size=224,
+                             mean_value=[104, 117, 123]),
+        data_param=dict(source="examples/imagenet/ilsvrc12_train_lmdb",
+                        batch_size=32, backend=pb.DataParameter.LMDB))
+    fc = body(n, n.data)
+    n.loss = L.SoftmaxWithLoss(fc, n.label)
+    n.accuracy = L.Accuracy(fc, n.label, include=dict(phase=pb.TEST))
+    n["accuracy_top5"] = L.Accuracy(
+        fc, n.label, include=dict(phase=pb.TEST),
+        accuracy_param=dict(top_k=5))
+    proto = n.to_proto()
+    # TEST-phase twin data layer, prepended like the zoo train_vals
+    test_data = pb.LayerParameter()
+    test_data.name = "data"
+    test_data.type = "Data"
+    test_data.top.extend(["data", "label"])
+    test_data.include.add().phase = pb.TEST
+    test_data.transform_param.crop_size = 224
+    test_data.transform_param.mean_value.extend([104, 117, 123])
+    test_data.data_param.source = "examples/imagenet/ilsvrc12_val_lmdb"
+    test_data.data_param.batch_size = 25
+    test_data.data_param.backend = pb.DataParameter.LMDB
+    out = pb.NetParameter()
+    out.name = "ResNet-50"
+    out.layer.append(proto.layer[0])   # TRAIN data
+    out.layer.append(test_data)
+    out.layer.extend(proto.layer[1:])
+    return out
+
+
+def deploy_proto():
+    """Deploy = Input layer + body + Softmax prob."""
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[1, 3, 224, 224])))
+    fc = body(n, n.data)
+    n.prob = L.Softmax(fc)
+    proto = n.to_proto()
+    proto.name = "ResNet-50"
+    return proto
+
+
+def main():
+    from google.protobuf import text_format
+    for fname, proto in (("resnet50_train_val.prototxt", train_val()),
+                         ("resnet50_deploy.prototxt", deploy_proto())):
+        path = os.path.join(HERE, fname)
+        with open(path, "w") as f:
+            f.write(text_format.MessageToString(proto))
+        print(f"wrote {path} ({len(proto.layer)} layers)")
+
+
+if __name__ == "__main__":
+    main()

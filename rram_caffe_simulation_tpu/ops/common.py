@@ -352,7 +352,9 @@ class ScaleLayer(Layer):
             if sp.HasField("filler"):
                 params.append(make_filler(sp.filler)(ks, self.scale_shape))
             else:
-                params.append(jnp.ones(self.scale_shape))
+                # explicit f32: default dtype would be f64 under x64,
+                # poisoning downstream conv dtypes
+                params.append(jnp.ones(self.scale_shape, jnp.float32))
         if self.bias_term:
             params.append(make_filler(sp.bias_filler)(kb, self.scale_shape))
         return params

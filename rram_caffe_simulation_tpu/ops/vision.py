@@ -302,7 +302,10 @@ class BatchNormLayer(Layer):
 
     def init_params(self, key):
         c = self.channels
-        return [jnp.zeros((c,)), jnp.zeros((c,)), jnp.zeros((1,))]
+        # explicit f32: default dtype would be f64 under x64 (the test
+        # matrix), and f64 stats poison downstream conv dtypes
+        return [jnp.zeros((c,), jnp.float32), jnp.zeros((c,), jnp.float32),
+                jnp.zeros((1,), jnp.float32)]
 
     def apply(self, params, bottoms, ctx):
         x = bottoms[0]

@@ -310,9 +310,16 @@ class Solver:
         adc_bits = (int(param.rram_forward.adc_bits)
                     if param.HasField("rram_forward") and has_fault else 0)
         cdtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        if cdtype == jnp.float32:
+            cdtype = None  # f32 is the native dtype; nothing to cast
         # the Pallas crossbar custom_vjp is f32-typed end to end; under a
-        # lower compute_dtype the pure perturb path partitions/casts
-        # cleanly, so compute_dtype forces the "jax" engine
+        # lower compute_dtype only the pure perturb path partitions/casts
+        # cleanly
+        if cdtype is not None and hw_engine == "pallas":
+            raise ValueError(
+                f"hw_engine='pallas' is f32-only (the crossbar custom_vjp "
+                f"computes f32 cotangents) but compute_dtype={compute_dtype!r}"
+                "; drop compute_dtype or use hw_engine='jax'")
         use_pallas = bool(hw_sigma) and cdtype is None and (
             hw_engine == "pallas" or
             (hw_engine == "auto" and jax.default_backend() == "tpu"))

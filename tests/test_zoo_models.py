@@ -201,3 +201,46 @@ def test_googlenet_test_phase_has_topk(tmp_path):
     for head in ("loss1", "loss2", "loss3"):
         assert f"{head}/top-1" in names
         assert f"{head}/top-5" in names
+
+
+def test_resnet50_structure_and_train_backward(tmp_path):
+    """ResNet-50 (SURVEY §7 item 7: the scale-out net for the
+    noise-in-the-loop config; generated by models/resnet50/generate.py
+    with the release's layer names so published weights load by name).
+    Structural pins + forward/backward through all four bottleneck
+    stages. BN runs on batch statistics (TRAIN) — a random-init
+    TEST-phase BN net amplifies by 1/sqrt(eps) per stage by design,
+    in the reference exactly as here."""
+    npar = uio.read_net_param(
+        os.path.join(REPO, "models", "resnet50",
+                     "resnet50_train_val.prototxt"))
+    db = _tiny_ilsvrc_lmdb(tmp_path / "ilsvrc_lmdb")
+    for lp in npar.layer:
+        if lp.type == "Data":
+            lp.data_param.source = db
+            lp.data_param.batch_size = 2
+    net = Net(npar, pb.TRAIN)
+    names = {l.name for l in net.layers}
+    # release naming contract (one probe per naming family)
+    for probe in ["conv1", "bn_conv1", "scale_conv1", "res2a_branch1",
+                  "res3b_branch2b", "bn4c_branch2c", "scale5a_branch1",
+                  "res5c", "pool5", "fc1000"]:
+        assert probe in names, probe
+    params = net.init(jax.random.PRNGKey(0))
+    count = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params)
+                if a is not None)
+    assert 25_500_000 < count < 25_700_000, count
+
+    batch = _synthetic_batch(224)
+
+    def loss_fn(p):
+        _, loss = net.apply(p, batch, rng=jax.random.PRNGKey(1))
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    # gradient reaches the stem, every stage, both branch kinds
+    for lname in ["conv1", "res2a_branch1", "res3d_branch2a",
+                  "res4f_branch2c", "res5c_branch2b", "fc1000"]:
+        g = np.asarray(grads[lname][0])
+        assert np.abs(g).sum() > 0, lname
