@@ -464,7 +464,8 @@ class ParameterLayer(Layer):
         return 1
 
     def init_params(self, key):
-        return [jnp.zeros(self.shape)]
+        # explicit f32 (default dtype is f64 under x64)
+        return [jnp.zeros(self.shape, jnp.float32)]
 
     def apply(self, params, bottoms, ctx):
         return [params[0]], None

@@ -54,7 +54,8 @@ class PReLULayer(_Elementwise):
         pp = self.lp.prelu_param
         if pp.HasField("filler"):
             return [make_filler(pp.filler)(key, shape)]
-        return [jnp.full(shape, 0.25)]
+        # explicit f32 (default dtype is f64 under x64)
+        return [jnp.full(shape, 0.25, jnp.float32)]
 
     def apply(self, params, bottoms, ctx):
         x = bottoms[0]

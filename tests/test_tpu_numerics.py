@@ -293,3 +293,49 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc2" bottom: "target" }
     assert np.isfinite(s._materialize_smoothed_loss())
     np.testing.assert_array_equal(
         np.asarray(s._flat(s.params)["fc1/0"]), w0)
+
+
+def test_bf16_sweep_step_on_device():
+    """Mixed-precision sweep step on the real chip: bf16 forward/backward
+    (MXU-native) with f32 masters — finite per-config losses, masters
+    stay f32, fault lifetimes identical to the f32 engine's dtype."""
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+    name: "bf"
+    layer { name: "data" type: "Input" top: "data" top: "label"
+      input_param { shape { dim: 16 dim: 3 dim: 16 dim: 16 }
+                    shape { dim: 16 } } }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 8 kernel_size: 3
+        weight_filler { type: "xavier" } } }
+    layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+    layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+      inner_product_param { num_output: 10
+        weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1"
+      bottom: "label" top: "loss" }
+    """, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.momentum = 0.9
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 9
+    sp.snapshot_prefix = "/tmp/tpu_bf16"
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 300.0
+    sp.failure_pattern.std = 30.0
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randn(16, 3, 16, 16).astype(np.float32),
+             "label": rng.randint(0, 10, 16).astype(np.int32)}
+    solver = Solver(sp, train_feed=lambda: batch)
+    runner = SweepRunner(solver, n_configs=8, compute_dtype="bfloat16")
+    loss, _ = runner.step(5)
+    loss = np.asarray(loss)
+    assert loss.shape == (8,) and np.isfinite(loss).all(), loss
+    assert all(a.dtype == jnp.float32
+               for a in jax.tree.leaves(runner.params))
+    assert all(v.dtype == jnp.float32
+               for v in runner.fault_states["lifetimes"].values())
