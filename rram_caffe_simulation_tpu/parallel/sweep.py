@@ -216,9 +216,10 @@ class SweepRunner:
         return self._chunk_fns[key]
 
     def _place(self):
-        from .mesh import config_sharding, data_sharding
+        from .mesh import data_sharding
         has_config = "config" in self.mesh.axis_names
         has_data = "data" in self.mesh.axis_names
+        has_model = "model" in self.mesh.axis_names
         # The shared batch rides the orthogonal "data" axis: its batch dim
         # is split across data-axis devices and replicated across
         # config-axis devices, so a (config, data) mesh trains
@@ -227,12 +228,24 @@ class SweepRunner:
             (lambda ndim, lead=0: data_sharding(self.mesh, ndim=ndim,
                                                 lead=lead))
             if has_config and has_data else None)
-        if has_config:
-            shard0 = lambda x: jax.device_put(
-                x, config_sharding(self.mesh, ndim=x.ndim))
-            self.params = jax.tree.map(shard0, self.params)
-            self.history = jax.tree.map(shard0, self.history)
-            self.fault_states = jax.tree.map(shard0, self.fault_states)
+        if has_config or has_model:
+            # A "model" axis additionally shards the big FC weights
+            # Megatron-style WITHIN each config shard (parallel/tp.py):
+            # the per-config stacked param (config, N, K) gets
+            # P("config", "model", None) for a column-parallel layer, so
+            # a (config x model) mesh holds n_configs/c x 1/m of each
+            # matrix per chip — the layout for VGG/ResNet-scale sweeps.
+            from . import tp
+            layer_specs, key_specs = {}, {}
+            if has_model:
+                layer_specs = tp.tp_param_specs(self.solver.net,
+                                                self.mesh.shape["model"])
+                key_specs = tp.flat_specs(self.solver, layer_specs)
+            (self.params, self.history, self.fault_states, _) = (
+                tp.place_trees(self.mesh, layer_specs, key_specs,
+                               self.params, self.history,
+                               self.fault_states,
+                               lead_axis="config" if has_config else None))
         if self._dataset is not None:
             # rows sharded over "data" when present (HBM cost scales down
             # with the mesh instead of replicating the whole dataset);

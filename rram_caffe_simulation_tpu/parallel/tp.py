@@ -107,37 +107,52 @@ def flat_specs(solver, layer_specs: dict) -> dict:
     return out
 
 
-def place_state(solver, mesh: Mesh, layer_specs: dict):
-    """device_put params/history/fault_state with their TP shardings.
-    Returns (params, history, fault_state, out_shardings_tuple) where the
-    tuple mirrors the train step's (params', history', fault', loss, outs)
-    outputs (loss/outputs entries are the replicated prefix)."""
-    repl = NamedSharding(mesh, P())
-
+def place_trees(mesh: Mesh, layer_specs: dict, key_specs: dict,
+                params, history, fault_state, lead_axis=None):
+    """THE placement walk over the solver state-tree shapes — params
+    ({layer: [arr_or_None]}), history ({flat_key: {slot: arr}}), fault
+    state ({part: {flat_key: arr}}) — shared by Solver TP and the sweep.
+    `lead_axis` prepends a mesh axis to every spec (the sweep's stacked
+    "config" dim). Returns (placed_params, placed_history, placed_fault,
+    sharding trees of the same shapes)."""
     def nsh(spec):
-        return NamedSharding(mesh, spec)
+        lead = (lead_axis,) if lead_axis else ()
+        return NamedSharding(mesh, P(*lead, *tuple(spec)))
 
-    pspecs = {ln: [nsh(s) if s is not None else None for s in sl]
-              for ln, sl in layer_specs.items()}
+    pshard = {ln: [nsh(s if s is not None else P())
+                   if a is not None else None
+                   for s, a in zip(layer_specs.get(ln, [None] * len(arrs)),
+                                   arrs)]
+              for ln, arrs in params.items()}
     params = {ln: [jax.device_put(a, sh) if a is not None else None
-                   for a, sh in zip(arrs, pspecs[ln])]
-              for ln, arrs in solver.params.items()}
+                   for a, sh in zip(arrs, pshard[ln])]
+              for ln, arrs in params.items()}
 
-    fspecs = flat_specs(solver, layer_specs)
-    history = {k: {slot: jax.device_put(v, nsh(fspecs.get(k, P())))
+    hshard = {k: {slot: nsh(key_specs.get(k, P())) for slot in d}
+              for k, d in history.items()}
+    history = {k: {slot: jax.device_put(v, hshard[k][slot])
                    for slot, v in d.items()}
-               for k, d in solver.history.items()}
-    hshard = {k: {slot: nsh(fspecs.get(k, P())) for slot in d}
-              for k, d in solver.history.items()}
+               for k, d in history.items()}
 
-    fault_state = solver.fault_state
     fshard = None
     if fault_state is not None:
-        fault_state = {part: {k: jax.device_put(v, nsh(fspecs.get(k, P())))
+        fshard = {part: {k: nsh(key_specs.get(k, P())) for k in d}
+                  for part, d in fault_state.items()}
+        fault_state = {part: {k: jax.device_put(v, fshard[part][k])
                               for k, v in d.items()}
                        for part, d in fault_state.items()}
-        fshard = {part: {k: nsh(fspecs.get(k, P())) for k in d}
-                  for part, d in fault_state.items()}
+    return params, history, fault_state, (pshard, hshard, fshard)
 
-    out_shardings = (pspecs, hshard, fshard, repl, repl)
-    return params, history, fault_state, out_shardings
+
+def place_state(solver, mesh: Mesh, layer_specs: dict):
+    """device_put the solver's params/history/fault_state with their TP
+    shardings. Returns (params, history, fault_state,
+    out_shardings_tuple) where the tuple mirrors the train step's
+    (params', history', fault', loss, outs) outputs (loss/outputs
+    entries are the replicated prefix)."""
+    params, history, fault_state, (pshard, hshard, fshard) = place_trees(
+        mesh, layer_specs, flat_specs(solver, layer_specs),
+        solver.params, solver.history, solver.fault_state)
+    repl = NamedSharding(mesh, P())
+    return params, history, fault_state, (pshard, hshard, fshard,
+                                          repl, repl)

@@ -206,3 +206,24 @@ def test_model_parallel_requires_model_axis():
     s = Solver(mlp_solver(), train_feed=_feed())
     with pytest.raises(ValueError, match="model"):
         s.enable_model_parallel(make_mesh({"data": 8}))
+
+
+def test_sweep_composes_with_model_axis():
+    """(config x model) mesh: the Monte-Carlo sweep with TP-sharded FC
+    weights must train identically to the default config-only mesh."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+
+    def run(mesh):
+        feed = _feed()
+        s = Solver(mlp_solver(fault=True), train_feed=feed)
+        r = SweepRunner(s, n_configs=4, mesh=mesh)
+        r.step(5)
+        return r
+
+    ref = run(None)  # default config-only mesh
+    tp_run = run(make_mesh({"config": 2, "model": 4}))
+    w = tp_run.params["fc1"][0]
+    assert w.sharding.spec == P("config", "model", None), w.sharding
+    _tree_allclose(ref.params, tp_run.params, rtol=1e-5, atol=1e-6)
+    _tree_allclose(ref.fault_states, tp_run.fault_states,
+                   rtol=1e-5, atol=1e-6)
