@@ -59,6 +59,10 @@ class LayerContext:
     # sigma); the layer computes its matmul through the fused
     # fault/hw_aware.crossbar_matmul kernel (noise drawn in VMEM).
     crossbar: Optional[dict] = None
+    # Mixed precision (Solver compute_dtype, static): layers that CREATE
+    # float data inside the graph (DummyData fillers) emit it in this
+    # dtype so generated blobs match the cast parameters.
+    compute_dtype: Optional[Any] = None
 
 
 @dataclasses.dataclass

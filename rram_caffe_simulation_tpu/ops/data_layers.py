@@ -169,6 +169,13 @@ class DummyDataLayer(Layer):
                     ctx.rng,
                     (zlib.crc32(self.name.encode()) + i) & 0x7FFFFFFF)
             tops.append(fill(key, shape))
+        if ctx.compute_dtype is not None:
+            # generated float data must match the cast params (mixed
+            # precision): external batches are cast by the solver, but
+            # in-graph fillers draw f32 by default
+            tops = [t.astype(ctx.compute_dtype)
+                    if jnp.issubdtype(t.dtype, jnp.floating) else t
+                    for t in tops]
         return tops, None
 
 

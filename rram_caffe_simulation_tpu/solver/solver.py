@@ -362,7 +362,8 @@ class Solver:
                     run_batch = _to_run(batch)
                 blobs, loss, newp = net.apply(
                     p, run_batch, rng=rng, iteration=it, with_updates=True,
-                    adc_bits=adc_bits, crossbar=crossbar)
+                    adc_bits=adc_bits, crossbar=crossbar,
+                    compute_dtype=cdtype)
                 if hw_sigma:
                     # Conductance noise is a READ effect only: net.apply
                     # copies the (perturbed) input tree into new_params, so

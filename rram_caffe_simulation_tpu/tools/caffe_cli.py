@@ -63,7 +63,15 @@ def train(args):
     if args.snapshot and args.weights:
         sys.exit("Give a snapshot to resume OR weights to finetune, "
                  "not both")
-    solver = Solver(args.solver)
+    if args.compute_dtype:
+        import jax.numpy as jnp
+        try:
+            jnp.dtype(args.compute_dtype)
+        except TypeError:
+            sys.exit(f"unknown --compute-dtype {args.compute_dtype!r} "
+                     "(e.g. bfloat16)")
+    solver = Solver(args.solver,
+                    compute_dtype=args.compute_dtype or None)
     if args.weights:
         for w in args.weights.split(","):
             solver.params = solver.net.copy_trained_from(solver.params, w)
@@ -436,6 +444,10 @@ def main(argv=None):
                         "whole-net numbers (slower compile)")
     p.add_argument("--level", type=int, default=0)
     p.add_argument("--stage", default="")
+    p.add_argument("--compute-dtype", default="",
+                   help="train: forward/backward dtype (e.g. bfloat16 "
+                        "for MXU-native mixed precision; masters/"
+                        "updates/fault state stay f32)")
     p.add_argument("--sigint_effect", default="stop",
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",

@@ -118,3 +118,35 @@ def test_bf16_single_solver_step():
     assert np.isfinite(s.smoothed_loss)
     assert all(a.dtype != jnp.bfloat16
                for a in jax.tree.leaves(s.params))
+
+
+def test_bf16_with_in_graph_dummy_data():
+    """Regression: DummyData creates float blobs INSIDE the graph; under
+    compute_dtype they must match the cast params (was: f32 filler output
+    vs bf16 conv weights -> dtype error)."""
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+    name: "dd"
+    layer { name: "data" type: "DummyData" top: "data" top: "label"
+      dummy_data_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 }
+        shape { dim: 8 }
+        data_filler { type: "gaussian" std: 1.0 }
+        data_filler { type: "constant" value: 1 } } }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 4 kernel_size: 3
+        weight_filler { type: "xavier" } } }
+    layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+      inner_product_param { num_output: 5
+        weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1"
+      bottom: "label" top: "loss" }
+    """, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10
+    sp.display = 0
+    sp.random_seed = 3
+    sp.snapshot_prefix = "/tmp/mp_dd"
+    s = Solver(sp, compute_dtype="bfloat16")
+    s.step(3)
+    assert np.isfinite(s.smoothed_loss)
