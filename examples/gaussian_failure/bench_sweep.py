@@ -56,7 +56,12 @@ def main(argv=None):
         param.random_seed = 7
         param.display = 0
         solver = Solver(param)
-        runner = SweepRunner(solver, n_configs=n_cfg)
+        runner = SweepRunner(
+            solver, n_configs=n_cfg,
+            # same default as bench.py so the two benches measure the
+            # same arithmetic under an identical environment
+            compute_dtype=os.environ.get("BENCH_DTYPE", "bfloat16")
+            or None)
         runner.step(max(args.warmup, args.chunk), chunk=args.chunk)
         jax.block_until_ready(runner.params)
         t0 = time.perf_counter()

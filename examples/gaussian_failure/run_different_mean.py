@@ -19,6 +19,8 @@ def main(argv=None):
     p.add_argument("--std", type=float, default=3e7)
     p.add_argument("--max-iter", type=int, default=0)
     p.add_argument("--tag", default="")
+    p.add_argument("--compute-dtype", default="",
+                   help="e.g. bfloat16 (~1.6x; f32 fault dynamics)")
     args = p.parse_args(argv)
 
     from run_gaussian_exp import main as run
@@ -27,6 +29,8 @@ def main(argv=None):
                 "--sweep-means", ",".join(str(m) for m in args.means)]
     if args.max_iter:
         run_args += ["--max-iter", str(args.max_iter)]
+    if args.compute_dtype:
+        run_args += ["--compute-dtype", args.compute_dtype]
     return run(run_args)
 
 
