@@ -63,13 +63,6 @@ def train(args):
     if args.snapshot and args.weights:
         sys.exit("Give a snapshot to resume OR weights to finetune, "
                  "not both")
-    if args.compute_dtype:
-        import jax.numpy as jnp
-        try:
-            jnp.dtype(args.compute_dtype)
-        except TypeError:
-            sys.exit(f"unknown --compute-dtype {args.compute_dtype!r} "
-                     "(e.g. bfloat16)")
     solver = Solver(args.solver,
                     compute_dtype=args.compute_dtype or None)
     if args.weights:
@@ -152,7 +145,14 @@ def time(args):
               pb.TRAIN if args.phase == "TRAIN" else pb.TEST)
     params = net.init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
-    batch = {name: jnp.asarray(rng.randn(*shape), jnp.float32)
+    dtype = jnp.dtype(args.compute_dtype) if args.compute_dtype \
+        else jnp.float32
+    if args.compute_dtype:
+        # profile the arithmetic the training mode actually runs
+        params = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    batch = {name: jnp.asarray(rng.randn(*shape), dtype)
              for name, shape in net.data_source_tops.items()}
 
     # time the OUTPUT blobs, not just the loss scalar — otherwise XLA
@@ -161,8 +161,10 @@ def time(args):
     # reference's default `caffe time` phase).
     time_key = jax.random.PRNGKey(0)
 
+    cdt = dtype if args.compute_dtype else None
+
     def outputs_of(p, b):
-        blobs, loss = net.apply(p, b, rng=time_key)
+        blobs, loss = net.apply(p, b, rng=time_key, compute_dtype=cdt)
         return {n: blobs[n] for n in net.output_names}, loss
 
     iters = args.iterations
@@ -186,8 +188,10 @@ def time(args):
         # 1e-30 scale so XLA cannot hoist the invariant body.
         def timed(scalar_fn, n):
             def body(_, carry):
-                bumped = {k: v + carry * 1e-30 for k, v in batch.items()}
-                return scalar_fn(params, bumped)
+                bumped = {k: v + (carry * 1e-30).astype(v.dtype)
+                          for k, v in batch.items()}
+                # carry stays f32 whatever dtype the net computes in
+                return scalar_fn(params, bumped).astype(jnp.float32)
 
             run = jax.jit(lambda z: jax.lax.fori_loop(
                 0, n, body, jnp.float32(0.0)))
@@ -226,7 +230,8 @@ def time(args):
             continue
         bottoms = [blobs[b] for b in layer.lp.bottom]
         lparams = net._gather_layer_params(params, layer)
-        ctx = LayerContext(phase=net.phase, rng=jax.random.PRNGKey(0))
+        ctx = LayerContext(phase=net.phase, rng=jax.random.PRNGKey(0),
+                           compute_dtype=cdt)
         run = jax.jit(lambda lp, bt: layer.apply(lp, bt, ctx)[0])
         tops = run(lparams, bottoms)
         jax.block_until_ready(tops)
@@ -445,14 +450,21 @@ def main(argv=None):
     p.add_argument("--level", type=int, default=0)
     p.add_argument("--stage", default="")
     p.add_argument("--compute-dtype", default="",
-                   help="train: forward/backward dtype (e.g. bfloat16 "
-                        "for MXU-native mixed precision; masters/"
-                        "updates/fault state stay f32)")
+                   help="train/time: forward/backward dtype (e.g. "
+                        "bfloat16 for MXU-native mixed precision; train "
+                        "keeps masters/updates/fault state f32)")
     p.add_argument("--sigint_effect", default="stop",
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
     args = p.parse_args(argv)
+    if getattr(args, "compute_dtype", ""):
+        import jax.numpy as jnp
+        try:
+            jnp.dtype(args.compute_dtype)
+        except TypeError:
+            p.error(f"unknown --compute-dtype {args.compute_dtype!r} "
+                    "(e.g. bfloat16)")
     takes_positional = (args.command.startswith("upgrade_")
                         or args.command == "extract_features"
                         or args.command in ("train_net", "finetune_net",

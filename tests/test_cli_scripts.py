@@ -260,7 +260,9 @@ def test_train_compute_dtype_flag(tmp_path):
                               pb.NetParameter())
     assert any(len(lp.blobs) for lp in m.layer)
 
-    # invalid dtype: clean CLI error, not a mid-solve traceback
-    with pytest.raises(SystemExit, match="compute-dtype"):
+    # invalid dtype: clean usage error at parse time (argparse p.error
+    # exits 2 with the message on stderr), not a mid-solve traceback
+    with pytest.raises(SystemExit) as exc:
         caffe_cli.main(["train", "--solver", solver_path,
                         "--compute-dtype", "bfloat17"])
+    assert exc.value.code == 2

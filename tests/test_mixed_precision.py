@@ -150,3 +150,33 @@ def test_bf16_with_in_graph_dummy_data():
     s = Solver(sp, compute_dtype="bfloat16")
     s.step(3)
     assert np.isfinite(s.smoothed_loss)
+
+
+def test_bf16_with_data_parallel():
+    """compute_dtype flows through enable_data_parallel (dp.make_dp_step
+    forwards solver.compute_dtype): 8-replica bf16 DP trains, masters
+    stay full precision, and the result tracks the f32 DP run."""
+    from rram_caffe_simulation_tpu.parallel import make_mesh
+
+    def feed():
+        state = {"i": 0}
+
+        def f():
+            rng = np.random.RandomState(500 + state["i"])
+            state["i"] += 1
+            return {"data": rng.randn(8, 3, 8, 8).astype(np.float32),
+                    "label": rng.randint(0, 10, 8).astype(np.int32)}
+        return f
+
+    mass = {}
+    for dt in (None, "bfloat16"):
+        s = Solver(make_sp(0.05), train_feed=feed(), compute_dtype=dt)
+        s.enable_data_parallel(make_mesh({"data": 8}))
+        s.step(5)
+        assert np.isfinite(s.smoothed_loss)
+        assert all(a.dtype != jnp.bfloat16
+                   for a in jax.tree.leaves(s.params))
+        mass[dt] = sum(float(jnp.sum(jnp.abs(a)))
+                       for a in jax.tree.leaves(s.params))
+    rel = abs(mass[None] - mass["bfloat16"]) / abs(mass[None])
+    assert rel < 0.05, rel
