@@ -196,6 +196,12 @@ def make_server(clf: DemoClassifier, port: int = 5000,
             if url.path == "/classify_url":
                 q = urllib.parse.parse_qs(url.query)
                 target = (q.get("imageurl") or [""])[0]
+                # http(s) only: file:// etc. would let a remote caller
+                # probe local files through the demo (SSRF).
+                if urllib.parse.urlparse(target).scheme not in ("http",
+                                                                "https"):
+                    return self._page(
+                        banner="<p><b>Cannot open that URL.</b></p>")
                 try:
                     with urllib.request.urlopen(target, timeout=10) as r:
                         data = r.read()

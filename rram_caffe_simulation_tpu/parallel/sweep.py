@@ -71,6 +71,18 @@ class SweepRunner:
             n_dev = min(n_configs, len(jax.devices()))
             mesh = make_mesh({"config": n_dev},
                              devices=jax.devices()[:n_dev])
+        if ("model" in mesh.axis_names
+                and "config" not in mesh.axis_names):
+            # TP PartitionSpecs are written against the config-stacked
+            # shapes (lead "config" dim first); with no config axis they
+            # would land on dim 0 and shard n_configs instead of the
+            # weight dims — wrong layout, and device_put fails whenever
+            # n_configs % model_size != 0.
+            raise ValueError(
+                "a SweepRunner mesh with a 'model' axis must also have a "
+                "'config' axis (use make_mesh({'config': c, 'model': m})); "
+                "for pure tensor parallelism without the Monte-Carlo axis "
+                "use Solver.enable_model_parallel instead")
         self.mesh = mesh
         self.iter = 0
 

@@ -461,10 +461,14 @@ def main(argv=None):
     if getattr(args, "compute_dtype", ""):
         import jax.numpy as jnp
         try:
-            jnp.dtype(args.compute_dtype)
+            dt = jnp.dtype(args.compute_dtype)
         except TypeError:
             p.error(f"unknown --compute-dtype {args.compute_dtype!r} "
                     "(e.g. bfloat16)")
+        if not jnp.issubdtype(dt, jnp.floating):
+            p.error(f"--compute-dtype {args.compute_dtype!r} is not a "
+                    "floating dtype (params/batches would be cast to "
+                    "it; e.g. bfloat16, float32)")
     takes_positional = (args.command.startswith("upgrade_")
                         or args.command == "extract_features"
                         or args.command in ("train_net", "finetune_net",

@@ -266,3 +266,11 @@ def test_train_compute_dtype_flag(tmp_path):
         caffe_cli.main(["train", "--solver", solver_path,
                         "--compute-dtype", "bfloat17"])
     assert exc.value.code == 2
+
+    # parseable but non-float dtypes are rejected too: casting float
+    # params/batches to int8 would silently produce garbage
+    for bad in ("int8", "bool"):
+        with pytest.raises(SystemExit) as exc:
+            caffe_cli.main(["train", "--solver", solver_path,
+                            "--compute-dtype", bad])
+        assert exc.value.code == 2

@@ -186,6 +186,17 @@ def test_sweep_evaluate(tmp_path):
     assert out["loss"].shape == (4,)
 
 
+def test_sweep_model_axis_requires_config_axis(tmp_path):
+    """A 'model' axis without a 'config' axis would misalign the TP
+    PartitionSpecs against the config-stacked shapes (sharding the
+    n_configs dim) — rejected up front (ADVICE r2)."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    with pytest.raises(ValueError, match="config"):
+        SweepRunner(s, n_configs=4,
+                    mesh=make_mesh({"model": 2},
+                                   devices=jax.devices()[:2]))
+
+
 def test_sweep_batch_data_sharding(tmp_path):
     """On a (config, data) mesh the shared batch is split over the data
     axis inside SweepRunner.step — and sharding must not change numerics

@@ -1,11 +1,12 @@
 """examples/web_demo parity: the stdlib http.server rebuild of the
 reference's Flask demo (examples/web_demo/app.py), driven over a real
-socket — form page, multipart upload, file:// URL classification, and
-the error banners."""
+socket — form page, multipart upload, URL-scheme rejection (SSRF
+guard), and the error banners."""
 import io
 import os
 import sys
 import threading
+import urllib.parse
 import urllib.request
 import uuid
 
@@ -59,7 +60,7 @@ def demo_server(tmp_path_factory):
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
-    yield base, tmp
+    yield base, tmp, clf
     srv.shutdown()
 
 
@@ -78,14 +79,14 @@ def _get(url):
 
 
 def test_index_serves_forms(demo_server):
-    base, _ = demo_server
+    base, _, _ = demo_server
     status, body = _get(base + "/")
     assert status == 200
     assert "classify_url" in body and "classify_upload" in body
 
 
 def test_upload_classifies(demo_server):
-    base, _ = demo_server
+    base, _, _ = demo_server
     boundary = uuid.uuid4().hex
     payload = (
         f"--{boundary}\r\n"
@@ -104,19 +105,70 @@ def test_upload_classifies(demo_server):
     assert "data:image/png;base64," in body  # image echoed back
 
 
-def test_classify_file_url(demo_server):
-    base, tmp = demo_server
+def test_classify_decoded_bytes(demo_server):
+    """The classify path itself, bytes -> decode_image -> classify
+    (what /classify_url does after its fetch)."""
+    _, _, clf = demo_server
+    image, b64 = web_app.decode_image(_png_bytes(seed=3))
+    ok, payload, dt = clf.classify(image)
+    assert ok
+    assert any(l in str(payload) for l in ("aardvark", "bobcat", "crane"))
+    assert b64
+
+
+def test_classify_http_url(demo_server):
+    """The full /classify_url path over http: fetch -> decode ->
+    classify, plus the urlopen-failure banner on a dead port."""
+    import http.server
+    base, _, _ = demo_server
+    png = _png_bytes(seed=5)
+
+    class ImgHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.end_headers()
+            self.wfile.write(png)
+
+        def log_message(self, *a):
+            pass
+
+    imgsrv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ImgHandler)
+    t = threading.Thread(target=imgsrv.serve_forever, daemon=True)
+    t.start()
+    try:
+        img_url = f"http://127.0.0.1:{imgsrv.server_address[1]}/img.png"
+        status, body = _get(base + "/classify_url?imageurl="
+                            + urllib.parse.quote(img_url, safe=""))
+        assert status == 200
+        assert "Top predictions" in body
+    finally:
+        imgsrv.shutdown()
+        imgsrv.server_close()
+    # http scheme passes the guard, but the fetch fails -> error banner
+    dead = f"http://127.0.0.1:{imgsrv.server_address[1]}/img.png"
+    status, body = _get(base + "/classify_url?imageurl="
+                        + urllib.parse.quote(dead, safe=""))
+    assert status == 200
+    assert "Cannot open that URL" in body
+
+
+def test_file_url_rejected(demo_server):
+    """file:// (and any non-http scheme) must not reach urlopen — SSRF
+    guard; the handler answers with the error banner instead."""
+    base, tmp, _ = demo_server
     img = tmp / "input.png"
     img.write_bytes(_png_bytes(seed=3))
     status, body = _get(base + "/classify_url?imageurl=file://" + str(img))
     assert status == 200
-    assert "Top predictions" in body
+    assert "Cannot open that URL" in body
+    assert "Top predictions" not in body
 
 
 def test_bad_url_banner(demo_server):
-    base, _ = demo_server
+    base, _, _ = demo_server
     status, body = _get(
-        base + "/classify_url?imageurl=file:///nonexistent.png")
+        base + "/classify_url?imageurl=notascheme://nowhere/x.png")
     assert status == 200
     assert "Cannot open that URL" in body
 
@@ -137,7 +189,7 @@ def test_parse_multipart_preserves_trailing_bytes():
 
 
 def test_disallowed_extension_banner(demo_server):
-    base, _ = demo_server
+    base, _, _ = demo_server
     boundary = uuid.uuid4().hex
     payload = (
         f"--{boundary}\r\n"
@@ -154,7 +206,7 @@ def test_disallowed_extension_banner(demo_server):
 
 
 def test_bad_upload_banner(demo_server):
-    base, _ = demo_server
+    base, _, _ = demo_server
     req = urllib.request.Request(
         base + "/classify_upload", data=b"not multipart", method="POST",
         headers={"Content-Type": "text/plain"})
