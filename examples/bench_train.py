@@ -1,0 +1,163 @@
+"""ImageNet-class TRAINING throughput on TPU — the reference's headline
+perf metric (CaffeNet train at 193-267 img/s on a K40,
+/root/reference/docs/performance_hardware.md:17-25).
+
+Trains the real zoo train_val graphs through the Solver path: the TRAIN
+Data layer is swapped for an in-graph DummyData feed of the same shape
+(so the whole fwd+bwd+update loop runs chip-resident under
+Solver.step_fused with zero input-pipeline confound), and throughput is
+steady-state img/s over a timed window after a compile/warmup chunk.
+Also reports achieved model FLOP/s — 3 x analytic forward FLOPs per
+step (fwd + two bwd matmul passes) — and MFU against the chip's peak.
+
+    python examples/bench_train.py \
+        --model models/bvlc_reference_caffenet/train_val.prototxt \
+        --batch 256 --iters 40 --chunk 10 --compute-dtype bfloat16
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..")
+sys.path.insert(0, REPO)
+
+
+def _num_classes(net_param):
+    """num_output of the layer feeding the softmax loss (uniform label
+    range; constant labels collapse the loss to 0 in one step)."""
+    producers = {}
+    for lp in net_param.layer:
+        for t in lp.top:
+            producers[t] = lp
+    for lp in net_param.layer:
+        if lp.type == "SoftmaxWithLoss" and lp.bottom:
+            prod = producers.get(lp.bottom[0])
+            if prod is not None and prod.type == "InnerProduct":
+                return int(prod.inner_product_param.num_output)
+    return 1000
+
+
+def dummyize(net_param, batch):
+    """Replace TRAIN-phase Data layers with shape-equivalent DummyData
+    (gaussian images, uniform labels) so the step is chip-resident."""
+    from rram_caffe_simulation_tpu.proto import pb
+    n_classes = _num_classes(net_param)
+    for lp in net_param.layer:
+        if lp.type != "Data":
+            continue
+        phases = [inc.phase for inc in lp.include] or [pb.TRAIN]
+        if pb.TRAIN not in phases:
+            continue
+        crop = lp.transform_param.crop_size or 224
+        lp.type = "DummyData"
+        dp = lp.dummy_data_param
+        del dp.shape[:]
+        s = dp.shape.add()
+        s.dim.extend([batch, 3, crop, crop])
+        if len(lp.top) > 1:
+            s = dp.shape.add()
+            s.dim.extend([batch])
+        f = dp.data_filler.add()
+        f.type = "gaussian"
+        f.std = 1.0
+        if len(lp.top) > 1:
+            f = dp.data_filler.add()
+            f.type = "uniform"
+            f.min = 0.0
+            f.max = n_classes - 0.001  # astype(int32) truncates
+        lp.ClearField("data_param")
+        lp.ClearField("transform_param")
+    return net_param
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", required=True,
+                   help="train_val prototxt (TRAIN Data layer is swapped "
+                        "for DummyData)")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--iters", type=int, default=40,
+                   help="timed iterations (after one warmup chunk)")
+    p.add_argument("--chunk", type=int, default=10,
+                   help="iterations scanned per device dispatch")
+    p.add_argument("--compute-dtype", default="",
+                   help="e.g. bfloat16; empty = float32")
+    p.add_argument("--peak-tflops", type=float, default=197.0,
+                   help="chip peak for the MFU column (v5e bf16 = 197)")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable JSON line")
+    args = p.parse_args(argv)
+    args.iters = max(args.iters // args.chunk, 1) * args.chunk
+
+    os.chdir(REPO)
+    import jax
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.utils.io import read_net_param
+    from rram_caffe_simulation_tpu.tools.summarize import net_fwd_flops
+
+    netp = dummyize(read_net_param(args.model), args.batch)
+    sp = pb.SolverParameter()
+    sp.net_param.CopyFrom(netp)
+    sp.base_lr = 0.001  # throughput run; random labels diverge at 0.01
+    sp.momentum = 0.9
+    sp.weight_decay = 0.0005
+    sp.lr_policy = "fixed"
+    sp.type = "SGD"
+    sp.max_iter = 10 ** 9
+    sp.display = 0
+    sp.random_seed = 7
+    solver = Solver(sp, compute_dtype=args.compute_dtype or None)
+
+    fwd_flops, _ = net_fwd_flops(solver.net)  # at the built batch size
+    t0 = time.perf_counter()
+    solver.step_fused(args.chunk, chunk=args.chunk)  # compile + warmup
+    jax.block_until_ready(jax.tree.leaves(solver.params))
+    setup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    solver.step_fused(args.iters, chunk=args.chunk)
+    jax.block_until_ready(jax.tree.leaves(solver.params))
+    dt = time.perf_counter() - t0
+
+    img_s = args.batch * args.iters / dt
+    step_ms = dt / args.iters * 1e3
+    train_tflops = 3 * fwd_flops * args.iters / dt / 1e12
+    mfu = train_tflops / args.peak_tflops
+    loss = solver.smoothed_loss
+    rec = {
+        "model": os.path.basename(os.path.dirname(args.model)) or
+                 args.model,
+        "batch": args.batch,
+        "compute_dtype": args.compute_dtype or "float32",
+        "img_per_s": round(img_s, 1),
+        "step_ms": round(step_ms, 3),
+        "fwd_gflops_per_batch": round(fwd_flops / 1e9, 2),
+        "achieved_tflops": round(train_tflops, 2),
+        "mfu_vs_peak": round(mfu, 4),
+        "peak_tflops": args.peak_tflops,
+        "iters": args.iters,
+        "chunk": args.chunk,
+        "compile_warmup_s": round(setup_s, 1),
+        "final_loss": round(float(loss), 4),
+        "backend": jax.default_backend(),
+    }
+    if args.json:
+        print(json.dumps(rec))
+    else:
+        print(f"{rec['model']}  batch {args.batch}  "
+              f"{rec['compute_dtype']}")
+        print(f"  {img_s:,.1f} img/s   {step_ms:.2f} ms/step   "
+              f"{train_tflops:.1f} TFLOP/s achieved   "
+              f"MFU {100 * mfu:.1f}% of {args.peak_tflops:.0f} TF peak")
+        print(f"  (fwd {fwd_flops / 1e9:.1f} GFLOPs/batch, train = 3x; "
+              f"compile+warmup {setup_s:.1f}s, final loss "
+              f"{float(loss):.3f}, backend {rec['backend']})")
+    return rec
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,13 @@ class LayerContext:
     # float data inside the graph (DummyData fillers) emit it in this
     # dtype so generated blobs match the cast parameters.
     compute_dtype: Optional[Any] = None
+    # Sequence parallelism (Solver.enable_sequence_parallel, static):
+    # when a mesh is present, Attention layers run their core through
+    # ring/ulysses attention sharded over seq_axis (parallel/sequence.py)
+    # instead of the single-device path.
+    seq_mesh: Optional[Any] = None
+    seq_axis: str = "seq"
+    seq_impl: str = "ring"
 
 
 @dataclasses.dataclass

@@ -1,29 +1,57 @@
 """Image-file ingestion for ImageDataLayer (reference:
-src/caffe/layers/image_data_layer.cpp, util/io.cpp ReadImageToDatum).
-"""
+src/caffe/layers/image_data_layer.cpp, util/io.cpp ReadImageToDatum —
+the reference decodes through OpenCV; here PNG/BMP/PPM decode through
+the in-repo pure-Python codecs (`data/imagecodec.py`) so ImageData has
+no imaging dependency, and JPEG/other formats fall back to PIL when
+it is installed."""
 from __future__ import annotations
 
 import numpy as np
+
+from . import imagecodec
+
+# ITU-R BT.601 luma, what OpenCV's cvtColor BGR2GRAY (and PIL 'L') use
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def _decode_any(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        return imagecodec.decode(data)
+    except ValueError:
+        pass
+    try:
+        from PIL import Image
+    except ImportError:
+        raise ValueError(
+            f"{path}: not a PNG/BMP/PPM (decoded natively) and PIL is "
+            "not installed for other formats (JPEG)") from None
+    import io
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if img.mode not in ("L", "RGB", "RGBA")
+                      else img.mode)
+    arr = np.asarray(img, dtype=np.uint8)
+    return arr[:, :, None] if arr.ndim == 2 else arr
 
 
 def load_image(path: str, color: bool = True, new_height: int = 0,
                new_width: int = 0) -> np.ndarray:
     """Load an image file to a (C,H,W) uint8 array (BGR channel order to
     match Caffe/OpenCV conventions)."""
-    try:
-        from PIL import Image
-    except ImportError:
-        raise NotImplementedError(
-            "ImageData requires PIL, which this environment lacks") from None
-    img = Image.open(path)
-    img = img.convert("RGB" if color else "L")
+    arr = _decode_any(path)                   # (H,W,C) RGB/gray
+    if arr.shape[2] == 4:
+        arr = arr[:, :, :3]                   # drop alpha (cv::imread)
+    if color and arr.shape[2] == 1:
+        arr = np.repeat(arr, 3, axis=2)
+    elif not color and arr.shape[2] == 3:
+        arr = np.rint(arr.astype(np.float32) @ _LUMA) \
+            .astype(np.uint8)[:, :, None]
     if new_height > 0 and new_width > 0:
-        img = img.resize((new_width, new_height), Image.BILINEAR)
-    arr = np.asarray(img, dtype=np.uint8)
+        arr = imagecodec.resize_bilinear(arr, new_height, new_width)
     if color:
-        arr = arr[:, :, ::-1]  # RGB -> BGR like OpenCV
-        return arr.transpose(2, 0, 1)
-    return arr[None]
+        return arr[:, :, ::-1].transpose(2, 0, 1)   # RGB -> BGR, CHW
+    return arr.transpose(2, 0, 1)
 
 
 def infer_image_shape(image_data_param) -> tuple[int, int, int]:

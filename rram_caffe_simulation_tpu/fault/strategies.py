@@ -135,6 +135,9 @@ class GeneticStrategy:
     def __post_init__(self):
         self.times = 0
         self._rng = np.random.RandomState(self.seed)
+        # the loader may hand over views of jax buffers (read-only);
+        # kept swaps mutate the masks in place
+        self.prune_weights = [np.array(w) for w in self.prune_weights]
         if len(self.fc_pairs) < 2:
             # reference strategy.cpp:174 computes rand() % (size-1): with a
             # single FC fault target there is no neuron pair to swap.

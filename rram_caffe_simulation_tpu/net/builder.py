@@ -244,7 +244,7 @@ class Net:
               iteration=None, with_updates: bool = False,
               start: Optional[str] = None, end: Optional[str] = None,
               adc_bits: int = 0, crossbar: Optional[dict] = None,
-              compute_dtype=None):
+              compute_dtype=None, seq_mesh=None, seq_impl: str = "ring"):
         """Run the net (or the [start, end] layer range). `batch` feeds
         data-source tops — plus, for partial runs, any bottom consumed but
         not produced inside the range. Returns (blobs, loss) or
@@ -257,7 +257,8 @@ class Net:
         batch = batch or {}
         ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration,
                            adc_bits=adc_bits, crossbar=crossbar,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype,
+                           seq_mesh=seq_mesh, seq_impl=seq_impl)
         run_layers = self.layer_range(start, end)
         produced_in_range = {t for l in run_layers for t in l.lp.top}
         blobs: dict[str, Any] = {}

@@ -66,7 +66,18 @@ class AttentionLayer(Layer):
         def split_heads(t):  # (N, S, E) -> (N, H, S, E/H)
             return t.reshape(n, s, h, e // h).transpose(0, 2, 1, 3)
 
-        o = attention(split_heads(q), split_heads(k), split_heads(v),
-                      causal=self.causal)
+        if ctx.seq_mesh is not None:
+            # sequence parallelism (Solver.enable_sequence_parallel):
+            # the S axis shards over the mesh and K/V ride the ring (or
+            # two all_to_alls for ulysses) — parallel/sequence.py
+            from ..parallel.sequence import (ring_attention_sharded,
+                                             ulysses_attention_sharded)
+            fn = (ring_attention_sharded if ctx.seq_impl == "ring"
+                  else ulysses_attention_sharded)
+            o = fn(split_heads(q), split_heads(k), split_heads(v),
+                   ctx.seq_mesh, axis=ctx.seq_axis, causal=self.causal)
+        else:
+            o = attention(split_heads(q), split_heads(k), split_heads(v),
+                          causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(n, s, e)
         return [jnp.einsum("nse,fe->nsf", o, w_out) + b_out], None

@@ -31,7 +31,388 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+class Stage:
+    """One contiguous layer group of a partitioned net."""
+
+    def __init__(self, first, last, layer_names, in_blob, out_blob):
+        self.first = first          # first layer name (apply start=)
+        self.last = last            # last layer name (apply end=)
+        self.layer_names = layer_names
+        self.in_blob = in_blob      # blob crossing the left cut (None: head)
+        self.out_blob = out_blob    # blob crossing the right cut (None: tail)
+
+    def __repr__(self):
+        return (f"Stage({self.first}..{self.last}, in={self.in_blob}, "
+                f"out={self.out_blob})")
+
+
+def partition_net(net, n_stages: int):
+    """Split `net.layers` into `n_stages` contiguous groups, balanced by
+    analytic per-layer FLOPs, cutting only where exactly ONE non-data
+    blob crosses the boundary (the rotating activation is one array).
+
+    The reference has nothing to compare (no PP); the granularity
+    contract matches GPipe's sequential-partition assumption. Returns a
+    list of Stage.
+    """
+    from ..tools.summarize import net_fwd_flops
+    layers = net.layers
+    n = len(layers)
+    if n_stages < 2:
+        raise ValueError("need n_stages >= 2")
+    data_tops = set(net.data_source_tops)
+    last_prod = {}
+    last_cons = {}
+    for i, l in enumerate(layers):
+        for b in l.lp.bottom:
+            last_cons[b] = i
+        for t in l.lp.top:
+            last_prod.setdefault(t, []).append(i)
+
+    def crossing(cut):          # blobs live across the boundary after layer `cut`
+        out = set()
+        for b, prods in last_prod.items():
+            if b in data_tops:
+                continue
+            if (any(p <= cut for p in prods)
+                    and last_cons.get(b, -1) > cut):
+                out.add(b)
+        return out
+
+    valid = {i: crossing(i) for i in range(n - 1)}
+    valid = {i: c for i, c in valid.items() if len(c) == 1}
+    if len(valid) < n_stages - 1:
+        raise ValueError(
+            f"net has only {len(valid)} single-blob cut points; cannot "
+            f"make {n_stages} stages")
+    _, per = net_fwd_flops(net)
+    cost = np.cumsum([per.get(l.name, 0) + 1.0 for l in layers])
+    total = cost[-1]
+    cuts = []
+    lo = -1
+    for j in range(1, n_stages):
+        target = total * j / n_stages
+        cands = [i for i in valid if i > lo and i < n - 1
+                 # leave room for the remaining cuts
+                 and sum(1 for v in valid if v > i) >= n_stages - 1 - j]
+        if not cands:
+            raise ValueError("could not place balanced cuts")
+        best = min(cands, key=lambda i: abs(cost[i] - target))
+        cuts.append(best)
+        lo = best
+    stages = []
+    bounds = [-1] + cuts + [n - 1]
+    for s in range(n_stages):
+        i0, i1 = bounds[s] + 1, bounds[s + 1]
+        stages.append(Stage(
+            first=layers[i0].name, last=layers[i1].name,
+            layer_names=[l.name for l in layers[i0:i1 + 1]],
+            in_blob=(next(iter(valid[bounds[s]])) if s > 0 else None),
+            out_blob=(next(iter(valid[bounds[s + 1]]))
+                      if s < n_stages - 1 else None)))
+    return stages
+
+
+def _rebatch_net(net, n_micro: int):
+    """Rebuild a Net at batch/n_micro (Input shapes and data-layer
+    batch_size divided; mirrors Solver._scale_replica_batch, inverse)."""
+    from ..net import Net as CoreNet
+    from ..proto import pb
+    proto = pb.NetParameter.FromString(
+        net.param_proto.SerializeToString())
+    for lp in proto.layer:
+        if lp.type == "Input":
+            for shp in lp.input_param.shape:
+                if shp.dim:
+                    if shp.dim[0] % n_micro:
+                        raise ValueError(
+                            f"Input batch {shp.dim[0]} not divisible by "
+                            f"n_micro {n_micro}")
+                    shp.dim[0] //= n_micro
+        for field in ("data_param", "memory_data_param",
+                      "image_data_param", "window_data_param",
+                      "hdf5_data_param"):
+            if lp.HasField(field):
+                fp = getattr(lp, field)
+                if fp.batch_size % n_micro:
+                    raise ValueError(
+                        f"batch {fp.batch_size} not divisible by "
+                        f"n_micro {n_micro}")
+                fp.batch_size //= n_micro
+        if lp.type == "DummyData":
+            for shp in lp.dummy_data_param.shape:
+                if shp.dim:
+                    shp.dim[0] //= n_micro
+    return CoreNet(proto, net.phase)
+
+
+class NetPipeline:
+    """Heterogeneous (non-homomorphic) pipeline over a partitioned Caffe
+    graph: per-stage activation AND param shapes may differ.
+
+    Mechanism: stage params are flattened into fixed-width rows of one
+    (S, Pmax) array (sharded over the mesh "stage" axis — each device
+    holds its own stage's weights only inside the step), activations
+    ride a fixed-width (m, Fmax) buffer rotated by `lax.ppermute`, and
+    each device selects its stage's computation with `lax.switch` over
+    its stage index — SPMD code, MPMD execution. Data-source blobs
+    (data/labels) are side inputs indexed by microbatch = tick - stage,
+    so the head reads images and the tail reads labels for the same
+    logical microbatch. BatchNorm moving stats are threaded through the
+    scan carry (each device updates only its own row), so self-updating
+    layers work; their statistics are per-MICROBATCH, the standard GPipe
+    semantic (equal to the sequential net when n_micro == 1).
+
+    The mesh may carry a "data" axis: the microbatch dim of the buffer
+    and side inputs shards over it, composing PP x DP.
+    """
+
+    def __init__(self, net, mesh: Mesh, n_micro: int, axis: str = "stage",
+                 adc_bits: int = 0):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stage = mesh.shape[axis]
+        self.n_micro = n_micro
+        self.adc_bits = adc_bits
+        self.n_data = dict(mesh.shape).get("data", 1)
+        if not net.data_source_tops:
+            raise ValueError(
+                "pipeline parallelism needs a host-fed data layer "
+                "(Data/Input/ImageData/...): in-graph feeds (DummyData) "
+                "generate inside one stage and cannot deliver "
+                "per-microbatch data/label sides to head and tail")
+        global_batch = next(iter(net.data_source_tops.values()))[0]
+        div = n_micro * self.n_data
+        if global_batch % div:
+            raise ValueError(
+                f"batch {global_batch} not divisible by n_micro x n_data "
+                f"= {div}")
+        # layer setup bakes static blob shapes at the net's batch size;
+        # stage applies see the LOCAL microbatch (batch / n_micro /
+        # n_data), so the pipeline runs its own net instance rebuilt at
+        # that size (params are batch-independent, shared with the
+        # caller's tree)
+        self.net = net if div == 1 else _rebatch_net(net, div)
+        # sides reshape to (n_micro, m_global, ...); the data axis
+        # shards m_global down to the stage net's batch
+        self.m = global_batch // n_micro
+        net = self.net
+        self.stages = partition_net(net, self.n_stage)
+        names_by_stage = [set(st.layer_names) for st in self.stages]
+        # no cross-stage parameter sharing: a sharer's owner row lives on
+        # another device and could not be packed consistently
+        for l in net.layers:
+            owners = {o for o, _ in net._layer_slots.get(l.name, [])}
+            for s, names in enumerate(names_by_stage):
+                if l.name in names and not owners <= names:
+                    raise ValueError(
+                        f"layer {l.name!r} shares params across the "
+                        f"stage cut; repartition or unshare")
+        pshapes = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
+        param_shapes = {
+            ln: {i: tuple(a.shape) for i, a in enumerate(vals)
+                 if a is not None}
+            for ln, vals in pshapes.items()}
+        # per-stage packing layout over the params-tree owner entries
+        self.layouts = []
+        for st in self.stages:
+            entries = []      # (layer, slot, shape, offset)
+            off = 0
+            for l in net.layers:
+                if l.name not in st.layer_names:
+                    continue
+                slots = net._layer_slots.get(l.name, [])
+                for slot, (owner, oslot) in enumerate(slots):
+                    if (owner, oslot) != (l.name, slot):
+                        continue
+                    shape = param_shapes[l.name][slot]
+                    size = int(np.prod(shape)) if shape else 1
+                    entries.append((l.name, slot, tuple(shape), off))
+                    off += size
+            self.layouts.append((entries, off))
+        self.p_max = max(off for _, off in self.layouts)
+        # interface feature sizes (per-LOCAL-microbatch, batch first);
+        # net is the local-microbatch-sized instance, so its data-top
+        # batch IS m_local
+        blob_shape = dict(net.blob_shapes)
+        self.m_local = next(iter(net.data_source_tops.values()))[0]
+        feat = []
+        for st in self.stages:
+            for b in (st.in_blob, st.out_blob):
+                if b is not None:
+                    feat.append(int(np.prod(blob_shape[b][1:])))
+        self.f_max = max(feat)
+        self._mb_shapes = {
+            b: (self.m_local,) + tuple(blob_shape[b][1:])
+            for st in self.stages
+            for b in (st.in_blob, st.out_blob) if b is not None}
+
+    # -- packing ------------------------------------------------------
+    def pack(self, params):
+        """params tree -> (S, Pmax) rows (row s = stage s's owners)."""
+        rows = []
+        for entries, size in self.layouts:
+            parts = [jnp.ravel(params[ln][slot])
+                     for ln, slot, _, _ in entries]
+            row = (jnp.concatenate(parts) if parts
+                   else jnp.zeros((0,), jnp.float32))
+            pad = self.p_max - row.shape[0]
+            rows.append(jnp.pad(row, (0, pad)) if pad else row)
+        return jnp.stack(rows)
+
+    def _unpack_stage(self, row, s, like_dtypes):
+        entries, _ = self.layouts[s]
+        out = {}
+        for ln, slot, shape, off in entries:
+            size = int(np.prod(shape)) if shape else 1
+            arr = row[off:off + size].reshape(shape)
+            out.setdefault(ln, {})[slot] = arr.astype(like_dtypes[(ln, slot)])
+        return {ln: [slots.get(i) for i in range(max(slots) + 1)]
+                for ln, slots in out.items()}
+
+    def unpack_all(self, rows, base_params):
+        """(S, Pmax) rows -> merged params tree (non-stage entries and
+        non-owner slots keep base_params')."""
+        new = {ln: list(vals) for ln, vals in base_params.items()}
+        for s, (entries, _) in enumerate(self.layouts):
+            for ln, slot, shape, off in entries:
+                size = int(np.prod(shape)) if shape else 1
+                new[ln][slot] = rows[s, off:off + size].reshape(shape) \
+                    .astype(base_params[ln][slot].dtype)
+        return new
+
+    # -- the pipelined forward ---------------------------------------
+    def apply_fn(self, params, batch, rng=None, iteration=None,
+                 with_updates=True, compute_dtype=None, **_):
+        """Drop-in for Net.apply inside make_train_step: returns
+        (blobs, loss, new_params) with loss = mean over microbatch
+        losses and blobs carrying the net's scalar output blobs."""
+        net, S, M, m = self.net, self.n_stage, self.n_micro, self.m
+        m_local = self.m_local
+        axis = self.axis
+        out_names = list(net.output_names)
+        dtypes = {(ln, slot): params[ln][slot].dtype
+                  for ln, vals in params.items()
+                  for slot, a in enumerate(vals) if a is not None}
+        rows = self.pack(params)
+        rows = jax.lax.with_sharding_constraint(
+            rows, jax.sharding.NamedSharding(self.mesh, P(axis, None)))
+        sides = {k: v.reshape((M, m) + tuple(v.shape[1:]))
+                 for k, v in batch.items()}
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        it = (jnp.int32(0) if iteration is None
+              else jnp.asarray(iteration, jnp.int32))
+
+        mb_shapes = self._mb_shapes
+        f_max = self.f_max
+        stages = self.stages
+        adc_bits = self.adc_bits
+
+        def make_branch(s):
+            st = stages[s]
+
+            def branch(prow, buf, sides_mb, key):
+                p = self._unpack_stage(prow, s, dtypes)
+                feed = dict(sides_mb)
+                if st.in_blob is not None:
+                    shape = mb_shapes[st.in_blob]
+                    size = int(np.prod(shape[1:]))
+                    feed[st.in_blob] = buf[:, :size].reshape(shape)
+                blobs, loss, newp = net.apply(
+                    p, feed, rng=key, iteration=it, with_updates=True,
+                    adc_bits=adc_bits, start=st.first, end=st.last,
+                    compute_dtype=compute_dtype)
+                if st.out_blob is not None:
+                    out = blobs[st.out_blob].reshape(m_local, -1)
+                    pad = f_max - out.shape[1]
+                    newbuf = (jnp.pad(out, ((0, 0), (0, pad)))
+                              if pad else out).astype(buf.dtype)
+                else:
+                    newbuf = jnp.zeros_like(buf)
+                metrics = jnp.stack(
+                    [jnp.asarray(blobs[n], jnp.float32).reshape(())
+                     if (n in blobs and np.prod(np.shape(blobs[n])) == 1)
+                     else jnp.float32(0.0) for n in out_names]) \
+                    if out_names else jnp.zeros((0,), jnp.float32)
+                # repack ONLY this stage's updated params (BatchNorm
+                # moving stats); shape must match prow
+                entries, _ = self.layouts[s]
+                parts = [jnp.ravel(newp[ln][slot]).astype(prow.dtype)
+                         for ln, slot, _, _ in entries]
+                new_row = (jnp.concatenate(parts) if parts
+                           else jnp.zeros((0,), prow.dtype))
+                pad = self.p_max - new_row.shape[0]
+                if pad:
+                    new_row = jnp.pad(new_row, (0, pad))
+                return newbuf, jnp.asarray(loss, jnp.float32), \
+                    metrics, new_row
+            return branch
+
+        branches = [make_branch(s) for s in range(S)]
+        right = [(s, (s + 1) % S) for s in range(S)]
+
+        def local(rows_l, sides_l):
+            idx = jax.lax.axis_index(axis)
+            prow0 = jax.tree.map(lambda a: a[0], rows_l)
+
+            def tick(carry, t):
+                buf, prow = carry
+                mb = jnp.clip(t - idx, 0, M - 1)
+                sides_mb = {k: jax.lax.dynamic_index_in_dim(
+                    v, mb, keepdims=False) for k, v in sides_l.items()}
+                key = jax.random.fold_in(rng, mb)
+                newbuf, loss, metrics, new_prow = jax.lax.switch(
+                    idx, branches, prow, buf, sides_mb, key)
+                # stage idx holds a REAL microbatch only for ticks
+                # idx <= t < idx + M; outside that window the branch ran
+                # on the warm-up zero buffer or re-ran the clipped last
+                # microbatch — its self-updates (BatchNorm moving stats)
+                # must be discarded or TEST-phase statistics corrupt
+                valid = (t >= idx) & (t < idx + M)
+                new_prow = jnp.where(valid, new_prow, prow)
+                tail = idx == S - 1
+                done = jnp.where(tail, loss, 0.0)
+                met = jnp.where(tail, metrics, jnp.zeros_like(metrics))
+                nxt = jax.lax.ppermute(newbuf, axis, right)
+                return (nxt, new_prow), (done, met)
+
+            buf0 = jnp.zeros((m_local, f_max), jnp.float32)
+            (_, prow_f), (dones, mets) = jax.lax.scan(
+                tick, (buf0, prow0), jnp.arange(M + S - 1))
+            # microbatch j finishes at tick j + S - 1 on the tail stage
+            losses = jax.lax.psum(dones[S - 1:], axis)
+            mets = jax.lax.psum(mets[S - 1:], axis)
+            if "data" in self.mesh.axis_names:
+                # per-data-shard loss (each shard saw its slice of the
+                # microbatch) -> batch-level mean; BatchNorm stats in the
+                # updated rows average like SyncBN's moving stats
+                losses = jax.lax.pmean(losses, "data")
+                mets = jax.lax.pmean(mets, "data")
+                prow_f = jax.lax.pmean(prow_f, "data")
+            return losses, mets, prow_f[None]
+
+        has_data = "data" in self.mesh.axis_names
+        dspec = (lambda nd: P(None, "data", *([None] * (nd - 2)))) \
+            if has_data else (lambda nd: P())
+        losses, mets, new_rows = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(axis, None),
+                      {k: dspec(v.ndim) for k, v in sides.items()}),
+            out_specs=(P(), P(), P(axis, None)),
+            check_vma=False)(rows, sides)
+        loss = losses.mean()
+        mets = mets.mean(axis=0)
+        blobs = {n: mets[i] for i, n in enumerate(out_names)}
+        newp = self.unpack_all(new_rows, params) if with_updates \
+            else params
+        if with_updates:
+            return blobs, loss, newp
+        return blobs, loss
 
 
 def stack_stage_params(per_stage_params):

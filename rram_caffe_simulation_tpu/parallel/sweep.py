@@ -60,11 +60,6 @@ class SweepRunner:
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
-        if solver.strategies.genetic is not None:
-            raise NotImplementedError(
-                "genetic strategy is host-side sequential search and is not "
-                "supported under the vmapped sweep; run it per config via "
-                "Solver, or use threshold/remapping (both vmap)")
         self.solver = solver
         self.n = n_configs
         if mesh is None:
@@ -95,6 +90,21 @@ class SweepRunner:
         bcast = lambda x: jnp.repeat(x[None], n_configs, axis=0)
         self.params = jax.tree.map(bcast, solver.params)
         self.history = jax.tree.map(bcast, solver.history)
+
+        # Genetic strategy: host-side episodic search, applied PER CONFIG
+        # on host slices of the stacked state between device dispatches
+        # (the reference runs one process per config, each applying its
+        # own GeneticFailureStrategy — strategy.cpp:159-288). Each config
+        # gets an independent instance (own rng stream + prune-mask
+        # copies, seeded like a fresh per-config process would be).
+        self._genetics = None
+        if solver.strategies.genetic is not None:
+            import copy
+            self._genetics = []
+            for i in range(n_configs):
+                g = copy.deepcopy(solver.strategies.genetic)
+                g._rng = np.random.RandomState(g.seed)
+                self._genetics.append(g)
 
         # Force the pure-JAX hardware-aware engine: the Monte-Carlo config
         # axis vmaps the whole step, and perturb_weight vmaps cleanly
@@ -279,6 +289,51 @@ class SweepRunner:
         return times >= st.remap_start and (
             (times - st.remap_start) % st.remap_period == 0)
 
+    def _genetic_due_at(self, iteration: int) -> bool:
+        """GeneticStrategy.due() arithmetic (times_ counter == iter + 1
+        when due() is called once per iteration, as Solver.step does)."""
+        g = self.solver.strategies.genetic
+        if g is None:
+            return False
+        times = iteration + 1
+        return times >= g.start and (times - g.start) % g.period == 0
+
+    def _genetic_chunk_cap(self, k: int) -> int:
+        """Cap a chunk so every scheduled genetic application lands on a
+        dispatch boundary (the search runs on host between dispatches)."""
+        if self._genetics is None:
+            return k
+        for j in range(1, k):
+            if self._genetic_due_at(self.iter + j):
+                return j
+        return k
+
+    def _apply_genetic(self):
+        """One episodic application for every config, on host slices of
+        the config-stacked params/lifetimes (the Solver._apply_genetic
+        counterpart). The per-config swap search mutates its own prune
+        masks; device placement/sharding of the params is preserved."""
+        s = self.solver
+        flat = s._flat(self.params)
+        fc_keys = list(s._iter_fc_keys())
+        data = {k: np.array(flat[k]) for k, _ in fc_keys}
+        lifetimes = {k: np.asarray(self.fault_states["lifetimes"][k])
+                     for k in s._fault_keys}
+        for i, g in enumerate(self._genetics):
+            d_i = {k: v[i] for k, v in data.items()}      # views
+            diffs_i = {k: np.zeros_like(v) for k, v in d_i.items()}
+            life_i = {k: v[i] for k, v in lifetimes.items()}
+            g.apply(d_i, diffs_i, life_i)                 # in-place
+        new_flat = dict(flat)
+        for k, _ in fc_keys:
+            new_flat[k] = jax.device_put(jnp.asarray(data[k]),
+                                         flat[k].sharding)
+        self.params = s._unflat(new_flat, self.params)
+
+    def _maybe_genetic(self):
+        if self._genetics is not None and self._genetic_due_at(self.iter):
+            self._apply_genetic()
+
     def step(self, iters: int = 1, chunk: int = 1):
         """Run `iters` sweep iterations; `chunk` > 1 scans that many
         iterations per device dispatch (fresh host batch per iteration
@@ -288,7 +343,9 @@ class SweepRunner:
         if self._dataset is not None:
             done = 0
             while done < iters:
-                k = min(max(chunk, 1), iters - done)
+                self._maybe_genetic()
+                k = self._genetic_chunk_cap(min(max(chunk, 1),
+                                                iters - done))
                 its, starts, remaps = [], [], []
                 for _ in range(k):
                     its.append(self.iter)
@@ -306,6 +363,7 @@ class SweepRunner:
                     jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
         if chunk <= 1:
             for _ in range(iters):
+                self._maybe_genetic()
                 batch = self._placed(self._host_batch())
                 rngs = jax.vmap(
                     lambda i: jax.random.fold_in(
@@ -321,7 +379,8 @@ class SweepRunner:
 
         done = 0
         while done < iters:
-            k = min(chunk, iters - done)
+            self._maybe_genetic()
+            k = self._genetic_chunk_cap(min(chunk, iters - done))
             subs, its, remaps = [], [], []
             for _ in range(k):
                 subs.append(self._host_batch())
@@ -380,8 +439,9 @@ class SweepRunner:
 
 def sequential_sweep(solver_param, configs, iters, eval_iters: int = 0):
     """Per-config fallback driver: one full Solver per fault config, run
-    sequentially — the vmap-free path that supports EVERY strategy,
-    including genetic (host-side search, excluded from SweepRunner).
+    sequentially — the vmap-free path, kept as the reference-shaped
+    cross-check for SweepRunner (which supports every strategy too;
+    genetic runs per config on host slices between dispatches).
 
     Semantics match the reference's sweep workflow of one `caffe train`
     process per config (run_different_mean.sh), minus the process

@@ -256,7 +256,7 @@ class Solver:
     # the jitted train step
 
     def make_train_step(self, hw_engine: str = "auto",
-                        compute_dtype=None):
+                        compute_dtype=None, apply_fn=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs)
@@ -360,7 +360,10 @@ class Solver:
                 if cdtype is not None:
                     p = _to_run(p)
                     run_batch = _to_run(batch)
-                blobs, loss, newp = net.apply(
+                # apply_fn: an alternative forward with Net.apply's
+                # contract (enable_pipeline_parallel routes through the
+                # staged NetPipeline here)
+                blobs, loss, newp = (apply_fn or net.apply)(
                     p, run_batch, rng=rng, iteration=it, with_updates=True,
                     adc_bits=adc_bits, crossbar=crossbar,
                     compute_dtype=cdtype)
@@ -589,10 +592,103 @@ class Solver:
             self._dp_mesh = mesh  # _next_batch shards the batch over "data"
         return mesh
 
+    def enable_pipeline_parallel(self, mesh=None, devices=None,
+                                 microbatches: Optional[int] = None):
+        """Switch the train loop to GPipe-style pipeline (stage)
+        parallelism: the layer graph is partitioned into S flop-balanced
+        contiguous stages (parallel/pp.partition_net — heterogeneous
+        activation/param shapes handled via fixed-width packed buffers),
+        one stage per device along the mesh "stage" axis. Inside the
+        step each device holds ONLY its stage's packed weights,
+        activations rotate stage-to-stage over ICI (`lax.ppermute`), and
+        `microbatches` (default S) flow through the pipe per iteration.
+
+        The mesh may also carry a "data" axis: the microbatch dim then
+        shards over it with the DP weak-scaling contract (effective
+        batch = n_data x batch_size). The reference has no pipeline
+        axis at all (SURVEY §2c: P2PSync data parallelism only) — this
+        is the TPU-first scale-out for nets deeper than one chip.
+        BatchNorm stats are per-microbatch (GPipe semantics; equal to
+        sequential when microbatches == 1). Call before the first
+        step()."""
+        from ..parallel import pp as pp_mod
+        from ..parallel.mesh import make_mesh
+        if mesh is None:
+            mesh = make_mesh({"stage": len(devices or jax.devices())},
+                             devices=devices)
+        if "stage" not in mesh.axis_names:
+            raise ValueError(
+                f"enable_pipeline_parallel needs a mesh with a 'stage' "
+                f"axis (got axes {mesh.axis_names}); build one with "
+                "make_mesh({'stage': S})")
+        n_data = dict(mesh.shape).get("data", 1)
+        if n_data > 1:
+            self._scale_replica_batch(n_data)
+        adc_bits = (int(self.param.rram_forward.adc_bits)
+                    if self.param.HasField("rram_forward") else 0)
+        pipe = pp_mod.NetPipeline(
+            self.net, mesh, microbatches or mesh.shape["stage"],
+            adc_bits=adc_bits)
+        # "jax" engine: like TP, the pallas crossbar kernel has no
+        # partitioning rule under the stage axis
+        step = self.make_train_step(hw_engine="jax",
+                                    compute_dtype=self.compute_dtype,
+                                    apply_fn=pipe.apply_fn)
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._pp = pipe
+        if n_data > 1:
+            self._dp_mesh = mesh
+        return mesh
+
+    def enable_sequence_parallel(self, mesh=None, devices=None,
+                                 impl: str = "ring"):
+        """Switch the net's Attention layers to sequence/context
+        parallelism: the sequence axis of every attention computation is
+        sharded over the mesh "seq" axis, with K/V shards rotating on
+        ICI (`impl="ring"`, blockwise flash-style accumulation) or two
+        all_to_alls re-sharding sequence<->heads (`impl="ulysses"`,
+        needs num_heads % seq divisible). Per-chip attention memory is
+        O(S/P) — the long-context story the reference's single-device
+        RNN unrolling cannot reach (SURVEY §5.7). The mesh may carry a
+        "data" axis for batch weak scaling like enable_data_parallel.
+        Call before the first step()."""
+        from ..parallel.mesh import make_mesh
+        if mesh is None:
+            mesh = make_mesh({"seq": len(devices or jax.devices())},
+                             devices=devices)
+        if "seq" not in mesh.axis_names:
+            raise ValueError(
+                f"enable_sequence_parallel needs a mesh with a 'seq' "
+                f"axis (got axes {mesh.axis_names}); build one with "
+                "make_mesh({'seq': N})")
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+        if not any(l.type_name == "Attention" for l in self.net.layers):
+            raise ValueError(
+                "enable_sequence_parallel: the net has no Attention "
+                "layers to shard")
+        n_data = dict(mesh.shape).get("data", 1)
+        if n_data > 1:
+            self._scale_replica_batch(n_data)
+        net = self.net
+
+        def apply_fn(p, b, **kw):
+            kw.pop("crossbar", None)   # pallas crossbar: no GSPMD rule
+            return net.apply(p, b, seq_mesh=mesh, seq_impl=impl, **kw)
+
+        step = self.make_train_step(hw_engine="jax",
+                                    compute_dtype=self.compute_dtype,
+                                    apply_fn=apply_fn)
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._sp_mesh = mesh
+        if n_data > 1:
+            self._dp_mesh = mesh
+        return mesh
+
     # ------------------------------------------------------------------
     # host loop
 
-    def _next_batch(self):
+    def _next_batch(self, place: bool = True):
         iter_size = max(self.param.iter_size, 1)
         n_rep = getattr(self, "_dp_pulls", 1)
 
@@ -614,6 +710,10 @@ class Solver:
                 return {}
             batch = {k: jnp.stack([jnp.asarray(s[k]) for s in subs])
                      for k in subs[0]}
+        if not place:
+            # caller (step_fused) stacks chunk batches first and applies
+            # the data-parallel placement to the stacked array
+            return batch
         if getattr(self, "_dp_mesh", None) is not None and batch:
             from ..parallel.dp import shard_batch
             from ..parallel.mesh import data_sharding
@@ -635,12 +735,15 @@ class Solver:
         return batch
 
     def _remap_due(self) -> bool:
+        return self._remap_due_at(self.iter)
+
+    def _remap_due_at(self, iteration: int) -> bool:
         s = self.strategies
         if s.prune_orders is None or self.fault_state is None:
             return False
         # times_ gating (strategy.cpp:91-93): Apply is called every
         # iteration, so times_ == iter + 1 at the check.
-        times = self.iter + 1
+        times = iteration + 1
         return times >= s.remap_start and (
             (times - s.remap_start) % s.remap_period == 0)
 
@@ -699,6 +802,116 @@ class Solver:
                 break
         self._materialize_smoothed_loss()
 
+    def step_fused(self, iters: int, chunk: int = 0):
+        """Dispatch-amortized Solver::Step: `iters` iterations run as
+        ceil(iters/chunk) device dispatches, each a `lax.scan` over the
+        fused train step — forward+backward+update+fail back-to-back
+        on-chip with no host round-trip between iterations.
+
+        `Solver.step` pays one dispatch per iteration; on TPU (and
+        especially over a tunneled PJRT link, ~100 ms/round-trip) that
+        dwarfs a millisecond-scale step, so fused stepping is how
+        training reaches device-bound throughput. The reference has no
+        analogue because CUDA launches are asynchronous — its
+        per-iteration loop (solver.cpp:238) never blocks on the GPU.
+
+        Semantics match `Solver.step` iteration-for-iteration (same rng
+        fold per iter, same remap schedule, same loss smoothing), except
+        host-side work is chunk-granular: display prints and snapshots
+        happen at chunk boundaries, test_interval fires only when a
+        boundary lands on a multiple (pick `chunk` to divide it), and
+        the last net outputs are not mirrored to `last_outputs`. The
+        genetic strategy is host-side per-iteration search — use
+        `Solver.step` for genetic solvers.
+
+        Host-fed nets (Data/HDF5Data/...) get `chunk` batches pulled and
+        stacked per dispatch; in-graph feeds (DummyData/Input) generate
+        on-chip, making the whole run a single resident computation.
+        """
+        if self.strategies.genetic is not None:
+            raise NotImplementedError(
+                "the genetic strategy runs host-side between iterations; "
+                "use Solver.step for genetic solvers")
+        if iters <= 0:
+            return
+        chunk = min(chunk, iters) if chunk else iters
+        param = self.param
+        start_iter = self.iter
+        average_loss = max(param.average_loss, 1)
+        self.losses = []
+        self.smoothed_loss = 0.0
+        step_fn = self._compiled_step()
+        key = self._key
+        has_feed = bool(self.net.data_source_tops)
+        iter_size = max(param.iter_size, 1)
+
+        if not hasattr(self, "_fused_fns"):
+            self._fused_fns = {}
+
+        def make_run(n):
+            def run(params, history, fault, batches, its, remaps):
+                def body(carry, x):
+                    p, h, f = carry
+                    b, it, rm = x
+                    rng = jax.random.fold_in(key, it)
+                    p, h, f, loss, _ = step_fn(p, h, f, b, it, rng, rm)
+                    return (p, h, f), loss
+                (p, h, f), losses = jax.lax.scan(
+                    body, (params, history, fault),
+                    (batches, its, remaps), length=n)
+                return p, h, f, losses
+            return jax.jit(run, donate_argnums=(0, 1, 2))
+
+        done = 0
+        while done < iters:
+            n = min(chunk, iters - done)
+            if n not in self._fused_fns:
+                self._fused_fns[n] = make_run(n)
+            its = jnp.arange(self.iter, self.iter + n, dtype=jnp.int32)
+            remaps = jnp.asarray(
+                [self._remap_due_at(i)
+                 for i in range(self.iter, self.iter + n)])
+            if has_feed:
+                pulled = [self._next_batch(place=False) for _ in range(n)]
+                batches = {k: jnp.stack([b[k] for b in pulled])
+                           for k in pulled[0]}
+                if getattr(self, "_dp_mesh", None) is not None:
+                    if jax.process_count() > 1:
+                        raise NotImplementedError(
+                            "fused stepping with a multi-host feed; use "
+                            "Solver.step")
+                    from ..parallel.dp import shard_batch
+                    # the chunk axis is in front of the (iter_size,)
+                    # batch layout _next_batch normally places
+                    lead = 1 if iter_size == 1 else 2
+                    batches = shard_batch(batches, self._dp_mesh,
+                                          lead=lead)
+            else:
+                batches = {}
+            (self.params, self.history, self.fault_state,
+             losses) = self._fused_fns[n](
+                self.params, self.history, self.fault_state,
+                batches, its, remaps)
+            for i in range(n):
+                self._record_loss(losses[i], start_iter, average_loss)
+                self.iter += 1
+            if param.display and self.iter % param.display == 0:
+                self._materialize_smoothed_loss()
+                lr = float(self._lr_fn(jnp.int32(self.iter - 1)))
+                print(f"Iteration {self.iter - 1}, lr = {lr:g}",
+                      flush=True)
+                print(f"Iteration {self.iter - 1}, loss = "
+                      f"{self.smoothed_loss:g}", flush=True)
+            if (param.test_interval and
+                    self.iter % param.test_interval == 0):
+                self.test_all()
+            if param.snapshot and self.iter % param.snapshot == 0:
+                self.snapshot()
+            done += n
+            if self._requested_action == "stop":
+                break
+        self._materialize_smoothed_loss()
+
     def _apply_genetic(self, genetic):
         """Episodic host-side genetic strategy between jitted steps (the
         reference interleaves it mid-step, but the update values it would
@@ -740,12 +953,19 @@ class Solver:
             self.smoothed_loss = float(jnp.stack(self.losses).mean())
         return self.smoothed_loss
 
-    def solve(self, resume_file: Optional[str] = None):
-        """Solver::Solve (solver.cpp:328-375)."""
+    def solve(self, resume_file: Optional[str] = None,
+              fused_chunk: Optional[int] = None):
+        """Solver::Solve (solver.cpp:328-375). `fused_chunk` switches the
+        iteration loop to `step_fused` with that chunk size (see there
+        for the chunk-granular display/test/snapshot semantics)."""
         print(f"Solving {self.net.name}", flush=True)
         if resume_file:
             self.restore(resume_file)
-        self.step(self.param.max_iter - self.iter)
+        if fused_chunk:
+            self.step_fused(self.param.max_iter - self.iter,
+                            chunk=fused_chunk)
+        else:
+            self.step(self.param.max_iter - self.iter)
         if (self.param.snapshot_after_train and
                 (not self.param.snapshot or
                  self.iter % self.param.snapshot != 0)):

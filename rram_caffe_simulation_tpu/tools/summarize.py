@@ -15,12 +15,69 @@ from ..proto import pb
 from ..utils import io as uio
 
 
-def summarize(net_param, phase):
+def _conv_kernel_dims(cp, ndim):
+    if cp.kernel_h or cp.kernel_w:
+        return [int(cp.kernel_h), int(cp.kernel_w)]
+    ks = [int(k) for k in cp.kernel_size]
+    if len(ks) == 1:
+        ks = ks * ndim
+    return ks
+
+
+def net_fwd_flops(net):
+    """Analytic forward FLOPs (2 x MACs) per compute-bearing layer —
+    Convolution / Deconvolution / InnerProduct, where essentially all of
+    a convnet's arithmetic lives; elementwise, pooling, and norm layers
+    are noise at MFU granularity and are counted as 0.
+
+    Returns (total_flops, {layer_name: flops}) at the net's built batch
+    size. The usual training-step estimate is 3 x forward (one forward
+    matmul + two backward: grad-wrt-input and grad-wrt-weights).
+    """
+    shapes = {}
+    per = {}
+    for layer in net.layers:
+        bshapes = [tuple(shapes[b]) for b in layer.lp.bottom]
+        for t, s in zip(layer.lp.top, layer.top_shapes):
+            shapes[t] = tuple(s)
+        t = layer.type_name
+        macs = 0
+        if t == "Convolution" and bshapes:
+            cp = layer.lp.convolution_param
+            n, co, *sp_out = layer.top_shapes[0]
+            ci = bshapes[0][1]
+            k = _conv_kernel_dims(cp, len(sp_out))
+            macs = (n * co * int(np.prod(sp_out))
+                    * (ci // max(cp.group, 1)) * int(np.prod(k)))
+        elif t == "Deconvolution" and bshapes:
+            # transpose of a conv: one MAC per INPUT position per tap
+            cp = layer.lp.convolution_param
+            n, ci, *sp_in = bshapes[0]
+            co = layer.top_shapes[0][1]
+            k = _conv_kernel_dims(cp, len(sp_in))
+            macs = (n * ci * int(np.prod(sp_in))
+                    * (co // max(cp.group, 1)) * int(np.prod(k)))
+        elif t == "InnerProduct" and bshapes:
+            ipp = layer.lp.inner_product_param
+            axis = ipp.axis if ipp.HasField("axis") else 1
+            m = int(np.prod(bshapes[0][:axis])) or 1
+            kk = int(np.prod(bshapes[0][axis:]))
+            macs = m * kk * int(ipp.num_output)
+        if macs:
+            per[layer.name] = 2 * macs
+    return sum(per.values()), per
+
+
+def summarize(net_param, phase, flops=False):
     import jax
 
     net = Net(net_param, phase)
     params = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
-    rows = [("LAYER", "TYPE", "BOTTOMS", "TOPS", "TOP SHAPES", "PARAMS")]
+    header = ("LAYER", "TYPE", "BOTTOMS", "TOPS", "TOP SHAPES", "PARAMS")
+    total_flops, per_flops = net_fwd_flops(net) if flops else (0, {})
+    if flops:
+        header = header + ("FWD MFLOPs",)
+    rows = [header]
     total = 0
     owned = {(r.layer_name, r.slot) for r in net.learnable_params
              if r.key == (r.layer_name, r.slot)}
@@ -32,14 +89,21 @@ def summarize(net_param, phase):
             for slot, a in enumerate(params.get(layer.name, []))
             if a is not None and (layer.name, slot) in owned)
         total += n_params
-        rows.append((layer.name, layer.type_name,
-                     ",".join(layer.lp.bottom) or "-",
-                     ",".join(layer.lp.top) or "-",
-                     shapes, str(n_params) if n_params else "-"))
+        row = (layer.name, layer.type_name,
+               ",".join(layer.lp.bottom) or "-",
+               ",".join(layer.lp.top) or "-",
+               shapes, str(n_params) if n_params else "-")
+        if flops:
+            f = per_flops.get(layer.name, 0)
+            row = row + (f"{f / 1e6:.1f}" if f else "-",)
+        rows.append(row)
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
              for r in rows]
     lines.append(f"Total learnable parameters: {total:,}")
+    if flops:
+        lines.append(f"Total forward FLOPs (2xMACs, built batch): "
+                     f"{total_flops / 1e9:.3f} GFLOPs")
     return "\n".join(lines)
 
 
@@ -47,10 +111,13 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("prototxt")
     p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
+    p.add_argument("--flops", action="store_true",
+                   help="add an analytic forward-FLOPs column "
+                        "(conv/deconv/inner-product MACs x 2)")
     args = p.parse_args(argv)
     net_param = uio.read_net_param(args.prototxt)
     phase = pb.TRAIN if args.phase == "TRAIN" else pb.TEST
-    print(summarize(net_param, phase))
+    print(summarize(net_param, phase, flops=args.flops))
     return 0
 
 

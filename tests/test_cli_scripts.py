@@ -274,3 +274,69 @@ def test_train_compute_dtype_flag(tmp_path):
             caffe_cli.main(["train", "--solver", solver_path,
                             "--compute-dtype", bad])
         assert exc.value.code == 2
+
+
+def test_train_amortize_flag(tmp_path, capsys):
+    """caffe_cli train --amortize: the solve loop runs through
+    Solver.step_fused (chunk = gcd of display/test/snapshot intervals)
+    and still produces the final snapshot and display lines."""
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+
+    npar = pb.NetParameter()
+    text_format.Parse(DUMMY_SCORE_NET, npar)
+    net_path = str(tmp_path / "net.prototxt")
+    uio.write_proto_text(net_path, npar)
+    sp = pb.SolverParameter()
+    sp.net = net_path
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 6
+    sp.display = 2
+    sp.random_seed = 5
+    sp.snapshot_prefix = str(tmp_path / "am")
+    solver_path = str(tmp_path / "solver.prototxt")
+    uio.write_proto_text(solver_path, sp)
+
+    rc = caffe_cli.main(["train", "--solver", solver_path, "--amortize"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Amortized stepping: 2 iterations per dispatch" in out
+    assert "loss = " in out
+    assert os.path.exists(str(tmp_path / "am_iter_6.caffemodel"))
+
+    # same solver, per-iteration loop: identical final weights
+    sp2 = pb.SolverParameter()
+    sp2.CopyFrom(sp)
+    sp2.snapshot_prefix = str(tmp_path / "pl")
+    solver_path2 = str(tmp_path / "solver2.prototxt")
+    uio.write_proto_text(solver_path2, sp2)
+    rc = caffe_cli.main(["train", "--solver", solver_path2])
+    assert rc == 0
+    m1 = uio.read_proto_binary(str(tmp_path / "am_iter_6.caffemodel"),
+                               pb.NetParameter())
+    m2 = uio.read_proto_binary(str(tmp_path / "pl_iter_6.caffemodel"),
+                               pb.NetParameter())
+    for l1, l2 in zip(m1.layer, m2.layer):
+        for b1, b2 in zip(l1.blobs, l2.blobs):
+            np.testing.assert_array_equal(np.asarray(b1.data),
+                                          np.asarray(b2.data))
+
+
+def test_train_amortize_genetic_falls_back(tmp_path, capsys):
+    """--amortize with a genetic failure strategy cannot scan on-device
+    (host-side per-iteration search) — the CLI warns and uses the
+    per-iteration loop instead of crashing mid-run (review r3)."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(__file__))
+    from test_parallel import _genetic_solver_param
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+    sp = _genetic_solver_param(tmp_path)
+    sp.max_iter = 2
+    sp.display = 1
+    solver_path = str(tmp_path / "gsolver.prototxt")
+    uio.write_proto_text(solver_path, sp)
+    rc = caffe_cli.main(["train", "--solver", solver_path, "--amortize"])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "unsupported with the genetic" in cap.err
+    assert "Optimization Done" in cap.out

@@ -139,3 +139,15 @@ def test_cifar10_full_family_trains(net_file, tmp_path):
         assert np.isfinite(s._materialize_smoothed_loss())
     finally:
         os.chdir(cwd)
+
+
+def test_toy_imagenet_flow(tmp_path):
+    """examples/imagenet end-to-end on a generated folder: PNG encode
+    (no PIL) -> convert_imageset -> compute_image_mean -> caffe_cli
+    train with LMDB TRAIN + ImageData TEST phases. Accuracy must beat
+    chance by a wide margin (the classes are color-separable)."""
+    ex = _load("examples/imagenet/run_toy_imagenet.py",
+               "run_toy_imagenet")
+    acc = ex.main(["--classes", "3", "--per-class", "8",
+                   "--iters", "25", "--out", str(tmp_path)])
+    assert acc >= 0.8

