@@ -187,7 +187,13 @@ class PoolingLayer(Layer):
     def apply(self, params, bottoms, ctx):
         x = bottoms[0]
         if self.method == pb.PoolingParameter.MAX:
-            y = self._reduce(x, -jnp.inf, lax.max).astype(x.dtype)
+            # custom_vjp backward with a selectable engine (XLA
+            # select_and_scatter by default — measured at the bandwidth
+            # floor; the Pallas kernel alternative via RRAM_POOL_BWD) —
+            # see ops/pool_backward.py
+            from .pool_backward import max_pool
+            y = max_pool(x, self.kernel, self.stride,
+                         self.xla_pad).astype(x.dtype)
             tops = [y]
             if len(self.top_shapes) > 1:
                 # Mask top: flat argmax index within the input feature map

@@ -241,16 +241,18 @@ def time(args):
         # round-trip latency stays off the measurement — the honest
         # number on tunneled/remote runtimes, at the cost of one big
         # loop compile per pass. The carry feeds back into the inputs at
-        # 1e-30 scale so XLA cannot hoist the invariant body. The ONE
-        # remaining dispatch's round-trip (~100 ms over a tunnel, i.e.
-        # 100/n ms per iteration) is measured with a trivial program
-        # and subtracted.
-        trivial = jax.jit(lambda z: z + 1.0)
-        jax.block_until_ready(trivial(jnp.float32(0.0)))
-        _d0 = _time.perf_counter()
-        for _ in range(5):
-            jax.block_until_ready(trivial(jnp.float32(0.0)))
-        dispatch_ms = (_time.perf_counter() - _d0) / 5 * 1e3
+        # 1e-30 scale so XLA cannot hoist the invariant body. The one
+        # remaining dispatch varies wildly on a tunnel (cold ~100 ms,
+        # warm sub-ms), so each measurement repeats and keeps the MIN —
+        # the warm-path dispatch leaves only ~0.01 ms/iter residue.
+        def best_of(run, repeats=3):
+            jax.block_until_ready(run(jnp.float32(0.0)))  # compile+warm
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(run(jnp.float32(0.0)))
+                best = min(best, (_time.perf_counter() - t0) * 1e3)
+            return best
 
         def timed(scalar_fn, n):
             def body(_, carry):
@@ -261,11 +263,7 @@ def time(args):
 
             run = jax.jit(lambda z: jax.lax.fori_loop(
                 0, n, body, jnp.float32(0.0)))
-            jax.block_until_ready(run(0.0))        # compile + warmup
-            t0 = _time.perf_counter()
-            jax.block_until_ready(run(0.0))
-            total = (_time.perf_counter() - t0) * 1e3
-            return max(total - dispatch_ms, 0.0) / n
+            return best_of(run) / n
     else:
         # reference semantics (caffe.cpp:334 Timer around each
         # iteration): includes dispatch — on remote/tunneled runtimes
@@ -315,11 +313,7 @@ def time(args):
                 return jnp.sum(t[0]).astype(jnp.float32)
             lrun = jax.jit(lambda z: jax.lax.fori_loop(
                 0, iters, lbody, z))
-            jax.block_until_ready(lrun(jnp.float32(0.0)))
-            t0 = _time.perf_counter()
-            jax.block_until_ready(lrun(jnp.float32(0.0)))
-            total = (_time.perf_counter() - t0) * 1e3
-            dt = max(total - dispatch_ms, 0.0) / iters
+            dt = best_of(lrun) / iters
         else:
             t0 = _time.perf_counter()
             for _ in range(max(iters // 5, 1)):

@@ -1,0 +1,144 @@
+"""The Pallas max-pool backward (ops/pool_backward.py) must equal XLA's
+select_and_scatter VJP bit-for-bit — including Caffe CEIL padding,
+overlapping windows, and tie-breaking (first row-major argmax)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rram_caffe_simulation_tpu.ops import pool_backward as pbwd
+
+
+def _xla_dx(x, g, kernel, stride, pads):
+    _, vjp = jax.vjp(
+        lambda a: pbwd._fwd_reduce(a, kernel, stride, pads), x)
+    return vjp(g)[0]
+
+
+CASES = [
+    # (H, W, kernel, stride, pads) — first row is CIFAR-quick pool1:
+    # 32->16 with Caffe CEIL (hi pad 1)
+    (32, 32, (3, 3), (2, 2), ((0, 1), (0, 1))),
+    (16, 16, (3, 3), (2, 2), ((0, 1), (0, 1))),
+    (12, 12, (2, 2), (2, 2), ((0, 0), (0, 0))),
+    (9, 11, (3, 2), (1, 2), ((1, 1), (0, 1))),
+    (8, 8, (3, 3), (3, 3), ((0, 1), (0, 1))),
+]
+
+
+def _out_hw(h, k, s, pads):
+    return (h + pads[0] + pads[1] - k) // s + 1
+
+
+@pytest.mark.parametrize("H,W,kernel,stride,pads", CASES)
+def test_pallas_matches_xla(H, W, kernel, stride, pads):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 4, H, W), jnp.float32)
+    ho = _out_hw(H, kernel[0], stride[0], pads[0])
+    wo = _out_hw(W, kernel[1], stride[1], pads[1])
+    g = jnp.asarray(rng.randn(6, 4, ho, wo), jnp.float32)
+    dx_pallas = pbwd._pallas_bwd(g, x, kernel, stride, pads,
+                                 interpret=True)
+    dx_xla = _xla_dx(x, g, kernel, stride, pads)
+    # positions winning several overlapping windows accumulate their
+    # cotangents in a different order than select_and_scatter -> ulp
+    np.testing.assert_allclose(np.asarray(dx_pallas),
+                               np.asarray(dx_xla), rtol=1e-6, atol=1e-6)
+
+
+def test_tie_breaking_first_argmax():
+    """Duplicate maxima inside a window: the FIRST (row-major) position
+    gets the whole gradient, like SelectAndScatter's GE select."""
+    x = jnp.zeros((1, 1, 4, 4), jnp.float32)          # all ties
+    g = jnp.asarray(np.arange(1, 5, dtype=np.float32)
+                    .reshape(1, 1, 2, 2))
+    kernel, stride, pads = (2, 2), (2, 2), ((0, 0), (0, 0))
+    dx_pallas = pbwd._pallas_bwd(g, x, kernel, stride, pads,
+                                 interpret=True)
+    dx_xla = _xla_dx(x, g, kernel, stride, pads)
+    np.testing.assert_array_equal(np.asarray(dx_pallas),
+                                  np.asarray(dx_xla))
+    # and explicitly: each window's top-left corner holds the grad
+    expect = np.zeros((1, 1, 4, 4), np.float32)
+    expect[0, 0, ::2, ::2] = [[1, 2], [3, 4]]
+    np.testing.assert_array_equal(np.asarray(dx_pallas), expect)
+
+
+def test_overlapping_windows_accumulate():
+    """stride < kernel: one input position can win several windows and
+    must sum their cotangents."""
+    rng = np.random.RandomState(3)
+    # a spike at (2,2) wins every window containing it
+    x = jnp.asarray(-np.abs(rng.randn(1, 1, 6, 6)), jnp.float32)
+    x = x.at[0, 0, 2, 2].set(10.0)
+    kernel, stride, pads = (3, 3), (1, 1), ((0, 0), (0, 0))
+    g = jnp.asarray(rng.randn(1, 1, 4, 4), jnp.float32)
+    dx_pallas = pbwd._pallas_bwd(g, x, kernel, stride, pads,
+                                 interpret=True)
+    dx_xla = _xla_dx(x, g, kernel, stride, pads)
+    np.testing.assert_allclose(np.asarray(dx_pallas),
+                               np.asarray(dx_xla), rtol=1e-6, atol=1e-6)
+    # the spike is inside the 9 windows with oh, ow in 0..2; its grad
+    # is exactly their cotangent sum
+    np.testing.assert_allclose(float(dx_pallas[0, 0, 2, 2]),
+                               float(g[0, 0, :3, :3].sum()), rtol=1e-5)
+
+
+def test_bfloat16():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(2, 3, 16, 16), jnp.bfloat16)
+    g = jnp.asarray(rng.randn(2, 3, 8, 8), jnp.bfloat16)
+    kernel, stride, pads = (3, 3), (2, 2), ((0, 1), (0, 1))
+    dx_pallas = pbwd._pallas_bwd(g, x, kernel, stride, pads,
+                                 interpret=True)
+    dx_xla = _xla_dx(x, g, kernel, stride, pads)
+    np.testing.assert_allclose(
+        np.asarray(dx_pallas, np.float32), np.asarray(dx_xla, np.float32),
+        rtol=1e-2, atol=1e-2)
+
+
+def test_vmap_config_axis(monkeypatch):
+    """The sweep vmaps the whole step over the config axis; the
+    custom_vjp + pallas_call must batch correctly."""
+    monkeypatch.setenv("RRAM_POOL_BWD", "interpret")
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(3, 2, 2, 8, 8), jnp.float32)  # (cfg,...)
+    kernel, stride, pads = (3, 3), (2, 2), ((0, 1), (0, 1))
+
+    def loss(xi):
+        y = pbwd.max_pool(xi, kernel, stride, pads)
+        return jnp.sum(y * y)
+
+    g_v = jax.vmap(jax.grad(loss))(x)
+    monkeypatch.setenv("RRAM_POOL_BWD", "xla")
+    g_ref = jax.vmap(jax.grad(loss))(x)
+    np.testing.assert_array_equal(np.asarray(g_v), np.asarray(g_ref))
+
+
+def test_max_pool_layer_uses_custom_vjp(monkeypatch):
+    """End-to-end through the Pooling layer: CIFAR-quick pool1 geometry,
+    interpret-mode pallas backward == xla backward."""
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.net import Net
+    from rram_caffe_simulation_tpu.proto import pb
+    npar = pb.NetParameter()
+    text_format.Parse("""
+layer { name: "data" type: "Input" top: "x"
+  input_param { shape { dim: 2 dim: 3 dim: 32 dim: 32 } } }
+layer { name: "pool1" type: "Pooling" bottom: "x" top: "y"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+""", npar)
+    net = Net(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(9)
+    batch = {"x": jnp.asarray(rng.randn(2, 3, 32, 32), jnp.float32)}
+
+    def loss(b):
+        blobs, _ = net.apply(params, b)
+        return jnp.sum(blobs["y"] ** 2)
+
+    monkeypatch.setenv("RRAM_POOL_BWD", "interpret")
+    g1 = jax.grad(loss)(batch)["x"]
+    monkeypatch.setenv("RRAM_POOL_BWD", "xla")
+    g2 = jax.grad(loss)(batch)["x"]
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
