@@ -1,0 +1,85 @@
+"""The MEASURED 1000-config north-star run (BASELINE: 1000-config
+5k-iter CIFAR-10-quick sweep < 10 min on a v4-8).
+
+One v5e chip can hold ~500 CIFAR-quick fault configs in HBM at batch
+100 (1000 at once needs ~21 GB), and the config axis is embarrassingly
+parallel — so the single-chip measurement runs the 1000 configs as
+sequential SweepRunner groups and reports TOTAL wall time, which is
+exactly what 2 chips would do concurrently (and what 8 chips do at 125
+configs each for the v4-8 figure; the dryrun certifies the multi-chip
+mesh compiles/executes).
+
+    python examples/gaussian_failure/run_1000_sweep.py \
+        [--configs 1000] [--group 500] [--iters 5000] [--chunk 50]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..", "..")
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", type=int, default=1000)
+    p.add_argument("--group", type=int, default=500,
+                   help="configs resident per runner (HBM-bound)")
+    p.add_argument("--iters", type=int, default=5000)
+    p.add_argument("--chunk", type=int, default=50)
+    p.add_argument("--mean", type=float, default=1e8)
+    p.add_argument("--std", type=float, default=3e7)
+    args = p.parse_args(argv)
+
+    os.chdir(REPO)
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.utils.io import read_solver_param
+
+    groups = [args.group] * (args.configs // args.group)
+    if args.configs % args.group:
+        groups.append(args.configs % args.group)
+    t_total = time.perf_counter()
+    done = 0
+    for gi, n_cfg in enumerate(groups):
+        param = read_solver_param(
+            "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt")
+        param.failure_pattern.type = "gaussian"
+        param.failure_pattern.mean = args.mean
+        param.failure_pattern.std = args.std
+        param.random_seed = 7 + gi
+        param.display = 0
+        param.ClearField("test_interval")
+        solver = Solver(param, compute_dtype="bfloat16")
+        t0 = time.perf_counter()
+        runner = SweepRunner(solver, n_configs=n_cfg)
+        runner.step(args.iters, chunk=args.chunk)
+        broken = runner.broken_fractions()
+        dt = time.perf_counter() - t0
+        done += n_cfg
+        print(f"group {gi}: {n_cfg} configs x {args.iters} iters in "
+              f"{dt / 60:.2f} min (broken mean {broken.mean():.3f}); "
+              f"{done}/{args.configs} done", flush=True)
+    total_min = (time.perf_counter() - t_total) / 60
+    rec = {
+        "configs": args.configs,
+        "iters_per_config": args.iters,
+        "batch": 100,
+        "groups": groups,
+        "wall_minutes_one_chip": round(total_min, 2),
+        "configs_per_hour_one_chip": round(args.configs
+                                           / (total_min / 60), 1),
+        "v4_8_projection_minutes": round(total_min / 8, 2),
+        "compute_dtype": "bfloat16",
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,6 @@ def _bwd_kernel(g_ref, x_ref, dx_ref, xp_ref, *, hw, ohw, kernel,
     # anchor grid must reach anchor (Ho-1)*sh + window extent kh
     Hp = max(H + pl0 + phi0, (Ho - 1) * sh + kh)
     Wp = max(W + pl1 + phi1, (Wo - 1) * sw + kw)
-    ha, wa = Hp - kh + 1, Wp - kw + 1        # anchor extents
     out_dtype = x_ref.dtype
     # all selection math in f32 (exact upcast): sub-f32 dtypes trip
     # Mosaic's comparison layouts, and VMEM-resident upcasts are free

@@ -243,8 +243,12 @@ def time(args):
         # loop compile per pass. The carry feeds back into the inputs at
         # 1e-30 scale so XLA cannot hoist the invariant body. The one
         # remaining dispatch varies wildly on a tunnel (cold ~100 ms,
-        # warm sub-ms), so each measurement repeats and keeps the MIN —
-        # the warm-path dispatch leaves only ~0.01 ms/iter residue.
+        # warm sometimes sub-ms), so each measurement repeats and keeps
+        # the MIN. The residue is dispatch/iters — often still ~2 ms/it
+        # at 40 iters when no warm path appears — so tiny per-layer
+        # numbers are upper bounds; raising --iterations shrinks the
+        # floor. (A trivial-program subtraction was tried and removed:
+        # dispatch variance made it over-correct to 0.)
         def best_of(run, repeats=3):
             jax.block_until_ready(run(jnp.float32(0.0)))  # compile+warm
             best = float("inf")

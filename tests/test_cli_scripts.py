@@ -416,3 +416,19 @@ def test_cli_train_curve_equals_solver_api(tmp_path, capsys):
         assert pairs > 0
     finally:
         os.chdir(cwd)
+
+
+def test_summarize_flops_column(capsys):
+    """summarize --flops: analytic conv/FC forward FLOPs column + total
+    (LeNet conv1: 2 x 20x1x5x5 x 24x24 x TEST batch 64 = 36.9 MFLOPs)."""
+    from rram_caffe_simulation_tpu.tools import summarize
+    rc = summarize.main([os.path.join(REPO, "models", "lenet",
+                                      "lenet_train_test.prototxt"),
+                         "--phase", "TEST", "--flops"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "FWD MFLOPs" in out
+    assert "Total forward FLOPs" in out
+    import re
+    m = re.search(r"conv1.*?(\d+\.\d)\s*$", out, re.M)
+    assert m and abs(float(m.group(1)) - 36.9) < 1.0

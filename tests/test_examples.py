@@ -151,3 +151,21 @@ def test_toy_imagenet_flow(tmp_path):
     acc = ex.main(["--classes", "3", "--per-class", "8",
                    "--iters", "25", "--out", str(tmp_path)])
     assert acc >= 0.8
+
+
+def test_sweep_1000_runner_small(tmp_path):
+    """The measured-north-star driver (run_1000_sweep.py) at a tiny
+    operating point: grouping math, per-group seeding, and the JSON
+    record."""
+    ex = _load("examples/gaussian_failure/run_1000_sweep.py",
+               "run_1000_sweep")
+    cwd = os.getcwd()
+    try:
+        rec = ex.main(["--configs", "6", "--group", "4", "--iters", "4",
+                       "--chunk", "2"])
+    finally:
+        os.chdir(cwd)
+    assert rec["configs"] == 6
+    assert rec["groups"] == [4, 2]
+    assert rec["wall_minutes_one_chip"] > 0
+    assert rec["configs_per_hour_one_chip"] > 0
