@@ -169,3 +169,22 @@ def test_sweep_1000_runner_small(tmp_path):
     assert rec["groups"] == [4, 2]
     assert rec["wall_minutes_one_chip"] > 0
     assert rec["configs_per_hour_one_chip"] > 0
+
+
+@pytest.mark.parametrize("name", ["01-learning-lenet", "net_surgery",
+                                  "brewing-logreg"])
+def test_notebooks_execute(name):
+    """The generated tutorial notebooks (reference .ipynb parity) must
+    actually run: execute every code cell in order from the repo root."""
+    import json
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        nb = json.load(open(os.path.join(
+            "examples", "notebooks", f"{name}.ipynb")))
+        glb = {}
+        for cell in nb["cells"]:
+            if cell["cell_type"] == "code":
+                exec("".join(cell["source"]), glb)
+    finally:
+        os.chdir(cwd)

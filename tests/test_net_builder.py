@@ -172,3 +172,86 @@ def test_loss_layer_auto_top():
     blobs, loss = net.apply(params, batch)
     assert float(loss) > 0.5  # ~ln(3) at init
     assert "(automatic)" in blobs
+
+
+def test_grouped_convolution_matches_feature_group_count():
+    """Grouped conv is lowered as per-group convs + concat (the grouped
+    weight-grad conv mis-performs on XLA:TPU — round 3); values AND
+    gradients must equal lax's feature_group_count form exactly."""
+    from jax import lax
+    npar = pb.NetParameter()
+    text_format.Parse("""
+name: "G"
+layer { name: "x" type: "Input" top: "x"
+  input_param { shape { dim: 2 dim: 4 dim: 9 dim: 9 } } }
+layer { name: "conv" type: "Convolution" bottom: "x" top: "y"
+  convolution_param { num_output: 6 kernel_size: 3 group: 2 pad: 1
+    weight_filler { type: "xavier" } } }
+""", npar)
+    net = Net(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(2))
+    assert params["conv"][0].shape == (6, 2, 3, 3)   # Cin/group = 2
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 9, 9), jnp.float32)
+
+    def layer_out(p, xv):
+        blobs, _ = net.apply(p, {"x": xv})
+        return blobs["y"]
+
+    def ref_out(p, xv):
+        y = lax.conv_general_dilated(
+            xv, p["conv"][0], (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=lax.conv_dimension_numbers(
+                xv.shape, p["conv"][0].shape, ("NCHW", "OIHW", "NCHW")),
+            feature_group_count=2)
+        return y + p["conv"][1].reshape(1, -1, 1, 1)
+
+    np.testing.assert_allclose(np.asarray(layer_out(params, x)),
+                               np.asarray(ref_out(params, x)),
+                               rtol=1e-6, atol=1e-6)
+    g1 = jax.grad(lambda p: jnp.sum(layer_out(p, x) ** 2))(params)
+    g2 = jax.grad(lambda p: jnp.sum(ref_out(p, x) ** 2))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("ltype,group", [("Convolution", 2),
+                                         ("Convolution", 8),
+                                         ("Deconvolution", 2)])
+def test_group_split_and_fgc_paths_agree(monkeypatch, ltype, group):
+    """Both grouped-conv lowerings (per-group split+concat under
+    _GROUP_SPLIT_MAX, feature_group_count above) must agree in values
+    and gradients — for Deconvolution too, which shares the slow-path
+    fix."""
+    from rram_caffe_simulation_tpu.ops import vision
+    npar = pb.NetParameter()
+    text_format.Parse(f"""
+name: "G"
+layer {{ name: "x" type: "Input" top: "x"
+  input_param {{ shape {{ dim: 2 dim: {2 * group} dim: 7 dim: 7 }} }} }}
+layer {{ name: "c" type: "{ltype}" bottom: "x" top: "y"
+  convolution_param {{ num_output: {2 * group} kernel_size: 3
+    group: {group} pad: 1 stride: 2
+    weight_filler {{ type: "xavier" }} }} }}
+""", npar)
+    net = Net(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 2 * group, 7, 7), jnp.float32)
+
+    def loss(p):
+        blobs, _ = net.apply(p, {"x": x})
+        return jnp.sum(blobs["y"] ** 2)
+
+    outs = {}
+    for cap in (0, 64):          # 0 forces fgc; 64 forces the split
+        monkeypatch.setattr(vision, "_GROUP_SPLIT_MAX", cap)
+        blobs, _ = net.apply(params, {"x": x})
+        g = jax.grad(loss)(params)
+        outs[cap] = (np.asarray(blobs["y"]),
+                     [np.asarray(a) for a in jax.tree.leaves(g)])
+    np.testing.assert_allclose(outs[0][0], outs[64][0],
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(outs[0][1], outs[64][1]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
