@@ -103,6 +103,11 @@ def main():
             "steps_timed": STEPS, "batch": BATCH, "chunk": CHUNK,
             "n_configs": N_CONFIGS, "chips": n_chips,
             "seconds": round(dt, 3),
+            # companion measurements live in-repo (ImageNet-class
+            # training rows, the measured 1000-config north star):
+            "see_also": ["RESULTS.md", "examples/bench_train.py",
+                         "examples/gaussian_failure/logs/"
+                         "sweep_1000_measured.log"],
         },
     }))
 

@@ -75,17 +75,20 @@ n.relu1 = L.ReLU(n.ip1, in_place=True)
 n.ip2 = L.InnerProduct(n.relu1, num_output=10,
                        weight_filler=dict(type='xavier'))
 n.loss = L.SoftmaxWithLoss(n.ip2, n.label)
-open('/tmp/lenet_auto.prototxt', 'w').write(str(n.to_proto()))
+import tempfile
+workdir = tempfile.mkdtemp(prefix='lenet_nb_')
+proto_path = os.path.join(workdir, 'lenet_auto.prototxt')
+open(proto_path, 'w').write(str(n.to_proto()))
 """),
     code("""
 from rram_caffe_simulation_tpu.proto import pb
 from rram_caffe_simulation_tpu.solver import Solver
 
 sp = pb.SolverParameter()
-sp.net = '/tmp/lenet_auto.prototxt'
+sp.net = proto_path
 sp.base_lr = 0.1; sp.momentum = 0.9; sp.lr_policy = 'fixed'
 sp.max_iter = 200; sp.display = 50; sp.random_seed = 0
-sp.snapshot_prefix = '/tmp/lenet_auto'
+sp.snapshot_prefix = os.path.join(workdir, 'lenet_auto')
 
 rng = np.random.RandomState(0)
 def feed():

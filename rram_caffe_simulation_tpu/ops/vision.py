@@ -87,6 +87,18 @@ class _BaseConv(Layer):
 _GROUP_SPLIT_MAX = 4
 
 
+def _grouped_conv(conv, x, w, group):
+    """Apply `conv(x, w)` with Caffe group semantics: unrolled
+    per-group convs + concat under _GROUP_SPLIT_MAX, XLA
+    feature_group_count beyond."""
+    if 1 < group <= _GROUP_SPLIT_MAX:
+        xs = jnp.split(x, group, axis=1)
+        ws = jnp.split(w, group, axis=0)
+        return jnp.concatenate(
+            [conv(a, b) for a, b in zip(xs, ws)], axis=1)
+    return conv(x, w, feature_group_count=group)
+
+
 @register_layer("Convolution")
 class ConvolutionLayer(_BaseConv):
     """reference conv_layer.cpp + base_conv_layer.cpp (im2col+GEMM with
@@ -102,12 +114,7 @@ class ConvolutionLayer(_BaseConv):
             rhs_dilation=self.dilation,
             dimension_numbers=DIMNUMS_2D,
             preferred_element_type=x.dtype)
-        if 1 < self.group <= _GROUP_SPLIT_MAX:
-            xs = jnp.split(x, self.group, axis=1)
-            ws = jnp.split(w, self.group, axis=0)
-            return jnp.concatenate(
-                [conv(a, b) for a, b in zip(xs, ws)], axis=1)
-        return conv(x, w, feature_group_count=self.group)
+        return _grouped_conv(conv, x, w, self.group)
 
     def apply(self, params, bottoms, ctx):
         # Shared filters applied to each bottom independently
@@ -150,15 +157,7 @@ class DeconvolutionLayer(_BaseConv):
             rhs_dilation=self.dilation,
             dimension_numbers=DIMNUMS_2D,
             preferred_element_type=x.dtype)
-        if 1 < self.group <= _GROUP_SPLIT_MAX:
-            # same grouped weight-gradient slow path as ConvolutionLayer
-            # (see _GROUP_SPLIT_MAX)
-            xs = jnp.split(x, self.group, axis=1)
-            ws = jnp.split(w, self.group, axis=0)
-            y = jnp.concatenate(
-                [conv(a, b) for a, b in zip(xs, ws)], axis=1)
-        else:
-            y = conv(x, w, feature_group_count=self.group)
+        y = _grouped_conv(conv, x, w, self.group)
         if self.bias_term:
             y = y + params[1].reshape((1, -1) + (1,) * (y.ndim - 2))
         return [y], None

@@ -177,6 +177,8 @@ def test_notebooks_execute(name):
     """The generated tutorial notebooks (reference .ipynb parity) must
     actually run: execute every code cell in order from the repo root."""
     import json
+    if name == "01-learning-lenet":
+        pytest.importorskip("sklearn")   # extras dep (load_digits)
     cwd = os.getcwd()
     os.chdir(REPO)
     try:
