@@ -29,9 +29,6 @@ sys.path.insert(0, REPO)
 BASELINE_IMG_S = 267.0  # reference: CaffeNet+cuDNN on K40
 
 BATCH = 100          # matches the fault engine's per-write decrement
-# 256 simultaneous configs saturates the MXU best (see RESULTS.md sweep
-# table: img/s/chip grows to a plateau at 256)
-N_CONFIGS = int(os.environ.get("BENCH_CONFIGS", "256"))
 CHUNK = int(os.environ.get("BENCH_CHUNK", "20"))
 # forward/backward compute dtype. Default bfloat16 — the MXU-native
 # mixed precision (f32 masters, f32 updates/momentum, f32 fault state;
@@ -41,6 +38,13 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", "20"))
 # the throughput. BENCH_DTYPE="" reverts to full f32, the reference's
 # arithmetic.
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16") or None
+# simultaneous configs: the img/s plateau starts ~256 (RESULTS.md sweep
+# table) and half-width dtypes leave HBM room for 512 resident configs
+# (~+2%, measured r3); 4-byte state at 512 would exceed the 15.75 GB
+# budget, so full-precision runs stay at 256.
+N_CONFIGS = int(os.environ.get(
+    "BENCH_CONFIGS",
+    "512" if DTYPE in ("bfloat16", "float16") else "256"))
 # timed steps must be a chunk multiple or the trailing partial chunk
 # compiles a second jit INSIDE the timed window
 STEPS = max(int(os.environ.get("BENCH_STEPS", "100")) // CHUNK, 1) * CHUNK
