@@ -25,12 +25,15 @@ from __future__ import annotations
 import argparse
 import base64
 import html
+import http.client
 import io
+import ipaddress
 import os
+import socket
+import ssl
 import sys
 import time
 import urllib.parse
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -39,6 +42,108 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 sys.path.insert(0, REPO)
 
 ALLOWED_EXT = {"png", "jpg", "jpeg", "bmp", "gif"}
+
+MAX_FETCH_BYTES = 10 * 1024 * 1024
+# tests / local dev only (--allow-private-urls): permit loopback targets
+ALLOW_PRIVATE = False
+
+
+def _host_is_public(hostname) -> bool:
+    """Every address the name resolves to must be globally routable —
+    otherwise the demo is an SSRF proxy into the host's network
+    (cloud metadata at 169.254.169.254, intranet services, localhost)."""
+    try:
+        _resolve_pinned(hostname)
+        return True
+    except ValueError:
+        return False
+
+
+def _resolve_pinned(hostname) -> str:
+    """Resolve ONCE, validate every returned address, and return the one
+    IP the connection will actually use — connecting by name would let a
+    TTL-0 DNS rebind swap a public answer for 169.254.169.254 between
+    the check and the connect."""
+    if not hostname:
+        raise ValueError("empty host")
+    try:
+        infos = socket.getaddrinfo(hostname, None, type=socket.SOCK_STREAM)
+    except OSError:
+        raise ValueError(f"cannot resolve {hostname!r}")
+    if not infos:
+        raise ValueError(f"cannot resolve {hostname!r}")
+    addrs = []
+    for info in infos:
+        ip = ipaddress.ip_address(info[4][0])
+        if not ip.is_global and not ALLOW_PRIVATE:
+            raise ValueError(f"non-public address for {hostname!r}")
+        addrs.append(str(ip))
+    # prefer IPv4: the demo host may lack a v6 route
+    v4 = [a for a in addrs if ":" not in a]
+    return (v4 or addrs)[0]
+
+
+class _PinnedHTTPSConnection(http.client.HTTPSConnection):
+    """HTTPSConnection that dials a pre-validated IP while doing SNI and
+    certificate verification against the original hostname."""
+
+    def __init__(self, ip, port, server_hostname, **kw):
+        super().__init__(ip, port, **kw)
+        self._server_hostname = server_hostname
+
+    def connect(self):
+        sock = socket.create_connection((self.host, self.port),
+                                        self.timeout)
+        self.sock = self._context.wrap_socket(
+            sock, server_hostname=self._server_hostname)
+
+
+def fetch_image_url(target: str, timeout: float = 10,
+                    max_redirects: int = 5) -> bytes:
+    """http(s)-only, public-address-only, size-capped fetch of a
+    user-supplied image URL. Each hop (including every redirect) is
+    resolved once and dialed by the validated IP with the Host header /
+    TLS SNI pinned to the URL's hostname, so DNS rebinding between
+    check and connect cannot redirect the fetch."""
+    for _ in range(max_redirects + 1):
+        parsed = urllib.parse.urlparse(target)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError("non-http(s) URL")
+        host = parsed.hostname
+        ip = _resolve_pinned(host)
+        port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        if parsed.scheme == "https":
+            conn = _PinnedHTTPSConnection(
+                ip, port, server_hostname=host, timeout=timeout,
+                context=ssl.create_default_context())
+        else:
+            conn = http.client.HTTPConnection(ip, port, timeout=timeout)
+        try:
+            path = parsed.path or "/"
+            if parsed.query:
+                path += "?" + parsed.query
+            host_hdr = f"[{host}]" if ":" in host else host
+            default = 443 if parsed.scheme == "https" else 80
+            hdr_host = host_hdr if port == default else \
+                f"{host_hdr}:{port}"
+            conn.request("GET", path, headers={"Host": hdr_host,
+                                               "User-Agent": "webdemo"})
+            resp = conn.getresponse()
+            if resp.status in (301, 302, 303, 307, 308):
+                loc = resp.getheader("Location")
+                if not loc:
+                    raise ValueError("redirect without Location")
+                target = urllib.parse.urljoin(target, loc)
+                continue
+            if resp.status != 200:
+                raise ValueError(f"HTTP {resp.status}")
+            data = resp.read(MAX_FETCH_BYTES + 1)
+            if len(data) > MAX_FETCH_BYTES:
+                raise ValueError("response too large")
+            return data
+        finally:
+            conn.close()
+    raise ValueError("too many redirects")
 
 PAGE = """<!doctype html>
 <html><head><title>rram-caffe-simulation-tpu demo</title></head>
@@ -196,15 +301,8 @@ def make_server(clf: DemoClassifier, port: int = 5000,
             if url.path == "/classify_url":
                 q = urllib.parse.parse_qs(url.query)
                 target = (q.get("imageurl") or [""])[0]
-                # http(s) only: file:// etc. would let a remote caller
-                # probe local files through the demo (SSRF).
-                if urllib.parse.urlparse(target).scheme not in ("http",
-                                                                "https"):
-                    return self._page(
-                        banner="<p><b>Cannot open that URL.</b></p>")
                 try:
-                    with urllib.request.urlopen(target, timeout=10) as r:
-                        data = r.read()
+                    data = fetch_image_url(target)
                 except Exception:
                     return self._page(
                         banner="<p><b>Cannot open that URL.</b></p>")
@@ -246,7 +344,13 @@ def main(argv=None):
     p.add_argument("--image-dim", type=int, default=256)
     p.add_argument("--raw-scale", type=float, default=255.0)
     p.add_argument("--port", type=int, default=5000)
+    p.add_argument("--allow-private-urls", action="store_true",
+                   help="permit classify_url fetches from loopback/"
+                        "private addresses (local development only)")
     args = p.parse_args(argv)
+    if args.allow_private_urls:
+        global ALLOW_PRIVATE
+        ALLOW_PRIVATE = True
     clf = DemoClassifier(args.model_def, args.pretrained_model,
                          labels_file=args.labels or None,
                          mean_file=args.mean_file or None,

@@ -254,15 +254,32 @@ def decode_ppm(data: bytes) -> np.ndarray:
     width, height, maxval = vals
     n = width * height * channels
     if magic in (b"P5", b"P6"):
-        body_off = 2 + end + 1               # single whitespace after maxval
+        # exactly one whitespace char terminates the header, but writers
+        # on Windows emit \r\n — treat that pair as the single terminator
+        # UNLESS the payload length says the \n is really the first pixel
+        # byte (lone-\r terminator + pixel value 0x0A). With trailing
+        # slack after the raster the two readings are indistinguishable;
+        # the CRLF reading wins (lone-\r headers are vanishingly rare)
+        body_off = 2 + end + 1
+        nbytes = n * (2 if maxval > 255 else 1)
+        if data[2 + end:2 + end + 2] == b"\r\n" \
+                and len(data) - body_off != nbytes:
+            body_off += 1
         if maxval > 255:
             img = np.frombuffer(data, ">u2", n, body_off)
             img = (img >> 8).astype(np.uint8)
         else:
             img = np.frombuffer(data, np.uint8, n, body_off)
     else:
-        ascii_vals = data[2 + end:].split()
-        img = np.array([int(v) for v in ascii_vals[:n]], np.uint32)
+        # keep tokenizing so body-side comments are skipped like header ones
+        body = []
+        for tok, _ in toks:
+            if not tok:
+                break
+            body.append(int(tok))
+            if len(body) == n:
+                break
+        img = np.array(body[:n], np.uint32)
         if maxval != 255:
             img = img * 255 // maxval
         img = img.astype(np.uint8)

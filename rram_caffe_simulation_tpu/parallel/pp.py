@@ -146,6 +146,10 @@ def _rebatch_net(net, n_micro: int):
         if lp.type == "DummyData":
             for shp in lp.dummy_data_param.shape:
                 if shp.dim:
+                    if shp.dim[0] % n_micro:
+                        raise ValueError(
+                            f"DummyData batch {shp.dim[0]} not divisible "
+                            f"by n_micro {n_micro}")
                     shp.dim[0] //= n_micro
     return CoreNet(proto, net.phase)
 
@@ -203,6 +207,22 @@ class NetPipeline:
         net = self.net
         self.stages = partition_net(net, self.n_stage)
         names_by_stage = [set(st.layer_names) for st in self.stages]
+        # the scan keeps loss only from the tail stage; a loss blob
+        # produced earlier (possible for multi-loss nets — an auxiliary
+        # loss top is never consumed downstream, so it never blocks a
+        # cut) would silently vanish from the objective AND its gradient
+        loss_blobs = {b for b, w in net.loss_weights.items() if w}
+        for s, names in enumerate(names_by_stage[:-1]):
+            produced = {t for l in net.layers if l.name in names
+                        for t in l.lp.top}
+            dropped = sorted(loss_blobs & produced)
+            if dropped:
+                raise ValueError(
+                    f"loss blob(s) {dropped} are produced by pipeline "
+                    f"stage {s}, not the tail stage: their loss and "
+                    "gradient contribution would be silently dropped. "
+                    "Use fewer stages or reorder the prototxt so every "
+                    "loss layer lands in the final stage.")
         # no cross-stage parameter sharing: a sharer's owner row lives on
         # another device and could not be packed consistently
         for l in net.layers:

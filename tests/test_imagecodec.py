@@ -137,6 +137,30 @@ def test_ppm_ascii_with_comments():
                        [[0, 0, 255], [9, 9, 9]]], np.uint8))
 
 
+def test_ppm_crlf_header():
+    """A CRLF-terminated binary header must not shift the payload."""
+    arr = _rand(4, 3, 3, seed=11)
+    data = b"P6\r\n3 4\r\n255\r\n" + arr.tobytes()
+    np.testing.assert_array_equal(ic.decode_ppm(data), arr)
+
+
+def test_ppm_lone_cr_header_with_0x0a_pixel():
+    """A lone-\\r terminator whose first pixel byte is 0x0A must keep
+    that byte: payload length disambiguates the \\r\\n heuristic."""
+    arr = _rand(4, 3, 3, seed=12)
+    arr[0, 0, 0] = 0x0A
+    data = b"P6\r3 4\r255\r" + arr.tobytes()
+    np.testing.assert_array_equal(ic.decode_ppm(data), arr)
+
+
+def test_ppm_ascii_comment_in_body():
+    """P2/P3 comments after the header are whitespace, not pixel data."""
+    data = (b"P2\n2 2\n255\n10 20\n# mid-body comment\n30 40\n")
+    out = ic.decode_ppm(data)
+    np.testing.assert_array_equal(
+        out[:, :, 0], np.array([[10, 20], [30, 40]], np.uint8))
+
+
 # ------------------------------------------------------------- resize
 
 def test_resize_constant_exact():

@@ -410,3 +410,71 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc" bottom: "y" }
     with pytest.raises(ValueError, match="host-fed"):
         s.enable_pipeline_parallel(
             mesh=make_mesh({"stage": 2}, devices=jax.devices()[:2]))
+
+
+MULTILOSS_NET = """
+name: "AuxLossNet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 8 dim: 1 dim: 8 dim: 8 } } }
+layer { name: "labelin" type: "Input" top: "label"
+  input_param { shape { dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 2 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "fc_a" type: "InnerProduct" bottom: "conv1" top: "fc_a"
+  inner_product_param { num_output: 256
+    weight_filler { type: "xavier" } } }
+layer { name: "auxloss" type: "SoftmaxWithLoss" bottom: "fc_a"
+  bottom: "label" top: "auxloss" loss_weight: 0.3 }
+layer { name: "fc_b" type: "InnerProduct" bottom: "conv1" top: "fc_b"
+  inner_product_param { num_output: 256
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc_b"
+  bottom: "label" }
+"""
+
+
+def test_pipeline_rejects_non_tail_loss(tmp_path):
+    """A multi-loss net whose auxiliary loss lands in a non-tail stage
+    (its top is never consumed downstream, so it never blocks a cut)
+    must raise instead of silently dropping that loss term from the
+    objective and its gradient."""
+    sp = pb.SolverParameter()
+    text_format.Parse(MULTILOSS_NET, sp.net_param)
+    sp.base_lr = 0.01
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10
+    sp.display = 0
+    sp.snapshot_prefix = str(tmp_path / "aux")
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 1, 8, 8).astype(np.float32)
+    label = rng.randint(0, 3, (8,)).astype(np.float32)
+    s = Solver(sp, train_feed=lambda: {"data": data, "label": label})
+    # the flop-balanced 2-stage cut of this net falls after auxloss
+    # (boundaries after fc_a are blocked by {conv1, fc_a} crossing)
+    with pytest.raises(ValueError, match="loss blob"):
+        s.enable_pipeline_parallel(
+            mesh=make_mesh({"stage": 2}, devices=jax.devices()[:2]))
+
+
+def test_rebatch_rejects_indivisible_dummydata():
+    """_rebatch_net applies the same divisibility contract to DummyData
+    shapes as to Input/data_param batch sizes."""
+    from rram_caffe_simulation_tpu.net import Net as CoreNet
+    from rram_caffe_simulation_tpu.parallel.pp import _rebatch_net
+    from google.protobuf import text_format as tf
+    npar = pb.NetParameter()
+    tf.Parse("""
+layer { name: "in" type: "Input" top: "x"
+  input_param { shape { dim: 8 dim: 4 } } }
+layer { name: "noise" type: "DummyData" top: "n"
+  dummy_data_param { shape { dim: 6 dim: 4 }
+    data_filler { type: "gaussian" } } }
+layer { name: "lossx" type: "Reduction" bottom: "x" top: "rx"
+  loss_weight: 1.0 }
+layer { name: "lossn" type: "Reduction" bottom: "n" top: "rn"
+  loss_weight: 1.0 }
+""", npar)
+    net = CoreNet(npar, pb.TRAIN)
+    with pytest.raises(ValueError, match="DummyData batch 6"):
+        _rebatch_net(net, 4)

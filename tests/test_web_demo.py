@@ -116,11 +116,14 @@ def test_classify_decoded_bytes(demo_server):
     assert b64
 
 
-def test_classify_http_url(demo_server):
+def test_classify_http_url(demo_server, monkeypatch):
     """The full /classify_url path over http: fetch -> decode ->
-    classify, plus the urlopen-failure banner on a dead port."""
+    classify, plus the urlopen-failure banner on a dead port. The
+    image server lives on loopback, so the private-address SSRF guard
+    is relaxed for this test (the --allow-private-urls dev mode)."""
     import http.server
     base, _, _ = demo_server
+    monkeypatch.setattr(web_app, "ALLOW_PRIVATE", True)
     png = _png_bytes(seed=5)
 
     class ImgHandler(http.server.BaseHTTPRequestHandler):
@@ -171,6 +174,49 @@ def test_bad_url_banner(demo_server):
         base + "/classify_url?imageurl=notascheme://nowhere/x.png")
     assert status == 200
     assert "Cannot open that URL" in body
+
+
+def test_private_targets_rejected():
+    """The SSRF guard rejects loopback/link-local/private and
+    unresolvable hosts by default (ALLOW_PRIVATE is False outside the
+    dev flag), including the cloud metadata address."""
+    assert web_app.ALLOW_PRIVATE is False
+    for host in ("127.0.0.1", "localhost", "169.254.169.254",
+                 "10.0.0.7", "192.168.1.1", "::1",
+                 "no-such-host.invalid", ""):
+        assert not web_app._host_is_public(host), host
+    for url in ("http://169.254.169.254/latest/meta-data/",
+                "http://127.0.0.1:8080/x.png"):
+        with pytest.raises(ValueError):
+            web_app.fetch_image_url(url)
+
+
+def test_fetch_size_cap(monkeypatch):
+    """An over-sized response raises instead of buffering unbounded."""
+    import http.server
+    big = b"x" * (web_app.MAX_FETCH_BYTES + 4096)
+
+    class BigHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(big)))
+            self.end_headers()
+            self.wfile.write(big)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BigHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setattr(web_app, "ALLOW_PRIVATE", True)
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/big"
+        with pytest.raises(ValueError, match="too large"):
+            web_app.fetch_image_url(url)
+    finally:
+        srv.shutdown()
+        srv.server_close()
 
 
 def test_parse_multipart_preserves_trailing_bytes():
