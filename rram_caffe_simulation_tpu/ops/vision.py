@@ -205,9 +205,14 @@ class PoolingLayer(Layer):
         (pl0, ph0), (pl1, ph1) = self.xla_pad
         apad = jnp.pad(a, ((0, 0), (0, 0), (pl0, ph0), (pl1, ph1)),
                        constant_values=pad_value)
+        # HIGHEST: the one-hot extraction conv must reproduce values
+        # bit-exactly (the mask path matches on equality; stochastic
+        # pooling emits these values) — TPU's default MXU precision
+        # rounds f32 operands through bf16
         p = lax.conv_general_dilated_patches(
             apad, filter_shape=self.kernel, window_strides=self.stride,
-            padding=[(0, 0), (0, 0)], dimension_numbers=DIMNUMS_2D)
+            padding=[(0, 0), (0, 0)], dimension_numbers=DIMNUMS_2D,
+            precision=lax.Precision.HIGHEST)
         n_, _, oh, ow = p.shape
         return p.reshape(n_, a.shape[1], self.kernel[0] * self.kernel[1],
                          oh, ow)
@@ -229,11 +234,17 @@ class PoolingLayer(Layer):
                 h, w = self.in_hw
                 idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
                 idx = jnp.broadcast_to(idx, x.shape)
-                xp = self._patches(x, -jnp.inf)
+                # finite pad: patches extract via a 0/1 conv, and
+                # -inf * 0 = NaN would poison every window touching the
+                # CEIL/pad fringe (equality match below never fires);
+                # `ip >= 0` keeps a data value equal to finfo.min from
+                # matching a pad slot. Mask stays f32: indices above the
+                # mantissa range would round under bf16/f16 activations.
+                xp = self._patches(x, jnp.finfo(x.dtype).min)
                 ip = self._patches(idx, -1.0)
-                sel = jnp.argmax(xp == y[:, :, None], axis=2)
+                sel = jnp.argmax((xp == y[:, :, None]) & (ip >= 0), axis=2)
                 mask = jnp.take_along_axis(
-                    ip, sel[:, :, None], axis=2).squeeze(2).astype(x.dtype)
+                    ip, sel[:, :, None], axis=2).squeeze(2)
                 tops.append(mask)
             return tops, None
         elif self.method == pb.PoolingParameter.AVE:
