@@ -1,0 +1,144 @@
+"""Per-HLO device-time profile of one fused train step on real TPU.
+
+Captures a jax.profiler trace around Solver.step_fused on a zoo
+train_val graph (Data swapped for DummyData, like bench_train.py) and
+aggregates the device events: time by HLO category, top ops by total
+device time with achieved FLOP/s and HBM bandwidth. This is the
+profile-backed MFU attribution the RESULTS.md table rows point at.
+
+    python examples/profile_train.py \
+        --model models/bvlc_googlenet/train_val.prototxt \
+        --batch 128 --compute-dtype bfloat16
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..")
+sys.path.insert(0, REPO)
+
+from bench_train import dummyize  # noqa: E402
+
+
+def capture(args):
+    os.chdir(REPO)
+    import jax
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.utils.io import read_net_param
+
+    netp = dummyize(read_net_param(args.model), args.batch)
+    sp = pb.SolverParameter()
+    sp.net_param.CopyFrom(netp)
+    sp.base_lr = 0.001
+    sp.momentum = 0.9
+    sp.weight_decay = 0.0005
+    sp.lr_policy = "fixed"
+    sp.type = "SGD"
+    sp.max_iter = 10 ** 9
+    sp.display = 0
+    sp.random_seed = 7
+    solver = Solver(sp, compute_dtype=args.compute_dtype or None)
+    # compile + warmup outside the trace. --no-scan profiles the plain
+    # per-iteration step: the fused path wraps the same body in a scan
+    # `while`, which the trace reports as one opaque event.
+    step = ((lambda n: solver.step(n)) if args.no_scan
+            else (lambda n: solver.step_fused(n, chunk=n)))
+    step(args.chunk)
+    jax.block_until_ready(jax.tree.leaves(solver.params))
+    tracedir = tempfile.mkdtemp(prefix="train_profile_")
+    with jax.profiler.trace(tracedir):
+        step(args.chunk)
+        jax.block_until_ready(jax.tree.leaves(solver.params))
+    files = sorted(glob.glob(
+        os.path.join(tracedir, "plugins/profile/*/*.trace.json.gz")))
+    assert files, f"no trace under {tracedir}"
+    return files[-1], args.chunk
+
+
+def device_events(trace_file):
+    t = json.load(gzip.open(trace_file))
+    ev = t["traceEvents"]
+    tpu_pids = {e["pid"] for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "TPU" in e["args"].get("name", "")}
+    for e in ev:
+        if e.get("ph") == "X" and e.get("pid") in tpu_pids \
+                and "hlo_category" in e.get("args", {}):
+            yield e
+
+
+def aggregate(trace_file, n_iters, peak_tflops, top=25):
+    by_cat = collections.Counter()
+    by_op = {}
+    total = 0.0
+    for e in device_events(trace_file):
+        a = e["args"]
+        dur = e["dur"]  # us
+        cat = a["hlo_category"]
+        by_cat[cat] += dur
+        total += dur
+        # merge by (base name, category, source op) so distinct fusions
+        # with the generic "fusion.N" name stay distinguishable
+        base = e["name"].rstrip("0123456789").rstrip(".")
+        key = (base, cat, a.get("tf_op", "")[:60])
+        rec = by_op.setdefault(key, dict(
+            dur=0.0, n=0, flops=0, bytes=0, cat=cat,
+            tf_op=a.get("tf_op", ""), long=a.get("long_name", "")[:200]))
+        rec["dur"] += dur
+        rec["n"] += 1
+        rec["flops"] += int(a.get("model_flops", 0) or 0)
+        rec["bytes"] += int(a.get("raw_bytes_accessed", 0) or 0)
+
+    print(f"device total: {total / 1e3:.2f} ms over {n_iters} iters "
+          f"({total / 1e3 / n_iters:.2f} ms/iter)")
+    print("\n-- time by HLO category --")
+    for cat, dur in by_cat.most_common():
+        print(f"  {cat:<28} {dur / 1e3:9.2f} ms  {100 * dur / total:5.1f}%")
+    print(f"\n-- top {top} ops by device time --")
+    print(f"  {'op / source':<58}{'ms':>8}{'%':>6}{'TFLOP/s':>9}"
+          f"{'GB/s':>7}  kind")
+    for key, r in sorted(by_op.items(), key=lambda kv: -kv[1]["dur"])[:top]:
+        tflops = r["flops"] / (r["dur"] * 1e-6) / 1e12 if r["dur"] else 0
+        gbs = r["bytes"] / (r["dur"] * 1e-6) / 1e9 if r["dur"] else 0
+        label = (r["tf_op"].split("/")[-1].rstrip(":") or key[0])[:58]
+        print(f"  {label:<58}{r['dur'] / 1e3:8.2f}"
+              f"{100 * r['dur'] / total:6.1f}{tflops:9.2f}{gbs:7.0f}"
+              f"  {r['cat']}")
+    mxu = sum(d for c, d in by_cat.items() if "convolution" in c)
+    print(f"\nconvolution-category time: {100 * mxu / total:.1f}% of device"
+          f" — everything else is MXU-idle overhead")
+    return by_cat, by_op, total
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", required=True)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--chunk", type=int, default=5)
+    p.add_argument("--compute-dtype", default="bfloat16")
+    p.add_argument("--peak-tflops", type=float, default=197.0)
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--no-scan", action="store_true",
+                   help="profile Solver.step instead of step_fused "
+                        "(breaks the scan `while` out into its body ops)")
+    p.add_argument("--trace", default="",
+                   help="parse an existing trace.json.gz instead of "
+                        "capturing")
+    args = p.parse_args(argv)
+    if args.trace:
+        trace_file, n = args.trace, args.chunk
+    else:
+        trace_file, n = capture(args)
+        print(f"trace: {trace_file}")
+    aggregate(trace_file, n, args.peak_tflops, args.top)
+
+
+if __name__ == "__main__":
+    main()
