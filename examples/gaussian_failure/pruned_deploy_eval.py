@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""The remapping strategy in its designed-for setting: PRUNED deployment.
+
+The fork's remapping thesis (RemappingFailureStrategy, reference
+strategy.cpp:89-137 + usage.md workflow): during training, periodically
+park the most-PRUNABLE logical neurons (per a magnitude-prune ranking)
+on the most-BROKEN physical rows, so the important sub-network trains on
+healthy cells. The payoff is not dense accuracy — RESULTS.md shows
+remapping losing densely, because the sacrificial neurons keep injecting
+stuck-cell garbage — it is the *pruned deployment*: remove the prunable
+neurons at deploy time and the parked corruption leaves with them.
+
+This script measures exactly that, end to end on the LeNet/digits task
+at the r3 operating point (lifetimes N(3e5, 8e4), stuck prob 5/90/5):
+
+  1. train unmitigated and remapped runs side by side;
+  2. deploy both PRUNED: zero the K most-prunable logical neurons —
+     for the unmitigated run those are the prune_order tail rows (the
+     physical layout never moved); for the remapped run they sit, by
+     the strategy's permutation invariant, on the most-broken physical
+     slots (sort_fc_neurons of the final fault state);
+  3. report dense vs pruned test accuracy for both.
+
+    python examples/gaussian_failure/pruned_deploy_eval.py \
+        [--iters 3000] [--prune-k 300]
+"""
+import argparse
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, ROOT)
+
+
+def build_solver(args, remapping: bool, tmp_tag: str,
+                 tracked: bool = False):
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    param = pb.SolverParameter()
+    with open(os.path.join(ROOT, "models/lenet/"
+                           "lenet_digits_solver.prototxt")) as f:
+        text_format.Merge(f.read(), param)
+    param.net = "models/lenet/lenet_train_test_lmdb.prototxt"
+    param.max_iter = args.iters
+    param.display = 500
+    param.test_interval = 10 ** 9          # eval is explicit, below
+    param.snapshot = 0
+    param.random_seed = 11
+    param.snapshot_prefix = os.path.join(
+        args.out, f"pruned_deploy_{tmp_tag}")
+    fp = param.failure_pattern
+    fp.type = "gaussian"
+    fp.mean = args.mean
+    fp.std = args.std
+    fp.failure_prob.neg = 5
+    fp.failure_prob.zero = 90
+    fp.failure_prob.pos = 5
+    if remapping:
+        st = param.failure_strategy.add()
+        st.type = "remapping"
+        st.start = 0
+        st.period = 100
+        st.prune_order_file = os.path.join(HERE, "prune_order_lenet.txt")
+        st.track_identity = tracked
+    return Solver(param)
+
+
+def prune_hidden(params, fc_pairs, slots):
+    """Deploy-time removal of hidden neurons `slots` of the (single)
+    LeNet hidden FC group: zero ip1 rows + bias and ip2 columns —
+    exactly what instantiating the pruned sub-network does."""
+    out = {ln: list(v) for ln, v in params.items()}
+    (w1, b1), (w2, _) = fc_pairs
+    l1, s1 = w1.rsplit("/", 1)
+    l2, s2 = w2.rsplit("/", 1)
+    w = np.array(out[l1][int(s1)])
+    w[slots, :] = 0.0
+    out[l1][int(s1)] = w
+    if b1 is not None:
+        lb, sb = b1.rsplit("/", 1)
+        b = np.array(out[lb][int(sb)])
+        b[slots] = 0.0
+        out[lb][int(sb)] = b
+    v = np.array(out[l2][int(s2)])
+    v[:, slots] = 0.0
+    out[l2][int(s2)] = v
+    return out
+
+
+def test_accuracy(solver, params) -> float:
+    saved = solver.params
+    try:
+        solver.params = params
+        scores = solver.test(0)
+    finally:
+        solver.params = saved
+    for name, val in scores.items():
+        if "accuracy" in name.lower() or name == "accuracy":
+            return float(np.ravel(val)[0])
+    raise KeyError(f"no accuracy output in {list(scores)}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=3000,
+                   help="~50%% of cells break by 3000 at the 3e5/8e4 "
+                        "operating point (decrement 100/write)")
+    p.add_argument("--mean", type=float, default=3e5)
+    p.add_argument("--std", type=float, default=8e4)
+    p.add_argument("--prune-k", type=int, default=300,
+                   help="hidden neurons pruned at deployment (of 500; "
+                        "300 = the 0.6 prune ratio of the ordering file)")
+    p.add_argument("--out", default=os.path.join(HERE, "logs"))
+    args = p.parse_args(argv)
+
+    os.chdir(ROOT)
+    os.makedirs(args.out, exist_ok=True)
+    from rram_caffe_simulation_tpu.fault.strategies import sort_fc_neurons
+
+    prune_order = np.loadtxt(
+        os.path.join(HERE, "prune_order_lenet.txt"), dtype=int)
+    K = args.prune_k
+    logical_prunable = prune_order[-K:]     # most-prunable tail
+
+    rows = {}
+    for tag, remap, tracked in (("unmitigated", False, False),
+                                ("remapping", True, False),
+                                ("remapping_tracked", True, True)):
+        solver = build_solver(args, remapping=remap, tmp_tag=tag,
+                              tracked=tracked)
+        solver.step_fused(args.iters, chunk=100)
+        dense = test_accuracy(solver, solver.params)
+
+        if tracked:
+            # the slot map says exactly where each logical neuron lives
+            sol = np.asarray(solver.fault_state["remap_slots"]["0"])
+            slots = sol[logical_prunable]
+        elif remap:
+            # reference semantics: the strategy claims to park the
+            # prunable logical tail on the most-broken physical slots;
+            # deployment prunes there
+            order = np.asarray(sort_fc_neurons(
+                solver.fault_state, [w for w, _ in solver.fc_pairs])[0])
+            slots = order[-K:]
+        else:
+            slots = logical_prunable        # layout never moved
+        pruned_params = prune_hidden(solver.params, solver.fc_pairs,
+                                     slots)
+        pruned = test_accuracy(solver, pruned_params)
+
+        # most charitable deployment: magnitude-prune 60% of ip1 CELLS
+        # of the run's OWN final weights (stuck-0 cells self-select into
+        # the pruned set; this is the per-cell analogue of the thesis)
+        w1key = solver.fc_pairs[0][0]
+        l1, s1 = w1key.rsplit("/", 1)
+        cellp = {ln: list(v) for ln, v in solver.params.items()}
+        w = np.array(cellp[l1][int(s1)])
+        thresh = np.quantile(np.abs(w), 0.6)
+        w[np.abs(w) <= thresh] = 0.0
+        cellp[l1][int(s1)] = w
+        cell_pruned = test_accuracy(solver, cellp)
+
+        life = np.asarray(
+            solver.fault_state["lifetimes"][solver.fc_pairs[0][0]])
+        broken_frac = float((life <= 0).mean())
+        rows[tag] = {"dense": round(dense, 4),
+                     "pruned": round(pruned, 4),
+                     "cell_pruned": round(cell_pruned, 4),
+                     "ip1_broken_frac": round(broken_frac, 3)}
+        print(f"{tag}: dense {dense:.4f}  pruned-deploy {pruned:.4f}  "
+              f"cell-pruned {cell_pruned:.4f}  "
+              f"(ip1 broken {broken_frac:.1%})", flush=True)
+
+    rec = {"iters": args.iters, "mean": args.mean, "std": args.std,
+           "prune_k": K, **rows}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+if __name__ == "__main__":
+    main()

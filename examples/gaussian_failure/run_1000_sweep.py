@@ -28,8 +28,15 @@ sys.path.insert(0, REPO)
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--configs", type=int, default=1000)
-    p.add_argument("--group", type=int, default=500,
-                   help="configs resident per runner (HBM-bound)")
+    p.add_argument("--group", type=int, default=1000,
+                   help="configs resident per runner (with --block, all "
+                        "1000 fit one chip — r4; use 500 with block 0 "
+                        "to reproduce the r3 two-group run)")
+    p.add_argument("--block", type=int, default=250,
+                   help="configs computed per sequential lax.map block "
+                        "inside the step (activation memory scales with "
+                        "the block, resident state with the group); 0 "
+                        "disables blocking")
     p.add_argument("--iters", type=int, default=5000)
     p.add_argument("--chunk", type=int, default=50)
     p.add_argument("--mean", type=float, default=1e8)
@@ -57,7 +64,19 @@ def main(argv=None):
         param.ClearField("test_interval")
         solver = Solver(param, compute_dtype="bfloat16")
         t0 = time.perf_counter()
-        runner = SweepRunner(solver, n_configs=n_cfg)
+        # per-group block: groups at or under the block need no
+        # blocking (they already fit the activation budget); an
+        # indivisible larger remainder falls back to its gcd rather
+        # than crashing after earlier groups burned their wall-clock
+        import math
+        if not args.block or n_cfg <= args.block:
+            block = 0
+        elif n_cfg % args.block == 0:
+            block = args.block
+        else:
+            block = math.gcd(n_cfg, args.block)
+        runner = SweepRunner(solver, n_configs=n_cfg,
+                             config_block=block)
         runner.step(args.iters, chunk=args.chunk)
         broken = runner.broken_fractions()
         dt = time.perf_counter() - t0
@@ -71,6 +90,7 @@ def main(argv=None):
         "iters_per_config": args.iters,
         "batch": 100,
         "groups": groups,
+        "config_block": args.block,
         "wall_minutes_one_chip": round(total_min, 2),
         "configs_per_hour_one_chip": round(args.configs
                                            / (total_min / 60), 1),

@@ -154,10 +154,441 @@ print(f'logreg {acc_lr:.3f}  vs  two-layer ReLU {acc_nn:.3f}')
 ])
 
 
+CLASSIFICATION = nb([
+    md("""# Classifying images with a trained net
+
+Counterpart of the reference's `00-classification.ipynb`: load a net +
+weights into the `Classifier` facade, classify an image, read the top
+predictions, and look inside the net at intermediate blobs. The
+reference downloads CaffeNet weights; this image has no network, so we
+first brew a small classifier on generated images (same API end to
+end)."""),
+    code("""
+import os, sys, tempfile
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from rram_caffe_simulation_tpu import api as caffe
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import io as uio
+from google.protobuf import text_format
+
+workdir = tempfile.mkdtemp(prefix='cls_nb_')
+"""),
+    code("""
+# three synthetic classes distinguished by channel dominance
+rng = np.random.RandomState(0)
+def make_image(cls, n=1):
+    img = rng.rand(n, 3, 24, 24).astype(np.float32) * 0.3
+    img[:, cls] += 0.7
+    return img
+LABELS = ['reddish', 'greenish', 'blueish']
+
+TRAIN_NET = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 32 dim: 3 dim: 24 dim: 24 } } }
+layer { name: "lab" type: "Input" top: "label"
+  input_param { shape { dim: 32 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc"
+  bottom: "label" }
+'''
+sp = pb.SolverParameter()
+text_format.Parse(TRAIN_NET, sp.net_param)
+sp.base_lr = 0.05; sp.momentum = 0.9; sp.lr_policy = 'fixed'
+sp.max_iter = 60; sp.display = 0; sp.random_seed = 1
+sp.snapshot_prefix = os.path.join(workdir, 'cls')
+
+from rram_caffe_simulation_tpu.solver import Solver
+def feed():
+    y = rng.randint(0, 3, 32)
+    return {'data': np.concatenate([make_image(c) for c in y]),
+            'label': y.astype(np.float32)}
+solver = Solver(sp, train_feed=feed)
+solver.step(60)
+weights = os.path.join(workdir, 'cls.caffemodel')
+uio.write_proto_binary(weights,
+                       solver.net.to_proto(solver.params))
+"""),
+    code("""
+# deploy net (Input only) + Classifier facade, reference flow
+DEPLOY = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 24 dim: 24 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 3 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+'''
+deploy = os.path.join(workdir, 'deploy.prototxt')
+open(deploy, 'w').write(DEPLOY)
+clf = caffe.Classifier(deploy, weights, image_dims=(24, 24),
+                       raw_scale=1.0)
+img = make_image(2)[0].transpose(1, 2, 0)  # HWC like caffe.io images
+probs = clf.predict([img], oversample=False)[0]
+for i in np.argsort(-probs):
+    print(f'{LABELS[i]:<9} {probs[i]:.4f}')
+assert probs.argmax() == 2
+"""),
+    code("""
+# look inside the net: blob shapes + conv1 activations, pycaffe-style
+net = caffe.Net(deploy, weights, pb.TEST)
+net.blobs['data'].data[...] = make_image(0)
+net.forward()
+for name, blob in net.blobs.items():
+    print(f'{name:<6} {blob.data.shape}')
+acts = net.blobs['conv1'].data
+print('conv1 activation stats: mean %.3f  max %.3f'
+      % (acts.mean(), acts.max()))
+"""),
+])
+
+
+FINE_TUNING = nb([
+    md("""# Fine-tuning a pretrained net
+
+Counterpart of `02-fine-tuning.ipynb` (CaffeNet -> Flickr style): start
+from weights trained on one task and fine-tune on another, against a
+from-scratch baseline at the same iteration budget — the pretrained
+start learns faster. Tasks: digits 0-4 (pretrain) -> digits 5-9
+(fine-tune), on scikit-learn's bundled handwritten digits."""),
+    code("""
+import os, sys, tempfile
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from sklearn.datasets import load_digits
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.utils import io as uio
+from google.protobuf import text_format
+
+digits = load_digits()
+X = digits.images.astype(np.float32)[:, None] / 16.0
+y = digits.target
+lo = y < 5            # pretraining task
+hi = ~lo              # fine-tuning task (labels shifted to 0..4)
+workdir = tempfile.mkdtemp(prefix='ft_nb_')
+
+NET = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 64 dim: 1 dim: 8 dim: 8 } } }
+layer { name: "lab" type: "Input" top: "label"
+  input_param { shape { dim: 64 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 48
+    weight_filler { type: "xavier" } } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 5
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+  bottom: "label" }
+'''
+
+def make_solver(Xs, ys, seed, weights=None, lr=0.05, iters=80):
+    sp = pb.SolverParameter()
+    text_format.Parse(NET, sp.net_param)
+    sp.base_lr = lr; sp.momentum = 0.9; sp.lr_policy = 'fixed'
+    sp.max_iter = iters; sp.display = 0; sp.random_seed = seed
+    sp.snapshot_prefix = os.path.join(workdir, f's{seed}')
+    rng = np.random.RandomState(seed)
+    def feed():
+        idx = rng.randint(0, len(Xs), 64)
+        return {'data': Xs[idx], 'label': ys[idx].astype(np.float32)}
+    s = Solver(sp, train_feed=feed)
+    if weights:
+        # name-matched weight loading, the CLI --weights flow
+        s.params = s.net.copy_trained_from(s.params, weights)
+    return s
+
+def accuracy(s, Xs, ys):
+    correct = 0
+    for i in range(0, 256, 64):
+        blobs, _ = s.net.apply(
+            s.params, {'data': Xs[i:i+64],
+                       'label': ys[i:i+64].astype(np.float32)})
+        correct += (np.asarray(blobs['ip2']).argmax(1)
+                    == ys[i:i+64]).sum()
+    return correct / 256
+"""),
+    code("""
+# 1) pretrain on digits 0-4 and snapshot the weights
+pre = make_solver(X[lo], y[lo], seed=0, iters=150)
+pre.step(150)
+pretrained = os.path.join(workdir, 'pretrained.caffemodel')
+uio.write_proto_binary(pretrained, pre.net.to_proto(pre.params))
+print('pretrain accuracy (0-4):', accuracy(pre, X[lo], y[lo]))
+"""),
+    code("""
+# 2) fine-tune on 5-9 from those weights vs train from scratch,
+#    SAME small iteration budget
+SHORT = 40
+ft = make_solver(X[hi], y[hi] - 5, seed=1, weights=pretrained,
+                 iters=SHORT)
+scratch = make_solver(X[hi], y[hi] - 5, seed=1, iters=SHORT)
+ft.step(SHORT); scratch.step(SHORT)
+acc_ft = accuracy(ft, X[hi], y[hi] - 5)
+acc_scratch = accuracy(scratch, X[hi], y[hi] - 5)
+print(f'fine-tuned {acc_ft:.3f}  vs  scratch {acc_scratch:.3f} '
+      f'after {SHORT} iters')
+assert acc_ft > acc_scratch  # the transferred conv features pay off
+"""),
+])
+
+
+DETECTION = nb([
+    md("""# R-CNN detection
+
+Counterpart of `detection.ipynb`: run a classifier over region
+proposals with the `Detector` facade (`api.detector`, the pycaffe
+`detect_windows` flow) and keep the best-scoring windows. The reference
+uses selective-search proposals over a downloaded image; here the
+proposals are a sliding grid over a generated scene with a bright
+'object' planted in one quadrant."""),
+    code("""
+import os, sys, tempfile
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from rram_caffe_simulation_tpu import api as caffe
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.utils import io as uio
+from rram_caffe_simulation_tpu.solver import Solver
+from google.protobuf import text_format
+
+workdir = tempfile.mkdtemp(prefix='det_nb_')
+rng = np.random.RandomState(0)
+
+def scene_with_object(cx, cy):
+    img = rng.rand(48, 48, 3).astype(np.float32) * 0.2
+    img[cy - 6:cy + 6, cx - 6:cx + 6, 0] = 1.0   # bright red square
+    return img
+"""),
+    code("""
+# brew the window classifier: object-vs-background crops (16x16)
+TRAIN_NET = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 32 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "lab" type: "Input" top: "label"
+  input_param { shape { dim: 32 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 16
+    weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 2
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc2"
+  bottom: "label" }
+'''
+sp = pb.SolverParameter()
+text_format.Parse(TRAIN_NET, sp.net_param)
+sp.base_lr = 0.05; sp.momentum = 0.9; sp.lr_policy = 'fixed'
+sp.max_iter = 80; sp.display = 0; sp.random_seed = 2
+sp.snapshot_prefix = os.path.join(workdir, 'det')
+
+def crop_batch():
+    xs, ys = [], []
+    for _ in range(32):
+        obj = rng.rand() < 0.5
+        patch = rng.rand(16, 16, 3).astype(np.float32) * 0.2
+        if obj:
+            patch[4:12, 4:12, 0] = 1.0
+        xs.append(patch.transpose(2, 0, 1))
+        ys.append(float(obj))
+    return {'data': np.stack(xs), 'label': np.asarray(ys, np.float32)}
+solver = Solver(sp, train_feed=crop_batch)
+solver.step(80)
+weights = os.path.join(workdir, 'det.caffemodel')
+uio.write_proto_binary(weights, solver.net.to_proto(solver.params))
+"""),
+    code("""
+DEPLOY = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 16 } }
+layer { name: "relu" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 2 } }
+layer { name: "prob" type: "Softmax" bottom: "fc2" top: "prob" }
+'''
+deploy = os.path.join(workdir, 'deploy.prototxt')
+open(deploy, 'w').write(DEPLOY)
+
+det = caffe.Detector(deploy, weights)
+image = scene_with_object(cx=36, cy=12)   # object in the NE quadrant
+# detect_windows loads images by filename, like the reference flow
+from PIL import Image
+scene_png = os.path.join(workdir, 'scene.png')
+Image.fromarray((np.clip(image, 0, 1) * 255).astype(np.uint8)) \
+    .save(scene_png)
+# sliding 16x16 proposals, stride 8 — (ymin, xmin, ymax, xmax)
+windows = [(yy, xx, yy + 16, xx + 16)
+           for yy in range(0, 33, 8) for xx in range(0, 33, 8)]
+dets = det.detect_windows([(scene_png, np.asarray(windows))])
+scores = np.asarray([d['prediction'][1] for d in dets])
+best = windows[int(scores.argmax())]
+print('best window (object score %.3f):' % scores.max(), best)
+# the winning window must overlap the planted object at (36, 12)
+assert best[1] <= 36 <= best[3] and best[0] <= 12 <= best[2]
+print('top-3 windows:',
+      [windows[i] for i in np.argsort(-scores)[:3]])
+"""),
+])
+
+
+PASCAL_MULTILABEL = nb([
+    md("""# Multilabel classification
+
+Counterpart of `pascal-multilabel-with-datalayer.ipynb`: multilabel
+targets (several classes can be present at once) trained with
+`SigmoidCrossEntropyLoss`, plus a `Python` layer computing the batch
+hamming accuracy inside the net — the two mechanisms the reference
+notebook demonstrates on PASCAL. Data: synthetic 3-channel images where
+each channel's presence is one label."""),
+    code("""
+import os, sys, tempfile
+sys.path.insert(0, os.getcwd())
+import numpy as np
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+from google.protobuf import text_format
+
+workdir = tempfile.mkdtemp(prefix='ml_nb_')
+rng = np.random.RandomState(0)
+N_CLASSES = 3
+
+def multilabel_batch(n=32):
+    labels = (rng.rand(n, N_CLASSES) < 0.4).astype(np.float32)
+    imgs = rng.rand(n, 3, 12, 12).astype(np.float32) * 0.2
+    for c in range(N_CLASSES):
+        imgs[:, c] += labels[:, c, None, None] * 0.8
+    return {'data': imgs, 'label': labels}
+"""),
+    code("""
+# the hamming-accuracy Python layer (pascal_multilabel_datalayers.py
+# counterpart): user code with Caffe's setup/reshape/forward contract
+layer_mod = os.path.join(workdir, 'hamming_layer.py')
+open(layer_mod, 'w').write('''
+import numpy as np
+
+class HammingAccuracyLayer:
+    # top[0] = mean(1 - |round(sigmoid(score)) - label|)
+    def setup(self, bottom, top):
+        pass
+    def reshape(self, bottom, top):
+        top[0].reshape(1)
+    def forward(self, bottom, top):
+        pred = 1.0 / (1.0 + np.exp(-bottom[0].data)) > 0.5
+        top[0].data[...] = 1.0 - np.abs(
+            pred.astype(np.float32) - bottom[1].data).mean()
+''')
+sys.path.insert(0, workdir)
+
+NET = '''
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 32 dim: 3 dim: 12 dim: 12 } } }
+layer { name: "lab" type: "Input" top: "label"
+  input_param { shape { dim: 32 dim: 3 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 24
+    weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "score" type: "InnerProduct" bottom: "fc1" top: "score"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SigmoidCrossEntropyLoss" bottom: "score"
+  bottom: "label" top: "loss" }
+layer { name: "hamming" type: "Python" bottom: "score" bottom: "label"
+  top: "hamming"
+  python_param { module: "hamming_layer"
+                 layer: "HammingAccuracyLayer" } }
+'''
+sp = pb.SolverParameter()
+text_format.Parse(NET, sp.net_param)
+sp.base_lr = 0.05; sp.momentum = 0.9; sp.lr_policy = 'fixed'
+sp.max_iter = 120; sp.display = 0; sp.random_seed = 3
+sp.snapshot_prefix = os.path.join(workdir, 'ml')
+solver = Solver(sp, train_feed=multilabel_batch)
+"""),
+    code("""
+# hamming accuracy before vs after training
+def hamming_now():
+    batch = multilabel_batch()
+    blobs, _ = solver.net.apply(solver.params, batch)
+    return float(np.asarray(blobs['hamming']).ravel()[0])
+
+before = hamming_now()
+solver.step(120)
+after = hamming_now()
+print(f'hamming accuracy: {before:.3f} -> {after:.3f}')
+assert after > 0.9 and after > before
+"""),
+])
+
+
+MNIST_SIAMESE = nb([
+    md("""# Siamese network embedding
+
+Counterpart of `siamese/mnist_siamese.ipynb`: train the shared-weight
+siamese pair with `ContrastiveLoss` and check that the learned 2-D
+embedding separates same-digit pairs from different-digit pairs —
+through the CI-tested `examples/siamese/run_siamese.py` flow (dataset
+pairing, weight sharing across the two towers, the margin loss)."""),
+    code("""
+import os, sys, subprocess
+sys.path.insert(0, os.getcwd())
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    'run_siamese_mod', 'examples/siamese/run_siamese.py')
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+mod.ensure_datasets()                       # pair LMDBs from digits
+subprocess.run([sys.executable,
+                'examples/siamese/generate.py'], check=True)
+"""),
+    code("""
+# train briefly and measure the embedding separation
+# (mean distance of different-digit pairs vs same-digit pairs)
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.utils.io import read_solver_param
+param = read_solver_param('examples/siamese/mnist_siamese_solver.prototxt')
+param.max_iter = 150
+param.display = 0
+param.ClearField('snapshot')
+import tempfile
+param.snapshot_prefix = os.path.join(
+    tempfile.mkdtemp(prefix='siam_nb_'), 'siam')
+solver = Solver(param)
+solver.step(150)
+same, diff = mod.embedding_separation(solver)
+print(f'same-class {same:.3f}  different-class {diff:.3f}  '
+      f'ratio {diff / max(same, 1e-9):.2f}x')
+assert diff > same   # the margin loss pushes unlike pairs apart
+"""),
+])
+
+
 NOTEBOOKS = {
+    "00-classification.ipynb": CLASSIFICATION,
     "01-learning-lenet.ipynb": LEARNING_LENET,
+    "02-fine-tuning.ipynb": FINE_TUNING,
     "net_surgery.ipynb": NET_SURGERY,
     "brewing-logreg.ipynb": BREWING_LOGREG,
+    "detection.ipynb": DETECTION,
+    "pascal-multilabel-with-datalayer.ipynb": PASCAL_MULTILABEL,
+    "mnist_siamese.ipynb": MNIST_SIAMESE,
 }
 
 

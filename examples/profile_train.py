@@ -114,6 +114,10 @@ def aggregate(trace_file, n_iters, peak_tflops, top=25):
     mxu = sum(d for c, d in by_cat.items() if "convolution" in c)
     print(f"\nconvolution-category time: {100 * mxu / total:.1f}% of device"
           f" — everything else is MXU-idle overhead")
+    flops = sum(r["flops"] for r in by_op.values())
+    ach = flops / (total * 1e-6) / 1e12 if total else 0.0
+    print(f"achieved over the whole capture: {ach:.1f} TFLOP/s = "
+          f"{100 * ach / peak_tflops:.1f}% of the {peak_tflops:.0f} TF peak")
     return by_cat, by_op, total
 
 

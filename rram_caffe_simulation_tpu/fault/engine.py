@@ -103,7 +103,9 @@ def fail(fault_params: Dict[str, jax.Array], state: FaultState,
         broken = life2 <= 0
         new_params[name] = jnp.where(broken, stuck, data)
         new_life[name] = life2
-    return new_params, {"lifetimes": new_life, "stuck": state["stuck"]}
+    # {**state, ...}: extra strategy state (e.g. tracked-remap slot maps)
+    # rides along untouched
+    return new_params, {**state, "lifetimes": new_life}
 
 
 def broken_fraction(state: FaultState) -> jax.Array:
@@ -141,13 +143,29 @@ def fault_state_to_proto(state: FaultState) -> "pb.NetParameter":
         lp.type = "FaultState"
         array_to_blob(np.asarray(state["lifetimes"][name]), lp.blobs.add())
         array_to_blob(np.asarray(state["stuck"][name]), lp.blobs.add())
+    # tracked-remap slot maps (framework extension) ride as their own
+    # entries so snapshot/resume preserves the logical->physical mapping
+    for gid in sorted(state.get("remap_slots", {})):
+        lp = out.layer.add()
+        lp.name = gid
+        lp.type = "RemapSlots"
+        array_to_blob(
+            np.asarray(state["remap_slots"][gid], np.float64),
+            lp.blobs.add())
     return out
 
 
 def fault_state_from_proto(proto: "pb.NetParameter") -> FaultState:
     from ..utils.io import blob_to_array
-    lifetimes, stuck = {}, {}
+    lifetimes, stuck, slots = {}, {}, {}
     for lp in proto.layer:
+        if lp.type == "RemapSlots":
+            slots[lp.name] = jnp.asarray(blob_to_array(lp.blobs[0]),
+                                         jnp.int32)
+            continue
         lifetimes[lp.name] = jnp.asarray(blob_to_array(lp.blobs[0]))
         stuck[lp.name] = jnp.asarray(blob_to_array(lp.blobs[1]))
-    return {"lifetimes": lifetimes, "stuck": stuck}
+    out: FaultState = {"lifetimes": lifetimes, "stuck": stuck}
+    if slots:
+        out["remap_slots"] = slots
+    return out

@@ -112,6 +112,53 @@ def remap_fc_neurons(data: Dict[str, jax.Array], diffs: Dict[str, jax.Array],
     return data, diffs
 
 
+def remap_fc_neurons_tracked(data: Dict[str, jax.Array],
+                             diffs: Dict[str, jax.Array],
+                             state: FaultState,
+                             fc_pairs: Sequence[Tuple[str, Optional[str]]],
+                             prune_orders: Sequence[np.ndarray],
+                             slots: Dict[str, jax.Array]):
+    """Identity-TRACKING remapping (framework extension,
+    FailureStrategyParameter.track_identity).
+
+    The reference's Apply (strategy.cpp:89-137, mirrored by
+    remap_fc_neurons above) indexes the prune ranking into the CURRENT
+    physical array, so after the first event the ranking no longer
+    addresses the neurons it was computed for — every event reshuffles
+    corruption across the whole layer (the measured dense AND pruned
+    collapse in pruned_deploy_eval.py). This variant carries
+    `slots[str(g)]`: logical neuron id -> current physical slot for
+    hidden group g, and each event routes logical prune_order[j] from
+    wherever it lives onto the j-th least-broken slot — the parking the
+    strategy's pruned-deployment thesis actually requires.
+
+    Returns (new_data, new_diffs, new_slots).
+    """
+    weight_keys = [w for w, _ in fc_pairs]
+    orders = sort_fc_neurons(state, weight_keys)
+    data = dict(data)
+    diffs = dict(diffs)
+    new_slots = dict(slots)
+    for i in range(1, len(fc_pairs)):
+        order = orders[i - 1]
+        prune = jnp.asarray(prune_orders[i - 1], dtype=jnp.int32)
+        sol = slots[str(i - 1)]
+        n = data[weight_keys[i - 1]].shape[0]
+        # dest slot order[j] <- logical prune[j]'s CURRENT slot sol[prune[j]]
+        perm = jnp.zeros((n,), dtype=jnp.int32).at[order].set(
+            jnp.take(sol, prune))
+        w_in, b_in = fc_pairs[i - 1]
+        w_out = weight_keys[i]
+        for d in (data, diffs):
+            d[w_in] = d[w_in][perm, :]
+            if b_in is not None and b_in in d:
+                d[b_in] = d[b_in][perm]
+            d[w_out] = d[w_out][:, perm]
+        new_slots[str(i - 1)] = jnp.zeros((n,), jnp.int32).at[prune].set(
+            order.astype(jnp.int32))
+    return data, diffs, new_slots
+
+
 # ---------------------------------------------------------------------------
 # Genetic strategy (host-side episodic search)
 
@@ -212,6 +259,7 @@ class StrategyConfig:
     remap_start: int = 0                        # in-jit via lax.cond
     remap_period: int = 0
     prune_orders: Optional[List[np.ndarray]] = None
+    remap_tracked: bool = False                 # track_identity extension
     genetic: Optional[GeneticStrategy] = None   # host-side episodic
 
 
@@ -260,6 +308,7 @@ def build_strategies(solver_param: "pb.SolverParameter", fc_pairs,
             cfg.remap_start = int(sp.start)
             cfg.remap_period = max(int(sp.period), 1)
             cfg.prune_orders = load_prune_orders(sp.prune_order_file)
+            cfg.remap_tracked = bool(sp.track_identity)
             _check_prune_orders(cfg.prune_orders, hidden_sizes)
         elif sp.type == "genetic":
             if prune_net_loader is None:

@@ -290,7 +290,10 @@ class Net:
                 blobs[t] = v
         loss = jnp.asarray(0.0, dtype=jnp.float32)
         for blob_name, w in self.loss_weights.items():
-            if blob_name in blobs:  # absent on partial runs ending earlier
+            # produced_in_range: partial runs count only loss blobs THEY
+            # computed — a loss-weighted blob fed in as a boundary input
+            # (segmented remat carries) must not be counted twice
+            if blob_name in blobs and blob_name in produced_in_range:
                 loss = loss + w * jnp.sum(blobs[blob_name])
         if with_updates:
             new_params = {ln: list(vals) for ln, vals in params.items()}

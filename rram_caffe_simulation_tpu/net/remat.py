@@ -1,0 +1,167 @@
+"""Segmented rematerialization for the fused train step.
+
+Autodiff of `Net.apply` stores every layer boundary for the backward
+pass; on the Monte-Carlo sweep that activation set is multiplied by the
+config axis and becomes the HBM ceiling (XLA `memory_analysis`: 10.4 GiB
+of temps for 500 CIFAR-quick configs — activations, not fault state or
+masters, are what capped the r3 sweep at 500 resident configs / chip).
+
+`make_remat_apply(net, S)` returns a Net.apply-compatible forward that
+runs the layer graph as S flop-balanced contiguous segments, each under
+`jax.checkpoint`: the backward pass holds only segment-boundary blobs
+and recomputes interior activations segment by segment, cutting peak
+temp memory roughly by the largest segment's share for one extra
+forward of FLOPs. This is the standard TPU recompute-for-HBM trade
+("How to Scale Your Model": rematerialisation) applied at the Caffe
+graph level.
+
+The reference has no counterpart (Caffe stores every blob
+unconditionally); cite: src/caffe/net.cpp AppendTop allocates all
+intermediates for the lifetime of the net.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def plan_segments(net, n_segments: int):
+    """Contiguous segments of net.layers cut where the CARRY is small.
+
+    The point of segmenting is memory: every blob crossing a boundary
+    is stored for backward, everything interior is recomputed. So cuts
+    go at the n_segments-1 boundaries with the smallest crossing-blob
+    byte count (pool outputs, not conv outputs) — a flop-balanced cut
+    right after the widest activation would store exactly the tensor
+    remat exists to drop.
+
+    Returns a list of (start_name, end_name, carry_out) where carry_out
+    is the set of blobs produced in the segment and needed later —
+    either consumed by a downstream layer or listed in
+    net.output_names (the solver mirrors those to the host).
+    """
+    import itertools
+
+    layers = net.layers
+    n = len(layers)
+    n_segments = max(1, min(n_segments, n))
+    data_tops_ = set(net.data_source_tops)
+    shapes = net.blob_shapes
+    last_use = {}
+    for i, l in enumerate(layers):
+        for b in l.lp.bottom:
+            last_use[b] = i
+
+    def blob_elems(t):
+        return int(np.prod(shapes.get(t, (1,)))) if shapes.get(t) else 1
+
+    def _crossing_elems(cut):
+        size, seen = 0, set()
+        for l in layers[:cut + 1]:
+            for t in l.lp.top:
+                if t in data_tops_ or t in seen:
+                    continue
+                if last_use.get(t, -1) > cut:
+                    seen.add(t)
+                    size += blob_elems(t)
+        return size
+
+    crossing = {c: _crossing_elems(c) for c in range(n - 1)}
+
+    # interior estimate: elems produced inside a segment (live during
+    # that segment's backward recomputation)
+    layer_out = [sum(blob_elems(t) for t in l.lp.top
+                     if t not in data_tops_) for l in layers]
+    pref = np.concatenate([[0], np.cumsum(layer_out)])
+
+    def peak(cuts):
+        bnds = [0] + [c + 1 for c in cuts] + [n]
+        interiors = [pref[b] - pref[a] for a, b in zip(bnds, bnds[1:])]
+        return sum(crossing[c] for c in cuts) + max(interiors)
+
+    cand = list(range(n - 1))
+    best, best_cuts = None, []
+    if len(cand) ** (n_segments - 1) <= 200_000:
+        combos = itertools.combinations(cand, n_segments - 1)
+    else:  # big nets: restrict candidates to the smallest-carry cuts
+        cand = sorted(cand, key=crossing.get)[:24]
+        combos = itertools.combinations(sorted(cand), n_segments - 1)
+    for cuts in combos:
+        p = peak(cuts)
+        if best is None or p < best:
+            best, best_cuts = p, list(cuts)
+    bounds = [0] + [c + 1 for c in sorted(best_cuts)] + [n]
+
+    data_tops = set(net.data_source_tops)
+    outputs = set(net.output_names)
+    seg_of = {}
+    for s in range(n_segments):
+        for l in layers[bounds[s]:bounds[s + 1]]:
+            seg_of[l.name] = s
+    segs = []
+    for s in range(n_segments):
+        seg_layers = layers[bounds[s]:bounds[s + 1]]
+        produced = {t for l in seg_layers for t in l.lp.top}
+        carry = set()
+        for b in produced - data_tops:
+            consumed_later = any(
+                b in l.lp.bottom for l in layers
+                if seg_of[l.name] > s)
+            if consumed_later or b in outputs:
+                carry.add(b)
+        segs.append((seg_layers[0].name, seg_layers[-1].name,
+                     sorted(carry)))
+    return segs
+
+
+def make_remat_apply(net, n_segments: int):
+    """A drop-in for `Net.apply` (the solver's `apply_fn` hook) that
+    checkpoints each of `n_segments` flop-balanced layer segments.
+
+    Loss: each segment's `net.apply` counts exactly the loss blobs it
+    produces (loss tops are never consumed downstream, so no carry-in
+    double counting); the wrapper sums them. Self-updates (BatchNorm
+    moving stats) merge per segment. Semantics are bit-for-bit those of
+    one whole-net apply — only the autodiff storage schedule changes.
+    """
+    segs = plan_segments(net, n_segments)
+    seg_names = [[l.name for l in net.layer_range(s, e)]
+                 for s, e, _ in segs]
+
+    def apply_fn(params, batch, rng=None, iteration=None,
+                 with_updates=True, adc_bits=0, crossbar=None,
+                 compute_dtype=None, **_):
+        carry = {}
+        total_loss = jnp.asarray(0.0, jnp.float32)
+        out_blobs = {}
+        merged = {ln: list(vals) for ln, vals in params.items()}
+
+        for (s, e, carry_out), names in zip(segs, seg_names):
+            # rng/iteration/crossbar ride as explicit checkpoint args so
+            # traced values are residuals, not closure captures
+            def seg(p, feed, rng_, it_, cb_, s=s, e=e,
+                    carry_out=carry_out):
+                blobs, loss, newp = net.apply(
+                    p, feed, rng=rng_, iteration=it_,
+                    with_updates=True, adc_bits=adc_bits,
+                    crossbar=cb_, compute_dtype=compute_dtype,
+                    start=s, end=e)
+                sel = {b: blobs[b] for b in carry_out}
+                return sel, jnp.asarray(loss, jnp.float32), newp
+
+            sel, loss, newp = jax.checkpoint(seg)(
+                params, {**batch, **carry}, rng, iteration, crossbar)
+            total_loss = total_loss + loss
+            carry = {**carry, **sel}
+            out_blobs.update(sel)
+            for ln in names:
+                if ln in newp:
+                    merged[ln] = newp[ln]
+
+        if with_updates:
+            return out_blobs, total_loss, merged
+        return out_blobs, total_loss
+
+    apply_fn.segments = segs
+    return apply_fn

@@ -56,7 +56,8 @@ class SweepRunner:
     """
 
     def __init__(self, solver, n_configs: int, mesh=None, means=None,
-                 stds=None, preload: bool = True, compute_dtype=None):
+                 stds=None, preload: bool = True, compute_dtype=None,
+                 remat_segments: int = 0, config_block: int = 0):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -88,6 +89,10 @@ class SweepRunner:
             key, shapes, solver.param.failure_pattern, n_configs,
             means=means, stds=stds)
         bcast = lambda x: jnp.repeat(x[None], n_configs, axis=0)
+        if "remap_slots" in (solver.fault_state or {}):
+            # tracked remapping: every config starts at the identity map
+            self.fault_states["remap_slots"] = jax.tree.map(
+                bcast, solver.fault_state["remap_slots"])
         self.params = jax.tree.map(bcast, solver.params)
         self.history = jax.tree.map(bcast, solver.history)
 
@@ -113,11 +118,66 @@ class SweepRunner:
         # masters/updates/fault state stay f32 (see make_train_step).
         if compute_dtype is None:
             compute_dtype = getattr(solver, "compute_dtype", None)
+        # remat_segments > 1: checkpointed segment forward (net/remat.py)
+        # — backward recomputes interior activations, cutting the
+        # config-multiplied activation term that caps resident configs
+        apply_fn = None
+        if remat_segments and remat_segments > 1:
+            from ..net.remat import make_remat_apply
+            apply_fn = make_remat_apply(solver.net, remat_segments)
         base = solver.make_train_step(hw_engine="jax",
-                                      compute_dtype=compute_dtype)
+                                      compute_dtype=compute_dtype,
+                                      apply_fn=apply_fn)
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
+        # config_block: run the config axis in sequential blocks inside
+        # the step (lax.map). Activation memory — the term that caps
+        # resident configs (XLA memory_analysis: at 1000 configs the
+        # conv1 activation + its cotangent alone are 2 x 7.8 GiB) —
+        # scales with the BLOCK, while params/momentum/fault state stay
+        # fully resident. Identical math, one dispatch.
+        if config_block and 0 < config_block < n_configs:
+            if n_configs % config_block:
+                raise ValueError(
+                    f"n_configs {n_configs} not divisible by "
+                    f"config_block {config_block}")
+            G, B = n_configs // config_block, config_block
+            inner_v = vstep
+
+            def vstep(params, history, fault, batch, it, rngs, remap):
+                # leaves cross the lax.map boundary FLATTENED to
+                # (G, B, -1): XLA tiles the trailing two dims of loop
+                # state, and a (..., 5, 5) conv kernel would pad
+                # (8, 128)-wise — measured 41x HBM expansion
+                shp = jax.tree.map(lambda a: a.shape[1:],
+                                   (params, history, fault))
+                flat2 = lambda t: jax.tree.map(
+                    lambda a: a.reshape((G, B, -1)), t)
+                blk_un = lambda t, s: jax.tree.map(
+                    lambda a, sh: a.reshape((B,) + sh), t, s)
+                blk_fl = lambda t: jax.tree.map(
+                    lambda a: a.reshape((B, -1)), t)
+
+                def f(blk):
+                    pf, hf, ff, rg = blk
+                    p, h, fa = blk_un((pf, hf, ff), shp)
+                    p2, h2, f2, loss, outs = inner_v(
+                        p, h, fa, batch, it, rg, remap)
+                    return (blk_fl(p2), blk_fl(h2), blk_fl(f2), loss,
+                            outs)
+
+                pf, hf, ff, lf, of = jax.lax.map(
+                    f, (flat2(params), flat2(history), flat2(fault),
+                        jax.tree.map(
+                            lambda a: a.reshape((G, B) + a.shape[1:]),
+                            rngs)))
+                unstk = lambda t, s: jax.tree.map(
+                    lambda a, sh: a.reshape((n_configs,) + sh), t, s)
+                join = lambda t: jax.tree.map(
+                    lambda a: a.reshape((-1,) + a.shape[2:]), t)
+                p3, h3, f3 = unstk((pf, hf, ff), shp)
+                return p3, h3, f3, join(lf), join(of)
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._vstep = vstep
         self._chunk_fns = {}

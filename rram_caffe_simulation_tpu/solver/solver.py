@@ -155,6 +155,16 @@ class Solver:
         self.strategies = fault_strategies.build_strategies(
             param, self.fc_pairs, prune_net_loader=self._load_prune_net,
             hidden_sizes=hidden_sizes)
+        if self.strategies.remap_tracked:
+            if self.fault_state is None:
+                raise ValueError(
+                    "remapping with track_identity needs an active "
+                    "fault engine (failure_pattern { type: 'gaussian' })")
+            # logical neuron id -> physical slot, one map per hidden
+            # group; starts at identity (see remap_fc_neurons_tracked)
+            self.fault_state["remap_slots"] = {
+                str(i): jnp.arange(n, dtype=jnp.int32)
+                for i, n in enumerate(hidden_sizes)}
 
         # --- data feeds ---
         self.custom_train_feed = train_feed is not None
@@ -454,12 +464,24 @@ class Solver:
                     fd, rate, lr_mults, strategies.threshold)
                 upd.update(fd)
             if strategies.prune_orders is not None and has_fault:
-                def remap(dd):
-                    return fault_strategies.remap_fc_neurons(
-                        dd[0], dd[1], fault_state, fc_pairs,
-                        strategies.prune_orders)
-                data, upd = jax.lax.cond(do_remap, remap,
-                                         lambda dd: dd, (data, upd))
+                if strategies.remap_tracked:
+                    def remap(dd):
+                        d, u, slots = dd
+                        return fault_strategies.remap_fc_neurons_tracked(
+                            d, u, fault_state, fc_pairs,
+                            strategies.prune_orders, slots)
+                    data, upd, new_slots = jax.lax.cond(
+                        do_remap, remap, lambda dd: dd,
+                        (data, upd, fault_state["remap_slots"]))
+                    fault_state = {**fault_state,
+                                   "remap_slots": new_slots}
+                else:
+                    def remap(dd):
+                        return fault_strategies.remap_fc_neurons(
+                            dd[0], dd[1], fault_state, fc_pairs,
+                            strategies.prune_orders)
+                    data, upd = jax.lax.cond(do_remap, remap,
+                                             lambda dd: dd, (data, upd))
 
             # -- ApplyUpdate (sgd_solver.cpp:119; blob.cpp:156) --
             data = {k: data[k] - upd[k] for k in data}
@@ -1136,6 +1158,14 @@ class Solver:
                     f"{sorted(saved)} but this solver's fault targets are "
                     f"{sorted(live)}; resume with the same failure_pattern "
                     "(including conv_also) the snapshot was taken under")
+            if (self.strategies.remap_tracked
+                    and "remap_slots" not in restored):
+                # pre-extension snapshot: the mapping is unrecoverable,
+                # so restart it at identity rather than KeyError mid-step
+                restored["remap_slots"] = {
+                    gid: jnp.arange(len(arr), dtype=jnp.int32)
+                    for gid, arr in
+                    self.fault_state["remap_slots"].items()}
             self.fault_state = restored
 
     # observability -----------------------------------------------------

@@ -171,13 +171,16 @@ def test_sweep_1000_runner_small(tmp_path):
     assert rec["configs_per_hour_one_chip"] > 0
 
 
-@pytest.mark.parametrize("name", ["01-learning-lenet", "net_surgery",
-                                  "brewing-logreg"])
+@pytest.mark.parametrize("name", [
+    "00-classification", "01-learning-lenet", "02-fine-tuning",
+    "net_surgery", "brewing-logreg", "detection",
+    "pascal-multilabel-with-datalayer", "mnist_siamese"])
 def test_notebooks_execute(name):
-    """The generated tutorial notebooks (reference .ipynb parity) must
-    actually run: execute every code cell in order from the repo root."""
+    """The generated tutorial notebooks (reference .ipynb parity, 8/8)
+    must actually run: execute every code cell in order from the repo
+    root."""
     import json
-    if name == "01-learning-lenet":
+    if name in ("01-learning-lenet", "02-fine-tuning", "mnist_siamese"):
         pytest.importorskip("sklearn")   # extras dep (load_digits)
     cwd = os.getcwd()
     os.chdir(REPO)

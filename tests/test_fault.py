@@ -122,6 +122,62 @@ def test_remap_preserves_function():
         rtol=1e-5, atol=1e-5)
 
 
+def test_remap_tracked_preserves_logical_identity():
+    """track_identity (framework extension): across MULTIPLE remap
+    events with a changing fault state, the slot map recovers every
+    logical neuron's row exactly — the invariant the reference's
+    untracked Apply loses after its first event — and the network
+    function is preserved at every step."""
+    from rram_caffe_simulation_tpu.fault.strategies import (
+        remap_fc_neurons_tracked)
+    rng = np.random.RandomState(1)
+    n_in, n_hidden, n_out = 4, 6, 3
+    w1 = rng.randn(n_hidden, n_in).astype(np.float32)
+    b1 = rng.randn(n_hidden).astype(np.float32)
+    w2 = rng.randn(n_out, n_hidden).astype(np.float32)
+    b2 = rng.randn(n_out).astype(np.float32)
+    data = {"fc1/0": jnp.asarray(w1), "fc1/1": jnp.asarray(b1),
+            "fc2/0": jnp.asarray(w2), "fc2/1": jnp.asarray(b2)}
+    diffs = {k: jnp.zeros_like(v) for k, v in data.items()}
+    fc_pairs = [("fc1/0", "fc1/1"), ("fc2/0", "fc2/1")]
+    prune_orders = [np.asarray([3, 0, 5, 1, 4, 2], np.int32)]
+    slots = {"0": jnp.arange(n_hidden, dtype=jnp.int32)}
+
+    x = rng.randn(5, n_in).astype(np.float32)
+
+    def f(d):
+        h = np.maximum(x @ np.asarray(d["fc1/0"]).T
+                       + np.asarray(d["fc1/1"]), 0)
+        return h @ np.asarray(d["fc2/0"]).T + np.asarray(d["fc2/1"])
+
+    want = f(data)
+    # three events, each with a different broken pattern
+    for ev, broken_neurons in enumerate([(2,), (2, 4), (0, 2, 4)]):
+        life1 = np.ones((n_hidden, n_in), np.float32)
+        for bn in broken_neurons:
+            life1[bn, :] = -1.0
+        state = {"lifetimes": {"fc1/0": jnp.asarray(life1),
+                               "fc2/0": jnp.ones((n_out, n_hidden),
+                                                 jnp.float32)},
+                 "stuck": {"fc1/0": jnp.zeros((n_hidden, n_in)),
+                           "fc2/0": jnp.zeros((n_out, n_hidden))}}
+        data, diffs, slots = remap_fc_neurons_tracked(
+            data, diffs, state, fc_pairs, prune_orders, slots)
+        # identity: slot map recovers every ORIGINAL logical row
+        sol = np.asarray(slots["0"])
+        np.testing.assert_array_equal(
+            np.asarray(data["fc1/0"])[sol], w1, err_msg=f"event {ev}")
+        np.testing.assert_array_equal(
+            np.asarray(data["fc1/1"])[sol], b1)
+        np.testing.assert_array_equal(
+            np.asarray(data["fc2/0"])[:, sol], w2)
+        # the permutation is function preserving
+        np.testing.assert_allclose(f(data), want, rtol=1e-5, atol=1e-5)
+    # after the last event the most prunable logical neuron (ranking
+    # tail = 2) must live on one of the broken slots {0, 2, 4}
+    assert int(np.asarray(slots["0"])[2]) in (0, 2, 4)
+
+
 # ---------------------------------------------------------------------------
 # End-to-end: solver with the fault engine in the loop
 

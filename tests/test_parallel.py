@@ -539,3 +539,123 @@ def test_sweep_genetic_matches_sequential_qualitatively(tmp_path):
     broken = runner.broken_fractions()
     assert broken[0] > 0.0 and broken[1] == 0.0       # same ordering
     assert recs[0]["broken"] > 0.0 and recs[1]["broken"] == 0.0
+
+
+def test_sweep_config_block_matches_unblocked(tmp_path):
+    """config_block runs the config axis in sequential lax.map blocks
+    inside the step (activation memory scales with the block, resident
+    state with the group — how 1000 configs fit one chip in r4); the
+    numerics must match the all-at-once vmap bit for bit."""
+    s1 = fault_solver(tmp_path, mean=250.0, std=30.0)
+    s2 = fault_solver(tmp_path, mean=250.0, std=30.0)
+    r1 = SweepRunner(s1, n_configs=8)
+    r2 = SweepRunner(s2, n_configs=8, config_block=4)
+    loss1, _ = r1.step(4, chunk=2)
+    loss2, _ = r2.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(loss1), np.asarray(loss2))
+    np.testing.assert_array_equal(np.asarray(r1.params["fc1"][0]),
+                                  np.asarray(r2.params["fc1"][0]))
+    np.testing.assert_array_equal(
+        np.asarray(r1.fault_states["lifetimes"]["fc1/0"]),
+        np.asarray(r2.fault_states["lifetimes"]["fc1/0"]))
+
+
+def test_sweep_config_block_divisibility(tmp_path):
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    with pytest.raises(ValueError, match="not divisible"):
+        SweepRunner(s, n_configs=8, config_block=3)
+
+
+def test_sweep_remat_segments_matches_plain(tmp_path):
+    """Segmented rematerialization (net/remat.py) recomputes interior
+    activations in backward; values must be bit-identical to the
+    unsegmented apply."""
+    s1 = fault_solver(tmp_path, mean=250.0, std=30.0)
+    s2 = fault_solver(tmp_path, mean=250.0, std=30.0)
+    r1 = SweepRunner(s1, n_configs=4)
+    r2 = SweepRunner(s2, n_configs=4, remat_segments=2)
+    loss1, _ = r1.step(3, chunk=3)
+    loss2, _ = r2.step(3, chunk=3)
+    np.testing.assert_array_equal(np.asarray(loss1), np.asarray(loss2))
+    np.testing.assert_array_equal(np.asarray(r1.params["fc1"][0]),
+                                  np.asarray(r2.params["fc1"][0]))
+
+
+def test_remat_plan_cuts_avoid_wide_blobs():
+    """plan_segments must cut where the carry is small: for the
+    conv->pool stack the boundary belongs after the pool, keeping the
+    4x-wider conv output interior (recomputed, not stored)."""
+    from rram_caffe_simulation_tpu.net import Net as CoreNet
+    from rram_caffe_simulation_tpu.net.remat import plan_segments
+    npar = pb.NetParameter()
+    text_format.Parse("""
+layer { name: "x" type: "Input" top: "x"
+  input_param { shape { dim: 4 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "x" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 16 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "fc" type: "InnerProduct" bottom: "pool2" top: "fc"
+  inner_product_param { num_output: 4
+    weight_filler { type: "xavier" } } }
+layer { name: "lab" type: "Input" top: "label"
+  input_param { shape { dim: 4 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc"
+  bottom: "label" }
+""", npar)
+    net = CoreNet(npar, pb.TRAIN)
+    segs = plan_segments(net, 2)
+    carries = [c for _, _, c in segs]
+    # no conv output may cross a boundary; pool tops are 4x smaller
+    assert all("conv1" not in c and "conv2" not in c for c in carries), \
+        carries
+
+
+def test_remat_no_loss_double_count():
+    """A loss-weighted blob that is ALSO consumed downstream crosses
+    segment boundaries as a carry; the segment that receives it must not
+    count its loss again (review r4: builder's loss loop now filters by
+    produced_in_range)."""
+    from rram_caffe_simulation_tpu.net import Net as CoreNet
+    from rram_caffe_simulation_tpu.net.remat import make_remat_apply
+    npar = pb.NetParameter()
+    text_format.Parse("""
+layer { name: "x" type: "Input" top: "x"
+  input_param { shape { dim: 4 dim: 6 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "x" top: "h"
+  loss_weight: 0.1 inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "fc2" type: "InnerProduct" bottom: "h" top: "y1"
+  inner_product_param { num_output: 16
+    weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "relu" type: "ReLU" bottom: "y1" top: "y1" }
+layer { name: "fc3" type: "InnerProduct" bottom: "y1" top: "y2"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "y2" bottom: "h" }
+""", npar)
+    net = CoreNet(npar, pb.TRAIN)
+    params = net.init(jax.random.PRNGKey(0))
+    batch = {"x": jnp.asarray(np.random.RandomState(0)
+                              .randn(4, 6), jnp.float32)}
+    _, loss_plain = net.apply(params, batch)
+    for S in (2, 3):
+        apply_s = make_remat_apply(net, S)
+        _, loss_remat, _ = apply_s(params, batch)
+        np.testing.assert_array_equal(np.asarray(loss_plain),
+                                      np.asarray(loss_remat)), S
+    # gradients agree too (the doubled contribution was the real harm)
+    g1 = jax.jit(jax.grad(lambda p: net.apply(p, batch)[1]))(params)
+    apply_s = make_remat_apply(net, 3)
+    g2 = jax.jit(jax.grad(lambda p: apply_s(p, batch)[1]))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
