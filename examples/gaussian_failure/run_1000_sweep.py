@@ -53,6 +53,7 @@ def main(argv=None):
         groups.append(args.configs % args.group)
     t_total = time.perf_counter()
     done = 0
+    blocks_used = []
     for gi, n_cfg in enumerate(groups):
         param = read_solver_param(
             "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt")
@@ -77,6 +78,7 @@ def main(argv=None):
             block = math.gcd(n_cfg, args.block)
         runner = SweepRunner(solver, n_configs=n_cfg,
                              config_block=block)
+        blocks_used.append(block)
         runner.step(args.iters, chunk=args.chunk)
         broken = runner.broken_fractions()
         dt = time.perf_counter() - t0
@@ -90,7 +92,7 @@ def main(argv=None):
         "iters_per_config": args.iters,
         "batch": 100,
         "groups": groups,
-        "config_block": args.block,
+        "config_block": blocks_used,
         "wall_minutes_one_chip": round(total_min, 2),
         "configs_per_hour_one_chip": round(args.configs
                                            / (total_min / 60), 1),

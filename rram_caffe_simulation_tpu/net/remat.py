@@ -82,11 +82,13 @@ def plan_segments(net, n_segments: int):
 
     cand = list(range(n - 1))
     best, best_cuts = None, []
-    if len(cand) ** (n_segments - 1) <= 200_000:
-        combos = itertools.combinations(cand, n_segments - 1)
-    else:  # big nets: restrict candidates to the smallest-carry cuts
-        cand = sorted(cand, key=crossing.get)[:24]
-        combos = itertools.combinations(sorted(cand), n_segments - 1)
+    if len(cand) ** (n_segments - 1) > 200_000:
+        # big nets: restrict candidates to the smallest-carry cuts, but
+        # never below the number of cuts requested (an empty
+        # combinations() would silently disable remat)
+        keep = max(24, n_segments - 1)
+        cand = sorted(sorted(cand, key=crossing.get)[:keep])
+    combos = itertools.combinations(cand, n_segments - 1)
     for cuts in combos:
         p = peak(cuts)
         if best is None or p < best:
