@@ -193,3 +193,21 @@ def test_notebooks_execute(name):
                 exec("".join(cell["source"]), glb)
     finally:
         os.chdir(cwd)
+
+
+def test_docs_tutorial_tree():
+    """The docs/tutorial tree (reference docs/tutorial/ parity): every
+    page the index links to exists, and every implementing module a
+    page names is a real file."""
+    import re
+    droot = os.path.join(REPO, "docs", "tutorial")
+    index = open(os.path.join(droot, "index.md")).read()
+    pages = re.findall(r"\]\((\w[\w_]*\.md)\)", index)
+    assert len(pages) >= 7, pages
+    for p in pages:
+        assert os.path.exists(os.path.join(droot, p)), p
+    body = "".join(open(os.path.join(droot, p)).read() for p in pages)
+    for mod in re.findall(r"`((?:ops|net|solver|parallel|data|fault|"
+                          r"tools|core)/\w+\.py)`", body):
+        assert os.path.exists(os.path.join(
+            REPO, "rram_caffe_simulation_tpu", mod)), mod
