@@ -659,3 +659,30 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "y2" bottom: "h" }
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_sweep_tracked_remap_per_config_slots(tmp_path):
+    """Tracked remapping under the vmapped sweep: each config carries
+    its own slot map (broadcast at identity, then diverging with each
+    config's fault state), and every map stays a permutation."""
+    order = " ".join(str(i)
+                     for i in np.random.RandomState(3).permutation(5))
+    pf = tmp_path / "po.txt"
+    pf.write_text(order + "\n")
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    st = s.param.failure_strategy.add()
+    st.type = "remapping"
+    st.period = 2
+    st.prune_order_file = str(pf)
+    st.track_identity = True
+    s = Solver(s.param, train_feed=s.train_feed)
+    runner = SweepRunner(s, n_configs=4)
+    assert runner.fault_states["remap_slots"]["0"].shape == (4, 5)
+    loss, _ = runner.step(6, chunk=3)
+    assert np.isfinite(np.asarray(loss)).all()
+    slots = np.asarray(runner.fault_states["remap_slots"]["0"])
+    for c in range(4):
+        assert sorted(slots[c]) == list(range(5)), c
+    # distinct fault states -> the maps diverge across configs
+    assert any(not np.array_equal(slots[0], slots[c])
+               for c in range(1, 4))
