@@ -914,9 +914,27 @@ class Solver:
              losses) = self._fused_fns[n](
                 self.params, self.history, self.fault_state,
                 batches, its, remaps)
-            for i in range(n):
-                self._record_loss(losses[i], start_iter, average_loss)
-                self.iter += 1
+            if n >= average_loss:
+                # ring buffer = the chunk's tail, stored at the SAME
+                # slot positions _record_loss would use (slot p holds
+                # the iteration with (it - start_iter) % average_loss
+                # == p) so a following smaller chunk overwrites the
+                # right entries; ONE device slice per buffered scalar
+                # instead of one per iteration (each slice is a
+                # dispatch — on a tunneled runtime the per-iteration
+                # loop was a measurable per-chunk cost)
+                end = self.iter + n
+                buf = [None] * average_loss
+                for t in range(end - average_loss, end):
+                    buf[(t - start_iter) % average_loss] = \
+                        losses[t - self.iter]
+                self.losses = buf
+                self.iter = end
+            else:
+                for i in range(n):
+                    self._record_loss(losses[i], start_iter,
+                                      average_loss)
+                    self.iter += 1
             if param.display and self.iter % param.display == 0:
                 self._materialize_smoothed_loss()
                 lr = float(self._lr_fn(jnp.int32(self.iter - 1)))

@@ -328,3 +328,23 @@ def test_step_fused_matches_step_in_graph_feed(tmp_path):
     _tree_equal(s1.params, s2.params)
     _tree_equal(s1.history, s2.history)
     assert s1.iter == s2.iter == 7
+
+
+def test_step_fused_loss_ring_mixed_chunks(tmp_path):
+    """average_loss > 1 with a trailing chunk SMALLER than the window
+    (review r4): the fast chunk path must store the ring at
+    _record_loss's slot positions, or the small chunk overwrites the
+    wrong entries and smoothed_loss averages stale iterations."""
+    from test_fault import fault_solver
+    s1 = fault_solver(tmp_path, mean=1e6, std=10.0)
+    s2 = fault_solver(tmp_path, mean=1e6, std=10.0)
+    for s in (s1, s2):
+        s.param.average_loss = 8
+    s1.step(25)
+    s2.step_fused(25, chunk=20)            # 20 (fast) + 5 (slow) chunks
+    assert s1.iter == s2.iter == 25
+    np.testing.assert_array_equal(
+        np.asarray(jnp.stack([jnp.asarray(l) for l in s1.losses])),
+        np.asarray(jnp.stack([jnp.asarray(l) for l in s2.losses])))
+    np.testing.assert_allclose(s1._materialize_smoothed_loss(),
+                               s2._materialize_smoothed_loss())
