@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Per-iteration elapsed seconds from a training log (reference:
+tools/extra/extract_seconds.py — same CLI: input log, output file with
+one elapsed-seconds value per 'Iteration N' line).
+
+Two timestamp sources are understood:
+- glog-prefixed lines from the reference binary
+  (`I0210 13:39:22.381027 pid solver.cpp:204] Iteration 100 ...`);
+- this framework's optional wall-clock prefix (none by default — logs
+  without any timestamp get a clear error instead of garbage).
+
+Elapsed time is measured from the `Solving` banner, like the reference.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import re
+import sys
+
+GLOG = re.compile(r"^[IWEF](\d{2})(\d{2}) (\d{2}):(\d{2}):(\d{2})\.(\d+)")
+
+
+def glog_datetime(line: str, year: int):
+    m = GLOG.match(line.strip())
+    if not m:
+        return None
+    month, day, h, mi, s, us = m.groups()
+    return datetime.datetime(year, int(month), int(day), int(h),
+                             int(mi), int(s), int(us[:6].ljust(6, "0")))
+
+
+def iteration_seconds(in_path: str):
+    """(iteration, elapsed_seconds) for the FIRST timestamped line of
+    each iteration, measured from the timestamped `Solving` banner.
+    Raises if the banner or timestamps are absent (matching the
+    reference, which errors rather than guessing a baseline)."""
+    year = datetime.datetime.fromtimestamp(
+        os.path.getctime(in_path)).year
+    it_re = re.compile(r"Iteration (\d+)")
+    start = None
+    rows = []
+    seen = set()
+    with open(in_path) as f:
+        for line in f:
+            dt = glog_datetime(line, year)
+            if start is None:
+                if "Solving" in line:
+                    if dt is None:
+                        raise SystemExit(
+                            f"the 'Solving' line of {in_path!r} has no "
+                            "glog timestamp; elapsed seconds need a "
+                            "timestamped solve start")
+                    start = dt
+                continue
+            m = it_re.search(line)
+            if m and dt is not None:
+                it = int(m.group(1))
+                if it in seen:
+                    continue
+                seen.add(it)
+                delta = (dt - start).total_seconds()
+                if delta < 0:                      # midnight rollover
+                    delta += 24 * 3600
+                rows.append((it, delta))
+    if start is None:
+        raise SystemExit(
+            f"no 'Solving' banner in {in_path!r}; cannot establish the "
+            "solve start time")
+    if not rows:
+        raise SystemExit(
+            f"no timestamped 'Iteration' lines in {in_path!r} — this "
+            "framework's default logs carry no glog prefix; elapsed "
+            "seconds need a log produced with timestamps")
+    return rows
+
+
+def extract_seconds(in_path: str, out_path: str) -> int:
+    rows = iteration_seconds(in_path)
+    with open(out_path, "w") as f:
+        for _, s in rows:
+            f.write(f"{s}\n")
+    return len(rows)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("input_file")
+    p.add_argument("output_file")
+    args = p.parse_args(argv)
+    n = extract_seconds(args.input_file, args.output_file)
+    print(f"wrote {n} elapsed-seconds rows to {args.output_file}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Multi-log training curves (reference:
+tools/extra/plot_training_log.py.example — same chart-type numbers and
+multi-log overlay semantics, built on this framework's parse_log).
+
+    python -m rram_caffe_simulation_tpu.tools.plot_training_log \
+        CHART_TYPE OUT.png LOG [LOG ...]
+
+Chart types (reference numbering):
+  0: Test accuracy  vs. Iters      4: Train learning rate vs. Iters
+  1: Test accuracy  vs. Seconds    5: Train learning rate vs. Seconds
+  2: Test loss      vs. Iters      6: Train loss vs. Iters
+  3: Test loss      vs. Seconds    7: Train loss vs. Seconds
+
+Seconds-based types need glog-timestamped logs (see
+extract_seconds.py); this framework's default logs support the
+Iters-based types. Without matplotlib (or with --table) the data prints
+as a table instead — the reference's headless workflow (plot_pic -n).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .parse_log import parse_log
+
+CHARTS = {
+    0: ("Test accuracy", "Iters"),
+    1: ("Test accuracy", "Seconds"),
+    2: ("Test loss", "Iters"),
+    3: ("Test loss", "Seconds"),
+    4: ("Train learning rate", "Iters"),
+    5: ("Train learning rate", "Seconds"),
+    6: ("Train loss", "Iters"),
+    7: ("Train loss", "Seconds"),
+}
+
+
+def series_for(chart: int, log_path: str):
+    y_name, x_name = CHARTS[chart]
+    train, test = parse_log(log_path)
+    rows = test if y_name.startswith("Test") else train
+    key = {"Test accuracy": "accuracy", "Test loss": "loss",
+           "Train learning rate": "lr", "Train loss": "loss"}[y_name]
+    xs, ys = [], []
+    if x_name == "Seconds":
+        from .extract_seconds import iteration_seconds
+        # keyed by iteration NUMBER: the log emits several 'Iteration N'
+        # lines per iteration, so positional zipping would misalign
+        secs = dict(iteration_seconds(log_path))
+        for it in sorted(rows):
+            if key in rows[it] and it in secs:
+                xs.append(secs[it])
+                ys.append(rows[it][key])
+    else:
+        for it in sorted(rows):
+            if key in rows[it]:
+                xs.append(it)
+                ys.append(rows[it][key])
+    if not xs:
+        raise SystemExit(
+            f"log {log_path!r} has no '{y_name}' data (for Test "
+            "accuracy the test net must emit an output named "
+            "'accuracy')")
+    return xs, ys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("chart_type", type=int, choices=sorted(CHARTS))
+    p.add_argument("output")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--table", action="store_true",
+                   help="print the data instead of plotting")
+    args = p.parse_args(argv)
+
+    y_name, x_name = CHARTS[args.chart_type]
+    data = [(log, *series_for(args.chart_type, log))
+            for log in args.logs]
+
+    plt = None
+    if not args.table:
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            print("matplotlib unavailable; printing table", flush=True)
+    if plt is None:
+        print(f"{x_name}\t{y_name}")
+        for log, xs, ys in data:
+            print(f"# {log}")
+            for x, y in zip(xs, ys):
+                print(f"{x:g}\t{y:g}")
+        return 0
+    for log, xs, ys in data:
+        plt.plot(xs, ys, marker=".", label=log)
+    plt.xlabel(x_name)
+    plt.ylabel(y_name)
+    plt.title(f"{y_name} vs. {x_name}")
+    plt.legend(fontsize=7)
+    plt.savefig(args.output)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Batch square resize-and-center-crop for dataset prep (reference:
+tools/extra/resize_and_crop_images.py — the MapReduce-flavored original
+becomes a multiprocessing pool over the same inputs: a file list of
+image paths, an output directory, and the target edge).
+
+    python -m rram_caffe_simulation_tpu.tools.resize_and_crop_images \
+        --input_file_list files.txt --output_folder out/ --dimension 256
+
+Each image is resized so its short edge equals --dimension, then
+center-cropped square — the standard ImageNet prep the reference's
+`launch_resize_and_crop_images.sh` drove. Decode/encode uses PIL when
+present, else the built-in PNG/BMP/PPM codecs.
+"""
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import os
+import sys
+
+import numpy as np
+
+
+def resize_and_crop(src: str, dst: str, dim: int) -> bool:
+    try:
+        try:
+            from PIL import Image
+            im = Image.open(src).convert("RGB")
+            w, h = im.size
+            scale = dim / min(w, h)
+            im = im.resize((max(dim, round(w * scale)),
+                            max(dim, round(h * scale))))
+            w, h = im.size
+            left, top = (w - dim) // 2, (h - dim) // 2
+            im = im.crop((left, top, left + dim, top + dim))
+            im.save(dst)
+        except ImportError:
+            from ..data import imagecodec as ic
+            arr = ic.decode(open(src, "rb").read())
+            h, w = arr.shape[:2]
+            scale = dim / min(w, h)
+            arr = ic.resize_bilinear(arr, max(dim, round(h * scale)),
+                                     max(dim, round(w * scale)))
+            h, w = arr.shape[:2]
+            top, left = (h - dim) // 2, (w - dim) // 2
+            arr = np.ascontiguousarray(arr[top:top + dim,
+                                           left:left + dim])
+            with open(dst, "wb") as f:
+                f.write(ic.encode_png(arr))
+        return True
+    except Exception as e:                      # keep the pool alive
+        print(f"FAIL {src}: {e}", file=sys.stderr, flush=True)
+        return False
+
+
+def _job(args):
+    src, out_name, out_dir, dim = args
+    return resize_and_crop(src, os.path.join(out_dir, out_name), dim)
+
+
+def output_names(srcs, keep_ext):
+    """One output filename per source: basenames, except that colliding
+    basenames (a/img.png + b/img.png) fall back to the full path with
+    separators flattened — a silent overwrite loses images."""
+    import collections
+    counts = collections.Counter(os.path.basename(s) for s in srcs)
+    names = []
+    for s in srcs:
+        base = os.path.basename(s)
+        if counts[base] > 1:
+            base = s.replace(os.sep, "_").lstrip("_")
+        if not keep_ext:
+            base = os.path.splitext(base)[0] + ".png"
+        names.append(base)
+    return names
+
+
+def parse_file_list(path):
+    """One image path per line; an optional trailing integer label
+    (convert_imageset list format) is stripped, but spaces inside the
+    path itself are preserved."""
+    srcs = []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) == 2 and parts[1].lstrip("-").isdigit():
+            line = parts[0]
+        srcs.append(line)
+    return srcs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input_file_list", required=True,
+                   help="text file, one image path per line")
+    p.add_argument("--output_folder", required=True)
+    p.add_argument("--dimension", type=int, default=256)
+    p.add_argument("--num_clients", type=int,
+                   default=max(os.cpu_count() // 2, 1),
+                   help="worker processes (the reference's mincepie "
+                        "client count)")
+    p.add_argument("--keep_ext", action="store_true",
+                   help="keep each input's extension (needs PIL for "
+                        "JPEG output)")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.output_folder, exist_ok=True)
+    srcs = parse_file_list(args.input_file_list)
+    names = output_names(srcs, args.keep_ext)
+    jobs = [(s, n, args.output_folder, args.dimension)
+            for s, n in zip(srcs, names)]
+    if args.num_clients > 1 and len(jobs) > 1:
+        with multiprocessing.Pool(args.num_clients) as pool:
+            ok = sum(pool.map(_job, jobs))
+    else:
+        ok = sum(_job(j) for j in jobs)
+    print(f"{ok}/{len(jobs)} images written to {args.output_folder}")
+    return 0 if ok == len(jobs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -432,3 +432,122 @@ def test_summarize_flops_column(capsys):
     import re
     m = re.search(r"conv1.*?(\d+\.\d)\s*$", out, re.M)
     assert m and abs(float(m.group(1)) - 36.9) < 1.0
+
+
+def test_extract_seconds_glog_log(tmp_path):
+    """Reference-format glog timestamps -> per-Iteration elapsed
+    seconds (tools/extra/extract_seconds.py contract)."""
+    from rram_caffe_simulation_tpu.tools.extract_seconds import main
+    log = tmp_path / "ref.log"
+    log.write_text(
+        "I0210 13:39:20.000000 25210 solver.cpp:276] Solving LeNet\n"
+        "I0210 13:39:22.500000 25210 solver.cpp:204] Iteration 0, "
+        "loss = 2.3\n"
+        "I0210 13:40:20.000000 25210 solver.cpp:204] Iteration 100, "
+        "loss = 1.1\n")
+    out = tmp_path / "secs.txt"
+    assert main([str(log), str(out)]) == 0
+    secs = [float(v) for v in out.read_text().split()]
+    assert secs == [2.5, 60.0]
+
+
+def test_extract_seconds_rejects_timestampless(tmp_path):
+    from rram_caffe_simulation_tpu.tools.extract_seconds import main
+    log = tmp_path / "ours.log"
+    log.write_text("Iteration 0, loss = 2.3\n")
+    with pytest.raises(SystemExit):
+        main([str(log), str(tmp_path / "o.txt")])
+
+
+def test_plot_training_log_table(tmp_path, capsys):
+    """Chart types over a framework log: Test accuracy vs. Iters (0)
+    and Train loss vs. Iters (6) print the parsed series."""
+    from rram_caffe_simulation_tpu.tools.plot_training_log import main
+    log = tmp_path / "train.log"
+    log.write_text(
+        "Iteration 0, loss = 2.3\n"
+        "Iteration 0, Testing net (#0)\n"
+        "    Test net output #1: accuracy = 0.10\n"
+        "Iteration 100, loss = 1.5\n"
+        "Iteration 100, Testing net (#0)\n"
+        "    Test net output #1: accuracy = 0.55\n")
+    assert main(["0", str(tmp_path / "o.png"), str(log),
+                 "--table"]) == 0
+    out = capsys.readouterr().out
+    assert "0.55" in out and "Test accuracy" in out
+    assert main(["6", str(tmp_path / "o.png"), str(log),
+                 "--table"]) == 0
+    out = capsys.readouterr().out
+    assert "1.5" in out
+
+
+def test_resize_and_crop_images(tmp_path):
+    """Short-edge resize + center crop over a file list, multiprocess
+    pool (tools/extra/resize_and_crop_images.py contract)."""
+    from PIL import Image
+    from rram_caffe_simulation_tpu.tools.resize_and_crop_images import (
+        main)
+    rng = np.random.RandomState(0)
+    paths = []
+    for i, (h, w) in enumerate([(40, 60), (64, 32), (48, 48)]):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray(rng.randint(0, 255, (h, w, 3),
+                                    np.uint8)).save(p)
+        paths.append(str(p))
+    flist = tmp_path / "files.txt"
+    flist.write_text("\n".join(paths) + "\n")
+    out = tmp_path / "out"
+    assert main(["--input_file_list", str(flist),
+                 "--output_folder", str(out),
+                 "--dimension", "24", "--num_clients", "2"]) == 0
+    for i in range(3):
+        im = Image.open(out / f"im{i}.png")
+        assert im.size == (24, 24)
+
+
+def test_resize_and_crop_collisions_and_spaces(tmp_path):
+    """Colliding basenames get path-derived names (no silent overwrite)
+    and spaces inside paths survive; a trailing imageset label is
+    stripped."""
+    from PIL import Image
+    from rram_caffe_simulation_tpu.tools.resize_and_crop_images import (
+        main, parse_file_list)
+    rng = np.random.RandomState(1)
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    for d in ("a", "b"):
+        Image.fromarray(rng.randint(0, 255, (40, 40, 3),
+                                    np.uint8)).save(
+            tmp_path / d / "img.png")
+    spaced = tmp_path / "my photos"
+    spaced.mkdir()
+    Image.fromarray(rng.randint(0, 255, (40, 40, 3), np.uint8)).save(
+        spaced / "pic.png")
+    flist = tmp_path / "files.txt"
+    flist.write_text(f"{tmp_path}/a/img.png\n"
+                     f"{tmp_path}/b/img.png\n"
+                     f"{spaced}/pic.png 7\n")   # trailing label
+    assert parse_file_list(str(flist))[2] == str(spaced / "pic.png")
+    out = tmp_path / "out"
+    assert main(["--input_file_list", str(flist),
+                 "--output_folder", str(out),
+                 "--dimension", "16", "--num_clients", "1"]) == 0
+    pngs = sorted(p.name for p in out.iterdir())
+    assert len(pngs) == 3, pngs               # no overwrite
+    assert "pic.png" in pngs
+
+
+def test_extract_seconds_dedups_iteration_lines(tmp_path):
+    """Several timestamped lines for ONE iteration (lr + loss prints)
+    yield one row, keyed to the first, so seconds align with parsed
+    iteration series."""
+    from rram_caffe_simulation_tpu.tools.extract_seconds import (
+        iteration_seconds)
+    log = tmp_path / "ref.log"
+    log.write_text(
+        "I0210 13:00:00.000000 1 solver.cpp:276] Solving\n"
+        "I0210 13:00:01.000000 1 s.cpp:1] Iteration 0, lr = 0.01\n"
+        "I0210 13:00:01.500000 1 s.cpp:1] Iteration 0, loss = 2.0\n"
+        "I0210 13:00:10.000000 1 s.cpp:1] Iteration 20, lr = 0.01\n"
+        "I0210 13:00:10.200000 1 s.cpp:1] Iteration 20, loss = 1.0\n")
+    assert iteration_seconds(str(log)) == [(0, 1.0), (20, 10.0)]
