@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Model-zoo weight fetcher (reference: scripts/download_model_binary.py
+— same CLI: a model directory whose readme.md frontmatter names the
+`caffemodel`, `caffemodel_url`, and `sha1`; skips the download when a
+file with the right checksum is already in place).
+
+    python -m rram_caffe_simulation_tpu.tools.download_model_binary \
+        models/bvlc_reference_caffenet
+
+The zoo files are V1-serialized; they load here unchanged through
+`Net.copy_trained_from` (the upgrade path handles the vintage). On an
+air-gapped host, download the file elsewhere and drop it into the model
+directory — this tool then verifies the checksum and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sys
+import urllib.request
+
+REQUIRED = ("caffemodel", "caffemodel_url", "sha1")
+
+
+def parse_readme_frontmatter(dirname: str) -> dict:
+    """YAML-frontmatter subset parser (flat `key: value` lines between
+    the --- fences) — enough for every zoo readme, no yaml dependency."""
+    path = os.path.join(dirname, "readme.md")
+    lines = [l.rstrip("\n") for l in open(path)]
+    try:
+        top = lines.index("---")
+        bottom = lines.index("---", top + 1)
+    except ValueError:
+        raise SystemExit(
+            f"{path} has no --- frontmatter fences; zoo readmes carry "
+            "caffemodel/caffemodel_url/sha1 metadata there")
+    fm = {}
+    for line in lines[top + 1:bottom]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            fm[k.strip()] = v.strip()
+    missing = [k for k in REQUIRED if k not in fm]
+    if missing:
+        raise SystemExit(f"{path} frontmatter lacks {missing}")
+    return fm
+
+
+def model_checks_out(path: str, sha1: str) -> bool:
+    if not os.path.exists(path):
+        return False
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest() == sha1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("dirname", help="model directory with a readme.md")
+    args = p.parse_args(argv)
+    fm = parse_readme_frontmatter(args.dirname)
+    target = os.path.join(args.dirname, fm["caffemodel"])
+    if model_checks_out(target, fm["sha1"]):
+        print(f"Model already exists and checks out: {target}")
+        return 0
+    print(f"Downloading {fm['caffemodel_url']} -> {target}")
+    try:
+        urllib.request.urlretrieve(fm["caffemodel_url"], target)
+    except Exception as e:
+        raise SystemExit(
+            f"download failed ({e}); on an air-gapped host fetch "
+            f"{fm['caffemodel_url']} elsewhere and place it at "
+            f"{target}, then re-run to verify the checksum")
+    if not model_checks_out(target, fm["sha1"]):
+        raise SystemExit(
+            f"{target} does not match sha1 {fm['sha1']} — partial or "
+            "corrupted download")
+    print("Download verified.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,8 +27,17 @@ def glog_datetime(line: str, year: int):
     if not m:
         return None
     month, day, h, mi, s, us = m.groups()
-    return datetime.datetime(year, int(month), int(day), int(h),
-                             int(mi), int(s), int(us[:6].ljust(6, "0")))
+    try:
+        return datetime.datetime(year, int(month), int(day), int(h),
+                                 int(mi), int(s),
+                                 int(us[:6].ljust(6, "0")))
+    except ValueError:
+        # glog drops the year; it comes from the log file's ctime, and
+        # a Feb 29 stamp under a non-leap assumed year is unbuildable
+        raise SystemExit(
+            f"timestamp {line.split()[0]!r} is invalid under assumed "
+            f"year {year} (taken from the log file's ctime — restore "
+            "the file's original timestamp or re-copy with `cp -p`)")
 
 
 def iteration_seconds(in_path: str):
@@ -60,10 +69,11 @@ def iteration_seconds(in_path: str):
                 if it in seen:
                     continue
                 seen.add(it)
-                delta = (dt - start).total_seconds()
-                if delta < 0:                      # midnight rollover
-                    delta += 24 * 3600
-                rows.append((it, delta))
+                if dt < start:
+                    # month/day are in the stamp, so a negative delta
+                    # means the run crossed a YEAR boundary
+                    dt = dt.replace(year=dt.year + 1)
+                rows.append((it, (dt - start).total_seconds()))
     if start is None:
         raise SystemExit(
             f"no 'Solving' banner in {in_path!r}; cannot establish the "

@@ -61,32 +61,42 @@ def _job(args):
 
 def output_names(srcs, keep_ext):
     """One output filename per source: basenames, except that colliding
-    basenames (a/img.png + b/img.png) fall back to the full path with
-    separators flattened — a silent overwrite loses images."""
+    POST-TRANSFORM names (a/img.png + b/img.png, or img.jpg + img.png
+    under the default .png normalization) fall back to the full path
+    with separators flattened — a silent overwrite loses images."""
     import collections
-    counts = collections.Counter(os.path.basename(s) for s in srcs)
-    names = []
-    for s in srcs:
+
+    def name(s):
         base = os.path.basename(s)
-        if counts[base] > 1:
-            base = s.replace(os.sep, "_").lstrip("_")
         if not keep_ext:
             base = os.path.splitext(base)[0] + ".png"
-        names.append(base)
+        return base
+
+    counts = collections.Counter(name(s) for s in srcs)
+    names = []
+    for s in srcs:
+        if counts[name(s)] > 1:
+            flat = s.replace(os.sep, "_").lstrip("_")
+            names.append(flat if keep_ext
+                         else os.path.splitext(flat)[0] + ".png")
+        else:
+            names.append(name(s))
     return names
 
 
 def parse_file_list(path):
     """One image path per line; an optional trailing integer label
-    (convert_imageset list format) is stripped, but spaces inside the
-    path itself are preserved."""
+    (convert_imageset list format) is stripped — unless the whole line
+    IS an existing file (a path that merely ends in digits) — and
+    spaces inside the path itself are preserved."""
     srcs = []
     for line in open(path):
         line = line.strip()
         if not line:
             continue
         parts = line.rsplit(None, 1)
-        if len(parts) == 2 and parts[1].lstrip("-").isdigit():
+        if (len(parts) == 2 and parts[1].lstrip("-").isdigit()
+                and not os.path.exists(line)):
             line = parts[0]
         srcs.append(line)
     return srcs

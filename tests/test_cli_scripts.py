@@ -551,3 +551,66 @@ def test_extract_seconds_dedups_iteration_lines(tmp_path):
         "I0210 13:00:10.000000 1 s.cpp:1] Iteration 20, lr = 0.01\n"
         "I0210 13:00:10.200000 1 s.cpp:1] Iteration 20, loss = 1.0\n")
     assert iteration_seconds(str(log)) == [(0, 1.0), (20, 10.0)]
+
+
+def test_download_model_binary_frontmatter_and_verify(tmp_path):
+    """Zoo downloader (scripts/download_model_binary.py contract):
+    frontmatter parse over the SHIPPED model readmes, checksum
+    verification, and skip-when-valid via a file:// URL."""
+    import hashlib
+    from rram_caffe_simulation_tpu.tools.download_model_binary import (
+        main, parse_readme_frontmatter)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    for m in ("bvlc_alexnet", "bvlc_googlenet",
+              "bvlc_reference_caffenet",
+              "bvlc_reference_rcnn_ilsvrc13", "finetune_flickr_style"):
+        fm = parse_readme_frontmatter(os.path.join(repo, "models", m))
+        assert fm["caffemodel_url"].startswith("http")
+        assert len(fm["sha1"]) == 40
+    # a local zoo: file:// URL + matching sha1 downloads and verifies
+    blob = b"not really weights"
+    src = tmp_path / "w.caffemodel"
+    src.write_bytes(blob)
+    mdir = tmp_path / "model"
+    mdir.mkdir()
+    (mdir / "readme.md").write_text(
+        "---\n"
+        "name: T\n"
+        "caffemodel: w.caffemodel\n"
+        f"caffemodel_url: file://{src}\n"
+        f"sha1: {hashlib.sha1(blob).hexdigest()}\n"
+        "---\nbody\n")
+    assert main([str(mdir)]) == 0
+    assert (mdir / "w.caffemodel").read_bytes() == blob
+    assert main([str(mdir)]) == 0      # second run: already checks out
+    # corrupted file + dead URL -> clear SystemExit
+    (mdir / "w.caffemodel").write_bytes(b"corrupt")
+    (mdir / "readme.md").write_text(
+        "---\ncaffemodel: w.caffemodel\n"
+        "caffemodel_url: file:///nonexistent/x\n"
+        f"sha1: {hashlib.sha1(blob).hexdigest()}\n---\n")
+    with pytest.raises(SystemExit, match="download failed"):
+        main([str(mdir)])
+
+
+def test_extract_seconds_year_rollover(tmp_path):
+    """A Dec 31 -> Jan 1 run: month/day live in the glog stamp, so a
+    negative delta means the year wrapped — elapsed stays positive."""
+    from rram_caffe_simulation_tpu.tools.extract_seconds import (
+        iteration_seconds)
+    log = tmp_path / "ny.log"
+    log.write_text(
+        "I1231 23:59:00.000000 1 s.cpp:1] Solving\n"
+        "I0101 00:01:00.000000 1 s.cpp:1] Iteration 0, loss = 2\n")
+    assert iteration_seconds(str(log)) == [(0, 120.0)]
+
+
+def test_resize_and_crop_cross_extension_collision(tmp_path):
+    """img.jpg + img.png both normalize to img.png under the default —
+    the collision check runs on POST-transform names, so neither is
+    silently overwritten."""
+    from PIL import Image
+    from rram_caffe_simulation_tpu.tools.resize_and_crop_images import (
+        output_names)
+    names = output_names(["a/img.jpg", "b/img.png"], keep_ext=False)
+    assert len(set(names)) == 2, names
