@@ -286,6 +286,9 @@ case(id="Dropout_train",
      proto='name: "l" type: "Dropout" bottom: "x" top: "y" '
            'dropout_param { dropout_ratio: 0.5 }',
      bottoms=[np.abs(_x4) + 1.0], phase=pb.TRAIN, needs_rng=True,
+     # the keep mask depends only on the (fixed) rng key, never on x,
+     # so finite differences are valid in TRAIN phase too
+     grad_bottoms=(0,),
      forward_check=_dropout_train_check)
 
 # --------------------------------------------------------------------------
