@@ -264,6 +264,16 @@ def decode_ppm(data: bytes) -> np.ndarray:
         nbytes = n * (2 if maxval > 255 else 1)
         if data[2 + end:2 + end + 2] == b"\r\n" \
                 and len(data) - body_off != nbytes:
+            if len(data) - (body_off + 1) != nbytes:
+                # neither reading is an exact fit: trailing slack makes
+                # "CRLF terminator" vs "lone-\r + first pixel 0x0A"
+                # indistinguishable — say so instead of silently shifting
+                import warnings
+                warnings.warn(
+                    "PNM header ends in \\r\\n with trailing bytes after "
+                    "the raster; assuming CRLF terminator (a lone-\\r "
+                    "header whose first pixel is 0x0A would decode "
+                    "shifted by one byte)", stacklevel=2)
             body_off += 1
         if maxval > 255:
             img = np.frombuffer(data, ">u2", n, body_off)

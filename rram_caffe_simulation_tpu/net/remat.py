@@ -80,13 +80,20 @@ def plan_segments(net, n_segments: int):
         interiors = [pref[b] - pref[a] for a, b in zip(bnds, bnds[1:])]
         return sum(crossing[c] for c in cuts) + max(interiors)
 
+    import math
+
     cand = list(range(n - 1))
     best, best_cuts = None, []
-    if len(cand) ** (n_segments - 1) > 200_000:
+    k = n_segments - 1
+    if k and math.comb(len(cand), k) > 200_000:
         # big nets: restrict candidates to the smallest-carry cuts, but
         # never below the number of cuts requested (an empty
-        # combinations() would silently disable remat)
-        keep = max(24, n_segments - 1)
+        # combinations() would silently disable remat) — and cap the pool
+        # so C(keep, k) itself stays bounded (a fixed keep=24 at k=12
+        # still meant ~2.7M peak() evaluations)
+        keep = max(24, k)
+        while keep > k and math.comb(keep, k) > 200_000:
+            keep -= 1
         cand = sorted(sorted(cand, key=crossing.get)[:keep])
     combos = itertools.combinations(cand, n_segments - 1)
     for cuts in combos:

@@ -32,11 +32,11 @@ def glog_datetime(line: str, year: int):
                                  int(mi), int(s),
                                  int(us[:6].ljust(6, "0")))
     except ValueError:
-        # glog drops the year; it comes from the log file's ctime, and
+        # glog drops the year; it comes from the log file's mtime, and
         # a Feb 29 stamp under a non-leap assumed year is unbuildable
         raise SystemExit(
             f"timestamp {line.split()[0]!r} is invalid under assumed "
-            f"year {year} (taken from the log file's ctime — restore "
+            f"year {year} (taken from the log file's mtime — restore "
             "the file's original timestamp or re-copy with `cp -p`)")
 
 
@@ -45,8 +45,12 @@ def iteration_seconds(in_path: str):
     each iteration, measured from the timestamped `Solving` banner.
     Raises if the banner or timestamps are absent (matching the
     reference, which errors rather than guessing a baseline)."""
+    # mtime, not ctime: on Linux getctime is inode-change time, which a
+    # plain `cp` resets and `cp -p` cannot restore; mtime matches the
+    # log's last write and survives `cp -p` (the reference tool reads
+    # ctime — a deliberate divergence, ADVICE r4)
     year = datetime.datetime.fromtimestamp(
-        os.path.getctime(in_path)).year
+        os.path.getmtime(in_path)).year
     it_re = re.compile(r"Iteration (\d+)")
     start = None
     rows = []

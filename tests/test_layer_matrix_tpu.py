@@ -1,0 +1,559 @@
+"""On-device (real TPU) half of the per-layer correctness matrix.
+
+The reference runs EVERY layer test on both backends through its
+typed-test matrix (include/caffe/test/test_caffe_main.hpp:56-72,
+`TestDtypesAndDevices` = {float,double} x {CPU,GPU}). The CPU suite
+(test_layer_matrix.py) proves the math at float64 on the virtual mesh;
+this module re-executes the SAME cases on the real TPU chip at f32 —
+the r4 pool-mask bug proved CPU-green != MXU-correct, so every
+registered type must earn its pass on the primary backend:
+
+- `test_forward_on_device`: all forward cases, jitted, under
+  `jax.default_matmul_precision("highest")` (full-f32 MXU accumulation),
+  pinned to the float64 NumPy reference at an f32-roundoff band
+  (default rtol/atol 1e-4; per-case overrides documented below);
+- MXU-bearing cases (Convolution/Deconvolution/InnerProduct) are ALSO
+  run at DEFAULT matmul precision — the bf16-input multi-pass MXU path
+  the bench rows use — and pinned to a 2e-2 band;
+- `test_gradient_on_device`: finite differences vs jax.grad at f32 for
+  the fault-target layer family (InnerProduct, Convolution, Scale,
+  BatchNorm — the weights the RRAM engine mutates);
+- `test_*_on_device` singletons: the registered types that live outside
+  CASES (data sources, recurrent stack, Attention, Python) each get an
+  on-device forward assertion; `test_registry_fully_covered_on_device`
+  enforces that the union is exactly the registry.
+
+Run: python -m pytest tests/ -m tpu --tpu -q
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.core.registry import (LAYER_REGISTRY,
+                                                     LayerContext,
+                                                     create_layer)
+import rram_caffe_simulation_tpu.ops  # noqa: F401  (registers layers)
+from rram_caffe_simulation_tpu.net import Net
+from rram_caffe_simulation_tpu.proto import pb
+
+from gradcheck import check_gradient
+from test_layer_matrix import CASES, GRAD_CASES, build
+import test_layer_matrix as cpu_matrix
+
+pytestmark = pytest.mark.tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _require_accelerator():
+    assert jax.default_backend() != "cpu", (
+        "tpu-marked tests ran on the CPU backend — invoke as "
+        "`pytest -m tpu --tpu` on a host with a chip")
+
+
+def _case_type(c):
+    lp = pb.LayerParameter()
+    text_format.Parse(c.proto, lp)
+    return lp.type
+
+
+# --------------------------------------------------------------------------
+# forward: every case, on the chip
+
+# f32-roundoff band at HIGHEST matmul precision. The default covers
+# elementwise ops, comparisons, and short reductions; overrides document
+# where TPU transcendental approximations (pow/exp/log lower to rational
+# approximations on the VPU) or longer f32 reduction chains need a wider
+# band than one decade over the 1e-5 on-device precedent.
+TPU_TOL_DEFAULT = dict(rtol=1e-4, atol=1e-4)
+TPU_TOL = {
+    # x**(-beta) via exp(beta*log(x)) on the VPU: ~1e-3 relative
+    "LRN_across": dict(rtol=2e-3, atol=2e-3),
+    "LRN_within": dict(rtol=2e-3, atol=2e-3),
+    # pow(shift + scale*x, power) same lowering
+    "Power": dict(rtol=2e-3, atol=2e-3),
+    # 1/sqrt(var+eps) amplifies the f32 variance reduction error
+    "BatchNorm_train": dict(rtol=1e-3, atol=1e-3),
+    "BatchNorm_global": dict(rtol=1e-3, atol=1e-3),
+    "MVN": dict(rtol=1e-3, atol=1e-3),
+}
+
+# MXU-bearing types: also assert the default-precision (bf16-input
+# multi-pass) band — the fast path every bench row runs on.
+MXU_TYPES = {"Convolution", "Deconvolution", "InnerProduct"}
+MXU_BAND = dict(rtol=2e-2, atol=2e-2)
+
+
+def _f32_inputs(c, params):
+    """Cast case inputs/params to f32 once, host-side, so the device and
+    the float64 NumPy reference see identical (already-rounded) values."""
+    b32 = [np.asarray(b, np.float32) for b in c.bottoms]
+    p32 = [np.asarray(p, np.float32) for p in params]
+    return b32, p32
+
+
+@pytest.mark.parametrize("c", CASES, ids=[c.id for c in CASES])
+def test_forward_on_device(c):
+    layer, params, ctx = build(c)
+    if hasattr(c, "override_params"):
+        params = c.override_params
+    b32, p32 = _f32_inputs(c, params)
+    jitted = jax.jit(lambda ps, bs: layer.apply(ps, bs, ctx))
+
+    with jax.default_matmul_precision("highest"):
+        tops, new_params = jitted([jnp.asarray(p) for p in p32],
+                                  [jnp.asarray(b) for b in b32])
+    tol = TPU_TOL.get(c.id, TPU_TOL_DEFAULT)
+    if c.forward_check is not None:
+        c.forward_check(tops, b32, p32)
+    else:
+        want = c.expected([b.astype(np.float64) for b in b32],
+                          [p.astype(np.float64) for p in p32])
+        assert len(tops) == len(want), \
+            f"{c.id}: {len(tops)} tops, expected {len(want)}"
+        for i, (got, exp) in enumerate(zip(tops, want)):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64), exp, **tol,
+                err_msg=f"{c.id} top {i} (highest precision)")
+    if c.check_updates is not None:
+        chk = TPU_UPDATE_CHECKS.get(c.id, c.check_updates)
+        assert new_params is not None
+        chk(new_params, b32, p32)
+
+    # default-precision band for the MXU cases (the bench path)
+    if _case_type(c) in MXU_TYPES and c.forward_check is None:
+        tops_d, _ = jitted([jnp.asarray(p) for p in p32],
+                           [jnp.asarray(b) for b in b32])
+        want = c.expected([b.astype(np.float64) for b in b32],
+                          [p.astype(np.float64) for p in p32])
+        for i, (got, exp) in enumerate(zip(tops_d, want)):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64), exp, **MXU_BAND,
+                err_msg=f"{c.id} top {i} (default precision)")
+
+
+def _bn_update_check_f32(new_params, bottoms, params):
+    """The CPU matrix's _bn_update_check at an f32 band: the moving
+    sums are accumulated on-device in f32."""
+    x = np.asarray(bottoms[0], np.float64)
+    m = x.shape[0] * x.shape[2] * x.shape[3]
+    mean = x.mean((0, 2, 3))
+    var = ((x - mean.reshape(1, -1, 1, 1)) ** 2).mean((0, 2, 3))
+    maf = 0.9
+    p64 = [np.asarray(p, np.float64) for p in params]
+    np.testing.assert_allclose(np.asarray(new_params[0], np.float64),
+                               maf * p64[0] + mean, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_params[1], np.float64),
+                               maf * p64[1] + m / (m - 1.0) * var,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_params[2], np.float64),
+                               maf * p64[2] + 1.0, rtol=1e-5)
+
+
+TPU_UPDATE_CHECKS = {"BatchNorm_train": _bn_update_check_f32}
+
+
+# --------------------------------------------------------------------------
+# gradients: the fault-target family (the weights the RRAM engine mutates)
+
+TPU_GRAD_TYPES = {"InnerProduct", "Convolution", "Scale", "BatchNorm"}
+TPU_GRAD_CASES = [c for c in GRAD_CASES if _case_type(c) in TPU_GRAD_TYPES]
+
+
+@pytest.mark.parametrize("c", TPU_GRAD_CASES,
+                         ids=[c.id for c in TPU_GRAD_CASES])
+def test_gradient_on_device(c):
+    """f32 central differences vs jax.grad on the chip (stepsize/threshold
+    per the test_gradcheck_f32_inner_product precedent: fd truncation and
+    f32 roundoff dominate)."""
+    layer, params, ctx = build(c)
+    if hasattr(c, "override_params"):
+        params = c.override_params
+    b32, p32 = _f32_inputs(c, params)
+    cots = [np.asarray(cpu_matrix.R(99).randn(*s) if s
+                       else cpu_matrix.R(99).randn(), np.float32)
+            for s in [np.shape(t) for t in
+                      layer.apply([jnp.asarray(p) for p in p32],
+                                  [jnp.asarray(b) for b in b32],
+                                  ctx)[0]]]
+
+    n_b = len(c.grad_bottoms)
+
+    def fn(*args):
+        bottoms = [jnp.asarray(b) for b in b32]
+        ps = [jnp.asarray(p) for p in p32]
+        for k, idx in enumerate(c.grad_bottoms):
+            bottoms[idx] = args[k]
+        for k, idx in enumerate(c.grad_params):
+            ps[idx] = args[n_b + k]
+        tops, _ = layer.apply(ps, bottoms, ctx)
+        return sum((t * jnp.asarray(ct)).sum() for t, ct in zip(tops, cots))
+
+    args = ([b32[i] for i in c.grad_bottoms]
+            + [p32[i] for i in c.grad_params])
+    with jax.default_matmul_precision("highest"):
+        check_gradient(fn, args, stepsize=1e-2, threshold=2e-2,
+                       dtype=jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# the registered types that live outside CASES: one on-device forward
+# assertion each (the data sources produce host batches that must flow
+# into a compiled TPU computation with correct values; the recurrent
+# stack and Attention are lax.scan/matmul programs that must lower)
+
+def _parse_layer(text, phase=pb.TRAIN):
+    lp = pb.LayerParameter()
+    text_format.Parse(text, lp)
+    layer = create_layer(lp, phase)
+    return layer
+
+
+def _parse_net(text, phase=pb.TEST):
+    npar = pb.NetParameter()
+    text_format.Parse(text, npar)
+    return Net(npar, phase)
+
+
+def _device_scale(batch, scale=2.0):
+    """The minimal compiled device program: y = scale*x, jitted."""
+    return jax.jit(lambda v: scale * v)(jnp.asarray(batch))
+
+
+def test_input_on_device():
+    net = _parse_net("""
+layer { name: "in" type: "Input" top: "x"
+  input_param { shape { dim: 2 dim: 3 } } }
+layer { name: "pow" type: "Power" bottom: "x" top: "y"
+  power_param { scale: 3.0 } }
+""")
+    x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    blobs, _ = jax.jit(lambda b: net.apply(net.init(jax.random.PRNGKey(0)),
+                                           b))({"x": jnp.asarray(x)})
+    np.testing.assert_allclose(np.asarray(blobs["y"]), 3.0 * x, rtol=1e-6)
+
+
+def test_memory_data_on_device():
+    cpu_matrix.test_memory_data_feeds_through_net()
+
+
+def test_hdf5_data_on_device(tmp_path):
+    cpu_matrix.test_hdf5_data_shapes_and_feed(tmp_path)
+
+
+def test_data_lmdb_on_device():
+    """Data (LMDB): the host feed's first batch flows into a jitted TPU
+    computation; values pinned against a direct LMDB decode."""
+    from rram_caffe_simulation_tpu.data.feed import FEED_BUILDERS
+    from rram_caffe_simulation_tpu.data.db import open_db, datum_to_array
+    layer = _parse_layer(f"""
+      name: "d" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{REPO}/examples/cifar10/cifar10_test_lmdb"
+                    batch_size: 4 backend: LMDB }}
+      transform_param {{ scale: 0.00390625 }}
+    """, phase=pb.TEST)
+    layer.setup([])
+    batch = FEED_BUILDERS["Data"](layer)()
+    assert batch["data"].shape == (4, 3, 32, 32)
+    got = np.asarray(_device_scale(batch["data"], 256.0))
+    # direct decode of the first 4 records
+    cursor = open_db(f"{REPO}/examples/cifar10/cifar10_test_lmdb").cursor()
+    want, labels = [], []
+    for _ in range(4):
+        d = pb.Datum()
+        d.ParseFromString(cursor.next_value())
+        arr, label = datum_to_array(d)
+        want.append(arr)
+        labels.append(label)
+    want = np.stack(want).astype(np.float32)  # scale*256 undoes 1/256
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(batch["label"]), labels)
+
+
+def test_image_data_on_device(tmp_path):
+    """ImageData: file-list feed -> jitted device op, values pinned
+    against PIL's own decode."""
+    from PIL import Image
+    from rram_caffe_simulation_tpu.data.feed import FEED_BUILDERS
+    rng = np.random.RandomState(3)
+    arrs = []
+    for i in range(2):
+        a = rng.randint(0, 255, (8, 8, 3), np.uint8)
+        Image.fromarray(a).save(tmp_path / f"im{i}.png")
+        arrs.append(a)
+    src = tmp_path / "list.txt"
+    src.write_text("".join(f"im{i}.png {i}\n" for i in range(2)))
+    layer = _parse_layer(f"""
+      name: "i" type: "ImageData" top: "data" top: "label"
+      image_data_param {{ source: "{src}" root_folder: "{tmp_path}/"
+                          batch_size: 2 shuffle: false }}
+    """, phase=pb.TEST)
+    layer.setup([])
+    batch = FEED_BUILDERS["ImageData"](layer)()
+    got = np.asarray(_device_scale(batch["data"], 1.0))
+    # caffe channel order: BGR, CHW (io.py / image_data_layer.cpp)
+    want = np.stack([a[:, :, ::-1].transpose(2, 0, 1) for a in arrs])
+    np.testing.assert_allclose(got, want.astype(np.float32))
+    np.testing.assert_allclose(np.asarray(batch["label"]), [0.0, 1.0])
+
+
+WINDOW_FILE_MIN = """# 0
+im0.png
+3 16 24
+2
+1 0.8 2 2 12 12
+0 0.2 1 1 8 8
+"""
+
+
+def test_window_data_on_device(tmp_path):
+    from PIL import Image
+    from rram_caffe_simulation_tpu.data.feed import FEED_BUILDERS
+    rng = np.random.RandomState(5)
+    Image.fromarray(rng.randint(0, 255, (16, 24, 3), np.uint8)).save(
+        tmp_path / "im0.png")
+    (tmp_path / "windows.txt").write_text(WINDOW_FILE_MIN)
+    layer = _parse_layer(f"""
+      name: "w" type: "WindowData" top: "data" top: "label"
+      window_data_param {{ source: "{tmp_path}/windows.txt"
+        root_folder: "{tmp_path}/" batch_size: 4 crop_size: 8
+        fg_threshold: 0.5 bg_threshold: 0.3 fg_fraction: 0.5 }}
+    """)
+    layer.setup([])
+    batch = FEED_BUILDERS["WindowData"](layer)()
+    assert batch["data"].shape == (4, 3, 8, 8)
+    dev = np.asarray(_device_scale(batch["data"], 1.0))
+    np.testing.assert_allclose(dev, batch["data"])
+    assert (batch["label"][:2] == 0).all() and (batch["label"][2:] >= 1).all()
+
+
+def test_hdf5_output_on_device(tmp_path):
+    """HDF5Output: device-computed blobs sink to the HDF5 file with the
+    values the chip produced (hdf5_output_layer.cpp)."""
+    import h5py
+    out = tmp_path / "out.h5"
+    layer = _parse_layer(f"""
+      name: "o" type: "HDF5Output" bottom: "data" bottom: "label"
+      hdf5_output_param {{ file_name: "{out}" }}
+    """)
+    layer.setup([(2, 3), (2,)])
+    x = _device_scale(np.random.RandomState(1).randn(2, 3)
+                      .astype(np.float32), 2.0)
+    lab = jnp.asarray([0.0, 1.0])
+    layer.apply([], [x, lab], LayerContext(phase=pb.TRAIN))
+    with h5py.File(out) as f:
+        np.testing.assert_allclose(np.asarray(f["data"]), np.asarray(x))
+        np.testing.assert_allclose(np.asarray(f["label"]), [0.0, 1.0])
+
+
+class TpuDoubler:
+    """User Python layer for the on-device round trip (host callback
+    between device programs, python_layer.hpp:14 contract)."""
+
+    def __init__(self, param_str=""):
+        pass
+
+    def setup(self, bottom, top):
+        pass
+
+    def reshape(self, bottom, top):
+        top[0].reshape(*bottom[0].data.shape)
+
+    def forward(self, bottom, top):
+        top[0].data[...] = 2.0 * bottom[0].data
+
+
+def test_python_layer_on_device():
+    layer = _parse_layer("""
+      name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "test_layer_matrix_tpu" layer: "TpuDoubler" }
+    """, phase=pb.TEST)
+    layer.setup([(2, 3)])
+    x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    f = jax.jit(lambda v: layer.apply(
+        [], [v], LayerContext(phase=pb.TEST))[0][0] + 1.0)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))), 2.0 * x + 1.0,
+                               rtol=1e-6)
+
+
+def test_rnn_on_device():
+    T, N, I, D = 3, 2, 4, 5
+    layer = _parse_layer(f"""
+      name: "rnn" type: "RNN" bottom: "x" bottom: "cont" top: "o"
+      recurrent_param {{ num_output: {D}
+        weight_filler {{ type: "uniform" min: -0.2 max: 0.2 }}
+        bias_filler {{ type: "constant" value: 0.1 }} }}
+    """)
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0
+    layer.setup([(T, N, I), (T, N)])
+    params = [np.asarray(p, np.float32)
+              for p in layer.init_params(jax.random.PRNGKey(1))]
+    with jax.default_matmul_precision("highest"):
+        tops, _ = jax.jit(lambda ps, bs: layer.apply(
+            ps, bs, LayerContext(phase=pb.TRAIN)))(
+            [jnp.asarray(p) for p in params],
+            [jnp.asarray(x), jnp.asarray(cont)])
+    W_xh, b_h, W_hh, W_ho, b_o = [p.astype(np.float64) for p in params]
+    h = np.zeros((N, D))
+    outs = []
+    for t in range(T):
+        h = np.tanh((cont[t][:, None] * h) @ W_hh.T
+                    + x[t].astype(np.float64) @ W_xh.T + b_h)
+        outs.append(np.tanh(h @ W_ho.T + b_o))
+    np.testing.assert_allclose(np.asarray(tops[0], np.float64),
+                               np.stack(outs), rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_on_device():
+    T, N, I, D = 3, 2, 4, 5
+    layer = _parse_layer(f"""
+      name: "lstm" type: "LSTM" bottom: "x" bottom: "cont" top: "h"
+      recurrent_param {{ num_output: {D}
+        weight_filler {{ type: "uniform" min: -0.2 max: 0.2 }}
+        bias_filler {{ type: "constant" value: 0.1 }} }}
+    """)
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0
+    layer.setup([(T, N, I), (T, N)])
+    params = [np.asarray(p, np.float32)
+              for p in layer.init_params(jax.random.PRNGKey(1))]
+    with jax.default_matmul_precision("highest"):
+        tops, _ = jax.jit(lambda ps, bs: layer.apply(
+            ps, bs, LayerContext(phase=pb.TRAIN)))(
+            [jnp.asarray(p) for p in params],
+            [jnp.asarray(x), jnp.asarray(cont)])
+    W_xc, b_c, W_hc = [p.astype(np.float64) for p in params]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    h = np.zeros((N, D))
+    c = np.zeros((N, D))
+    outs = []
+    for t in range(T):
+        ct = cont[t][:, None]
+        gates = (x[t].astype(np.float64) @ W_xc.T + b_c
+                 + (ct * h) @ W_hc.T)
+        i, f, o, g = (sig(gates[:, :D]), sig(gates[:, D:2 * D]),
+                      sig(gates[:, 2 * D:3 * D]), np.tanh(gates[:, 3 * D:]))
+        c = f * (ct * c) + i * g
+        h = o * np.tanh(c)
+        outs.append(h)
+    np.testing.assert_allclose(np.asarray(tops[0], np.float64),
+                               np.stack(outs), rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_unit_on_device():
+    N, D = 2, 5
+    unit = _parse_layer("""
+      name: "u" type: "LSTMUnit" bottom: "c" bottom: "g" bottom: "cont"
+      top: "c1" top: "h1"
+    """)
+    rng = np.random.RandomState(0)
+    c_prev = rng.randn(1, N, D).astype(np.float32)
+    gates = rng.randn(1, N, 4 * D).astype(np.float32)
+    cont = np.ones((1, N), np.float32)
+    unit.setup([(1, N, D), (1, N, 4 * D), (1, N)])
+    tops, _ = jax.jit(lambda bs: unit.apply(
+        [], bs, LayerContext(phase=pb.TRAIN)))(
+        [jnp.asarray(c_prev), jnp.asarray(gates), jnp.asarray(cont)])
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    g64 = gates.astype(np.float64)
+    i = sig(g64[0, :, :D])
+    f = sig(g64[0, :, D:2 * D])
+    o = sig(g64[0, :, 2 * D:3 * D])
+    g = np.tanh(g64[0, :, 3 * D:])
+    c = f * c_prev[0].astype(np.float64) + i * g
+    np.testing.assert_allclose(np.asarray(tops[0][0], np.float64), c,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(tops[1][0], np.float64),
+                               o * np.tanh(c), rtol=1e-4, atol=1e-4)
+
+
+def test_attention_on_device():
+    """Attention (extension id 147): jitted forward on the chip, pinned
+    against a float64 NumPy multi-head softmax-attention recomputation."""
+    B, S, E, H = 2, 8, 16, 4
+    layer = _parse_layer(f"""
+      name: "attn" type: "Attention" bottom: "x" top: "y"
+      attention_param {{ num_heads: {H} causal: true }}
+    """, phase=pb.TEST)
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, S, E).astype(np.float32)
+    layer.setup([(B, S, E)])
+    params = [np.asarray(p, np.float32)
+              for p in layer.init_params(jax.random.PRNGKey(3))]
+    with jax.default_matmul_precision("highest"):
+        tops, _ = jax.jit(lambda ps, bs: layer.apply(
+            ps, bs, LayerContext(phase=pb.TEST)))(
+            [jnp.asarray(p) for p in params], [jnp.asarray(x)])
+
+    wqkv, bqkv, wo, bo = [p.astype(np.float64) for p in params]
+    x64 = x.astype(np.float64)
+    qkv = x64 @ wqkv.T + bqkv               # (B, S, 3E)
+    q, k, v = np.split(qkv, 3, axis=-1)
+    d = E // H
+
+    def heads(a):
+        return a.reshape(B, S, H, d).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d)
+    mask = np.tril(np.ones((S, S), bool))
+    logits = np.where(mask, logits, -np.inf)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    out = (w @ v).transpose(0, 2, 1, 3).reshape(B, S, E) @ wo.T + bo
+    np.testing.assert_allclose(np.asarray(tops[0], np.float64), out,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_dummy_data_random_fillers_on_device():
+    """DummyData with random fillers draws in-graph on the chip (the
+    bench's input path) — moments must be right."""
+    layer = _parse_layer("""
+      name: "d" type: "DummyData" top: "a"
+      dummy_data_param { shape { dim: 64 dim: 64 }
+        data_filler { type: "gaussian" mean: 1.0 std: 2.0 } }
+    """)
+    layer.setup([])
+    tops, _ = layer.apply([], [], LayerContext(phase=pb.TRAIN,
+                                               rng=jax.random.PRNGKey(5)))
+    a = np.asarray(tops[0])
+    assert abs(a.mean() - 1.0) < 0.2 and abs(a.std() - 2.0) < 0.2
+
+
+ON_DEVICE_SINGLETONS = {
+    "Input": "test_input_on_device",
+    "MemoryData": "test_memory_data_on_device",
+    "HDF5Data": "test_hdf5_data_on_device",
+    "Data": "test_data_lmdb_on_device",
+    "ImageData": "test_image_data_on_device",
+    "WindowData": "test_window_data_on_device",
+    "HDF5Output": "test_hdf5_output_on_device",
+    "Python": "test_python_layer_on_device",
+    "RNN": "test_rnn_on_device",
+    "LSTM": "test_lstm_on_device",
+    "LSTMUnit": "test_lstm_unit_on_device",
+    "Attention": "test_attention_on_device",
+}
+
+
+def test_registry_fully_covered_on_device():
+    """Every registered layer type has an on-device forward assertion:
+    through CASES (test_forward_on_device) or a singleton above."""
+    covered = {_case_type(c) for c in CASES} | set(ON_DEVICE_SINGLETONS)
+    missing = set(LAYER_REGISTRY) - covered
+    assert not missing, \
+        f"layer types with no ON-DEVICE coverage: {sorted(missing)}"
+    for fn in ON_DEVICE_SINGLETONS.values():
+        assert fn in globals() and callable(globals()[fn]), fn
