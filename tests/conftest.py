@@ -47,6 +47,11 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: on-device numerics tests (need --tpu and a chip)")
+    config.addinivalue_line(
+        "markers", "slow: multi-minute tests (notebook executions, deep-net "
+                   "pipelines, multi-process clusters); excluded from the "
+                   "default run — CI adds a `-m slow` tier, locally use "
+                   "`pytest -m slow` or `-m \"\"` for everything")
     # The argv sniff above must agree with pytest's parsed option: with
     # --tpu hidden in addopts or a programmatic pytest.main() list, the env
     # setup would silently run the "on-device" suite on the forced-CPU

@@ -30,7 +30,12 @@ def check_gradient(fn, args, check_args=None, stepsize=1e-4, threshold=1e-3,
     f = jax.jit(lambda *a: jnp.asarray(fn(*a), dtype=dtype))
     analytic = jax.jit(jax.grad(f, argnums=tuple(check_args)))(*args)
     for gi, ai in enumerate(check_args):
-        a = np.array(args[ai], dtype=np.float64)  # writable copy
+        # writable copy; order="C" is load-bearing: converting a device
+        # array preserves its layout by default (order="K"), and the axon
+        # TPU backend hands back non-C-contiguous strides — reshape(-1)
+        # on that is a COPY, so the perturbation writes below would be
+        # silently lost (fd == 0 for every element)
+        a = np.array(args[ai], dtype=np.float64, order="C")
         g = np.asarray(analytic[gi], dtype=np.float64)
         flat = a.reshape(-1)
         gflat = g.reshape(-1)

@@ -153,6 +153,7 @@ def test_toy_imagenet_flow(tmp_path):
     assert acc >= 0.8
 
 
+@pytest.mark.slow
 def test_sweep_1000_runner_small(tmp_path):
     """The measured-north-star driver (run_1000_sweep.py) at a tiny
     operating point: grouping math, per-group seeding, and the JSON
@@ -175,6 +176,7 @@ def test_sweep_1000_runner_small(tmp_path):
     "00-classification", "01-learning-lenet", "02-fine-tuning",
     "net_surgery", "brewing-logreg", "detection",
     "pascal-multilabel-with-datalayer", "mnist_siamese"])
+@pytest.mark.slow
 def test_notebooks_execute(name):
     """The generated tutorial notebooks (reference .ipynb parity, 8/8)
     must actually run: execute every code cell in order from the repo

@@ -25,6 +25,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_dp_matches_single_process(tmp_path):
     import jax
     from rram_caffe_simulation_tpu.proto import pb

@@ -265,6 +265,7 @@ def test_pipeline_composes_with_fault_engine(tmp_path):
     assert np.isfinite(float(s._materialize_smoothed_loss()))
 
 
+@pytest.mark.slow
 def test_vgg11_zoo_net_pipelines(tmp_path):
     """The shipped cifar10_vgg11 prototxt (the RRAM thesis net, BN+Scale
     heterogeneous stages) trains under PP from its real LMDB feed; M=1
@@ -480,6 +481,7 @@ layer { name: "lossn" type: "Reduction" bottom: "n" top: "rn"
         _rebatch_net(net, 4)
 
 
+@pytest.mark.slow
 def test_resnet50_branchy_graph_pipelines(tmp_path):
     """VERDICT r3 task 8: pipeline partitioning on a NON-linear zoo
     graph. ResNet-50's residual blocks branch (identity + bottleneck

@@ -57,8 +57,10 @@ def _synthetic_batch(crop, batch=2):
     }
 
 
-@pytest.mark.parametrize("model,crop", [("bvlc_alexnet", 227),
-                                        ("bvlc_googlenet", 224)])
+@pytest.mark.parametrize("model,crop", [
+    ("bvlc_alexnet", 227),
+    pytest.param("bvlc_googlenet", 224, marks=pytest.mark.slow),
+])
 def test_deploy_forward(model, crop):
     npar = uio.read_net_param(
         os.path.join(REPO, "models", model, "deploy.prototxt"))
@@ -72,6 +74,7 @@ def test_deploy_forward(model, crop):
     assert np.all(prob >= 0)
 
 
+@pytest.mark.slow
 def test_alexnet_train_backward(tmp_path):
     net = _load_train_net("bvlc_alexnet", tmp_path)
     params = net.init(jax.random.PRNGKey(0))
@@ -89,6 +92,7 @@ def test_alexnet_train_backward(tmp_path):
         assert np.abs(g).sum() > 0, lname
 
 
+@pytest.mark.slow
 def test_googlenet_train_backward(tmp_path):
     net = _load_train_net("bvlc_googlenet", tmp_path)
     # three weighted losses: two aux heads at 0.3 + main at 1.0
@@ -203,6 +207,7 @@ def test_googlenet_test_phase_has_topk(tmp_path):
         assert f"{head}/top-5" in names
 
 
+@pytest.mark.slow
 def test_resnet50_structure_and_train_backward(tmp_path):
     """ResNet-50 (SURVEY §7 item 7: the scale-out net for the
     noise-in-the-loop config; generated by models/resnet50/generate.py
@@ -244,3 +249,58 @@ def test_resnet50_structure_and_train_backward(tmp_path):
                   "res4f_branch2c", "res5c_branch2b", "fc1000"]:
         g = np.asarray(grads[lname][0])
         assert np.abs(g).sum() > 0, lname
+
+
+def test_pascal_finetune_window_net(tmp_path):
+    """examples/finetune_pascal_detection: the R-CNN window-classification
+    finetune (reference examples/finetune_pascal_detection/
+    pascal_finetune_{solver,trainval_test}.prototxt) — WindowData head
+    feeding the CaffeNet trunk into a 21-way fc8_pascal at 10x/20x LR,
+    driven end-to-end through the window feed on a tiny VOC stand-in."""
+    from PIL import Image
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.data.feed import build_feed
+
+    npar = uio.read_net_param(os.path.join(
+        REPO, "examples", "finetune_pascal_detection",
+        "pascal_finetune_trainval_test.prototxt"))
+    fc8 = next(lp for lp in npar.layer if lp.name == "fc8_pascal")
+    assert fc8.inner_product_param.num_output == 21
+    assert [p.lr_mult for p in fc8.param] == [10, 20]
+
+    # tiny VOC stand-in: one 256x320 image, one fg window (overlap .9,
+    # class 7) and one bg window (overlap .2)
+    rng = np.random.RandomState(3)
+    img = tmp_path / "voc0.png"
+    Image.fromarray(rng.randint(0, 255, (256, 320, 3), np.uint8)).save(img)
+    (tmp_path / "windows.txt").write_text(
+        f"# 0\n{img}\n3 256 320\n2\n"
+        "7 0.9 20 20 180 180\n"
+        "0 0.2 5 5 60 60\n")
+    for lp in npar.layer:
+        if lp.type == "WindowData":
+            lp.window_data_param.source = str(tmp_path / "windows.txt")
+            lp.window_data_param.batch_size = 4
+            # the ilsvrc mean binaryproto isn't shipped
+            lp.transform_param.ClearField("mean_file")
+            lp.transform_param.mean_value.extend([104, 117, 123])
+
+    net = Net(npar, pb.TRAIN)
+    assert net.blob_shapes["data"] == (4, 3, 227, 227)
+    assert net.blob_shapes["fc8_pascal"] == (4, 21)
+    feed = build_feed(net, prefetch=False)
+    batch = feed()
+    # fg_fraction 0.25 of 4: 3 bg then 1 fg window
+    assert (batch["label"][:3] == 0).all() and batch["label"][3] == 7
+    params = net.init(jax.random.PRNGKey(0))
+    blobs, loss = net.apply(params, {k: jnp.asarray(v)
+                                     for k, v in batch.items()},
+                            rng=jax.random.PRNGKey(7))  # TRAIN dropout
+    assert np.isfinite(float(loss))
+
+    # the solver prototxt parses and points at this net
+    sp = uio.read_solver_param(os.path.join(
+        REPO, "examples", "finetune_pascal_detection",
+        "pascal_finetune_solver.prototxt"))
+    assert sp.net.endswith("pascal_finetune_trainval_test.prototxt")
+    assert sp.lr_policy == "step" and sp.stepsize == 20000
