@@ -43,27 +43,20 @@ def _num_classes(net_param):
 def dummyize(net_param, batch):
     """Replace TRAIN-phase Data layers with shape-equivalent DummyData
     (gaussian images, uniform labels) so the step is chip-resident."""
-    from rram_caffe_simulation_tpu.proto import pb
     n_classes = _num_classes(net_param)
-    for lp in net_param.layer:
-        if lp.type != "Data":
-            continue
-        phases = [inc.phase for inc in lp.include] or [pb.TRAIN]
-        if pb.TRAIN not in phases:
-            continue
-        crop = lp.transform_param.crop_size or 224
+    for lp, dshape, lshape in list(_train_data_layers(net_param, batch)):
         lp.type = "DummyData"
         dp = lp.dummy_data_param
         del dp.shape[:]
         s = dp.shape.add()
-        s.dim.extend([batch, 3, crop, crop])
-        if len(lp.top) > 1:
+        s.dim.extend(dshape)
+        if lshape is not None:
             s = dp.shape.add()
-            s.dim.extend([batch])
+            s.dim.extend(lshape)
         f = dp.data_filler.add()
         f.type = "gaussian"
         f.std = 1.0
-        if len(lp.top) > 1:
+        if lshape is not None:
             f = dp.data_filler.add()
             f.type = "uniform"
             f.min = 0.0
@@ -71,6 +64,68 @@ def dummyize(net_param, batch):
         lp.ClearField("data_param")
         lp.ClearField("transform_param")
     return net_param
+
+
+def _train_data_layers(net_param, batch):
+    """Yield (layer, data_shape, label_shape_or_None) for every
+    TRAIN-phase Data layer — the selection/shape logic dummyize and
+    inputize share."""
+    from rram_caffe_simulation_tpu.proto import pb
+    for lp in net_param.layer:
+        if lp.type != "Data":
+            continue
+        phases = [inc.phase for inc in lp.include] or [pb.TRAIN]
+        if pb.TRAIN not in phases:
+            continue
+        crop = lp.transform_param.crop_size or 224
+        yield (lp, (batch, 3, crop, crop),
+               (batch,) if len(lp.top) > 1 else None)
+
+
+def inputize(net_param, batch):
+    """Replace TRAIN-phase Data layers with shape-equal Input
+    declarations and return (net_param, batch_spec): the feed comes from
+    a once-device-put batch (see fixed_feed), so the profiled/benched
+    step contains no in-graph input generation (the DummyData RNG ops
+    polluted 6-15% of the r4 per-HLO attributions)."""
+    n_classes = _num_classes(net_param)
+    spec = {}
+    for lp, dshape, lshape in list(_train_data_layers(net_param, batch)):
+        lp.type = "Input"
+        s = lp.input_param.shape.add()
+        s.dim.extend(dshape)
+        spec[lp.top[0]] = ("image", dshape)
+        if lshape is not None:
+            s = lp.input_param.shape.add()
+            s.dim.extend(lshape)
+            spec[lp.top[1]] = ("label", lshape, n_classes)
+        lp.ClearField("data_param")
+        lp.ClearField("transform_param")
+    return net_param, spec
+
+
+def fixed_feed(spec, seed=0):
+    """One fixed batch per the inputize spec, drawn once and device_put
+    ONCE: every step_fused pull returns the same device buffers, so the
+    per-chunk jnp.stack is a device-side broadcast — no repeated H2D of
+    identical data inside the profiled region."""
+    import numpy as np
+    import jax
+    rng = np.random.RandomState(seed)
+    batch = {}
+    for top, info in spec.items():
+        if info[0] == "image":
+            batch[top] = rng.randn(*info[1]).astype(np.float32)
+        else:
+            batch[top] = rng.randint(
+                0, info[2], size=info[1]).astype(np.int32)
+    staged = {}
+
+    def feed():
+        if not staged:
+            staged.update({k: jax.device_put(v) for k, v in batch.items()})
+        return staged
+    return feed
 
 
 def main(argv=None):

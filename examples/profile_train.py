@@ -1,10 +1,11 @@
 """Per-HLO device-time profile of one fused train step on real TPU.
 
 Captures a jax.profiler trace around Solver.step_fused on a zoo
-train_val graph (Data swapped for DummyData, like bench_train.py) and
-aggregates the device events: time by HLO category, top ops by total
-device time with achieved FLOP/s and HBM bandwidth. This is the
-profile-backed MFU attribution the RESULTS.md table rows point at.
+train_val graph (Data swapped for a device-resident Input feed by
+default, or DummyData with --dummy-data) and aggregates the device
+events: time by HLO category, top ops by total device time with
+achieved FLOP/s and HBM bandwidth. This is the profile-backed MFU
+attribution the RESULTS.md table rows point at.
 
     python examples/profile_train.py \
         --model models/bvlc_googlenet/train_val.prototxt \
@@ -23,7 +24,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.join(HERE, "..")
 sys.path.insert(0, REPO)
 
-from bench_train import dummyize  # noqa: E402
+from bench_train import dummyize, inputize, fixed_feed  # noqa: E402
 
 
 def capture(args):
@@ -33,7 +34,16 @@ def capture(args):
     from rram_caffe_simulation_tpu.solver import Solver
     from rram_caffe_simulation_tpu.utils.io import read_net_param
 
-    netp = dummyize(read_net_param(args.model), args.batch)
+    netp = read_net_param(args.model)
+    if args.dummy_data:
+        netp = dummyize(netp, args.batch)
+        feed = None
+    else:
+        # default: Input layers + a pre-staged host batch — the profiled
+        # step then contains no in-graph input generation (the DummyData
+        # RNG ops claimed 6-15% of the r4 attributions)
+        netp, spec = inputize(netp, args.batch)
+        feed = fixed_feed(spec)
     sp = pb.SolverParameter()
     sp.net_param.CopyFrom(netp)
     sp.base_lr = 0.001
@@ -44,7 +54,8 @@ def capture(args):
     sp.max_iter = 10 ** 9
     sp.display = 0
     sp.random_seed = 7
-    solver = Solver(sp, compute_dtype=args.compute_dtype or None)
+    solver = Solver(sp, train_feed=feed,
+                    compute_dtype=args.compute_dtype or None)
     # compile + warmup outside the trace. --no-scan profiles the plain
     # per-iteration step: the fused path wraps the same body in a scan
     # `while`, which the trace reports as one opaque event.
@@ -132,6 +143,10 @@ def main(argv=None):
     p.add_argument("--no-scan", action="store_true",
                    help="profile Solver.step instead of step_fused "
                         "(breaks the scan `while` out into its body ops)")
+    p.add_argument("--dummy-data", action="store_true",
+                   help="generate inputs in-graph via DummyData (the r4 "
+                        "harness); default is a device-resident Input "
+                        "feed with no in-step generation")
     p.add_argument("--trace", default="",
                    help="parse an existing trace.json.gz instead of "
                         "capturing")

@@ -213,6 +213,14 @@ class HDF5OutputLayer(Layer):
                                      maxshape=(None,) + arr.shape[1:])
 
     def apply(self, params, bottoms, ctx):
+        # Concrete (eager) inputs write synchronously on the host, like
+        # the reference's Forward_cpu — also the only path that works on
+        # remote-compile transports where host-callback programs cannot
+        # lower (the axon tunnel hangs compiling io_callback). Traced
+        # inputs keep the io_callback so the layer composes under jit.
+        if not any(isinstance(b, jax.core.Tracer) for b in bottoms):
+            self._save(np.asarray(bottoms[0]), np.asarray(bottoms[1]))
+            return [], None
         from jax.experimental import io_callback
         # stop_gradient keeps the callback out of the autodiff graph (the
         # reference Backward is a no-op)

@@ -12,6 +12,10 @@ CASES (non-differentiable layers carry no grad_bottoms/grad_params),
 in IN_MODULE_FUNCTIONAL (data sources driven through a net below), or
 in TESTED_ELSEWHERE (layers with dedicated test files — asserted to
 actually mention the type).
+
+This is the CPU (float64) half of the reference's two-backend typed-test
+matrix (test_caffe_main.hpp:56-72); the TPU half re-executes every CASE
+on the real chip at f32 — see test_layer_matrix_tpu.py.
 """
 from __future__ import annotations
 

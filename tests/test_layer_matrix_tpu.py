@@ -377,10 +377,34 @@ def test_python_layer_on_device():
     """, phase=pb.TEST)
     layer.setup([(2, 3)])
     x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    # eager: the user layer runs host-side between device programs (the
+    # PythonLayer concrete-input path), with device arrays in and out
+    tops, _ = layer.apply([], [jnp.asarray(x)], LayerContext(phase=pb.TEST))
+    np.testing.assert_allclose(np.asarray(2.0 * tops[0] + 1.0),
+                               4.0 * x + 1.0, rtol=1e-6)
+
+def test_python_layer_under_jit_on_device():
+    """Under jit the layer lowers to pure_callback; transports without
+    host-callback service (the axon tunnel reports "does not support
+    host send/recv callbacks") cannot run this half — skip there, the
+    real TPU runtime covers it."""
+    layer = _parse_layer("""
+      name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "test_layer_matrix_tpu" layer: "TpuDoubler" }
+    """, phase=pb.TEST)
+    layer.setup([(2, 3)])
+    x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
     f = jax.jit(lambda v: layer.apply(
         [], [v], LayerContext(phase=pb.TEST))[0][0] + 1.0)
-    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))), 2.0 * x + 1.0,
-                               rtol=1e-6)
+    try:
+        out = np.asarray(f(jnp.asarray(x)))
+    except jax.errors.JaxRuntimeError as e:
+        # match the exact transport refusal — a genuine callback FAILURE
+        # (e.g. "CpuCallback error") must still fail the test
+        if "does not support host send/recv callbacks" in str(e):
+            pytest.skip(f"transport lacks host-callback support: {e}")
+        raise
+    np.testing.assert_allclose(out, 2.0 * x + 1.0, rtol=1e-6)
 
 
 def test_rnn_on_device():
