@@ -16,6 +16,6 @@ Capability map (reference paths are relative to the reference repo):
 - tools/    CLI and experiment harness (tools/caffe.cpp, examples/cifar10/gaussian_failure)
 """
 
-__version__ = "0.1.0"
+__version__ = "1.0.0"
 
 from .proto import pb  # noqa: F401

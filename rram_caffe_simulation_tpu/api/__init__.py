@@ -25,7 +25,7 @@ TRAIN = pb.TRAIN
 TEST = pb.TEST
 
 # package version; the wire format tracks the reference 1.0.0-rc3 schema
-__version__ = "0.2.0"
+__version__ = "1.0.0"
 
 
 class Layer:
