@@ -614,3 +614,44 @@ def test_resize_and_crop_cross_extension_collision(tmp_path):
         output_names)
     names = output_names(["a/img.jpg", "b/img.png"], keep_ext=False)
     assert len(set(names)) == 2, names
+
+
+def test_parse_log_sh_reference_tables(tmp_path):
+    """tools/extra/parse_log.sh writes the reference's whitespace tables
+    (<log>.test / <log>.train with Iters/Seconds columns) over the
+    Python ports — with the Seconds column blank when the log carries no
+    glog timestamps (the bare experiment runner's tee)."""
+    import subprocess
+    sh = os.path.join(REPO, "tools", "extra", "parse_log.sh")
+    log = tmp_path / "run.log"
+    log.write_text(
+        "I0731 10:00:00.000000 1 s.cpp:1] Solving Net\n"
+        "I0731 10:00:01.000000 1 s.cpp:1] Iteration 0, Testing net (#0)\n"
+        "I0731 10:00:02.000000 1 s.cpp:1]   Test net output #0: "
+        "accuracy = 0.5\n"
+        "I0731 10:00:02.100000 1 s.cpp:1]   Test net output #1: "
+        "loss = 1.5\n"
+        "I0731 10:00:03.000000 1 s.cpp:1] Iteration 0, loss = 2.0\n"
+        "I0731 10:00:03.100000 1 s.cpp:1] Iteration 0, lr = 0.01\n")
+    r = subprocess.run(["bash", sh, str(log)], cwd=tmp_path,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    test_tbl = (tmp_path / "run.log.test").read_text().splitlines()
+    train_tbl = (tmp_path / "run.log.train").read_text().splitlines()
+    assert test_tbl[0].split() == ["#Iters", "Seconds", "TestAccuracy",
+                                  "TestLoss"]
+    assert test_tbl[1].split() == ["0", "1", "0.5", "1.5"]
+    assert train_tbl[0].split() == ["#Iters", "Seconds", "TrainingLoss",
+                                   "LearningRate"]
+    assert train_tbl[1].split() == ["0", "1", "2", "0.01"]
+    # timestamp-less log: tables still come out, Seconds blank
+    bare = tmp_path / "bare.log"
+    bare.write_text("Solving Net\n"
+                    "Iteration 0, Testing net (#0)\n"
+                    "  Test net output #0: accuracy = 0.25\n"
+                    "  Test net output #1: loss = 2.5\n")
+    r = subprocess.run(["bash", sh, str(bare)], cwd=tmp_path,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    rows = (tmp_path / "bare.log.test").read_text().splitlines()
+    assert rows[1].split() == ["0", "0.25", "2.5"]  # Seconds column blank

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Parity shim for the reference tools/extra/launch_resize_and_crop_images.sh
+# (which drove resize_and_crop_images.py through Hadoop MapReduce). The
+# TPU-native port is a multiprocessing pool — same flags, no cluster:
+#     python -m rram_caffe_simulation_tpu.tools.resize_and_crop_images \
+#         --num_clients=8 \
+#         --input_file_list=/path/list.txt --output_folder=/path/out
+# This wrapper simply forwards its arguments there.
+DIR="$( cd "$(dirname "$0")/../.." ; pwd -P )"
+exec env PYTHONPATH="$DIR${PYTHONPATH:+:$PYTHONPATH}" \
+  python3 -m rram_caffe_simulation_tpu.tools.resize_and_crop_images "$@"

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Usage: parse_log.sh /path/to/your.log
+# Shell-glue parity with the reference tools/extra/parse_log.sh: writes
+#     <log>.test  (columns: #Iters Seconds TestAccuracy TestLoss)
+#     <log>.train (columns: #Iters Seconds TrainingLoss LearningRate)
+# in the CURRENT directory. The parsing is the Python ports
+# (tools/parse_log.py + tools/extract_seconds.py); this wrapper only
+# assembles the reference's whitespace tables so existing gnuplot
+# snippets (plot_log.gnuplot.example) keep working.
+set -e
+if [ "$#" -lt 1 ]; then
+  echo "Usage: parse_log.sh /path/to/your.log"
+  exit 1
+fi
+DIR="$( cd "$(dirname "$0")/../.." ; pwd -P )"
+PYTHONPATH="$DIR${PYTHONPATH:+:$PYTHONPATH}" python3 - "$1" <<'PYEOF'
+import os
+import sys
+
+from rram_caffe_simulation_tpu.tools.parse_log import parse_log
+from rram_caffe_simulation_tpu.tools.extract_seconds import \
+    iteration_seconds
+
+log_path = sys.argv[1]
+base = os.path.basename(log_path)
+train, test = parse_log(log_path)
+try:
+    secs = dict(iteration_seconds(log_path))
+except SystemExit:
+    # logs without glog timestamps (e.g. the bare experiment runner's
+    # tee) still get the loss/accuracy tables; Seconds stays blank
+    secs = {}
+
+
+def table(path, header, rows):
+    widths = [max(len(h), *(len(c) for _, cells in rows for c in [cells[i]]))
+              if rows else len(h) for i, h in enumerate(header)]
+    with open(path, "w") as f:
+        f.write("  ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip()
+                + "\n")
+        for _, cells in rows:
+            f.write("  ".join(c.ljust(w)
+                              for c, w in zip(cells, widths)).rstrip() + "\n")
+
+
+def fmt(v):
+    return "" if v is None else f"{v:g}"
+
+
+test_rows = [(it, (str(it), fmt(secs.get(it)),
+                   fmt(r.get("accuracy")), fmt(r.get("loss"))))
+             for it, r in sorted(test.items())]
+train_rows = [(it, (str(it), fmt(secs.get(it)),
+                    fmt(r.get("loss")), fmt(r.get("lr"))))
+              for it, r in sorted(train.items())]
+table(base + ".test", ["#Iters", "Seconds", "TestAccuracy", "TestLoss"],
+      test_rows)
+table(base + ".train", ["#Iters", "Seconds", "TrainingLoss", "LearningRate"],
+      train_rows)
+print(f"Wrote {base}.test and {base}.train")
+PYEOF
