@@ -10,13 +10,14 @@ leading fault-config axis for Monte-Carlo crossbar sweeps (replacing the
 reference's one-process-per-config workflow).
 """
 from .engine import (FaultState, init_fault_state, fail, broken_fraction,
-                     fault_state_to_proto, fault_state_from_proto)
+                     fault_counters, fault_state_to_proto,
+                     fault_state_from_proto)
 from .strategies import (threshold_diffs, remap_fc_neurons, sort_fc_neurons,
                          GeneticStrategy, build_strategies)
 
 __all__ = [
     "FaultState", "init_fault_state", "fail", "broken_fraction",
-    "fault_state_to_proto", "fault_state_from_proto",
+    "fault_counters", "fault_state_to_proto", "fault_state_from_proto",
     "threshold_diffs", "remap_fc_neurons", "sort_fc_neurons",
     "GeneticStrategy", "build_strategies",
 ]

@@ -108,6 +108,47 @@ def fail(fault_params: Dict[str, jax.Array], state: FaultState,
     return new_params, {**state, "lifetimes": new_life}
 
 
+def fault_counters(prev_life: Dict[str, jax.Array],
+                   new_life: Dict[str, jax.Array]) -> Tuple[dict, dict]:
+    """Per-parameter fault census as in-step reductions: broken-cell
+    count, newly-expired-this-step count, min/mean remaining lifetime.
+
+    Traced inside the jitted train step (observe package layer 1): the
+    reference's equivalent census (FailureMaker::Fail host-side count,
+    failure_maker.hpp:38-54) forced a GPU->CPU sync every iteration;
+    here the scalars ride the step's output pytree and reach the host
+    only at display boundaries. Under GSPMD-sharded lifetimes (tp/pp
+    meshes) each reduction is global — the partitioner inserts the
+    all-reduce — and under the sweep's vmap each config keeps its own.
+
+    Returns (totals, per_param): totals has broken_total/newly_expired/
+    life_min/life_mean; per_param the same four per fault-target key.
+    """
+    per = {}
+    broken_tot = jnp.int32(0)
+    newly_tot = jnp.int32(0)
+    life_min = jnp.float32(jnp.inf)
+    life_sum = jnp.float32(0.0)
+    n_cells = 0
+    for name in sorted(new_life):
+        l_new, l_prev = new_life[name], prev_life[name]
+        broken = jnp.sum(l_new <= 0).astype(jnp.int32)
+        newly = jnp.sum((l_new <= 0) & (l_prev > 0)).astype(jnp.int32)
+        pmin = jnp.min(l_new).astype(jnp.float32)
+        per[name] = {"broken": broken, "newly_expired": newly,
+                     "life_min": pmin,
+                     "life_mean": jnp.mean(l_new).astype(jnp.float32)}
+        broken_tot = broken_tot + broken
+        newly_tot = newly_tot + newly
+        life_min = jnp.minimum(life_min, pmin)
+        life_sum = life_sum + jnp.sum(l_new).astype(jnp.float32)
+        n_cells += l_new.size
+    totals = {"broken_total": broken_tot, "newly_expired": newly_tot,
+              "life_min": life_min,
+              "life_mean": life_sum / max(n_cells, 1)}
+    return totals, per
+
+
 def broken_fraction(state: FaultState) -> jax.Array:
     """Broken-cell census (reference FailureMaker::Fail CPU-side census,
     failure_maker.hpp:38-54 — which forced a GPU->CPU sync every iteration;

@@ -1,0 +1,32 @@
+"""Training telemetry: on-device counters, host-side sinks, trace hooks.
+
+The reference fork trains blind — loss at `display` boundaries and
+nothing else, while the phenomenon under study (RRAM cells dying, weights
+sticking at {-1, 0, +1}, mitigation strategies trading write traffic for
+accuracy) unfolds invisibly on the device. This package makes the run
+observable in three layers:
+
+1. on-device counters (counters.py + fault.engine.fault_counters):
+   cheap reductions traced INSIDE the fused train step — broken-cell and
+   newly-expired counts, lifetime min/mean, write-traffic saved by the
+   threshold strategy, grad/update global norms, loss, lr — carried out
+   as a small pytree and materialized only at display boundaries;
+2. host sinks (sink.py): a `MetricsLogger` registry with a JSONL sink
+   (schema.py documents and validates the record shape) and a
+   Caffe-format text emitter the legacy parse_log/plot/extract_seconds
+   tooling scrapes unchanged;
+3. profiler hooks (trace.py): `jax.named_scope` phase annotations in the
+   step and a `jax.profiler.trace` context manager wired to the CLI's
+   `--profile-dir` flag.
+"""
+from .counters import global_norm_sq, to_host, write_traffic_saved
+from .schema import SCHEMA_VERSION, validate_record
+from .sink import CaffeLogSink, JsonlSink, MetricsLogger, make_record
+from .trace import trace
+
+__all__ = [
+    "SCHEMA_VERSION", "validate_record",
+    "MetricsLogger", "JsonlSink", "CaffeLogSink", "make_record",
+    "global_norm_sq", "write_traffic_saved", "to_host",
+    "trace",
+]

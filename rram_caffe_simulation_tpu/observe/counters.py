@@ -1,0 +1,65 @@
+"""On-device metric reductions for the fused train step.
+
+Every function here is traced INSIDE the jitted step: the counters ride
+out as a small pytree of scalars and materialize on the host only at
+`display` boundaries (where the loop already blocks), so the hot loop
+never gains an extra dispatch or device->host sync.
+
+Mesh aggregation comes for free: under GSPMD-sharded state (the dp/tp/pp
+wrappers and the sweep's config axis), `jnp.sum`/`jnp.min` over a sharded
+array is a GLOBAL reduction — the partitioner inserts the psum/all-reduce
+— so a carried-out counter is already the cross-mesh aggregate. Under
+`vmap` (the Monte-Carlo sweep) each config keeps its own counter vector.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def global_norm_sq(tree: Dict[str, jax.Array]) -> jax.Array:
+    """Sum of squares over a flat dict of arrays (grad/update global-norm
+    building block; the clip-gradients path shares this value)."""
+    return sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+               for v in tree.values())
+
+
+def write_traffic_saved(before: Dict[str, jax.Array],
+                        after: Dict[str, jax.Array],
+                        epsilon: float,
+                        lifetimes: Dict[str, jax.Array] = None
+                        ) -> jax.Array:
+    """Cells whose pending write the threshold strategy suppressed this
+    step: |diff| >= epsilon would have decremented the cell's lifetime
+    (failure_maker.cu:25), but the strategy zeroed the update — the
+    write-budget the paper's threshold mitigation trades for accuracy.
+
+    `lifetimes` (pre-fail) masks the count to ALIVE cells: fail() only
+    decrements where `alive & written` (engine.fail), so a suppressed
+    write to an already-broken cell saves no endurance and must not
+    inflate the run's summed write-budget saving."""
+    saved = jnp.int32(0)
+    for k in before:
+        suppressed = (jnp.abs(before[k]) >= epsilon) & (after[k] == 0)
+        if lifetimes is not None:
+            suppressed = suppressed & (lifetimes[k] > 0)
+        saved = saved + jnp.sum(suppressed).astype(jnp.int32)
+    return saved
+
+
+def to_host(metrics):
+    """Materialize a metrics pytree into plain Python scalars/lists
+    (JSON-serializable). ONE device_get for the whole tree — this is the
+    only transfer, and the caller invokes it at display boundaries only."""
+    vals = jax.device_get(metrics)
+
+    def conv(x):
+        a = np.asarray(x)
+        if a.ndim == 0:
+            return int(a) if np.issubdtype(a.dtype, np.integer) else float(a)
+        return a.tolist()
+
+    return jax.tree.map(conv, vals)

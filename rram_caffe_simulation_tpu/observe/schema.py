@@ -1,0 +1,133 @@
+"""The JSONL metrics record schema — the single source of truth.
+
+One record per display interval, one JSON object per line. The schema is
+deliberately dependency-free (no jax/numpy imports) so
+`scripts/check_metrics_schema.py` can load this module by file path and
+validate logs without pulling in the framework.
+
+Top-level record::
+
+    {"schema_version": 1, "iter": 100, "wall_time": 1722700000.1,
+     "loss": 0.83, "smoothed_loss": 0.85, "lr": 0.01,
+     "step_latency_s": 0.0121, "iters_per_s": 82.6,
+     "seed": 1701,                       # first record of a run only
+     "grad_norm": 2.1, "update_norm": 0.2,
+     "outputs": {"loss": 0.83, "accuracy": 0.71},
+     "fault": {"broken_total": 120, "newly_expired": 7,
+               "life_min": -35.0, "life_mean": 9.1e7,
+               "writes_saved": 4096,
+               "per_param": {"fc1/0": {"broken": 100, "newly_expired": 5,
+                                       "life_min": -35.0,
+                                       "life_mean": 8.9e7}}}}
+
+`fault` is present only when the solver runs a fault engine; `seed` only
+on the first record a Solver writes — so once per run segment: a
+resumed run (JSONL append mode) logs its own seed on ITS first record,
+which is the seed that replays the post-resume iterations; everything
+else every record. Under a Monte-Carlo
+sweep the scalar counter fields become per-config lists — `validate_record`
+accepts both shapes.
+
+Semantics worth knowing: `step_latency_s`/`iters_per_s` cover the
+TRAINING time of the interval since the previous record (test-net
+evaluation and snapshot writes are excluded; the first interval includes
+jit compile). `fault.writes_saved` is the interval TOTAL of
+threshold-suppressed writes, so summing it across records gives the
+run's whole write-budget saving; the other fault counters are
+instantaneous state at the record's iteration.
+"""
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)          # JSON numbers; bools are excluded explicitly
+
+# field -> (accepted types, required)
+TOP_LEVEL = {
+    "schema_version": (int, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "loss": (_NUM, True),
+    "lr": (_NUM, True),
+    "step_latency_s": (_NUM, True),
+    "iters_per_s": (_NUM, True),
+    "smoothed_loss": (_NUM, False),
+    "seed": (int, False),
+    "grad_norm": (_NUM, False),
+    "update_norm": (_NUM, False),
+    "outputs": (dict, False),
+    "fault": (dict, False),
+}
+
+FAULT_FIELDS = {
+    "broken_total": (int, True),
+    "newly_expired": (int, True),
+    "life_min": (_NUM, True),
+    "life_mean": (_NUM, True),
+    "writes_saved": (int, True),
+    "per_param": (dict, False),
+}
+
+PER_PARAM_FIELDS = {
+    "broken": (int, True),
+    "newly_expired": (int, True),
+    "life_min": (_NUM, True),
+    "life_mean": (_NUM, True),
+}
+
+
+def _check_value(val, types):
+    """A value matches when it is of the accepted type(s), or a
+    NON-EMPTY list of them (a sweep record carries per-config vectors;
+    an empty vector is always an emission bug, not data)."""
+    if isinstance(val, bool):           # bool is an int subclass in JSON
+        return False
+    if isinstance(val, types):
+        return True
+    if isinstance(val, list):
+        return bool(val) and all(
+            not isinstance(v, bool) and isinstance(v, types)
+            for v in val)
+    return False
+
+
+def _check_fields(rec, fields, where):
+    errs = []
+    for key, (types, required) in fields.items():
+        if key not in rec:
+            if required:
+                errs.append(f"{where}: missing required field {key!r}")
+            continue
+        if not _check_value(rec[key], types):
+            errs.append(f"{where}: field {key!r} has invalid type "
+                        f"{type(rec[key]).__name__}")
+    return errs
+
+
+def validate_record(rec) -> list:
+    """Return a list of schema violations (empty = valid)."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    errs = _check_fields(rec, TOP_LEVEL, "record")
+    if rec.get("schema_version") not in (None, SCHEMA_VERSION):
+        errs.append(f"record: schema_version {rec['schema_version']!r} "
+                    f"!= {SCHEMA_VERSION}")
+    if isinstance(rec.get("iter"), int) and rec["iter"] < 0:
+        errs.append("record: iter must be >= 0")
+    outs = rec.get("outputs")
+    if isinstance(outs, dict):
+        for name, v in outs.items():
+            if not _check_value(v, _NUM):
+                errs.append(f"outputs[{name!r}]: not a number (or list)")
+    fault = rec.get("fault")
+    if isinstance(fault, dict):
+        errs += _check_fields(fault, FAULT_FIELDS, "fault")
+        per = fault.get("per_param")
+        if isinstance(per, dict):
+            for key, entry in per.items():
+                if not isinstance(entry, dict):
+                    errs.append(f"fault.per_param[{key!r}]: not an object")
+                    continue
+                errs += _check_fields(entry, PER_PARAM_FIELDS,
+                                      f"fault.per_param[{key!r}]")
+    return errs

@@ -1,0 +1,159 @@
+"""Host-side metric sinks: a JSONL writer and a Caffe-format text emitter.
+
+The logger is a plain registry — `MetricsLogger([sink, ...]).log(record)`
+fans a record out to every sink. Records are built with `make_record`
+(schema.py documents the shape) and are plain dicts of Python scalars, so
+any sink is a few lines.
+
+`CaffeLogSink` exists for the legacy-tooling compatibility promise: it
+emits glog-prefixed lines with EXACTLY the shapes the reference solver
+printed ("Iteration N, lr = X", "Iteration N, loss = X", "    Train net
+output #j: name = v", plus the timestamped "Solving <net>" banner), so
+`tools/parse_log.py`, `tools/plot_training_log.py`, and
+`tools/extract_seconds.py` scrape it unchanged.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import time
+from typing import Optional
+
+from .schema import SCHEMA_VERSION
+
+
+def make_record(iteration: int, metrics: Optional[dict] = None,
+                smoothed_loss: Optional[float] = None,
+                outputs: Optional[dict] = None,
+                elapsed_s: Optional[float] = None, n_iters: int = 1,
+                seed: Optional[int] = None) -> dict:
+    """Assemble one schema-versioned record from the materialized
+    on-device metrics plus host-side timing. `elapsed_s` spans the
+    `n_iters` iterations since the previous record (the first interval
+    includes jit compile time — by design: it is the wall time the user
+    actually waited)."""
+    metrics = dict(metrics or {})
+    fault = metrics.pop("fault", None)
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "loss": metrics.pop("loss", smoothed_loss),
+        "lr": metrics.pop("lr", 0.0),
+        "step_latency_s": (elapsed_s / max(n_iters, 1)
+                           if elapsed_s is not None else 0.0),
+        "iters_per_s": (max(n_iters, 1) / elapsed_s
+                        if elapsed_s else 0.0),
+    }
+    if smoothed_loss is not None:
+        rec["smoothed_loss"] = float(smoothed_loss)
+    if seed is not None:
+        rec["seed"] = int(seed)
+    for key in ("grad_norm", "update_norm"):
+        if key in metrics:
+            rec[key] = metrics.pop(key)
+    if outputs:
+        rec["outputs"] = dict(outputs)
+    if fault is not None:
+        rec["fault"] = fault
+    return rec
+
+
+class MetricsLogger:
+    """Sink registry. Every `log(record)` fans out to all sinks; sinks
+    are closed (flushed) by `close` — call it when the run ends."""
+
+    def __init__(self, sinks=()):
+        self.sinks = list(sinks)
+
+    def add(self, sink):
+        self.sinks.append(sink)
+        return sink
+
+    def log(self, record: dict):
+        for s in self.sinks:
+            s.write(record)
+
+    def close(self):
+        for s in self.sinks:
+            close = getattr(s, "close", None)
+            if close:
+                close()
+
+
+class JsonlSink:
+    """One JSON object per line per display interval (schema.py).
+    `append=True` continues an existing log (a resumed run must not
+    truncate the degradation trajectory already captured)."""
+
+    def __init__(self, path: str, append: bool = False):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        self._f = open(path, "a" if append else "w")
+
+    def write(self, record: dict):
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+
+def _scalar(v):
+    """The Caffe line shape is inherently scalar; a sweep record's
+    per-config vector (schema-legal) is emitted as its mean."""
+    if isinstance(v, list):
+        return sum(v) / len(v) if v else 0.0
+    return v
+
+
+class CaffeLogSink:
+    """Caffe/glog-format text emitter (see module docstring). The banner
+    and every line carry a glog timestamp prefix so elapsed-seconds
+    extraction works; the reference binary's own logs parse with the
+    identical regexes."""
+
+    def __init__(self, path: str, net_name: str = "net",
+                 append: bool = False):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        had_content = append and os.path.exists(path) \
+            and os.path.getsize(path) > 0
+        self._f = open(path, "a" if append else "w")
+        if not had_content:
+            # one banner per log: extract_seconds measures elapsed time
+            # from the FIRST 'Solving' line, so a resumed segment keeps
+            # the original solve start
+            self._emit(f"Solving {net_name}")
+
+    def _emit(self, line: str):
+        now = datetime.datetime.now()
+        prefix = ("I%02d%02d %02d:%02d:%02d.%06d %5d solver.py:0] "
+                  % (now.month, now.day, now.hour, now.minute, now.second,
+                     now.microsecond, os.getpid()))
+        self._f.write(prefix + line + "\n")
+
+    def write(self, record: dict):
+        it = record["iter"]
+        lr = _scalar(record.get("lr", 0.0))
+        loss = _scalar(record.get("smoothed_loss",
+                                  record.get("loss", 0.0)))
+        self._emit(f"Iteration {it}, lr = {lr:g}")
+        self._emit(f"Iteration {it}, loss = {loss:g}")
+        j = 0
+        for name, v in (record.get("outputs") or {}).items():
+            vals = v if isinstance(v, list) else [v]
+            for x in vals:
+                self._emit(f"    Train net output #{j}: {name} = {x:g}")
+                j += 1
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()

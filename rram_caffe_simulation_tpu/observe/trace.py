@@ -1,0 +1,28 @@
+"""jax.profiler integration.
+
+`trace(profile_dir)` wraps a code region in a profiler capture when a
+directory is given and is a no-op otherwise — the train/time CLI
+subcommands thread their `--profile-dir` flag through it. The capture is
+the standard XProf dump: open it with TensorBoard's Profile plugin
+(`tensorboard --logdir <dir>`) or load the
+`plugins/profile/*/*.trace.json.gz` file into Perfetto / chrome://tracing.
+
+Phase attribution inside the step comes from `jax.named_scope`
+annotations in `Solver.make_train_step` (forward_backward /
+compute_update / apply_strategy / apply_update / fail / metrics): XLA
+propagates the scope names into op metadata, so the trace viewer groups
+device time by training phase. `examples/profile_train.py` aggregates
+the same capture into an HLO-category table.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+def trace(profile_dir=None):
+    """Context manager: capture a jax.profiler trace under `profile_dir`
+    when set (created if missing); `contextlib.nullcontext()` otherwise."""
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(profile_dir)

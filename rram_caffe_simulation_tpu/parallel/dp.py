@@ -46,6 +46,10 @@ def make_dp_step(solver, mesh: Mesh):
                                 (params, history, fault_state))
         return jax.device_put((params, history, fault_state), sharding)
 
+    # six outputs: (params, history, fault, loss, outputs, metrics) —
+    # all replicated. The metrics pytree needs no hand-written psum:
+    # its reductions run over replicated/sharded state inside the jitted
+    # step, so GSPMD emits the cross-replica aggregate directly.
     jitted = jax.jit(step, donate_argnums=(0, 1, 2),
-                     out_shardings=(repl, repl, repl, repl, repl))
+                     out_shardings=(repl, repl, repl, repl, repl, repl))
     return jitted, place_state

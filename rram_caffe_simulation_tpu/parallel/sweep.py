@@ -81,6 +81,11 @@ class SweepRunner:
                 "use Solver.enable_model_parallel instead")
         self.mesh = mesh
         self.iter = 0
+        # last executed iteration's per-config metrics pytree (leading
+        # config axis), {} until a step runs or when the solver has no
+        # metrics enabled (Solver.enable_metrics before building the
+        # runner switches the counters on)
+        self.last_metrics = {}
 
         flat = solver._flat(solver.params)
         shapes = {k: flat[k].shape for k in solver._fault_keys}
@@ -162,12 +167,12 @@ class SweepRunner:
                 def f(blk):
                     pf, hf, ff, rg = blk
                     p, h, fa = blk_un((pf, hf, ff), shp)
-                    p2, h2, f2, loss, outs = inner_v(
+                    p2, h2, f2, loss, outs, mets = inner_v(
                         p, h, fa, batch, it, rg, remap)
                     return (blk_fl(p2), blk_fl(h2), blk_fl(f2), loss,
-                            outs)
+                            outs, mets)
 
-                pf, hf, ff, lf, of = jax.lax.map(
+                pf, hf, ff, lf, of, mf = jax.lax.map(
                     f, (flat2(params), flat2(history), flat2(fault),
                         jax.tree.map(
                             lambda a: a.reshape((G, B) + a.shape[1:]),
@@ -177,7 +182,7 @@ class SweepRunner:
                 join = lambda t: jax.tree.map(
                     lambda a: a.reshape((-1,) + a.shape[2:]), t)
                 p3, h3, f3 = unstk((pf, hf, ff), shp)
-                return p3, h3, f3, join(lf), join(of)
+                return p3, h3, f3, join(lf), join(of), join(mf)
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._vstep = vstep
         self._chunk_fns = {}
@@ -257,15 +262,15 @@ class SweepRunner:
                 def one(carry, xs):
                     params, history, fault = carry
                     batch_t, it_t, remap_t = xs
-                    p2, h2, f2, loss, outputs = inner(
+                    p2, h2, f2, loss, outputs, mets = inner(
                         params, history, fault, batch_t, it_t, remap_t)
-                    return (p2, h2, f2), (loss, outputs)
+                    return (p2, h2, f2), (loss, outputs, mets)
 
                 def run(params, history, fault, batches, its, remaps):
-                    (p, h, f), (losses, outputs) = jax.lax.scan(
+                    (p, h, f), (losses, outputs, mets) = jax.lax.scan(
                         one, (params, history, fault),
                         (batches, its, remaps))
-                    return p, h, f, losses, outputs
+                    return p, h, f, losses, outputs, mets
             else:
                 B, N = self._ds_batch, self._ds_n
 
@@ -284,15 +289,15 @@ class SweepRunner:
                             name: jax.lax.with_sharding_constraint(
                                 v, self._batch_sharding(v.ndim))
                             for name, v in batch_t.items()}
-                    p2, h2, f2, loss, outputs = inner(
+                    p2, h2, f2, loss, outputs, mets = inner(
                         params, history, fault, batch_t, it_t, remap_t)
-                    return (p2, h2, f2), (loss, outputs)
+                    return (p2, h2, f2), (loss, outputs, mets)
 
                 def run(params, history, fault, its, starts, remaps):
-                    (p, h, f), (losses, outputs) = jax.lax.scan(
+                    (p, h, f), (losses, outputs, mets) = jax.lax.scan(
                         one, (params, history, fault),
                         (its, starts, remaps))
-                    return p, h, f, losses, outputs
+                    return p, h, f, losses, outputs, mets
 
             self._chunk_fns[key] = jax.jit(run, donate_argnums=(0, 1, 2))
         return self._chunk_fns[key]
@@ -413,11 +418,12 @@ class SweepRunner:
                     remaps.append(self._remap_due())
                     self.iter += 1
                 (self.params, self.history, self.fault_states, losses,
-                 outputs) = self._chunk_fn(k)(
+                 outputs, mets) = self._chunk_fn(k)(
                     self.params, self.history, self.fault_states,
                     jnp.asarray(its, jnp.int32),
                     jnp.asarray(starts, jnp.int32),
                     jnp.asarray(remaps))
+                self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
                 done += k
             return (np.asarray(losses)[-1],
                     jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
@@ -430,10 +436,11 @@ class SweepRunner:
                         jax.random.fold_in(s._key, self.iter), i))(
                             jnp.arange(self.n))
                 (self.params, self.history, self.fault_states, loss,
-                 outputs) = self._step(self.params, self.history,
-                                       self.fault_states, batch,
-                                       jnp.int32(self.iter), rngs,
-                                       self._remap_due())
+                 outputs, mets) = self._step(self.params, self.history,
+                                             self.fault_states, batch,
+                                             jnp.int32(self.iter), rngs,
+                                             self._remap_due())
+                self.last_metrics = mets
                 self.iter += 1
             return np.asarray(loss), jax.tree.map(np.asarray, outputs)
 
@@ -451,9 +458,10 @@ class SweepRunner:
                 {kk: np.stack([sb[kk] for sb in subs]) for kk in subs[0]},
                 stacked=True)
             (self.params, self.history, self.fault_states, losses,
-             outputs) = self._chunk_fn(k)(
+             outputs, mets) = self._chunk_fn(k)(
                 self.params, self.history, self.fault_states, batches,
                 jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
+            self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
             done += k
         return (np.asarray(losses)[-1],
                 jax.tree.map(lambda x: np.asarray(x)[-1], outputs))

@@ -148,11 +148,14 @@ def place_state(solver, mesh: Mesh, layer_specs: dict):
     """device_put the solver's params/history/fault_state with their TP
     shardings. Returns (params, history, fault_state,
     out_shardings_tuple) where the tuple mirrors the train step's
-    (params', history', fault', loss, outs) outputs (loss/outputs
-    entries are the replicated prefix)."""
+    (params', history', fault', loss, outs, metrics) outputs —
+    loss/outputs/metrics are replicated; the metrics counters are
+    reductions over the SHARDED fault state and grads, so GSPMD inserts
+    the cross-shard all-reduce and the replicated scalar is already the
+    whole-matrix census."""
     params, history, fault_state, (pshard, hshard, fshard) = place_trees(
         mesh, layer_specs, flat_specs(solver, layer_specs),
         solver.params, solver.history, solver.fault_state)
     repl = NamedSharding(mesh, P())
     return params, history, fault_state, (pshard, hshard, fshard,
-                                          repl, repl)
+                                          repl, repl, repl)

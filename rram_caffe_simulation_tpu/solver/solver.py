@@ -61,6 +61,35 @@ def _train_net_param(param: "pb.SolverParameter") -> "pb.NetParameter":
                               else param.net)
 
 
+class _IntervalClock:
+    """Host-side bookkeeping for the interval between metric records:
+    training wall time (test/snapshot time excluded via `exclude`),
+    iteration count, and the per-step writes_saved device scalars (or
+    per-chunk vectors) collected for the record's interval total. Lives
+    on the Solver so repeated `step(1)` calls (the pycaffe loop shape)
+    keep ONE running interval across calls instead of resetting it."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self, now: Optional[float] = None):
+        self.t0 = time.perf_counter() if now is None else now
+        self.excl = 0.0
+        self.n = 0
+        self.ws: list = []
+
+    def tick(self, k: int = 1, writes_saved=None):
+        self.n += k
+        if writes_saved is not None:
+            self.ws.append(writes_saved)
+
+    def exclude(self, t_start: float):
+        self.excl += time.perf_counter() - t_start
+
+    def elapsed(self, now: float) -> float:
+        return now - self.t0 - self.excl
+
+
 class Solver:
     """Owns the train/test nets, parameter + history + fault state, and the
     jitted train step. API mirrors the reference Solver (solver.hpp):
@@ -82,9 +111,25 @@ class Solver:
         self.smoothed_loss = 0.0
         self._requested_action = None
 
-        seed = param.random_seed if param.random_seed >= 0 else (
-            int(time.time()) & 0x7FFFFFFF)
+        if param.random_seed >= 0:
+            seed = param.random_seed
+        elif os.environ.get("RRAM_TPU_SEED"):
+            # reproducibility hook: a failing run seeded from wall-clock
+            # time cannot be replayed; the env var pins the fallback
+            # (and the first metrics record logs whichever seed won)
+            seed = int(os.environ["RRAM_TPU_SEED"]) & 0x7FFFFFFF
+        else:
+            seed = int(time.time()) & 0x7FFFFFFF
+        self.seed = seed
         self._key = jax.random.PRNGKey(seed)
+
+        # --- telemetry (observe package): attach sinks with
+        # enable_metrics() BEFORE the first step ---
+        self.metrics_logger = None
+        self._metrics_enabled = False
+        self._seed_logged = False
+        self._step_baked = False   # any make_train_step call sets this
+        self._mclock = None        # _IntervalClock once metrics enabled
 
         # --- nets (InitTrainNet/InitTestNets, solver.cpp:95-230) ---
         net_param = _train_net_param(param)
@@ -266,12 +311,24 @@ class Solver:
     # the jitted train step
 
     def make_train_step(self, hw_engine: str = "auto",
-                        compute_dtype=None, apply_fn=None):
+                        compute_dtype=None, apply_fn=None,
+                        with_metrics=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
-          -> (params', history', fault_state', loss, outputs)
+          -> (params', history', fault_state', loss, outputs, metrics)
         — ForwardBackward + ComputeUpdate + ApplyStrategy + ApplyUpdate +
         Fail in one traced computation (solver.cpp:238-321).
+
+        `with_metrics` (default: whether `enable_metrics` was called)
+        adds the observe-package counters as in-step reductions — fault
+        census (broken/newly-expired/lifetime min-mean per param),
+        write-traffic saved by the threshold strategy, grad/update
+        global norms, loss, lr — returned as the trailing `metrics`
+        pytree ({} when off). No extra dispatches: the scalars ride the
+        step outputs and the host reads them at display boundaries
+        only. Every phase is wrapped in `jax.named_scope` so profiler
+        captures attribute device time to forward_backward /
+        compute_update / apply_strategy / apply_update / fail.
 
         `hw_engine` selects how the hardware-aware forward (rram_forward)
         reads fault-target weights, mirroring the reference's Caffe-vs-
@@ -312,6 +369,8 @@ class Solver:
         flat = self._flat
         unflat = self._unflat
         has_fault = self.fault_state is not None
+        metrics_on = (self._metrics_enabled if with_metrics is None
+                      else bool(with_metrics))
         # Hardware-aware forward (RRAMForwardParameter, framework
         # extension): fault-target weights are READ through the crossbar's
         # conductance variation each forward, straight-through gradients.
@@ -407,96 +466,143 @@ class Solver:
 
         def step(params, history, fault_state, batch, it, rng, do_remap):
             # -- ForwardBackward x iter_size (solver.cpp:265-269) --
-            if iter_size == 1:
-                loss, outputs, newp, grads = forward_backward(
-                    params, batch, it, rng, fault_state)
-            else:
-                def body(carry, sub):
-                    p, g_acc, loss_acc, i = carry
-                    l, outs, p2, g = forward_backward(
-                        p, sub, it, jax.random.fold_in(rng, i),
-                        fault_state)
-                    g_acc = jax.tree.map(jnp.add, g_acc, g)
-                    return (p2, g_acc, loss_acc + l, i + 1), outs
-                zero_g = jax.tree.map(jnp.zeros_like, params)
-                (newp, grads, loss, _), outs_seq = jax.lax.scan(
-                    body, (params, zero_g, 0.0, 0), batch)
-                outputs = jax.tree.map(lambda x: x[-1], outs_seq)
-                loss = loss / iter_size
+            with jax.named_scope("forward_backward"):
+                if iter_size == 1:
+                    loss, outputs, newp, grads = forward_backward(
+                        params, batch, it, rng, fault_state)
+                else:
+                    def body(carry, sub):
+                        p, g_acc, loss_acc, i = carry
+                        l, outs, p2, g = forward_backward(
+                            p, sub, it, jax.random.fold_in(rng, i),
+                            fault_state)
+                        g_acc = jax.tree.map(jnp.add, g_acc, g)
+                        return (p2, g_acc, loss_acc + l, i + 1), outs
+                    zero_g = jax.tree.map(jnp.zeros_like, params)
+                    (newp, grads, loss, _), outs_seq = jax.lax.scan(
+                        body, (params, zero_g, 0.0, 0), batch)
+                    outputs = jax.tree.map(lambda x: x[-1], outs_seq)
+                    loss = loss / iter_size
             data = flat(newp)      # BatchNorm stats already advanced
             g = flat(grads)
 
             # -- ComputeUpdate (sgd_solver.cpp:102-117) --
-            rate = lr_fn(it)
-            if clip >= 0:
-                # ClipGradients (sgd_solver.cpp:82-100): global L2 rescale
-                sumsq = sum(jnp.sum(v * v) for v in g.values())
-                l2 = jnp.sqrt(sumsq)
-                scale = jnp.where(l2 > clip, clip / jnp.maximum(l2, 1e-30),
-                                  1.0)
-                g = {k: v * scale for k, v in g.items()}
-            upd = {}
-            new_hist = {}
-            t = it + 1
-            for r in owner_refs:
-                k = fault_engine.param_key(r.layer_name, r.slot)
-                diff = g[k]
-                if iter_size != 1:   # Normalize (sgd_solver.cpp:123)
-                    diff = diff / iter_size
-                # Regularize (sgd_solver.cpp:149-215)
-                local_decay = weight_decay * decay_mults[k]
-                if local_decay:
-                    if reg_type == "L2":
-                        diff = diff + local_decay * data[k]
-                    elif reg_type == "L1":
-                        diff = diff + local_decay * jnp.sign(data[k])
-                    else:
-                        raise ValueError(
-                            f"unknown regularization {reg_type!r}")
-                local_rate = rate * lr_mults[k]
-                upd[k], new_hist[k] = rule(diff, history[k], local_rate,
-                                           hp, t)
+            with jax.named_scope("compute_update"):
+                rate = lr_fn(it)
+                grad_sumsq = None
+                if clip >= 0 or metrics_on:
+                    # shared by ClipGradients and the grad_norm counter
+                    grad_sumsq = sum(jnp.sum(v * v) for v in g.values())
+                if clip >= 0:
+                    # ClipGradients (sgd_solver.cpp:82-100): global L2
+                    # rescale
+                    l2 = jnp.sqrt(grad_sumsq)
+                    scale = jnp.where(l2 > clip,
+                                      clip / jnp.maximum(l2, 1e-30), 1.0)
+                    g = {k: v * scale for k, v in g.items()}
+                upd = {}
+                new_hist = {}
+                t = it + 1
+                for r in owner_refs:
+                    k = fault_engine.param_key(r.layer_name, r.slot)
+                    diff = g[k]
+                    if iter_size != 1:   # Normalize (sgd_solver.cpp:123)
+                        diff = diff / iter_size
+                    # Regularize (sgd_solver.cpp:149-215)
+                    local_decay = weight_decay * decay_mults[k]
+                    if local_decay:
+                        if reg_type == "L2":
+                            diff = diff + local_decay * data[k]
+                        elif reg_type == "L1":
+                            diff = diff + local_decay * jnp.sign(data[k])
+                        else:
+                            raise ValueError(
+                                f"unknown regularization {reg_type!r}")
+                    local_rate = rate * lr_mults[k]
+                    upd[k], new_hist[k] = rule(diff, history[k],
+                                               local_rate, hp, t)
 
             # -- ApplyStrategy (solver.cpp:302; strategy.cpp) --
-            if strategies.threshold is not None and fault_keys:
-                fd = {k: upd[k] for k in fault_keys}
-                fd = fault_strategies.threshold_diffs(
-                    fd, rate, lr_mults, strategies.threshold)
-                upd.update(fd)
-            if strategies.prune_orders is not None and has_fault:
-                if strategies.remap_tracked:
-                    def remap(dd):
-                        d, u, slots = dd
-                        return fault_strategies.remap_fc_neurons_tracked(
-                            d, u, fault_state, fc_pairs,
-                            strategies.prune_orders, slots)
-                    data, upd, new_slots = jax.lax.cond(
-                        do_remap, remap, lambda dd: dd,
-                        (data, upd, fault_state["remap_slots"]))
-                    fault_state = {**fault_state,
-                                   "remap_slots": new_slots}
-                else:
-                    def remap(dd):
-                        return fault_strategies.remap_fc_neurons(
-                            dd[0], dd[1], fault_state, fc_pairs,
-                            strategies.prune_orders)
-                    data, upd = jax.lax.cond(do_remap, remap,
-                                             lambda dd: dd, (data, upd))
+            writes_saved = jnp.int32(0)
+            with jax.named_scope("apply_strategy"):
+                if strategies.threshold is not None and fault_keys:
+                    fd_before = {k: upd[k] for k in fault_keys}
+                    fd = fault_strategies.threshold_diffs(
+                        fd_before, rate, lr_mults, strategies.threshold)
+                    if metrics_on:
+                        from ..observe import counters as obs_counters
+                        writes_saved = obs_counters.write_traffic_saved(
+                            fd_before, fd, fault_engine.EPSILON,
+                            lifetimes=(fault_state["lifetimes"]
+                                       if has_fault else None))
+                    upd.update(fd)
+                if strategies.prune_orders is not None and has_fault:
+                    if strategies.remap_tracked:
+                        def remap(dd):
+                            d, u, slots = dd
+                            return \
+                                fault_strategies.remap_fc_neurons_tracked(
+                                    d, u, fault_state, fc_pairs,
+                                    strategies.prune_orders, slots)
+                        data, upd, new_slots = jax.lax.cond(
+                            do_remap, remap, lambda dd: dd,
+                            (data, upd, fault_state["remap_slots"]))
+                        fault_state = {**fault_state,
+                                       "remap_slots": new_slots}
+                    else:
+                        def remap(dd):
+                            return fault_strategies.remap_fc_neurons(
+                                dd[0], dd[1], fault_state, fc_pairs,
+                                strategies.prune_orders)
+                        data, upd = jax.lax.cond(do_remap, remap,
+                                                 lambda dd: dd,
+                                                 (data, upd))
 
             # -- ApplyUpdate (sgd_solver.cpp:119; blob.cpp:156) --
-            data = {k: data[k] - upd[k] for k in data}
+            with jax.named_scope("apply_update"):
+                data = {k: data[k] - upd[k] for k in data}
 
             # -- Fail (solver.cpp:305; failure_maker.cu:23-40) --
-            if has_fault:
-                fp = {k: data[k] for k in fault_keys}
-                fd = {k: upd[k] for k in fault_keys}
-                fp, fault_state = fault_engine.fail(
-                    fp, fault_state, fd, decrement)
-                data.update(fp)
+            prev_life = (fault_state["lifetimes"] if has_fault else None)
+            with jax.named_scope("fail"):
+                if has_fault:
+                    fp = {k: data[k] for k in fault_keys}
+                    fd = {k: upd[k] for k in fault_keys}
+                    fp, fault_state = fault_engine.fail(
+                        fp, fault_state, fd, decrement)
+                    data.update(fp)
+
+            # -- in-step telemetry (observe package, layer 1) --
+            metrics = {}
+            if metrics_on:
+                with jax.named_scope("metrics"):
+                    from ..observe import counters as obs_counters
+                    metrics = {
+                        "loss": jnp.asarray(loss, jnp.float32),
+                        "lr": jnp.asarray(rate, jnp.float32),
+                        # normalized by iter_size so the logged norm is
+                        # the EFFECTIVE gradient's (clip deliberately
+                        # uses the unnormalized sum, Caffe parity —
+                        # sgd_solver.cpp clips before Normalize)
+                        "grad_norm": jnp.sqrt(
+                            jnp.asarray(grad_sumsq, jnp.float32))
+                        / iter_size,
+                        "update_norm": jnp.sqrt(
+                            obs_counters.global_norm_sq(upd)),
+                    }
+                    if has_fault:
+                        totals, per = fault_engine.fault_counters(
+                            prev_life, fault_state["lifetimes"])
+                        totals["writes_saved"] = writes_saved
+                        metrics["fault"] = {**totals, "per_param": per}
 
             return (unflat(data, newp), new_hist, fault_state, loss,
-                    outputs)
+                    outputs, metrics)
 
+        # any baked step (dp/tp/pp/sweep or _compiled_step) froze the
+        # metrics_on choice — enable_metrics after this point would be a
+        # silent no-op, so it guards on the flag and raises instead
+        self._step_baked = True
         return step
 
     def _compiled_step(self):
@@ -505,6 +611,67 @@ class Solver:
                 self.make_train_step(compute_dtype=self.compute_dtype),
                 donate_argnums=(0, 1, 2))
         return self._step_fn
+
+    def enable_metrics(self, *sinks, logger=None):
+        """Attach host-side metric sinks (observe package) and switch the
+        jitted step to carry on-device counters. One record per display
+        interval goes to every sink; the first record logs the run's
+        seed. Call BEFORE the first step and before enable_*_parallel —
+        those bake the step function, and rebuilding it here would
+        silently drop their mesh placement."""
+        if (self._step_fn is not None or self._step_baked
+                or getattr(self, "_fused_fns", None)):
+            raise ValueError(
+                "enable_metrics must be called before the train step is "
+                "built (before the first step()/step_fused(), before "
+                "enable_data_parallel/enable_model_parallel/"
+                "enable_pipeline_parallel/enable_sequence_parallel, and "
+                "before constructing a SweepRunner)")
+        from ..observe import MetricsLogger
+        self.metrics_logger = (logger if logger is not None
+                               else MetricsLogger(list(sinks)))
+        self._metrics_enabled = True
+        self._mclock = _IntervalClock()
+        return self.metrics_logger
+
+    def _log_metrics_record(self, metrics, outputs, elapsed_s, n_iters,
+                            iteration=None, writes_saved_acc=None):
+        """Materialize the step's on-device counters and fan a record out
+        to the sinks (the ONE device->host transfer, at a display
+        boundary where the loop already synchronizes).
+
+        `elapsed_s` must cover TRAINING wall time only (callers subtract
+        test/snapshot time); `writes_saved_acc` is a list of per-step
+        device scalars whose sum replaces the instantaneous
+        writes_saved, making the record the interval total — records
+        then sum to the run's whole write-budget saving."""
+        from ..observe import counters as obs_counters
+        from ..observe import sink as obs_sink
+        host = obs_counters.to_host(metrics) if metrics else {}
+        if writes_saved_acc and "fault" in host:
+            # entries are per-step scalars (step) or per-chunk vectors
+            # (step_fused); summed HOST-SIDE in int64 — an on-device
+            # int32 sum would wrap at 2^31 (CaffeNet fc6 alone is ~37M
+            # cells, a 100-step interval total exceeds int32)
+            vals = jax.device_get(list(writes_saved_acc))
+            host["fault"]["writes_saved"] = int(
+                sum(int(np.asarray(v, np.int64).sum()) for v in vals))
+        outs = {}
+        if outputs:
+            for name in self.net.output_names:
+                if name not in outputs:
+                    continue
+                v = np.ravel(np.asarray(outputs[name]))
+                outs[name] = float(v[0]) if v.size == 1 else v.tolist()
+        rec = obs_sink.make_record(
+            iteration=self.iter if iteration is None else iteration,
+            metrics=host,
+            smoothed_loss=self.smoothed_loss, outputs=outs,
+            elapsed_s=elapsed_s, n_iters=n_iters,
+            seed=None if self._seed_logged else self.seed)
+        self._seed_logged = True
+        self.metrics_logger.log(rec)
+        return rec
 
     def enable_data_parallel(self, mesh=None, devices=None):
         """Switch the train loop to synchronous data parallelism over a
@@ -785,23 +952,38 @@ class Solver:
         self.losses = []
         self.smoothed_loss = 0.0
         genetic = self.strategies.genetic
+        # metric records fire at display boundaries; with display == 0
+        # nothing would ever be logged, so don't accumulate either
+        # (caffe_cli warns about that combination)
+        track = self._metrics_enabled and bool(param.display)
+        mlog = self.metrics_logger if track else None
+        clock = self._mclock if track else None
         for _ in range(iters):
             if (param.test_interval and
                     self.iter % param.test_interval == 0 and
                     (self.iter > 0 or param.test_initialization)):
+                t0 = time.perf_counter()
                 self.test_all()
+                if track:
+                    clock.exclude(t0)
             if genetic is not None and genetic.due():
                 self._apply_genetic(genetic)
             batch = self._next_batch()
             rng = jax.random.fold_in(self._key, self.iter)
             (self.params, self.history, self.fault_state, loss,
-             outputs) = step_fn(
+             outputs, metrics) = step_fn(
                 self.params, self.history, self.fault_state, batch,
                 jnp.int32(self.iter), rng, self._remap_due())
             # last step's net outputs, device-resident (pycaffe exposes
             # them as net.blobs after solver.step; the api view pulls them)
             self.last_outputs = outputs
             self._record_loss(loss, start_iter, average_loss)
+            if track:
+                # writes_saved rides as a device scalar, no sync; summed
+                # at the next record so it totals the interval rather
+                # than sampling one step
+                clock.tick(1, metrics["fault"]["writes_saved"]
+                           if (metrics and "fault" in metrics) else None)
             display = param.display and self.iter % param.display == 0
             if display:
                 self._materialize_smoothed_loss()
@@ -817,9 +999,18 @@ class Solver:
                                  if w else "")
                         print(f"    Train net output #{j}: {name} = "
                               f"{float(v):g}{extra}", flush=True)
+                if mlog is not None:
+                    now = time.perf_counter()
+                    self._log_metrics_record(
+                        metrics, outputs, clock.elapsed(now), clock.n,
+                        writes_saved_acc=clock.ws)
+                    clock.reset(now)
             self.iter += 1
             if (param.snapshot and self.iter % param.snapshot == 0):
+                t0 = time.perf_counter()
                 self.snapshot()
+                if track:
+                    clock.exclude(t0)
             if self._requested_action == "stop":
                 break
         self._materialize_smoothed_loss()
@@ -876,14 +1067,21 @@ class Solver:
                     p, h, f = carry
                     b, it, rm = x
                     rng = jax.random.fold_in(key, it)
-                    p, h, f, loss, _ = step_fn(p, h, f, b, it, rng, rm)
-                    return (p, h, f), loss
-                (p, h, f), losses = jax.lax.scan(
+                    p, h, f, loss, _, m = step_fn(p, h, f, b, it, rng,
+                                                  rm)
+                    return (p, h, f), (loss, m)
+                (p, h, f), (losses, mseq) = jax.lax.scan(
                     body, (params, history, fault),
                     (batches, its, remaps), length=n)
-                return p, h, f, losses
+                # mseq: the metrics pytree stacked over the chunk —
+                # scalars x n, so carrying every iteration out costs
+                # nothing; the host materializes the display iteration
+                return p, h, f, losses, mseq
             return jax.jit(run, donate_argnums=(0, 1, 2))
 
+        track = self._metrics_enabled and bool(param.display)
+        mlog = self.metrics_logger if track else None
+        clock = self._mclock if track else None
         done = 0
         while done < iters:
             n = min(chunk, iters - done)
@@ -911,9 +1109,15 @@ class Solver:
             else:
                 batches = {}
             (self.params, self.history, self.fault_state,
-             losses) = self._fused_fns[n](
+             losses, mseq) = self._fused_fns[n](
                 self.params, self.history, self.fault_state,
                 batches, its, remaps)
+            if track:
+                # the whole per-chunk VECTOR rides to the record, where
+                # the host sums in int64 (an on-device int32 chunk sum
+                # would wrap on big-net intervals)
+                clock.tick(n, mseq["fault"]["writes_saved"]
+                           if (mseq and "fault" in mseq) else None)
             if n >= average_loss:
                 # ring buffer = the chunk's tail, stored at the SAME
                 # slot positions _record_loss would use (slot p holds
@@ -942,11 +1146,34 @@ class Solver:
                       flush=True)
                 print(f"Iteration {self.iter - 1}, loss = "
                       f"{self.smoothed_loss:g}", flush=True)
+            if mlog is not None and param.display and (
+                    (self.iter - n) // param.display
+                    != self.iter // param.display):
+                # chunk-granular like display itself, but fires whenever
+                # the chunk CROSSED a display boundary (a chunk size
+                # that never lands exactly on one must not silently
+                # hoard clock.ws device buffers forever). The record
+                # carries the LAST scanned iteration's counters
+                # (writes_saved excepted — interval total, above).
+                last = jax.tree.map(lambda x: x[-1], mseq)
+                self._materialize_smoothed_loss()
+                now = time.perf_counter()
+                self._log_metrics_record(
+                    last, None, clock.elapsed(now), clock.n,
+                    iteration=self.iter - 1,
+                    writes_saved_acc=clock.ws)
+                clock.reset(now)
             if (param.test_interval and
                     self.iter % param.test_interval == 0):
+                t0 = time.perf_counter()
                 self.test_all()
+                if track:
+                    clock.exclude(t0)
             if param.snapshot and self.iter % param.snapshot == 0:
+                t0 = time.perf_counter()
                 self.snapshot()
+                if track:
+                    clock.exclude(t0)
             done += n
             if self._requested_action == "stop":
                 break

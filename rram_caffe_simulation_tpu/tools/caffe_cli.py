@@ -65,6 +65,26 @@ def train(args):
                  "not both")
     solver = Solver(args.solver,
                     compute_dtype=args.compute_dtype or None)
+    if args.metrics_out:
+        # observe package layer 2: one record per display interval.
+        # Extension picks the sink — .jsonl gets the schema-versioned
+        # JSONL sink, anything else the Caffe-format text emitter that
+        # parse_log.py / plot_training_log.py / extract_seconds.py
+        # scrape unchanged. Attached BEFORE the parallel enables below
+        # so their baked step functions carry the on-device counters.
+        from ..observe import CaffeLogSink, JsonlSink
+        resume = bool(args.snapshot)   # resumed run: append, don't
+        sink = (JsonlSink(args.metrics_out, append=resume)  # truncate
+                if args.metrics_out.endswith(".jsonl")
+                else CaffeLogSink(args.metrics_out,
+                                  net_name=solver.net.name,
+                                  append=resume))
+        solver.enable_metrics(sink)
+        if not solver.param.display:
+            print("Warning: --metrics-out with display = 0 writes no "
+                  "records (they are emitted at display boundaries); "
+                  "set `display` in the solver prototxt",
+                  file=sys.stderr, flush=True)
     if args.weights:
         for w in args.weights.split(","):
             solver.params = solver.net.copy_trained_from(solver.params, w)
@@ -139,8 +159,15 @@ def train(args):
         fused_chunk = math.gcd(*intervals) if intervals else 100
         print(f"Amortized stepping: {fused_chunk} iterations per "
               "dispatch", flush=True)
-    solver.solve(resume_file=args.snapshot or None,
-                 fused_chunk=fused_chunk)
+    from ..observe import trace
+    with trace(args.profile_dir or None):
+        solver.solve(resume_file=args.snapshot or None,
+                     fused_chunk=fused_chunk)
+    if args.profile_dir:
+        print(f"Profiler trace written to {args.profile_dir} (open with "
+              "TensorBoard's Profile plugin or Perfetto)", flush=True)
+    if solver.metrics_logger is not None:
+        solver.metrics_logger.close()
     return 0
 
 
@@ -280,8 +307,12 @@ def time(args):
                 jax.block_until_ready(run(params, batch))
             return (_time.perf_counter() - t0) / n * 1e3
 
-    t_fwd = timed(fwd_scalar, iters)
-    t_bwd = timed(fb_scalar, iters)
+    from ..observe import trace as _trace
+    with _trace(args.profile_dir or None):
+        t_fwd = timed(fwd_scalar, iters)
+        t_bwd = timed(fb_scalar, iters)
+    if args.profile_dir:
+        print(f"Profiler trace written to {args.profile_dir}")
 
     print(f"Average Forward pass: {t_fwd:.3f} ms.")
     print(f"Average Forward-Backward: {t_bwd:.3f} ms.")
@@ -555,6 +586,18 @@ def main(argv=None):
                    help="train/time: forward/backward dtype (e.g. "
                         "bfloat16 for MXU-native mixed precision; train "
                         "keeps masters/updates/fault state f32)")
+    p.add_argument("--metrics-out", default="",
+                   help="train: write one telemetry record per display "
+                        "interval (loss/lr/grad-update norms, fault "
+                        "census, step latency); *.jsonl -> JSONL sink "
+                        "(schema: USAGE.md Observability), other paths "
+                        "-> Caffe-format text log that parse_log.py / "
+                        "extract_seconds.py scrape unchanged")
+    p.add_argument("--profile-dir", default="",
+                   help="train/time: capture a jax.profiler trace of "
+                        "the run into this directory (TensorBoard "
+                        "Profile plugin / Perfetto); the train step's "
+                        "phases are named_scope-annotated")
     p.add_argument("--sigint_effect", default="stop",
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",

@@ -4,12 +4,16 @@ examples/cifar10/plot_pic.py — both regex-scrape the human-readable log).
 
 Our Solver emits the same line shapes ("Iteration N, loss = X",
 "Test net output #i: name = v"), so this parser works on logs from either
-framework.
+framework. It ALSO understands the observe package's JSONL metrics sink
+(one JSON record per display interval): a `.jsonl` path — or any file
+whose first non-blank line is a JSON object — routes through the JSONL
+parser, so one toolchain covers the old text logs and the new sinks.
 """
 from __future__ import annotations
 
 import argparse
 import csv
+import json
 import re
 import sys
 
@@ -20,9 +24,55 @@ TEST_BEGIN = re.compile(r"Iteration (\d+), Testing net \(#(\d+)\)")
 OUTPUT = re.compile(r"(Train|Test) net output #(\d+): (\S+) = ([\d.eE+-]+)")
 
 
+def is_jsonl(path: str) -> bool:
+    """JSONL metrics sink? By extension, else by sniffing the first
+    non-blank line (text logs never start a line with '{')."""
+    if path.endswith(".jsonl"):
+        return True
+    with open(path) as f:
+        for line in f:
+            s = line.strip()
+            if s:
+                return s.startswith("{")
+    return False
+
+
+def parse_jsonl(path: str):
+    """JSONL metrics records -> the same (train_rows, test_rows) shape as
+    the text parser: loss (the displayed smoothed loss when present), lr,
+    named net outputs, plus the fault-census totals as extra columns.
+    Test rows: the JSONL sink logs train-side records only, so test_rows
+    is empty — point this tool at the text log for test-net scores."""
+    train: dict[int, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            row = train.setdefault(int(rec["iter"]), {})
+            loss = rec.get("smoothed_loss", rec.get("loss"))
+            if loss is not None and not isinstance(loss, list):
+                row["loss"] = float(loss)
+            if not isinstance(rec.get("lr"), (list, type(None))):
+                row["lr"] = float(rec["lr"])
+            for name, v in (rec.get("outputs") or {}).items():
+                if not isinstance(v, list):
+                    row[name] = float(v)
+            fault = rec.get("fault") or {}
+            for key in ("broken_total", "newly_expired", "life_min",
+                        "life_mean", "writes_saved"):
+                if key in fault and not isinstance(fault[key], list):
+                    row[key] = float(fault[key])
+    return train, {}
+
+
 def parse_log(path: str):
     """Returns (train_rows, test_rows): dicts keyed iteration with loss/lr
-    and named outputs."""
+    and named outputs. Dispatches on the format — Caffe-shaped text logs
+    and JSONL metrics sinks both land here."""
+    if is_jsonl(path):
+        return parse_jsonl(path)
     train: dict[int, dict] = {}
     test: dict[int, dict] = {}
     cur_test_iter = None

@@ -5,8 +5,16 @@ parameter counts from the net builder, per phase.
 
     python -m rram_caffe_simulation_tpu.tools.summarize \
         models/bvlc_googlenet/train_val.prototxt [--phase TEST]
+
+Pointed at a JSONL metrics log (observe package sink; auto-detected by
+extension/content) it summarizes the RUN instead of the net: iteration
+range, loss trajectory endpoints, step latency/throughput, and the
+final fault census.
+
+    python -m rram_caffe_simulation_tpu.tools.summarize run.jsonl
 """
 import argparse
+import json
 
 import numpy as np
 
@@ -107,14 +115,85 @@ def summarize(net_param, phase, flops=False):
     return "\n".join(lines)
 
 
+def _fmt_num(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def summarize_metrics(path):
+    """One-screen digest of a JSONL metrics log (schema: observe/schema.py
+    / USAGE.md Observability)."""
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    if not recs:
+        return f"{path}: no records"
+    first, last = recs[0], recs[-1]
+    lines = [f"Metrics log: {path}",
+             f"Records: {len(recs)} (schema v"
+             f"{first.get('schema_version', '?')})",
+             f"Iterations: {first.get('iter')} .. {last.get('iter')}"]
+    seeds = [(r["iter"], r["seed"]) for r in recs if "seed" in r]
+    if len(seeds) == 1:
+        lines.append(f"Seed: {seeds[0][1]}")
+    elif seeds:
+        # one per run segment (a resume appends with its own seed; each
+        # replays the iterations from its own record onward)
+        lines.append("Seeds: " + ", ".join(
+            f"{seed} (from iter {it})" for it, seed in seeds))
+    loss = lambda r: r.get("smoothed_loss", r.get("loss"))
+    lines.append(f"Loss: {_fmt_num(loss(first))} -> {_fmt_num(loss(last))}")
+    lines.append(f"LR: {_fmt_num(first.get('lr'))} -> "
+                 f"{_fmt_num(last.get('lr'))}")
+    lat = [r["step_latency_s"] for r in recs
+           if isinstance(r.get("step_latency_s"), (int, float))
+           and r["step_latency_s"] > 0]
+    if lat:
+        # the first interval includes jit compile; report it separately
+        steady = lat[1:] or lat
+        lines.append(f"Step latency: first interval {lat[0] * 1e3:.2f} ms"
+                     f" (incl. compile), steady "
+                     f"{float(np.mean(steady)) * 1e3:.2f} ms "
+                     f"({1.0 / float(np.mean(steady)):.1f} iters/s)")
+    fault = last.get("fault")
+    if isinstance(fault, dict):
+        lines.append(
+            "Fault census (final record): "
+            f"broken={_fmt_num(fault.get('broken_total'))} "
+            f"newly_expired={_fmt_num(fault.get('newly_expired'))} "
+            f"life_min={_fmt_num(fault.get('life_min'))} "
+            f"life_mean={_fmt_num(fault.get('life_mean'))} "
+            f"writes_saved={_fmt_num(fault.get('writes_saved'))}")
+        per = fault.get("per_param")
+        if isinstance(per, dict):
+            for key in sorted(per):
+                e = per[key]
+                lines.append(f"  {key:20s} broken="
+                             f"{_fmt_num(e.get('broken'))} "
+                             f"life_mean={_fmt_num(e.get('life_mean'))}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("prototxt")
+    p.add_argument("prototxt",
+                   help="net prototxt to summarize, or a JSONL metrics "
+                        "log (auto-detected) to digest")
     p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
     p.add_argument("--flops", action="store_true",
                    help="add an analytic forward-FLOPs column "
                         "(conv/deconv/inner-product MACs x 2)")
     args = p.parse_args(argv)
+    from .parse_log import is_jsonl
+    if is_jsonl(args.prototxt):
+        print(summarize_metrics(args.prototxt))
+        return 0
     net_param = uio.read_net_param(args.prototxt)
     phase = pb.TRAIN if args.phase == "TRAIN" else pb.TEST
     print(summarize(net_param, phase, flops=args.flops))

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Validate JSONL metrics records against the documented schema.
+
+The schema lives in `rram_caffe_simulation_tpu/observe/schema.py` (and is
+documented in USAGE.md "Observability"); this script is the CI/tooling
+face of it. It loads the schema module BY FILE PATH so validation needs
+no jax/protobuf — a bare Python interpreter checks a log in milliseconds.
+
+    python scripts/check_metrics_schema.py run.jsonl [more.jsonl ...]
+    python scripts/check_metrics_schema.py --sample
+
+`--sample` validates a built-in known-good record (and rejects a
+known-bad one) — the self-check the test suite runs as a tier-1 test.
+Exit status: 0 = every record of every file valid, 1 = violations (or an
+unreadable/empty file), 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCHEMA_PATH = os.path.join(_REPO, "rram_caffe_simulation_tpu", "observe",
+                            "schema.py")
+
+
+def _load_schema():
+    spec = importlib.util.spec_from_file_location("_metrics_schema",
+                                                  _SCHEMA_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+SAMPLE_GOOD = {
+    "schema_version": 1, "iter": 100, "wall_time": 1722700000.0,
+    "loss": 0.83, "smoothed_loss": 0.85, "lr": 0.01, "seed": 1701,
+    "step_latency_s": 0.0121, "iters_per_s": 82.6,
+    "grad_norm": 2.1, "update_norm": 0.2,
+    "outputs": {"loss": 0.83, "accuracy": 0.71},
+    "fault": {"broken_total": 120, "newly_expired": 7,
+              "life_min": -35.0, "life_mean": 9.1e7, "writes_saved": 4096,
+              "per_param": {"fc1/0": {"broken": 100, "newly_expired": 5,
+                                      "life_min": -35.0,
+                                      "life_mean": 8.9e7}}},
+}
+
+SAMPLE_BAD = {"schema_version": 1, "iter": -3, "loss": "NaN-ish",
+              "fault": {"broken_total": 1.5}}
+
+
+def check_file(path: str, schema) -> list:
+    errs = []
+    n = 0
+    try:
+        f = open(path)
+    except OSError as e:
+        return [f"{path}: {e}"]
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errs.append(f"{path}:{lineno}: not JSON ({e})")
+                continue
+            n += 1
+            for e in schema.validate_record(rec):
+                errs.append(f"{path}:{lineno}: {e}")
+    if n == 0:
+        errs.append(f"{path}: no records")
+    return errs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("files", nargs="*", help="JSONL metrics logs")
+    p.add_argument("--sample", action="store_true",
+                   help="validate the built-in sample records instead "
+                        "of files (self-check)")
+    args = p.parse_args(argv)
+    schema = _load_schema()
+    if args.sample:
+        good = schema.validate_record(SAMPLE_GOOD)
+        bad = schema.validate_record(SAMPLE_BAD)
+        if good:
+            print("sample record REJECTED by its own schema:")
+            for e in good:
+                print(f"  {e}")
+            return 1
+        if not bad:
+            print("known-bad sample record PASSED validation "
+                  "(schema lost its teeth)")
+            return 1
+        print("sample self-check OK (good record accepted, "
+              f"bad record produced {len(bad)} violations)")
+        return 0
+    if not args.files:
+        p.error("give at least one JSONL file (or --sample)")
+    all_errs = []
+    total = 0
+    for path in args.files:
+        errs = check_file(path, schema)
+        all_errs += errs
+        total += 1
+    if all_errs:
+        for e in all_errs:
+            print(e)
+        print(f"FAIL: {len(all_errs)} violation(s) across {total} file(s)")
+        return 1
+    print(f"OK: {total} file(s) conform to metrics schema v"
+          f"{schema.SCHEMA_VERSION}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
