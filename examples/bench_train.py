@@ -3,10 +3,12 @@ perf metric (CaffeNet train at 193-267 img/s on a K40,
 /root/reference/docs/performance_hardware.md:17-25).
 
 Trains the real zoo train_val graphs through the Solver path: the TRAIN
-Data layer is swapped for an in-graph DummyData feed of the same shape
-(so the whole fwd+bwd+update loop runs chip-resident under
-Solver.step_fused with zero input-pipeline confound), and throughput is
-steady-state img/s over a timed window after a compile/warmup chunk.
+Data layer is swapped for a shape-equal Input declaration fed from one
+pre-staged device-resident batch (inputize/fixed_feed — the same feed
+profile_train.py captures, so bench wall-clock and profile attribution
+measure the SAME program; --dummy-data swaps in the older in-graph
+DummyData generator instead), and throughput is steady-state img/s over
+a timed window after a compile/warmup chunk.
 Also reports achieved model FLOP/s — 3 x analytic forward FLOPs per
 step (fwd + two bwd matmul passes) — and MFU against the chip's peak.
 
@@ -124,6 +126,7 @@ def fixed_feed(spec, seed=0):
     def feed():
         if not staged:
             staged.update({k: jax.device_put(v) for k, v in batch.items()})
+            batch.clear()   # release the host copy (~150 MB at b256)
         return staged
     return feed
 
@@ -143,6 +146,10 @@ def main(argv=None):
                    help="iterations scanned per device dispatch")
     p.add_argument("--compute-dtype", default="",
                    help="e.g. bfloat16; empty = float32")
+    p.add_argument("--dummy-data", action="store_true",
+                   help="generate inputs in-graph (DummyData) instead "
+                        "of the default pre-staged Input feed; the "
+                        "in-graph RNG then rides the timed step")
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="chip peak for the MFU column (v5e bf16 = 197)")
     p.add_argument("--json", action="store_true",
@@ -157,7 +164,15 @@ def main(argv=None):
     from rram_caffe_simulation_tpu.utils.io import read_net_param
     from rram_caffe_simulation_tpu.tools.summarize import net_fwd_flops
 
-    netp = dummyize(read_net_param(args.model), args.batch)
+    netp = read_net_param(args.model)
+    if args.dummy_data:
+        netp = dummyize(netp, args.batch)
+        feed = None
+    else:
+        # default: device-resident fixed batch through Input layers —
+        # the benched program matches the profiled one (profile_train)
+        netp, spec = inputize(netp, args.batch)
+        feed = fixed_feed(spec)
     sp = pb.SolverParameter()
     sp.net_param.CopyFrom(netp)
     sp.base_lr = 0.001  # throughput run; random labels diverge at 0.01
@@ -168,7 +183,8 @@ def main(argv=None):
     sp.max_iter = 10 ** 9
     sp.display = 0
     sp.random_seed = 7
-    solver = Solver(sp, compute_dtype=args.compute_dtype or None)
+    solver = Solver(sp, train_feed=feed,
+                    compute_dtype=args.compute_dtype or None)
 
     fwd_flops, _ = net_fwd_flops(solver.net)  # at the built batch size
     # sync on ONE leaf: the step is a single device program, so one
@@ -198,6 +214,7 @@ def main(argv=None):
                  args.model,
         "batch": args.batch,
         "compute_dtype": args.compute_dtype or "float32",
+        "feed": "dummy" if args.dummy_data else "input",
         "img_per_s": round(img_s, 1),
         "step_ms": round(step_ms, 3),
         "fwd_gflops_per_batch": round(fwd_flops / 1e9, 2),

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from ..core.registry import LayerContext, create_layer
 from .. import ops  # noqa: F401  (importing ops registers every layer type)
+from ..observe.counters import mean_abs
 from ..proto import pb
 from ..utils.io import blob_to_array
 
@@ -244,7 +245,9 @@ class Net:
               iteration=None, with_updates: bool = False,
               start: Optional[str] = None, end: Optional[str] = None,
               adc_bits: int = 0, crossbar: Optional[dict] = None,
-              compute_dtype=None, seq_mesh=None, seq_impl: str = "ring"):
+              compute_dtype=None, seq_mesh=None, seq_impl: str = "ring",
+              probes: Optional[dict] = None,
+              trace_sites: Optional[dict] = None):
         """Run the net (or the [start, end] layer range). `batch` feeds
         data-source tops — plus, for partial runs, any bottom consumed but
         not produced inside the range. Returns (blobs, loss) or
@@ -253,6 +256,16 @@ class Net:
         ADC output quantization in crossbar (InnerProduct) layers;
         `crossbar` routes named InnerProduct layers through the fused
         Pallas conductance-noise kernel (see LayerContext.crossbar).
+
+        Debug capture points (observe/debug.py — the `debug_info` deep
+        trace; both default off and add NOTHING to the traced program
+        when unset): `probes` maps (layer_name, top_name) production
+        sites to zero arrays added to that top as produced, so the
+        caller's gradient w.r.t. a probe is the blob's cotangent at that
+        site — per-site, which is what disambiguates in-place chains
+        (fc1 -> ReLU -> fc1). `trace_sites`, a mutable dict, receives
+        the mean-abs of every computed top keyed by the same site
+        (the ForwardDebugInfo reduction, net.cpp:618-632).
         """
         batch = batch or {}
         ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration,
@@ -265,6 +278,12 @@ class Net:
         for name, shape in self.data_source_tops.items():
             if name in batch:
                 blobs[name] = batch[name]
+                if trace_sites is not None:
+                    # captured at FEED time so an in-place layer
+                    # overwriting a data top can't alias the data
+                    # layer's own [Forward] line
+                    trace_sites[("__data__", name)] = mean_abs(
+                        batch[name])
             elif any(not l.is_data_source for l in run_layers
                      if name in l.lp.bottom):
                 raise ValueError(f"batch missing data blob {name!r}")
@@ -287,6 +306,12 @@ class Net:
             if new_params is not None:
                 updates[layer.name] = new_params
             for t, v in zip(layer.lp.top, tops):
+                if probes is not None:
+                    probe = probes.get((layer.name, t))
+                    if probe is not None:
+                        v = v + probe.astype(v.dtype)
+                if trace_sites is not None:
+                    trace_sites[(layer.name, t)] = mean_abs(v)
                 blobs[t] = v
         loss = jnp.asarray(0.0, dtype=jnp.float32)
         for blob_name, w in self.loss_weights.items():

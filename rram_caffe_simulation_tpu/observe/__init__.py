@@ -17,16 +17,25 @@ observable in three layers:
    tooling scrapes unchanged;
 3. profiler hooks (trace.py): `jax.named_scope` phase annotations in the
    step and a `jax.profiler.trace` context manager wired to the CLI's
-   `--profile-dir` flag.
+   `--profile-dir` flag;
+4. deep tracing (debug.py): reference-parity `debug_info` — per-layer
+   forward/backward/update mean-abs lines (net.cpp:618-668 format)
+   computed inside the jitted step, in-jit NaN/Inf/overflow sentinels
+   with first-bad-layer attribution, and the host-side divergence
+   watchdog policy (`Solver.enable_watchdog` / `--watchdog`).
 """
-from .counters import global_norm_sq, to_host, write_traffic_saved
+from .counters import global_norm_sq, mean_abs, to_host, write_traffic_saved
+from .debug import OVERFLOW_LIMIT, PHASES, NetDebugSpec, sentinel_tree
 from .schema import SCHEMA_VERSION, validate_record
-from .sink import CaffeLogSink, JsonlSink, MetricsLogger, make_record
+from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
+                   debug_trace_lines, make_record, sentinel_line)
 from .trace import trace
 
 __all__ = [
     "SCHEMA_VERSION", "validate_record",
     "MetricsLogger", "JsonlSink", "CaffeLogSink", "make_record",
-    "global_norm_sq", "write_traffic_saved", "to_host",
+    "debug_trace_lines", "sentinel_line",
+    "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",
+    "NetDebugSpec", "sentinel_tree", "PHASES", "OVERFLOW_LIMIT",
     "trace",
 ]

@@ -20,6 +20,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def mean_abs(x) -> jax.Array:
+    """asum/count of a blob (the reference Blob::asum_data()/count()
+    quantity every debug_info line reports), f32. Shared by the net
+    builder's per-site trace captures and the debug-spec reductions."""
+    return jnp.mean(jnp.abs(jnp.asarray(x).astype(jnp.float32)))
+
+
 def global_norm_sq(tree: Dict[str, jax.Array]) -> jax.Array:
     """Sum of squares over a flat dict of arrays (grad/update global-norm
     building block; the clip-gradients path shares this value)."""

@@ -1,0 +1,318 @@
+"""Reference-parity `debug_info` deep tracing + numeric health sentinels.
+
+The reference's first-line divergence tool is `SolverParameter.debug_info`:
+per-layer mean-absolute-value lines from `ForwardDebugInfo` /
+`BackwardDebugInfo` / `UpdateDebugInfo` (net.cpp:618-668), one glog line
+per blob per iteration. Here the same reductions are traced INSIDE the
+jitted train step — `NetDebugSpec` enumerates the capture points once at
+build time, the step carries the values out as a few stacked f32 vectors
+on the metrics pytree (no mid-step host syncs), and the host formats
+byte-compatible lines plus structured JSONL records from them.
+
+Layered on top, because the values are already in the graph:
+
+- **sentinels** — per-phase (forward / backward / update / fault-clamp)
+  NaN / Inf / overflow flags with FIRST-BAD-ENTRY attribution, computed
+  from the same trace vectors (`sentinel_tree`). A NaN anywhere in a
+  blob poisons its mean-abs, so the per-entry scalar is a sufficient
+  detector — and its index names the first layer/param that went bad.
+- **divergence watchdog** — a host-side policy (Solver.enable_watchdog /
+  `caffe_cli train --watchdog halt|snapshot|none`) that reads the
+  sentinel summary each iteration and, on a trip or a non-finite loss,
+  prints a diagnostic naming the offending phase + layer, optionally
+  snapshots (the SIGINT snapshot path), and stops the run.
+
+Known deviations from the reference, all second-order:
+
+- Multi-consumer blobs carry ONE summed cotangent (this net builder
+  skips InsertSplits; autodiff already sums), so the per-consumer
+  partial diffs Caffe's Split layers expose collapse into one line.
+- `iter_size > 1` traces the LAST sub-batch's forward values and the
+  ACCUMULATED backward diffs (Caffe prints each sub-pass).
+- Shared params report the owner's accumulated gradient at every
+  consuming layer.
+
+In-place chains (`fc1 -> ReLU -> fc1`) ARE disambiguated exactly: capture
+sites are (producing layer, top name) pairs, so the pre- and post-ReLU
+versions of `fc1` trace separately, like Caffe's shared-buffer walk.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .counters import mean_abs
+from .schema import SCHEMA_VERSION
+
+#: Sentinel phases, in the order their vectors stack into the tree.
+PHASES = ("forward", "backward", "update", "fault")
+
+#: A finite mean-abs above this trips the overflow sentinel (f32 max is
+#: ~3.4e38; a healthy activation/gradient never gets within 8 orders).
+OVERFLOW_LIMIT = 1e30
+
+
+class NetDebugSpec:
+    """Static enumeration of a net's debug capture points.
+
+    Built once per net (at `make_train_step` time when tracing is on);
+    the in-jit side reduces exactly these entries into stacked vectors,
+    the host side zips the materialized vectors back against the entry
+    metadata to format lines / records / diagnostics.
+
+    Entry forms (all tuples, order = emission order):
+
+    - ``fwd``:  ("top", layer, blob, site) then
+      ("param", layer, display_name, slot) per layer in forward order —
+      ForwardDebugInfo's tops-then-params walk. `site` is the
+      (producing_layer, top) pair a probe/trace capture keys on;
+      host-fed data tops use ("__data__", top), captured at feed time.
+    - ``bwd``:  ("bottom", layer, blob, site) then
+      ("bparam", layer, slot, owner_key) per layer in REVERSE order —
+      BackwardDebugInfo. Bottoms fed from the host pipeline are skipped
+      (bottom_need_backward == false in the reference); params with
+      lr_mult == 0 are skipped (param_propagate_down == false).
+    - ``update``: (layer, display_name, owner_key) per OWNED learnable
+      param, in learnable_params order — UpdateDebugInfo.
+    - ``fault``: owner_key per fault-target param — the post-clamp
+      health check (no reference counterpart; the clamp is the fork's).
+    """
+
+    def __init__(self, net, owner_refs, fault_keys):
+        self.net = net
+        consumed = {b for l in net.layers for b in l.lp.bottom}
+        self.fwd: List[tuple] = []
+        bwd_per_layer: List[List[tuple]] = []
+        current_site: Dict[str, Optional[tuple]] = {}
+        for layer in net.layers:
+            if layer.is_data_source:
+                for t in layer.lp.top:
+                    # data-produced: no probe site (bottom_need_backward
+                    # == false in the reference), but the forward value
+                    # is captured at FEED time under a ("__data__", t)
+                    # site so a later in-place overwrite of the blob
+                    # name can't alias this layer's line
+                    current_site[t] = None
+                    if t in consumed:
+                        self.fwd.append(("top", layer.name, t,
+                                         ("__data__", t)))
+                continue
+            specs = layer.param_specs()
+            # bottoms resolve against the site table BEFORE this layer's
+            # tops overwrite it — the in-place (fc1 -> ReLU -> fc1) case
+            bottom_sites = [(b, current_site.get(b))
+                            for b in layer.lp.bottom]
+            for t in layer.lp.top:
+                site = (layer.name, t)
+                current_site[t] = site
+                self.fwd.append(("top", layer.name, t, site))
+            for slot in range(layer.num_params()):
+                disp = specs[slot].name or str(slot)
+                self.fwd.append(("param", layer.name, disp, slot))
+            entries = [("bottom", layer.name, b, site)
+                       for b, site in bottom_sites if site is not None]
+            for slot in range(layer.num_params()):
+                if specs[slot].lr_mult == 0:
+                    continue
+                owner, oslot = net._layer_slots[layer.name][slot]
+                entries.append(("bparam", layer.name, slot,
+                                f"{owner}/{oslot}"))
+            bwd_per_layer.append(entries)
+        self.bwd: List[tuple] = [e for lay in reversed(bwd_per_layer)
+                                 for e in lay]
+        # probes only where a backward entry reads the cotangent
+        self.probe_sites = sorted({e[3] for e in self.bwd
+                                   if e[0] == "bottom"},
+                                  key=lambda s: (s[0], s[1]))
+        self.update: List[tuple] = [
+            (r.layer_name, r.name or str(r.slot),
+             f"{r.layer_name}/{r.slot}") for r in owner_refs]
+        self.fault: List[str] = list(fault_keys)
+
+    # ------------------------------------------------------------------
+    # traced (in-jit) side
+
+    def make_probes(self) -> Dict[tuple, jax.Array]:
+        """Zero probes, one per consumed capture site: `apply` adds each
+        to its top at the production point, so the gradient w.r.t. the
+        probe IS the blob's cotangent (summed over consumers)."""
+        return {site: jnp.zeros(self.net.blob_shapes[site[1]], jnp.float32)
+                for site in self.probe_sites}
+
+    def _stack(self, vals) -> jax.Array:
+        if not vals:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.stack(vals)
+
+    def forward_values(self, params, blobs, trace_sites) -> jax.Array:
+        """ForwardDebugInfo reductions: per-site captures for computed
+        AND host-fed tops (both captured pre-overwrite, so in-place
+        chains over any blob stay disambiguated), the layer's resolved
+        param list for params. Falls back to the final blobs dict for a
+        site the run didn't capture (partial-run boundary feeds)."""
+        net = self.net
+        vals = []
+        for e in self.fwd:
+            if e[0] == "top":
+                _, _, blob, site = e
+                v = trace_sites.get(site)
+                vals.append(v if v is not None else mean_abs(blobs[blob]))
+            else:
+                _, lname, _, slot = e
+                lp = net._gather_layer_params(params,
+                                              net.layer_by_name[lname])
+                vals.append(mean_abs(lp[slot]))
+        return self._stack(vals)
+
+    def backward_values(self, probe_grads, grad_flat) -> jax.Array:
+        """BackwardDebugInfo reductions: bottom diffs from the probe
+        cotangents, param diffs from the (raw, pre-clip) gradients."""
+        vals = []
+        for e in self.bwd:
+            if e[0] == "bottom":
+                vals.append(mean_abs(probe_grads[e[3]]))
+            else:
+                vals.append(mean_abs(grad_flat[e[3]]))
+        return self._stack(vals)
+
+    def values_for_keys(self, flat, keys) -> jax.Array:
+        return self._stack([mean_abs(flat[k]) for k in keys])
+
+    def update_keys(self):
+        return [k for _, _, k in self.update]
+
+    def all_param_norms(self, data_flat, grad_flat) -> jax.Array:
+        """The "[Backward] All net params" totals over OWNED learnable
+        params: [L1 data, L1 diff, L2 data, L2 diff] (sums, not means —
+        net.cpp accumulates asum/sumsq)."""
+        l1d = l1g = sqd = sqg = jnp.float32(0.0)
+        for _, _, k in self.update:
+            d = data_flat[k].astype(jnp.float32)
+            g = grad_flat[k].astype(jnp.float32)
+            l1d = l1d + jnp.sum(jnp.abs(d))
+            l1g = l1g + jnp.sum(jnp.abs(g))
+            sqd = sqd + jnp.sum(d * d)
+            sqg = sqg + jnp.sum(g * g)
+        return jnp.stack([l1d, l1g, jnp.sqrt(sqd), jnp.sqrt(sqg)])
+
+    # ------------------------------------------------------------------
+    # host side
+
+    def _phase_entries(self, phase: str):
+        return {"forward": self.fwd, "backward": self.bwd,
+                "update": self.update, "fault": self.fault}[phase]
+
+    def entry_name(self, phase: str, idx: int) -> str:
+        """Human name of sentinel entry `idx` of `phase`, for the
+        watchdog diagnostic."""
+        e = self._phase_entries(phase)[idx]
+        if phase == "fault":
+            return f"param {e}"
+        if phase == "update":
+            return f"layer {e[0]}, param {e[1]}"
+        kind = e[0]
+        if kind in ("top", "bottom"):
+            return f"layer {e[1]}, {kind} blob {e[2]}"
+        name = e[2] if kind == "param" else str(e[2])
+        return f"layer {e[1]}, param blob {name}"
+
+    def sentinel_summary(self, host_debug: dict) -> dict:
+        """Collapse a materialized per-iteration debug tree into
+        {tripped, phase, entry, flags{nan,inf,overflow}, loss} — the
+        watchdog's input and the sentinel record's payload."""
+        sent = host_debug["sentinel"]
+        for pi, phase in enumerate(PHASES):
+            first = int(np.asarray(sent["first"])[pi])
+            if first >= 0:
+                return {"tripped": True, "phase": phase,
+                        "entry": self.entry_name(phase, first),
+                        "flags": {
+                            "nan": bool(np.asarray(sent["nan"])[pi]),
+                            "inf": bool(np.asarray(sent["inf"])[pi]),
+                            "overflow": bool(np.asarray(sent["ovf"])[pi]),
+                        },
+                        "loss": float(host_debug["loss"])}
+        return {"tripped": False, "phase": None, "entry": None,
+                "flags": {"nan": False, "inf": False, "overflow": False},
+                "loss": float(host_debug["loss"])}
+
+    def trace_record(self, iteration: int, host_debug: dict) -> dict:
+        """One schema-v1 `debug_trace` JSONL record per iteration; the
+        Caffe-format lines regenerate from it (sink.debug_trace_lines),
+        so the record is the single source for both outputs."""
+        fwd, bwd = host_debug["fwd"], host_debug["bwd"]
+        norms = host_debug["norms"]
+        forward = []
+        for e, v in zip(self.fwd, fwd):
+            forward.append({"layer": e[1],
+                            "kind": "top" if e[0] == "top" else "param",
+                            "blob": str(e[2]), "value": float(v)})
+        backward = []
+        for e, v in zip(self.bwd, bwd):
+            backward.append({"layer": e[1],
+                             "kind": ("bottom" if e[0] == "bottom"
+                                      else "param"),
+                             "blob": str(e[2]), "value": float(v)})
+        update = [{"layer": l, "param": disp, "data": float(dv),
+                   "diff": float(uv)}
+                  for (l, disp, _), dv, uv in zip(
+                      self.update, host_debug["upd_data"],
+                      host_debug["upd_diff"])]
+        return {"schema_version": SCHEMA_VERSION, "type": "debug_trace",
+                "iter": int(iteration), "wall_time": time.time(),
+                "forward": forward, "backward": backward,
+                "update": update,
+                "params_l1": [float(norms[0]), float(norms[1])],
+                "params_l2": [float(norms[2]), float(norms[3])]}
+
+    def sentinel_record(self, iteration: int, summary: dict) -> dict:
+        """Schema-v1 `sentinel` record, emitted on a tripped sentinel
+        (and on a non-finite loss with phase="loss" — a weighted
+        loss-top sum can overflow while every per-entry mean-abs stays
+        finite, so the loss shape carries no `entry`)."""
+        rec = {"schema_version": SCHEMA_VERSION, "type": "sentinel",
+               "iter": int(iteration), "wall_time": time.time(),
+               "phase": summary["phase"] or "loss",
+               "nan": summary["flags"]["nan"],
+               "inf": summary["flags"]["inf"],
+               "overflow": summary["flags"]["overflow"],
+               "loss": summary["loss"]}
+        if summary["entry"] is not None:
+            rec["entry"] = summary["entry"]
+        return rec
+
+
+def sentinel_tree(phase_vecs: Dict[str, jax.Array]) -> dict:
+    """Traced numeric-health flags from the per-phase trace vectors.
+
+    A NaN/Inf anywhere in a blob propagates into its mean-abs scalar, so
+    per-entry flags need no extra full-blob reductions. Returns stacked
+    (len(PHASES),) arrays: nan/inf/ovf any-flags (int32 0/1) and `first`
+    — the first bad entry index per phase, -1 when the phase is clean.
+    """
+    nan_f, inf_f, ovf_f, first_f = [], [], [], []
+    for phase in PHASES:
+        v = phase_vecs[phase]
+        if v.size == 0:
+            zero = jnp.int32(0)
+            nan_f.append(zero)
+            inf_f.append(zero)
+            ovf_f.append(zero)
+            first_f.append(jnp.int32(-1))
+            continue
+        nan = jnp.isnan(v)
+        inf = jnp.isinf(v)
+        ovf = jnp.isfinite(v) & (jnp.abs(v) > OVERFLOW_LIMIT)
+        bad = nan | inf | ovf
+        nan_f.append(jnp.any(nan).astype(jnp.int32))
+        inf_f.append(jnp.any(inf).astype(jnp.int32))
+        ovf_f.append(jnp.any(ovf).astype(jnp.int32))
+        first_f.append(jnp.where(jnp.any(bad),
+                                 jnp.argmax(bad).astype(jnp.int32),
+                                 jnp.int32(-1)))
+    return {"nan": jnp.stack(nan_f), "inf": jnp.stack(inf_f),
+            "ovf": jnp.stack(ovf_f), "first": jnp.stack(first_f)}

@@ -28,6 +28,35 @@ else every record. Under a Monte-Carlo
 sweep the scalar counter fields become per-config lists — `validate_record`
 accepts both shapes.
 
+Two further record types carry the `debug_info` deep traces, keyed by a
+`"type"` field (records without one are the metrics record above):
+
+``debug_trace`` — one per iteration while `debug_info: true`, the
+structured twin of the reference's ForwardDebugInfo / BackwardDebugInfo
+/ UpdateDebugInfo glog lines (net.cpp:618-668)::
+
+    {"schema_version": 1, "type": "debug_trace", "iter": 3,
+     "wall_time": 1722700000.1,
+     "forward":  [{"layer": "fc1", "kind": "top",   "blob": "fc1",
+                   "value": 0.41}, ...],          # kind: top | param
+     "backward": [{"layer": "fc1", "kind": "param", "blob": "0",
+                   "value": 0.003}, ...],         # kind: bottom | param
+     "update":   [{"layer": "fc1", "param": "0", "data": 0.39,
+                   "diff": 0.0002}, ...],
+     "params_l1": [12.3, 0.4], "params_l2": [5.0, 0.1]}
+
+``sentinel`` — emitted when an in-jit numeric health sentinel trips
+(NaN / Inf / overflow in a phase's trace vector) or the watchdog sees a
+non-finite loss (phase "loss")::
+
+    {"schema_version": 1, "type": "sentinel", "iter": 3,
+     "wall_time": 1722700000.1, "phase": "forward",
+     "entry": "layer fc1, top blob fc1",
+     "nan": true, "inf": false, "overflow": false, "loss": NaN}
+
+Trace values may legitimately be NaN/Inf (that is what they diagnose);
+Python's json module reads and writes those literals.
+
 Semantics worth knowing: `step_latency_s`/`iters_per_s` cover the
 TRAINING time of the interval since the previous record (test-net
 evaluation and snapshot writes are excluded; the first interval includes
@@ -75,13 +104,62 @@ PER_PARAM_FIELDS = {
     "life_mean": (_NUM, True),
 }
 
+# --- debug_trace records (the structured debug_info trace) ---
+
+DEBUG_TRACE_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "forward": (list, True),
+    "backward": (list, True),
+    "update": (list, True),
+    "params_l1": (list, True),
+    "params_l2": (list, True),
+}
+
+DEBUG_BLOB_FIELDS = {
+    "layer": (str, True),
+    "kind": (str, True),
+    "blob": (str, True),
+    "value": (_NUM, True),
+}
+
+# legal `kind` values per trace list
+DEBUG_KINDS = {"forward": ("top", "param"),
+               "backward": ("bottom", "param")}
+
+DEBUG_UPDATE_FIELDS = {
+    "layer": (str, True),
+    "param": (str, True),
+    "data": (_NUM, True),
+    "diff": (_NUM, True),
+}
+
+# --- sentinel records (tripped numeric-health flags) ---
+
+SENTINEL_PHASES = ("forward", "backward", "update", "fault", "loss")
+
+SENTINEL_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "phase": (str, True),
+    "entry": (str, False),     # absent for phase="loss" explosions
+    "nan": (bool, True),
+    "inf": (bool, True),
+    "overflow": (bool, True),
+    "loss": (_NUM, False),
+}
+
 
 def _check_value(val, types):
     """A value matches when it is of the accepted type(s), or a
     NON-EMPTY list of them (a sweep record carries per-config vectors;
     an empty vector is always an emission bug, not data)."""
     if isinstance(val, bool):           # bool is an int subclass in JSON
-        return False
+        return types is bool            # accepted only where asked for
     if isinstance(val, types):
         return True
     if isinstance(val, list):
@@ -104,16 +182,80 @@ def _check_fields(rec, fields, where):
     return errs
 
 
+def _check_iter(rec, where) -> list:
+    if isinstance(rec.get("iter"), int) and rec["iter"] < 0:
+        return [f"{where}: iter must be >= 0"]
+    return []
+
+
+def _validate_debug_trace(rec) -> list:
+    errs = _check_fields(rec, DEBUG_TRACE_FIELDS, "debug_trace")
+    errs += _check_iter(rec, "debug_trace")
+    for phase in ("forward", "backward"):
+        entries = rec.get(phase)
+        if not isinstance(entries, list):
+            continue
+        for i, e in enumerate(entries):
+            if not isinstance(e, dict):
+                errs.append(f"debug_trace.{phase}[{i}]: not an object")
+                continue
+            errs += _check_fields(e, DEBUG_BLOB_FIELDS,
+                                  f"debug_trace.{phase}[{i}]")
+            kind = e.get("kind")
+            if isinstance(kind, str) and kind not in DEBUG_KINDS[phase]:
+                errs.append(f"debug_trace.{phase}[{i}]: unknown kind "
+                            f"{kind!r} (expected one of "
+                            f"{DEBUG_KINDS[phase]})")
+    entries = rec.get("update")
+    if isinstance(entries, list):
+        for i, e in enumerate(entries):
+            if not isinstance(e, dict):
+                errs.append(f"debug_trace.update[{i}]: not an object")
+                continue
+            errs += _check_fields(e, DEBUG_UPDATE_FIELDS,
+                                  f"debug_trace.update[{i}]")
+    for key in ("params_l1", "params_l2"):
+        pair = rec.get(key)
+        if isinstance(pair, list) and (
+                len(pair) != 2 or not all(
+                    not isinstance(v, bool) and isinstance(v, _NUM)
+                    for v in pair)):
+            errs.append(f"debug_trace.{key}: expected [data, diff] "
+                        "number pair")
+    return errs
+
+
+def _validate_sentinel(rec) -> list:
+    errs = _check_fields(rec, SENTINEL_FIELDS, "sentinel")
+    errs += _check_iter(rec, "sentinel")
+    phase = rec.get("phase")
+    if isinstance(phase, str) and phase not in SENTINEL_PHASES:
+        errs.append(f"sentinel: unknown phase {phase!r} "
+                    f"(expected one of {SENTINEL_PHASES})")
+    return errs
+
+
+def _check_version(rec) -> list:
+    if rec.get("schema_version") not in (None, SCHEMA_VERSION):
+        return [f"record: schema_version {rec['schema_version']!r} "
+                f"!= {SCHEMA_VERSION}"]
+    return []
+
+
 def validate_record(rec) -> list:
     """Return a list of schema violations (empty = valid)."""
     if not isinstance(rec, dict):
         return ["record is not a JSON object"]
+    rtype = rec.get("type")
+    if rtype == "debug_trace":
+        return _check_version(rec) + _validate_debug_trace(rec)
+    if rtype == "sentinel":
+        return _check_version(rec) + _validate_sentinel(rec)
+    if rtype is not None:
+        return [f"record: unknown record type {rtype!r}"]
     errs = _check_fields(rec, TOP_LEVEL, "record")
-    if rec.get("schema_version") not in (None, SCHEMA_VERSION):
-        errs.append(f"record: schema_version {rec['schema_version']!r} "
-                    f"!= {SCHEMA_VERSION}")
-    if isinstance(rec.get("iter"), int) and rec["iter"] < 0:
-        errs.append("record: iter must be >= 0")
+    errs += _check_version(rec)
+    errs += _check_iter(rec, "record")
     outs = rec.get("outputs")
     if isinstance(outs, dict):
         for name, v in outs.items():

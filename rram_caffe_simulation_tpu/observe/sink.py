@@ -10,7 +10,12 @@ emits glog-prefixed lines with EXACTLY the shapes the reference solver
 printed ("Iteration N, lr = X", "Iteration N, loss = X", "    Train net
 output #j: name = v", plus the timestamped "Solving <net>" banner), so
 `tools/parse_log.py`, `tools/plot_training_log.py`, and
-`tools/extract_seconds.py` scrape it unchanged.
+`tools/extract_seconds.py` scrape it unchanged. Typed records from the
+debug_info deep trace (observe/debug.py) render through it too:
+`debug_trace` records become the reference's per-layer
+Forward/Backward/Update lines (`debug_trace_lines` is the single
+formatter both this sink and the solver's stdout path use), `sentinel`
+records a one-line trip notice.
 """
 from __future__ import annotations
 
@@ -111,6 +116,42 @@ def _scalar(v):
     return v
 
 
+def debug_trace_lines(record: dict) -> list:
+    """Reference-format `debug_info` lines from a `debug_trace` record
+    (the record is the single source: the solver prints these to stdout
+    and `CaffeLogSink` emits them glog-prefixed, both byte-compatible
+    with net.cpp:618-668's ForwardDebugInfo / BackwardDebugInfo /
+    UpdateDebugInfo and Net::Backward's all-params totals)."""
+    lines = []
+    for e in record.get("forward", ()):
+        kind = "top blob" if e["kind"] == "top" else "param blob"
+        lines.append(f"    [Forward] Layer {e['layer']}, {kind} "
+                     f"{e['blob']} data: {e['value']:g}")
+    for e in record.get("backward", ()):
+        kind = "bottom blob" if e["kind"] == "bottom" else "param blob"
+        lines.append(f"    [Backward] Layer {e['layer']}, {kind} "
+                     f"{e['blob']} diff: {e['value']:g}")
+    l1 = record.get("params_l1", (0.0, 0.0))
+    l2 = record.get("params_l2", (0.0, 0.0))
+    lines.append(f"    [Backward] All net params (data, diff): "
+                 f"L1 norm = ({l1[0]:g}, {l1[1]:g}); "
+                 f"L2 norm = ({l2[0]:g}, {l2[1]:g})")
+    for e in record.get("update", ()):
+        lines.append(f"    [Update] Layer {e['layer']}, param "
+                     f"{e['param']} data: {e['data']:g}; "
+                     f"diff: {e['diff']:g}")
+    return lines
+
+
+def sentinel_line(record: dict) -> str:
+    """One-line text form of a `sentinel` record."""
+    flags = ", ".join(f for f in ("nan", "inf", "overflow")
+                      if record.get(f))
+    where = record.get("entry") or record.get("phase", "?")
+    return (f"Numeric sentinel tripped at iteration {record['iter']}: "
+            f"{record.get('phase')} phase, {where} [{flags or 'loss'}]")
+
+
 class CaffeLogSink:
     """Caffe/glog-format text emitter (see module docstring). The banner
     and every line carry a glog timestamp prefix so elapsed-seconds
@@ -140,6 +181,18 @@ class CaffeLogSink:
         self._f.write(prefix + line + "\n")
 
     def write(self, record: dict):
+        rtype = record.get("type")
+        if rtype == "debug_trace":
+            for line in debug_trace_lines(record):
+                self._emit(line)
+            self._f.flush()
+            return
+        if rtype == "sentinel":
+            self._emit(sentinel_line(record))
+            self._f.flush()
+            return
+        if rtype is not None:
+            return  # unknown typed records are not Caffe-shaped; skip
         it = record["iter"]
         lr = _scalar(record.get("lr", 0.0))
         loss = _scalar(record.get("smoothed_loss",

@@ -49,7 +49,11 @@ def make_dp_step(solver, mesh: Mesh):
     # six outputs: (params, history, fault, loss, outputs, metrics) —
     # all replicated. The metrics pytree needs no hand-written psum:
     # its reductions run over replicated/sharded state inside the jitted
-    # step, so GSPMD emits the cross-replica aggregate directly.
+    # step, so GSPMD emits the cross-replica aggregate directly. That
+    # covers the debug_info deep-trace subtree too (metrics["debug"],
+    # observe/debug.py): its mean-abs vectors reduce over the
+    # batch-sharded activations/cotangents, so each traced scalar is the
+    # GLOBAL-batch value, identical to the single-device trace.
     jitted = jax.jit(step, donate_argnums=(0, 1, 2),
                      out_shardings=(repl, repl, repl, repl, repl, repl))
     return jitted, place_state

@@ -483,6 +483,26 @@ class SweepRunner:
         return np.asarray(jax.vmap(fault_engine.broken_fraction)(
             self.fault_states))
 
+    def sentinel_state(self):
+        """Per-config numeric-health sentinel summaries from the last
+        executed iteration (observe/debug.py): a list of n_configs
+        dicts {tripped, phase, entry, flags, loss}. Empty list until a
+        step runs with debug tracing on (set `debug_info: true` on the
+        solver — or arm its watchdog — BEFORE building the runner; the
+        vmapped step then carries each config's own sentinel vector).
+        A NaN diverging in ONE config names that config's first bad
+        layer without disturbing the other configs' training."""
+        m = self.last_metrics
+        if not m or "debug" not in m:
+            return []
+        spec = self.solver.debug_spec
+        host = jax.device_get(m["debug"])
+        out = []
+        for i in range(self.n):
+            sl = jax.tree.map(lambda a, _i=i: np.asarray(a)[_i], host)
+            out.append(spec.sentinel_summary(sl))
+        return out
+
     def evaluate(self, batch, net=None) -> Dict[str, np.ndarray]:
         """Per-config forward metrics on a shared eval batch (test-net
         outputs, e.g. accuracy), vmapped over config params. The jitted

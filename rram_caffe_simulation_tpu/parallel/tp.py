@@ -152,7 +152,11 @@ def place_state(solver, mesh: Mesh, layer_specs: dict):
     loss/outputs/metrics are replicated; the metrics counters are
     reductions over the SHARDED fault state and grads, so GSPMD inserts
     the cross-shard all-reduce and the replicated scalar is already the
-    whole-matrix census."""
+    whole-matrix census. The debug_info deep-trace subtree
+    (metrics["debug"], observe/debug.py) rides the same replicated
+    metrics slot: its per-layer mean-abs reductions run over the
+    model-sharded weights/activations, so each traced line reports the
+    whole matrix, identical to the single-device trace."""
     params, history, fault_state, (pshard, hshard, fshard) = place_trees(
         mesh, layer_specs, flat_specs(solver, layer_specs),
         solver.params, solver.history, solver.fault_state)

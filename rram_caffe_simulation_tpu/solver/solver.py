@@ -130,6 +130,11 @@ class Solver:
         self._seed_logged = False
         self._step_baked = False   # any make_train_step call sets this
         self._mclock = None        # _IntervalClock once metrics enabled
+        # --- deep tracing (observe/debug.py): debug_info reference
+        # parity + sentinels; the watchdog policy forces the sentinel
+        # computation even when debug_info is unset ---
+        self._watchdog = None      # None | "halt" | "snapshot"
+        self.debug_spec = None     # NetDebugSpec once tracing is built
 
         # --- nets (InitTrainNet/InitTestNets, solver.cpp:95-230) ---
         net_param = _train_net_param(param)
@@ -312,7 +317,7 @@ class Solver:
 
     def make_train_step(self, hw_engine: str = "auto",
                         compute_dtype=None, apply_fn=None,
-                        with_metrics=None):
+                        with_metrics=None, with_debug=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs, metrics)
@@ -329,6 +334,18 @@ class Solver:
         only. Every phase is wrapped in `jax.named_scope` so profiler
         captures attribute device time to forward_backward /
         compute_update / apply_strategy / apply_update / fail.
+
+        `with_debug` (default: `param.debug_info` or an armed watchdog)
+        additionally traces the reference's debug_info reductions
+        (observe/debug.py): per-blob/per-param mean-abs vectors for the
+        forward / backward / update / fault-clamp phases plus the
+        all-params norms and in-jit NaN/Inf/overflow sentinels with
+        first-bad-entry attribution, carried as `metrics["debug"]`.
+        Every debug computation sits behind this static flag, so the
+        OFF path traces to the identical program as before (asserted by
+        tests/test_debug_trace.py). Not supported together with a
+        custom `apply_fn` (pipeline/sequence parallel, remat sweeps) —
+        those wrappers bypass the builder's capture sites.
 
         `hw_engine` selects how the hardware-aware forward (rram_forward)
         reads fault-target weights, mirroring the reference's Caffe-vs-
@@ -371,6 +388,23 @@ class Solver:
         has_fault = self.fault_state is not None
         metrics_on = (self._metrics_enabled if with_metrics is None
                       else bool(with_metrics))
+        debug_on = (bool(param.debug_info) or self._watchdog is not None
+                    if with_debug is None else bool(with_debug))
+        spec = None
+        if debug_on:
+            if apply_fn is not None:
+                raise ValueError(
+                    "debug_info deep tracing / watchdog sentinels are "
+                    "not supported with a custom apply_fn (pipeline or "
+                    "sequence parallelism, remat sweeps): those wrappers "
+                    "bypass the net builder's capture sites. Unset "
+                    "debug_info / the watchdog, or train without the "
+                    "wrapper.")
+            from ..observe import debug as obs_debug
+            if self.debug_spec is None:
+                self.debug_spec = obs_debug.NetDebugSpec(
+                    self.net, self._owner_refs, self._fault_keys)
+            spec = self.debug_spec
         # Hardware-aware forward (RRAMForwardParameter, framework
         # extension): fault-target weights are READ through the crossbar's
         # conductance variation each forward, straight-through gradients.
@@ -402,7 +436,13 @@ class Solver:
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
         def forward_backward(params, batch, it, rng, fault_state):
-            def loss_fn(p):
+            # debug probes: zeros added at each consumed top's production
+            # site, so grad w.r.t. them = the blob cotangents Backward-
+            # DebugInfo reports. None when tracing is off — the off path
+            # then traces the identical program (None is an empty pytree)
+            probes = spec.make_probes() if debug_on else None
+
+            def loss_fn(p, pr):
                 p_master = p
                 clean = flat(p)
                 crossbar = None
@@ -432,10 +472,15 @@ class Solver:
                 # apply_fn: an alternative forward with Net.apply's
                 # contract (enable_pipeline_parallel routes through the
                 # staged NetPipeline here)
+                trace_sites = {} if debug_on else None
+                extra = ({"probes": pr, "trace_sites": trace_sites}
+                         if debug_on else {})
                 blobs, loss, newp = (apply_fn or net.apply)(
                     p, run_batch, rng=rng, iteration=it, with_updates=True,
                     adc_bits=adc_bits, crossbar=crossbar,
-                    compute_dtype=cdtype)
+                    compute_dtype=cdtype, **extra)
+                dbg_fwd = (spec.forward_values(p, blobs, trace_sites)
+                           if debug_on else None)
                 if hw_sigma:
                     # Conductance noise is a READ effect only: net.apply
                     # copies the (perturbed) input tree into new_params, so
@@ -459,32 +504,55 @@ class Solver:
                         p_master, newp)
                     loss = loss.astype(jnp.float32)
                 outputs = {name: blobs[name] for name in net.output_names}
-                return loss, (outputs, newp)
-            (loss, (outputs, newp)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            return loss, outputs, newp, grads
+                return loss, (outputs, newp, dbg_fwd)
+            if debug_on:
+                (loss, (outputs, newp, dbg_fwd)), (grads, pgrads) = \
+                    jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                       has_aux=True)(params, probes)
+            else:
+                (loss, (outputs, newp, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, None)
+                dbg_fwd = pgrads = None
+            return loss, outputs, newp, grads, (dbg_fwd, pgrads)
 
         def step(params, history, fault_state, batch, it, rng, do_remap):
             # -- ForwardBackward x iter_size (solver.cpp:265-269) --
             with jax.named_scope("forward_backward"):
                 if iter_size == 1:
-                    loss, outputs, newp, grads = forward_backward(
-                        params, batch, it, rng, fault_state)
+                    loss, outputs, newp, grads, (dbg_fwd, pgrads) = \
+                        forward_backward(params, batch, it, rng,
+                                         fault_state)
                 else:
                     def body(carry, sub):
-                        p, g_acc, loss_acc, i = carry
-                        l, outs, p2, g = forward_backward(
+                        p, g_acc, pg_acc, loss_acc, i = carry
+                        l, outs, p2, g, (dfwd, pg) = forward_backward(
                             p, sub, it, jax.random.fold_in(rng, i),
                             fault_state)
                         g_acc = jax.tree.map(jnp.add, g_acc, g)
-                        return (p2, g_acc, loss_acc + l, i + 1), outs
+                        # probe cotangents accumulate like Caffe's diffs
+                        # under iter_size (pg is None when tracing off —
+                        # an empty pytree, so the off path is unchanged)
+                        pg_acc = jax.tree.map(jnp.add, pg_acc, pg)
+                        return (p2, g_acc, pg_acc, loss_acc + l, i + 1), \
+                            (outs, dfwd)
                     zero_g = jax.tree.map(jnp.zeros_like, params)
-                    (newp, grads, loss, _), outs_seq = jax.lax.scan(
-                        body, (params, zero_g, 0.0, 0), batch)
+                    zero_pg = spec.make_probes() if debug_on else None
+                    (newp, grads, pgrads, loss, _), (outs_seq, dfwd_seq) \
+                        = jax.lax.scan(
+                            body, (params, zero_g, zero_pg, 0.0, 0),
+                            batch)
                     outputs = jax.tree.map(lambda x: x[-1], outs_seq)
+                    # forward trace reports the LAST sub-batch (the
+                    # reference prints each sub-pass; one line set per
+                    # iteration keeps records per-iteration shaped)
+                    dbg_fwd = (jax.tree.map(lambda x: x[-1], dfwd_seq)
+                               if debug_on else None)
                     loss = loss / iter_size
             data = flat(newp)      # BatchNorm stats already advanced
             g = flat(grads)
+            g_dbg = dict(g) if debug_on else None  # raw pre-clip diffs
+            norms_dbg = (spec.all_param_norms(data, g_dbg)
+                         if debug_on else None)
 
             # -- ComputeUpdate (sgd_solver.cpp:102-117) --
             with jax.named_scope("compute_update"):
@@ -559,6 +627,13 @@ class Solver:
                                                  (data, upd))
 
             # -- ApplyUpdate (sgd_solver.cpp:119; blob.cpp:156) --
+            if debug_on:
+                # UpdateDebugInfo (net.cpp:652-668) runs pre-update with
+                # the post-strategy data/diffs, exactly the fork's
+                # ordering (ApplyStrategy sits before Net::Update)
+                upd_keys = spec.update_keys()
+                upd_data_dbg = spec.values_for_keys(data, upd_keys)
+                upd_diff_dbg = spec.values_for_keys(upd, upd_keys)
             with jax.named_scope("apply_update"):
                 data = {k: data[k] - upd[k] for k in data}
 
@@ -595,6 +670,27 @@ class Solver:
                             prev_life, fault_state["lifetimes"])
                         totals["writes_saved"] = writes_saved
                         metrics["fault"] = {**totals, "per_param": per}
+
+            # -- debug_info deep trace + sentinels (observe/debug.py) --
+            if debug_on:
+                with jax.named_scope("debug_trace"):
+                    # obs_debug bound in the enclosing make_train_step
+                    # scope (imported under the same debug_on guard)
+                    dbg_bwd = spec.backward_values(pgrads, g_dbg)
+                    fault_dbg = spec.values_for_keys(data, spec.fault)
+                    metrics = {**metrics, "debug": {
+                        "fwd": dbg_fwd,
+                        "bwd": dbg_bwd,
+                        "upd_data": upd_data_dbg,
+                        "upd_diff": upd_diff_dbg,
+                        "fault": fault_dbg,
+                        "norms": norms_dbg,
+                        "loss": jnp.asarray(loss, jnp.float32),
+                        "sentinel": obs_debug.sentinel_tree({
+                            "forward": dbg_fwd, "backward": dbg_bwd,
+                            "update": upd_diff_dbg, "fault": fault_dbg,
+                        }),
+                    }}
 
             return (unflat(data, newp), new_hist, fault_state, loss,
                     outputs, metrics)
@@ -633,6 +729,78 @@ class Solver:
         self._metrics_enabled = True
         self._mclock = _IntervalClock()
         return self.metrics_logger
+
+    def enable_watchdog(self, policy: str = "halt"):
+        """Arm the divergence watchdog (CLI: `--watchdog`). The jitted
+        step then carries the in-jit numeric health sentinels
+        (observe/debug.py) even when `debug_info` is unset, and every
+        iteration the host checks them: on a tripped sentinel or a
+        non-finite loss it prints a diagnostic naming the first bad
+        phase + layer/param, optionally snapshots via the SIGINT
+        snapshot path (`policy="snapshot"`), and stops the run.
+
+        Like enable_metrics, call BEFORE the train step is built — the
+        sentinel reductions live inside the traced program."""
+        if policy == "none":
+            return
+        if policy not in ("halt", "snapshot"):
+            raise ValueError(
+                f"unknown watchdog policy {policy!r} "
+                "(expected halt, snapshot, or none)")
+        if (self._step_fn is not None or self._step_baked
+                or getattr(self, "_fused_fns", None)):
+            raise ValueError(
+                "enable_watchdog must be called before the train step "
+                "is built (before the first step()/step_fused(), before "
+                "enable_*_parallel, and before constructing a "
+                "SweepRunner)")
+        self._watchdog = policy
+
+    def _process_debug(self, dbg, iteration: Optional[int] = None) -> bool:
+        """Materialize one iteration's debug tree and act on it: print
+        the reference-format lines + log a `debug_trace` record (when
+        `debug_info` is on), log a `sentinel` record on a trip, and run
+        the watchdog policy. Returns True when the watchdog stopped the
+        run. One device->host transfer per iteration — debug mode's
+        inherent cost (the reference syncs every blob per iteration by
+        construction)."""
+        from ..observe import counters as obs_counters
+        from ..observe import sink as obs_sink
+        spec = self.debug_spec
+        it = self.iter if iteration is None else iteration
+        if self.param.debug_info:
+            host = obs_counters.to_host(dbg)
+        else:
+            # watchdog-only mode: only the sentinel flags + loss are
+            # consumed — keep the per-iteration D2H payload to a few
+            # scalars instead of the full per-layer trace vectors
+            host = obs_counters.to_host({"sentinel": dbg["sentinel"],
+                                         "loss": dbg["loss"]})
+        summ = spec.sentinel_summary(host)
+        if self.param.debug_info:
+            rec = spec.trace_record(it, host)
+            for line in obs_sink.debug_trace_lines(rec):
+                print(line, flush=True)
+            if self.metrics_logger is not None:
+                self.metrics_logger.log(rec)
+        loss_bad = not np.isfinite(summ["loss"])
+        if (summ["tripped"] or loss_bad) and self.metrics_logger is not None:
+            self.metrics_logger.log(spec.sentinel_record(it, summ))
+        if self._watchdog is None or not (summ["tripped"] or loss_bad):
+            return False
+        where = (f"{summ['phase']} phase, {summ['entry']}"
+                 if summ["tripped"]
+                 else f"loss = {summ['loss']} (non-finite)")
+        flags = summ["flags"]
+        print(f"Watchdog tripped at iteration {it}: {where} "
+              f"(nan={flags['nan']}, inf={flags['inf']}, "
+              f"overflow={flags['overflow']})", flush=True)
+        if self._watchdog == "snapshot":
+            path = self.snapshot()
+            print(f"Watchdog snapshot saved to {path}", flush=True)
+        print("Watchdog stopping optimization.", flush=True)
+        self._requested_action = "stop"
+        return True
 
     def _log_metrics_record(self, metrics, outputs, elapsed_s, n_iters,
                             iteration=None, writes_saved_acc=None):
@@ -978,6 +1146,11 @@ class Solver:
             # them as net.blobs after solver.step; the api view pulls them)
             self.last_outputs = outputs
             self._record_loss(loss, start_iter, average_loss)
+            if metrics and "debug" in metrics:
+                # debug_info lines print BEFORE the display block, like
+                # the reference's per-iteration glog stream; the
+                # watchdog stop takes effect at this loop's tail
+                self._process_debug(metrics["debug"])
             if track:
                 # writes_saved rides as a device scalar, no sync; summed
                 # at the next record so it totals the interval rather
@@ -1139,6 +1312,21 @@ class Solver:
                     self._record_loss(losses[i], start_iter,
                                       average_loss)
                     self.iter += 1
+            if mseq and "debug" in mseq:
+                # the debug subtree rides the scan stacked over the
+                # chunk; ONE device->host transfer for the whole chunk
+                # (per-iteration device slices would reintroduce the
+                # dispatch cost the fused path amortizes away), then
+                # emit per-iteration lines/records host-side. The
+                # watchdog is chunk-granular here: params have already
+                # advanced through the whole chunk when it trips.
+                host_seq = jax.device_get(mseq["debug"])
+                for i in range(n):
+                    dbg_i = jax.tree.map(lambda x, _i=i: x[_i],
+                                         host_seq)
+                    if self._process_debug(dbg_i,
+                                           iteration=self.iter - n + i):
+                        break
             if param.display and self.iter % param.display == 0:
                 self._materialize_smoothed_loss()
                 lr = float(self._lr_fn(jnp.int32(self.iter - 1)))

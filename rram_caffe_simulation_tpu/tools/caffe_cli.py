@@ -85,6 +85,11 @@ def train(args):
                   "records (they are emitted at display boundaries); "
                   "set `display` in the solver prototxt",
                   file=sys.stderr, flush=True)
+    if args.watchdog != "none":
+        # divergence watchdog (observe/debug.py): in-jit NaN/Inf/
+        # overflow sentinels + per-iteration host check. Armed BEFORE
+        # the parallel enables below — they bake the step function.
+        solver.enable_watchdog(args.watchdog)
     if args.weights:
         for w in args.weights.split(","):
             solver.params = solver.net.copy_trained_from(solver.params, w)
@@ -598,6 +603,15 @@ def main(argv=None):
                         "the run into this directory (TensorBoard "
                         "Profile plugin / Perfetto); the train step's "
                         "phases are named_scope-annotated")
+    p.add_argument("--watchdog", default="none",
+                   choices=["halt", "snapshot", "none"],
+                   help="train: divergence watchdog — the jitted step "
+                        "carries in-jit NaN/Inf/overflow sentinels with "
+                        "first-bad-layer attribution (even without "
+                        "debug_info); on a trip or a non-finite loss, "
+                        "print a diagnostic naming the offending phase/"
+                        "layer and stop ('halt'), or snapshot first "
+                        "via the SIGINT snapshot path ('snapshot')")
     p.add_argument("--sigint_effect", default="stop",
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",

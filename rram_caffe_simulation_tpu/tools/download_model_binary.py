@@ -68,24 +68,29 @@ def main(argv=None):
     print(f"Downloading {fm['caffemodel_url']} -> {target}")
     # download to a sibling temp file and move into place only once the
     # sha1 verifies: an interrupted urlretrieve must never leave a
-    # corrupt file where existence-checking tools would pick it up
+    # corrupt file where existence-checking tools would pick it up.
+    # try/finally (not just except Exception) so a KeyboardInterrupt
+    # mid-download doesn't orphan the partial .download file either.
     tmp = target + ".download"
     try:
-        urllib.request.urlretrieve(fm["caffemodel_url"], tmp)
-    except Exception as e:
+        try:
+            urllib.request.urlretrieve(fm["caffemodel_url"], tmp)
+        except Exception as e:
+            raise SystemExit(
+                f"download failed ({e}); on an air-gapped host fetch "
+                f"{fm['caffemodel_url']} elsewhere and place it at "
+                f"{target}, then re-run to verify the checksum")
+        if not model_checks_out(tmp, fm["sha1"]):
+            raise SystemExit(
+                f"download does not match sha1 {fm['sha1']} — partial "
+                "or corrupted transfer; nothing written to "
+                f"{target}")
+        os.replace(tmp, target)
+    finally:
+        # on success os.replace already moved it; anything left here is
+        # a partial/corrupt transfer from a non-success exit path
         if os.path.exists(tmp):
             os.remove(tmp)
-        raise SystemExit(
-            f"download failed ({e}); on an air-gapped host fetch "
-            f"{fm['caffemodel_url']} elsewhere and place it at "
-            f"{target}, then re-run to verify the checksum")
-    if not model_checks_out(tmp, fm["sha1"]):
-        os.remove(tmp)
-        raise SystemExit(
-            f"download does not match sha1 {fm['sha1']} — partial or "
-            "corrupted transfer; nothing written to "
-            f"{target}")
-    os.replace(tmp, target)
     print("Download verified.")
     return 0
 

@@ -50,6 +50,12 @@ def parse_jsonl(path: str):
             if not line:
                 continue
             rec = json.loads(line)
+            if rec.get("type") is not None:
+                # typed records (debug_trace / sentinel deep-trace
+                # stream, observe/schema.py) are not display-interval
+                # metrics — they would emit an empty CSV row per traced
+                # iteration
+                continue
             row = train.setdefault(int(rec["iter"]), {})
             loss = rec.get("smoothed_loss", rec.get("loss"))
             if loss is not None and not isinstance(loss, list):

@@ -127,11 +127,19 @@ def summarize_metrics(path):
     """One-screen digest of a JSONL metrics log (schema: observe/schema.py
     / USAGE.md Observability)."""
     recs = []
+    n_typed = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
-                recs.append(json.loads(line))
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("type") is not None:
+                # debug_trace / sentinel records ride the same sink;
+                # the digest summarizes the display-interval metrics
+                n_typed += 1
+                continue
+            recs.append(rec)
     if not recs:
         return f"{path}: no records"
     first, last = recs[0], recs[-1]
@@ -139,6 +147,9 @@ def summarize_metrics(path):
              f"Records: {len(recs)} (schema v"
              f"{first.get('schema_version', '?')})",
              f"Iterations: {first.get('iter')} .. {last.get('iter')}"]
+    if n_typed:
+        lines.append(f"Deep-trace records: {n_typed} "
+                     "(debug_trace/sentinel, not summarized)")
     seeds = [(r["iter"], r["seed"]) for r in recs if "seed" in r]
     if len(seeds) == 1:
         lines.append(f"Seed: {seeds[0][1]}")

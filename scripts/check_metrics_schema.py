@@ -51,6 +51,44 @@ SAMPLE_GOOD = {
 SAMPLE_BAD = {"schema_version": 1, "iter": -3, "loss": "NaN-ish",
               "fault": {"broken_total": 1.5}}
 
+# the debug_info deep-trace record types (observe/debug.py)
+SAMPLE_GOOD_DEBUG = {
+    "schema_version": 1, "type": "debug_trace", "iter": 3,
+    "wall_time": 1722700000.0,
+    "forward": [{"layer": "fc1", "kind": "top", "blob": "fc1",
+                 "value": 0.41},
+                {"layer": "fc1", "kind": "param", "blob": "0",
+                 "value": 0.12}],
+    "backward": [{"layer": "fc1", "kind": "bottom", "blob": "data",
+                  "value": 0.003},
+                 {"layer": "fc1", "kind": "param", "blob": "0",
+                  "value": 0.2}],
+    "update": [{"layer": "fc1", "param": "0", "data": 0.39,
+                "diff": 0.0002}],
+    "params_l1": [12.3, 0.4], "params_l2": [5.0, 0.1],
+}
+
+SAMPLE_GOOD_SENTINEL = {
+    "schema_version": 1, "type": "sentinel", "iter": 3,
+    "wall_time": 1722700000.0, "phase": "forward",
+    "entry": "layer fc1, top blob fc1",
+    "nan": True, "inf": False, "overflow": False, "loss": 1.5,
+}
+
+SAMPLE_BAD_DEBUG = {
+    "schema_version": 1, "type": "debug_trace", "iter": 3,
+    "wall_time": 1722700000.0,
+    "forward": [{"layer": "fc1", "value": "big"}],   # missing kind/blob
+    "backward": [], "update": [],
+    "params_l1": [1.0], "params_l2": "nope",         # not [data, diff]
+}
+
+SAMPLE_BAD_SENTINEL = {
+    "schema_version": 1, "type": "sentinel", "iter": 3,
+    "wall_time": 1722700000.0, "phase": "sideways",  # unknown phase
+    "nan": 1, "inf": False, "overflow": False,       # nan not a bool
+}
+
 
 def check_file(path: str, schema) -> list:
     errs = []
@@ -86,19 +124,27 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     schema = _load_schema()
     if args.sample:
-        good = schema.validate_record(SAMPLE_GOOD)
-        bad = schema.validate_record(SAMPLE_BAD)
-        if good:
-            print("sample record REJECTED by its own schema:")
-            for e in good:
-                print(f"  {e}")
-            return 1
-        if not bad:
-            print("known-bad sample record PASSED validation "
-                  "(schema lost its teeth)")
-            return 1
-        print("sample self-check OK (good record accepted, "
-              f"bad record produced {len(bad)} violations)")
+        n_bad = 0
+        for name, rec in (("metrics", SAMPLE_GOOD),
+                          ("debug_trace", SAMPLE_GOOD_DEBUG),
+                          ("sentinel", SAMPLE_GOOD_SENTINEL)):
+            errs = schema.validate_record(rec)
+            if errs:
+                print(f"good {name} sample REJECTED by its own schema:")
+                for e in errs:
+                    print(f"  {e}")
+                return 1
+        for name, rec in (("metrics", SAMPLE_BAD),
+                          ("debug_trace", SAMPLE_BAD_DEBUG),
+                          ("sentinel", SAMPLE_BAD_SENTINEL)):
+            errs = schema.validate_record(rec)
+            if not errs:
+                print(f"known-bad {name} sample PASSED validation "
+                      "(schema lost its teeth)")
+                return 1
+            n_bad += len(errs)
+        print("sample self-check OK (3 good records accepted, 3 bad "
+              f"records produced {n_bad} violations)")
         return 0
     if not args.files:
         p.error("give at least one JSONL file (or --sample)")

@@ -60,6 +60,35 @@ def pytest_configure(config):
         raise pytest.UsageError(
             "--tpu must be passed on the pytest command line itself (it "
             "steers JAX platform selection before pytest parses options)")
+    # Naming a test by node id means "run THIS test": drop the addopts
+    # default `-m "not slow"` so an explicitly selected slow test runs
+    # without `-m ""` gymnastics. Only the pyproject default is dropped
+    # — a -m the user typed on the command line always wins.
+    inv = getattr(config, "invocation_params", None)
+    inv_args = list(inv.args) if inv else []
+    # positional selection args only: skip flags AND the value of the
+    # common value-taking options (so `--deselect pkg.py::t` or `-k x`
+    # cannot masquerade as a node-id selection)
+    _value_opts = ("-m", "-k", "-p", "-o", "-c", "-W", "--deselect",
+                   "--ignore", "--markexpr", "--rootdir", "--confcutdir")
+    positionals = []
+    prev = ""
+    for a in inv_args:
+        if a.startswith("-"):
+            prev = a
+            continue
+        if prev in _value_opts:
+            prev = ""
+            continue
+        positionals.append(a)
+        prev = ""
+    named_node_ids = bool(positionals) and all("::" in a
+                                               for a in positionals)
+    user_markexpr = any(a == "-m" or a.startswith("-m=")
+                        or a.startswith("--markexpr") for a in inv_args)
+    if (named_node_ids and not user_markexpr
+            and config.option.markexpr == "not slow"):
+        config.option.markexpr = ""
 
 
 def pytest_collection_modifyitems(config, items):
