@@ -53,11 +53,17 @@ STEPS = max(int(os.environ.get("BENCH_STEPS", "100")) // CHUNK, 1) * CHUNK
 def main():
     import jax
 
+    from rram_caffe_simulation_tpu import cache as rcache
     from rram_caffe_simulation_tpu.solver import Solver
     from rram_caffe_simulation_tpu.parallel import SweepRunner
     from rram_caffe_simulation_tpu.utils.io import read_solver_param
 
     os.chdir(REPO)
+    # cold-start layer (rram_caffe_simulation_tpu/cache.py): with
+    # RRAM_TPU_CACHE_DIR set, the XLA compile and the LMDB decode both
+    # come from disk on the second and every later run — the `setup`
+    # extra below splits the wall clock so BENCH_r0N.json tracks it
+    rcache.enable_compilation_cache()
     t_setup = time.perf_counter()
     sp = read_solver_param(os.path.join(
         REPO, "models", "cifar10_quick",
@@ -73,13 +79,18 @@ def main():
     sp.failure_pattern.std = 3e7
 
     solver = Solver(sp)
-    runner = SweepRunner(solver, n_configs=N_CONFIGS, compute_dtype=DTYPE)
+    # precompile_chunk: AOT-compile the CHUNK-step function on the main
+    # thread while the LMDB decode runs on a background thread — the
+    # two cold-start halves overlap instead of serializing
+    runner = SweepRunner(solver, n_configs=N_CONFIGS, compute_dtype=DTYPE,
+                         precompile_chunk=CHUNK)
     input_path = ("lmdb->transformer->device-resident dataset"
                   if runner._dataset is not None
                   else "host feed per step")
     runner.step(CHUNK, chunk=CHUNK)  # compile + warmup
     jax.block_until_ready(runner.params)
     setup_s = time.perf_counter() - t_setup
+    setup_rec = runner.setup_record(setup_s)
 
     t0 = time.perf_counter()
     runner.step(STEPS, chunk=CHUNK)
@@ -104,6 +115,12 @@ def main():
             "input_path": input_path,
             "setup_seconds_incl_lmdb_decode_and_compile":
                 round(setup_s, 1),
+            # the cold-start split (observe `setup` record shape):
+            # decode/compile may overlap (precompile_chunk), cache
+            # states hit|miss|partial|disabled per component
+            "decode_seconds": setup_rec["decode_seconds"],
+            "compile_seconds": setup_rec["compile_seconds"],
+            "cache": setup_rec["cache"],
             "steps_timed": STEPS, "batch": BATCH, "chunk": CHUNK,
             "n_configs": N_CONFIGS, "chips": n_chips,
             "seconds": round(dt, 3),

@@ -152,6 +152,11 @@ def main(argv=None):
                         "in-graph RNG then rides the timed step")
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="chip peak for the MFU column (v5e bf16 = 197)")
+    p.add_argument("--cache-dir", default="",
+                   help="cold-start cache root (overrides "
+                        "RRAM_TPU_CACHE_DIR): the step's XLA compile "
+                        "persists under <dir>/xla, so a second "
+                        "same-config run skips compilation entirely")
     p.add_argument("--json", action="store_true",
                    help="print one machine-readable JSON line")
     args = p.parse_args(argv)
@@ -159,11 +164,19 @@ def main(argv=None):
 
     os.chdir(REPO)
     import jax
+    from rram_caffe_simulation_tpu import cache as rcache
     from rram_caffe_simulation_tpu.proto import pb
     from rram_caffe_simulation_tpu.solver import Solver
     from rram_caffe_simulation_tpu.utils.io import read_net_param
     from rram_caffe_simulation_tpu.tools.summarize import net_fwd_flops
 
+    rcache.enable_compilation_cache(args.cache_dir or None)
+    setup_stats = rcache.SetupStats()
+    if rcache.cache_dir():
+        # the Input feed decodes no dataset: with a cache root active
+        # that is "unused", not "disabled" (= no cache dir configured)
+        setup_stats.dataset = "unused"
+    t_setup0 = time.perf_counter()
     netp = read_net_param(args.model)
     if args.dummy_data:
         netp = dummyize(netp, args.batch)
@@ -193,8 +206,9 @@ def main(argv=None):
     sync = lambda: jax.block_until_ready(
         jax.tree.leaves(solver.params)[0])
     t0 = time.perf_counter()
-    solver.step_fused(args.chunk, chunk=args.chunk)  # compile + warmup
-    sync()
+    with setup_stats.timed_compile():
+        solver.step_fused(args.chunk, chunk=args.chunk)  # compile + warmup
+        sync()
     setup_s = time.perf_counter() - t0
 
     dt = float("inf")
@@ -225,6 +239,12 @@ def main(argv=None):
         "chunk": args.chunk,
         "repeats": max(args.repeats, 1),
         "compile_warmup_s": round(setup_s, 1),
+        # the structured cold-start breakdown (observe `setup` record):
+        # decode_seconds is the host-side input staging (zero for the
+        # default pre-staged Input feed), compile_seconds the jit
+        # compile+warmup chunk, cache.compile hit|miss|partial|disabled
+        "setup": setup_stats.record(
+            setup_s=time.perf_counter() - t_setup0),
         "final_loss": round(float(loss), 4),
         "backend": jax.default_backend(),
     }
@@ -239,6 +259,8 @@ def main(argv=None):
         print(f"  (fwd {fwd_flops / 1e9:.1f} GFLOPs/batch, train = 3x; "
               f"compile+warmup {setup_s:.1f}s, final loss "
               f"{float(loss):.3f}, backend {rec['backend']})")
+        from rram_caffe_simulation_tpu.observe import setup_line
+        print("  " + setup_line(rec["setup"]))
     return rec
 
 

@@ -1,0 +1,185 @@
+"""Cold-start performance layer: persistent compile cache + setup stats.
+
+BENCH_r05 measured 136.6 s of per-process setup (LMDB decode + XLA
+compilation) against a ~12 s steady-state train loop — fault-tolerance
+studies are Monte-Carlo by construction (many short runs over fault
+configs), so that setup tax recurs on every process start and caps
+`fault_configs_swept_per_hour` directly. This module is the wiring that
+makes the second and every later run start warm:
+
+- `enable_compilation_cache` points JAX's persistent compilation cache
+  (`jax_compilation_cache_dir`) at `<cache_dir>/xla`, so every jitted
+  step function — Solver, SweepRunner, the dp/tp/pp wrappers — hits
+  disk instead of recompiling. Controlled by the `RRAM_TPU_CACHE_DIR`
+  env var and the `caffe_cli --cache-dir` / bench `--cache-dir` flags;
+  with neither set, nothing changes.
+- hit/miss counters ride JAX's monitoring events, so the emitted
+  `setup` record (observe/schema.py) can say whether a run's compiles
+  came from disk ("hit"), were compiled fresh ("miss"), or mixed
+  ("partial").
+- `SetupStats` collects the cold-start phase timings (decode seconds,
+  compile seconds, per-cache hit/miss) and assembles the structured
+  `setup` record benches and the sweep runner emit.
+
+The decoded-dataset half of the layer lives in `data/dataset_cache.py`
+(same root directory, `<cache_dir>/datasets`).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+ENV_VAR = "RRAM_TPU_CACHE_DIR"
+
+_lock = threading.Lock()
+_state = {"dir": None, "explicit": False, "listener": False}
+_counts = {"hits": 0, "misses": 0}
+
+
+def resolve_cache_dir(cli_value: Optional[str] = None) -> Optional[str]:
+    """The cache root: explicit argument (CLI flag) wins, then the
+    RRAM_TPU_CACHE_DIR env var; None = caching disabled."""
+    if cli_value:
+        return os.path.abspath(os.path.expanduser(cli_value))
+    env = os.environ.get(ENV_VAR, "")
+    return os.path.abspath(os.path.expanduser(env)) if env else None
+
+
+def _on_event(name: str, **kw):
+    # JAX emits these from the persistent-cache lookup path
+    # (jax/_src/compiler.py); counting them is how the setup record
+    # knows hit vs miss without touching cache internals.
+    if name == "/jax/compilation_cache/cache_hits":
+        _counts["hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        _counts["misses"] += 1
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None,
+                             ) -> Optional[str]:
+    """Wire the persistent XLA compilation cache to
+    `<cache_dir>/xla` (cache_dir resolved via `resolve_cache_dir`).
+    Returns the cache root, or None when no directory is configured —
+    in which case this is a no-op and compiles stay in-memory-only.
+
+    Idempotent; safe to call from every entry point (Solver.__init__,
+    the CLI, benches). An EXPLICIT directory (CLI flag) is latched:
+    later bare calls — e.g. Solver.__init__'s env-var hook — keep it
+    rather than demoting to the env var, so `--cache-dir` wins for the
+    whole process as its help text promises. Min-compile-time/size
+    thresholds are zeroed so even millisecond-scale step functions
+    (tiny CI nets) persist — the whole point is that NO second compile
+    of the same program ever happens on this machine."""
+    if not cache_dir and _state["explicit"] and _state["dir"]:
+        return _state["dir"]
+    d = resolve_cache_dir(cache_dir)
+    if d is None:
+        return None
+    import jax
+    xla_dir = os.path.join(d, "xla")
+    os.makedirs(xla_dir, exist_ok=True)
+    with _lock:
+        changed = _state["dir"] != d
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if changed:
+            # JAX latches its cache-in-use decision at the FIRST compile
+            # of the process; enabling after any jit has run is silently
+            # ignored unless that latch is reset (the on-disk content is
+            # untouched — this only re-arms the lookup path).
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        if not _state["listener"]:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_on_event)
+            _state["listener"] = True
+        _state["dir"] = d
+        if cache_dir:
+            _state["explicit"] = True
+    return d
+
+
+def cache_dir() -> Optional[str]:
+    """The active cache root (None until enable_compilation_cache
+    succeeds)."""
+    return _state["dir"]
+
+
+def compile_cache_stats() -> dict:
+    """Cumulative persistent-cache counters for this process:
+    {"hits": int, "misses": int}."""
+    return dict(_counts)
+
+
+def _status_from(h0: int, m0: int) -> str:
+    """hit / miss / partial / disabled from a counter delta."""
+    if _state["dir"] is None:
+        return "disabled"
+    dh = _counts["hits"] - h0
+    dm = _counts["misses"] - m0
+    if dh and not dm:
+        return "hit"
+    if dh and dm:
+        return "partial"
+    return "miss"
+
+
+class SetupStats:
+    """Cold-start phase accounting for one process: decode seconds,
+    compile seconds, and per-cache hit/miss, assembled into the
+    `setup` record documented in observe/schema.py.
+
+    Compile status is derived from the persistent-cache counter delta
+    over this object's lifetime, so construct it BEFORE the first
+    compile of the run."""
+
+    def __init__(self):
+        self.decode_s = 0.0
+        self.compile_s = 0.0
+        self.dataset = "disabled"   # hit | miss | disabled
+        self._h0 = _counts["hits"]
+        self._m0 = _counts["misses"]
+
+    def add_decode(self, seconds: float):
+        self.decode_s += float(seconds)
+
+    def add_compile(self, seconds: float):
+        self.compile_s += float(seconds)
+
+    def timed_decode(self):
+        return _Timed(self.add_decode)
+
+    def timed_compile(self):
+        return _Timed(self.add_compile)
+
+    def compile_status(self) -> str:
+        return _status_from(self._h0, self._m0)
+
+    def record(self, setup_s: Optional[float] = None) -> dict:
+        """The schema-versioned `setup` record (observe/schema.py);
+        `setup_s` is the caller's total wall clock when it tracked one
+        (decode and compile may overlap, so the phases need not sum to
+        it)."""
+        from .observe.sink import make_setup_record
+        return make_setup_record(
+            decode_s=self.decode_s, compile_s=self.compile_s,
+            compile_status=self.compile_status(),
+            dataset_status=self.dataset,
+            cache_dir=_state["dir"], setup_s=setup_s)
+
+
+class _Timed:
+    def __init__(self, sink):
+        self._sink = sink
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._sink(time.perf_counter() - self._t0)
+        return False

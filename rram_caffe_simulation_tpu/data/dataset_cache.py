@@ -1,0 +1,165 @@
+"""Decoded-dataset disk cache: memoize the LMDB/LevelDB/ImageData →
+ndarray decode into an .npz under `<cache_dir>/datasets`.
+
+The pure-Python Datum decode + DataTransformer pass over a full LMDB is
+the multi-minute half of the measured cold start (BENCH_r05: setup
+136.6 s vs a ~12 s train loop), and its output is a pure function of
+(source bytes, transform/batch parameters). So it caches cleanly:
+
+- the key is a SHA-256 over the source's identity — every data file's
+  relative name, size, and mtime_ns — plus a caller-supplied params
+  dict (serialized transform proto, phase, tops, byte budget). Touching
+  the DB or changing any transform parameter changes the key, so stale
+  entries are never read; they just age out (`when to wipe`: never for
+  correctness, occasionally for disk space).
+- entries are written atomically: np.savez to a temp file in the same
+  directory, then os.replace. A crashed writer leaves only a temp file
+  (ignored), never a half-readable entry; concurrent writers race
+  benignly (last replace wins, both wrote identical bytes).
+- a sidecar `<key>.json` records the human-readable key inputs for
+  debugging.
+
+Enabled exactly like the compile cache (rram_caffe_simulation_tpu/
+cache.py): `RRAM_TPU_CACHE_DIR` or an explicit directory; with neither,
+every call is a transparent "disabled" pass-through to the decoder.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import zipfile
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import cache as _cache
+
+
+def dataset_cache_dir(cache_dir: Optional[str] = None) -> Optional[str]:
+    """`<cache root>/datasets`, or None when caching is disabled. An
+    explicit argument wins, then the ACTIVE root (so both caches share
+    the directory an operator enabled with `--cache-dir`, even when the
+    env var points elsewhere), then the env var."""
+    if cache_dir:
+        root = _cache.resolve_cache_dir(cache_dir)
+    else:
+        root = _cache.cache_dir() or _cache.resolve_cache_dir(None)
+    if root is None:
+        return None
+    return os.path.join(root, "datasets")
+
+
+def source_signature(source: str) -> dict:
+    """Identity of a dataset source on disk: for a directory (LMDB /
+    LevelDB layout) every entry's (name, size, mtime_ns); for a single
+    file its (size, mtime_ns). Any rewrite — even same-size — bumps
+    mtime_ns and therefore the key."""
+    source = os.path.abspath(source)
+    sig = {"path": source}
+    if os.path.isdir(source):
+        entries = []
+        for name in sorted(os.listdir(source)):
+            st = os.stat(os.path.join(source, name))
+            entries.append([name, st.st_size, st.st_mtime_ns])
+        sig["entries"] = entries
+    else:
+        st = os.stat(source)
+        sig["size"] = st.st_size
+        sig["mtime_ns"] = st.st_mtime_ns
+    return sig
+
+
+def cache_key(source: str, params: dict) -> str:
+    """Deterministic key over the source signature + decode params.
+    `params` must be JSON-serializable (serialize protos to hex
+    first)."""
+    payload = json.dumps({"source": source_signature(source),
+                          "params": params},
+                         sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def load(key: str, cache_dir: Optional[str] = None
+         ) -> Optional[Dict[str, np.ndarray]]:
+    """The cached arrays for `key`, or None (missing, unreadable, or
+    caching disabled). A corrupt entry is treated as a miss — the
+    decoder runs and `store` overwrites it."""
+    d = dataset_cache_dir(cache_dir)
+    if d is None:
+        return None
+    path = os.path.join(d, key + ".npz")
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            return {name: z[name] for name in z.files}
+    except (OSError, ValueError, EOFError, zipfile.BadZipFile):
+        # BadZipFile: zip magic intact but the archive truncated by
+        # external means (disk-full copy, partial sync) — a miss, so
+        # the decoder runs and store() overwrites the entry
+        return None
+
+
+def store(key: str, arrays: Dict[str, np.ndarray],
+          cache_dir: Optional[str] = None, params: Optional[dict] = None
+          ) -> Optional[str]:
+    """Atomically persist `arrays` under `key`; returns the entry path
+    (None when caching is disabled or the write failed — a full disk
+    must not take the run down, the decode already succeeded)."""
+    d = dataset_cache_dir(cache_dir)
+    if d is None:
+        return None
+    path = os.path.join(d, key + ".npz")
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=key[:8] + ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        if params is not None:
+            # same unique-temp + rename dance as the payload: a fixed
+            # .tmp name would let concurrent cold-starters truncate each
+            # other mid-write and install a torn sidecar
+            meta = os.path.join(d, key + ".json")
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=key[:8] + ".",
+                                       suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(params, f, sort_keys=True, indent=1)
+                os.replace(tmp, meta)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+    except OSError:
+        return None
+    return path
+
+
+def memoize(source: str, params: dict,
+            decode: Callable[[], Optional[Dict[str, np.ndarray]]],
+            cache_dir: Optional[str] = None,
+            ) -> Tuple[Optional[Dict[str, np.ndarray]], str]:
+    """Run `decode` through the cache. Returns (arrays, status) with
+    status in {"hit", "miss", "disabled"}; a decode that returns None
+    (non-materializable source) is passed through uncached."""
+    d = dataset_cache_dir(cache_dir)
+    if d is None:
+        return decode(), "disabled"
+    key = cache_key(source, params)
+    cached = load(key, cache_dir)
+    if cached is not None:
+        return cached, "hit"
+    arrays = decode()
+    if arrays is not None:
+        store(key, {k: np.asarray(v) for k, v in arrays.items()},
+              cache_dir, params=params)
+    return arrays, "miss"

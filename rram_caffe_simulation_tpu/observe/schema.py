@@ -28,8 +28,10 @@ else every record. Under a Monte-Carlo
 sweep the scalar counter fields become per-config lists — `validate_record`
 accepts both shapes.
 
-Two further record types carry the `debug_info` deep traces, keyed by a
-`"type"` field (records without one are the metrics record above):
+Further record types are keyed by a `"type"` field (records without one
+are the metrics record above): `setup` — one per process cold start,
+the decode/compile breakdown plus per-cache hit/miss (documented inline
+below) — and two that carry the `debug_info` deep traces:
 
 ``debug_trace`` — one per iteration while `debug_info: true`, the
 structured twin of the reference's ForwardDebugInfo / BackwardDebugInfo
@@ -136,6 +138,39 @@ DEBUG_UPDATE_FIELDS = {
     "diff": (_NUM, True),
 }
 
+# --- setup records (cold-start breakdown, one per process start) ---
+#
+# {"schema_version": 1, "type": "setup", "wall_time": 1722700000.1,
+#  "decode_seconds": 121.4, "compile_seconds": 14.9,
+#  "setup_seconds": 136.6,                       # caller's total wall
+#  "cache": {"compile": "hit", "dataset": "miss"},
+#  "cache_dir": "/var/cache/rram-tpu"}
+#
+# decode/compile may OVERLAP (SweepRunner precompile_chunk), so the two
+# phase fields need not sum to setup_seconds. Cache states: "hit" =
+# every lookup served from disk, "miss" = none, "partial" = mixed
+# (compile cache only), "disabled" = no cache dir configured,
+# "unused" = cache configured but this run had no such work (e.g. an
+# Input-fed bench performs no dataset decode).
+
+SETUP_CACHE_STATES = ("hit", "miss", "partial", "disabled", "unused")
+
+SETUP_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "wall_time": (_NUM, True),
+    "decode_seconds": (_NUM, True),
+    "compile_seconds": (_NUM, True),
+    "setup_seconds": (_NUM, False),
+    "cache": (dict, True),
+    "cache_dir": (str, False),
+}
+
+SETUP_CACHE_FIELDS = {
+    "compile": (str, True),
+    "dataset": (str, True),
+}
+
 # --- sentinel records (tripped numeric-health flags) ---
 
 SENTINEL_PHASES = ("forward", "backward", "update", "fault", "loss")
@@ -225,6 +260,24 @@ def _validate_debug_trace(rec) -> list:
     return errs
 
 
+def _validate_setup(rec) -> list:
+    errs = _check_fields(rec, SETUP_FIELDS, "setup")
+    cache = rec.get("cache")
+    if isinstance(cache, dict):
+        errs += _check_fields(cache, SETUP_CACHE_FIELDS, "setup.cache")
+        for key in SETUP_CACHE_FIELDS:
+            val = cache.get(key)
+            if isinstance(val, str) and val not in SETUP_CACHE_STATES:
+                errs.append(f"setup.cache.{key}: unknown state {val!r} "
+                            f"(expected one of {SETUP_CACHE_STATES})")
+    for key in ("decode_seconds", "compile_seconds", "setup_seconds"):
+        val = rec.get(key)
+        if isinstance(val, _NUM) and not isinstance(val, bool) \
+                and val < 0:
+            errs.append(f"setup.{key}: must be >= 0")
+    return errs
+
+
 def _validate_sentinel(rec) -> list:
     errs = _check_fields(rec, SENTINEL_FIELDS, "sentinel")
     errs += _check_iter(rec, "sentinel")
@@ -251,6 +304,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_debug_trace(rec)
     if rtype == "sentinel":
         return _check_version(rec) + _validate_sentinel(rec)
+    if rtype == "setup":
+        return _check_version(rec) + _validate_setup(rec)
     if rtype is not None:
         return [f"record: unknown record type {rtype!r}"]
     errs = _check_fields(rec, TOP_LEVEL, "record")

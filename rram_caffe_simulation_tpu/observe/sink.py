@@ -65,6 +65,41 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
     return rec
 
 
+def make_setup_record(decode_s: float, compile_s: float,
+                      compile_status: str, dataset_status: str,
+                      cache_dir: Optional[str] = None,
+                      setup_s: Optional[float] = None) -> dict:
+    """One `setup` record per process cold start (schema.py): the
+    decode/compile split of the setup wall clock plus each cache's
+    hit/miss — the record benches and CI track to hold the cold-start
+    trajectory. `setup_s` is the caller's TOTAL setup wall time; decode
+    and compile may overlap, so the phases need not sum to it."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "setup",
+        "wall_time": time.time(),
+        "decode_seconds": round(float(decode_s), 4),
+        "compile_seconds": round(float(compile_s), 4),
+        "cache": {"compile": compile_status, "dataset": dataset_status},
+    }
+    if setup_s is not None:
+        rec["setup_seconds"] = round(float(setup_s), 4)
+    if cache_dir:
+        rec["cache_dir"] = cache_dir
+    return rec
+
+
+def setup_line(record: dict) -> str:
+    """One-line text form of a `setup` record."""
+    cache = record.get("cache", {})
+    extra = (f", total {record['setup_seconds']:g} s"
+             if "setup_seconds" in record else "")
+    return (f"Setup: decode {record.get('decode_seconds', 0):g} s, "
+            f"compile {record.get('compile_seconds', 0):g} s{extra} "
+            f"(compile cache {cache.get('compile', '?')}, "
+            f"dataset cache {cache.get('dataset', '?')})")
+
+
 class MetricsLogger:
     """Sink registry. Every `log(record)` fans out to all sinks; sinks
     are closed (flushed) by `close` — call it when the run ends."""
@@ -189,6 +224,10 @@ class CaffeLogSink:
             return
         if rtype == "sentinel":
             self._emit(sentinel_line(record))
+            self._f.flush()
+            return
+        if rtype == "setup":
+            self._emit(setup_line(record))
             self._f.flush()
             return
         if rtype is not None:

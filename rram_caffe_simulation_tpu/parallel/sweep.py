@@ -12,12 +12,14 @@ mean/std grid sweeps.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import cache as _cache
 from ..fault import engine as fault_engine
 from .mesh import make_mesh
 
@@ -57,12 +59,23 @@ class SweepRunner:
 
     def __init__(self, solver, n_configs: int, mesh=None, means=None,
                  stds=None, preload: bool = True, compute_dtype=None,
-                 remat_segments: int = 0, config_block: int = 0):
+                 remat_segments: int = 0, config_block: int = 0,
+                 precompile_chunk: int = 0):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
         self.solver = solver
         self.n = n_configs
+        # cold-start accounting: decode/compile seconds + cache
+        # hit/miss, emitted via setup_record() (observe `setup` record)
+        self.setup = _cache.SetupStats()
+        from ..data import dataset_cache
+        if dataset_cache.dataset_cache_dir() is not None:
+            # a cache dir IS configured; "unused" (vs "disabled") until
+            # an actual decode refines it to hit/miss — a runner built
+            # with preload=False, or whose source can't materialize,
+            # must not read as "cache off"
+            self.setup.dataset = "unused"
         if mesh is None:
             n_dev = min(n_configs, len(jax.devices()))
             mesh = make_mesh({"config": n_dev},
@@ -186,13 +199,17 @@ class SweepRunner:
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._vstep = vstep
         self._chunk_fns = {}
+        self._aot_keys = set()
         self._eval_fns = {}
         self._dataset = None
         self._ds_batch = 0
         self._ds_n = 0
+        # state placement happens BEFORE the dataset decode so an
+        # overlapped AOT compile (`precompile_chunk`) can lower against
+        # the final param/history/fault shardings while the host decodes
+        self._place_state()
         if preload:
-            self._try_preload()
-        self._place()
+            self._preload(precompile_chunk)
         # One feed instance for every host path (chunked or not) so the
         # cursor advances consistently across mixed step() calls. The
         # default feed is built RAW (no prefetch device_put): chunked
@@ -216,93 +233,248 @@ class SweepRunner:
         return {k: np.stack([np.asarray(s[k]) for s in subs])
                 for k in subs[0]}
 
-    def _try_preload(self):
+    def _materializable_layer(self):
+        """The single Data layer whose DB can become the device-resident
+        dataset, or None (custom feed, iter_size stacking, wrong layer
+        mix, random per-pull transforms — the same gates
+        feed.materialize_data_source applies, mirrored here so a doomed
+        preload never probes the DB or AOT-compiles the dataset-path
+        chunk function it could not use)."""
+        if getattr(self.solver, "custom_train_feed", False):
+            return None
+        if max(self.solver.param.iter_size, 1) > 1:
+            return None
+        src_layers = [l for l in self.solver.net.layers
+                      if l.is_data_source]
+        if len(src_layers) != 1:
+            return None
+        from ..data.feed import can_materialize
+        return src_layers[0] if can_materialize(src_layers[0]) else None
+
+    def _preload(self, precompile_chunk: int = 0):
         """Upload the whole training set to device once when it's small and
         the transform is deterministic; batches are then gathered on-device
         by iteration index, removing per-step host->device transfers (see
-        feed.materialize_data_source). Skipped when the caller supplied a
-        custom train_feed (its batches are authoritative, not the DB) or
-        uses iter_size accumulation (the host feed path stacks those)."""
-        from ..data.feed import materialize_data_source
-        if getattr(self.solver, "custom_train_feed", False):
-            return
-        if max(self.solver.param.iter_size, 1) > 1:
-            return
-        src_layers = [l for l in self.solver.net.layers if l.is_data_source]
-        if len(src_layers) != 1 or src_layers[0].type_name != "Data":
-            return
-        arrays = materialize_data_source(src_layers[0])
-        if arrays is None:
-            return
-        self._ds_batch = int(src_layers[0].lp.data_param.batch_size)
-        self._ds_n = next(iter(arrays.values())).shape[0]
-        # host arrays here; _place() device_puts them with the mesh layout
-        self._dataset = arrays
+        feed.materialize_data_source — which memoizes the decode through
+        the dataset disk cache when RRAM_TPU_CACHE_DIR is set).
 
-    def _chunk_fn(self, k: int):
-        """One dispatch = k scanned sweep iterations. On a tunneled/remote
-        runtime each dispatch pays a fixed round-trip; scanning k steps
-        under one jit amortizes it (measured: the per-dispatch overhead,
-        not compute, capped the single-chip sweep rate). With a preloaded
-        device dataset the batch is gathered on-device by iteration index
-        instead of riding the host->device path each step."""
+        `precompile_chunk` > 0 overlaps the two halves of the cold
+        start: the dataset array shapes are predicted from the DB
+        header alone (count + first-record shape + crop), the decode
+        moves to a background thread, and the main thread AOT-compiles
+        the k-iteration chunk function (`jit(...).lower().compile()`)
+        against those predicted shapes — so by the time the decode
+        lands, the step is (persistent-cache permitting) ready to run."""
+        from ..data.feed import materialize_data_source
+        layer = self._materializable_layer()
+        if layer is None:
+            return
+
+        result: dict = {}
+
+        def decode():
+            try:
+                with self.setup.timed_decode():
+                    result["arrays"], result["status"] = \
+                        materialize_data_source(layer, with_status=True)
+            except BaseException as e:
+                result["error"] = e
+
+        probe = self._probe_dataset(layer) if precompile_chunk else None
+        if probe is not None:
+            self._ds_batch, self._ds_n = probe["batch"], probe["n"]
+            t = threading.Thread(target=decode, name="dataset-decode")
+            t.start()
+            try:
+                with self.setup.timed_compile():
+                    self._aot_compile_chunk(int(precompile_chunk), probe)
+            except Exception:
+                # AOT is an optimization only — any lowering/compile
+                # hiccup falls back to the lazy jit path at first step
+                self._chunk_fns.pop((int(precompile_chunk), True), None)
+            t.join()
+        else:
+            decode()
+        if "error" in result:
+            raise result["error"]
+        self.setup.dataset = result.get("status", self.setup.dataset)
+        arrays = result.get("arrays")
+        if arrays is None:
+            self._ds_batch = self._ds_n = 0
+            if probe is not None:
+                # the probe-built dataset-path executable can never run
+                # (step() keys on (k, False) now) — drop it instead of
+                # pinning a dead XLA executable for the runner's life
+                self._chunk_fns.pop((int(precompile_chunk), True), None)
+                self._aot_keys.discard((int(precompile_chunk), True))
+            return
+        self._ds_batch = int(layer.lp.data_param.batch_size)
+        self._ds_n = next(iter(arrays.values())).shape[0]
+        self._dataset = arrays
+        self._place_dataset()
+
+    def _probe_dataset(self, layer):
+        """Predict the device-dataset shapes from the DB header alone
+        (record count, first-Datum shape, deterministic center crop) —
+        milliseconds, vs the minutes of the decode it lets compilation
+        overlap with. None when the probe fails (no DB yet, etc.)."""
+        try:
+            from ..data.db import infer_datum_shape, open_db
+            dp = layer.lp.data_param
+            tp = layer.lp.transform_param
+            c, h, w = infer_datum_shape(dp.source, dp.backend)
+            db = open_db(dp.source, dp.backend)
+            n = len(db)
+            db.close()
+        except Exception:
+            return None
+        if not n:
+            return None
+        crop = int(tp.crop_size)
+        oh, ow = (crop, crop) if crop else (h, w)
+        tops = list(layer.lp.top)
+        shapes = {tops[0]: (n, c, oh, ow)}
+        if len(tops) > 1:
+            shapes[tops[1]] = (n,)
+        return {"batch": int(dp.batch_size), "n": n, "shapes": shapes}
+
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _dataset_sharding(self, ndim: int):
+        """Rows sharded over "data" when the mesh has one (HBM cost
+        scales down with the mesh); replicated over the mesh
+        otherwise."""
+        from .mesh import data_sharding
+        if self._batch_sharding is not None:
+            return data_sharding(self.mesh, ndim=ndim)
+        return self._replicated_sharding()
+
+    def _aot_compile_chunk(self, k: int, probe: dict):
+        """Ahead-of-time compile of the k-iteration dataset-path chunk
+        function against predicted dataset shapes; runs on the main
+        thread while the decode owns a background thread. The compiled
+        executable lands in the same _chunk_fns slot the lazy path
+        would fill, so step() picks it up transparently."""
+        run = self._make_chunk_run(with_dataset=True)
+        jfn = jax.jit(run, donate_argnums=(0, 1, 2))
+        rep = self._replicated_sharding()
+        ds = {name: jax.ShapeDtypeStruct(
+                  shape, jnp.float32,
+                  sharding=self._dataset_sharding(len(shape)))
+              for name, shape in probe["shapes"].items()}
+        its = jax.ShapeDtypeStruct((k,), jnp.int32, sharding=rep)
+        starts = jax.ShapeDtypeStruct((k,), jnp.int32, sharding=rep)
+        remaps = jax.ShapeDtypeStruct((k,), jnp.bool_, sharding=rep)
+        compiled = jfn.lower(self.params, self.history, self.fault_states,
+                             ds, its, starts, remaps).compile()
+        self._chunk_fns[(k, True)] = compiled
+        self._aot_keys.add((k, True))
+
+    def _make_chunk_run(self, with_dataset: bool):
+        """Build the scanned k-iteration run function. The device
+        dataset is an ARGUMENT (not a closure constant): AOT lowering
+        can describe it as a ShapeDtypeStruct before the decode
+        finishes, and a refreshed dataset never forces a retrace."""
+        n = self.n
+
+        def inner(params, history, fault, batch_t, it_t, remap_t):
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(self.solver._key, it_t), i))(
+                        jnp.arange(n))
+            return self._vstep(params, history, fault, batch_t, it_t,
+                               rngs, remap_t)
+
+        if not with_dataset:
+            def one(carry, xs):
+                params, history, fault = carry
+                batch_t, it_t, remap_t = xs
+                p2, h2, f2, loss, outputs, mets = inner(
+                    params, history, fault, batch_t, it_t, remap_t)
+                return (p2, h2, f2), (loss, outputs, mets)
+
+            def run(params, history, fault, batches, its, remaps):
+                (p, h, f), (losses, outputs, mets) = jax.lax.scan(
+                    one, (params, history, fault),
+                    (batches, its, remaps))
+                return p, h, f, losses, outputs, mets
+            return run
+
+        B, N = self._ds_batch, self._ds_n
+
+        def run(params, history, fault, dataset, its, starts, remaps):
+            def one(carry, xs):
+                params_, history_, fault_ = carry
+                it_t, start_t, remap_t = xs
+                # sequential wrap-around order == the host cursor
+                # feed; start_t = (it*B) % N is computed on the host
+                # in arbitrary precision (it*B overflows int32 after
+                # ~21M iterations at batch 100)
+                idx = (start_t + jnp.arange(B)) % N
+                batch_t = {name: arr[idx]
+                           for name, arr in dataset.items()}
+                if self._batch_sharding is not None:
+                    batch_t = {
+                        name: jax.lax.with_sharding_constraint(
+                            v, self._batch_sharding(v.ndim))
+                        for name, v in batch_t.items()}
+                p2, h2, f2, loss, outputs, mets = inner(
+                    params_, history_, fault_, batch_t, it_t, remap_t)
+                return (p2, h2, f2), (loss, outputs, mets)
+
+            (p, h, f), (losses, outputs, mets) = jax.lax.scan(
+                one, (params, history, fault), (its, starts, remaps))
+            return p, h, f, losses, outputs, mets
+        return run
+
+    def _run_chunk(self, k: int, *args):
+        """Dispatch one chunk = k scanned sweep iterations. On a
+        tunneled/remote runtime each dispatch pays a fixed round-trip;
+        scanning k steps under one jit amortizes it (measured: the
+        per-dispatch overhead, not compute, capped the single-chip
+        sweep rate). With a preloaded device dataset the batch is
+        gathered on-device by iteration index instead of riding the
+        host->device path each step.
+
+        A first-use entry compiles HERE against
+        the real arguments, inside `setup.timed_compile()` — so the
+        setup record's compile_seconds stays honest on the lazy path
+        too (probe declined, host feed, or precompile_chunk=0), not
+        just for the overlapped AOT compile.
+
+        If an AOT executable (compiled against PREDICTED dataset
+        shapes) rejects the real arguments, rebuild and retry once —
+        correctness never depends on the probe. Only the PRE-execution
+        mismatch errors retry (a compiled call validates
+        types/shardings and raises TypeError/ValueError before
+        running): an execution failure must propagate — the donated
+        input buffers are already gone, so a retry would only mask the
+        root cause with 'array deleted' noise."""
         key = (k, self._dataset is not None)
         if key not in self._chunk_fns:
-            n = self.n
+            jfn = jax.jit(self._make_chunk_run(with_dataset=key[1]),
+                          donate_argnums=(0, 1, 2))
+            with self.setup.timed_compile():
+                self._chunk_fns[key] = jfn.lower(*args).compile()
+        fn = self._chunk_fns[key]
+        try:
+            return fn(*args)
+        except (TypeError, ValueError):
+            if key not in self._aot_keys:
+                raise
+            self._aot_keys.discard(key)
+            del self._chunk_fns[key]
+            return self._run_chunk(k, *args)
 
-            def inner(params, history, fault, batch_t, it_t, remap_t):
-                rngs = jax.vmap(
-                    lambda i: jax.random.fold_in(
-                        jax.random.fold_in(self.solver._key, it_t), i))(
-                            jnp.arange(n))
-                return self._vstep(params, history, fault, batch_t, it_t,
-                                   rngs, remap_t)
+    def setup_record(self, setup_s: Optional[float] = None) -> dict:
+        """The schema-versioned `setup` record for this runner's cold
+        start (observe/schema.py: decode/compile seconds + per-cache
+        hit/miss); `setup_s` is the caller's total setup wall clock."""
+        return self.setup.record(setup_s)
 
-            if self._dataset is None:
-                def one(carry, xs):
-                    params, history, fault = carry
-                    batch_t, it_t, remap_t = xs
-                    p2, h2, f2, loss, outputs, mets = inner(
-                        params, history, fault, batch_t, it_t, remap_t)
-                    return (p2, h2, f2), (loss, outputs, mets)
-
-                def run(params, history, fault, batches, its, remaps):
-                    (p, h, f), (losses, outputs, mets) = jax.lax.scan(
-                        one, (params, history, fault),
-                        (batches, its, remaps))
-                    return p, h, f, losses, outputs, mets
-            else:
-                B, N = self._ds_batch, self._ds_n
-
-                def one(carry, xs):
-                    params, history, fault = carry
-                    it_t, start_t, remap_t = xs
-                    # sequential wrap-around order == the host cursor
-                    # feed; start_t = (it*B) % N is computed on the host
-                    # in arbitrary precision (it*B overflows int32 after
-                    # ~21M iterations at batch 100)
-                    idx = (start_t + jnp.arange(B)) % N
-                    batch_t = {name: arr[idx]
-                               for name, arr in self._dataset.items()}
-                    if self._batch_sharding is not None:
-                        batch_t = {
-                            name: jax.lax.with_sharding_constraint(
-                                v, self._batch_sharding(v.ndim))
-                            for name, v in batch_t.items()}
-                    p2, h2, f2, loss, outputs, mets = inner(
-                        params, history, fault, batch_t, it_t, remap_t)
-                    return (p2, h2, f2), (loss, outputs, mets)
-
-                def run(params, history, fault, its, starts, remaps):
-                    (p, h, f), (losses, outputs, mets) = jax.lax.scan(
-                        one, (params, history, fault),
-                        (its, starts, remaps))
-                    return p, h, f, losses, outputs, mets
-
-            self._chunk_fns[key] = jax.jit(run, donate_argnums=(0, 1, 2))
-        return self._chunk_fns[key]
-
-    def _place(self):
+    def _place_state(self):
         from .mesh import data_sharding
         has_config = "config" in self.mesh.axis_names
         has_data = "data" in self.mesh.axis_names
@@ -333,16 +505,15 @@ class SweepRunner:
                                self.params, self.history,
                                self.fault_states,
                                lead_axis="config" if has_config else None))
-        if self._dataset is not None:
-            # rows sharded over "data" when present (HBM cost scales down
-            # with the mesh instead of replicating the whole dataset);
-            # otherwise replicated explicitly.
-            if self._batch_sharding is not None:
-                put = lambda v: jax.device_put(
-                    jnp.asarray(v), data_sharding(self.mesh, ndim=v.ndim))
-            else:
-                put = jnp.asarray
-            self._dataset = {k: put(v) for k, v in self._dataset.items()}
+
+    def _place_dataset(self):
+        """Device-place the decoded dataset with an explicit mesh-wide
+        sharding (replicated, or rows over "data") — explicit so the
+        AOT-lowered executable's input spec matches exactly."""
+        self._dataset = {
+            name: jax.device_put(jnp.asarray(v),
+                                 self._dataset_sharding(np.ndim(v)))
+            for name, v in self._dataset.items()}
 
     def _remap_due(self) -> bool:
         """Same start/period gating as Solver._remap_due — remapping stays
@@ -417,12 +588,15 @@ class SweepRunner:
                     starts.append((self.iter * self._ds_batch) % self._ds_n)
                     remaps.append(self._remap_due())
                     self.iter += 1
+                rep = self._replicated_sharding()
+                put = lambda v: jax.device_put(v, rep)
                 (self.params, self.history, self.fault_states, losses,
-                 outputs, mets) = self._chunk_fn(k)(
-                    self.params, self.history, self.fault_states,
-                    jnp.asarray(its, jnp.int32),
-                    jnp.asarray(starts, jnp.int32),
-                    jnp.asarray(remaps))
+                 outputs, mets) = self._run_chunk(
+                    k, self.params, self.history, self.fault_states,
+                    self._dataset,
+                    put(jnp.asarray(its, jnp.int32)),
+                    put(jnp.asarray(starts, jnp.int32)),
+                    put(jnp.asarray(remaps)))
                 self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
                 done += k
             return (np.asarray(losses)[-1],
@@ -458,8 +632,8 @@ class SweepRunner:
                 {kk: np.stack([sb[kk] for sb in subs]) for kk in subs[0]},
                 stacked=True)
             (self.params, self.history, self.fault_states, losses,
-             outputs, mets) = self._chunk_fn(k)(
-                self.params, self.history, self.fault_states, batches,
+             outputs, mets) = self._run_chunk(
+                k, self.params, self.history, self.fault_states, batches,
                 jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
             self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
             done += k

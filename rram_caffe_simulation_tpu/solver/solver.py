@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import cache as perf_cache
 from ..fault import engine as fault_engine
 from ..fault import strategies as fault_strategies
 from ..net import Net
@@ -99,6 +100,11 @@ class Solver:
                  test_feeds=None, compute_dtype=None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
+        # cold-start layer: when RRAM_TPU_CACHE_DIR is set, every jitted
+        # step this solver (or its dp/tp/pp/sweep wrappers) builds hits
+        # the persistent XLA compile cache instead of recompiling
+        # (no-op without the env var; the CLI flag wires through too)
+        perf_cache.enable_compilation_cache()
         self.param = param
         # forward/backward dtype for the train step (e.g. "bfloat16");
         # masters/updates/fault state stay f32 — see make_train_step

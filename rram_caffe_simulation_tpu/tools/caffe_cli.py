@@ -8,6 +8,7 @@ Usage: python -m rram_caffe_simulation_tpu.tools.caffe_cli <command> [flags]
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import time as _time
@@ -612,11 +613,25 @@ def main(argv=None):
                         "print a diagnostic naming the offending phase/"
                         "layer and stop ('halt'), or snapshot first "
                         "via the SIGINT snapshot path ('snapshot')")
+    p.add_argument("--cache-dir", default="",
+                   help="cold-start cache root (overrides the "
+                        "RRAM_TPU_CACHE_DIR env var): <dir>/xla holds "
+                        "the persistent XLA compile cache so a second "
+                        "run of the same step skips compilation, "
+                        "<dir>/datasets the decoded-dataset cache "
+                        "(USAGE.md 'Caching & cold start')")
     p.add_argument("--sigint_effect", default="stop",
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
     args = p.parse_args(argv)
+    if args.cache_dir or os.environ.get("RRAM_TPU_CACHE_DIR"):
+        from ..cache import enable_compilation_cache
+        d = enable_compilation_cache(args.cache_dir or None)
+        if d:
+            print(f"Cold-start cache at {d} (xla/ compile cache, "
+                  "datasets/ decoded datasets)", file=sys.stderr,
+                  flush=True)
     if getattr(args, "compute_dtype", ""):
         import jax.numpy as jnp
         try:

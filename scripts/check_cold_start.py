@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""CI guard for the cold-start layer: run a tiny sweep training TWICE
+in fresh processes sharing one RRAM_TPU_CACHE_DIR, and assert the
+second run's `setup` record reports a compilation-cache hit AND a
+dataset-cache hit.
+
+This pins the end-to-end wiring — Solver/SweepRunner -> cache.py ->
+jax persistent compile cache, and materialize_data_source ->
+data/dataset_cache.py — against regressions: any key instability
+(nondeterministic HLO, a source-signature change leaking into the key)
+or a broken enable path turns the second run into a miss and fails CI.
+It also cross-checks that the warm run's batch tensors are
+byte-identical to the cold run's fresh decode.
+
+    python scripts/check_cold_start.py            # parent: orchestrates
+    python scripts/check_cold_start.py --child DB # one training run
+
+Exit status: 0 = second run hit both caches, 1 = any miss/violation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+MARK = "SETUP_RECORD:"
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(32):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def child(db: str) -> int:
+    """One cold-start-instrumented training run; prints the setup
+    record (and a digest of the decoded batch tensors) on stdout."""
+    import hashlib
+
+    import numpy as np
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    solver_txt = """
+    base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+    max_iter: 100 display: 0 random_seed: 3 snapshot_prefix: "/tmp/ccs"
+    failure_pattern { type: "gaussian" mean: 1e8 std: 3e7 }
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(solver_txt, sp)
+    net_txt = f"""
+    name: "coldstart"
+    layer {{ name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{db}" batch_size: 8 }}
+      transform_param {{ scale: 0.00390625 }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param {{ num_output: 4
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" top: "loss" }}
+    """
+    text_format.Parse(net_txt, sp.net_param)
+    solver = Solver(sp)
+    runner = SweepRunner(solver, n_configs=2, precompile_chunk=2)
+    runner.step(4, chunk=2)
+    rec = runner.setup_record()
+    digest = hashlib.sha256()
+    for name in sorted(runner._dataset):
+        digest.update(np.asarray(runner._dataset[name]).tobytes())
+    rec["_dataset_sha256"] = digest.hexdigest()
+    print(MARK + json.dumps(rec), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--child", metavar="DB", default="")
+    args = p.parse_args(argv)
+    if args.child:
+        return child(args.child)
+
+    work = tempfile.mkdtemp(prefix="cold_start_guard_")
+    try:
+        return _run_guard(work)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _run_guard(work: str) -> int:
+    db = os.path.join(work, "db")
+    _build_db(db)   # built ONCE: a rebuilt DB would bump mtime -> miss
+    env = dict(os.environ,
+               RRAM_TPU_CACHE_DIR=os.path.join(work, "cache"),
+               JAX_PLATFORMS="cpu", PYTHONHASHSEED="0")
+
+    recs = []
+    for i in range(2):
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", db],
+            env=env, capture_output=True, text=True, cwd=_REPO)
+        if out.returncode != 0:
+            print(f"run {i + 1} failed:\n{out.stdout}\n{out.stderr}")
+            return 1
+        lines = [ln for ln in out.stdout.splitlines()
+                 if ln.startswith(MARK)]
+        if len(lines) != 1:
+            print(f"run {i + 1}: expected one {MARK} line, got "
+                  f"{len(lines)}\n{out.stdout}")
+            return 1
+        recs.append(json.loads(lines[0][len(MARK):]))
+
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    from check_metrics_schema import _load_schema
+    schema = _load_schema()
+    failures = []
+    for i, rec in enumerate(recs):
+        errs = schema.validate_record({k: v for k, v in rec.items()
+                                       if not k.startswith("_")})
+        if errs:
+            failures += [f"run {i + 1} setup record invalid: {e}"
+                         for e in errs]
+    cold, warm = recs
+    if cold["cache"]["dataset"] != "miss":
+        failures.append(
+            f"cold run dataset cache = {cold['cache']['dataset']!r} "
+            "(expected miss — is the temp dir being reused?)")
+    if warm["cache"]["dataset"] != "hit":
+        failures.append(
+            f"warm run dataset cache = {warm['cache']['dataset']!r} "
+            "(expected hit)")
+    if warm["cache"]["compile"] != "hit":
+        failures.append(
+            f"warm run compile cache = {warm['cache']['compile']!r} "
+            "(expected hit — HLO or cache key is unstable across "
+            "processes)")
+    if cold["_dataset_sha256"] != warm["_dataset_sha256"]:
+        failures.append("warm run's cached dataset is not byte-identical "
+                        "to the cold run's fresh decode")
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print(f"cold-start guard OK: cold run decode {cold['decode_seconds']}s"
+          f" compile {cold['compile_seconds']}s "
+          f"({cold['cache']['compile']}/{cold['cache']['dataset']}), "
+          f"warm run decode {warm['decode_seconds']}s compile "
+          f"{warm['compile_seconds']}s (hit/hit), dataset byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,22 @@ SAMPLE_BAD_SENTINEL = {
     "nan": 1, "inf": False, "overflow": False,       # nan not a bool
 }
 
+# the cold-start breakdown record (cache.py / observe.make_setup_record)
+SAMPLE_GOOD_SETUP = {
+    "schema_version": 1, "type": "setup", "wall_time": 1722700000.0,
+    "decode_seconds": 121.4, "compile_seconds": 14.9,
+    "setup_seconds": 136.6,
+    "cache": {"compile": "hit", "dataset": "miss"},
+    "cache_dir": "/var/cache/rram-tpu",
+}
+
+SAMPLE_BAD_SETUP = {
+    "schema_version": 1, "type": "setup", "wall_time": 1722700000.0,
+    "decode_seconds": -1.0,                          # negative time
+    "compile_seconds": "fast",                       # not a number
+    "cache": {"compile": "sideways"},                # bad state, no dataset
+}
+
 
 def check_file(path: str, schema) -> list:
     errs = []
@@ -127,7 +143,8 @@ def main(argv=None) -> int:
         n_bad = 0
         for name, rec in (("metrics", SAMPLE_GOOD),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
-                          ("sentinel", SAMPLE_GOOD_SENTINEL)):
+                          ("sentinel", SAMPLE_GOOD_SENTINEL),
+                          ("setup", SAMPLE_GOOD_SETUP)):
             errs = schema.validate_record(rec)
             if errs:
                 print(f"good {name} sample REJECTED by its own schema:")
@@ -136,14 +153,15 @@ def main(argv=None) -> int:
                 return 1
         for name, rec in (("metrics", SAMPLE_BAD),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
-                          ("sentinel", SAMPLE_BAD_SENTINEL)):
+                          ("sentinel", SAMPLE_BAD_SENTINEL),
+                          ("setup", SAMPLE_BAD_SETUP)):
             errs = schema.validate_record(rec)
             if not errs:
                 print(f"known-bad {name} sample PASSED validation "
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (3 good records accepted, 3 bad "
+        print("sample self-check OK (4 good records accepted, 4 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

@@ -1,0 +1,332 @@
+"""Cold-start performance layer (rram_caffe_simulation_tpu/cache.py +
+data/dataset_cache.py): persistent compile cache wiring, decoded-dataset
+disk cache with staleness invalidation, the PrefetchingFeed sticky-error
+contract, the SweepRunner decode/compile overlap, and the `setup`
+record schema."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu import cache as rcache
+from rram_caffe_simulation_tpu.data import dataset_cache, lmdb_py
+from rram_caffe_simulation_tpu.data.db import array_to_datum
+from rram_caffe_simulation_tpu.observe import validate_record
+from rram_caffe_simulation_tpu.observe.sink import (make_setup_record,
+                                                    setup_line)
+from rram_caffe_simulation_tpu.proto import pb
+
+
+@pytest.fixture
+def cache_enabled(tmp_path, monkeypatch):
+    """Enable the cold-start caches rooted at a temp dir and restore the
+    process-global jax cache config afterwards (other tests must not
+    inherit a persistent cache pointed at a dead tmpdir)."""
+    import jax
+    from jax._src import compilation_cache as cc
+    root = str(tmp_path / "cache")
+    monkeypatch.setenv("RRAM_TPU_CACHE_DIR", root)
+    rcache.enable_compilation_cache()
+    yield root
+    jax.config.update("jax_compilation_cache_dir", None)
+    cc.reset_cache()
+    rcache._state["dir"] = None
+    rcache._state["explicit"] = False
+
+
+# ----------------------------------------------------- cache-dir wiring
+
+def test_resolve_cache_dir_precedence(tmp_path, monkeypatch):
+    monkeypatch.delenv("RRAM_TPU_CACHE_DIR", raising=False)
+    assert rcache.resolve_cache_dir() is None
+    monkeypatch.setenv("RRAM_TPU_CACHE_DIR", str(tmp_path / "env"))
+    assert rcache.resolve_cache_dir() == str(tmp_path / "env")
+    # an explicit (CLI) value beats the env var
+    assert rcache.resolve_cache_dir(str(tmp_path / "cli")) == \
+        str(tmp_path / "cli")
+
+
+def test_enable_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("RRAM_TPU_CACHE_DIR", raising=False)
+    assert rcache.enable_compilation_cache() is None
+
+
+def test_explicit_dir_not_demoted_by_env(tmp_path, monkeypatch):
+    """A --cache-dir style explicit enable must survive later bare
+    enables (Solver.__init__'s env hook) even with the env var set,
+    and the dataset cache must follow the ACTIVE root."""
+    import jax
+    from jax._src import compilation_cache as cc
+    monkeypatch.setenv("RRAM_TPU_CACHE_DIR", str(tmp_path / "env"))
+    try:
+        cli = rcache.enable_compilation_cache(str(tmp_path / "cli"))
+        assert cli == str(tmp_path / "cli")
+        # the bare re-enable keeps the explicit root
+        assert rcache.enable_compilation_cache() == cli
+        assert rcache.cache_dir() == cli
+        from rram_caffe_simulation_tpu.data import dataset_cache
+        assert dataset_cache.dataset_cache_dir() == \
+            os.path.join(cli, "datasets")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        cc.reset_cache()
+        rcache._state["dir"] = None
+        rcache._state["explicit"] = False
+
+
+def test_compile_cache_persists_and_hits(cache_enabled):
+    """Two identical programs from distinct function objects: the first
+    compile writes the persistent entry, the second is served from disk
+    (the trace cache can't serve it — different function identity)."""
+    import jax
+    import jax.numpy as jnp
+
+    def make():
+        def probe_fn(x):
+            return jnp.sin(x) @ x.T
+        return probe_fn
+
+    x = jnp.ones((17, 17))
+    before = rcache.compile_cache_stats()
+    jax.jit(make())(x).block_until_ready()
+    mid = rcache.compile_cache_stats()
+    assert mid["misses"] > before["misses"]
+    assert os.listdir(os.path.join(cache_enabled, "xla"))
+    jax.jit(make())(x).block_until_ready()
+    after = rcache.compile_cache_stats()
+    assert after["hits"] > mid["hits"]
+    assert after["misses"] == mid["misses"]
+
+
+# ------------------------------------------------- dataset disk cache
+
+def _write_db(path, n=8, seed=0, shape=(1, 6, 6)):
+    rng = np.random.RandomState(seed)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(n):
+            img = rng.randint(0, 255, shape, dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, i % 4).SerializeToString())
+
+
+def test_dataset_cache_roundtrip(tmp_path, cache_enabled):
+    db = str(tmp_path / "db")
+    _write_db(db)
+    arrays = {"data": np.random.RandomState(1).randn(8, 1, 6, 6)
+              .astype(np.float32),
+              "label": np.arange(8, dtype=np.float32)}
+    key = dataset_cache.cache_key(db, {"p": 1})
+    assert dataset_cache.load(key) is None
+    path = dataset_cache.store(key, arrays, params={"p": 1})
+    assert path and os.path.exists(path)
+    back = dataset_cache.load(key)
+    for name in arrays:
+        np.testing.assert_array_equal(back[name], arrays[name])
+        assert back[name].tobytes() == arrays[name].tobytes()
+    # no half-written temp files left behind
+    assert not [f for f in os.listdir(os.path.dirname(path))
+                if f.endswith(".tmp")]
+
+
+def test_dataset_cache_memoize_hit_and_mtime_invalidation(
+        tmp_path, cache_enabled):
+    db = str(tmp_path / "db")
+    _write_db(db)
+    calls = []
+
+    def decode():
+        calls.append(1)
+        return {"data": np.full((4, 2), 7.0, np.float32)}
+
+    a1, s1 = dataset_cache.memoize(db, {"t": "x"}, decode)
+    a2, s2 = dataset_cache.memoize(db, {"t": "x"}, decode)
+    assert (s1, s2) == ("miss", "hit")
+    assert len(calls) == 1
+    np.testing.assert_array_equal(a1["data"], a2["data"])
+    # touching any DB file must invalidate (mtime_ns is in the key)
+    target = os.path.join(db, os.listdir(db)[0])
+    st = os.stat(target)
+    os.utime(target, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    _, s3 = dataset_cache.memoize(db, {"t": "x"}, decode)
+    assert s3 == "miss"
+    assert len(calls) == 2
+
+
+def test_dataset_cache_param_invalidation(tmp_path, cache_enabled):
+    db = str(tmp_path / "db")
+    _write_db(db)
+    decode = lambda: {"data": np.zeros((2, 2), np.float32)}
+    _, s1 = dataset_cache.memoize(db, {"scale": 1.0}, decode)
+    _, s2 = dataset_cache.memoize(db, {"scale": 0.5}, decode)
+    _, s3 = dataset_cache.memoize(db, {"scale": 1.0}, decode)
+    assert (s1, s2, s3) == ("miss", "miss", "hit")
+
+
+def test_dataset_cache_disabled_passthrough(tmp_path, monkeypatch):
+    monkeypatch.delenv("RRAM_TPU_CACHE_DIR", raising=False)
+    rcache._state["dir"] = None
+    calls = []
+
+    def decode():
+        calls.append(1)
+        return {"x": np.ones(3, np.float32)}
+
+    db = str(tmp_path / "db")
+    _write_db(db)
+    _, s1 = dataset_cache.memoize(db, {}, decode)
+    _, s2 = dataset_cache.memoize(db, {}, decode)
+    assert (s1, s2) == ("disabled", "disabled")
+    assert len(calls) == 2
+
+
+def _data_layer(db, batch_size=4, scale=0.5):
+    """A minimal Data-layer net wrapped in a Solver-free Net, returning
+    the layer object materialize_data_source consumes."""
+    from rram_caffe_simulation_tpu.net import Net
+    net_txt = f"""
+    name: "n"
+    layer {{ name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{db}" batch_size: {batch_size} }}
+      transform_param {{ scale: {scale} }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param {{ num_output: 2
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" top: "loss" }}
+    """
+    npb = pb.NetParameter()
+    text_format.Parse(net_txt, npb)
+    net = Net(npb, pb.TRAIN)
+    return [l for l in net.layers if l.type_name == "Data"][0]
+
+
+def test_materialize_cached_byte_identical(tmp_path, cache_enabled):
+    """The cached decode must hand back byte-identical batch tensors vs
+    a fresh decode, and transform-param changes must re-decode."""
+    from rram_caffe_simulation_tpu.data.feed import materialize_data_source
+    db = str(tmp_path / "db")
+    _write_db(db, n=12)
+    fresh, s1 = materialize_data_source(_data_layer(db), with_status=True)
+    cached, s2 = materialize_data_source(_data_layer(db), with_status=True)
+    assert (s1, s2) == ("miss", "hit")
+    for name in fresh:
+        assert np.asarray(cached[name]).tobytes() == \
+            np.asarray(fresh[name]).tobytes()
+    # a different transform scale is a different dataset
+    other, s3 = materialize_data_source(_data_layer(db, scale=0.25),
+                                        with_status=True)
+    assert s3 == "miss"
+    assert not np.array_equal(np.asarray(other["data"]),
+                              np.asarray(fresh["data"]))
+
+
+# ------------------------------------------------ PrefetchingFeed fix
+
+def test_prefetching_feed_sticky_error():
+    """After the producer dies, every call raises (previously: the first
+    raised and the second blocked forever on the empty queue)."""
+    from rram_caffe_simulation_tpu.data.feed import PrefetchingFeed
+    state = {"n": 0}
+
+    def feed():
+        state["n"] += 1
+        if state["n"] > 2:
+            raise RuntimeError("db went away")
+        return {"x": np.full((2,), state["n"], np.float32)}
+
+    pf = PrefetchingFeed(feed, depth=1, device_put=False)
+    got = [pf()["x"][0] for _ in range(2)]
+    assert got == [1.0, 2.0]
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="db went away"):
+        pf()
+    with pytest.raises(RuntimeError, match="db went away"):
+        pf()   # sticky: still raises, still no hang
+    assert time.perf_counter() - t0 < 5.0
+
+
+# ------------------------------------------- sweep overlap + records
+
+def _sweep_solver(tmp_path, db):
+    solver_txt = f"""
+    base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+    max_iter: 100 display: 0 random_seed: 3
+    snapshot_prefix: "{tmp_path}/s"
+    failure_pattern {{ type: "gaussian" mean: 1e8 std: 3e7 }}
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(solver_txt, sp)
+    net_txt = f"""
+    name: "dbnet"
+    layer {{ name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{db}" batch_size: 4 }}
+      transform_param {{ scale: 0.00390625 }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param {{ num_output: 4
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" top: "loss" }}
+    """
+    text_format.Parse(net_txt, sp.net_param)
+    from rram_caffe_simulation_tpu.solver import Solver
+    return Solver(sp)
+
+
+def test_sweep_precompile_overlap_equivalence(tmp_path, cache_enabled):
+    """precompile_chunk (AOT compile overlapped with the decode) must be
+    numerically invisible, populate the setup stats, and the second
+    runner must hit both caches."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    db = str(tmp_path / "db")
+    _write_db(db, n=16)
+
+    r1 = SweepRunner(_sweep_solver(tmp_path, db), n_configs=2)
+    l1, _ = r1.step(4, chunk=2)
+
+    r2 = SweepRunner(_sweep_solver(tmp_path, db), n_configs=2,
+                     precompile_chunk=2)
+    assert (2, True) in r2._aot_keys
+    l2, _ = r2.step(4, chunk=2)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
+    assert r2.setup.compile_s > 0
+    assert r2.setup.dataset == "hit"   # r1's decode populated it
+
+    rec = r2.setup_record(setup_s=1.0)
+    assert validate_record(rec) == []
+    assert rec["cache"]["dataset"] == "hit"
+    assert "decode" in setup_line(rec)
+    assert json.loads(json.dumps(rec)) == rec
+
+
+def test_preload_skips_random_transform(tmp_path, cache_enabled):
+    """mirror:true makes the dataset non-materializable: the preload
+    must neither decode nor waste an AOT compile on the dataset-path
+    chunk fn it could never use."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    db = str(tmp_path / "db")
+    _write_db(db, n=16)
+    s = _sweep_solver(tmp_path, db)
+    data_layer = [l for l in s.net.layers if l.type_name == "Data"][0]
+    data_layer.lp.transform_param.mirror = True
+    r = SweepRunner(s, n_configs=2, precompile_chunk=2)
+    assert r._dataset is None
+    assert not r._aot_keys
+    assert r.setup.compile_s == 0.0
+    # cache dir IS configured, there was just no decode to serve
+    assert r.setup.dataset == "unused"
+    r.step(2, chunk=2)   # host-feed path still trains
+
+
+def test_setup_record_schema():
+    rec = make_setup_record(1.5, 2.5, "hit", "miss",
+                            cache_dir="/tmp/c", setup_s=3.0)
+    assert validate_record(rec) == []
+    bad = dict(rec)
+    bad["cache"] = {"compile": "sideways", "dataset": "miss"}
+    assert validate_record(bad)
+    bad2 = dict(rec)
+    bad2["decode_seconds"] = -1.0
+    assert validate_record(bad2)
