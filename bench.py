@@ -18,6 +18,7 @@ docs/performance_hardware.md:23-25).
 
 Prints exactly ONE JSON line on stdout.
 """
+import argparse
 import json
 import os
 import sys
@@ -48,9 +49,24 @@ N_CONFIGS = int(os.environ.get(
 # timed steps must be a chunk multiple or the trailing partial chunk
 # compiles a second jit INSIDE the timed window
 STEPS = max(int(os.environ.get("BENCH_STEPS", "100")) // CHUNK, 1) * CHUNK
+# async dispatch pipeline depth (SweepRunner pipeline_depth): in-flight
+# chunks whose host bookkeeping the consumer thread hides; 0 = fetch
+# inline at every chunk boundary (the pre-pipeline baseline)
+PIPELINE = int(os.environ.get("BENCH_PIPELINE", "2"))
 
 
-def main():
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    # min-of-N jitter rejection (the bench_train.py pattern): the
+    # tunneled dispatch path swings +-35% run to run, so BENCH_r0N.json
+    # trajectories track min(window) and keep every window in extra
+    p.add_argument("--repeats", type=int,
+                   default=int(os.environ.get("BENCH_REPEATS", "1")),
+                   help="timed windows; min is reported, per-window "
+                        "seconds land in extra.window_seconds")
+    args = p.parse_args(argv)
+    repeats = max(args.repeats, 1)
+
     import jax
 
     from rram_caffe_simulation_tpu import cache as rcache
@@ -83,19 +99,25 @@ def main():
     # thread while the LMDB decode runs on a background thread — the
     # two cold-start halves overlap instead of serializing
     runner = SweepRunner(solver, n_configs=N_CONFIGS, compute_dtype=DTYPE,
-                         precompile_chunk=CHUNK)
+                         precompile_chunk=CHUNK, pipeline_depth=PIPELINE)
     input_path = ("lmdb->transformer->device-resident dataset"
                   if runner._dataset is not None
                   else "host feed per step")
     runner.step(CHUNK, chunk=CHUNK)  # compile + warmup
     jax.block_until_ready(runner.params)
     setup_s = time.perf_counter() - t_setup
-    setup_rec = runner.setup_record(setup_s)
 
-    t0 = time.perf_counter()
-    runner.step(STEPS, chunk=CHUNK)
-    jax.block_until_ready(runner.params)
-    dt = time.perf_counter() - t0
+    windows = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        runner.step(STEPS, chunk=CHUNK)
+        jax.block_until_ready(runner.params)
+        windows.append(time.perf_counter() - t0)
+    dt = min(windows)
+    # the setup record is taken AFTER the timed windows so its pipeline
+    # accounting covers the whole run's chunks, not just the warmup
+    setup_rec = runner.setup_record(setup_s)
+    runner.close()
 
     n_chips = len(jax.devices())
     img_s_chip = N_CONFIGS * BATCH * STEPS / dt / n_chips
@@ -121,9 +143,15 @@ def main():
             "decode_seconds": setup_rec["decode_seconds"],
             "compile_seconds": setup_rec["compile_seconds"],
             "cache": setup_rec["cache"],
+            # async dispatch pipeline accounting (observe `setup`
+            # record "pipeline" shape): depth, chunks dispatched, and
+            # the dispatcher's host-blocked seconds across them
+            "pipeline": setup_rec.get("pipeline", {}),
             "steps_timed": STEPS, "batch": BATCH, "chunk": CHUNK,
             "n_configs": N_CONFIGS, "chips": n_chips,
             "seconds": round(dt, 3),
+            "repeats": repeats,
+            "window_seconds": [round(w, 3) for w in windows],
             # companion measurements live in-repo (ImageNet-class
             # training rows, the measured 1000-config north star):
             "see_also": ["RESULTS.md", "examples/bench_train.py",

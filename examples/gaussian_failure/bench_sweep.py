@@ -35,6 +35,10 @@ def main(argv=None):
     p.add_argument("--std", type=float, default=3e7)
     p.add_argument("--contract-iters", type=int, default=5000,
                    help="iters per config in the sweep contract")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="async dispatch pipeline depth (SweepRunner "
+                        "pipeline_depth); 0 = synchronous per-chunk "
+                        "bookkeeping")
     args = p.parse_args(argv)
     # a trailing partial chunk would jit-compile inside the timed window
     args.iters = max(args.iters // args.chunk, 1) * args.chunk
@@ -61,7 +65,8 @@ def main(argv=None):
             # same default as bench.py so the two benches measure the
             # same arithmetic under an identical environment
             compute_dtype=os.environ.get("BENCH_DTYPE", "bfloat16")
-            or None)
+            or None,
+            pipeline_depth=args.pipeline_depth)
         runner.step(max(args.warmup, args.chunk), chunk=args.chunk)
         jax.block_until_ready(runner.params)
         t0 = time.perf_counter()
@@ -71,6 +76,8 @@ def main(argv=None):
         steps_per_s = args.iters / dt
         cfg_hours = n_cfg * steps_per_s * 3600 / args.contract_iters
         img_s = n_cfg * steps_per_s * 100
+        pipe = runner.setup_record().get("pipeline", {})
+        runner.close()
         results.append({
             "n_configs": n_cfg, "steps_per_s": round(steps_per_s, 2),
             "img_per_s_per_chip": round(img_s),
@@ -78,6 +85,11 @@ def main(argv=None):
             "minutes_for_1000_configs_1chip":
                 round(1000 / cfg_hours * 60, 1),
             "loss_finite": bool(np.isfinite(loss).all()),
+            # dispatcher host-blocked seconds across all dispatched
+            # chunks (observe `setup` record pipeline shape)
+            "pipeline_depth": args.pipeline_depth,
+            "host_blocked_seconds":
+                round(pipe.get("host_blocked_seconds", 0.0), 4),
         })
         print(json.dumps(results[-1]))
 

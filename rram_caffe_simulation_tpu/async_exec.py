@@ -1,0 +1,205 @@
+"""Async execution layer: overlap host bookkeeping with device dispatch.
+
+The sweep's device side sits at its bandwidth floor (RESULTS.md), so the
+remaining wall-clock lever is the HOST: fetching losses/metrics at chunk
+boundaries, feeding sinks, serializing snapshots, and setting up the next
+resident group all stall the dispatch queue when they run inline. This
+module holds the three host-side primitives the overlap is built from —
+the production pattern of async-checkpointing / dispatch-pipelining
+training stacks (Orbax, t5x; PAPERS.md):
+
+- `OrderedConsumer`: a bounded-queue consumer thread that applies a
+  callback to submitted items in EXACT submission order. The dispatcher
+  enqueues chunk N+1 as soon as chunk N's donated-state handles return
+  (JAX async dispatch) while the consumer drains completed chunks —
+  device_get, sink writes, host strategy work — off the critical path.
+  Errors are sticky like `data.feed.PrefetchingFeed`: the first call
+  that observes a consumer failure re-raises it, and so does every later
+  call (the thread stays alive and discards queued work, so nothing can
+  block forever on a dead consumer).
+
+- `BackgroundWriter`: serialize + atomic-rename file writes off-thread.
+  Every payload is written to a sibling temp file and `os.replace`d into
+  place only on success, so a crash mid-write can never leave a partial
+  file under the final name (a good snapshot is never replaced by a bad
+  one).
+
+- `PipelineStats`: per-run accounting of where the host actually blocked
+  (submit backpressure or inline consume), how long the consumer worked
+  concurrently, snapshot write time moved off-loop, and overlapped
+  group-setup seconds — assembled into the `pipeline` field of the
+  observe `setup` record (observe/schema.py).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+
+class OrderedConsumer:
+    """Bounded-queue consumer thread with in-order processing and sticky
+    error propagation (the PrefetchingFeed pattern, consumer-side).
+
+    `submit(item)` hands one unit of host work to the thread and returns
+    the seconds it spent blocked (only when the queue — the pipeline
+    depth — is full: that is backpressure, the dispatcher's true
+    host-blocked time). `drain()` is the synchronous barrier: it returns
+    once every submitted item has been consumed, re-raising any consumer
+    error. After an error the thread keeps draining the queue WITHOUT
+    processing, so neither submit nor drain can hang; every subsequent
+    call re-raises the original failure."""
+
+    def __init__(self, fn: Callable, depth: int = 2,
+                 name: str = "chunk-consumer"):
+        self._fn = fn
+        self._depth = max(int(depth), 1)
+        self._name = name
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.consumer_s = 0.0    # seconds the thread spent in fn
+
+    def check(self):
+        """Re-raise the sticky consumer error, if one has occurred."""
+        if self._error is not None:
+            raise self._error
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is _STOP:
+                    return
+                if self._error is None:
+                    t0 = time.perf_counter()
+                    self._fn(item)
+                    self.consumer_s += time.perf_counter() - t0
+            except BaseException as e:   # surfaced at next submit/drain
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def submit(self, item) -> float:
+        """Enqueue one item; returns seconds blocked on backpressure."""
+        self.check()
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=self._name)
+            self._thread.start()
+        t0 = time.perf_counter()
+        self._q.put(item)
+        return time.perf_counter() - t0
+
+    def drain(self) -> float:
+        """Barrier: block until every submitted item is consumed, then
+        re-raise any sticky consumer error. Returns seconds blocked."""
+        self.check()
+        t0 = time.perf_counter()
+        self._q.join()
+        dt = time.perf_counter() - t0
+        self.check()
+        return dt
+
+    def close(self):
+        """Stop the thread (pending items are still consumed first)."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(_STOP)
+            self._thread.join()
+        self._thread = None
+
+
+_STOP = object()
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None]):
+    """Run `write_fn(tmp_path)` against a sibling temp file and
+    `os.replace` it into `path` only on success; the temp file is
+    removed on failure so a crash mid-serialization never leaves a
+    partial file under the final name."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class BackgroundWriter:
+    """Off-thread snapshot/fault-state writer: the hot loop pays only the
+    device_get (materializing the trees), then hands (path, write_fn) to
+    this writer, which serializes to a temp file and atomically renames.
+    `wait()` is the barrier; errors are sticky via OrderedConsumer."""
+
+    def __init__(self, depth: int = 2):
+        self._consumer = OrderedConsumer(self._write, depth=depth,
+                                         name="snapshot-writer")
+        self.write_s = 0.0       # total off-loop serialize+write seconds
+
+    def _write(self, item):
+        path, write_fn = item
+        t0 = time.perf_counter()
+        atomic_write(path, write_fn)
+        self.write_s += time.perf_counter() - t0
+
+    def submit(self, path: str, write_fn: Callable[[str], None]):
+        """Queue one atomic file write; `write_fn(tmp_path)` runs on the
+        writer thread. Re-raises a prior writer error (sticky)."""
+        self._consumer.submit((path, write_fn))
+
+    def wait(self):
+        """Block until all queued writes have landed (or re-raise the
+        first writer error)."""
+        self._consumer.drain()
+
+    def close(self):
+        self._consumer.close()
+
+
+class PipelineStats:
+    """Host-overlap accounting for one runner/run, assembled into the
+    `pipeline` field of the observe `setup` record (schema.py). In sync
+    mode `host_blocked_s` is the inline fetch+sink time per chunk; in
+    pipelined mode it is submit backpressure only — the acceptance
+    signal is the pipelined value falling strictly below the sync one
+    for the same work."""
+
+    def __init__(self, depth: int = 0):
+        self.depth = int(depth)
+        self.chunks = 0
+        self.records = 0
+        self.host_blocked_s = 0.0
+        self.consumer_s = 0.0
+        self.drain_s = 0.0
+        self.snapshot_write_s = 0.0
+        self.setup_overlap_s = 0.0
+
+    def record(self) -> dict:
+        """The `pipeline` sub-record (observe/schema.py PIPELINE_FIELDS)."""
+        rec = {
+            "depth": self.depth,
+            "chunks": int(self.chunks),
+            "host_blocked_seconds": round(float(self.host_blocked_s), 6),
+        }
+        if self.records:
+            rec["records"] = int(self.records)
+        if self.consumer_s:
+            rec["consumer_seconds"] = round(float(self.consumer_s), 6)
+        if self.drain_s:
+            rec["drain_seconds"] = round(float(self.drain_s), 6)
+        if self.snapshot_write_s:
+            rec["snapshot_write_seconds"] = round(
+                float(self.snapshot_write_s), 6)
+        if self.setup_overlap_s:
+            rec["setup_overlap_seconds"] = round(
+                float(self.setup_overlap_s), 6)
+        return rec

@@ -141,6 +141,9 @@ class SetupStats:
         self.decode_s = 0.0
         self.compile_s = 0.0
         self.dataset = "disabled"   # hit | miss | disabled
+        # async-execution-layer accounting (async_exec.PipelineStats),
+        # attached by the runner when the dispatch pipeline is on
+        self.pipeline = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -169,7 +172,9 @@ class SetupStats:
             decode_s=self.decode_s, compile_s=self.compile_s,
             compile_status=self.compile_status(),
             dataset_status=self.dataset,
-            cache_dir=_state["dir"], setup_s=setup_s)
+            cache_dir=_state["dir"], setup_s=setup_s,
+            pipeline=(self.pipeline.record()
+                      if self.pipeline is not None else None))
 
 
 class _Timed:

@@ -144,7 +144,12 @@ DEBUG_UPDATE_FIELDS = {
 #  "decode_seconds": 121.4, "compile_seconds": 14.9,
 #  "setup_seconds": 136.6,                       # caller's total wall
 #  "cache": {"compile": "hit", "dataset": "miss"},
-#  "cache_dir": "/var/cache/rram-tpu"}
+#  "cache_dir": "/var/cache/rram-tpu",
+#  "pipeline": {"depth": 2, "chunks": 100, "records": 100,
+#               "host_blocked_seconds": 0.021,
+#               "consumer_seconds": 3.4, "drain_seconds": 0.8,
+#               "snapshot_write_seconds": 1.2,
+#               "setup_overlap_seconds": 12.1}}
 #
 # decode/compile may OVERLAP (SweepRunner precompile_chunk), so the two
 # phase fields need not sum to setup_seconds. Cache states: "hit" =
@@ -152,6 +157,16 @@ DEBUG_UPDATE_FIELDS = {
 # (compile cache only), "disabled" = no cache dir configured,
 # "unused" = cache configured but this run had no such work (e.g. an
 # Input-fed bench performs no dataset decode).
+#
+# `pipeline` (optional) is the async-execution-layer accounting
+# (async_exec.PipelineStats): `depth` 0 = synchronous bookkeeping,
+# >= 1 = bounded-queue consumer thread; `host_blocked_seconds` is the
+# dispatcher's total blocked time across `chunks` dispatches (inline
+# fetch+sink time when sync, submit backpressure when pipelined);
+# `consumer_seconds` the concurrent consumer work; `drain_seconds`
+# barrier waits; `snapshot_write_seconds` serialize+rename time moved
+# off the hot loop; `setup_overlap_seconds` next-resident-group setup
+# that ran concurrently with the previous group's execution.
 
 SETUP_CACHE_STATES = ("hit", "miss", "partial", "disabled", "unused")
 
@@ -164,11 +179,23 @@ SETUP_FIELDS = {
     "setup_seconds": (_NUM, False),
     "cache": (dict, True),
     "cache_dir": (str, False),
+    "pipeline": (dict, False),
 }
 
 SETUP_CACHE_FIELDS = {
     "compile": (str, True),
     "dataset": (str, True),
+}
+
+PIPELINE_FIELDS = {
+    "depth": (int, True),
+    "chunks": (int, True),
+    "host_blocked_seconds": (_NUM, True),
+    "records": (int, False),
+    "consumer_seconds": (_NUM, False),
+    "drain_seconds": (_NUM, False),
+    "snapshot_write_seconds": (_NUM, False),
+    "setup_overlap_seconds": (_NUM, False),
 }
 
 # --- sentinel records (tripped numeric-health flags) ---
@@ -275,6 +302,14 @@ def _validate_setup(rec) -> list:
         if isinstance(val, _NUM) and not isinstance(val, bool) \
                 and val < 0:
             errs.append(f"setup.{key}: must be >= 0")
+    pipe = rec.get("pipeline")
+    if isinstance(pipe, dict):
+        errs += _check_fields(pipe, PIPELINE_FIELDS, "setup.pipeline")
+        for key, (types, _) in PIPELINE_FIELDS.items():
+            val = pipe.get(key)
+            if isinstance(val, _NUM) and not isinstance(val, bool) \
+                    and val < 0:
+                errs.append(f"setup.pipeline.{key}: must be >= 0")
     return errs
 
 

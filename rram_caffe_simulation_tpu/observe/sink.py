@@ -68,12 +68,17 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
 def make_setup_record(decode_s: float, compile_s: float,
                       compile_status: str, dataset_status: str,
                       cache_dir: Optional[str] = None,
-                      setup_s: Optional[float] = None) -> dict:
+                      setup_s: Optional[float] = None,
+                      pipeline: Optional[dict] = None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
     trajectory. `setup_s` is the caller's TOTAL setup wall time; decode
-    and compile may overlap, so the phases need not sum to it."""
+    and compile may overlap, so the phases need not sum to it.
+    `pipeline` is the async-execution-layer accounting sub-record
+    (async_exec.PipelineStats.record): host-blocked seconds per run,
+    consumer concurrency, off-loop snapshot writes, group-setup
+    overlap."""
     rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "setup",
@@ -86,6 +91,8 @@ def make_setup_record(decode_s: float, compile_s: float,
         rec["setup_seconds"] = round(float(setup_s), 4)
     if cache_dir:
         rec["cache_dir"] = cache_dir
+    if pipeline:
+        rec["pipeline"] = dict(pipeline)
     return rec
 
 
@@ -94,10 +101,16 @@ def setup_line(record: dict) -> str:
     cache = record.get("cache", {})
     extra = (f", total {record['setup_seconds']:g} s"
              if "setup_seconds" in record else "")
+    pipe = record.get("pipeline")
+    ptail = ""
+    if pipe:
+        ptail = (f"; pipeline depth {pipe.get('depth', 0)}: host blocked "
+                 f"{pipe.get('host_blocked_seconds', 0):g} s over "
+                 f"{pipe.get('chunks', 0)} chunks")
     return (f"Setup: decode {record.get('decode_seconds', 0):g} s, "
             f"compile {record.get('compile_seconds', 0):g} s{extra} "
             f"(compile cache {cache.get('compile', '?')}, "
-            f"dataset cache {cache.get('dataset', '?')})")
+            f"dataset cache {cache.get('dataset', '?')})" + ptail)
 
 
 class MetricsLogger:
@@ -122,21 +135,66 @@ class MetricsLogger:
                 close()
 
 
+class _FlushPolicy:
+    """Buffered-write policy shared by the file sinks: flush after
+    `flush_every` records, or once `flush_secs` seconds have passed
+    since the last flush — whichever comes first. A per-record flush
+    stalls the consumer thread of the async sweep pipeline on filesystem
+    latency, so buffering is the default; `unbuffered=True` restores
+    flush-per-record (the `tail -f` debugging escape hatch). `close`
+    always flushes regardless of policy."""
+
+    def __init__(self, unbuffered: bool = False, flush_every: int = 64,
+                 flush_secs: float = 5.0):
+        self.unbuffered = bool(unbuffered)
+        self.flush_every = max(int(flush_every), 1)
+        self.flush_secs = float(flush_secs)
+        self._pending = 0
+        self._last = time.monotonic()
+
+    def due(self) -> bool:
+        """Count one record; True when the sink should flush now."""
+        if self.unbuffered:
+            return True
+        self._pending += 1
+        now = time.monotonic()
+        if (self._pending >= self.flush_every
+                or now - self._last >= self.flush_secs):
+            return True
+        return False
+
+    def flushed(self):
+        self._pending = 0
+        self._last = time.monotonic()
+
+
 class JsonlSink:
     """One JSON object per line per display interval (schema.py).
     `append=True` continues an existing log (a resumed run must not
-    truncate the degradation trajectory already captured)."""
+    truncate the degradation trajectory already captured). Writes are
+    buffered per `_FlushPolicy` (flush every `flush_every` records or
+    `flush_secs` seconds; `unbuffered=True` for flush-per-record)."""
 
-    def __init__(self, path: str, append: bool = False):
+    def __init__(self, path: str, append: bool = False,
+                 unbuffered: bool = False, flush_every: int = 64,
+                 flush_secs: float = 5.0):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self.path = path
+        self._policy = _FlushPolicy(unbuffered, flush_every, flush_secs)
         self._f = open(path, "a" if append else "w")
 
     def write(self, record: dict):
         self._f.write(json.dumps(record) + "\n")
-        self._f.flush()
+        if self._policy.due():
+            self._f.flush()
+            self._policy.flushed()
+
+    def flush(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._policy.flushed()
 
     def close(self):
         if not self._f.closed:
@@ -194,11 +252,13 @@ class CaffeLogSink:
     identical regexes."""
 
     def __init__(self, path: str, net_name: str = "net",
-                 append: bool = False):
+                 append: bool = False, unbuffered: bool = False,
+                 flush_every: int = 64, flush_secs: float = 5.0):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self.path = path
+        self._policy = _FlushPolicy(unbuffered, flush_every, flush_secs)
         had_content = append and os.path.exists(path) \
             and os.path.getsize(path) > 0
         self._f = open(path, "a" if append else "w")
@@ -207,6 +267,7 @@ class CaffeLogSink:
             # from the FIRST 'Solving' line, so a resumed segment keeps
             # the original solve start
             self._emit(f"Solving {net_name}")
+            self._f.flush()
 
     def _emit(self, line: str):
         now = datetime.datetime.now()
@@ -215,20 +276,27 @@ class CaffeLogSink:
                      now.microsecond, os.getpid()))
         self._f.write(prefix + line + "\n")
 
+    def _maybe_flush(self):
+        # buffered like JsonlSink (same policy knobs): one record = one
+        # policy tick, however many glog lines it rendered to
+        if self._policy.due():
+            self._f.flush()
+            self._policy.flushed()
+
     def write(self, record: dict):
         rtype = record.get("type")
         if rtype == "debug_trace":
             for line in debug_trace_lines(record):
                 self._emit(line)
-            self._f.flush()
+            self._maybe_flush()
             return
         if rtype == "sentinel":
             self._emit(sentinel_line(record))
-            self._f.flush()
+            self._maybe_flush()
             return
         if rtype == "setup":
             self._emit(setup_line(record))
-            self._f.flush()
+            self._maybe_flush()
             return
         if rtype is not None:
             return  # unknown typed records are not Caffe-shaped; skip
@@ -244,7 +312,12 @@ class CaffeLogSink:
             for x in vals:
                 self._emit(f"    Train net output #{j}: {name} = {x:g}")
                 j += 1
-        self._f.flush()
+        self._maybe_flush()
+
+    def flush(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._policy.flushed()
 
     def close(self):
         if not self._f.closed:

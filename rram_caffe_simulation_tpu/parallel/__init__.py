@@ -18,11 +18,11 @@ GPUs; here thousands of crossbar configs ride one TPU batch).
 """
 from .mesh import make_mesh, data_sharding, config_sharding, replicated
 from .dp import make_dp_step, shard_batch
-from .sweep import SweepRunner, stack_fault_states
+from .sweep import GroupPrefetcher, SweepRunner, stack_fault_states
 from .tp import tp_param_specs
 from .pp import pipeline_apply, stack_stage_params
 
 __all__ = ["make_mesh", "data_sharding", "config_sharding", "replicated",
-           "make_dp_step", "shard_batch", "SweepRunner",
+           "make_dp_step", "shard_batch", "SweepRunner", "GroupPrefetcher",
            "stack_fault_states", "tp_param_specs", "pipeline_apply",
            "stack_stage_params"]

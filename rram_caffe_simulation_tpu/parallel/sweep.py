@@ -13,12 +13,14 @@ mean/std grid sweeps.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import async_exec
 from .. import cache as _cache
 from ..fault import engine as fault_engine
 from .mesh import make_mesh
@@ -60,7 +62,8 @@ class SweepRunner:
     def __init__(self, solver, n_configs: int, mesh=None, means=None,
                  stds=None, preload: bool = True, compute_dtype=None,
                  remat_segments: int = 0, config_block: int = 0,
-                 precompile_chunk: int = 0):
+                 precompile_chunk: int = 0,
+                 pipeline_depth: Optional[int] = None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -69,6 +72,27 @@ class SweepRunner:
         # cold-start accounting: decode/compile seconds + cache
         # hit/miss, emitted via setup_record() (observe `setup` record)
         self.setup = _cache.SetupStats()
+        # async dispatch pipeline (async_exec): None = legacy (results
+        # materialize only when step() returns, no sink feeding), 0 =
+        # synchronous per-chunk bookkeeping (fetch losses/metrics +
+        # feed the solver's metric sinks inline at every chunk
+        # boundary — the comparison baseline), >= 1 = a bounded-queue
+        # consumer thread of that depth: the dispatcher enqueues chunk
+        # N+1 as soon as chunk N's donated-state handles return (JAX
+        # async dispatch) while the consumer does the same bookkeeping
+        # off the critical path, in exact chunk order, with sticky
+        # error propagation.
+        self.pipeline = async_exec.PipelineStats(depth=pipeline_depth or 0)
+        self._pipeline_on = pipeline_depth is not None
+        self._consumer = (
+            async_exec.OrderedConsumer(self._consume_chunk,
+                                       depth=pipeline_depth)
+            if pipeline_depth else None)
+        self.setup.pipeline = self.pipeline
+        self._last_host = None     # (losses, outputs) of the last chunk
+        self._record_t0 = None     # perf_counter at the last sink record
+        self._bg_writer = None     # lazy BackgroundWriter (fault states)
+        self._inline_write_s = 0.0  # save_fault_states(background=False)
         from ..data import dataset_cache
         if dataset_cache.dataset_cache_dir() is not None:
             # a cache dir IS configured; "unused" (vs "disabled") until
@@ -93,6 +117,7 @@ class SweepRunner:
                 "for pure tensor parallelism without the Monte-Carlo axis "
                 "use Solver.enable_model_parallel instead")
         self.mesh = mesh
+        self.config_block = int(config_block or 0)
         self.iter = 0
         # last executed iteration's per-config metrics pytree (leading
         # config axis), {} until a step runs or when the solver has no
@@ -471,7 +496,13 @@ class SweepRunner:
     def setup_record(self, setup_s: Optional[float] = None) -> dict:
         """The schema-versioned `setup` record for this runner's cold
         start (observe/schema.py: decode/compile seconds + per-cache
-        hit/miss); `setup_s` is the caller's total setup wall clock."""
+        hit/miss + the async-pipeline accounting); `setup_s` is the
+        caller's total setup wall clock."""
+        if self._consumer is not None:
+            self.pipeline.consumer_s = self._consumer.consumer_s
+        self.pipeline.snapshot_write_s = self._inline_write_s + (
+            self._bg_writer.write_s if self._bg_writer is not None
+            else 0.0)
         return self.setup.record(setup_s)
 
     def _place_state(self):
@@ -568,13 +599,108 @@ class SweepRunner:
 
     def _maybe_genetic(self):
         if self._genetics is not None and self._genetic_due_at(self.iter):
+            if self._consumer is not None:
+                # synchronous barrier: the episodic host search mutates
+                # params — pending consumer bookkeeping must land (and
+                # any sticky consumer error surface) before the state
+                # changes under it
+                self.pipeline.drain_s += self._consumer.drain()
             self._apply_genetic()
+
+    # ------------------------------------------------------------------
+    # async dispatch pipeline (host bookkeeping off the critical path)
+
+    def _consume_chunk(self, item):
+        """Host bookkeeping for one dispatched chunk, in exact chunk
+        order: materialize losses/outputs/metrics (where the host
+        blocks on the device — on the consumer thread when pipelined),
+        refresh the last-result view, and feed the solver's metric
+        sinks one per-chunk record. Runs inline when pipeline_depth=0,
+        on the OrderedConsumer thread when >= 1."""
+        k, last_it, losses, outputs, mets, stacked = item
+        if stacked:
+            # slice the last iteration ON DEVICE first: records and the
+            # step() return only ever use it, and fetching the whole
+            # k-iteration stack would move k x the data over a link the
+            # sweep already saturates
+            losses = losses[-1]
+            outputs = jax.tree.map(lambda x: x[-1], outputs)
+        self._last_host = (np.asarray(losses),
+                           jax.tree.map(np.asarray, outputs))
+        logger = (self.solver.metrics_logger
+                  if self.solver._metrics_enabled else None)
+        if logger is None or not mets:
+            return
+        from ..observe import counters as obs_counters
+        from ..observe import sink as obs_sink
+        last = dict(jax.tree.map(lambda x: x[-1], mets) if stacked
+                    else mets)
+        last.pop("debug", None)   # deep traces are not record fields
+        host_mets = obs_counters.to_host(last)
+        outs = {}
+        for name, v in self._last_host[1].items():
+            arr = np.ravel(np.asarray(v))
+            outs[name] = float(arr[0]) if arr.size == 1 else arr.tolist()
+        now = time.perf_counter()
+        elapsed = (now - self._record_t0
+                   if self._record_t0 is not None else None)
+        self._record_t0 = now
+        rec = obs_sink.make_record(iteration=last_it, metrics=host_mets,
+                                   outputs=outs, elapsed_s=elapsed,
+                                   n_iters=k)
+        self.pipeline.records += 1
+        logger.log(rec)
+
+    def _after_dispatch(self, k, last_it, losses, outputs, mets,
+                        stacked=True):
+        """Hand one dispatched chunk's result handles to the bookkeeping
+        path. Pipelined: enqueue and keep dispatching (host_blocked
+        counts only submit backpressure). Sync: consume inline
+        (host_blocked counts the full fetch+sink time — the baseline
+        the pipeline is measured against)."""
+        self.pipeline.chunks += 1
+        if not self._pipeline_on:
+            return
+        item = (k, last_it, losses, outputs, mets, stacked)
+        if self._consumer is not None:
+            self.pipeline.host_blocked_s += self._consumer.submit(item)
+        else:
+            t0 = time.perf_counter()
+            self._consume_chunk(item)
+            self.pipeline.host_blocked_s += time.perf_counter() - t0
+
+    def _finish_step(self, losses, outputs, stacked=True):
+        """End-of-step result materialization: drain the consumer (the
+        step() return is a synchronous barrier) and return the last
+        iteration's host (loss, outputs)."""
+        if self._pipeline_on:
+            if self._consumer is not None:
+                self.pipeline.drain_s += self._consumer.drain()
+            return self._last_host
+        t0 = time.perf_counter()
+        if stacked:
+            out = (np.asarray(losses)[-1],
+                   jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
+        else:
+            out = (np.asarray(losses), jax.tree.map(np.asarray, outputs))
+        self.pipeline.host_blocked_s += time.perf_counter() - t0
+        return out
 
     def step(self, iters: int = 1, chunk: int = 1):
         """Run `iters` sweep iterations; `chunk` > 1 scans that many
         iterations per device dispatch (fresh host batch per iteration
         either way). Returns (last-iter per-config loss, last-iter
-        outputs)."""
+        outputs).
+
+        With `pipeline_depth` >= 1 the loop is a pure dispatcher: each
+        chunk's host bookkeeping (device_get of losses/metrics, sink
+        records) runs on the consumer thread while the next chunks are
+        already enqueued; a consumer failure is sticky and re-raises
+        here on the next call. Results returned are identical bit for
+        bit to the sequential path (tests + CI
+        scripts/check_async_equivalence.py pin this)."""
+        if self._consumer is not None:
+            self._consumer.check()   # sticky: surface a prior failure
         s = self.solver
         if self._dataset is not None:
             done = 0
@@ -598,9 +724,10 @@ class SweepRunner:
                     put(jnp.asarray(starts, jnp.int32)),
                     put(jnp.asarray(remaps)))
                 self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
+                self._after_dispatch(k, self.iter - 1, losses, outputs,
+                                     mets)
                 done += k
-            return (np.asarray(losses)[-1],
-                    jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
+            return self._finish_step(losses, outputs)
         if chunk <= 1:
             for _ in range(iters):
                 self._maybe_genetic()
@@ -615,8 +742,10 @@ class SweepRunner:
                                              jnp.int32(self.iter), rngs,
                                              self._remap_due())
                 self.last_metrics = mets
+                self._after_dispatch(1, self.iter, loss, outputs, mets,
+                                     stacked=False)
                 self.iter += 1
-            return np.asarray(loss), jax.tree.map(np.asarray, outputs)
+            return self._finish_step(loss, outputs, stacked=False)
 
         done = 0
         while done < iters:
@@ -636,9 +765,56 @@ class SweepRunner:
                 k, self.params, self.history, self.fault_states, batches,
                 jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
             self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
+            self._after_dispatch(k, self.iter - 1, losses, outputs, mets)
             done += k
-        return (np.asarray(losses)[-1],
-                jax.tree.map(lambda x: np.asarray(x)[-1], outputs))
+        return self._finish_step(losses, outputs)
+
+    def save_fault_states(self, path: str, background: bool = True):
+        """Write the config-stacked fault state (lifetimes, stuck
+        levels, remap slots) to `path` as an .npz archive. The hot loop
+        pays only the device fetch; serialization and the crash-safe
+        temp-file + atomic-rename write happen on the background writer
+        thread (`background=False` writes inline with the same
+        atomicity). `wait_for_writes()` is the barrier; a writer error
+        is sticky and re-raises at the next save/wait."""
+        flat = {}
+        for group, tree in self.fault_states.items():
+            for k, v in tree.items():
+                flat[f"{group}/{k}"] = np.asarray(v)   # the fetch
+
+        def write(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, **flat)
+
+        if background:
+            if self._bg_writer is None:
+                self._bg_writer = async_exec.BackgroundWriter()
+            self._bg_writer.submit(path, write)
+        else:
+            t0 = time.perf_counter()
+            async_exec.atomic_write(path, write)
+            self._inline_write_s += time.perf_counter() - t0
+        return path
+
+    def wait_for_writes(self):
+        """Barrier for background fault-state writes (re-raises the
+        first writer error, if any)."""
+        if self._bg_writer is not None:
+            self._bg_writer.wait()
+
+    def close(self):
+        """Stop the pipeline consumer and background writer threads.
+        Pending work is drained first; sticky errors re-raise here."""
+        try:
+            if self._consumer is not None:
+                self._consumer.drain()
+            if self._bg_writer is not None:
+                self._bg_writer.wait()
+        finally:
+            if self._consumer is not None:
+                self._consumer.close()
+            if self._bg_writer is not None:
+                self._bg_writer.close()
 
     def _placed(self, batch, stacked: bool = False):
         """Device-place a host batch; under a (config, data) mesh the batch
@@ -697,6 +873,70 @@ class SweepRunner:
                 jax.vmap(run, in_axes=(0, None)))
         out = self._eval_fns[id(net)](self.params, batch)
         return {k: np.asarray(v) for k, v in out.items()}
+
+
+class GroupPrefetcher:
+    """Overlapped resident-group scheduling for multi-group sweeps
+    (run_1000_sweep.py): a 1000-config run that holds 500 configs
+    resident pays TWO serial cold starts — group B's fault-state draw,
+    placement, dataset decode, and chunk compile all wait for group A
+    to finish. `start(build_fn)` runs the next group's whole setup on a
+    background thread WHILE the current group executes (the AOT path:
+    pass `precompile_chunk` to the runner so the compile overlaps too),
+    and `take()` joins and returns the built runner, crediting the
+    hidden seconds to the runner's `PipelineStats.setup_overlap_s` (the
+    `setup_overlap_seconds` field of its `setup` record).
+
+    A build error is held and re-raised by `take()` — the scheduling
+    thread never swallows a failed setup."""
+
+    def __init__(self):
+        self._thread = None
+        self._box: dict = {}
+        self.last_build_s = 0.0   # the prefetched build's own wall time
+        self.last_wait_s = 0.0    # how long take() still had to block
+
+    def start(self, build_fn, *args):
+        """Kick off `build_fn(*args)` (returning a runner) on a
+        background thread. One prefetch in flight at a time."""
+        if self._thread is not None:
+            raise RuntimeError("a group prefetch is already in flight; "
+                               "take() it first")
+        box = self._box = {}
+
+        def run():
+            t0 = time.perf_counter()
+            try:
+                box["result"] = build_fn(*args)
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                box["seconds"] = time.perf_counter() - t0
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="group-prefetch")
+        self._thread.start()
+
+    def take(self):
+        """Join the in-flight build and return the runner; build errors
+        re-raise here. Records build/wait seconds and credits the
+        overlapped portion to the runner's pipeline stats."""
+        if self._thread is None:
+            raise RuntimeError("no group prefetch in flight")
+        t0 = time.perf_counter()
+        self._thread.join()
+        self.last_wait_s = time.perf_counter() - t0
+        self._thread = None
+        box = self._box
+        self.last_build_s = box.get("seconds", 0.0)
+        if "error" in box:
+            raise box["error"]
+        runner = box["result"]
+        overlap = max(self.last_build_s - self.last_wait_s, 0.0)
+        pipe = getattr(runner, "pipeline", None)
+        if pipe is not None:
+            pipe.setup_overlap_s += overlap
+        return runner
 
 
 def sequential_sweep(solver_param, configs, iters, eval_iters: int = 0):

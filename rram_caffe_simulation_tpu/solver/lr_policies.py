@@ -51,3 +51,45 @@ def learning_rate_fn(param: "pb.SolverParameter"):
         stepsize = jnp.float32(param.stepsize)
         return lambda it: base / (1.0 + jnp.exp(-gamma * (it - stepsize)))
     raise ValueError(f"Unknown lr policy: {policy!r}")
+
+
+def host_learning_rate_fn(param: "pb.SolverParameter"):
+    """The NumPy twin of `learning_rate_fn`: rate(iter) evaluated
+    entirely on host, in the same float32 arithmetic as the traced
+    policy (tests/test_async_pipeline.py pins the parity). The display
+    path uses it so printing a log line never dispatches to the device
+    — the traced version's only remaining caller is the jitted step
+    itself, where it belongs."""
+    import numpy as np
+
+    policy = param.lr_policy
+    base = np.float32(param.base_lr)
+    gamma = np.float32(param.gamma)
+    power = np.float32(param.power)
+
+    if policy == "fixed":
+        return lambda it: float(base)
+    if policy == "step":
+        stepsize = max(int(param.stepsize), 1)
+        return lambda it: float(
+            base * gamma ** np.float32(int(it) // stepsize))
+    if policy == "multistep":
+        steps = sorted(int(s) for s in param.stepvalue)
+        return lambda it: float(
+            base * gamma ** np.float32(sum(int(it) >= s for s in steps)))
+    if policy == "exp":
+        return lambda it: float(base * gamma ** np.float32(it))
+    if policy == "inv":
+        return lambda it: float(
+            base * (np.float32(1.0) + gamma * np.float32(it))
+            ** (-power))
+    if policy == "poly":
+        max_iter = np.float32(param.max_iter)
+        return lambda it: float(
+            base * (np.float32(1.0) - np.float32(it) / max_iter) ** power)
+    if policy == "sigmoid":
+        stepsize = np.float32(param.stepsize)
+        return lambda it: float(
+            base / (np.float32(1.0)
+                    + np.exp(-gamma * (np.float32(it) - stepsize))))
+    raise ValueError(f"Unknown lr policy: {policy!r}")

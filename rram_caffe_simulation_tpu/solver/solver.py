@@ -32,7 +32,8 @@ from ..net import Net
 from ..proto import pb
 from ..utils import io as uio
 from . import updates as U
-from .lr_policies import current_step_fn, learning_rate_fn
+from .lr_policies import (current_step_fn, host_learning_rate_fn,
+                          learning_rate_fn)
 
 
 def _resolve_solver_type(param: "pb.SolverParameter") -> str:
@@ -230,9 +231,13 @@ class Solver:
         self.test_feeds = test_feeds
 
         self._lr_fn = learning_rate_fn(param)
+        # host (NumPy) twin of the policy for display paths: printing a
+        # log line must never cost a device round-trip
+        self._host_lr_fn = host_learning_rate_fn(param)
         self.last_outputs = {}     # net outputs of the most recent step
         self._step_fn = None       # jit cache
         self._test_fns = [None] * len(self.test_nets)
+        self._snapshot_writer = None   # BackgroundWriter once enabled
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -1166,7 +1171,7 @@ class Solver:
             display = param.display and self.iter % param.display == 0
             if display:
                 self._materialize_smoothed_loss()
-                lr = float(self._lr_fn(jnp.int32(self.iter)))
+                lr = self._host_lr_fn(self.iter)
                 print(f"Iteration {self.iter}, lr = {lr:g}", flush=True)
                 print(f"Iteration {self.iter}, loss = "
                       f"{self.smoothed_loss:g}", flush=True)
@@ -1335,7 +1340,7 @@ class Solver:
                         break
             if param.display and self.iter % param.display == 0:
                 self._materialize_smoothed_loss()
-                lr = float(self._lr_fn(jnp.int32(self.iter - 1)))
+                lr = self._host_lr_fn(self.iter - 1)
                 print(f"Iteration {self.iter - 1}, lr = {lr:g}",
                       flush=True)
                 print(f"Iteration {self.iter - 1}, loss = "
@@ -1437,6 +1442,9 @@ class Solver:
         if (self.param.test_interval and
                 self.iter % self.param.test_interval == 0):
             self.test_all()
+        # queued background snapshot writes must land before the run is
+        # declared done (and any writer error must fail it)
+        self.wait_for_snapshots()
         print("Optimization Done.", flush=True)
 
     # ------------------------------------------------------------------
@@ -1531,22 +1539,64 @@ class Solver:
                     self.history[k][s].shape)
                 i += 1
 
+    def enable_background_snapshots(self):
+        """Move snapshot serialization and file writes to a background
+        writer thread (async_exec.BackgroundWriter): `snapshot()` then
+        costs the training loop only the device fetch of params /
+        history / fault state — protobuf/HDF5 serialization and the
+        write happen off-thread, each through a sibling temp file and
+        an atomic `os.replace`, so a crash mid-write can never leave a
+        partial file under the final name. `wait_for_snapshots()` is
+        the barrier (`restore()` and `solve()` take it automatically);
+        a writer error is sticky and re-raises at the next snapshot or
+        wait."""
+        from ..async_exec import BackgroundWriter
+        if self._snapshot_writer is None:
+            self._snapshot_writer = BackgroundWriter()
+        return self._snapshot_writer
+
+    def wait_for_snapshots(self):
+        """Block until every queued background snapshot write has landed
+        (re-raises the first writer error, if any). No-op when
+        background snapshots are not enabled."""
+        if self._snapshot_writer is not None:
+            self._snapshot_writer.wait()
+
+    def _put_snapshot_file(self, path: str, write_fn):
+        """Route one snapshot payload write: background writer when
+        enabled (serialize+rename off-thread), else inline."""
+        if self._snapshot_writer is not None:
+            self._snapshot_writer.submit(path, write_fn)
+        else:
+            write_fn(path)
+
     def snapshot(self):
         os.makedirs(os.path.dirname(self.param.snapshot_prefix) or ".",
                     exist_ok=True)
         use_hdf5 = (self.param.snapshot_format ==
                     pb.SolverParameter.HDF5)
+        # Payloads are materialized HERE (device fetch + host copies);
+        # with background snapshots enabled only serialization and the
+        # filesystem write leave the loop's thread.
         if use_hdf5:
             model_name = self.snapshot_filename(".caffemodel.h5")
-            uio.write_net_hdf5(self.net.to_proto(self.params), model_name)
+            model_proto = self.net.to_proto(self.params)
+            self._put_snapshot_file(
+                model_name,
+                lambda p, m=model_proto: uio.write_net_hdf5(m, p))
             state_name = self.snapshot_filename(".solverstate.h5")
-            uio.write_solver_state_hdf5(
-                state_name, self.iter, model_name,
-                int(current_step_fn(self.param)(jnp.int32(self.iter))),
-                self._history_blob_list())
+            cur = int(current_step_fn(self.param)(jnp.int32(self.iter)))
+            hist = self._history_blob_list()
+            self._put_snapshot_file(
+                state_name,
+                lambda p, it=self.iter, m=model_name, c=cur, h=hist:
+                    uio.write_solver_state_hdf5(p, it, m, c, h))
         else:
             model_name = self.snapshot_filename(".caffemodel")
-            uio.write_proto_binary(model_name, self.net.to_proto(self.params))
+            model_proto = self.net.to_proto(self.params)
+            self._put_snapshot_file(
+                model_name,
+                lambda p, m=model_proto: uio.write_proto_binary(p, m))
             state = pb.SolverState(
                 iter=self.iter, learned_net=model_name,
                 current_step=int(current_step_fn(self.param)(
@@ -1554,18 +1604,25 @@ class Solver:
             for arr in self._history_blob_list():
                 uio.array_to_blob(arr, state.history.add())
             state_name = self.snapshot_filename(".solverstate")
-            uio.write_proto_binary(state_name, state)
+            self._put_snapshot_file(
+                state_name,
+                lambda p, s=state: uio.write_proto_binary(p, s))
         if self.fault_state is not None:
             # NEW vs reference: persist RRAM fault state so resume continues
             # the same crossbar degradation (the reference re-draws,
             # SURVEY §5.4 gap).
-            uio.write_proto_binary(
+            fault_proto = fault_engine.fault_state_to_proto(
+                self.fault_state)
+            self._put_snapshot_file(
                 self.snapshot_filename(".faultstate"),
-                fault_engine.fault_state_to_proto(self.fault_state))
+                lambda p, m=fault_proto: uio.write_proto_binary(p, m))
         print(f"Snapshotting to {model_name}", flush=True)
         return model_name
 
     def restore(self, state_file: str):
+        # a snapshot still queued on the background writer must land
+        # before its files are read back
+        self.wait_for_snapshots()
         if state_file.endswith(".h5"):
             it, learned_net, cur_step, hist = uio.read_solver_state_hdf5(
                 state_file)

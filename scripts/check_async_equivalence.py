@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CI guard for the async dispatch pipeline: run the SAME short sweep
+twice in one process — once with synchronous per-chunk bookkeeping
+(pipeline_depth=0) and once pipelined (a bounded-queue consumer thread,
+pipeline_depth>=1) — and fail on ANY divergence in:
+
+  * per-chunk losses (every sink record's per-config loss vector),
+  * final state (params, momentum history, fault-state census —
+    byte-identical),
+  * the emitted sink record sequence (order and content, timing fields
+    excluded),
+
+while also asserting the overlap is REAL: the pipelined dispatcher's
+host-blocked seconds must come in strictly below the sync path's (the
+sync path blocks on device_get + sink feeding at every chunk boundary;
+the pipelined path only pays submit backpressure).
+
+Trains on a tiny generated LMDB through the device-resident dataset
+path — the production sweep configuration the pipeline targets.
+
+    python scripts/check_async_equivalence.py
+
+Exit status: 0 = bit-exact and overlapped, 1 = any divergence.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ITERS = 12
+CHUNK = 3
+N_CONFIGS = 2
+# timing fields legitimately differ between the two runs; everything
+# else in a record must match exactly
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s")
+
+
+class RecordingSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _run(db: str, pipeline_depth):
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    solver_txt = """
+    base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+    max_iter: 100 display: 1 random_seed: 3 snapshot_prefix: "/tmp/cae"
+    failure_pattern { type: "gaussian" mean: 200.0 std: 40.0 }
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(solver_txt, sp)
+    net_txt = f"""
+    name: "asyncguard"
+    layer {{ name: "data" type: "Data" top: "data" top: "label"
+      data_param {{ source: "{db}" batch_size: 8 }}
+      transform_param {{ scale: 0.00390625 }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+      inner_product_param {{ num_output: 4
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+      bottom: "label" top: "loss" }}
+    """
+    text_format.Parse(net_txt, sp.net_param)
+    solver = Solver(sp)
+    sink = RecordingSink()
+    solver.enable_metrics(sink)
+    runner = SweepRunner(solver, n_configs=N_CONFIGS,
+                         pipeline_depth=pipeline_depth)
+    loss, _ = runner.step(ITERS, chunk=CHUNK)
+    state = {
+        "loss": loss,
+        "params": runner.solver._flat(runner.params),
+        "history": runner.history,
+        "fault": runner.fault_states,
+        "broken": runner.broken_fractions(),
+        "pipeline": runner.setup_record().get("pipeline", {}),
+        "records": sink.records,
+    }
+    runner.close()
+    return state
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    work = tempfile.mkdtemp(prefix="async_equiv_guard_")
+    try:
+        db = os.path.join(work, "db")
+        _build_db(db)
+        sync = _run(db, pipeline_depth=0)
+        pipe = _run(db, pipeline_depth=3)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    failures = []
+
+    def bit_equal(name, a, b):
+        fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+        if len(fa) != len(fb):
+            failures.append(f"{name}: tree structure differs")
+            return
+        for i, (x, y) in enumerate(zip(fa, fb)):
+            if np.asarray(x).tobytes() != np.asarray(y).tobytes():
+                failures.append(f"{name}: leaf {i} not byte-identical")
+
+    bit_equal("final loss", sync["loss"], pipe["loss"])
+    bit_equal("final params", sync["params"], pipe["params"])
+    bit_equal("momentum history", sync["history"], pipe["history"])
+    bit_equal("fault state", sync["fault"], pipe["fault"])
+    bit_equal("broken census", sync["broken"], pipe["broken"])
+
+    strip = lambda recs: [
+        {k: v for k, v in r.items() if k not in TIMING_FIELDS}
+        for r in recs]
+    rs, rp = strip(sync["records"]), strip(pipe["records"])
+    if len(rs) != len(rp):
+        failures.append(f"record count differs: sync {len(rs)} vs "
+                        f"pipelined {len(rp)}")
+    elif rs != rp:
+        for i, (a, b) in enumerate(zip(rs, rp)):
+            if a != b:
+                failures.append(f"record {i} diverges: {a!r} != {b!r}")
+    if not rs:
+        failures.append("sync run emitted no records (the guard would "
+                        "be vacuous)")
+    for rec in sync["records"] + pipe["records"]:
+        losses = rec.get("loss")
+        if not isinstance(losses, list) or len(losses) != N_CONFIGS:
+            failures.append(f"record loss is not the per-config vector: "
+                            f"{losses!r}")
+            break
+
+    hb_sync = sync["pipeline"].get("host_blocked_seconds", 0.0)
+    hb_pipe = pipe["pipeline"].get("host_blocked_seconds", 0.0)
+    n_chunks = sync["pipeline"].get("chunks", 0)
+    if pipe["pipeline"].get("depth", 0) < 1:
+        failures.append("pipelined run does not report its depth")
+    if n_chunks != pipe["pipeline"].get("chunks", -1):
+        failures.append(
+            f"chunk counts differ: sync {n_chunks} vs pipelined "
+            f"{pipe['pipeline'].get('chunks')}")
+    if not hb_pipe < hb_sync:
+        failures.append(
+            f"no overlap: pipelined host-blocked {hb_pipe}s is not "
+            f"strictly below sync {hb_sync}s over {n_chunks} chunks "
+            "(host bookkeeping is not running concurrent with dispatch)")
+
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print(f"async-equivalence guard OK: {len(rs)} records bit-identical "
+          f"across {n_chunks} chunks; host-blocked "
+          f"{hb_sync:.4f}s sync -> {hb_pipe:.4f}s pipelined "
+          f"(consumer did the bookkeeping concurrently)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

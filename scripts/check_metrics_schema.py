@@ -89,13 +89,19 @@ SAMPLE_BAD_SENTINEL = {
     "nan": 1, "inf": False, "overflow": False,       # nan not a bool
 }
 
-# the cold-start breakdown record (cache.py / observe.make_setup_record)
+# the cold-start breakdown record (cache.py / observe.make_setup_record),
+# including the async-pipeline accounting (async_exec.PipelineStats)
 SAMPLE_GOOD_SETUP = {
     "schema_version": 1, "type": "setup", "wall_time": 1722700000.0,
     "decode_seconds": 121.4, "compile_seconds": 14.9,
     "setup_seconds": 136.6,
     "cache": {"compile": "hit", "dataset": "miss"},
     "cache_dir": "/var/cache/rram-tpu",
+    "pipeline": {"depth": 2, "chunks": 100, "records": 100,
+                 "host_blocked_seconds": 0.021,
+                 "consumer_seconds": 3.4, "drain_seconds": 0.8,
+                 "snapshot_write_seconds": 1.2,
+                 "setup_overlap_seconds": 12.1},
 }
 
 SAMPLE_BAD_SETUP = {
@@ -103,6 +109,8 @@ SAMPLE_BAD_SETUP = {
     "decode_seconds": -1.0,                          # negative time
     "compile_seconds": "fast",                       # not a number
     "cache": {"compile": "sideways"},                # bad state, no dataset
+    "pipeline": {"depth": 2,                         # chunks missing
+                 "host_blocked_seconds": -0.5},      # negative time
 }
 
 

@@ -1,0 +1,482 @@
+"""Async execution layer (async_exec + SweepRunner pipeline + background
+snapshots): the overlap must be free — pipelined results bit-identical
+to the sequential path, consumer errors sticky instead of hung, and a
+crashed snapshot write never corrupting a good snapshot."""
+import json
+import os
+import time
+
+import numpy as np
+import jax
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu import async_exec
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+from rram_caffe_simulation_tpu.parallel import GroupPrefetcher, SweepRunner
+from rram_caffe_simulation_tpu.observe import MetricsLogger
+
+from test_fault import fault_solver
+from test_parallel import _genetic_solver_param
+
+# timing fields legitimately differ between runs; everything else in an
+# emitted record must match exactly
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s")
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def _strip_timing(records):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in records]
+
+
+def _metrics_runner(tmp_path, depth, n_configs=4):
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    sink = ListSink()
+    s.enable_metrics(sink)
+    return SweepRunner(s, n_configs=n_configs, pipeline_depth=depth), sink
+
+
+def _bit_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# pipelined == sequential, bit for bit
+
+
+def test_pipelined_step_matches_sync_bit_exact(tmp_path):
+    """The tentpole contract: a pipelined SweepRunner.step (dispatcher +
+    bounded-queue consumer thread) produces the SAME per-chunk losses,
+    final params/momentum/fault census, and sink record order as the
+    synchronous path — while the dispatcher's host-blocked time drops
+    (the consumer does the device_get + sink feeding concurrently)."""
+    r_sync, sink_sync = _metrics_runner(tmp_path / "a", depth=0)
+    loss_sync, out_sync = r_sync.step(9, chunk=3)
+    r_pipe, sink_pipe = _metrics_runner(tmp_path / "b", depth=2)
+    loss_pipe, out_pipe = r_pipe.step(9, chunk=3)
+
+    _bit_equal(loss_sync, loss_pipe)
+    _bit_equal(out_sync, out_pipe)
+    _bit_equal(r_sync.solver._flat(r_sync.params),
+               r_pipe.solver._flat(r_pipe.params))
+    _bit_equal(r_sync.history, r_pipe.history)
+    _bit_equal(r_sync.fault_states, r_pipe.fault_states)
+    np.testing.assert_array_equal(r_sync.broken_fractions(),
+                                  r_pipe.broken_fractions())
+
+    assert len(sink_sync.records) == 3           # one per chunk
+    assert _strip_timing(sink_sync.records) == \
+        _strip_timing(sink_pipe.records)
+    # per-config loss vectors rode the records
+    assert all(len(r["loss"]) == 4 for r in sink_sync.records)
+
+    assert r_sync.pipeline.chunks == r_pipe.pipeline.chunks == 3
+    assert (r_pipe.pipeline.host_blocked_s
+            < r_sync.pipeline.host_blocked_s)
+    r_pipe.close()
+    r_sync.close()
+
+
+def test_pipelined_matches_legacy_path(tmp_path):
+    """pipeline_depth=None (legacy: no per-chunk bookkeeping at all)
+    computes the identical math — the pipeline only moves host work."""
+    s1 = fault_solver(tmp_path / "a", mean=250.0, std=30.0)
+    r1 = SweepRunner(s1, n_configs=2)
+    l1, _ = r1.step(6, chunk=2)
+    s2 = fault_solver(tmp_path / "b", mean=250.0, std=30.0)
+    r2 = SweepRunner(s2, n_configs=2, pipeline_depth=3)
+    l2, _ = r2.step(6, chunk=2)
+    _bit_equal(l1, l2)
+    _bit_equal(s1._flat(r1.params), s2._flat(r2.params))
+    r2.close()
+
+
+def test_pipelined_per_iteration_path_matches(tmp_path):
+    """chunk<=1 (one dispatch per iteration) flows through the same
+    consumer: records per iteration, same math."""
+    r_sync, sink_sync = _metrics_runner(tmp_path / "a", depth=0,
+                                        n_configs=2)
+    l1, _ = r_sync.step(3)
+    r_pipe, sink_pipe = _metrics_runner(tmp_path / "b", depth=2,
+                                        n_configs=2)
+    l2, _ = r_pipe.step(3)
+    _bit_equal(l1, l2)
+    assert len(sink_sync.records) == 3
+    assert _strip_timing(sink_sync.records) == \
+        _strip_timing(sink_pipe.records)
+    r_pipe.close()
+
+
+def test_pipelined_genetic_barrier_matches_sync(tmp_path):
+    """The genetic strategy mutates params on host between dispatches —
+    the pipeline must drain at those boundaries and still match the
+    synchronous path bit for bit."""
+    sp = _genetic_solver_param(tmp_path, start=1, period=2)
+    s1 = Solver(pb.SolverParameter.FromString(sp.SerializeToString()))
+    r1 = SweepRunner(s1, n_configs=2)
+    r1.step(5, chunk=5)
+    s2 = Solver(pb.SolverParameter.FromString(sp.SerializeToString()))
+    r2 = SweepRunner(s2, n_configs=2, pipeline_depth=2)
+    r2.step(5, chunk=5)
+    _bit_equal(s1._flat(r1.params), s2._flat(r2.params))
+    _bit_equal(r1.fault_states, r2.fault_states)
+    r2.close()
+
+
+def test_consumer_error_sticky_no_hang(tmp_path):
+    """A consumer-thread failure (here: a sink that raises) re-raises at
+    the step() call that observes it AND at every later call — never a
+    hang on the dead consumer."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+
+    class BoomSink:
+        def __init__(self):
+            self.n = 0
+
+        def write(self, record):
+            self.n += 1
+            if self.n >= 2:
+                raise RuntimeError("sink exploded")
+
+    s.enable_metrics(BoomSink())
+    runner = SweepRunner(s, n_configs=2, pipeline_depth=2)
+    with pytest.raises(RuntimeError, match="sink exploded"):
+        runner.step(8, chunk=2)      # 4 chunks; record 2 blows up
+    # sticky: the next call re-raises immediately instead of training
+    it_before = runner.iter
+    with pytest.raises(RuntimeError, match="sink exploded"):
+        runner.step(2, chunk=2)
+    assert runner.iter == it_before
+    with pytest.raises(RuntimeError, match="sink exploded"):
+        runner.close()
+
+
+# ---------------------------------------------------------------------------
+# OrderedConsumer unit behavior
+
+
+def test_ordered_consumer_preserves_order():
+    seen = []
+    c = async_exec.OrderedConsumer(seen.append, depth=2)
+    for i in range(20):
+        c.submit(i)
+    c.drain()
+    assert seen == list(range(20))
+    c.close()
+
+
+def test_ordered_consumer_sticky_error_drains_queue():
+    def fn(i):
+        if i == 3:
+            raise ValueError("item 3")
+    c = async_exec.OrderedConsumer(fn, depth=1)
+    with pytest.raises(ValueError, match="item 3"):
+        for i in range(50):          # must not hang on the full queue
+            c.submit(i)
+        c.drain()
+    with pytest.raises(ValueError, match="item 3"):
+        c.submit(99)
+    with pytest.raises(ValueError, match="item 3"):
+        c.drain()
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# background snapshots
+
+
+def test_background_snapshot_files_equal_sync(tmp_path):
+    """Background snapshots write byte-identical files to synchronous
+    ones (serialization moved, not changed)."""
+    s1 = fault_solver(tmp_path / "a", mean=250.0, std=30.0)
+    s1.step(2)
+    p1 = s1.snapshot()
+    s2 = fault_solver(tmp_path / "b", mean=250.0, std=30.0)
+    s2.enable_background_snapshots()
+    s2.step(2)
+    p2 = s2.snapshot()
+    s2.wait_for_snapshots()
+    for ext in (".caffemodel", ".faultstate"):
+        a = open(s1.snapshot_filename(ext), "rb").read()
+        b = open(s2.snapshot_filename(ext), "rb").read()
+        assert a == b, ext
+    # the solverstate embeds the (different) snapshot path — compare
+    # the state itself
+    from rram_caffe_simulation_tpu.utils import io as uio
+    st1 = uio.read_proto_binary(s1.snapshot_filename(".solverstate"),
+                                pb.SolverState())
+    st2 = uio.read_proto_binary(s2.snapshot_filename(".solverstate"),
+                                pb.SolverState())
+    assert st1.iter == st2.iter
+    assert st1.current_step == st2.current_step
+    assert ([uio.blob_to_array(b).tobytes() for b in st1.history]
+            == [uio.blob_to_array(b).tobytes() for b in st2.history])
+    # and the background snapshot restores
+    s3 = fault_solver(tmp_path / "b", mean=250.0, std=30.0)
+    s3.restore(s2.snapshot_filename(".solverstate"))
+    assert s3.iter == 2
+    _bit_equal(s2._flat(s2.params), s3._flat(s3.params))
+
+
+def test_background_snapshot_crash_never_replaces_good_file(tmp_path,
+                                                            monkeypatch):
+    """Crash-safety: a writer failure mid-serialization leaves the
+    previous good snapshot intact (temp file + atomic rename), surfaces
+    as a sticky error, and leaves no temp debris."""
+    from rram_caffe_simulation_tpu.utils import io as uio
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    s.enable_background_snapshots()
+    s.step(2)
+    s.snapshot()
+    s.wait_for_snapshots()
+    model = s.snapshot_filename(".caffemodel")
+    good = open(model, "rb").read()
+
+    real = uio.write_proto_binary
+
+    def partial_then_crash(path, msg):
+        with open(path, "wb") as f:
+            f.write(b"PARTIAL")          # a torn write...
+        raise IOError("disk full")       # ...that never completes
+
+    monkeypatch.setattr(uio, "write_proto_binary", partial_then_crash)
+    s.snapshot()                          # same iter -> same filenames
+    with pytest.raises(IOError, match="disk full"):
+        s.wait_for_snapshots()
+    monkeypatch.setattr(uio, "write_proto_binary", real)
+
+    assert open(model, "rb").read() == good     # untouched
+    debris = [f for f in os.listdir(os.path.dirname(model))
+              if ".tmp." in f]
+    assert debris == []
+    with pytest.raises(IOError, match="disk full"):   # sticky
+        s.snapshot()
+
+
+def test_sweep_fault_state_writer_roundtrip(tmp_path):
+    """SweepRunner.save_fault_states: background npz write lands
+    atomically and round-trips the stacked trees exactly."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    runner = SweepRunner(s, n_configs=3, pipeline_depth=2)
+    runner.step(2)
+    path = str(tmp_path / "fault_states.npz")
+    runner.save_fault_states(path)
+    runner.wait_for_writes()
+    with np.load(path) as d:
+        for group, tree in runner.fault_states.items():
+            for k, v in tree.items():
+                np.testing.assert_array_equal(d[f"{group}/{k}"],
+                                              np.asarray(v))
+    runner.close()
+
+
+# ---------------------------------------------------------------------------
+# buffered sinks
+
+
+def test_jsonl_sink_buffers_and_flushes_on_close(tmp_path):
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    path = str(tmp_path / "buf.jsonl")
+    sink = JsonlSink(path, flush_every=100, flush_secs=1000.0)
+    for i in range(5):
+        sink.write({"iter": i})
+    # buffered: nothing forced to disk yet
+    assert os.path.getsize(path) == 0
+    sink.close()                          # close always flushes
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    assert [r["iter"] for r in recs] == list(range(5))
+
+
+def test_jsonl_sink_flush_every_threshold(tmp_path):
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    path = str(tmp_path / "buf.jsonl")
+    sink = JsonlSink(path, flush_every=3, flush_secs=1000.0)
+    sink.write({"iter": 0})
+    sink.write({"iter": 1})
+    assert os.path.getsize(path) == 0
+    sink.write({"iter": 2})               # 3rd record trips the policy
+    assert len([l for l in open(path) if l.strip()]) == 3
+    sink.close()
+
+
+def test_jsonl_sink_unbuffered_escape_hatch(tmp_path):
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    path = str(tmp_path / "tail.jsonl")
+    sink = JsonlSink(path, unbuffered=True, flush_every=10 ** 6)
+    sink.write({"iter": 0})
+    # tail -f visibility: the record is on disk before close
+    assert json.loads(open(path).readline())["iter"] == 0
+    sink.close()
+
+
+def test_caffe_sink_honors_flush_policy(tmp_path):
+    from rram_caffe_simulation_tpu.observe import CaffeLogSink
+    path = str(tmp_path / "buf.log")
+    sink = CaffeLogSink(path, net_name="n", flush_every=100,
+                        flush_secs=1000.0)
+    banner_size = os.path.getsize(path)   # banner flushes at open
+    sink.write({"iter": 0, "lr": 0.1, "loss": 1.0})
+    assert os.path.getsize(path) == banner_size    # buffered
+    sink.close()
+    assert os.path.getsize(path) > banner_size
+    # unbuffered escape hatch flushes per record
+    path2 = str(tmp_path / "tail.log")
+    sink2 = CaffeLogSink(path2, net_name="n", unbuffered=True)
+    size0 = os.path.getsize(path2)
+    sink2.write({"iter": 0, "lr": 0.1, "loss": 1.0})
+    assert os.path.getsize(path2) > size0
+    sink2.close()
+
+
+# ---------------------------------------------------------------------------
+# host-side LR policy (display never dispatches)
+
+
+@pytest.mark.parametrize("policy,fields", [
+    ("fixed", {}),
+    ("step", {"gamma": 0.5, "stepsize": 7}),
+    ("multistep", {"gamma": 0.5, "stepvalue": [3, 11, 40]}),
+    ("exp", {"gamma": 0.98}),
+    ("inv", {"gamma": 0.0001, "power": 0.75}),
+    ("poly", {"power": 1.5, "max_iter": 100}),
+    ("sigmoid", {"gamma": -0.1, "stepsize": 25}),
+])
+def test_host_lr_matches_traced_policy(policy, fields):
+    import jax.numpy as jnp
+    from rram_caffe_simulation_tpu.solver.lr_policies import (
+        host_learning_rate_fn, learning_rate_fn)
+    sp = pb.SolverParameter()
+    sp.base_lr = 0.01
+    sp.lr_policy = policy
+    for k, v in fields.items():
+        if k == "stepvalue":
+            sp.stepvalue.extend(v)
+        else:
+            setattr(sp, k, v)
+    traced = learning_rate_fn(sp)
+    host = host_learning_rate_fn(sp)
+    for it in (0, 1, 2, 3, 7, 11, 12, 39, 40, 41, 99):
+        np.testing.assert_allclose(
+            host(it), float(traced(jnp.int32(it))), rtol=1e-6,
+            err_msg=f"{policy} at iter {it}")
+
+
+def test_display_lr_never_calls_traced_policy(tmp_path, capsys):
+    """The display path must evaluate the LR policy on host NumPy —
+    poisoning the traced fn after compile proves no display-boundary
+    device round-trip remains."""
+    s = fault_solver(tmp_path, mean=1e6, std=10.0)
+    s.param.display = 1
+    s.step(1)                             # compiles with the real policy
+
+    def boom(it):
+        raise AssertionError("display path dispatched the traced LR fn")
+    s._lr_fn = boom
+    s.step(2)                             # display prints every iter
+    out = capsys.readouterr().out
+    assert "lr = 0.05" in out
+    s.step_fused(2, chunk=2)
+    out = capsys.readouterr().out
+    assert "lr = 0.05" in out
+
+
+# ---------------------------------------------------------------------------
+# overlapped resident-group scheduling
+
+
+def test_group_prefetcher_overlap_accounting():
+    gp = GroupPrefetcher()
+
+    class FakeRunner:
+        pipeline = async_exec.PipelineStats()
+
+    def build():
+        time.sleep(0.2)
+        return FakeRunner()
+
+    gp.start(build)
+    with pytest.raises(RuntimeError, match="in flight"):
+        gp.start(build)                   # one prefetch at a time
+    time.sleep(0.3)                       # "group A executing"
+    r = gp.take()
+    assert isinstance(r, FakeRunner)
+    assert gp.last_build_s >= 0.2
+    assert gp.last_wait_s < 0.15          # build was hidden behind A
+    assert r.pipeline.setup_overlap_s > 0.0
+
+
+def test_group_prefetcher_build_error_reraises():
+    gp = GroupPrefetcher()
+
+    def boom():
+        raise RuntimeError("group B setup failed")
+
+    gp.start(boom)
+    with pytest.raises(RuntimeError, match="group B setup failed"):
+        gp.take()
+    with pytest.raises(RuntimeError, match="no group prefetch"):
+        gp.take()
+
+
+def test_group_prefetcher_builds_real_runner(tmp_path):
+    """End to end: a SweepRunner built on the prefetch thread trains
+    identically to one built inline."""
+    def build():
+        s = fault_solver(tmp_path / "bg", mean=250.0, std=30.0)
+        return SweepRunner(s, n_configs=2, pipeline_depth=2)
+
+    gp = GroupPrefetcher()
+    gp.start(build)
+    r_bg = gp.take()
+    l_bg, _ = r_bg.step(4, chunk=2)
+    s_fg = fault_solver(tmp_path / "fg", mean=250.0, std=30.0)
+    r_fg = SweepRunner(s_fg, n_configs=2)
+    l_fg, _ = r_fg.step(4, chunk=2)
+    _bit_equal(l_bg, l_fg)
+    rec = r_bg.setup_record()
+    assert rec["pipeline"]["depth"] == 2
+    r_bg.close()
+
+
+# ---------------------------------------------------------------------------
+# setup-record integration
+
+
+def test_setup_record_carries_pipeline_fields(tmp_path):
+    from rram_caffe_simulation_tpu.observe.schema import validate_record
+    r, _ = _metrics_runner(tmp_path, depth=2, n_configs=2)
+    r.step(4, chunk=2)
+    r.save_fault_states(str(tmp_path / "fs.npz"))
+    r.wait_for_writes()
+    rec = r.setup_record(setup_s=1.0)
+    assert validate_record(rec) == []
+    pipe = rec["pipeline"]
+    assert pipe["depth"] == 2
+    assert pipe["chunks"] == 2
+    assert pipe["records"] == 2
+    assert pipe["host_blocked_seconds"] >= 0.0
+    assert pipe["snapshot_write_seconds"] > 0.0
+    r.close()
+
+
+def test_check_async_equivalence_script():
+    """The CI guard itself (scripts/check_async_equivalence.py) passes
+    in-process — pipelined == sequential on the device-dataset path."""
+    import importlib.util
+    import sys as _sys
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "check_async_equivalence.py")
+    spec = importlib.util.spec_from_file_location("_cae", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
