@@ -18,13 +18,29 @@ so group B starts hot the moment A finishes. `--no-overlap` restores
 the serial cold starts for comparison; the JSON record reports the
 hidden setup seconds per group.
 
+Durability (the sweep-durability layer): `--run-dir DIR` makes the run
+survive the scheduler — DIR gets a manifest, a JSONL completion
+journal (one fsynced line per finished group), per-group fault-state
+.npz archives, per-group metrics JSONL, and periodic in-flight group
+checkpoints (`--checkpoint-every`, full SweepRunner.checkpoint: params
++ histories + fault state + quarantine + RNG roots). A SIGTERM or
+SIGINT drains the async pipeline, writes a final checkpoint within
+`--grace-seconds`, and exits with the distinct code 75 (EX_TEMPFAIL =
+"preempted, retry me"). `--resume DIR` then skips every journaled
+group and restores the in-flight one mid-run; the resumed sweep is
+BIT-EXACT against an uninterrupted one
+(scripts/check_resume_equivalence.py is the CI guard).
+
     python examples/gaussian_failure/run_1000_sweep.py \
-        [--configs 1000] [--group 500] [--iters 5000] [--chunk 50]
+        [--configs 1000] [--group 500] [--iters 5000] [--chunk 50] \
+        [--run-dir sweeps/run0]          # durable
+    python examples/gaussian_failure/run_1000_sweep.py --resume sweeps/run0
 """
 import argparse
 import json
 import math
 import os
+import signal
 import sys
 import time
 
@@ -33,6 +49,67 @@ import numpy as np
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.join(HERE, "..", "..")
 sys.path.insert(0, REPO)
+
+#: Exit code of a preempted (SIGTERM/SIGINT) durable run — EX_TEMPFAIL,
+#: the sysexits.h "transient failure, retry" code, distinct from both
+#: success and a crash so schedulers/wrappers can requeue with --resume.
+PREEMPTED_EXIT = 75
+
+#: Manifest keys that pin the run's math; --resume restores them so a
+#: resumed run cannot silently diverge from the original configuration.
+MANIFEST_ARGS = ("configs", "group", "block", "iters", "chunk", "mean",
+                 "std", "pipeline_depth", "solver", "checkpoint_every")
+
+
+def _journal_append(path: str, rec: dict):
+    """One fsynced JSONL line — the journal must survive the very
+    SIGKILL the checkpoint is racing."""
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_journal(path: str):
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    return recs
+
+
+def _ckpt_iter(path: str) -> int:
+    with np.load(path) as z:
+        meta = json.loads(bytes(bytearray(z["__meta__"])).decode())
+    return int(meta["iter"])
+
+
+def _truncate_metrics(path: str, upto_iter: int):
+    """Drop metrics records the restored checkpoint has NOT replayed.
+    A stale periodic checkpoint plus an exhausted grace budget leaves
+    records newer than the saved state; appending after restore would
+    then duplicate the re-run chunks. A chunk record's `iter` is its
+    LAST iteration, so everything >= the checkpoint iteration goes."""
+    if not os.path.exists(path):
+        return
+    kept = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            it = rec.get("iter")
+            if not isinstance(it, int) or it < upto_iter:
+                kept.append(line)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for line in kept:
+            f.write(line + "\n")
+    os.replace(tmp, path)
 
 
 def main(argv=None):
@@ -51,6 +128,10 @@ def main(argv=None):
     p.add_argument("--chunk", type=int, default=50)
     p.add_argument("--mean", type=float, default=1e8)
     p.add_argument("--std", type=float, default=3e7)
+    p.add_argument("--solver", default=(
+        "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt"),
+        help="solver prototxt the per-group Solver is built from "
+             "(failure pattern / seed / display are overridden here)")
     p.add_argument("--pipeline-depth", type=int, default=2,
                    help="in-flight chunks whose host bookkeeping the "
                         "consumer thread hides; 0 = synchronous "
@@ -58,21 +139,67 @@ def main(argv=None):
     p.add_argument("--no-overlap", action="store_true",
                    help="build each group's runner serially instead of "
                         "prefetching group N+1 while group N executes")
+    p.add_argument("--run-dir", default="",
+                   help="durable run directory: manifest + completion "
+                        "journal + per-group fault/metrics files + "
+                        "in-flight checkpoints; SIGTERM/SIGINT then "
+                        "checkpoint-and-exit(75) instead of dying")
+    p.add_argument("--resume", default="",
+                   help="resume a durable run directory: journaled "
+                        "groups are skipped, the in-flight group is "
+                        "restored mid-run (bit-exact vs uninterrupted)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="iterations between periodic in-flight group "
+                        "checkpoints (rounded up to a --chunk "
+                        "multiple); 0 = checkpoint only on preemption")
+    p.add_argument("--grace-seconds", type=float, default=30.0,
+                   help="preemption grace budget: the final checkpoint "
+                        "is only attempted while this much time "
+                        "remains since the signal landed")
     args = p.parse_args(argv)
 
     os.chdir(REPO)
-    from rram_caffe_simulation_tpu.solver import Solver
+    run_dir = os.path.abspath(args.resume or args.run_dir) \
+        if (args.resume or args.run_dir) else ""
+    resuming = bool(args.resume)
+    manifest_path = os.path.join(run_dir, "manifest.json") if run_dir \
+        else ""
+    journal_path = os.path.join(run_dir, "journal.jsonl") if run_dir \
+        else ""
+    if resuming:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for key in MANIFEST_ARGS:
+            setattr(args, key, manifest[key])
+        print(f"Resuming {run_dir}: manifest restored "
+              f"({args.configs} configs, groups of {args.group}, "
+              f"{args.iters} iters)", flush=True)
+
+    from rram_caffe_simulation_tpu.observe import JsonlSink
     from rram_caffe_simulation_tpu.parallel import (GroupPrefetcher,
                                                     SweepRunner)
+    from rram_caffe_simulation_tpu.solver import Solver
     from rram_caffe_simulation_tpu.utils.io import read_solver_param
 
     groups = [args.group] * (args.configs // args.group)
     if args.configs % args.group:
         groups.append(args.configs % args.group)
 
+    # completed groups (journal is append-only and groups run in order,
+    # so the finished set is a prefix); the first incomplete group may
+    # have an in-flight checkpoint to restore
+    done_recs = {}
+    if resuming:
+        for rec in _read_journal(journal_path):
+            if rec.get("event") == "group":
+                done_recs[rec["group"]] = rec
+    frontier = len(done_recs)
+
+    def ckpt_path(gi):
+        return os.path.join(run_dir, f"group_{gi}.ckpt.npz")
+
     def build_runner(gi, n_cfg):
-        param = read_solver_param(
-            "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt")
+        param = read_solver_param(args.solver)
         param.failure_pattern.type = "gaussian"
         param.failure_pattern.mean = args.mean
         param.failure_pattern.std = args.std
@@ -80,6 +207,22 @@ def main(argv=None):
         param.display = 0
         param.ClearField("test_interval")
         solver = Solver(param, compute_dtype="bfloat16")
+        if run_dir:
+            # per-group sweep records (one per chunk, per-config loss
+            # vectors + quarantine ids); the in-flight group resumes
+            # in append mode ONLY when its checkpoint landed — the
+            # pre-preemption records then cover exactly the chunks the
+            # restored state already replayed (no checkpoint = the
+            # group restarts from scratch, so its records must too)
+            # unbuffered: a durable run's records are crash evidence —
+            # they must be on disk when the scheduler's SIGKILL lands,
+            # not sitting in a userspace buffer (one flush per chunk
+            # record is noise next to the chunk's device time)
+            solver.enable_metrics(JsonlSink(
+                os.path.join(run_dir, f"metrics_g{gi}.jsonl"),
+                append=(resuming and gi == frontier
+                        and os.path.exists(ckpt_path(gi))),
+                unbuffered=True))
         # per-group block: groups at or under the block need no
         # blocking (they already fit the activation budget); an
         # indivisible larger remainder falls back to its gcd rather
@@ -94,33 +237,178 @@ def main(argv=None):
                            precompile_chunk=args.chunk,
                            pipeline_depth=args.pipeline_depth)
 
+    # --- preemption handling (durable runs only) ---
+    preempt: dict = {}
+
+    def _on_signal(signum, frame):
+        preempt.setdefault("signal", signal.Signals(signum).name)
+        preempt.setdefault("t", time.monotonic())
+
+    if run_dir:
+        os.makedirs(run_dir, exist_ok=True)
+        if not resuming:
+            with open(manifest_path, "w") as f:
+                json.dump({k: getattr(args, k) for k in MANIFEST_ARGS},
+                          f, indent=2)
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    def _close_runner(runner):
+        logger = runner.solver.metrics_logger
+        runner.close()
+        if logger is not None:
+            logger.close()
+
+    def _preempt_exit(runner, gi):
+        """Grace path: drain, checkpoint the in-flight group, journal
+        the preemption, exit with the distinct 'retry me' code."""
+        left = args.grace_seconds - (time.monotonic() - preempt["t"])
+        wrote = None
+        if runner is not None and left > 0:
+            wrote = runner.checkpoint(ckpt_path(gi))
+        if runner is not None:
+            _close_runner(runner)
+        _journal_append(journal_path, {
+            "event": "preempt", "signal": preempt["signal"],
+            "group": gi,
+            "iter": int(runner.iter) if runner is not None else 0,
+            "checkpoint": os.path.basename(wrote) if wrote else None})
+        print(f"Preempted by {preempt['signal']} in group {gi}"
+              + (f"; checkpoint {wrote}" if wrote
+                 else "; grace budget exhausted, no checkpoint"),
+              flush=True)
+        sys.exit(PREEMPTED_EXIT)
+
+    # checkpoint cadence in iterations, aligned to chunk boundaries so
+    # an interrupted-then-resumed run replays the exact same chunks
+    ck_every = 0
+    if args.checkpoint_every and run_dir:
+        ck_every = max(args.chunk, math.ceil(
+            args.checkpoint_every / max(args.chunk, 1)) * args.chunk)
+    # preemption poll slice: the signal handler only sets a flag, so a
+    # durable run must return from step() at sub-group granularity or
+    # the grace budget expires before the flag is ever read — even with
+    # periodic checkpoints off, poll every few dispatch windows
+    poll_every = ck_every or (args.chunk * 4 if run_dir else 0)
+
     t_total = time.perf_counter()
     done = 0
     blocks_used, overlap_s, host_blocked_s = [], [], []
     prefetch = GroupPrefetcher()
-    runner = build_runner(0, groups[0])
-    for gi, n_cfg in enumerate(groups):
-        if not args.no_overlap and gi + 1 < len(groups):
-            # group B's whole setup (fault draw, placement, dataset,
-            # AOT compile) runs behind group A's execution
-            prefetch.start(build_runner, gi + 1, groups[gi + 1])
-        t0 = time.perf_counter()
-        runner.step(args.iters, chunk=args.chunk)
-        broken = runner.broken_fractions()
-        dt = time.perf_counter() - t0
-        blocks_used.append(runner.config_block)
-        pipe = runner.setup_record().get("pipeline", {})
-        overlap_s.append(round(pipe.get("setup_overlap_seconds", 0.0), 2))
-        host_blocked_s.append(round(pipe.get("host_blocked_seconds",
-                                             0.0), 4))
-        runner.close()
-        done += n_cfg
-        print(f"group {gi}: {n_cfg} configs x {args.iters} iters in "
-              f"{dt / 60:.2f} min (broken mean {broken.mean():.3f}); "
-              f"{done}/{args.configs} done", flush=True)
-        if gi + 1 < len(groups):
-            runner = (build_runner(gi + 1, groups[gi + 1])
-                      if args.no_overlap else prefetch.take())
+    runner = None
+    gi = -1
+    try:
+        for gi, n_cfg in enumerate(groups):
+            if gi in done_recs:
+                rec = done_recs[gi]
+                blocks_used.append(rec.get("config_block", 0))
+                overlap_s.append(rec.get("setup_overlap_seconds", 0.0))
+                host_blocked_s.append(rec.get("host_blocked_seconds",
+                                              0.0))
+                done += n_cfg
+                continue
+            if preempt:
+                # signal landed between groups: the journal is already
+                # consistent, nothing in flight to checkpoint
+                _preempt_exit(None, gi)
+            if runner is None:
+                restoring = (resuming and gi == frontier
+                             and os.path.exists(ckpt_path(gi)))
+                if restoring:
+                    # records beyond the checkpoint would duplicate
+                    # once the restored state re-runs those chunks
+                    _truncate_metrics(
+                        os.path.join(run_dir, f"metrics_g{gi}.jsonl"),
+                        _ckpt_iter(ckpt_path(gi)))
+                runner = build_runner(gi, n_cfg)
+                if restoring:
+                    runner.restore(ckpt_path(gi))
+                    print(f"group {gi}: restored in-flight checkpoint "
+                          f"at iteration {runner.iter}", flush=True)
+            if not args.no_overlap and gi + 1 < len(groups):
+                # group B's whole setup (fault draw, placement, dataset,
+                # AOT compile) runs behind group A's execution
+                prefetch.start(build_runner, gi + 1, groups[gi + 1])
+            t0 = time.perf_counter()
+            loss = None
+            while runner.iter < args.iters:
+                n_it = min(poll_every or args.iters,
+                           args.iters - runner.iter)
+                loss, _ = runner.step(n_it, chunk=args.chunk)
+                if preempt:
+                    _preempt_exit(runner, gi)
+                if ck_every and runner.iter < args.iters:
+                    runner.checkpoint(ckpt_path(gi))
+            if loss is not None:
+                final_loss = [float(x) for x in np.ravel(loss)]
+            elif run_dir:
+                # restored checkpoint already covered every iteration
+                # (preempted at the very end of the group): the final
+                # per-config losses are the last journaled chunk record
+                mrecs = [r for r in _read_journal(os.path.join(
+                             run_dir, f"metrics_g{gi}.jsonl"))
+                         if r.get("type") is None]
+                final_loss = mrecs[-1]["loss"] if mrecs else []
+                if not isinstance(final_loss, list):
+                    final_loss = [final_loss]
+            else:
+                final_loss = []
+            broken = runner.broken_fractions()
+            quarantined = [int(i) for i in runner.quarantined()]
+            dt = time.perf_counter() - t0
+            blocks_used.append(runner.config_block)
+            pipe = runner.setup_record().get("pipeline", {})
+            overlap_s.append(round(pipe.get("setup_overlap_seconds",
+                                            0.0), 2))
+            host_blocked_s.append(round(pipe.get("host_blocked_seconds",
+                                                 0.0), 4))
+            fault_npz = None
+            if run_dir:
+                fault_npz = f"group_{gi}_faults.npz"
+                runner.save_fault_states(
+                    os.path.join(run_dir, fault_npz), background=False)
+            _close_runner(runner)
+            runner = None
+            # NOTE: a signal that landed during finalization is serviced
+            # only AFTER the group's journal line below — exiting first
+            # would discard a fully trained group on resume
+            if run_dir:
+                _journal_append(journal_path, {
+                    "event": "group", "group": gi, "n_configs": n_cfg,
+                    "iters": args.iters,
+                    "config_block": blocks_used[-1],
+                    "loss": final_loss,
+                    "broken_mean": float(broken.mean()),
+                    "quarantine": quarantined,
+                    "fault_npz": fault_npz,
+                    "wall_seconds": round(dt, 3),
+                    "setup_overlap_seconds": overlap_s[-1],
+                    "host_blocked_seconds": host_blocked_s[-1],
+                    "checkpoint_write_seconds": round(pipe.get(
+                        "checkpoint_write_seconds", 0.0), 4)})
+                try:
+                    os.remove(ckpt_path(gi))   # group done; ckpt stale
+                except OSError:
+                    pass
+            done += n_cfg
+            qtail = (f"; quarantined {quarantined}" if quarantined
+                     else "")
+            print(f"group {gi}: {n_cfg} configs x {args.iters} iters in "
+                  f"{dt / 60:.2f} min (broken mean {broken.mean():.3f})"
+                  f"{qtail}; {done}/{args.configs} done", flush=True)
+            if gi + 1 < len(groups) and (gi + 1) not in done_recs:
+                if preempt:
+                    # don't burn grace budget building a group we are
+                    # about to abandon (finally cancels the prefetch)
+                    _preempt_exit(None, gi + 1)
+                runner = (build_runner(gi + 1, groups[gi + 1])
+                          if args.no_overlap else prefetch.take())
+                if preempt:
+                    _preempt_exit(runner, gi + 1)
+    finally:
+        # a raised step / preemption exit must not leak the overlapped
+        # build: join the prefetch thread and close its runner
+        prefetch.cancel()
     total_min = (time.perf_counter() - t_total) / 60
     rec = {
         "configs": args.configs,
@@ -140,7 +428,12 @@ def main(argv=None):
         # seconds across the group's chunk dispatches
         "group_setup_overlap_seconds": overlap_s,
         "host_blocked_seconds": host_blocked_s,
+        "run_dir": run_dir or None,
+        "groups_resumed": len(done_recs),
     }
+    if run_dir:
+        _journal_append(journal_path, {"event": "done",
+                                       "configs": args.configs})
     print(json.dumps(rec), flush=True)
     return rec
 

@@ -181,6 +181,7 @@ class PipelineStats:
         self.consumer_s = 0.0
         self.drain_s = 0.0
         self.snapshot_write_s = 0.0
+        self.checkpoint_write_s = 0.0
         self.setup_overlap_s = 0.0
 
     def record(self) -> dict:
@@ -199,6 +200,12 @@ class PipelineStats:
         if self.snapshot_write_s:
             rec["snapshot_write_seconds"] = round(
                 float(self.snapshot_write_s), 6)
+        if self.checkpoint_write_s:
+            # inline sweep-checkpoint writes (SweepRunner.checkpoint
+            # with background=False) — the durability layer's per-group
+            # overhead, tracked so RESULTS.md can report it
+            rec["checkpoint_write_seconds"] = round(
+                float(self.checkpoint_write_s), 6)
         if self.setup_overlap_s:
             rec["setup_overlap_seconds"] = round(
                 float(self.setup_overlap_s), 6)

@@ -170,6 +170,34 @@ def stuck_zero_flags(state: FaultState, name: str) -> jax.Array:
     return jnp.where((life < 0) & (stuck == 0), 1.0, 0.0)
 
 
+def iter_state_leaves(state: FaultState):
+    """Yield ("group/key", leaf) in the canonical sorted order — the
+    single definition of the flat fault-state layout, shared by the
+    .npz writers (which fetch) and the checkpoint leaf map (which must
+    keep the device arrays)."""
+    for group in sorted(state):
+        for key in sorted(state[group]):
+            yield f"{group}/{key}", state[group][key]
+
+
+def state_to_arrays(state: FaultState) -> Dict[str, np.ndarray]:
+    """Flatten a (possibly config-stacked) fault state to
+    {"group/key": host array} — the .npz layout SweepRunner's
+    save_fault_states and checkpoint() share. The device fetch happens
+    here; `state_from_arrays` is the exact inverse."""
+    return {name: np.asarray(v) for name, v in iter_state_leaves(state)}
+
+
+def state_from_arrays(arrays: Dict[str, np.ndarray]) -> FaultState:
+    """Rebuild the nested fault-state tree from `state_to_arrays`
+    output (host arrays; the caller device-places them)."""
+    state: FaultState = {}
+    for name, arr in arrays.items():
+        group, key = name.split("/", 1)
+        state.setdefault(group, {})[key] = arr
+    return state
+
+
 # ---------------------------------------------------------------------------
 # Checkpointing: the reference never snapshots fault state (SURVEY §5.4 gap);
 # we serialize it as BlobProtos inside a NetParameter-shaped container so the

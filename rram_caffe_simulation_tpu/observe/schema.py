@@ -13,6 +13,7 @@ Top-level record::
      "seed": 1701,                       # first record of a run only
      "grad_norm": 2.1, "update_norm": 0.2,
      "outputs": {"loss": 0.83, "accuracy": 0.71},
+     "quarantine": [2, 7],               # sweep records only, see below
      "fault": {"broken_total": 120, "newly_expired": 7,
                "life_min": -35.0, "life_mean": 9.1e7,
                "writes_saved": 4096,
@@ -26,7 +27,10 @@ resumed run (JSONL append mode) logs its own seed on ITS first record,
 which is the seed that replays the post-resume iterations; everything
 else every record. Under a Monte-Carlo
 sweep the scalar counter fields become per-config lists — `validate_record`
-accepts both shapes.
+accepts both shapes — and `quarantine` (sweep records only, present only
+when non-empty) lists the config indices whose updates the per-config
+NaN/Inf quarantine has frozen: those lanes stopped training at the listed
+membership's onset while the rest of the group continued.
 
 Further record types are keyed by a `"type"` field (records without one
 are the metrics record above): `setup` — one per process cold start,
@@ -87,6 +91,7 @@ TOP_LEVEL = {
     "grad_norm": (_NUM, False),
     "update_norm": (_NUM, False),
     "outputs": (dict, False),
+    "quarantine": (int, False),   # non-empty list of config indices
     "fault": (dict, False),
 }
 
@@ -149,6 +154,7 @@ DEBUG_UPDATE_FIELDS = {
 #               "host_blocked_seconds": 0.021,
 #               "consumer_seconds": 3.4, "drain_seconds": 0.8,
 #               "snapshot_write_seconds": 1.2,
+#               "checkpoint_write_seconds": 0.4,
 #               "setup_overlap_seconds": 12.1}}
 #
 # decode/compile may OVERLAP (SweepRunner precompile_chunk), so the two
@@ -165,8 +171,10 @@ DEBUG_UPDATE_FIELDS = {
 # fetch+sink time when sync, submit backpressure when pipelined);
 # `consumer_seconds` the concurrent consumer work; `drain_seconds`
 # barrier waits; `snapshot_write_seconds` serialize+rename time moved
-# off the hot loop; `setup_overlap_seconds` next-resident-group setup
-# that ran concurrently with the previous group's execution.
+# off the hot loop; `checkpoint_write_seconds` inline sweep-checkpoint
+# writes (the durability layer's per-group overhead);
+# `setup_overlap_seconds` next-resident-group setup that ran
+# concurrently with the previous group's execution.
 
 SETUP_CACHE_STATES = ("hit", "miss", "partial", "disabled", "unused")
 
@@ -195,6 +203,7 @@ PIPELINE_FIELDS = {
     "consumer_seconds": (_NUM, False),
     "drain_seconds": (_NUM, False),
     "snapshot_write_seconds": (_NUM, False),
+    "checkpoint_write_seconds": (_NUM, False),
     "setup_overlap_seconds": (_NUM, False),
 }
 
@@ -351,6 +360,12 @@ def validate_record(rec) -> list:
         for name, v in outs.items():
             if not _check_value(v, _NUM):
                 errs.append(f"outputs[{name!r}]: not a number (or list)")
+    quar = rec.get("quarantine")
+    if quar is not None:
+        vals = quar if isinstance(quar, list) else [quar]
+        if any(isinstance(v, int) and not isinstance(v, bool) and v < 0
+               for v in vals):
+            errs.append("quarantine: config indices must be >= 0")
     fault = rec.get("fault")
     if isinstance(fault, dict):
         errs += _check_fields(fault, FAULT_FIELDS, "fault")

@@ -32,12 +32,15 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
                 smoothed_loss: Optional[float] = None,
                 outputs: Optional[dict] = None,
                 elapsed_s: Optional[float] = None, n_iters: int = 1,
-                seed: Optional[int] = None) -> dict:
+                seed: Optional[int] = None,
+                quarantine=None) -> dict:
     """Assemble one schema-versioned record from the materialized
     on-device metrics plus host-side timing. `elapsed_s` spans the
     `n_iters` iterations since the previous record (the first interval
     includes jit compile time — by design: it is the wall time the user
-    actually waited)."""
+    actually waited). `quarantine` (sweep records) is the list of
+    config indices whose updates the per-config NaN/Inf quarantine has
+    frozen — included only when non-empty."""
     metrics = dict(metrics or {})
     fault = metrics.pop("fault", None)
     rec = {
@@ -60,6 +63,8 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
             rec[key] = metrics.pop(key)
     if outputs:
         rec["outputs"] = dict(outputs)
+    if quarantine:
+        rec["quarantine"] = [int(i) for i in quarantine]
     if fault is not None:
         rec["fault"] = fault
     return rec
@@ -312,6 +317,13 @@ class CaffeLogSink:
             for x in vals:
                 self._emit(f"    Train net output #{j}: {name} = {x:g}")
                 j += 1
+        quar = record.get("quarantine")
+        if quar:
+            # extra line, deliberately shaped unlike any reference line
+            # so parse_log/extract_seconds regexes skip it unchanged
+            ids = quar if isinstance(quar, list) else [quar]
+            self._emit("    Quarantined configs: "
+                       + ", ".join(str(int(i)) for i in ids))
         self._maybe_flush()
 
     def flush(self):

@@ -25,6 +25,10 @@ from .. import cache as _cache
 from ..fault import engine as fault_engine
 from .mesh import make_mesh
 
+#: SweepRunner.checkpoint file format version (bumped on layout changes;
+#: restore() refuses a version it does not understand).
+CHECKPOINT_VERSION = 1
+
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
                        n_configs: int, means=None, stds=None):
@@ -221,8 +225,28 @@ class SweepRunner:
                     lambda a: a.reshape((-1,) + a.shape[2:]), t)
                 p3, h3, f3 = unstk((pf, hf, ff), shp)
                 return p3, h3, f3, join(lf), join(of), join(mf)
+        # Per-config quarantine: the step is wrapped so a config whose
+        # loss goes non-finite (or whose PR-2 sentinels trip, when
+        # tracing is on) has this and every later update frozen by
+        # mask — one diverging config can no longer poison its group.
+        vstep = self._make_quarantine_step(vstep, n_configs,
+                                           self._replicated_sharding())
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._vstep = vstep
+        # host-side quarantine bookkeeping: ids already diagnosed (so a
+        # config is announced once), and the watchdog event the consumer
+        # notes for the dispatcher thread to service (checkpoint/halt —
+        # the consumer cannot drain itself without deadlocking). The
+        # event slot is written by the consumer thread and cleared by
+        # the dispatcher, so it needs the lock.
+        self._quar_seen: set = set()
+        self._watchdog_event = None
+        self._watchdog_lock = threading.Lock()
+        self._stop = False
+        if solver._watchdog is not None:
+            # Solver._process_debug's "snapshot" policy must capture the
+            # SWEEP state, not just the scalar solver's
+            solver._sweep_checkpoint = self._watchdog_checkpoint
         self._chunk_fns = {}
         self._aot_keys = set()
         self._eval_fns = {}
@@ -233,6 +257,12 @@ class SweepRunner:
         # overlapped AOT compile (`precompile_chunk`) can lower against
         # the final param/history/fault shardings while the host decodes
         self._place_state()
+        # per-config quarantine mask, threaded through every dispatch
+        # (replicated: n booleans — the per-leaf freeze masks broadcast
+        # against whatever sharding the state carries)
+        self.quarantine = jax.device_put(
+            jnp.zeros((n_configs,), jnp.bool_),
+            self._replicated_sharding())
         if preload:
             self._preload(precompile_chunk)
         # One feed instance for every host path (chunked or not) so the
@@ -247,6 +277,40 @@ class SweepRunner:
             self._feed = build_feed(solver.net, prefetch=False)
         else:
             self._feed = None
+
+    @staticmethod
+    def _make_quarantine_step(vstep, n: int, mask_sharding):
+        """Wrap the config-vmapped step with the per-config NaN/Inf
+        quarantine. A config whose loss comes back non-finite — or, when
+        debug tracing / the watchdog is on, whose in-jit sentinels
+        (observe/debug.py) trip in any phase — has THIS step's update
+        discarded and every later update frozen by mask: params,
+        history, and fault state all keep their pre-step values while
+        the healthy configs keep training. vmap lanes are independent
+        and a `jnp.where` with a False mask is the identity, so healthy
+        configs' trajectories are bit-identical to a run without the
+        quarantine machinery."""
+        def qstep(params, history, fault, quar, batch, it, rngs, remap):
+            p2, h2, f2, loss, outs, mets = vstep(params, history, fault,
+                                                 batch, it, rngs, remap)
+            bad = quar | ~jnp.isfinite(loss)
+            if isinstance(mets, dict) and "debug" in mets:
+                # sentinel first-bad-entry indices, (n, phases): >= 0
+                # anywhere means the phase tripped for that config
+                first = mets["debug"]["sentinel"]["first"]
+                bad = bad | jnp.any(first >= 0, axis=-1)
+            # pin the mask replicated: the loss it derives from is
+            # config-sharded, and a mask whose sharding drifts between
+            # dispatches would invalidate the compiled executable's
+            # input spec (it is a step input AND output)
+            bad = jax.lax.with_sharding_constraint(bad, mask_sharding)
+            freeze = lambda old, new: jax.tree.map(
+                lambda o, v: jnp.where(
+                    bad.reshape((n,) + (1,) * (v.ndim - 1)), o, v),
+                old, new)
+            return (freeze(params, p2), freeze(history, h2),
+                    freeze(fault, f2), bad, loss, outs, mets)
+        return qstep
 
     def _host_batch(self):
         """One training batch as host arrays, with iter_size sub-batches
@@ -393,7 +457,8 @@ class SweepRunner:
         starts = jax.ShapeDtypeStruct((k,), jnp.int32, sharding=rep)
         remaps = jax.ShapeDtypeStruct((k,), jnp.bool_, sharding=rep)
         compiled = jfn.lower(self.params, self.history, self.fault_states,
-                             ds, its, starts, remaps).compile()
+                             self.quarantine, ds, its, starts,
+                             remaps).compile()
         self._chunk_fns[(k, True)] = compiled
         self._aot_keys.add((k, True))
 
@@ -404,34 +469,35 @@ class SweepRunner:
         finishes, and a refreshed dataset never forces a retrace."""
         n = self.n
 
-        def inner(params, history, fault, batch_t, it_t, remap_t):
+        def inner(params, history, fault, quar, batch_t, it_t, remap_t):
             rngs = jax.vmap(
                 lambda i: jax.random.fold_in(
                     jax.random.fold_in(self.solver._key, it_t), i))(
                         jnp.arange(n))
-            return self._vstep(params, history, fault, batch_t, it_t,
-                               rngs, remap_t)
+            return self._vstep(params, history, fault, quar, batch_t,
+                               it_t, rngs, remap_t)
 
         if not with_dataset:
             def one(carry, xs):
-                params, history, fault = carry
+                params, history, fault, quar = carry
                 batch_t, it_t, remap_t = xs
-                p2, h2, f2, loss, outputs, mets = inner(
-                    params, history, fault, batch_t, it_t, remap_t)
-                return (p2, h2, f2), (loss, outputs, mets)
+                p2, h2, f2, q2, loss, outputs, mets = inner(
+                    params, history, fault, quar, batch_t, it_t, remap_t)
+                return (p2, h2, f2, q2), (loss, outputs, mets)
 
-            def run(params, history, fault, batches, its, remaps):
-                (p, h, f), (losses, outputs, mets) = jax.lax.scan(
-                    one, (params, history, fault),
+            def run(params, history, fault, quar, batches, its, remaps):
+                (p, h, f, q), (losses, outputs, mets) = jax.lax.scan(
+                    one, (params, history, fault, quar),
                     (batches, its, remaps))
-                return p, h, f, losses, outputs, mets
+                return p, h, f, q, losses, outputs, mets
             return run
 
         B, N = self._ds_batch, self._ds_n
 
-        def run(params, history, fault, dataset, its, starts, remaps):
+        def run(params, history, fault, quar, dataset, its, starts,
+                remaps):
             def one(carry, xs):
-                params_, history_, fault_ = carry
+                params_, history_, fault_, quar_ = carry
                 it_t, start_t, remap_t = xs
                 # sequential wrap-around order == the host cursor
                 # feed; start_t = (it*B) % N is computed on the host
@@ -445,13 +511,15 @@ class SweepRunner:
                         name: jax.lax.with_sharding_constraint(
                             v, self._batch_sharding(v.ndim))
                         for name, v in batch_t.items()}
-                p2, h2, f2, loss, outputs, mets = inner(
-                    params_, history_, fault_, batch_t, it_t, remap_t)
-                return (p2, h2, f2), (loss, outputs, mets)
+                p2, h2, f2, q2, loss, outputs, mets = inner(
+                    params_, history_, fault_, quar_, batch_t, it_t,
+                    remap_t)
+                return (p2, h2, f2, q2), (loss, outputs, mets)
 
-            (p, h, f), (losses, outputs, mets) = jax.lax.scan(
-                one, (params, history, fault), (its, starts, remaps))
-            return p, h, f, losses, outputs, mets
+            (p, h, f, q), (losses, outputs, mets) = jax.lax.scan(
+                one, (params, history, fault, quar),
+                (its, starts, remaps))
+            return p, h, f, q, losses, outputs, mets
         return run
 
     def _run_chunk(self, k: int, *args):
@@ -586,7 +654,14 @@ class SweepRunner:
         data = {k: np.array(flat[k]) for k, _ in fc_keys}
         lifetimes = {k: np.asarray(self.fault_states["lifetimes"][k])
                      for k in s._fault_keys}
+        # quarantined lanes are frozen EVERYWHERE, including this host
+        # path — the episodic swap search must not mutate params (or
+        # advance its own RNG/prune-mask state) for a config whose
+        # updates the in-jit mask discards
+        quar = np.asarray(self.quarantine)
         for i, g in enumerate(self._genetics):
+            if quar[i]:
+                continue
             d_i = {k: v[i] for k, v in data.items()}      # views
             diffs_i = {k: np.zeros_like(v) for k, v in d_i.items()}
             life_i = {k: v[i] for k, v in lifetimes.items()}
@@ -614,10 +689,11 @@ class SweepRunner:
         """Host bookkeeping for one dispatched chunk, in exact chunk
         order: materialize losses/outputs/metrics (where the host
         blocks on the device — on the consumer thread when pipelined),
-        refresh the last-result view, and feed the solver's metric
-        sinks one per-chunk record. Runs inline when pipeline_depth=0,
-        on the OrderedConsumer thread when >= 1."""
-        k, last_it, losses, outputs, mets, stacked = item
+        refresh the last-result view, note quarantine transitions, and
+        feed the solver's metric sinks one per-chunk record. Runs
+        inline when pipeline_depth=0, on the OrderedConsumer thread
+        when >= 1."""
+        k, last_it, losses, outputs, mets, stacked, quar = item
         if stacked:
             # slice the last iteration ON DEVICE first: records and the
             # step() return only ever use it, and fetching the whole
@@ -627,6 +703,7 @@ class SweepRunner:
             outputs = jax.tree.map(lambda x: x[-1], outputs)
         self._last_host = (np.asarray(losses),
                            jax.tree.map(np.asarray, outputs))
+        qids = self._note_quarantine(quar, last_it, mets, stacked)
         logger = (self.solver.metrics_logger
                   if self.solver._metrics_enabled else None)
         if logger is None or not mets:
@@ -647,11 +724,90 @@ class SweepRunner:
         self._record_t0 = now
         rec = obs_sink.make_record(iteration=last_it, metrics=host_mets,
                                    outputs=outs, elapsed_s=elapsed,
-                                   n_iters=k)
+                                   n_iters=k, quarantine=qids or None)
         self.pipeline.records += 1
         logger.log(rec)
 
-    def _after_dispatch(self, k, last_it, losses, outputs, mets,
+    def _note_quarantine(self, quar, iteration, mets, stacked):
+        """Materialize the (n,) quarantine mask of one chunk, announce
+        newly quarantined configs by index, and note a watchdog event
+        for the dispatcher thread. Returns the current id list (for the
+        record's `quarantine` field)."""
+        ids = [int(i) for i in np.flatnonzero(np.asarray(quar))]
+        new = [i for i in ids if i not in self._quar_seen]
+        if not new:
+            return ids
+        self._quar_seen.update(new)
+        for i in new:
+            where = self._quarantine_entry(i, mets, stacked)
+            print(f"Sweep quarantine: config {i} went non-finite at "
+                  f"iteration {iteration}{where} — updates frozen, "
+                  "healthy configs keep training", flush=True)
+        if self.solver._watchdog is not None:
+            with self._watchdog_lock:
+                if self._watchdog_event is None:
+                    self._watchdog_event = {
+                        "iter": int(iteration), "configs": new,
+                        "policy": self.solver._watchdog}
+                else:
+                    # coalesce: a not-yet-serviced event absorbs the
+                    # newly tripped configs instead of dropping them
+                    self._watchdog_event["configs"].extend(new)
+        return ids
+
+    def _quarantine_entry(self, i, mets, stacked) -> str:
+        """First-bad-phase/layer attribution for config `i`'s
+        diagnostic, from the chunk's per-config sentinel vectors (debug
+        tracing / watchdog on); "" when tracing is off."""
+        if not mets or "debug" not in mets or self.solver.debug_spec is None:
+            return ""
+        try:
+            host = jax.device_get(mets["debug"])
+            if stacked:
+                host = jax.tree.map(lambda a: np.asarray(a)[-1], host)
+            sl = jax.tree.map(lambda a, _i=i: np.asarray(a)[_i], host)
+            summ = self.solver.debug_spec.sentinel_summary(sl)
+            if summ["tripped"]:
+                return f" ({summ['phase']} phase, {summ['entry']})"
+        except Exception:
+            pass
+        return ""
+
+    def _watchdog_checkpoint(self) -> str:
+        path = (f"{self.solver.param.snapshot_prefix}"
+                f"_sweep_iter_{self.iter}.ckpt.npz")
+        return self.checkpoint(path)
+
+    def _service_watchdog(self) -> bool:
+        """Apply the armed watchdog policy to a quarantine event the
+        bookkeeping path noted: checkpoint the SWEEP state ("snapshot")
+        or stop the whole sweep ("halt"). Runs on the dispatcher
+        thread only — checkpoint() drains the consumer, which would
+        deadlock if called from the consumer itself. Returns True when
+        the sweep should stop."""
+        with self._watchdog_lock:
+            ev, self._watchdog_event = self._watchdog_event, None
+        if ev is None:
+            return self._stop
+        names = ", ".join(str(i) for i in ev["configs"])
+        print(f"Sweep watchdog tripped at iteration {ev['iter']}: "
+              f"config {names} quarantined", flush=True)
+        if ev["policy"] == "snapshot":
+            path = self._watchdog_checkpoint()
+            print(f"Sweep watchdog checkpoint saved to {path}",
+                  flush=True)
+        else:
+            print("Sweep watchdog stopping the sweep.", flush=True)
+            self._stop = True
+        return self._stop
+
+    def quarantined(self) -> np.ndarray:
+        """Ids of quarantined configs (ascending int array). The mask
+        itself is updated inside the jitted chunk; this fetches the
+        (n,) flag vector."""
+        return np.flatnonzero(np.asarray(self.quarantine))
+
+    def _after_dispatch(self, k, last_it, losses, outputs, mets, quar,
                         stacked=True):
         """Hand one dispatched chunk's result handles to the bookkeeping
         path. Pipelined: enqueue and keep dispatching (host_blocked
@@ -660,8 +816,13 @@ class SweepRunner:
         the pipeline is measured against)."""
         self.pipeline.chunks += 1
         if not self._pipeline_on:
+            if self.solver._watchdog is not None:
+                # legacy path has no bookkeeping; an armed watchdog
+                # opts into a tiny (n,) fetch per dispatch so a
+                # quarantined config still triggers the policy
+                self._note_quarantine(quar, last_it, mets, stacked)
             return
-        item = (k, last_it, losses, outputs, mets, stacked)
+        item = (k, last_it, losses, outputs, mets, stacked, quar)
         if self._consumer is not None:
             self.pipeline.host_blocked_s += self._consumer.submit(item)
         else:
@@ -676,6 +837,7 @@ class SweepRunner:
         if self._pipeline_on:
             if self._consumer is not None:
                 self.pipeline.drain_s += self._consumer.drain()
+            self._service_watchdog()
             return self._last_host
         t0 = time.perf_counter()
         if stacked:
@@ -699,6 +861,12 @@ class SweepRunner:
         here on the next call. Results returned are identical bit for
         bit to the sequential path (tests + CI
         scripts/check_async_equivalence.py pin this)."""
+        if self._stop:
+            # a watchdog halt is sticky until restore(): re-entering
+            # step() (the durable driver's sliced loop) must not keep
+            # dispatching one chunk per call
+            return self._last_host if self._last_host is not None \
+                else (None, None)
         if self._consumer is not None:
             self._consumer.check()   # sticky: surface a prior failure
         s = self.solver
@@ -716,17 +884,20 @@ class SweepRunner:
                     self.iter += 1
                 rep = self._replicated_sharding()
                 put = lambda v: jax.device_put(v, rep)
-                (self.params, self.history, self.fault_states, losses,
-                 outputs, mets) = self._run_chunk(
+                (self.params, self.history, self.fault_states,
+                 self.quarantine, losses, outputs,
+                 mets) = self._run_chunk(
                     k, self.params, self.history, self.fault_states,
-                    self._dataset,
+                    self.quarantine, self._dataset,
                     put(jnp.asarray(its, jnp.int32)),
                     put(jnp.asarray(starts, jnp.int32)),
                     put(jnp.asarray(remaps)))
                 self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
                 self._after_dispatch(k, self.iter - 1, losses, outputs,
-                                     mets)
+                                     mets, self.quarantine)
                 done += k
+                if self._service_watchdog():
+                    break
             return self._finish_step(losses, outputs)
         if chunk <= 1:
             for _ in range(iters):
@@ -736,15 +907,17 @@ class SweepRunner:
                     lambda i: jax.random.fold_in(
                         jax.random.fold_in(s._key, self.iter), i))(
                             jnp.arange(self.n))
-                (self.params, self.history, self.fault_states, loss,
-                 outputs, mets) = self._step(self.params, self.history,
-                                             self.fault_states, batch,
-                                             jnp.int32(self.iter), rngs,
-                                             self._remap_due())
+                (self.params, self.history, self.fault_states,
+                 self.quarantine, loss, outputs, mets) = self._step(
+                    self.params, self.history, self.fault_states,
+                    self.quarantine, batch, jnp.int32(self.iter), rngs,
+                    self._remap_due())
                 self.last_metrics = mets
                 self._after_dispatch(1, self.iter, loss, outputs, mets,
-                                     stacked=False)
+                                     self.quarantine, stacked=False)
                 self.iter += 1
+                if self._service_watchdog():
+                    break
             return self._finish_step(loss, outputs, stacked=False)
 
         done = 0
@@ -760,13 +933,17 @@ class SweepRunner:
             batches = self._placed(
                 {kk: np.stack([sb[kk] for sb in subs]) for kk in subs[0]},
                 stacked=True)
-            (self.params, self.history, self.fault_states, losses,
-             outputs, mets) = self._run_chunk(
-                k, self.params, self.history, self.fault_states, batches,
+            (self.params, self.history, self.fault_states,
+             self.quarantine, losses, outputs, mets) = self._run_chunk(
+                k, self.params, self.history, self.fault_states,
+                self.quarantine, batches,
                 jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
             self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
-            self._after_dispatch(k, self.iter - 1, losses, outputs, mets)
+            self._after_dispatch(k, self.iter - 1, losses, outputs, mets,
+                                 self.quarantine)
             done += k
+            if self._service_watchdog():
+                break
         return self._finish_step(losses, outputs)
 
     def save_fault_states(self, path: str, background: bool = True):
@@ -777,10 +954,7 @@ class SweepRunner:
         thread (`background=False` writes inline with the same
         atomicity). `wait_for_writes()` is the barrier; a writer error
         is sticky and re-raises at the next save/wait."""
-        flat = {}
-        for group, tree in self.fault_states.items():
-            for k, v in tree.items():
-                flat[f"{group}/{k}"] = np.asarray(v)   # the fetch
+        flat = fault_engine.state_to_arrays(self.fault_states)
 
         def write(tmp):
             with open(tmp, "wb") as f:
@@ -795,6 +969,171 @@ class SweepRunner:
             async_exec.atomic_write(path, write)
             self._inline_write_s += time.perf_counter() - t0
         return path
+
+    # ------------------------------------------------------------------
+    # sweep durability: full checkpoint / restore (preemption tolerance)
+
+    def _state_arrays(self) -> Dict[str, jax.Array]:
+        """Every resumable device leaf under a flat name: the
+        config-stacked params, solver history banks, fault state
+        (lifetimes / stuck / remap slots), and the quarantine mask.
+        The name set doubles as the restore-compatibility contract."""
+        out = {}
+        for layer, vals in self.params.items():
+            for slot, v in enumerate(vals):
+                if v is not None:
+                    out[f"params/{layer}/{slot}"] = v
+        for key, slots in self.history.items():
+            for sname, v in slots.items():
+                out[f"history/{key}/{sname}"] = v
+        for name, v in fault_engine.iter_state_leaves(self.fault_states):
+            out[f"fault/{name}"] = v
+        out["quarantine"] = self.quarantine
+        return out
+
+    def _set_state_arrays(self, arrays):
+        """Write device-placed leaves back into the live structures
+        (inverse of `_state_arrays`; key sets already validated)."""
+        params = {ln: list(vals) for ln, vals in self.params.items()}
+        for layer, vals in params.items():
+            for slot in range(len(vals)):
+                k = f"params/{layer}/{slot}"
+                if k in arrays:
+                    vals[slot] = arrays[k]
+        self.params = params
+        self.history = {
+            key: {s: arrays[f"history/{key}/{s}"] for s in slots}
+            for key, slots in self.history.items()}
+        self.fault_states = {
+            group: {k: arrays[f"fault/{group}/{k}"] for k in tree}
+            for group, tree in self.fault_states.items()}
+        self.quarantine = arrays["quarantine"]
+
+    def checkpoint(self, path: str, background: bool = False) -> str:
+        """Capture the FULL resumable sweep state to `path` (.npz):
+        stacked params, solver histories, fault state, quarantine mask,
+        iteration, the solver RNG key (per-config stream roots), and
+        genetic-strategy state. The async pipeline is drained to a
+        consistent chunk boundary first and any queued background
+        writes/snapshots land before the capture, so the file is always
+        a clean boundary; the write itself goes through the temp-file +
+        atomic-rename path (on the BackgroundWriter thread with
+        `background=True`), so a crash mid-write can never leave a
+        truncated checkpoint under the final name. `restore(path)` on a
+        runner built with the SAME configuration resumes BIT-EXACTLY
+        (scripts/check_resume_equivalence.py is the CI guard)."""
+        import json as _json
+        import pickle
+        if self._consumer is not None:
+            self.pipeline.drain_s += self._consumer.drain()
+        self.wait_for_writes()
+        self.solver.wait_for_snapshots()
+        arrays = {name: np.asarray(v)
+                  for name, v in self._state_arrays().items()}
+        meta = {"version": CHECKPOINT_VERSION, "iter": int(self.iter),
+                "n_configs": int(self.n),
+                "key": [int(x)
+                        for x in np.asarray(self.solver._key).ravel()],
+                "seed": int(self.solver.seed),
+                "quarantined": sorted(self._quar_seen)}
+        arrays["__meta__"] = np.frombuffer(
+            _json.dumps(meta).encode(), np.uint8)
+        if self._genetics is not None:
+            # per-config episodic search state: own RNG streams +
+            # mutated prune-mask copies (plain numpy-backed objects)
+            arrays["__genetics__"] = np.frombuffer(
+                pickle.dumps(self._genetics), np.uint8)
+
+        def write(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+
+        if background:
+            if self._bg_writer is None:
+                self._bg_writer = async_exec.BackgroundWriter()
+            self._bg_writer.submit(path, write)
+        else:
+            t0 = time.perf_counter()
+            async_exec.atomic_write(path, write)
+            self.pipeline.checkpoint_write_s += time.perf_counter() - t0
+        return path
+
+    def restore(self, path: str):
+        """Load a `checkpoint()` file into this runner. The runner must
+        have been built with the same configuration (n_configs, solver
+        seed, strategy mix) — mismatches raise instead of silently
+        diverging. Takes the background-write and snapshot barriers
+        first, so restoring while a queued checkpoint/snapshot is still
+        in flight can never read a half-landed file. Every leaf is
+        device-placed with the runner's existing sharding, so resume
+        works unchanged under (config, data, model) meshes."""
+        import json as _json
+        import pickle
+        if self._consumer is not None:
+            self.pipeline.drain_s += self._consumer.drain()
+        self.wait_for_writes()
+        self.solver.wait_for_snapshots()
+        with np.load(path) as z:
+            data = {k: z[k] for k in z.files}
+        raw = data.pop("__meta__", None)
+        if raw is None:
+            raise ValueError(f"{path} is not a SweepRunner checkpoint "
+                             "(missing __meta__)")
+        meta = _json.loads(bytes(bytearray(raw)).decode())
+        if meta.get("version") != CHECKPOINT_VERSION:
+            raise ValueError(
+                f"checkpoint {path} has format version "
+                f"{meta.get('version')!r}; this build reads "
+                f"{CHECKPOINT_VERSION}")
+        if int(meta["n_configs"]) != self.n:
+            raise ValueError(
+                f"checkpoint {path} holds {meta['n_configs']} configs "
+                f"but this runner was built with {self.n}")
+        key = [int(x) for x in np.asarray(self.solver._key).ravel()]
+        if list(meta["key"]) != key:
+            raise ValueError(
+                f"checkpoint {path} was taken under a different solver "
+                f"RNG key (seed {meta.get('seed')}); resume with the "
+                "same random_seed / failure_pattern the checkpoint was "
+                "written under, or the replayed iterations would "
+                "silently diverge")
+        gen = data.pop("__genetics__", None)
+        if (gen is None) != (self._genetics is None):
+            raise ValueError(
+                f"checkpoint {path} and this runner disagree on the "
+                "genetic strategy (one has episodic search state, the "
+                "other does not); resume with the same solver strategy "
+                "configuration")
+        current = self._state_arrays()
+        saved, live = set(data), set(current)
+        if saved != live:
+            raise ValueError(
+                f"checkpoint {path} state keys do not match this "
+                f"runner: missing {sorted(live - saved)}, unexpected "
+                f"{sorted(saved - live)}")
+        placed = {}
+        for name, arr in data.items():
+            cur = current[name]
+            if tuple(arr.shape) != tuple(cur.shape):
+                raise ValueError(
+                    f"checkpoint {path}: leaf {name!r} has shape "
+                    f"{tuple(arr.shape)}, expected {tuple(cur.shape)}")
+            placed[name] = jax.device_put(jnp.asarray(arr, cur.dtype),
+                                          cur.sharding)
+        self._set_state_arrays(placed)
+        self.iter = int(meta["iter"])
+        self._quar_seen = {int(i) for i in meta.get("quarantined", [])}
+        if gen is not None:
+            self._genetics = pickle.loads(bytes(bytearray(gen)))
+        self.last_metrics = {}
+        self._last_host = None
+        self._record_t0 = None
+        with self._watchdog_lock:
+            self._watchdog_event = None
+        # a watchdog halt belongs to the abandoned timeline; restoring
+        # an earlier checkpoint must let the sweep run again
+        self._stop = False
+        return self
 
     def wait_for_writes(self):
         """Barrier for background fault-state writes (re-raises the
@@ -937,6 +1276,24 @@ class GroupPrefetcher:
         if pipe is not None:
             pipe.setup_overlap_s += overlap
         return runner
+
+    def cancel(self):
+        """Abandon an in-flight prefetch: join the build thread and
+        CLOSE the runner it produced (its consumer/writer threads and
+        device buffers), so a caller bailing out mid-group — a raised
+        step, a preemption exit — never leaks the overlapped build.
+        Build errors are swallowed (the build was abandoned); no-op
+        when nothing is in flight."""
+        if self._thread is None:
+            return
+        self._thread.join()
+        self._thread = None
+        runner = self._box.get("result")
+        if runner is not None:
+            try:
+                runner.close()
+            except Exception:
+                pass
 
 
 def sequential_sweep(solver_param, configs, iters, eval_iters: int = 0):

@@ -117,6 +117,11 @@ class Solver:
         self.losses: list = []
         self.smoothed_loss = 0.0
         self._requested_action = None
+        # signal-requested boundary snapshot (caffe_cli --sig*_effect
+        # snapshot): a flag SEPARATE from _requested_action so clearing
+        # it after servicing can never race away a concurrent "stop"
+        # set by another signal handler
+        self._snapshot_requested = False
 
         if param.random_seed >= 0:
             seed = param.random_seed
@@ -142,6 +147,10 @@ class Solver:
         # computation even when debug_info is unset ---
         self._watchdog = None      # None | "halt" | "snapshot"
         self.debug_spec = None     # NetDebugSpec once tracing is built
+        # SweepRunner installs its checkpoint() here so the watchdog's
+        # "snapshot" policy captures the SWEEP state (stacked params /
+        # fault state / quarantine), not just this scalar solver's
+        self._sweep_checkpoint = None
 
         # --- nets (InitTrainNet/InitTestNets, solver.cpp:95-230) ---
         net_param = _train_net_param(param)
@@ -807,8 +816,13 @@ class Solver:
               f"(nan={flags['nan']}, inf={flags['inf']}, "
               f"overflow={flags['overflow']})", flush=True)
         if self._watchdog == "snapshot":
-            path = self.snapshot()
-            print(f"Watchdog snapshot saved to {path}", flush=True)
+            if self._sweep_checkpoint is not None:
+                path = self._sweep_checkpoint()
+                print(f"Watchdog sweep checkpoint saved to {path}",
+                      flush=True)
+            else:
+                path = self.snapshot()
+                print(f"Watchdog snapshot saved to {path}", flush=True)
         print("Watchdog stopping optimization.", flush=True)
         self._requested_action = "stop"
         return True
@@ -1138,6 +1152,15 @@ class Solver:
         mlog = self.metrics_logger if track else None
         clock = self._mclock if track else None
         for _ in range(iters):
+            if self._snapshot_requested:
+                # signal-requested snapshot (caffe_cli --sig*_effect
+                # snapshot), deferred to this boundary so it can never
+                # capture torn mid-step state; training continues
+                self._snapshot_requested = False
+                t0 = time.perf_counter()
+                self.snapshot()
+                if track:
+                    clock.exclude(t0)
             if (param.test_interval and
                     self.iter % param.test_interval == 0 and
                     (self.iter > 0 or param.test_initialization)):
@@ -1268,6 +1291,14 @@ class Solver:
         clock = self._mclock if track else None
         done = 0
         while done < iters:
+            if self._snapshot_requested:
+                # signal-requested snapshot, chunk-granular like every
+                # other host-visible action on the fused path
+                self._snapshot_requested = False
+                t0 = time.perf_counter()
+                self.snapshot()
+                if track:
+                    clock.exclude(t0)
             n = min(chunk, iters - done)
             if n not in self._fused_fns:
                 self._fused_fns[n] = make_run(n)

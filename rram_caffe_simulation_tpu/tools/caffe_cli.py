@@ -38,19 +38,33 @@ def device_query(args):
 
 def _install_signal_actions(solver, args):
     """SignalHandler (util/signal_handler.cpp; flags caffe.cpp:51-54):
-    SIGINT/SIGHUP -> stop/snapshot/none."""
+    SIGINT/SIGHUP -> stop/snapshot/none, extended with SIGTERM — the
+    signal preemption schedulers (k8s, Borg, slurm) actually send —
+    whose default `snapshot` effect saves restorable state before the
+    kill escalates."""
     def make(effect):
         def handler(signum, frame):
             if effect == "stop":
                 solver._requested_action = "stop"
             elif effect == "snapshot":
-                solver.snapshot()
+                # deferred: the solver services this flag at the next
+                # iteration/chunk boundary (reference SolverAction
+                # queue semantics) — snapshotting inside the handler
+                # could capture torn state mid-step (params already
+                # advanced, history not yet) or read a donated buffer.
+                # A flag of its own: independent of a concurrent "stop"
+                solver._snapshot_requested = True
         return handler
     if args.sigint_effect != "none":
         signal.signal(signal.SIGINT, make(args.sigint_effect))
     if args.sighup_effect != "none":
         try:
             signal.signal(signal.SIGHUP, make(args.sighup_effect))
+        except (AttributeError, ValueError):
+            pass
+    if args.sigterm_effect != "none":
+        try:
+            signal.signal(signal.SIGTERM, make(args.sigterm_effect))
         except (AttributeError, ValueError):
             pass
 
@@ -624,6 +638,12 @@ def main(argv=None):
                    choices=["stop", "snapshot", "none"])
     p.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
+    p.add_argument("--sigterm-effect", "--sigterm_effect",
+                   default="snapshot", dest="sigterm_effect",
+                   choices=["stop", "snapshot", "none"],
+                   help="train: action on SIGTERM (what preemption "
+                        "schedulers send before SIGKILL); default "
+                        "snapshot so a preempted run stays resumable")
     args = p.parse_args(argv)
     if args.cache_dir or os.environ.get("RRAM_TPU_CACHE_DIR"):
         from ..cache import enable_compilation_cache

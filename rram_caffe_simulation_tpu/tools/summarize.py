@@ -172,6 +172,13 @@ def summarize_metrics(path):
                      f" (incl. compile), steady "
                      f"{float(np.mean(steady)) * 1e3:.2f} ms "
                      f"({1.0 / float(np.mean(steady)):.1f} iters/s)")
+    quar = last.get("quarantine")
+    if quar:
+        ids = quar if isinstance(quar, list) else [quar]
+        lines.append(f"Quarantined configs ({len(ids)}): "
+                     + ", ".join(str(i) for i in ids)
+                     + " (updates frozen by the per-config NaN/Inf "
+                       "quarantine; remaining configs kept training)")
     fault = last.get("fault")
     if isinstance(fault, dict):
         lines.append(

@@ -51,6 +51,22 @@ SAMPLE_GOOD = {
 SAMPLE_BAD = {"schema_version": 1, "iter": -3, "loss": "NaN-ish",
               "fault": {"broken_total": 1.5}}
 
+# a sweep record with quarantined configs (per-config loss vector +
+# the quarantine id list the NaN/Inf quarantine surfaced)
+SAMPLE_GOOD_QUARANTINE = {
+    "schema_version": 1, "iter": 50, "wall_time": 1722700000.0,
+    "loss": [0.83, 0.79, 0.9],
+    "lr": 0.01, "step_latency_s": 0.01, "iters_per_s": 100.0,
+    "quarantine": [2, 7],
+}
+
+SAMPLE_BAD_QUARANTINE = {
+    "schema_version": 1, "iter": 50, "wall_time": 1722700000.0,
+    "loss": 0.83, "lr": 0.01, "step_latency_s": 0.01,
+    "iters_per_s": 100.0,
+    "quarantine": [],        # empty list is an emission bug, not data
+}
+
 # the debug_info deep-trace record types (observe/debug.py)
 SAMPLE_GOOD_DEBUG = {
     "schema_version": 1, "type": "debug_trace", "iter": 3,
@@ -150,6 +166,7 @@ def main(argv=None) -> int:
     if args.sample:
         n_bad = 0
         for name, rec in (("metrics", SAMPLE_GOOD),
+                          ("quarantine", SAMPLE_GOOD_QUARANTINE),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP)):
@@ -160,6 +177,7 @@ def main(argv=None) -> int:
                     print(f"  {e}")
                 return 1
         for name, rec in (("metrics", SAMPLE_BAD),
+                          ("quarantine", SAMPLE_BAD_QUARANTINE),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP)):
@@ -169,7 +187,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (4 good records accepted, 4 bad "
+        print("sample self-check OK (5 good records accepted, 5 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""CI guard for the sweep-durability layer: a preempted-then-resumed
+sweep must be indistinguishable from an uninterrupted one.
+
+Two checks:
+
+1. **Preemption round-trip** (subprocesses): run the multi-group sweep
+   driver (`examples/gaussian_failure/run_1000_sweep.py`) twice against
+   the same tiny generated LMDB — once uninterrupted, once SIGTERMed
+   mid-run (after its first group journals) — asserting the killed run
+   exits with the distinct "preempted" code 75 and leaves a final
+   checkpoint, then `--resume` it and diff EVERYTHING durable:
+
+   * the completion journal's group records (losses, broken census,
+     quarantine ids, config blocks — timing fields excluded),
+   * every per-group metrics JSONL (per-chunk records, order and
+     content, timing fields excluded),
+   * every per-group fault-state .npz (loaded arrays byte-identical).
+
+2. **Quarantine isolation** (in-process): poison one config's params
+   with NaN, run the sweep, and assert that config lands in
+   `quarantine` (mask, records, and `SweepRunner.quarantined()`) while
+   the HEALTHY configs' params / momentum / fault trajectories are
+   byte-identical to a run without the poisoned lane frozen in.
+
+    python scripts/check_resume_equivalence.py
+
+Exit status: 0 = bit-exact resume and isolated quarantine, 1 = any
+divergence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DRIVER = os.path.join(_REPO, "examples", "gaussian_failure",
+                      "run_1000_sweep.py")
+PREEMPTED_EXIT = 75
+# timing fields legitimately differ between runs; everything else in a
+# journal/metrics record must match exactly
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s",
+                 "wall_seconds", "setup_overlap_seconds",
+                 "host_blocked_seconds", "checkpoint_write_seconds")
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _write_solver(path: str, db: str):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+net_param {{
+  name: "resumeguard"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+ITERS = 800
+CKPT_EVERY = 200
+
+
+def _driver_args(solver: str, run_flag: str, run_dir: str):
+    # --no-overlap (deterministic serial builds) + groups long enough
+    # (~seconds) that a SIGTERM sent once group 1 starts emitting chunk
+    # records reliably lands BETWEEN its checkpoint slices — the
+    # mid-group restore path is the one under test
+    return [sys.executable, DRIVER, "--solver", solver,
+            "--configs", "6", "--group", "2", "--block", "0",
+            "--iters", str(ITERS), "--chunk", "50",
+            "--checkpoint-every", str(CKPT_EVERY),
+            "--mean", "300", "--std", "60", "--pipeline-depth", "2",
+            "--no-overlap", run_flag, run_dir]
+
+
+def _read_jsonl(path: str):
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    return recs
+
+
+def _strip(recs):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in recs]
+
+
+def _check_preemption_roundtrip(work: str, failures: list):
+    import numpy as np
+    db = os.path.join(work, "db")
+    solver = os.path.join(work, "solver.prototxt")
+    _build_db(db)
+    _write_solver(solver, db)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    dir_a = os.path.join(work, "run_a")
+    dir_b = os.path.join(work, "run_b")
+
+    # uninterrupted reference
+    r = subprocess.run(_driver_args(solver, "--run-dir", dir_a),
+                       env=env, capture_output=True, text=True)
+    if r.returncode != 0:
+        failures.append(f"uninterrupted run failed ({r.returncode}):\n"
+                        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+
+    # interrupted run: SIGTERM once group 1 is actively stepping (it
+    # has journaled group 0 and emitted chunk records of its own)
+    proc = subprocess.Popen(_driver_args(solver, "--run-dir", dir_b),
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    journal_b = os.path.join(dir_b, "journal.jsonl")
+    metrics_g1 = os.path.join(dir_b, "metrics_g1.jsonl")
+    deadline = time.monotonic() + 300
+    signaled = False
+    while time.monotonic() < deadline and proc.poll() is None:
+        try:
+            started = os.path.getsize(metrics_g1) > 0
+        except OSError:
+            started = False
+        if started and any(rec.get("event") == "group"
+                           for rec in _read_jsonl(journal_b)):
+            proc.send_signal(signal.SIGTERM)
+            signaled = True
+            break
+        time.sleep(0.025)
+    out, _ = proc.communicate(timeout=300)
+    if not signaled:
+        failures.append("never saw group 0 complete; SIGTERM not sent "
+                        f"(rc {proc.returncode}):\n{out[-2000:]}")
+        return
+    if proc.returncode != PREEMPTED_EXIT:
+        failures.append(f"preempted run exited {proc.returncode}, "
+                        f"expected {PREEMPTED_EXIT}:\n{out[-2000:]}")
+        return
+    journal = _read_jsonl(journal_b)
+    preempts = [r for r in journal if r.get("event") == "preempt"]
+    if not preempts:
+        failures.append("preempted run journaled no preempt event")
+        return
+    if preempts[-1].get("checkpoint"):
+        ck = os.path.join(dir_b, preempts[-1]["checkpoint"])
+        if not os.path.exists(ck):
+            failures.append(f"journaled checkpoint {ck} missing on disk")
+
+    # resume to completion
+    r = subprocess.run(_driver_args(solver, "--resume", dir_b),
+                       env=env, capture_output=True, text=True)
+    if r.returncode != 0:
+        failures.append(f"resumed run failed ({r.returncode}):\n"
+                        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+
+    # --- diffs ---
+    groups_a = [r for r in _read_jsonl(os.path.join(dir_a,
+                                                    "journal.jsonl"))
+                if r.get("event") == "group"]
+    groups_b = [r for r in _read_jsonl(journal_b)
+                if r.get("event") == "group"]
+    if len(groups_a) != 3 or len(groups_b) != 3:
+        failures.append(f"journal group counts: uninterrupted "
+                        f"{len(groups_a)}, resumed {len(groups_b)} "
+                        "(expected 3 each)")
+    if _strip(groups_a) != _strip(groups_b):
+        for a, b in zip(_strip(groups_a), _strip(groups_b)):
+            if a != b:
+                failures.append(f"journal group record diverges:\n"
+                                f"  uninterrupted: {a!r}\n"
+                                f"  resumed:       {b!r}")
+    resumed_mid_group = any(
+        rec.get("event") == "preempt" and rec.get("checkpoint")
+        and 0 < rec.get("iter", 0) < ITERS for rec in journal)
+    if not resumed_mid_group:
+        failures.append(
+            "preemption did not land mid-group (no checkpoint with "
+            f"0 < iter < 20 in the journal: {preempts!r}) — the "
+            "mid-group restore path went unexercised")
+
+    for gi in range(3):
+        ma = _read_jsonl(os.path.join(dir_a, f"metrics_g{gi}.jsonl"))
+        mb = _read_jsonl(os.path.join(dir_b, f"metrics_g{gi}.jsonl"))
+        if _strip(ma) != _strip(mb):
+            failures.append(
+                f"metrics_g{gi}.jsonl diverges: {len(ma)} vs {len(mb)} "
+                "records" + ("" if len(ma) != len(mb) else
+                             " (same count, different content)"))
+        if not ma:
+            failures.append(f"metrics_g{gi}.jsonl empty in the "
+                            "uninterrupted run (vacuous diff)")
+        fa = os.path.join(dir_a, f"group_{gi}_faults.npz")
+        fb = os.path.join(dir_b, f"group_{gi}_faults.npz")
+        with np.load(fa) as za, np.load(fb) as zb:
+            if sorted(za.files) != sorted(zb.files):
+                failures.append(f"group {gi} fault npz key sets differ")
+            else:
+                for name in za.files:
+                    if za[name].tobytes() != zb[name].tobytes():
+                        failures.append(
+                            f"group {gi} fault state {name!r} not "
+                            "byte-identical after resume")
+    if not failures:
+        it = preempts[-1].get("iter")
+        print(f"preemption round-trip OK: SIGTERM at group "
+              f"{preempts[-1]['group']} iter {it}, resumed bit-exact "
+              f"({len(groups_a)} groups, "
+              f"{sum(len(_read_jsonl(os.path.join(dir_a, f'metrics_g{g}.jsonl'))) for g in range(3))}"
+              " records compared)")
+
+
+def _check_quarantine(work: str, failures: list):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    db = os.path.join(work, "qdb")
+    _build_db(db)
+
+    def build():
+        sp = pb.SolverParameter()
+        text_format.Parse("""
+        base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+        max_iter: 100 display: 1 random_seed: 3
+        snapshot_prefix: "/tmp/crq"
+        failure_pattern { type: "gaussian" mean: 200.0 std: 40.0 }
+        """, sp)
+        text_format.Parse(f"""
+        name: "quarguard"
+        layer {{ name: "data" type: "Data" top: "data" top: "label"
+          data_param {{ source: "{db}" batch_size: 8 }}
+          transform_param {{ scale: 0.00390625 }} }}
+        layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+          inner_product_param {{ num_output: 4
+            weight_filler {{ type: "xavier" }} }} }}
+        layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+          bottom: "label" top: "loss" }}
+        """, sp.net_param)
+        solver = Solver(sp)
+        records = []
+        solver.enable_metrics(type("S", (), {
+            "write": lambda self, rec: records.append(rec)})())
+        return SweepRunner(solver, n_configs=3, pipeline_depth=0), records
+
+    clean, _ = build()
+    poisoned, records = build()
+    w = np.array(poisoned.params["ip"][0])       # (3, ...) stacked
+    w[1].flat[0] = np.nan
+    poisoned.params["ip"][0] = jnp.asarray(w)
+
+    clean.step(8, chunk=2)
+    poisoned.step(8, chunk=2)
+
+    if poisoned.quarantined().tolist() != [1]:
+        failures.append(f"poisoned config not quarantined: ids = "
+                        f"{poisoned.quarantined().tolist()}")
+    q_fields = [r.get("quarantine") for r in records
+                if r.get("type") is None]
+    if not any(q == [1] for q in q_fields):
+        failures.append(f"no sweep record carried quarantine=[1] "
+                        f"(got {q_fields!r})")
+
+    def lane(tree, i):
+        return [np.asarray(x)[i].tobytes()
+                for x in jax.tree.leaves(tree)]
+
+    for i in (0, 2):
+        for name, a, b in (
+                ("params", clean.solver._flat(clean.params),
+                 poisoned.solver._flat(poisoned.params)),
+                ("history", clean.history, poisoned.history),
+                ("fault state", clean.fault_states,
+                 poisoned.fault_states)):
+            if lane(a, i) != lane(b, i):
+                failures.append(
+                    f"healthy config {i} {name} diverged from the "
+                    "clean run — quarantine is not isolated")
+    # the poisoned lane must actually be frozen: its params stay at the
+    # poisoned values and its momentum never advances off zero (the
+    # very first — already-poisoned — update is discarded too)
+    if not np.isnan(np.asarray(poisoned.params["ip"][0])[1].flat[0]):
+        failures.append("poisoned lane params changed after freeze")
+    if any(bool(np.any(np.asarray(x)[1] != 0))
+           for x in jax.tree.leaves(poisoned.history)):
+        failures.append("quarantined lane's momentum advanced — the "
+                        "freeze leaked an update")
+    clean.close()
+    poisoned.close()
+    if not failures:
+        print("quarantine isolation OK: config 1 frozen + surfaced in "
+              "records; configs 0/2 bit-identical to the clean run")
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="resume_equiv_guard_")
+    failures: list = []
+    try:
+        _check_quarantine(work, failures)
+        _check_preemption_roundtrip(work, failures)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print("resume-equivalence guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,481 @@
+"""Sweep-durability layer (SweepRunner.checkpoint/restore + per-config
+NaN quarantine + watchdog/sweep interaction): an interrupted-then-
+resumed sweep must be bit-identical to an uninterrupted one, a poisoned
+config must freeze without disturbing its group, and the watchdog's
+snapshot policy must capture the SWEEP state and name the offending
+config. The end-to-end SIGTERM path is CI-guarded by
+scripts/check_resume_equivalence.py; these tests pin the in-process
+contracts."""
+import glob
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rram_caffe_simulation_tpu.observe.schema import validate_record
+from rram_caffe_simulation_tpu.parallel import GroupPrefetcher, SweepRunner
+from rram_caffe_simulation_tpu.solver import Solver
+
+from test_fault import fault_solver
+from test_parallel import _genetic_solver_param
+
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s")
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def _strip_timing(records):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in records]
+
+
+def _runner(tmp_path, depth=0, n=3, watchdog=None):
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    if watchdog:
+        s.enable_watchdog(watchdog)
+    sink = ListSink()
+    s.enable_metrics(sink)
+    return SweepRunner(s, n_configs=n, pipeline_depth=depth), sink
+
+
+def _bit_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+def _poison(runner, cfg, key="fc2", slot=0):
+    orig = runner.params[key][slot]
+    w = np.array(orig)
+    w[cfg].flat[0] = np.nan
+    runner.params[key][slot] = jax.device_put(jnp.asarray(w),
+                                              orig.sharding)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / restore
+
+
+def test_checkpoint_restore_bit_exact(tmp_path):
+    """The tentpole contract: run 4 iters, checkpoint, restore into a
+    FRESH runner, run 4 more — losses, params, momentum, fault state,
+    and the emitted record sequence all match the uninterrupted 8-iter
+    run bit for bit."""
+    r_full, sink_full = _runner(tmp_path / "full", depth=0)
+    loss_full, _ = r_full.step(8, chunk=2)
+
+    r_a, sink_a = _runner(tmp_path / "part", depth=0)
+    r_a.step(4, chunk=2)
+    ckpt = r_a.checkpoint(str(tmp_path / "sweep.ckpt.npz"))
+    r_a.close()
+
+    r_b, sink_b = _runner(tmp_path / "resumed", depth=0)
+    r_b.restore(ckpt)
+    assert r_b.iter == 4
+    loss_b, _ = r_b.step(4, chunk=2)
+
+    _bit_equal(loss_full, loss_b)
+    _bit_equal(r_full.solver._flat(r_full.params),
+               r_b.solver._flat(r_b.params))
+    _bit_equal(r_full.history, r_b.history)
+    _bit_equal(r_full.fault_states, r_b.fault_states)
+    _bit_equal(r_full.quarantine, r_b.quarantine)
+    assert _strip_timing(sink_full.records) == \
+        _strip_timing(sink_a.records + sink_b.records)
+    r_full.close()
+    r_b.close()
+
+
+def test_checkpoint_restore_pipelined_drains_first(tmp_path):
+    """checkpoint() under an active consumer thread drains to a chunk
+    boundary first; the pipelined interrupted run still matches the
+    sync uninterrupted one."""
+    r_full, sink_full = _runner(tmp_path / "full", depth=0)
+    loss_full, _ = r_full.step(6, chunk=2)
+
+    r_a, sink_a = _runner(tmp_path / "part", depth=2)
+    r_a.step(2, chunk=2)
+    ckpt = r_a.checkpoint(str(tmp_path / "p.ckpt.npz"))
+    r_a.close()
+    r_b, sink_b = _runner(tmp_path / "res", depth=2)
+    loss_b, _ = r_b.restore(ckpt).step(4, chunk=2)
+
+    _bit_equal(loss_full, loss_b)
+    _bit_equal(r_full.solver._flat(r_full.params),
+               r_b.solver._flat(r_b.params))
+    assert _strip_timing(sink_full.records) == \
+        _strip_timing(sink_a.records + sink_b.records)
+    r_full.close()
+    r_b.close()
+
+
+def test_background_checkpoint_atomic_and_barriered(tmp_path):
+    """background=True routes through the BackgroundWriter; restore()
+    takes the write barrier first, so an immediately following restore
+    can never read a half-landed file, and no temp files survive."""
+    r, _ = _runner(tmp_path, depth=0)
+    r.step(2, chunk=2)
+    path = str(tmp_path / "bg.ckpt.npz")
+    r.checkpoint(path, background=True)
+    r.restore(path)            # barrier: wait_for_writes before read
+    assert r.iter == 2
+    assert os.path.exists(path)
+    assert not glob.glob(path + ".tmp*")
+    r.close()
+
+
+def test_restore_rejects_mismatches(tmp_path):
+    r, _ = _runner(tmp_path / "a", depth=0, n=3)
+    r.step(2, chunk=2)
+    ckpt = r.checkpoint(str(tmp_path / "m.ckpt.npz"))
+    r.close()
+
+    # wrong config count
+    r2, _ = _runner(tmp_path / "b", depth=0, n=2)
+    with pytest.raises(ValueError, match="3 configs"):
+        r2.restore(ckpt)
+    r2.close()
+
+    # wrong seed -> different solver RNG key
+    s = fault_solver(tmp_path / "c", mean=250.0, std=30.0,
+                     random_seed=8)
+    r3 = SweepRunner(s, n_configs=3, pipeline_depth=0)
+    with pytest.raises(ValueError, match="RNG key"):
+        r3.restore(ckpt)
+    r3.close()
+
+    # not a checkpoint at all
+    bogus = str(tmp_path / "bogus.npz")
+    np.savez(bogus, x=np.zeros(3))
+    r4, _ = _runner(tmp_path / "d", depth=0, n=3)
+    with pytest.raises(ValueError, match="__meta__"):
+        r4.restore(bogus)
+    r4.close()
+
+
+def test_genetic_state_rides_the_checkpoint(tmp_path):
+    """Per-config genetic search state (own RNG streams + mutated prune
+    masks) must survive checkpoint/restore: the resumed run's swaps —
+    and therefore its params — stay bit-identical."""
+    def build(sub):
+        d = tmp_path / sub
+        d.mkdir(exist_ok=True)
+        sp = _genetic_solver_param(d)
+        return SweepRunner(Solver(sp), n_configs=2, pipeline_depth=0)
+
+    r_full = build("full")
+    r_full.step(6, chunk=2)
+
+    r_a = build("part")
+    r_a.step(3, chunk=2)
+    ckpt = r_a.checkpoint(str(tmp_path / "g.ckpt.npz"))
+    r_a.close()
+    r_b = build("res")
+    r_b.restore(ckpt)
+    assert [g._rng.get_state()[1].tolist()
+            for g in r_b._genetics] == \
+        [g._rng.get_state()[1].tolist() for g in r_a._genetics]
+    r_b.step(3, chunk=2)
+
+    _bit_equal(r_full.solver._flat(r_full.params),
+               r_b.solver._flat(r_b.params))
+    _bit_equal(r_full.fault_states, r_b.fault_states)
+    for ga, gb in zip(r_full._genetics, r_b._genetics):
+        for wa, wb in zip(ga.prune_weights, gb.prune_weights):
+            np.testing.assert_array_equal(wa, wb)
+    r_full.close()
+    r_b.close()
+
+
+def test_genetic_mismatch_rejected(tmp_path):
+    """A checkpoint with genetic state cannot restore into a runner
+    without it (and vice versa) — the episodic search would silently
+    diverge."""
+    (tmp_path / "g").mkdir(exist_ok=True)
+    sp = _genetic_solver_param(tmp_path / "g")
+    rg = SweepRunner(Solver(sp), n_configs=2, pipeline_depth=0)
+    rg.step(2, chunk=2)
+    ckpt = rg.checkpoint(str(tmp_path / "gm.ckpt.npz"))
+    rg.close()
+    # plain runner, same n_configs — but no genetic strategy: the key
+    # check fires first only if seeds differ, so pin the seed mismatch
+    # out of the way by expecting EITHER targeted error
+    r, _ = _runner(tmp_path / "plain", depth=0, n=2)
+    with pytest.raises(ValueError):
+        r.restore(ckpt)
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# per-config quarantine
+
+
+def test_quarantine_isolates_poisoned_config(tmp_path):
+    """A NaN config is frozen by mask while the healthy configs'
+    trajectories stay bit-identical to a clean run, and the sweep
+    records surface the quarantined ids."""
+    r_clean, _ = _runner(tmp_path / "clean", depth=0)
+    r_clean.step(4, chunk=2)
+
+    r_poi, sink = _runner(tmp_path / "poisoned", depth=0)
+    _poison(r_poi, cfg=1)
+    r_poi.step(4, chunk=2)
+
+    assert r_poi.quarantined().tolist() == [1]
+    assert [r.get("quarantine") for r in sink.records] == [[1], [1]]
+    for rec in sink.records:
+        assert validate_record(rec) == []
+
+    for i in (0, 2):
+        for a, b in ((r_clean.solver._flat(r_clean.params),
+                      r_poi.solver._flat(r_poi.params)),
+                     (r_clean.history, r_poi.history),
+                     (r_clean.fault_states, r_poi.fault_states)):
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                assert np.asarray(x)[i].tobytes() == \
+                    np.asarray(y)[i].tobytes()
+    # the poisoned lane never advances: momentum still all-zero
+    for x in jax.tree.leaves(r_poi.history):
+        assert not np.any(np.asarray(x)[1] != 0)
+    r_clean.close()
+    r_poi.close()
+
+
+def test_quarantine_mask_survives_checkpoint(tmp_path):
+    r, sink = _runner(tmp_path, depth=0)
+    _poison(r, cfg=2)
+    r.step(2, chunk=2)
+    assert r.quarantined().tolist() == [2]
+    ckpt = r.checkpoint(str(tmp_path / "q.ckpt.npz"))
+    r.close()
+    r2, sink2 = _runner(tmp_path / "res", depth=0)
+    r2.restore(ckpt)
+    assert r2.quarantined().tolist() == [2]
+    r2.step(2, chunk=2)
+    # still frozen, still surfaced — but NOT re-announced as new
+    assert r2.quarantined().tolist() == [2]
+    assert [r_.get("quarantine") for r_ in sink2.records] == [[2]]
+    r2.close()
+
+
+def test_quarantine_caffe_sink_and_summarize(tmp_path):
+    """The quarantine field renders in the Caffe text sink (a line the
+    legacy scrapers skip) and in the summarize digest."""
+    import json
+    from rram_caffe_simulation_tpu.observe.sink import (CaffeLogSink,
+                                                        make_record)
+    from rram_caffe_simulation_tpu.tools.summarize import \
+        summarize_metrics
+    rec = make_record(iteration=7, metrics={"loss": [1.0, 2.0]},
+                      quarantine=[0, 2])
+    assert rec["quarantine"] == [0, 2]
+    assert validate_record(rec) == []
+
+    log = str(tmp_path / "run.log")
+    sink = CaffeLogSink(log, unbuffered=True)
+    sink.write(rec)
+    sink.close()
+    text = open(log).read()
+    assert "Quarantined configs: 0, 2" in text
+
+    jl = str(tmp_path / "run.jsonl")
+    with open(jl, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    digest = summarize_metrics(jl)
+    assert "Quarantined configs (2): 0, 2" in digest
+
+
+# ---------------------------------------------------------------------------
+# watchdog x sweep interaction
+
+
+def test_watchdog_snapshot_checkpoints_sweep(tmp_path, capsys):
+    """enable_watchdog('snapshot') under a SweepRunner checkpoints the
+    SWEEP (full .ckpt.npz, restorable) — not just the scalar solver —
+    and the diagnostic names the offending config index and layer."""
+    r, _ = _runner(tmp_path, depth=0, watchdog="snapshot")
+    _poison(r, cfg=2)
+    r.step(2, chunk=1)
+    out = capsys.readouterr().out
+    assert "config 2" in out
+    assert "fc2" in out          # sentinel attribution in the diagnostic
+    assert "Sweep watchdog checkpoint saved to" in out
+    files = glob.glob(str(tmp_path / "snap_sweep_iter_*.ckpt.npz"))
+    assert files, "watchdog wrote no sweep checkpoint"
+    # the run continued: only the poisoned lane is frozen
+    assert r.quarantined().tolist() == [2]
+    assert r.iter == 2
+    r.close()
+
+    r2, _ = _runner(tmp_path / "res", depth=0, watchdog="snapshot")
+    r2.restore(files[0])
+    assert r2.quarantined().tolist() == [2]
+    r2.close()
+
+
+def test_watchdog_halt_stops_sweep(tmp_path, capsys):
+    r, _ = _runner(tmp_path, depth=0, watchdog="halt")
+    _poison(r, cfg=0)
+    r.step(6, chunk=1)
+    assert r.iter < 6
+    out = capsys.readouterr().out
+    assert "config 0" in out
+    assert "stopping the sweep" in out
+    # the halt is STICKY across step() calls (the durable driver loops
+    # step() in slices — re-entry must not dispatch more work)
+    it = r.iter
+    r.step(3, chunk=1)
+    assert r.iter == it
+    r.close()
+
+
+def test_genetic_skips_quarantined_configs(tmp_path):
+    """The episodic host-side genetic search honors the quarantine: a
+    frozen lane's params and its search state (RNG, prune masks) stop
+    advancing at genetic boundaries too."""
+    sp = _genetic_solver_param(tmp_path)
+    r = SweepRunner(Solver(sp), n_configs=2, pipeline_depth=0)
+    wkey = r.solver.fc_pairs[0][0]
+    layer, slot = wkey.rsplit("/", 1)
+    _poison(r, cfg=0, key=layer, slot=int(slot))
+    r.step(2, chunk=1)                   # genetic at iter 0, trip at 0
+    assert r.quarantined().tolist() == [0]
+    lane0 = {k: np.asarray(v)[0].copy()
+             for k, v in r.solver._flat(r.params).items()}
+    rng0 = r._genetics[0]._rng.get_state()[1].copy()
+    r.step(2, chunk=1)                   # genetic boundary at iter 2
+    for k, v in r.solver._flat(r.params).items():
+        assert np.asarray(v)[0].tobytes() == lane0[k].tobytes(), k
+    assert (r._genetics[0]._rng.get_state()[1] == rng0).all()
+    r.close()
+
+
+def test_watchdog_snapshot_legacy_path(tmp_path, capsys):
+    """pipeline_depth=None (no bookkeeping consumer at all): an armed
+    watchdog still sees the quarantine and checkpoints the sweep."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    s.enable_watchdog("snapshot")
+    r = SweepRunner(s, n_configs=3)
+    _poison(r, cfg=1)
+    r.step(2, chunk=1)
+    out = capsys.readouterr().out
+    assert "config 1" in out
+    assert glob.glob(str(tmp_path / "snap_sweep_iter_*.ckpt.npz"))
+    r.close()
+
+
+def test_fault_state_array_roundtrip(tmp_path):
+    """engine.state_to_arrays / state_from_arrays are exact inverses —
+    the shared .npz layout of save_fault_states and checkpoint()."""
+    from rram_caffe_simulation_tpu.fault import engine
+    r, _ = _runner(tmp_path, depth=0)
+    r.step(2, chunk=2)
+    path = r.save_fault_states(str(tmp_path / "f.npz"),
+                               background=False)
+    with np.load(path) as z:
+        state = engine.state_from_arrays({k: z[k] for k in z.files})
+    _bit_equal(state, r.fault_states)
+    r.close()
+
+
+def test_solver_restore_waits_for_inflight_snapshot(tmp_path,
+                                                    monkeypatch):
+    """Solver.restore() takes the wait_for_snapshots() barrier BEFORE
+    reading files: restoring while a queued background snapshot is
+    still being written can never read a half-landed set."""
+    import time
+    from rram_caffe_simulation_tpu import async_exec
+
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    s.enable_background_snapshots()
+    s.step(2)
+    real = async_exec.atomic_write
+
+    def slow_write(path, fn):
+        time.sleep(0.3)
+        real(path, fn)
+
+    monkeypatch.setattr(async_exec, "atomic_write", slow_write)
+    state = s.snapshot_filename(".solverstate")
+    s.snapshot()                      # queued; files land ~0.3s later
+    assert not os.path.exists(state)  # genuinely still in flight
+    s.restore(state)                  # barrier, then read
+    assert s.iter == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI signal actions
+
+
+def test_cli_installs_sigterm_action():
+    """caffe_cli handles SIGTERM (what preemption schedulers send), not
+    just SIGINT/SIGHUP; --sigterm-effect stop/snapshot/none mirrors the
+    existing flags."""
+    import signal as _signal
+    from rram_caffe_simulation_tpu.tools import caffe_cli
+
+    class FakeSolver:
+        _requested_action = None
+        _snapshot_requested = False
+        snapshots = 0
+
+        def snapshot(self):
+            self.snapshots += 1
+
+    args = type("A", (), {"sigint_effect": "none",
+                          "sighup_effect": "none",
+                          "sigterm_effect": "snapshot"})()
+    solver = FakeSolver()
+    old = _signal.getsignal(_signal.SIGTERM)
+    try:
+        # snapshot is DEFERRED (a flag of its own, serviced at the next
+        # loop boundary), never taken inside the handler where it could
+        # capture torn mid-step state
+        caffe_cli._install_signal_actions(solver, args)
+        os.kill(os.getpid(), _signal.SIGTERM)
+        assert solver._snapshot_requested is True
+        assert solver.snapshots == 0
+
+        # an independent "stop" coexists — neither request can race
+        # the other away (separate attributes)
+        args.sigterm_effect = "stop"
+        caffe_cli._install_signal_actions(solver, args)
+        os.kill(os.getpid(), _signal.SIGTERM)
+        assert solver._requested_action == "stop"
+        assert solver._snapshot_requested is True
+    finally:
+        _signal.signal(_signal.SIGTERM, old)
+
+
+# ---------------------------------------------------------------------------
+# prefetch lifecycle
+
+
+def test_prefetch_cancel_closes_runner(tmp_path):
+    """cancel() joins the in-flight build and closes the runner it
+    produced — the mid-group failure path must not leak the consumer
+    thread (satellite: run_1000_sweep try/finally)."""
+    pf = GroupPrefetcher()
+    pf.start(lambda: _runner(tmp_path, depth=2)[0])
+    pf.cancel()
+    assert pf._thread is None
+    built = pf._box.get("result")
+    assert built is not None
+    assert built._consumer._thread is None   # close() stopped it
+
+    # a failed build cancels silently (the build was abandoned)
+    pf.start(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    pf.cancel()
+    assert pf._thread is None
+
+    # cancel with nothing in flight is a no-op
+    pf.cancel()
