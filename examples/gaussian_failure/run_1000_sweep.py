@@ -23,13 +23,26 @@ survive the scheduler — DIR gets a manifest, a JSONL completion
 journal (one fsynced line per finished group), per-group fault-state
 .npz archives, per-group metrics JSONL, and periodic in-flight group
 checkpoints (`--checkpoint-every`, full SweepRunner.checkpoint: params
-+ histories + fault state + quarantine + RNG roots). A SIGTERM or
-SIGINT drains the async pipeline, writes a final checkpoint within
-`--grace-seconds`, and exits with the distinct code 75 (EX_TEMPFAIL =
-"preempted, retry me"). `--resume DIR` then skips every journaled
-group and restores the in-flight one mid-run; the resumed sweep is
-BIT-EXACT against an uninterrupted one
++ histories + fault state + quarantine + RNG roots + the self-healing
+work queue). A SIGTERM or SIGINT drains the async pipeline, writes a
+final checkpoint within `--grace-seconds`, and exits with the distinct
+code 75 (EX_TEMPFAIL = "preempted, retry me"). `--resume DIR` then
+skips every journaled group and restores the in-flight one mid-run;
+the resumed sweep is BIT-EXACT against an uninterrupted one
 (scripts/check_resume_equivalence.py is the CI guard).
+
+Self-healing (the completion contract): every group runs with
+SweepRunner.enable_self_healing — a config whose lane goes NaN has its
+attempt voided and is retried (`--max-retries`, `--retry-backoff`
+iterations of escalating backoff; recovery restores the config's last
+good checkpointed slice when one exists, else re-initializes fresh) in
+a reclaimed lane, so the run ENDS only when every requested config is
+`completed` or `failed` with a triage diagnosis. The final ledger is
+written to `<run-dir>/sweep_report.json` and the exit code is the
+contract: 0 = every config completed, 65 (EX_DATAERR) = some configs
+permanently failed (partial results, diagnoses in the report), 75
+(EX_TEMPFAIL) = preempted or stalled mid-run (resume me).
+`scripts/check_lane_reclamation.py` is the CI guard.
 
     python examples/gaussian_failure/run_1000_sweep.py \
         [--configs 1000] [--group 500] [--iters 5000] [--chunk 50] \
@@ -40,6 +53,7 @@ import argparse
 import json
 import math
 import os
+import shutil
 import signal
 import sys
 import time
@@ -50,15 +64,23 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.join(HERE, "..", "..")
 sys.path.insert(0, REPO)
 
-#: Exit code of a preempted (SIGTERM/SIGINT) durable run — EX_TEMPFAIL,
-#: the sysexits.h "transient failure, retry" code, distinct from both
-#: success and a crash so schedulers/wrappers can requeue with --resume.
+#: Exit code of a preempted (SIGTERM/SIGINT) or stalled durable run —
+#: EX_TEMPFAIL, the sysexits.h "transient failure, retry" code, distinct
+#: from both success and a crash so schedulers/wrappers can requeue with
+#: --resume.
 PREEMPTED_EXIT = 75
+
+#: Exit code of a run that FINISHED but with permanently failed configs
+#: (retry budget exhausted) — EX_DATAERR: the results are partial and
+#: sweep_report.json carries a per-config triage diagnosis. Monte-Carlo
+#: statistics built from this run must account for the failed draws.
+PARTIAL_EXIT = 65
 
 #: Manifest keys that pin the run's math; --resume restores them so a
 #: resumed run cannot silently diverge from the original configuration.
 MANIFEST_ARGS = ("configs", "group", "block", "iters", "chunk", "mean",
-                 "std", "pipeline_depth", "solver", "checkpoint_every")
+                 "std", "pipeline_depth", "solver", "checkpoint_every",
+                 "max_retries", "retry_backoff")
 
 
 def _journal_append(path: str, rec: dict):
@@ -156,6 +178,27 @@ def main(argv=None):
                    help="preemption grace budget: the final checkpoint "
                         "is only attempted while this much time "
                         "remains since the signal landed")
+    p.add_argument("--max-retries", type=int, default=1,
+                   help="per-config retry budget: how many times a "
+                        "quarantined (NaN) config is re-seeded into a "
+                        "reclaimed lane before it is permanently "
+                        "failed with a diagnosis")
+    p.add_argument("--retry-backoff", type=int, default=0,
+                   help="iteration backoff per retry: attempt k waits "
+                        "k * this many iterations before its lane is "
+                        "re-seeded (escalating)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="seconds of consumer-heartbeat silence before "
+                        "a stalled chunk aborts the run with a "
+                        "best-effort checkpoint and exit 75 instead of "
+                        "hanging; 0 = disabled")
+    p.add_argument("--inject-nan", default="",
+                   help="TEST HOOK (check_lane_reclamation.py): "
+                        "'CFG@ITER' poisons global config CFG's params "
+                        "with NaN at the first step boundary at/after "
+                        "iteration ITER; append ':always' to re-poison "
+                        "every attempt (exercises the permanent-"
+                        "failure path)")
     args = p.parse_args(argv)
 
     os.chdir(REPO)
@@ -170,7 +213,10 @@ def main(argv=None):
         with open(manifest_path) as f:
             manifest = json.load(f)
         for key in MANIFEST_ARGS:
-            setattr(args, key, manifest[key])
+            # .get: manifests written before a flag existed resume with
+            # the current default (e.g. pre-self-healing run dirs have
+            # no max_retries/retry_backoff)
+            setattr(args, key, manifest.get(key, getattr(args, key)))
         print(f"Resuming {run_dir}: manifest restored "
               f"({args.configs} configs, groups of {args.group}, "
               f"{args.iters} iters)", flush=True)
@@ -233,9 +279,106 @@ def main(argv=None):
             block = args.block
         else:
             block = math.gcd(n_cfg, args.block)
-        return SweepRunner(solver, n_configs=n_cfg, config_block=block,
-                           precompile_chunk=args.chunk,
-                           pipeline_depth=args.pipeline_depth)
+        runner = SweepRunner(solver, n_configs=n_cfg, config_block=block,
+                             precompile_chunk=args.chunk,
+                             pipeline_depth=args.pipeline_depth,
+                             stall_timeout_s=args.stall_timeout or None)
+        # the completion contract: every config trains for --iters
+        # iterations or fails with a diagnosis after its retry budget;
+        # quarantined lanes are reclaimed and re-seeded at chunk
+        # boundaries instead of burning compute as frozen masks
+        runner.enable_self_healing(budget=args.iters,
+                                   max_retries=args.max_retries,
+                                   backoff_iters=args.retry_backoff)
+        return runner
+
+    # --- the completion-contract ledger (sweep_report.json) ---
+    # global config id -> terminal/pending entry; groups contribute
+    # their local reports offset by the configs before them
+    offsets = [0]
+    for n_cfg in groups[:-1]:
+        offsets.append(offsets[-1] + n_cfg)
+    ledger: dict = {}
+
+    def _merge_report(gi, report):
+        off = offsets[gi]
+        for cs, v in (report.get("completed") or {}).items():
+            ledger[off + int(cs)] = dict(v, group=gi)
+        for cs, v in (report.get("failed") or {}).items():
+            ledger[off + int(cs)] = dict(v, group=gi)
+        for cs, v in (report.get("active") or {}).items():
+            ledger[off + int(cs)] = dict(v, group=gi, status="pending")
+        for e in report.get("pending") or []:
+            ledger[off + int(e["config"])] = {
+                "status": "pending", "group": gi,
+                "attempt": int(e["attempt"])}
+
+    def _write_report(status: str, exit_code: int) -> dict:
+        """Assemble (and, for durable runs, write) the sweep completion
+        report: every requested config accounted for as completed /
+        failed / pending."""
+        for c in range(args.configs):
+            # configs of groups never started (preempted early) are
+            # still accounted for: the contract names every one
+            ledger.setdefault(c, {"status": "pending"})
+        n_done = sum(1 for v in ledger.values()
+                     if v.get("status") == "completed")
+        failed = sorted(c for c, v in ledger.items()
+                        if v.get("status") == "failed")
+        retried = sorted(
+            c for c, v in ledger.items()
+            if int(v.get("attempts", v.get("attempt", 1)) or 1) > 1)
+        report = {
+            "schema_version": 1,
+            "status": status, "exit_code": exit_code,
+            "requested": args.configs,
+            "completed": n_done, "failed": failed, "retried": retried,
+            "max_retries": args.max_retries,
+            "retry_backoff": args.retry_backoff,
+            "configs": {str(c): ledger[c] for c in sorted(ledger)},
+        }
+        if run_dir:
+            path = os.path.join(run_dir, "sweep_report.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=2)
+            os.replace(tmp, path)
+        return report
+
+    # --- deterministic NaN injection (CI test hook) ---
+    inject = None
+    if args.inject_nan:
+        spec = args.inject_nan
+        always = spec.endswith(":always")
+        body = spec[:-len(":always")] if always else spec
+        cfg_s, it_s = body.split("@")
+        inject = {"config": int(cfg_s), "iter": int(it_s),
+                  "always": always, "done": False}
+
+    def _maybe_inject(runner, gi):
+        """Poison the injected config's lane params with NaN once it is
+        resident and the target iteration has been reached (a step
+        boundary — deterministic for a fixed poll cadence)."""
+        if inject is None or (inject["done"] and not inject["always"]):
+            return
+        local = inject["config"] - offsets[gi]
+        if not (0 <= local < runner.n) or runner.iter < inject["iter"]:
+            return
+        lane = runner.config_report()["active"].get(local, {}).get("lane")
+        if lane is None:
+            return
+        import jax
+        import jax.numpy as jnp
+        key = runner.solver._fault_keys[0]
+        layer, slot = key.rsplit("/", 1)
+        orig = runner.params[layer][int(slot)]
+        w = np.array(orig)
+        w[lane].flat[0] = np.nan
+        runner.params[layer][int(slot)] = jax.device_put(
+            jnp.asarray(w), orig.sharding)
+        inject["done"] = True
+        print(f"Injected NaN into config {inject['config']} "
+              f"(lane {lane}) at iteration {runner.iter}", flush=True)
 
     # --- preemption handling (durable runs only) ---
     preempt: dict = {}
@@ -261,23 +404,53 @@ def main(argv=None):
 
     def _preempt_exit(runner, gi):
         """Grace path: drain, checkpoint the in-flight group, journal
-        the preemption, exit with the distinct 'retry me' code."""
+        the preemption, exit with the distinct 'retry me' code. The
+        sweep report is written too (status "preempted") so partial
+        progress is inspectable while the run waits for its retry."""
         left = args.grace_seconds - (time.monotonic() - preempt["t"])
         wrote = None
         if runner is not None and left > 0:
             wrote = runner.checkpoint(ckpt_path(gi))
         if runner is not None:
+            _merge_report(gi, runner.config_report())
             _close_runner(runner)
         _journal_append(journal_path, {
             "event": "preempt", "signal": preempt["signal"],
             "group": gi,
             "iter": int(runner.iter) if runner is not None else 0,
             "checkpoint": os.path.basename(wrote) if wrote else None})
+        _write_report("preempted", PREEMPTED_EXIT)
         print(f"Preempted by {preempt['signal']} in group {gi}"
               + (f"; checkpoint {wrote}" if wrote
                  else "; grace budget exhausted, no checkpoint"),
               flush=True)
         sys.exit(PREEMPTED_EXIT)
+
+    def _stall_exit(err, runner, gi):
+        """A chunk's bookkeeping stalled past --stall-timeout: the
+        runner already wrote a best-effort emergency checkpoint; move
+        it into the run dir so --resume restores mid-group, journal the
+        stall, and exit with the 'retry me' code."""
+        wrote = None
+        if run_dir and getattr(err, "checkpoint_path", None) \
+                and os.path.exists(err.checkpoint_path):
+            shutil.move(err.checkpoint_path, ckpt_path(gi))
+            wrote = ckpt_path(gi)
+        if runner is not None:
+            _merge_report(gi, runner.config_report())
+        if run_dir:
+            _journal_append(journal_path, {
+                "event": "stall", "group": gi,
+                "iter": int(runner.iter) if runner is not None else 0,
+                "checkpoint": os.path.basename(wrote) if wrote else None})
+            _write_report("preempted", PREEMPTED_EXIT)
+            print(f"Stalled in group {gi}: {err}"
+                  + (f"; checkpoint {wrote}" if wrote else ""),
+                  flush=True)
+            # the consumer thread is stuck: skip the close barriers and
+            # let the daemon threads die with the process
+            sys.exit(PREEMPTED_EXIT)
+        raise err
 
     # checkpoint cadence in iterations, aligned to chunk boundaries so
     # an interrupted-then-resumed run replays the exact same chunks
@@ -291,13 +464,17 @@ def main(argv=None):
     # periodic checkpoints off, poll every few dispatch windows
     poll_every = ck_every or (args.chunk * 4 if run_dir else 0)
 
+    from rram_caffe_simulation_tpu.async_exec import StallError
+
     t_total = time.perf_counter()
     done = 0
     blocks_used, overlap_s, host_blocked_s = [], [], []
-    prefetch = GroupPrefetcher()
     runner = None
     gi = -1
-    try:
+    # the prefetcher is a context manager: leaving the block (a raised
+    # step, a preemption sys.exit) cancels any in-flight build instead
+    # of leaking its consumer threads
+    with GroupPrefetcher() as prefetch:
         for gi, n_cfg in enumerate(groups):
             if gi in done_recs:
                 rec = done_recs[gi]
@@ -305,6 +482,20 @@ def main(argv=None):
                 overlap_s.append(rec.get("setup_overlap_seconds", 0.0))
                 host_blocked_s.append(rec.get("host_blocked_seconds",
                                               0.0))
+                rep = rec.get("report")
+                if rep:
+                    _merge_report(gi, {"completed": rep.get("completed",
+                                                            {}),
+                                       "failed": rep.get("failed", {})})
+                else:
+                    # legacy journal (pre-report): the group finished,
+                    # so every config counts as completed first-try
+                    losses = rec.get("loss") or []
+                    _merge_report(gi, {"completed": {
+                        str(i): {"status": "completed", "attempts": 1,
+                                 "loss": (losses[i] if i < len(losses)
+                                          else None)}
+                        for i in range(n_cfg)}})
                 done += n_cfg
                 continue
             if preempt:
@@ -330,31 +521,59 @@ def main(argv=None):
                 # AOT compile) runs behind group A's execution
                 prefetch.start(build_runner, gi + 1, groups[gi + 1])
             t0 = time.perf_counter()
-            loss = None
-            while runner.iter < args.iters:
-                n_it = min(poll_every or args.iters,
-                           args.iters - runner.iter)
-                loss, _ = runner.step(n_it, chunk=args.chunk)
-                if preempt:
-                    _preempt_exit(runner, gi)
-                if ck_every and runner.iter < args.iters:
-                    runner.checkpoint(ckpt_path(gi))
-            if loss is not None:
-                final_loss = [float(x) for x in np.ravel(loss)]
-            elif run_dir:
+            # completion contract: the group ends only when every one
+            # of its configs is completed (budget trained, possibly
+            # after retries in reclaimed lanes) or failed-with-diagnosis
+            try:
+                while not runner.healing_complete():
+                    _maybe_inject(runner, gi)
+                    runner.step(poll_every or args.iters,
+                                chunk=args.chunk)
+                    if preempt:
+                        _preempt_exit(runner, gi)
+                    if ck_every and not runner.healing_complete():
+                        runner.checkpoint(ckpt_path(gi))
+            except StallError as e:
+                _stall_exit(e, runner, gi)
+            report = runner.config_report()
+            completed, failed = report["completed"], report["failed"]
+            if run_dir and any(v.get("loss") is None
+                               for v in completed.values()):
                 # restored checkpoint already covered every iteration
                 # (preempted at the very end of the group): the final
                 # per-config losses are the last journaled chunk record
                 mrecs = [r for r in _read_journal(os.path.join(
                              run_dir, f"metrics_g{gi}.jsonl"))
                          if r.get("type") is None]
-                final_loss = mrecs[-1]["loss"] if mrecs else []
-                if not isinstance(final_loss, list):
-                    final_loss = [final_loss]
-            else:
-                final_loss = []
-            broken = runner.broken_fractions()
-            quarantined = [int(i) for i in runner.quarantined()]
+                for c, v in completed.items():
+                    lane = v.get("lane")
+                    if v.get("loss") is not None or lane is None:
+                        continue
+                    # take the LAST record in which this config still
+                    # occupied its harvest lane — a lane refilled after
+                    # the config completed carries another config's
+                    # trajectory in later records
+                    for r in reversed(mrecs):
+                        lm = r.get("lane_map")
+                        if lm is not None and (lane >= len(lm)
+                                               or lm[lane] != int(c)):
+                            continue
+                        lv = r.get("loss")
+                        lv = lv if isinstance(lv, list) else [lv]
+                        if lane < len(lv):
+                            v["loss"] = lv[lane]
+                        break
+            final_loss = [completed.get(c, {}).get("loss")
+                          for c in range(n_cfg)]
+            failed_ids = sorted(failed)
+            retried = sorted(c for c, v in {**completed,
+                                            **failed}.items()
+                             if int(v.get("attempts", 1)) > 1)
+            broken_vals = [v.get("broken") for v in completed.values()
+                           if v.get("broken") is not None]
+            broken_mean = (float(np.mean(broken_vals)) if broken_vals
+                           else float(runner.broken_fractions().mean()))
+            _merge_report(gi, report)
             dt = time.perf_counter() - t0
             blocks_used.append(runner.config_block)
             pipe = runner.setup_record().get("pipeline", {})
@@ -378,8 +597,12 @@ def main(argv=None):
                     "iters": args.iters,
                     "config_block": blocks_used[-1],
                     "loss": final_loss,
-                    "broken_mean": float(broken.mean()),
-                    "quarantine": quarantined,
+                    "broken_mean": broken_mean,
+                    "quarantine": failed_ids,
+                    "report": {
+                        "completed": {str(c): v
+                                      for c, v in completed.items()},
+                        "failed": {str(c): v for c, v in failed.items()}},
                     "fault_npz": fault_npz,
                     "wall_seconds": round(dt, 3),
                     "setup_overlap_seconds": overlap_s[-1],
@@ -391,25 +614,30 @@ def main(argv=None):
                 except OSError:
                     pass
             done += n_cfg
-            qtail = (f"; quarantined {quarantined}" if quarantined
-                     else "")
+            tail = ""
+            if retried:
+                tail += f"; retried {retried}"
+            if failed_ids:
+                tail += f"; failed {failed_ids}"
             print(f"group {gi}: {n_cfg} configs x {args.iters} iters in "
-                  f"{dt / 60:.2f} min (broken mean {broken.mean():.3f})"
-                  f"{qtail}; {done}/{args.configs} done", flush=True)
+                  f"{dt / 60:.2f} min (broken mean {broken_mean:.3f})"
+                  f"{tail}; {done}/{args.configs} done", flush=True)
             if gi + 1 < len(groups) and (gi + 1) not in done_recs:
                 if preempt:
                     # don't burn grace budget building a group we are
-                    # about to abandon (finally cancels the prefetch)
+                    # about to abandon (the with-block cancels the
+                    # prefetch)
                     _preempt_exit(None, gi + 1)
                 runner = (build_runner(gi + 1, groups[gi + 1])
                           if args.no_overlap else prefetch.take())
                 if preempt:
                     _preempt_exit(runner, gi + 1)
-    finally:
-        # a raised step / preemption exit must not leak the overlapped
-        # build: join the prefetch thread and close its runner
-        prefetch.cancel()
     total_min = (time.perf_counter() - t_total) / 60
+    n_failed = sum(1 for v in ledger.values()
+                   if v.get("status") == "failed")
+    status = "partial" if n_failed else "clean"
+    exit_code = PARTIAL_EXIT if n_failed else 0
+    sweep_report = _write_report(status, exit_code)
     rec = {
         "configs": args.configs,
         "iters_per_config": args.iters,
@@ -430,11 +658,20 @@ def main(argv=None):
         "host_blocked_seconds": host_blocked_s,
         "run_dir": run_dir or None,
         "groups_resumed": len(done_recs),
+        # the completion contract's summary (full per-config ledger in
+        # <run-dir>/sweep_report.json for durable runs)
+        "status": status,
+        "completed_configs": sweep_report["completed"],
+        "failed_configs": sweep_report["failed"],
+        "retried_configs": sweep_report["retried"],
     }
     if run_dir:
         _journal_append(journal_path, {"event": "done",
-                                       "configs": args.configs})
+                                       "configs": args.configs,
+                                       "status": status})
     print(json.dumps(rec), flush=True)
+    if exit_code:
+        sys.exit(exit_code)
     return rec
 
 

@@ -39,6 +39,20 @@ import time
 from typing import Callable, Optional
 
 
+class StallError(RuntimeError):
+    """The consumer thread stopped making progress while work was
+    pending (its heartbeat went stale past the stall timeout): a sink
+    blocked on a dead filesystem, a wedged device fetch — anything that
+    would otherwise hang `submit`/`drain` forever. The sweep layer
+    catches this to write a best-effort checkpoint before aborting
+    instead of hanging the whole run; `checkpoint_path` carries that
+    checkpoint's location when one was written."""
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+
+
 class OrderedConsumer:
     """Bounded-queue consumer thread with in-order processing and sticky
     error propagation (the PrefetchingFeed pattern, consumer-side).
@@ -53,7 +67,8 @@ class OrderedConsumer:
     call re-raises the original failure."""
 
     def __init__(self, fn: Callable, depth: int = 2,
-                 name: str = "chunk-consumer"):
+                 name: str = "chunk-consumer",
+                 stall_timeout: Optional[float] = None):
         self._fn = fn
         self._depth = max(int(depth), 1)
         self._name = name
@@ -61,15 +76,40 @@ class OrderedConsumer:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self.consumer_s = 0.0    # seconds the thread spent in fn
+        # heartbeat: monotonic timestamp of the consumer's last sign of
+        # life (item picked up or finished). With `stall_timeout` set, a
+        # submit/drain that would block while the heartbeat is staler
+        # than the timeout raises StallError instead of hanging.
+        self.stall_timeout = stall_timeout
+        self._beat = time.monotonic()
 
     def check(self):
         """Re-raise the sticky consumer error, if one has occurred."""
         if self._error is not None:
             raise self._error
 
+    def idle_for(self) -> float:
+        """Seconds since the consumer last made progress."""
+        return time.monotonic() - self._beat
+
+    def _check_stall(self, waited_from: float):
+        """Raise StallError when the heartbeat is stale past the
+        timeout AND the caller has itself been blocked at least that
+        long (a freshly stale heartbeat with an instantly returning
+        caller is not a stall)."""
+        if self.stall_timeout is None:
+            return
+        if (self.idle_for() > self.stall_timeout
+                and time.monotonic() - waited_from > self.stall_timeout):
+            raise StallError(
+                f"consumer {self._name!r} made no progress for "
+                f"{self.idle_for():.1f}s (stall timeout "
+                f"{self.stall_timeout:g}s) with work pending")
+
     def _run(self):
         while True:
             item = self._q.get()
+            self._beat = time.monotonic()
             try:
                 if item is _STOP:
                     return
@@ -80,28 +120,65 @@ class OrderedConsumer:
             except BaseException as e:   # surfaced at next submit/drain
                 self._error = e
             finally:
+                self._beat = time.monotonic()
                 self._q.task_done()
 
     def submit(self, item) -> float:
-        """Enqueue one item; returns seconds blocked on backpressure."""
+        """Enqueue one item; returns seconds blocked on backpressure.
+        Raises StallError when the queue is full and the consumer's
+        heartbeat is stale past `stall_timeout`."""
         self.check()
         if self._thread is None:
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name=self._name)
             self._thread.start()
         t0 = time.perf_counter()
-        self._q.put(item)
+        if self.stall_timeout is None:
+            self._q.put(item)
+        else:
+            t_block = time.monotonic()
+            while True:
+                try:
+                    self._q.put(item, timeout=min(
+                        0.25, max(self.stall_timeout, 0.01)))
+                    break
+                except queue.Full:
+                    self.check()
+                    self._check_stall(t_block)
         return time.perf_counter() - t0
 
     def drain(self) -> float:
         """Barrier: block until every submitted item is consumed, then
-        re-raise any sticky consumer error. Returns seconds blocked."""
+        re-raise any sticky consumer error. Returns seconds blocked.
+        Raises StallError when the heartbeat goes stale past
+        `stall_timeout` while items are still pending."""
         self.check()
         t0 = time.perf_counter()
-        self._q.join()
+        if self.stall_timeout is None:
+            self._q.join()
+        else:
+            t_block = time.monotonic()
+            with self._q.all_tasks_done:
+                while self._q.unfinished_tasks:
+                    self._q.all_tasks_done.wait(min(
+                        0.25, max(self.stall_timeout, 0.01)))
+                    if self._q.unfinished_tasks:
+                        if self._error is not None:
+                            break   # sticky error drains the queue itself
+                        self._check_stall(t_block)
         dt = time.perf_counter() - t0
         self.check()
         return dt
+
+    def abandon(self):
+        """Give up on a stalled consumer: mark it failed so no later
+        call blocks on it again, and leave the (daemon) thread to die
+        with the process. Used only on the stall-abort path — a healthy
+        consumer is stopped with `close()`."""
+        if self._error is None:
+            self._error = StallError(
+                f"consumer {self._name!r} abandoned after a stall")
+        self._thread = None
 
     def close(self):
         """Stop the thread (pending items are still consumed first)."""

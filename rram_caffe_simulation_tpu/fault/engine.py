@@ -82,6 +82,25 @@ def init_fault_state(key: jax.Array, param_shapes: Dict[str, tuple],
     return {"lifetimes": lifetimes, "stuck": stuck}
 
 
+def draw_rescaled_state(key: jax.Array, param_shapes: Dict[str, tuple],
+                        pattern: "pb.FailurePatternParameter",
+                        mean, std) -> FaultState:
+    """One independent fault-state draw whose lifetime distribution is
+    rescaled from the pattern's (mean, std) to the given per-config
+    pair: the standard-normal component of the base draw is kept and
+    re-anchored, exactly as the sweep's per-config mean/std grids do
+    (run_different_mean.sh / run_different_mean_var.sh). This is the
+    single-config kernel `stack_fault_states` vmaps over, and what the
+    self-healing lane refill uses for a fresh draw on one lane."""
+    st = init_fault_state(key, param_shapes, pattern)
+    base_m, base_s = float(pattern.mean), float(pattern.std)
+    life = {}
+    for name, v in st["lifetimes"].items():
+        z = (v - base_m) / base_s if base_s else jnp.zeros_like(v)
+        life[name] = mean + std * z
+    return {"lifetimes": life, "stuck": st["stuck"]}
+
+
 def fail(fault_params: Dict[str, jax.Array], state: FaultState,
          fault_diffs: Dict[str, jax.Array],
          decrement: float = 100.0) -> Tuple[Dict[str, jax.Array], FaultState]:

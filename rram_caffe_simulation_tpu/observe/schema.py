@@ -91,7 +91,9 @@ TOP_LEVEL = {
     "grad_norm": (_NUM, False),
     "update_norm": (_NUM, False),
     "outputs": (dict, False),
-    "quarantine": (int, False),   # non-empty list of config indices
+    "quarantine": (int, False),   # non-empty list of lane indices
+    "lane_map": (int, False),     # self-healing sweeps: config id per
+                                  # lane (-1 = idle lane), see below
     "fault": (dict, False),
 }
 
@@ -205,6 +207,44 @@ PIPELINE_FIELDS = {
     "snapshot_write_seconds": (_NUM, False),
     "checkpoint_write_seconds": (_NUM, False),
     "setup_overlap_seconds": (_NUM, False),
+}
+
+# --- retry records (self-healing sweep lane reclamation events) ---
+#
+# One per lane-reclamation event in a self-healing sweep
+# (SweepRunner.enable_self_healing): a quarantined config's attempt is
+# voided and the config re-enqueued ("requeue"), a freed lane is
+# re-seeded with a queued config ("reseed", with `recovery` naming the
+# escalation level used — "checkpoint" restored the config's last good
+# checkpointed slice, "fresh" re-initialized with a fresh RNG key), or
+# a config exhausts its retry budget ("failed", with the triage
+# `diagnosis` carrying the watchdog's first-bad-phase/layer attribution
+# when tracing was armed)::
+#
+#     {"schema_version": 1, "type": "retry", "iter": 150,
+#      "wall_time": 1722700000.1, "config": 7, "lane": 3, "attempt": 2,
+#      "event": "reseed", "recovery": "fresh"}
+#
+# A metrics record in a self-healing sweep additionally carries
+# `lane_map` — the config id occupying each vectorized lane when the
+# chunk was dispatched (-1 = idle lane, queue exhausted) — so the
+# per-config loss vectors stay attributable after a refill.
+
+RETRY_EVENTS = ("requeue", "reseed", "failed")
+RETRY_RECOVERIES = ("checkpoint", "fresh")
+
+RETRY_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "config": (int, True),
+    "lane": (int, True),
+    "attempt": (int, True),
+    "event": (str, True),
+    "recovery": (str, False),       # reseed events only
+    "eligible_iter": (int, False),  # requeue events: backoff target
+    "diagnosis": (str, False),      # failed events: triage attribution
 }
 
 # --- sentinel records (tripped numeric-health flags) ---
@@ -322,6 +362,25 @@ def _validate_setup(rec) -> list:
     return errs
 
 
+def _validate_retry(rec) -> list:
+    errs = _check_fields(rec, RETRY_FIELDS, "retry")
+    errs += _check_iter(rec, "retry")
+    event = rec.get("event")
+    if isinstance(event, str) and event not in RETRY_EVENTS:
+        errs.append(f"retry: unknown event {event!r} "
+                    f"(expected one of {RETRY_EVENTS})")
+    recovery = rec.get("recovery")
+    if isinstance(recovery, str) and recovery not in RETRY_RECOVERIES:
+        errs.append(f"retry: unknown recovery {recovery!r} "
+                    f"(expected one of {RETRY_RECOVERIES})")
+    for key, lo in (("config", 0), ("lane", 0), ("attempt", 1)):
+        val = rec.get(key)
+        if isinstance(val, int) and not isinstance(val, bool) \
+                and val < lo:
+            errs.append(f"retry: {key} must be >= {lo}")
+    return errs
+
+
 def _validate_sentinel(rec) -> list:
     errs = _check_fields(rec, SENTINEL_FIELDS, "sentinel")
     errs += _check_iter(rec, "sentinel")
@@ -350,6 +409,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_sentinel(rec)
     if rtype == "setup":
         return _check_version(rec) + _validate_setup(rec)
+    if rtype == "retry":
+        return _check_version(rec) + _validate_retry(rec)
     if rtype is not None:
         return [f"record: unknown record type {rtype!r}"]
     errs = _check_fields(rec, TOP_LEVEL, "record")
@@ -366,6 +427,13 @@ def validate_record(rec) -> list:
         if any(isinstance(v, int) and not isinstance(v, bool) and v < 0
                for v in vals):
             errs.append("quarantine: config indices must be >= 0")
+    lmap = rec.get("lane_map")
+    if lmap is not None:
+        vals = lmap if isinstance(lmap, list) else [lmap]
+        if any(isinstance(v, int) and not isinstance(v, bool) and v < -1
+               for v in vals):
+            errs.append("lane_map: config ids must be >= -1 "
+                        "(-1 marks an idle lane)")
     fault = rec.get("fault")
     if isinstance(fault, dict):
         errs += _check_fields(fault, FAULT_FIELDS, "fault")

@@ -33,14 +33,17 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
                 outputs: Optional[dict] = None,
                 elapsed_s: Optional[float] = None, n_iters: int = 1,
                 seed: Optional[int] = None,
-                quarantine=None) -> dict:
+                quarantine=None, lane_map=None) -> dict:
     """Assemble one schema-versioned record from the materialized
     on-device metrics plus host-side timing. `elapsed_s` spans the
     `n_iters` iterations since the previous record (the first interval
     includes jit compile time — by design: it is the wall time the user
     actually waited). `quarantine` (sweep records) is the list of
-    config indices whose updates the per-config NaN/Inf quarantine has
-    frozen — included only when non-empty."""
+    lane indices whose updates the per-config NaN/Inf quarantine has
+    frozen — included only when non-empty. `lane_map` (self-healing
+    sweeps) is the config id occupying each lane when the chunk was
+    dispatched (-1 = idle), keeping per-config vectors attributable
+    after a lane refill."""
     metrics = dict(metrics or {})
     fault = metrics.pop("fault", None)
     rec = {
@@ -65,9 +68,61 @@ def make_record(iteration: int, metrics: Optional[dict] = None,
         rec["outputs"] = dict(outputs)
     if quarantine:
         rec["quarantine"] = [int(i) for i in quarantine]
+    if lane_map is not None:
+        rec["lane_map"] = [int(i) for i in lane_map]
     if fault is not None:
         rec["fault"] = fault
     return rec
+
+
+def make_retry_record(iteration: int, config: int, lane: int,
+                      attempt: int, event: str,
+                      recovery: Optional[str] = None,
+                      eligible_iter: Optional[int] = None,
+                      diagnosis: Optional[str] = None) -> dict:
+    """One self-healing lane-reclamation event (schema.py RETRY_FIELDS):
+    `event` is "requeue" (attempt voided, config back on the queue),
+    "reseed" (lane refilled; `recovery` says from "checkpoint" slice or
+    "fresh" re-init), or "failed" (retry budget exhausted; `diagnosis`
+    carries the triage attribution)."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "retry",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "config": int(config),
+        "lane": int(lane),
+        "attempt": int(attempt),
+        "event": str(event),
+    }
+    if recovery is not None:
+        rec["recovery"] = str(recovery)
+    if eligible_iter is not None:
+        rec["eligible_iter"] = int(eligible_iter)
+    if diagnosis is not None:
+        rec["diagnosis"] = str(diagnosis)
+    return rec
+
+
+def retry_line(record: dict) -> str:
+    """One-line text form of a `retry` record."""
+    event = record.get("event")
+    head = (f"Sweep retry: config {record.get('config')} "
+            f"(lane {record.get('lane')}, attempt "
+            f"{record.get('attempt')})")
+    it = record.get("iter")
+    if event == "requeue":
+        tail = f" re-queued after quarantine at iteration {it}"
+        if "eligible_iter" in record:
+            tail += f"; eligible at iteration {record['eligible_iter']}"
+    elif event == "reseed":
+        tail = (f" re-seeded at iteration {it} "
+                f"({record.get('recovery', 'fresh')} recovery)")
+    else:
+        tail = f" permanently failed at iteration {it}"
+        if record.get("diagnosis"):
+            tail += f": {record['diagnosis']}"
+    return head + tail
 
 
 def make_setup_record(decode_s: float, compile_s: float,
@@ -301,6 +356,10 @@ class CaffeLogSink:
             return
         if rtype == "setup":
             self._emit(setup_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "retry":
+            self._emit(retry_line(record))
             self._maybe_flush()
             return
         if rtype is not None:

@@ -12,9 +12,10 @@ mean/std grid sweeps.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,9 +26,11 @@ from .. import cache as _cache
 from ..fault import engine as fault_engine
 from .mesh import make_mesh
 
-#: SweepRunner.checkpoint file format version (bumped on layout changes;
-#: restore() refuses a version it does not understand).
-CHECKPOINT_VERSION = 1
+#: SweepRunner.checkpoint file format version (bumped on layout changes).
+#: v2 added the self-healing lane->config indirection (lane_map /
+#: lane_done / retry queue); restore() upgrades a v1 checkpoint by
+#: assuming the identity lane map and refuses anything else.
+CHECKPOINT_VERSION = 2
 
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
@@ -42,17 +45,85 @@ def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
            else jnp.full((n_configs,), float(pattern.std), jnp.float32))
 
     def init_one(k, m, s):
-        st = fault_engine.init_fault_state(k, param_shapes, pattern)
-        # rescale the standard-normal draw to the per-config (mean, std):
-        # lifetimes were drawn with the pattern scalars; re-derive.
-        base_m, base_s = float(pattern.mean), float(pattern.std)
-        life = {}
-        for name, v in st["lifetimes"].items():
-            z = (v - base_m) / base_s if base_s else jnp.zeros_like(v)
-            life[name] = m + s * z
-        return {"lifetimes": life, "stuck": st["stuck"]}
+        # one draw rescaled from the pattern scalars to the per-config
+        # (mean, std) — the same kernel a self-healing lane refill uses
+        # for its fresh re-draw (engine.draw_rescaled_state)
+        return fault_engine.draw_rescaled_state(k, param_shapes, pattern,
+                                                m, s)
 
     return jax.vmap(init_one)(keys, mean, std)
+
+
+class _HealingState:
+    """Host-side bookkeeping of the self-healing execution layer
+    (SweepRunner.enable_self_healing): the lane->config indirection, a
+    pending-config work queue with at-least-once completion semantics,
+    per-config retry counters, and the completed/failed result ledger.
+    All plain numpy/python state — it rides the checkpoint as JSON."""
+
+    def __init__(self, n: int, budget: int, max_retries: int,
+                 backoff_iters: int, use_checkpoint: bool,
+                 start_iter: int):
+        self.budget = int(budget)
+        self.max_retries = int(max_retries)
+        self.backoff_iters = int(backoff_iters)
+        self.use_checkpoint = bool(use_checkpoint)
+        #: config id occupying each vectorized lane; -1 = free/idle
+        self.lane_cfg = np.arange(n, dtype=np.int64)
+        #: iterations the lane's CURRENT occupant has completed
+        self.lane_done = np.full(n, int(start_iter), dtype=np.int64)
+        #: 1-based attempt number of the lane's current occupant
+        self.lane_attempt = np.ones(n, dtype=np.int64)
+        #: pending work: [{"config", "attempt", "eligible_iter"}]
+        self.pending: List[dict] = []
+        #: config id -> result record (see SweepRunner.config_report)
+        self.results: Dict[int, dict] = {}
+        self.failures: Dict[int, dict] = {}
+        #: lanes the HOST froze (completed/idle) — distinct from a
+        #: device-side NaN quarantine, and excluded from quarantine
+        #: announcements and record fields
+        self.benign: set = set()
+        #: id allocator for extra queued configs beyond the resident n
+        self.next_config = n
+
+    def requested(self) -> List[int]:
+        """Every config id this sweep has been asked to complete."""
+        ids = set(self.results) | set(self.failures)
+        ids.update(int(c) for c in self.lane_cfg if c >= 0)
+        ids.update(int(e["config"]) for e in self.pending)
+        return sorted(ids)
+
+    def complete(self) -> bool:
+        return not self.pending and bool(np.all(self.lane_cfg < 0))
+
+    def to_json(self) -> dict:
+        return {
+            "budget": self.budget, "max_retries": self.max_retries,
+            "backoff_iters": self.backoff_iters,
+            "use_checkpoint": self.use_checkpoint,
+            "lane_cfg": [int(x) for x in self.lane_cfg],
+            "lane_done": [int(x) for x in self.lane_done],
+            "lane_attempt": [int(x) for x in self.lane_attempt],
+            "pending": list(self.pending),
+            "results": {str(k): v for k, v in self.results.items()},
+            "failures": {str(k): v for k, v in self.failures.items()},
+            "benign": sorted(int(x) for x in self.benign),
+            "next_config": int(self.next_config),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "_HealingState":
+        h = cls(len(d["lane_cfg"]), d["budget"], d["max_retries"],
+                d["backoff_iters"], d["use_checkpoint"], 0)
+        h.lane_cfg = np.asarray(d["lane_cfg"], np.int64)
+        h.lane_done = np.asarray(d["lane_done"], np.int64)
+        h.lane_attempt = np.asarray(d["lane_attempt"], np.int64)
+        h.pending = list(d["pending"])
+        h.results = {int(k): v for k, v in d["results"].items()}
+        h.failures = {int(k): v for k, v in d["failures"].items()}
+        h.benign = set(d["benign"])
+        h.next_config = int(d["next_config"])
+        return h
 
 
 class SweepRunner:
@@ -67,12 +138,34 @@ class SweepRunner:
                  stds=None, preload: bool = True, compute_dtype=None,
                  remat_segments: int = 0, config_block: int = 0,
                  precompile_chunk: int = 0,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 stall_timeout_s: Optional[float] = None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
         self.solver = solver
         self.n = n_configs
+        self._closed = False
+        # self-healing layer (enable_self_healing): lane->config work
+        # queue, retry policy, completion ledger; None = plain sweep
+        self._healing: Optional[_HealingState] = None
+        self._means = None if means is None else np.asarray(means,
+                                                            np.float64)
+        self._stds = None if stds is None else np.asarray(stds,
+                                                          np.float64)
+        #: extra per-config (mean, std) specs for queued configs beyond
+        #: the resident lane count (enable_self_healing extra_configs)
+        self._cfg_specs: Dict[int, dict] = {}
+        #: last checkpoint() / restore() path — the escalating-recovery
+        #: source a retried config's lane is re-seeded from
+        self._last_ckpt_path: Optional[str] = None
+        # consumer -> dispatcher signal that a quarantine was observed
+        # and a reclamation pass is due at the next chunk boundary
+        self._reclaim_flag = threading.Event()
+        #: lane -> triage info noted by the bookkeeping path when a
+        #: quarantine is announced (read by the dispatcher AFTER a
+        #: consumer drain, so the hand-off needs no extra lock)
+        self._quar_diag: Dict[int, dict] = {}
         # cold-start accounting: decode/compile seconds + cache
         # hit/miss, emitted via setup_record() (observe `setup` record)
         self.setup = _cache.SetupStats()
@@ -90,7 +183,8 @@ class SweepRunner:
         self._pipeline_on = pipeline_depth is not None
         self._consumer = (
             async_exec.OrderedConsumer(self._consume_chunk,
-                                       depth=pipeline_depth)
+                                       depth=pipeline_depth,
+                                       stall_timeout=stall_timeout_s)
             if pipeline_depth else None)
         self.setup.pipeline = self.pipeline
         self._last_host = None     # (losses, outputs) of the last chunk
@@ -311,6 +405,400 @@ class SweepRunner:
             return (freeze(params, p2), freeze(history, h2),
                     freeze(fault, f2), bad, loss, outs, mets)
         return qstep
+
+    # ------------------------------------------------------------------
+    # self-healing execution layer: pending-config work queue, retry
+    # policy with escalating recovery, chunk-boundary lane reclamation
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def enable_self_healing(self, budget: int, max_retries: int = 1,
+                            backoff_iters: int = 0,
+                            use_checkpoint: bool = True,
+                            extra_configs=None):
+        """Arm the self-healing layer: every resident config becomes a
+        work-queue item with an iteration `budget` and at-least-once
+        completion semantics. At chunk boundaries the dispatcher
+        reclaims lanes whose config was quarantined (attempt voided,
+        config re-enqueued with `backoff_iters * attempt` iterations of
+        backoff until the per-config retry budget `max_retries` is
+        exhausted — then a permanent-failure triage record with the
+        watchdog's first-bad-phase/layer diagnosis) or whose config
+        completed its budget (result harvested), and re-seeds freed
+        lanes from the queue: escalating recovery restores the config's
+        last good checkpointed slice when one exists (`use_checkpoint`,
+        first retry), else re-initializes params/history and takes a
+        fresh fault draw under a fresh RNG key. Healthy lanes stay
+        bit-exact throughout (scripts/check_lane_reclamation.py is the
+        CI guard). `extra_configs` queues additional config specs
+        ({"mean", "std"}) beyond the resident lane count — they are
+        seeded continuous-batching style as lanes free up.
+
+        The sweep is complete (`healing_complete()`) only when every
+        requested config is completed or failed-with-diagnosis; see
+        `config_report()`."""
+        if not self._pipeline_on:
+            raise ValueError(
+                "self-healing needs the chunk bookkeeping path: build "
+                "the SweepRunner with pipeline_depth=0 (synchronous) or "
+                ">= 1 (consumer thread), not None")
+        h = _HealingState(self.n, budget, max_retries, backoff_iters,
+                          use_checkpoint, self.iter)
+        fp = self.solver.param.failure_pattern
+        for spec in (extra_configs or []):
+            cfg = h.next_config
+            h.next_config += 1
+            self._cfg_specs[cfg] = {
+                "mean": float(spec.get("mean", fp.mean)),
+                "std": float(spec.get("std", fp.std))}
+            h.pending.append({"config": cfg, "attempt": 1,
+                              "eligible_iter": int(self.iter)})
+        self._healing = h
+        return self
+
+    def healing_complete(self) -> bool:
+        """True when self-healing is armed and every requested config
+        has reached a terminal state (completed or failed)."""
+        return self._healing is not None and self._healing.complete()
+
+    def config_report(self) -> dict:
+        """The completion ledger of a self-healing sweep: every
+        requested config id, the completed/failed result records
+        (attempts, final loss, broken census, triage diagnosis), the
+        still-active lane occupancy, the pending queue, and the current
+        lane->config map."""
+        h = self._healing
+        if h is None:
+            raise ValueError("config_report() needs "
+                             "enable_self_healing() first")
+        active = {}
+        for lane in range(self.n):
+            cfg = int(h.lane_cfg[lane])
+            if cfg >= 0:
+                active[cfg] = {"lane": lane,
+                               "done": int(h.lane_done[lane]),
+                               "attempt": int(h.lane_attempt[lane])}
+        return {"requested": h.requested(),
+                "completed": {int(k): dict(v)
+                              for k, v in h.results.items()},
+                "failed": {int(k): dict(v)
+                           for k, v in h.failures.items()},
+                "active": active,
+                "pending": [dict(e) for e in h.pending],
+                "lane_map": [int(c) for c in h.lane_cfg]}
+
+    def _cfg_mean_std(self, cfg: int):
+        """The (mean, std) spec of a config id: the per-config override
+        arrays for resident ids, the extra-config spec table for queued
+        ids, the pattern scalars otherwise."""
+        spec = self._cfg_specs.get(cfg)
+        if spec is not None:
+            return float(spec["mean"]), float(spec["std"])
+        fp = self.solver.param.failure_pattern
+        mean = (float(self._means[cfg])
+                if self._means is not None and cfg < len(self._means)
+                else float(fp.mean))
+        std = (float(self._stds[cfg])
+               if self._stds is not None and cfg < len(self._stds)
+               else float(fp.std))
+        return mean, std
+
+    def _fresh_genetic(self):
+        import copy
+        g = copy.deepcopy(self.solver.strategies.genetic)
+        g._rng = np.random.RandomState(g.seed)
+        return g
+
+    def _fresh_rows(self, cfg: int, attempt: int) -> Dict[str, np.ndarray]:
+        """A freshly initialized lane image for `cfg` under the
+        `_state_arrays` flat names: the solver's initial params and
+        history banks, and a fresh fault draw under a key folded from
+        (config id, attempt) so every retry is an independent
+        Monte-Carlo sample of the same (mean, std) spec."""
+        s = self.solver
+        rows: Dict[str, np.ndarray] = {}
+        for layer, vals in s.params.items():
+            for slot, v in enumerate(vals):
+                if v is not None:
+                    rows[f"params/{layer}/{slot}"] = np.asarray(v)
+        for key, slots in s.history.items():
+            for sname, v in slots.items():
+                rows[f"history/{key}/{sname}"] = np.asarray(v)
+        flat = s._flat(s.params)
+        shapes = {k: flat[k].shape for k in s._fault_keys}
+        mean, std = self._cfg_mean_std(cfg)
+        key = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.fold_in(s._key, 0xFA117), cfg), attempt)
+        st = fault_engine.draw_rescaled_state(
+            key, shapes, s.param.failure_pattern, mean, std)
+        if "remap_slots" in (s.fault_state or {}):
+            # tracked remapping restarts at the identity map
+            st["remap_slots"] = s.fault_state["remap_slots"]
+        for name, v in fault_engine.iter_state_leaves(st):
+            rows[f"fault/{name}"] = np.asarray(v)
+        return rows
+
+    def _ckpt_lane_rows(self, cfg: int):
+        """The config's last good checkpointed lane slice, as
+        (_state_arrays rows, lane_done, genetic instance) — or None
+        when no usable checkpoint exists (no checkpoint taken, config
+        not in it, or it was already quarantined there)."""
+        import json as _json
+        import pickle
+        path = self._last_ckpt_path
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            self.wait_for_writes()
+            with np.load(path) as z:
+                data = {k: z[k] for k in z.files}
+            raw = data.pop("__meta__", None)
+            if raw is None:
+                return None
+            meta = _json.loads(bytes(bytearray(raw)).decode())
+            if int(meta.get("version", 1)) < 2:
+                return None          # v1 has no lane map to slice by
+            lane_map = list(meta.get("lane_map") or [])
+            if cfg not in lane_map:
+                return None
+            j = lane_map.index(cfg)
+            if bool(np.asarray(data["quarantine"])[j]):
+                return None          # not a GOOD slice: already bad
+            done = int(meta.get("lane_done",
+                                [meta["iter"]] * len(lane_map))[j])
+            gen = data.pop("__genetics__", None)
+            genetic = None
+            if self._genetics is not None:
+                if gen is None:
+                    return None
+                genetic = pickle.loads(bytes(bytearray(gen)))[j]
+            rows = {name: arr[j] for name, arr in data.items()
+                    if name != "quarantine"}
+            expected = set(self._state_arrays()) - {"quarantine"}
+            if set(rows) != expected:
+                return None
+            return rows, done, genetic
+        except Exception:
+            return None              # recovery is best-effort: fall
+                                     # back to the fresh re-init path
+
+    def _recovery_rows(self, cfg: int, attempt: int):
+        """Escalating recovery for a lane refill: the first retry
+        restores the config's last good checkpointed slice when one
+        exists; later retries (and first seedings) re-initialize fresh.
+        Returns (rows, start_done, genetic_or_None, recovery_name)."""
+        h = self._healing
+        if h.use_checkpoint and attempt == 2:
+            got = self._ckpt_lane_rows(cfg)
+            if got is not None:
+                rows, done, genetic = got
+                return rows, done, genetic, "checkpoint"
+        return self._fresh_rows(cfg, attempt), 0, None, "fresh"
+
+    def _write_lanes(self, updates: Dict[int, Dict[str, np.ndarray]]):
+        """Overwrite the given lanes' rows of every stacked state leaf
+        (host round-trip, device_put back with the existing sharding).
+        Untouched lanes are byte-preserved — the healthy-lane
+        bit-exactness contract survives a refill."""
+        cur = self._state_arrays()
+        placed = dict(cur)
+        names = sorted({n for rows in updates.values() for n in rows})
+        for name in names:
+            stacked = cur[name]
+            w = np.array(stacked)
+            for lane, rows in updates.items():
+                if name not in rows:
+                    continue
+                row = np.asarray(rows[name])
+                if tuple(row.shape) != tuple(w.shape[1:]):
+                    raise ValueError(
+                        f"lane refill: leaf {name!r} row has shape "
+                        f"{tuple(row.shape)}, expected "
+                        f"{tuple(w.shape[1:])}")
+                w[lane] = row
+            placed[name] = jax.device_put(jnp.asarray(w),
+                                          stacked.sharding)
+        self._set_state_arrays(placed)
+
+    def _set_quarantine_bits(self, set_lanes=(), clear_lanes=()):
+        """Host-side edit of the device quarantine mask: freeze
+        completed/idle lanes, unfreeze refilled ones."""
+        m = np.array(np.asarray(self.quarantine))
+        for lane in set_lanes:
+            m[lane] = True
+        for lane in clear_lanes:
+            m[lane] = False
+        self.quarantine = jax.device_put(
+            jnp.asarray(m), self._replicated_sharding())
+
+    def _lane_broken(self, lane: int) -> float:
+        """Broken-cell fraction of one lane's fault-state slice (the
+        single census definition: fault_engine.broken_fraction)."""
+        sl = {"lifetimes": {k: v[lane] for k, v in
+                            self.fault_states["lifetimes"].items()}}
+        return float(fault_engine.broken_fraction(sl))
+
+    def _emit_retry(self, rec: dict):
+        from ..observe import sink as obs_sink
+        print(obs_sink.retry_line(rec), flush=True)
+        if self.solver._metrics_enabled \
+                and self.solver.metrics_logger is not None:
+            self.solver.metrics_logger.log(rec)
+
+    def _heal_pass(self, k: int = 0, losses=None, stacked=True) -> bool:
+        """One chunk-boundary pass of the self-healing dispatcher:
+        advance per-lane progress by the `k` iterations just
+        dispatched, harvest configs that completed their budget (their
+        lanes freeze benign), run the failure reclamation when the
+        bookkeeping path flagged a quarantine (drain to a barrier, void
+        the attempt, requeue or permanently fail per the retry policy),
+        and re-seed freed lanes from the queue. Returns True when every
+        requested config has reached a terminal state — the sweep's
+        completion contract."""
+        from ..observe import sink as obs_sink
+        h = self._healing
+        if h is None:
+            return False
+        refilled, newly_benign = [], []
+        if k:
+            occupied = h.lane_cfg >= 0
+            if h.benign:
+                occupied &= ~np.isin(np.arange(self.n), list(h.benign))
+            h.lane_done[occupied] += k
+
+        # --- completion harvest ---
+        done_lanes = [l for l in range(self.n)
+                      if h.lane_cfg[l] >= 0 and l not in h.benign
+                      and h.lane_done[l] >= h.budget]
+        if done_lanes:
+            mask = np.asarray(self.quarantine)
+            lvals = None
+            if losses is not None:
+                lv = np.asarray(losses)
+                lvals = lv[-1] if stacked else lv
+            for lane in done_lanes:
+                if mask[lane]:
+                    continue   # diverged in its final chunk: the
+                               # failure path owns this lane
+                cfg = int(h.lane_cfg[lane])
+                h.results[cfg] = {
+                    "status": "completed",
+                    "attempts": int(h.lane_attempt[lane]),
+                    "iter": int(self.iter), "lane": int(lane),
+                    "loss": (float(lvals[lane])
+                             if lvals is not None else None),
+                    "broken": self._lane_broken(lane)}
+                h.lane_cfg[lane] = -1
+                h.benign.add(lane)
+                newly_benign.append(lane)
+
+        # --- failure reclamation (quarantined lanes) ---
+        if self._reclaim_flag.is_set():
+            if self._consumer is not None:
+                # barrier: the diagnosis/announce bookkeeping of every
+                # dispatched chunk must land before attempts are voided
+                self.pipeline.drain_s += self._consumer.drain()
+            self._reclaim_flag.clear()
+            mask = np.asarray(self.quarantine)
+            for lane in np.flatnonzero(mask):
+                lane = int(lane)
+                if lane in h.benign or h.lane_cfg[lane] < 0:
+                    continue
+                cfg = int(h.lane_cfg[lane])
+                attempt = int(h.lane_attempt[lane])
+                diag = self._quar_diag.pop(lane, {})
+                bad_iter = int(diag.get("iter", self.iter))
+                diagnosis = (f"non-finite loss at iteration "
+                             f"{bad_iter}{diag.get('where', '')}")
+                if attempt < 1 + h.max_retries:
+                    eligible = self.iter + h.backoff_iters * attempt
+                    h.pending.append({"config": cfg,
+                                      "attempt": attempt + 1,
+                                      "eligible_iter": int(eligible)})
+                    self._emit_retry(obs_sink.make_retry_record(
+                        self.iter, cfg, lane, attempt, "requeue",
+                        eligible_iter=int(eligible)))
+                else:
+                    h.failures[cfg] = {
+                        "status": "failed", "attempts": attempt,
+                        "iter": bad_iter, "lane": lane,
+                        "diagnosis": diagnosis}
+                    self._emit_retry(obs_sink.make_retry_record(
+                        self.iter, cfg, lane, attempt, "failed",
+                        diagnosis=diagnosis))
+                h.lane_cfg[lane] = -1   # freed; the mask bit keeps the
+                                        # lane frozen until refilled
+
+        # --- fast-forward: nothing can train but work is queued ---
+        if h.pending and not np.any(h.lane_cfg >= 0):
+            min_el = min(int(e["eligible_iter"]) for e in h.pending)
+            if min_el > self.iter:
+                self.iter = min_el
+
+        # --- refill freed lanes from the queue ---
+        free = [l for l in range(self.n) if h.lane_cfg[l] < 0]
+        eligible = sorted(
+            (e for e in h.pending if e["eligible_iter"] <= self.iter),
+            key=lambda e: (e["config"], e["attempt"]))
+        if free and eligible:
+            if self._consumer is not None:
+                # barrier BEFORE mutating _quar_seen / the mask: chunks
+                # dispatched pre-refill carry the freed lane's set mask
+                # bit, and a stale item processed after the discard
+                # below would re-mark the lane as seen — permanently
+                # suppressing the announcement (and reclaim flag) of a
+                # later genuine quarantine of the re-seeded config
+                self.pipeline.drain_s += self._consumer.drain()
+            updates = {}
+            for lane in free:
+                if not eligible:
+                    break
+                e = eligible.pop(0)
+                h.pending.remove(e)
+                cfg, attempt = int(e["config"]), int(e["attempt"])
+                rows, done0, genetic, recovery = self._recovery_rows(
+                    cfg, attempt)
+                updates[lane] = rows
+                h.lane_cfg[lane] = cfg
+                h.lane_done[lane] = done0
+                h.lane_attempt[lane] = attempt
+                h.benign.discard(lane)
+                self._quar_seen.discard(lane)
+                if self._genetics is not None:
+                    self._genetics[lane] = (genetic if genetic is not None
+                                            else self._fresh_genetic())
+                refilled.append(lane)
+                self._emit_retry(obs_sink.make_retry_record(
+                    self.iter, cfg, lane, attempt, "reseed",
+                    recovery=recovery))
+            if updates:
+                self._write_lanes(updates)
+
+        complete = h.complete()
+        if not complete and (refilled or newly_benign):
+            self._set_quarantine_bits(set_lanes=newly_benign,
+                                      clear_lanes=refilled)
+        return complete
+
+    def _budget_chunk_cap(self, k: int) -> int:
+        """Cap a chunk so no active lane's config overruns its
+        iteration budget (a completing lane must freeze exactly at the
+        budget boundary)."""
+        h = self._healing
+        if h is None:
+            return k
+        rem = [int(h.budget - h.lane_done[l]) for l in range(self.n)
+               if h.lane_cfg[l] >= 0 and l not in h.benign
+               and h.lane_done[l] < h.budget]
+        if rem:
+            k = min(k, min(rem))
+        return max(k, 1)
 
     def _host_batch(self):
         """One training batch as host arrays, with iter_size sub-batches
@@ -635,17 +1123,30 @@ class SweepRunner:
 
     def _genetic_chunk_cap(self, k: int) -> int:
         """Cap a chunk so every scheduled genetic application lands on a
-        dispatch boundary (the search runs on host between dispatches)."""
+        dispatch boundary (the search runs on host between dispatches).
+        Under self-healing each lane follows its OWN iteration count —
+        a re-seeded config's episodic schedule restarts with it, like a
+        fresh per-config process would."""
         if self._genetics is None:
             return k
+        h = self._healing
+        if h is None:
+            for j in range(1, k):
+                if self._genetic_due_at(self.iter + j):
+                    return j
+            return k
+        lanes = [l for l in range(self.n)
+                 if h.lane_cfg[l] >= 0 and l not in h.benign]
         for j in range(1, k):
-            if self._genetic_due_at(self.iter + j):
+            if any(self._genetic_due_at(int(h.lane_done[l]) + j)
+                   for l in lanes):
                 return j
         return k
 
-    def _apply_genetic(self):
-        """One episodic application for every config, on host slices of
-        the config-stacked params/lifetimes (the Solver._apply_genetic
+    def _apply_genetic(self, lanes=None):
+        """One episodic application for every config (or just `lanes`,
+        the self-healing per-lane schedule), on host slices of the
+        config-stacked params/lifetimes (the Solver._apply_genetic
         counterpart). The per-config swap search mutates its own prune
         masks; device placement/sharding of the params is preserved."""
         s = self.solver
@@ -660,7 +1161,7 @@ class SweepRunner:
         # updates the in-jit mask discards
         quar = np.asarray(self.quarantine)
         for i, g in enumerate(self._genetics):
-            if quar[i]:
+            if quar[i] or (lanes is not None and i not in lanes):
                 continue
             d_i = {k: v[i] for k, v in data.items()}      # views
             diffs_i = {k: np.zeros_like(v) for k, v in d_i.items()}
@@ -673,14 +1174,28 @@ class SweepRunner:
         self.params = s._unflat(new_flat, self.params)
 
     def _maybe_genetic(self):
-        if self._genetics is not None and self._genetic_due_at(self.iter):
+        if self._genetics is None:
+            return
+        h = self._healing
+        if h is None:
+            due = self._genetic_due_at(self.iter)
+            lanes = None
+        else:
+            lanes = [l for l in range(self.n)
+                     if h.lane_cfg[l] >= 0 and l not in h.benign
+                     and self._genetic_due_at(int(h.lane_done[l]))]
+            due = bool(lanes)
+        if due:
             if self._consumer is not None:
                 # synchronous barrier: the episodic host search mutates
                 # params — pending consumer bookkeeping must land (and
                 # any sticky consumer error surface) before the state
                 # changes under it
                 self.pipeline.drain_s += self._consumer.drain()
-            self._apply_genetic()
+            if lanes is None:
+                self._apply_genetic()
+            else:
+                self._apply_genetic(lanes=lanes)
 
     # ------------------------------------------------------------------
     # async dispatch pipeline (host bookkeeping off the critical path)
@@ -693,7 +1208,8 @@ class SweepRunner:
         feed the solver's metric sinks one per-chunk record. Runs
         inline when pipeline_depth=0, on the OrderedConsumer thread
         when >= 1."""
-        k, last_it, losses, outputs, mets, stacked, quar = item
+        (k, last_it, losses, outputs, mets, stacked, quar, lane_map,
+         benign) = item
         if stacked:
             # slice the last iteration ON DEVICE first: records and the
             # step() return only ever use it, and fetching the whole
@@ -703,7 +1219,8 @@ class SweepRunner:
             outputs = jax.tree.map(lambda x: x[-1], outputs)
         self._last_host = (np.asarray(losses),
                            jax.tree.map(np.asarray, outputs))
-        qids = self._note_quarantine(quar, last_it, mets, stacked)
+        qids = self._note_quarantine(quar, last_it, mets, stacked,
+                                     lane_map, benign)
         logger = (self.solver.metrics_logger
                   if self.solver._metrics_enabled else None)
         if logger is None or not mets:
@@ -724,25 +1241,40 @@ class SweepRunner:
         self._record_t0 = now
         rec = obs_sink.make_record(iteration=last_it, metrics=host_mets,
                                    outputs=outs, elapsed_s=elapsed,
-                                   n_iters=k, quarantine=qids or None)
+                                   n_iters=k, quarantine=qids or None,
+                                   lane_map=lane_map)
         self.pipeline.records += 1
         logger.log(rec)
 
-    def _note_quarantine(self, quar, iteration, mets, stacked):
+    def _note_quarantine(self, quar, iteration, mets, stacked,
+                         lane_map=None, benign=frozenset()):
         """Materialize the (n,) quarantine mask of one chunk, announce
         newly quarantined configs by index, and note a watchdog event
-        for the dispatcher thread. Returns the current id list (for the
+        for the dispatcher thread. Lanes the HOST froze (`benign`:
+        completed/idle lanes of a self-healing sweep) are excluded —
+        they did not diverge. Returns the current id list (for the
         record's `quarantine` field)."""
-        ids = [int(i) for i in np.flatnonzero(np.asarray(quar))]
+        ids = [int(i) for i in np.flatnonzero(np.asarray(quar))
+               if int(i) not in benign]
         new = [i for i in ids if i not in self._quar_seen]
         if not new:
             return ids
         self._quar_seen.update(new)
         for i in new:
             where = self._quarantine_entry(i, mets, stacked)
-            print(f"Sweep quarantine: config {i} went non-finite at "
+            # triage note for the retry policy's permanent-failure
+            # record (the dispatcher reads this after a drain barrier)
+            self._quar_diag[i] = {"iter": int(iteration),
+                                  "where": where}
+            who = (f"config {lane_map[i]} (lane {i})"
+                   if lane_map is not None else f"config {i}")
+            print(f"Sweep quarantine: {who} went non-finite at "
                   f"iteration {iteration}{where} — updates frozen, "
                   "healthy configs keep training", flush=True)
+        if self._healing is not None:
+            # wake the dispatcher's reclamation pass at its next
+            # chunk boundary
+            self._reclaim_flag.set()
         if self.solver._watchdog is not None:
             with self._watchdog_lock:
                 if self._watchdog_event is None:
@@ -815,14 +1347,19 @@ class SweepRunner:
         (host_blocked counts the full fetch+sink time — the baseline
         the pipeline is measured against)."""
         self.pipeline.chunks += 1
+        h = self._healing
+        lane_map = [int(c) for c in h.lane_cfg] if h is not None else None
+        benign = frozenset(h.benign) if h is not None else frozenset()
         if not self._pipeline_on:
             if self.solver._watchdog is not None:
                 # legacy path has no bookkeeping; an armed watchdog
                 # opts into a tiny (n,) fetch per dispatch so a
                 # quarantined config still triggers the policy
-                self._note_quarantine(quar, last_it, mets, stacked)
+                self._note_quarantine(quar, last_it, mets, stacked,
+                                      lane_map, benign)
             return
-        item = (k, last_it, losses, outputs, mets, stacked, quar)
+        item = (k, last_it, losses, outputs, mets, stacked, quar,
+                lane_map, benign)
         if self._consumer is not None:
             self.pipeline.host_blocked_s += self._consumer.submit(item)
         else:
@@ -860,7 +1397,39 @@ class SweepRunner:
         already enqueued; a consumer failure is sticky and re-raises
         here on the next call. Results returned are identical bit for
         bit to the sequential path (tests + CI
-        scripts/check_async_equivalence.py pin this)."""
+        scripts/check_async_equivalence.py pin this).
+
+        With self-healing armed (enable_self_healing) each chunk
+        boundary also runs the lane reclamation pass, and the loop ends
+        early once every requested config is terminal. A consumer stall
+        (stall_timeout_s) aborts with a best-effort checkpoint instead
+        of hanging — the raised StallError carries its path."""
+        try:
+            return self._step_impl(iters, chunk)
+        except async_exec.StallError as e:
+            raise self._on_stall(e) from None
+
+    def _on_stall(self, e: async_exec.StallError):
+        """A chunk's bookkeeping stalled (heartbeat went stale): write
+        a best-effort checkpoint WITHOUT draining the stuck consumer,
+        abandon it so nothing blocks on it again, and make the stop
+        sticky. The caller decides whether to resume elsewhere (the
+        durable driver journals the stall and exits EX_TEMPFAIL)."""
+        path = (f"{self.solver.param.snapshot_prefix}"
+                f"_sweep_stall_iter_{self.iter}.ckpt.npz")
+        try:
+            self.checkpoint(path, _drain=False)
+            e.checkpoint_path = path
+            print(f"Sweep stalled; emergency checkpoint saved to {path}",
+                  flush=True)
+        except Exception:
+            pass
+        if self._consumer is not None:
+            self._consumer.abandon()
+        self._stop = True
+        return e
+
+    def _step_impl(self, iters: int, chunk: int):
         if self._stop:
             # a watchdog halt is sticky until restore(): re-entering
             # step() (the durable driver's sliced loop) must not keep
@@ -869,13 +1438,20 @@ class SweepRunner:
                 else (None, None)
         if self._consumer is not None:
             self._consumer.check()   # sticky: surface a prior failure
+        # entry reclamation pass: service events noted during the
+        # previous call's final drain (or restored from a checkpoint)
+        # before dispatching anything — a frozen lane must not outlive
+        # this boundary
+        if self._heal_pass():
+            return self._last_host if self._last_host is not None \
+                else (None, None)
         s = self.solver
         if self._dataset is not None:
             done = 0
             while done < iters:
                 self._maybe_genetic()
-                k = self._genetic_chunk_cap(min(max(chunk, 1),
-                                                iters - done))
+                k = self._budget_chunk_cap(self._genetic_chunk_cap(
+                    min(max(chunk, 1), iters - done)))
                 its, starts, remaps = [], [], []
                 for _ in range(k):
                     its.append(self.iter)
@@ -898,9 +1474,12 @@ class SweepRunner:
                 done += k
                 if self._service_watchdog():
                     break
+                if self._heal_pass(k, losses):
+                    break
             return self._finish_step(losses, outputs)
         if chunk <= 1:
-            for _ in range(iters):
+            done = 0
+            while done < iters:
                 self._maybe_genetic()
                 batch = self._placed(self._host_batch())
                 rngs = jax.vmap(
@@ -916,14 +1495,18 @@ class SweepRunner:
                 self._after_dispatch(1, self.iter, loss, outputs, mets,
                                      self.quarantine, stacked=False)
                 self.iter += 1
+                done += 1
                 if self._service_watchdog():
+                    break
+                if self._heal_pass(1, loss, stacked=False):
                     break
             return self._finish_step(loss, outputs, stacked=False)
 
         done = 0
         while done < iters:
             self._maybe_genetic()
-            k = self._genetic_chunk_cap(min(chunk, iters - done))
+            k = self._budget_chunk_cap(
+                self._genetic_chunk_cap(min(chunk, iters - done)))
             subs, its, remaps = [], [], []
             for _ in range(k):
                 subs.append(self._host_batch())
@@ -943,6 +1526,8 @@ class SweepRunner:
                                  self.quarantine)
             done += k
             if self._service_watchdog():
+                break
+            if self._heal_pass(k, losses):
                 break
         return self._finish_step(losses, outputs)
 
@@ -1009,33 +1594,55 @@ class SweepRunner:
             for group, tree in self.fault_states.items()}
         self.quarantine = arrays["quarantine"]
 
-    def checkpoint(self, path: str, background: bool = False) -> str:
+    def checkpoint(self, path: str, background: bool = False,
+                   _drain: bool = True) -> str:
         """Capture the FULL resumable sweep state to `path` (.npz):
         stacked params, solver histories, fault state, quarantine mask,
-        iteration, the solver RNG key (per-config stream roots), and
-        genetic-strategy state. The async pipeline is drained to a
-        consistent chunk boundary first and any queued background
+        iteration, the solver RNG key (per-config stream roots),
+        genetic-strategy state, and — format v2 — the self-healing
+        layer's lane->config map, per-lane progress, retry counters,
+        and pending-config work queue. The async pipeline is drained to
+        a consistent chunk boundary first and any queued background
         writes/snapshots land before the capture, so the file is always
         a clean boundary; the write itself goes through the temp-file +
         atomic-rename path (on the BackgroundWriter thread with
         `background=True`), so a crash mid-write can never leave a
         truncated checkpoint under the final name. `restore(path)` on a
         runner built with the SAME configuration resumes BIT-EXACTLY
-        (scripts/check_resume_equivalence.py is the CI guard)."""
+        (scripts/check_resume_equivalence.py is the CI guard).
+        `_drain=False` is the stall-abort escape hatch: skip every
+        barrier that could block on a stuck thread and capture the
+        dispatcher's (consistent) device state as-is."""
         import json as _json
         import pickle
-        if self._consumer is not None:
-            self.pipeline.drain_s += self._consumer.drain()
-        self.wait_for_writes()
-        self.solver.wait_for_snapshots()
+        if _drain:
+            if self._consumer is not None:
+                self.pipeline.drain_s += self._consumer.drain()
+            self.wait_for_writes()
+            self.solver.wait_for_snapshots()
         arrays = {name: np.asarray(v)
                   for name, v in self._state_arrays().items()}
+        h = self._healing
         meta = {"version": CHECKPOINT_VERSION, "iter": int(self.iter),
                 "n_configs": int(self.n),
                 "key": [int(x)
                         for x in np.asarray(self.solver._key).ravel()],
                 "seed": int(self.solver.seed),
-                "quarantined": sorted(self._quar_seen)}
+                "quarantined": sorted(self._quar_seen),
+                "lane_map": ([int(c) for c in h.lane_cfg] if h is not None
+                             else list(range(self.n))),
+                "lane_done": ([int(x) for x in h.lane_done]
+                              if h is not None
+                              else [int(self.iter)] * self.n)}
+        if h is not None:
+            meta["healing"] = h.to_json()
+            meta["healing"]["cfg_specs"] = {
+                str(k): v for k, v in self._cfg_specs.items()}
+            # triage notes of announced-but-not-yet-reclaimed lanes
+            # (dict copied first: the _drain=False stall path snapshots
+            # while the consumer thread may still own the dict)
+            meta["healing"]["quar_diag"] = {
+                str(k): v for k, v in dict(self._quar_diag).items()}
         arrays["__meta__"] = np.frombuffer(
             _json.dumps(meta).encode(), np.uint8)
         if self._genetics is not None:
@@ -1056,6 +1663,9 @@ class SweepRunner:
             t0 = time.perf_counter()
             async_exec.atomic_write(path, write)
             self.pipeline.checkpoint_write_s += time.perf_counter() - t0
+        # remember the latest checkpoint: the retry policy's escalating
+        # recovery re-seeds a failed config from this file's lane slice
+        self._last_ckpt_path = path
         return path
 
     def restore(self, path: str):
@@ -1080,11 +1690,13 @@ class SweepRunner:
             raise ValueError(f"{path} is not a SweepRunner checkpoint "
                              "(missing __meta__)")
         meta = _json.loads(bytes(bytearray(raw)).decode())
-        if meta.get("version") != CHECKPOINT_VERSION:
+        found = meta.get("version")
+        if found not in (1, CHECKPOINT_VERSION):
             raise ValueError(
-                f"checkpoint {path} has format version "
-                f"{meta.get('version')!r}; this build reads "
-                f"{CHECKPOINT_VERSION}")
+                f"checkpoint {path} has format version {found!r} but "
+                f"this build expects version {CHECKPOINT_VERSION} "
+                "(v1 checkpoints are upgraded in place: v1 has no lane "
+                "map, so the identity lane->config mapping is assumed)")
         if int(meta["n_configs"]) != self.n:
             raise ValueError(
                 f"checkpoint {path} holds {meta['n_configs']} configs "
@@ -1125,6 +1737,55 @@ class SweepRunner:
         self._quar_seen = {int(i) for i in meta.get("quarantined", [])}
         if gen is not None:
             self._genetics = pickle.loads(bytes(bytearray(gen)))
+        # self-healing layer: v2 checkpoints round-trip the work queue,
+        # retry counters, and lane->config map; a v1 checkpoint (or a
+        # v2 one written with healing off) upgrades to the identity map
+        # with every lane mid-first-attempt
+        heal_meta = meta.get("healing")
+        if self._healing is not None:
+            if heal_meta is not None:
+                self._healing = _HealingState.from_json(heal_meta)
+                self._cfg_specs = {
+                    int(k): v for k, v in
+                    heal_meta.get("cfg_specs", {}).items()}
+            else:
+                h = self._healing
+                h.lane_cfg = np.asarray(
+                    meta.get("lane_map", list(range(self.n))), np.int64)
+                h.lane_done = np.asarray(
+                    meta.get("lane_done", [self.iter] * self.n),
+                    np.int64)
+                h.lane_attempt = np.ones(self.n, np.int64)
+                # the checkpoint's timeline had no queue, but configs
+                # queued via enable_self_healing(extra_configs=...)
+                # were requested of THIS runner — dropping them would
+                # silently break the at-least-once completion contract
+                h.pending = [dict(e, attempt=1,
+                                  eligible_iter=int(self.iter))
+                             for e in h.pending
+                             if int(e["config"]) >= self.n]
+                h.results, h.failures = {}, {}
+                h.benign = set()
+        elif heal_meta is not None:
+            raise ValueError(
+                f"checkpoint {path} carries self-healing state (lane "
+                "map / retry queue) but this runner has it disabled; "
+                "call enable_self_healing(...) before restore()")
+        self._quar_diag.clear()
+        self._reclaim_flag.clear()
+        if self._healing is not None:
+            h = self._healing
+            self._quar_diag.update(
+                {int(k): v for k, v in
+                 (heal_meta or {}).get("quar_diag", {}).items()})
+            # a lane quarantined before the checkpoint but not yet
+            # reclaimed must not stay frozen past the next boundary:
+            # re-arm the reclamation pass for any masked occupied lane
+            mask = np.asarray(self.quarantine)
+            if any(bool(mask[l]) and h.lane_cfg[l] >= 0
+                   and l not in h.benign for l in range(self.n)):
+                self._reclaim_flag.set()
+        self._last_ckpt_path = path
         self.last_metrics = {}
         self._last_host = None
         self._record_t0 = None
@@ -1143,7 +1804,13 @@ class SweepRunner:
 
     def close(self):
         """Stop the pipeline consumer and background writer threads.
-        Pending work is drained first; sticky errors re-raise here."""
+        Pending work is drained first; sticky errors re-raise here.
+        Idempotent: the second and later calls are no-ops, and the
+        runner is a context manager (`with SweepRunner(...) as r:`)
+        whose exit calls this."""
+        if self._closed:
+            return
+        self._closed = True
         try:
             if self._consumer is not None:
                 self._consumer.drain()
@@ -1234,6 +1901,15 @@ class GroupPrefetcher:
         self._box: dict = {}
         self.last_build_s = 0.0   # the prefetched build's own wall time
         self.last_wait_s = 0.0    # how long take() still had to block
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # leaving the block abandons any in-flight build (join + close
+        # its runner) — the `try/finally: prefetch.cancel()` pattern
+        self.cancel()
+        return False
 
     def start(self, build_fn, *args):
         """Kick off `build_fn(*args)` (returning a runner) on a

@@ -127,6 +127,7 @@ def summarize_metrics(path):
     """One-screen digest of a JSONL metrics log (schema: observe/schema.py
     / USAGE.md Observability)."""
     recs = []
+    retries = []
     n_typed = 0
     with open(path) as f:
         for line in f:
@@ -134,6 +135,9 @@ def summarize_metrics(path):
             if not line:
                 continue
             rec = json.loads(line)
+            if rec.get("type") == "retry":
+                retries.append(rec)
+                continue
             if rec.get("type") is not None:
                 # debug_trace / sentinel records ride the same sink;
                 # the digest summarizes the display-interval metrics
@@ -172,6 +176,28 @@ def summarize_metrics(path):
                      f" (incl. compile), steady "
                      f"{float(np.mean(steady)) * 1e3:.2f} ms "
                      f"({1.0 / float(np.mean(steady)):.1f} iters/s)")
+    if retries:
+        by_event = {}
+        for r in retries:
+            by_event.setdefault(r.get("event", "?"), []).append(r)
+        parts = [f"{len(v)} {k}" for k, v in sorted(by_event.items())]
+        lines.append(f"Self-healing events ({len(retries)}): "
+                     + ", ".join(parts))
+        failed = by_event.get("failed", [])
+        for r in failed:
+            diag = r.get("diagnosis") or "no diagnosis"
+            lines.append(f"  config {r.get('config')} failed after "
+                         f"{r.get('attempt')} attempt(s): {diag}")
+    lmap = last.get("lane_map")
+    if isinstance(lmap, list):
+        # keep the one-screen contract: a 500-lane sweep's full map
+        # would be a 2000-char line — show the head only
+        idle = sum(1 for c in lmap if c == -1)
+        shown = ", ".join(str(c) for c in lmap[:16])
+        if len(lmap) > 16:
+            shown += f", ... ({len(lmap) - 16} more)"
+        lines.append(f"Lane map (final record): {len(lmap)} lanes, "
+                     f"{idle} idle; configs {shown}")
     quar = last.get("quarantine")
     if quar:
         ids = quar if isinstance(quar, list) else [quar]

@@ -91,19 +91,18 @@ def _run(db: str, pipeline_depth):
     solver = Solver(sp)
     sink = RecordingSink()
     solver.enable_metrics(sink)
-    runner = SweepRunner(solver, n_configs=N_CONFIGS,
-                         pipeline_depth=pipeline_depth)
-    loss, _ = runner.step(ITERS, chunk=CHUNK)
-    state = {
-        "loss": loss,
-        "params": runner.solver._flat(runner.params),
-        "history": runner.history,
-        "fault": runner.fault_states,
-        "broken": runner.broken_fractions(),
-        "pipeline": runner.setup_record().get("pipeline", {}),
-        "records": sink.records,
-    }
-    runner.close()
+    with SweepRunner(solver, n_configs=N_CONFIGS,
+                     pipeline_depth=pipeline_depth) as runner:
+        loss, _ = runner.step(ITERS, chunk=CHUNK)
+        state = {
+            "loss": loss,
+            "params": runner.solver._flat(runner.params),
+            "history": runner.history,
+            "fault": runner.fault_states,
+            "broken": runner.broken_fractions(),
+            "pipeline": runner.setup_record().get("pipeline", {}),
+            "records": sink.records,
+        }
     return state
 
 

@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""CI guard for the self-healing sweep layer: a lane lost to a NaN
+config must be reclaimed and re-seeded, the retried config must reach a
+terminal state, and the healthy lanes must not notice any of it.
+
+Three driver runs (examples/gaussian_failure/run_1000_sweep.py) against
+the same tiny generated LMDB:
+
+1. **Reference**: no injection. Must exit 0 with every config
+   `completed` first-try in sweep_report.json.
+2. **Injected, retryable**: `--inject-nan CFG@ITER` poisons one
+   config's lane mid-sweep. Must exit 0 with every config completed
+   (the injected one after a retry in a reclaimed lane), the journal
+   must carry the requeue/reseed retry records, the lane must be
+   re-seeded by the chunk boundary after the reclamation barrier, and
+   the HEALTHY configs' final losses and fault-state arrays must be
+   byte-identical to the reference run.
+3. **Injected, permanent**: `--inject-nan CFG@ITER:always` re-poisons
+   every attempt. Must exit 65 (PARTIAL_EXIT) with the config `failed`
+   carrying a triage diagnosis, and the report still accounting for
+   every requested config.
+
+    python scripts/check_lane_reclamation.py
+
+Exit status: 0 = the completion contract holds, 1 = any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DRIVER = os.path.join(_REPO, "examples", "gaussian_failure",
+                      "run_1000_sweep.py")
+PARTIAL_EXIT = 65
+
+CONFIGS = 4
+GROUP = 4          # one resident group: every lane interaction visible
+ITERS = 200
+CHUNK = 20
+INJECT_CFG = 2
+INJECT_ITER = 60
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _write_solver(path: str, db: str):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+net_param {{
+  name: "reclaimguard"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+def _driver_args(solver: str, run_dir: str, extra=()):
+    return [sys.executable, DRIVER, "--solver", solver,
+            "--configs", str(CONFIGS), "--group", str(GROUP),
+            "--block", "0", "--iters", str(ITERS),
+            "--chunk", str(CHUNK), "--checkpoint-every", str(4 * CHUNK),
+            "--mean", "500", "--std", "100", "--pipeline-depth", "0",
+            "--no-overlap", "--max-retries", "1",
+            "--run-dir", run_dir] + list(extra)
+
+
+def _read_jsonl(path: str):
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    return recs
+
+
+def _report(run_dir: str) -> dict:
+    with open(os.path.join(run_dir, "sweep_report.json")) as f:
+        return json.load(f)
+
+
+def _run(solver, run_dir, extra, env):
+    return subprocess.run(_driver_args(solver, run_dir, extra),
+                          env=env, capture_output=True, text=True)
+
+
+def _check(work: str, failures: list):
+    import numpy as np
+    db = os.path.join(work, "db")
+    solver = os.path.join(work, "solver.prototxt")
+    _build_db(db)
+    _write_solver(solver, db)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    dir_ref = os.path.join(work, "ref")
+    dir_inj = os.path.join(work, "inj")
+    dir_perm = os.path.join(work, "perm")
+
+    # 1. reference run, no injection
+    r = _run(solver, dir_ref, (), env)
+    if r.returncode != 0:
+        failures.append(f"reference run failed ({r.returncode}):\n"
+                        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+    rep_ref = _report(dir_ref)
+    if rep_ref["status"] != "clean" or rep_ref["completed"] != CONFIGS:
+        failures.append(f"reference run not clean: {rep_ref!r}")
+
+    # 2. injected, retryable: must still exit 0 and complete everything
+    r = _run(solver, dir_inj,
+             ("--inject-nan", f"{INJECT_CFG}@{INJECT_ITER}"), env)
+    if r.returncode != 0:
+        failures.append(f"injected run exited {r.returncode}, expected "
+                        f"0 (retry should heal it):\n{r.stdout[-2000:]}"
+                        f"\n{r.stderr[-2000:]}")
+        return
+    rep = _report(dir_inj)
+    if rep["status"] != "clean" or rep["completed"] != CONFIGS:
+        failures.append("injected run's report does not complete every "
+                        f"config: {rep['status']=} {rep['completed']=} "
+                        f"{rep['failed']=}")
+    entry = rep["configs"].get(str(INJECT_CFG), {})
+    if entry.get("status") != "completed" \
+            or int(entry.get("attempts", 1)) < 2:
+        failures.append("injected config did not complete via retry: "
+                        f"{entry!r}")
+    if rep["retried"] != [INJECT_CFG]:
+        failures.append(f"report.retried = {rep['retried']!r}, expected "
+                        f"[{INJECT_CFG}]")
+    if sorted(int(c) for c in rep["configs"]) != list(range(CONFIGS)):
+        failures.append("report does not account for every requested "
+                        f"config: {sorted(rep['configs'])!r}")
+
+    # retry records: requeue then reseed, and the reseed lands at the
+    # chunk boundary right after the quarantine was reclaimed — no lane
+    # stays frozen past it
+    mrecs = _read_jsonl(os.path.join(dir_inj, "metrics_g0.jsonl"))
+    retries = [x for x in mrecs if x.get("type") == "retry"]
+    events = [x["event"] for x in retries]
+    if events[:2] != ["requeue", "reseed"]:
+        failures.append(f"expected requeue->reseed retry records, got "
+                        f"{events!r}")
+    elif retries[0].get("iter") != retries[1].get("iter"):
+        failures.append(
+            "lane stayed frozen past the reclamation boundary: requeue "
+            f"at iter {retries[0].get('iter')} but reseed at "
+            f"{retries[1].get('iter')}")
+    # after the reseed, the lane map shows the config back in a lane
+    lm_recs = [x.get("lane_map") for x in mrecs if x.get("type") is None]
+    if not lm_recs or not all(isinstance(m, list) for m in lm_recs):
+        failures.append("metrics records carry no lane_map")
+
+    # healthy configs byte-identical to the reference run: final
+    # losses (journal) and fault-state arrays (npz)
+    g_ref = [x for x in _read_jsonl(os.path.join(dir_ref,
+                                                 "journal.jsonl"))
+             if x.get("event") == "group"]
+    g_inj = [x for x in _read_jsonl(os.path.join(dir_inj,
+                                                 "journal.jsonl"))
+             if x.get("event") == "group"]
+    if len(g_ref) != 1 or len(g_inj) != 1:
+        failures.append("expected exactly one group journal record per "
+                        "run")
+        return
+    healthy = [c for c in range(CONFIGS) if c != INJECT_CFG]
+    for c in healthy:
+        la, lb = g_ref[0]["loss"][c], g_inj[0]["loss"][c]
+        if la != lb:
+            failures.append(f"healthy config {c} final loss diverged "
+                            f"under injection: {la!r} != {lb!r}")
+    fa = os.path.join(dir_ref, "group_0_faults.npz")
+    fb = os.path.join(dir_inj, "group_0_faults.npz")
+    with np.load(fa) as za, np.load(fb) as zb:
+        if sorted(za.files) != sorted(zb.files):
+            failures.append("fault npz key sets differ")
+        else:
+            for name in za.files:
+                for c in healthy:
+                    if za[name][c].tobytes() != zb[name][c].tobytes():
+                        failures.append(
+                            f"healthy config {c} fault state {name!r} "
+                            "not byte-identical under injection")
+
+    # 3. injected, permanent: retry budget exhausts -> partial exit
+    r = _run(solver, dir_perm,
+             ("--inject-nan", f"{INJECT_CFG}@{INJECT_ITER}:always"), env)
+    if r.returncode != PARTIAL_EXIT:
+        failures.append(f"always-NaN run exited {r.returncode}, "
+                        f"expected {PARTIAL_EXIT}:\n{r.stdout[-2000:]}"
+                        f"\n{r.stderr[-2000:]}")
+        return
+    rep = _report(dir_perm)
+    if rep["status"] != "partial" or rep["failed"] != [INJECT_CFG]:
+        failures.append(f"always-NaN report wrong: {rep['status']=} "
+                        f"{rep['failed']=}")
+    entry = rep["configs"].get(str(INJECT_CFG), {})
+    if entry.get("status") != "failed" or not entry.get("diagnosis"):
+        failures.append("failed config carries no diagnosis: "
+                        f"{entry!r}")
+    if rep["completed"] != CONFIGS - 1:
+        failures.append(f"always-NaN run completed {rep['completed']} "
+                        f"configs, expected {CONFIGS - 1}")
+    if not failures:
+        print("lane reclamation OK: injected config retried to "
+              "completion, healthy lanes byte-identical, permanent "
+              "failure diagnosed with exit "
+              f"{PARTIAL_EXIT}")
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="lane_reclaim_guard_")
+    failures: list = []
+    try:
+        _check(work, failures)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print("lane-reclamation guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,35 @@ SAMPLE_BAD_QUARANTINE = {
     "quarantine": [],        # empty list is an emission bug, not data
 }
 
+# a self-healing sweep record: the lane->config indirection rides every
+# metrics record so per-config vectors stay attributable after a refill
+SAMPLE_GOOD_LANE_MAP = {
+    "schema_version": 1, "iter": 150, "wall_time": 1722700000.0,
+    "loss": [0.83, 0.79, 0.9],
+    "lr": 0.01, "step_latency_s": 0.01, "iters_per_s": 100.0,
+    "lane_map": [0, 7, -1],           # lane 1 refilled, lane 2 idle
+}
+
+SAMPLE_BAD_LANE_MAP = {
+    "schema_version": 1, "iter": 150, "wall_time": 1722700000.0,
+    "loss": [0.83, 0.79, 0.9],
+    "lr": 0.01, "step_latency_s": 0.01, "iters_per_s": 100.0,
+    "lane_map": [0, -2, 2],           # only -1 marks an idle lane
+}
+
+# self-healing lane-reclamation events (schema.py RETRY_FIELDS)
+SAMPLE_GOOD_RETRY = {
+    "schema_version": 1, "type": "retry", "iter": 150,
+    "wall_time": 1722700000.0, "config": 7, "lane": 3, "attempt": 2,
+    "event": "reseed", "recovery": "fresh",
+}
+
+SAMPLE_BAD_RETRY = {
+    "schema_version": 1, "type": "retry", "iter": 150,
+    "wall_time": 1722700000.0, "config": -7, "lane": 3, "attempt": 0,
+    "event": "sideways", "recovery": "prayer",    # unknown enum values
+}
+
 # the debug_info deep-trace record types (observe/debug.py)
 SAMPLE_GOOD_DEBUG = {
     "schema_version": 1, "type": "debug_trace", "iter": 3,
@@ -167,6 +196,8 @@ def main(argv=None) -> int:
         n_bad = 0
         for name, rec in (("metrics", SAMPLE_GOOD),
                           ("quarantine", SAMPLE_GOOD_QUARANTINE),
+                          ("lane_map", SAMPLE_GOOD_LANE_MAP),
+                          ("retry", SAMPLE_GOOD_RETRY),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP)):
@@ -178,6 +209,8 @@ def main(argv=None) -> int:
                 return 1
         for name, rec in (("metrics", SAMPLE_BAD),
                           ("quarantine", SAMPLE_BAD_QUARANTINE),
+                          ("lane_map", SAMPLE_BAD_LANE_MAP),
+                          ("retry", SAMPLE_BAD_RETRY),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP)):
@@ -187,7 +220,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (5 good records accepted, 5 bad "
+        print("sample self-check OK (7 good records accepted, 7 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

@@ -247,9 +247,6 @@ def _check_preemption_roundtrip(work: str, failures: list):
 
 
 def _check_quarantine(work: str, failures: list):
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
     from google.protobuf import text_format
     from rram_caffe_simulation_tpu.parallel import SweepRunner
     from rram_caffe_simulation_tpu.proto import pb
@@ -285,6 +282,20 @@ def _check_quarantine(work: str, failures: list):
 
     clean, _ = build()
     poisoned, records = build()
+    # SweepRunner is a context manager: close() (idempotent) on exit
+    # replaces the manual close calls this guard used to carry
+    with clean, poisoned:
+        _quarantine_body(clean, poisoned, records, failures)
+    if not failures:
+        print("quarantine isolation OK: config 1 frozen + surfaced in "
+              "records; configs 0/2 bit-identical to the clean run")
+
+
+def _quarantine_body(clean, poisoned, records, failures):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
     w = np.array(poisoned.params["ip"][0])       # (3, ...) stacked
     w[1].flat[0] = np.nan
     poisoned.params["ip"][0] = jnp.asarray(w)
@@ -325,11 +336,6 @@ def _check_quarantine(work: str, failures: list):
            for x in jax.tree.leaves(poisoned.history)):
         failures.append("quarantined lane's momentum advanced — the "
                         "freeze leaked an update")
-    clean.close()
-    poisoned.close()
-    if not failures:
-        print("quarantine isolation OK: config 1 frozen + surfaced in "
-              "records; configs 0/2 bit-identical to the clean run")
 
 
 def main() -> int:

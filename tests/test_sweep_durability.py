@@ -267,6 +267,46 @@ def test_quarantine_mask_survives_checkpoint(tmp_path):
     r2.close()
 
 
+def test_quarantine_restore_across_pipeline_depths(tmp_path):
+    """pipeline_depth is host-side plumbing, not state: a checkpoint
+    written by a PIPELINED runner with a quarantined lane restores into
+    a SYNC runner (and vice versa) and both continuations — quarantine
+    mask, healthy-lane trajectories, and record sequence — match the
+    uninterrupted sync run bit for bit."""
+    import json
+
+    def dumps(recs):
+        # records carry NaN losses for the poisoned lane, and nan !=
+        # nan under list equality; the JSON text form compares exactly
+        return [json.dumps(r) for r in _strip_timing(recs)]
+
+    r_full, sink_full = _runner(tmp_path / "full", depth=0)
+    _poison(r_full, cfg=1)
+    loss_full, _ = r_full.step(6, chunk=2)
+    assert r_full.quarantined().tolist() == [1]
+
+    for d_write, d_read, tag in ((2, 0, "p2s"), (0, 2, "s2p")):
+        r_a, sink_a = _runner(tmp_path / f"{tag}_a", depth=d_write)
+        _poison(r_a, cfg=1)
+        r_a.step(2, chunk=2)
+        ckpt = r_a.checkpoint(str(tmp_path / f"{tag}.ckpt.npz"))
+        r_a.close()
+
+        r_b, sink_b = _runner(tmp_path / f"{tag}_b", depth=d_read)
+        r_b.restore(ckpt)
+        assert r_b.quarantined().tolist() == [1]
+        loss_b, _ = r_b.step(4, chunk=2)
+
+        _bit_equal(loss_full, loss_b)
+        _bit_equal(r_full.solver._flat(r_full.params),
+                   r_b.solver._flat(r_b.params))
+        _bit_equal(r_full.quarantine, r_b.quarantine)
+        assert dumps(sink_full.records) == \
+            dumps(sink_a.records + sink_b.records), tag
+        r_b.close()
+    r_full.close()
+
+
 def test_quarantine_caffe_sink_and_summarize(tmp_path):
     """The quarantine field renders in the Caffe text sink (a line the
     legacy scrapers skip) and in the summarize digest."""
