@@ -53,6 +53,23 @@ STEPS = max(int(os.environ.get("BENCH_STEPS", "100")) // CHUNK, 1) * CHUNK
 # chunks whose host bookkeeping the consumer thread hides; 0 = fetch
 # inline at every chunk boundary (the pre-pipeline baseline)
 PIPELINE = int(os.environ.get("BENCH_PIPELINE", "2"))
+# --- the HBM-floor attack knobs (ROADMAP item 3 / ISSUE 7) ---
+# fault-state layout: "1" packs the per-cell state into int16/uint8
+# banks (fault/packed.py — identical fault transitions, ~4x less
+# resident fault HBM per config); "" reverts to the f32 reference
+# leaves. Safe on every backend — on by default.
+PACKED = os.environ.get("BENCH_PACKED", "1") not in ("", "0")
+# hardware-aware crossbar engine (ENGINE MATRIX, fault/hw_aware.py):
+# "auto" resolves to the config-batched Pallas kernel on the TPU
+# backend (per-lane faulty+noisy weights formed in VMEM, never
+# round-tripping HBM; composes with BENCH_DTYPE — the kernel computes
+# f32 while activations stay half-width) and to the pure-JAX reference
+# path elsewhere. "jax" | "pallas" force a side.
+ENGINE = os.environ.get("BENCH_ENGINE", "auto")
+# quantized sweep compute ("" | "ternary" | "int8"): fault-target
+# weight reads through the quantize_ste ADC grid. Opt-in — it changes
+# the arithmetic (RESULTS.md "Quantized & packed sweeps" caveats).
+DTYPE_POLICY = os.environ.get("BENCH_DTYPE_POLICY", "") or None
 
 
 def main(argv=None):
@@ -95,11 +112,28 @@ def main(argv=None):
     sp.failure_pattern.std = 3e7
 
     solver = Solver(sp)
+    # resolve the "auto" engine HERE (SweepRunner's own "auto" is the
+    # conservative jax alias — sweeps opt in to pallas explicitly): the
+    # config-batched kernel needs the TPU pallas lowering (interpret
+    # mode elsewhere is a debug path). It composes with the bfloat16
+    # compute dtype (the kernel computes f32 behind call-site casts;
+    # activations keep the half-width HBM traffic). Whether the fused
+    # kernel actually ENGAGES (rram_forward.sigma > 0 or an ADC-grid
+    # policy — the stock bench point runs sigma == 0) is resolved by
+    # make_train_step's use_pallas gate and read back below as
+    # runner.engine_resolved; extra.engine always names the engine that
+    # actually RAN, never an inert flag — the r06+ HBM-floor
+    # attribution depends on it.
+    engine = ENGINE
+    if engine == "auto":
+        engine = "pallas" if jax.default_backend() == "tpu" else "jax"
     # precompile_chunk: AOT-compile the CHUNK-step function on the main
     # thread while the LMDB decode runs on a background thread — the
     # two cold-start halves overlap instead of serializing
     runner = SweepRunner(solver, n_configs=N_CONFIGS, compute_dtype=DTYPE,
-                         precompile_chunk=CHUNK, pipeline_depth=PIPELINE)
+                         precompile_chunk=CHUNK, pipeline_depth=PIPELINE,
+                         engine=engine, packed_state=PACKED,
+                         dtype_policy=DTYPE_POLICY)
     input_path = ("lmdb->transformer->device-resident dataset"
                   if runner._dataset is not None
                   else "host feed per step")
@@ -123,6 +157,12 @@ def main(argv=None):
     img_s_chip = N_CONFIGS * BATCH * STEPS / dt / n_chips
     configs_per_hour = N_CONFIGS * STEPS / dt * 3600.0 / 5000.0
     # (configs/hour normalized to a 5k-iteration CIFAR-quick training run)
+    # HBM-floor accounting (ROADMAP item 3): estimated resident-state
+    # bytes one sweep iteration moves, and the bandwidth the min window
+    # achieved against that floor — the trajectory r06+ tracks as the
+    # packed/quantized engines shrink bytes-per-step
+    bytes_step = setup_rec.get("bytes_per_step_est") or 0
+    achieved_gb_s = bytes_step * STEPS / dt / 1e9 / n_chips
 
     print(json.dumps({
         "metric": "images/sec/chip under RRAM noise (CIFAR-10-quick, "
@@ -147,6 +187,16 @@ def main(argv=None):
             # record "pipeline" shape): depth, chunks dispatched, and
             # the dispatcher's host-blocked seconds across them
             "pipeline": setup_rec.get("pipeline", {}),
+            # the bytes-per-step attack surface (ISSUE 7): which
+            # crossbar engine / fault-state banks / ADC-grid policy ran,
+            # the resident-state bytes one iteration moves, and the
+            # bandwidth the timed window sustained against that floor
+            "engine": runner.engine_resolved,
+            "fault_state_format": setup_rec.get("fault_state_format",
+                                                "f32"),
+            "dtype_policy": DTYPE_POLICY or "off",
+            "bytes_per_step_est": bytes_step,
+            "achieved_bandwidth_gb_s_per_chip": round(achieved_gb_s, 2),
             "steps_timed": STEPS, "batch": BATCH, "chunk": CHUNK,
             "n_configs": N_CONFIGS, "chips": n_chips,
             "seconds": round(dt, 3),

@@ -223,6 +223,16 @@ def main(argv=None):
     train_tflops = 3 * fwd_flops * args.iters / dt / 1e12
     mfu = train_tflops / args.peak_tflops
     loss = solver.smoothed_loss
+    # HBM-floor accounting (the sweep bench's bytes_per_step_est twin):
+    # resident state read + written once per step — masters and
+    # momentum (activations excluded: shape-dependent and largely
+    # fused) — plus the per-step input batch read.
+    bytes_step = 2 * sum(int(a.nbytes) for a in jax.tree.leaves(
+        (solver.params, solver.history)))
+    if feed is not None:
+        bytes_step += sum(int(v.nbytes) for v in feed().values())
+    setup_stats.bytes_per_step = bytes_step
+    achieved_gb_s = bytes_step * args.iters / dt / 1e9
     rec = {
         "model": os.path.basename(os.path.dirname(args.model)) or
                  args.model,
@@ -234,6 +244,8 @@ def main(argv=None):
         "fwd_gflops_per_batch": round(fwd_flops / 1e9, 2),
         "achieved_tflops": round(train_tflops, 2),
         "mfu_vs_peak": round(mfu, 4),
+        "bytes_per_step_est": bytes_step,
+        "achieved_bandwidth_gb_s": round(achieved_gb_s, 2),
         "peak_tflops": args.peak_tflops,
         "iters": args.iters,
         "chunk": args.chunk,

@@ -144,6 +144,11 @@ class SetupStats:
         # async-execution-layer accounting (async_exec.PipelineStats),
         # attached by the runner when the dispatch pipeline is on
         self.pipeline = None
+        # HBM-floor accounting (ISSUE 7): the runner's estimated bytes
+        # per sweep iteration and the fault-state format behind it
+        # (SweepRunner.bytes_per_step_est; "f32" | "packed")
+        self.bytes_per_step = None
+        self.fault_format = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -174,7 +179,9 @@ class SetupStats:
             dataset_status=self.dataset,
             cache_dir=_state["dir"], setup_s=setup_s,
             pipeline=(self.pipeline.record()
-                      if self.pipeline is not None else None))
+                      if self.pipeline is not None else None),
+            bytes_per_step_est=self.bytes_per_step,
+            fault_state_format=self.fault_format)
 
 
 class _Timed:

@@ -54,10 +54,11 @@ class LayerContext:
     # when nonzero, crossbar (InnerProduct) layers quantize their output
     # with straight-through gradients (fault/hw_aware.quantize_ste).
     adc_bits: int = 0
-    # Hardware-aware crossbar engine (RRAMForwardParameter.sigma on the
-    # Pallas path): maps fault-target layer name -> (broken, stuck, seed,
-    # sigma); the layer computes its matmul through the fused
-    # fault/hw_aware.crossbar_matmul kernel (noise drawn in VMEM).
+    # Hardware-aware crossbar engine: maps fault-target layer name ->
+    # (broken, stuck, seed, sigma, q_bits); the layer computes its
+    # matmul through the fused fault/hw_aware.crossbar_matmul kernel.
+    # Which hw_engine value populates this (and every fallback rule)
+    # is documented ONCE: the ENGINE MATRIX in fault/hw_aware.py.
     crossbar: Optional[dict] = None
     # Mixed precision (Solver compute_dtype, static): layers that CREATE
     # float data inside the graph (DummyData fillers) emit it in this

@@ -171,10 +171,16 @@ def fault_counters(prev_life: Dict[str, jax.Array],
 def broken_fraction(state: FaultState) -> jax.Array:
     """Broken-cell census (reference FailureMaker::Fail CPU-side census,
     failure_maker.hpp:38-54 — which forced a GPU->CPU sync every iteration;
-    here it is a reduction the caller fetches only when logging)."""
+    here it is a reduction the caller fetches only when logging).
+
+    Accepts both state formats: f32 lifetimes and the bit-packed
+    write-counter banks (fault/packed.py) share the `<= 0` broken
+    semantics, so the census is one definition either way."""
     broken = 0
     total = 0
-    for life in state["lifetimes"].values():
+    lives = (state["life_q"] if "life_q" in state
+             else state["lifetimes"])
+    for life in lives.values():
         broken = broken + jnp.sum(life <= 0)
         total += life.size
     return broken / max(total, 1)

@@ -18,12 +18,50 @@ Two implementations with one contract:
   `x + stop_gradient(f(x) - x)` identity, so d(w_eff)/dw == 1 while the
   forward sees the perturbed value.
 - `crossbar_matmul`: a fused Pallas TPU kernel computing
-  y = x @ where(broken, stuck, w * (1 + sigma*eps)) with the noise drawn
-  IN-KERNEL (pltpu PRNG + Box-Muller) per weight tile — the noisy weight
-  matrix never materializes in HBM. custom_vjp backward uses the CLEAN
-  masked weights (noise treated as a forward-only perturbation, the
-  standard QAT straight-through choice); with sigma == 0 forward and
-  backward match the pure path exactly.
+  y = x @ where(broken, stuck, quantize(w) * (1 + sigma*eps)) with the
+  noise drawn IN-KERNEL (pltpu PRNG + Box-Muller) per weight tile and
+  the optional `q_bits` weight quantization (the ADC/DAC-grid operating
+  point, same symmetric-uniform formula as `quantize_ste`) applied to
+  the VMEM tile — neither the noisy nor the quantized weight matrix
+  ever materializes in HBM. custom_vjp backward uses the CLEAN masked
+  weights (noise and quantization treated as forward-only
+  perturbations, the standard QAT straight-through choice); with
+  sigma == 0 and q_bits == 0 forward and backward match the pure path
+  exactly.
+
+ENGINE MATRIX — the single source for the `hw_engine` selection
+(referenced by core/registry.py `LayerContext.crossbar` and
+`Solver.make_train_step`; mirrors the reference's Caffe-vs-cuDNN engine
+choice, layer_factory.cpp:38):
+
+  ==========  ================================  ==============================
+  hw_engine   single config (Solver)            Monte-Carlo sweep (SweepRunner)
+  ==========  ================================  ==============================
+  "jax"       perturb_weight + quantize_ste     same, vmapped per config —
+              (pure JAX; vmap/GSPMD-safe        the semantic REFERENCE path
+              everywhere)                       and the sweep default
+  "pallas"    fused crossbar_matmul kernel      config-batched kernel: the
+              (noise + quantize drawn/applied   vmap over (w, broken, stuck,
+              in VMEM)                          seed) dispatches to ONE
+                                                (config, m, n, k)-grid launch
+                                                covering every lane
+  "auto"      pallas on the TPU backend,        jax (sweeps opt in to pallas
+              jax elsewhere                     explicitly via
+                                                SweepRunner(engine=...))
+  ==========  ================================  ==============================
+
+Fallbacks (every one loud or semantics-preserving, never silent wrong
+answers): under a `compute_dtype` below f32 the kernel still computes
+in f32 — the call site (ops/common.py) casts x/w up around the fused
+call and the output/cotangents back down, so activations keep the
+half-width HBM traffic while the crossbar read keeps f32 numerics
+("auto" stays conservative and engages pallas only at native f32; an
+explicit hw_engine="pallas" composes with any compute_dtype); the
+dp/tp/pp wrappers force "jax" (the kernel has no GSPMD partitioning
+rule); and a
+vmap batching pattern that does not batch ALL of w/broken/stuck/seed
+(x may be shared or per-config) runs the single-config kernel per lane
+under `lax.map` (identical numerics, no fusion win).
 """
 from __future__ import annotations
 
@@ -63,66 +101,128 @@ def quantize_ste(x, bits: int, max_abs=None):
 # ---------------------------------------------------------------------------
 # Pallas fused kernel
 
-def _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref, sigma, eps):
-    noisy = w_ref[:] * (1.0 + sigma * eps)
-    w_eff = jnp.where(broken_ref[:] > 0, stuck_ref[:], noisy)
+def _q_levels(q_bits: int) -> float:
+    """Symmetric quantization level count for a bit width (0 = off);
+    the same 2^(bits-1)-1 grid `quantize_ste` uses."""
+    if not q_bits:
+        return 0.0
+    if q_bits < 2:
+        raise ValueError(f"crossbar q_bits needs bits >= 2, got {q_bits}")
+    return float(2 ** (q_bits - 1) - 1)
+
+
+def _quantize_tile(w, scale, levels: float):
+    """quantize_ste's forward formula on a VMEM tile: `scale` is the
+    whole (per-config) weight matrix's max-abs, computed outside the
+    kernel (the grid must be uniform across tiles, like the pure path's
+    per-call dynamic range)."""
+    s = jnp.maximum(scale, 1e-12) / levels
+    return jnp.clip(jnp.round(w / s), -levels, levels) * s
+
+
+def _gauss_tile(shape):
+    """In-kernel N(0,1) tile draw (call after `pltpu.prng_seed`): raw
+    32-bit PRNG words -> [0,1) by scale + fractional part (proof
+    against signed/unsigned interpretation) -> Box-Muller. The ONE
+    definition shared by the single-config and config-batched kernels —
+    the batched-vs-per-lane bit-exactness contract hangs on these ops
+    matching exactly."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    def uniform01(s):
+        b = pltpu.prng_random_bits(s)
+        u = b.astype(jnp.float32) * (1.0 / 4294967296.0)
+        return u - jnp.floor(u)
+
+    u1 = jnp.maximum(uniform01(shape), 1e-12)
+    u2 = uniform01(shape)
+    return jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
+
+
+def _w_eff(w, broken, stuck, sigma, eps, q_levels, scale):
+    """The effective crossbar read of one weight tile — the semantic
+    sequence every kernel variant shares: optional ADC-grid
+    quantization, forward-only conductance noise (`eps=None` skips the
+    multiply: the sigma == 0 sweep builds no PRNG at all), stuck
+    clamp."""
+    if q_levels:
+        w = _quantize_tile(w, scale, q_levels)
+    if eps is not None:
+        w = w * (1.0 + sigma * eps)
+    return jnp.where(broken > 0, stuck, w)
+
+
+def _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref, sigma, eps,
+                q_levels=0.0, scale=None):
+    w_eff = _w_eff(w_ref[:], broken_ref[:], stuck_ref[:], sigma, eps,
+                   q_levels, scale)
     o_ref[:] += jnp.dot(x_ref[:], w_eff,
                         preferred_element_type=jnp.float32)
 
 
-def _crossbar_kernel(seed_ref, x_ref, w_ref, broken_ref, stuck_ref,
-                     sigma_ref, o_ref):
+def _make_crossbar_kernel(q_levels: float):
     """One (bm, bn) output tile, accumulating over the K grid axis; the
-    weight tile is perturbed in VMEM before hitting the MXU. The PRNG is
-    seeded per (j, k) tile so every x-tile sees the SAME weight noise."""
+    weight tile is quantized + perturbed in VMEM before hitting the MXU.
+    The PRNG is seeded per (j, k) tile so every x-tile sees the SAME
+    weight noise. `q_levels` is static: 0 builds the exact historical
+    kernel signature (no scale input)."""
     from jax.experimental.pallas import tpu as pltpu
     import jax.experimental.pallas as pl
 
-    j = pl.program_id(1)
-    k = pl.program_id(2)
-    nk = pl.num_programs(2)
+    def kernel(*refs):
+        if q_levels:
+            (seed_ref, scale_ref, x_ref, w_ref, broken_ref, stuck_ref,
+             sigma_ref, o_ref) = refs
+        else:
+            (seed_ref, x_ref, w_ref, broken_ref, stuck_ref, sigma_ref,
+             o_ref) = refs
+            scale_ref = None
+        j = pl.program_id(1)
+        k = pl.program_id(2)
+        nk = pl.num_programs(2)
 
-    @pl.when(k == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
 
-    w = w_ref[:]
-    # Seed and tile index are SEPARATE seed words: with a single word
-    # `seed + j*nk + k`, seed s+1 tile t would replay seed s tile t+1 —
-    # sequential Monte-Carlo seeds would share almost all their noise.
-    pltpu.prng_seed(seed_ref[0], j * nk + k)
-
-    def uniform01(shape):
-        # map raw 32-bit draws to [0,1) regardless of signed/unsigned
-        # interpretation: scale then take the fractional part
-        b = pltpu.prng_random_bits(shape)
-        u = b.astype(jnp.float32) * (1.0 / 4294967296.0)
-        return u - jnp.floor(u)
-
-    # Box-Muller -> N(0,1) per weight element
-    u1 = jnp.maximum(uniform01(w.shape), 1e-12)
-    u2 = uniform01(w.shape)
-    eps = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
-    _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
-                sigma_ref[0], eps)
+        # Seed and tile index are SEPARATE seed words: with a single word
+        # `seed + j*nk + k`, seed s+1 tile t would replay seed s tile t+1
+        # — sequential Monte-Carlo seeds would share almost all their
+        # noise.
+        pltpu.prng_seed(seed_ref[0], j * nk + k)
+        eps = _gauss_tile(w_ref[:].shape)
+        _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
+                    sigma_ref[0], eps, q_levels,
+                    scale_ref[0] if q_levels else None)
+    return kernel
 
 
-def _crossbar_kernel_hostnoise(x_ref, w_ref, broken_ref, stuck_ref,
-                               eps_ref, sigma_ref, o_ref):
+def _make_crossbar_kernel_hostnoise(q_levels: float):
     """Interpret-mode twin for off-TPU hosts: identical math, but the
     Gaussian draw arrives as an input (pltpu's in-kernel PRNG has no CPU
     interpret lowering)."""
     import jax.experimental.pallas as pl
 
-    @pl.when(pl.program_id(2) == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
+    def kernel(*refs):
+        if q_levels:
+            (scale_ref, x_ref, w_ref, broken_ref, stuck_ref, eps_ref,
+             sigma_ref, o_ref) = refs
+        else:
+            (x_ref, w_ref, broken_ref, stuck_ref, eps_ref, sigma_ref,
+             o_ref) = refs
+            scale_ref = None
 
-    _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
-                sigma_ref[0], eps_ref[:])
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
+                    sigma_ref[0], eps_ref[:], q_levels,
+                    scale_ref[0] if q_levels else None)
+    return kernel
 
 
-def _pallas_forward(x, w, broken, stuck, seed, sigma,
+def _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits=0,
                     bm=128, bn=128, bk=128):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -140,6 +240,13 @@ def _pallas_forward(x, w, broken, stuck, seed, sigma,
     gm, gk = xp.shape[0] // bm, xp.shape[1] // bk
     gn = wp.shape[1] // bn
     on_tpu = jax.default_backend() == "tpu"
+    levels = _q_levels(q_bits)
+    # the quantization grid spans the WHOLE weight matrix (quantize_ste's
+    # per-call dynamic range), so the max-abs reduction runs outside the
+    # tile loop; padding is zeros, so it can ride the padded array
+    scale = ([jnp.max(jnp.abs(wp)).reshape(1)] if levels else [])
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    scale_spec = [smem] if levels else []
     wspec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
     common = dict(
         grid=(gm, gn, gk),
@@ -150,47 +257,256 @@ def _pallas_forward(x, w, broken, stuck, seed, sigma,
     sig = jnp.asarray([sigma], jnp.float32)
     if on_tpu:
         out = pl.pallas_call(
-            _crossbar_kernel,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
+            _make_crossbar_kernel(levels),
+            in_specs=[smem] + scale_spec + [            # seed (+ scale)
                       pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
                       wspec, wspec, wspec,
-                      pl.BlockSpec(memory_space=pltpu.SMEM)],  # sigma
+                      smem],                            # sigma
             **common,
-        )(jnp.asarray([seed], jnp.int32), xp, wp, bp, sp, sig)
+        )(jnp.asarray([seed], jnp.int32), *scale, xp, wp, bp, sp, sig)
     else:
         eps = jax.random.normal(jax.random.PRNGKey(seed), wp.shape,
                                 jnp.float32)
         out = pl.pallas_call(
-            _crossbar_kernel_hostnoise,
-            in_specs=[pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            _make_crossbar_kernel_hostnoise(levels),
+            in_specs=scale_spec + [
+                      pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
                       wspec, wspec, wspec, wspec,
-                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+                      smem],
             interpret=True,
             **common,
-        )(xp, wp, bp, sp, eps, sig)
+        )(*scale, xp, wp, bp, sp, eps, sig)
     return out[:m, :n]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def crossbar_matmul(x, w, broken, stuck, seed, sigma):
-    """y = x @ where(broken, stuck, w * (1 + sigma*eps)) as one fused
-    Pallas kernel (noise generated in VMEM, never materialized in HBM).
+# ---------------------------------------------------------------------------
+# config-batched sweep kernel: one (config, m, n, k) grid launch forms
+# every lane's faulty+noisy+quantized weights in VMEM — the per-lane
+# weight matrices never round-trip HBM (ROADMAP item 3 / ISSUE 7 (a))
+
+def _make_batched_kernel(q_levels: float, draw_noise: bool,
+                         x_batched: bool):
+    """The config-grid twin of `_make_crossbar_kernel`: grid axis 0 is
+    the config lane; each lane is seeded with ITS OWN seed word and the
+    SAME (j*nk + k) tile index, so per-lane noise streams are
+    bit-identical to per-lane single-config kernel launches — the
+    batched-vs-per-lane parity tests compare exactly, not
+    statistically. `draw_noise` is static: a sigma == 0 sweep (e.g. the
+    pure ternary operating point) skips the Box-Muller draw entirely.
+    `x_batched` is static: False streams ONE shared (M, K) input to
+    every lane (the genetic-search eval pattern); True gives each lane
+    its own input slab (the training sweep pattern — activations differ
+    per config because the upstream weights do)."""
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        if q_levels:
+            (seed_ref, scale_ref, x_ref, w_ref, broken_ref, stuck_ref,
+             sigma_ref, o_ref) = refs
+        else:
+            (seed_ref, x_ref, w_ref, broken_ref, stuck_ref, sigma_ref,
+             o_ref) = refs
+            scale_ref = None
+        c = pl.program_id(0)
+        j = pl.program_id(2)
+        k = pl.program_id(3)
+        nk = pl.num_programs(3)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        w = w_ref[0]
+        if draw_noise:
+            # per-lane seed word + the SAME (j*nk + k) tile index as
+            # the single-config kernel -> bit-identical per-lane noise
+            pltpu.prng_seed(seed_ref[c], j * nk + k)
+            eps = _gauss_tile(w.shape)
+        else:
+            eps = None
+        w_eff = _w_eff(w, broken_ref[0], stuck_ref[0],
+                       sigma_ref[0] if draw_noise else None, eps,
+                       q_levels, scale_ref[c] if q_levels else None)
+        xt = x_ref[0] if x_batched else x_ref[:]
+        o_ref[0] += jnp.dot(xt, w_eff,
+                            preferred_element_type=jnp.float32)
+    return kernel
+
+
+def _make_batched_kernel_hostnoise(q_levels: float, draw_noise: bool,
+                                   x_batched: bool):
+    """Interpret-mode twin of `_make_batched_kernel` (per-lane Gaussian
+    draws arrive as a (config, K, N) input)."""
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        refs = list(refs)
+        scale_ref = refs.pop(0) if q_levels else None
+        x_ref, w_ref, broken_ref, stuck_ref = refs[:4]
+        refs = refs[4:]
+        eps_ref = refs.pop(0) if draw_noise else None
+        sigma_ref, o_ref = refs
+        c = pl.program_id(0)
+
+        @pl.when(pl.program_id(3) == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        w_eff = _w_eff(w_ref[0], broken_ref[0], stuck_ref[0],
+                       sigma_ref[0] if draw_noise else None,
+                       eps_ref[0] if draw_noise else None,
+                       q_levels, scale_ref[c] if q_levels else None)
+        xt = x_ref[0] if x_batched else x_ref[:]
+        o_ref[0] += jnp.dot(xt, w_eff,
+                            preferred_element_type=jnp.float32)
+    return kernel
+
+
+def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
+                            bm=128, bn=128, bk=128):
+    """The config-batched launch: x (M, K) SHARED across lanes or
+    (C, M, K) per lane; w/broken/stuck (C, K, N) and seeds (C,) per
+    lane; one pallas_call over grid (C, gm, gn, gk). Every lane's
+    weight tile is formed in VMEM — per-lane weight matrices never
+    materialize in HBM."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    cfg = w.shape[0]
+    x_batched = x.ndim == 3
+    m, kdim = x.shape[-2:]
+    n = w.shape[2]
+
+    def pad2(a, r, c):
+        return jnp.pad(a, ((0, -a.shape[0] % r), (0, -a.shape[1] % c)))
+
+    def pad3(a, r, c):
+        return jnp.pad(a, ((0, 0), (0, -a.shape[1] % r),
+                           (0, -a.shape[2] % c)))
+
+    xp = pad3(x, bm, bk) if x_batched else pad2(x, bm, bk)
+    wp = pad3(w, bk, bn)
+    bp = pad3(broken, bk, bn)
+    sp = pad3(stuck, bk, bn)
+    gm, gk = xp.shape[-2] // bm, xp.shape[-1] // bk
+    gn = wp.shape[2] // bn
+    on_tpu = jax.default_backend() == "tpu"
+    levels = _q_levels(q_bits)
+    draw = bool(sigma)
+    # per-lane quantization grids (each config trains its own weights,
+    # so each lane has its own dynamic range — matching what
+    # quantize_ste computes per lane under the pure engine's vmap)
+    scale = ([jnp.max(jnp.abs(wp), axis=(1, 2))] if levels else [])
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    scale_spec = [smem] if levels else []
+    xspec = (pl.BlockSpec((1, bm, bk), lambda c, i, j, k: (c, i, k))
+             if x_batched
+             else pl.BlockSpec((bm, bk), lambda c, i, j, k: (i, k)))
+    wspec = pl.BlockSpec((1, bk, bn), lambda c, i, j, k: (c, k, j))
+    common = dict(
+        grid=(cfg, gm, gn, gk),
+        out_specs=pl.BlockSpec((1, bm, bn), lambda c, i, j, k: (c, i, j)),
+        out_shape=jax.ShapeDtypeStruct((cfg, xp.shape[-2], wp.shape[2]),
+                                       jnp.float32),
+    )
+    sig = jnp.asarray([sigma], jnp.float32)
+    if on_tpu:
+        out = pl.pallas_call(
+            _make_batched_kernel(levels, draw, x_batched),
+            in_specs=[smem] + scale_spec + [xspec, wspec, wspec, wspec,
+                                            smem],
+            **common,
+        )(jnp.asarray(seeds, jnp.int32), *scale, xp, wp, bp, sp, sig)
+    else:
+        eps = ([jax.vmap(lambda s: jax.random.normal(
+                    jax.random.PRNGKey(s), wp.shape[1:], jnp.float32))(
+                        seeds)] if draw else [])
+        eps_spec = [wspec] if draw else []
+        out = pl.pallas_call(
+            _make_batched_kernel_hostnoise(levels, draw, x_batched),
+            in_specs=scale_spec + [xspec, wspec, wspec, wspec]
+            + eps_spec + [smem],
+            interpret=True,
+            **common,
+        )(*scale, xp, wp, bp, sp, *eps, sig)
+    return out[:, :m, :n]
+
+
+@functools.lru_cache(maxsize=None)
+def _vmappable_forward(sigma: float, q_bits: int):
+    """The engine-dispatch seam between the single-config and the
+    config-batched kernel: an unbatched call lowers to the single
+    kernel; a vmap over (w, broken, stuck, seed) — the Monte-Carlo
+    sweep's config axis, with x either shared (genetic eval) or
+    per-config (the training sweep: upstream per-config weights batch
+    every activation) — dispatches to ONE config-grid launch; any other
+    pattern falls back to per-lane single kernels under lax.map
+    (identical numerics, no fusion)."""
+    import jax.custom_batching
+
+    @jax.custom_batching.custom_vmap
+    def fwd(x, w, broken, stuck, seed):
+        return _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits)
+
+    @fwd.def_vmap
+    def _rule(axis_size, in_batched, x, w, broken, stuck, seed):
+        xb, wb, bb, sb, seedb = in_batched
+        if wb and bb and sb and seedb:
+            out = _pallas_forward_batched(x, w, broken, stuck, seed,
+                                          sigma, q_bits)
+        else:
+            # mixed batching (e.g. per-lane fault masks with shared
+            # weights): run the single kernel per lane — unbatched
+            # operands stay closure-captured, nothing is
+            # broadcast-materialized
+            def one(i):
+                take = lambda v, b: v[i] if b else v
+                return _pallas_forward(
+                    take(x, xb), take(w, wb), take(broken, bb),
+                    take(stuck, sb), take(seed, seedb), sigma, q_bits)
+            out = jax.lax.map(one, jnp.arange(axis_size))
+        return out, True
+    return fwd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0):
+    """y = x @ where(broken, stuck, quantize(w) * (1 + sigma*eps)) as
+    one fused Pallas kernel (noise generated and the optional q_bits
+    ADC-grid quantization applied in VMEM, never materialized in HBM).
 
     x: (M, K) f32; w: (K, N) f32; broken: (K, N) bool; stuck: (K, N) f32;
-    seed: python int (static under jit); sigma: python float (static).
-    Backward is straight-through against the CLEAN masked weights."""
-    return _pallas_forward(x, w, broken.astype(jnp.float32),
-                           stuck.astype(jnp.float32), seed, sigma)
+    seed: int scalar (python or traced); sigma: python float (static);
+    q_bits: python int (static; 0 = no quantization, >= 2 = the
+    symmetric-uniform grid `quantize_ste` models). Backward is
+    straight-through against the CLEAN masked weights.
+
+    vmap over (w, broken, stuck, seed) — the sweep's config axis, with
+    x shared or per-config — dispatches to the config-batched kernel
+    (one launch for every lane, per-lane noise streams bit-identical to
+    per-lane single launches); see the ENGINE MATRIX in the module
+    docstring."""
+    return _vmappable_forward(float(sigma), int(q_bits))(
+        x, w, broken.astype(jnp.float32), stuck.astype(jnp.float32),
+        seed)
 
 
-def _cm_fwd(x, w, broken, stuck, seed, sigma):
-    y = crossbar_matmul(x, w, broken, stuck, seed, sigma)
+def _cm_fwd(x, w, broken, stuck, seed, sigma, q_bits):
+    y = crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits)
     return y, (x, w, broken, stuck)
 
 
-def _cm_bwd(sigma, res, g):
+def _cm_bwd(sigma, q_bits, res, g):
     x, w, broken, stuck = res
-    w_masked = jnp.where(broken, stuck.astype(w.dtype), w)
+    wv = w
+    if q_bits:
+        # dx flows through the values the forward actually used: the
+        # ADC-grid weights (quantize_ste's STE differentiates x @ w_eff
+        # with w_eff on the grid). dw stays straight-through to the
+        # clean master weights.
+        wv = _quantize_tile(w, jnp.max(jnp.abs(w)), _q_levels(q_bits))
+    w_masked = jnp.where(broken, stuck.astype(w.dtype), wv)
     dx = g @ w_masked.T
     dw = x.T @ g
     # stuck cells take no gradient (their stored value is clamped by the
@@ -202,7 +518,11 @@ def _cm_bwd(sigma, res, g):
 crossbar_matmul.defvjp(_cm_fwd, _cm_bwd)
 
 
-def reference_crossbar_matmul(x, w, broken, stuck, key, sigma: float):
+def reference_crossbar_matmul(x, w, broken, stuck, key, sigma: float,
+                              q_bits: int = 0):
     """Pure-JAX semantic reference for crossbar_matmul (exact match at
-    sigma == 0; same distribution otherwise, different noise stream)."""
-    return x @ perturb_weight(w, broken, stuck, key, sigma)
+    sigma == 0; same distribution otherwise, different noise stream).
+    `q_bits` mirrors the kernel's in-VMEM quantization through
+    `quantize_ste` — same grid, same straight-through forward values."""
+    wq = quantize_ste(w, q_bits) if q_bits else w
+    return x @ perturb_weight(wq, broken, stuck, key, sigma)

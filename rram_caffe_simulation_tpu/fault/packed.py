@@ -1,0 +1,273 @@
+"""Bit-packed fault-state banks: the per-cell state the sweep reads every
+step for every config, at ~2.25 bytes/cell instead of 8.
+
+The f32 engine (engine.py) carries two f32 leaves per cell — a lifetime
+and a stuck value in {-1, 0, +1} — plus a derived broken mask. But the
+step only ever *compares lifetimes to zero* and *decrements them by the
+static write quantum* (`decrement`, the reference's hard-coded batch
+size 100, failure_maker.cpp:75), so the full f32 width is dead weight on
+the sweep's hottest resident state. The packed layout keeps exactly the
+information the transition function uses:
+
+- ``life_q``   — integer *write counters*: ``ceil(lifetime / decrement)``,
+  int16 when the operating point's range fits (chosen analytically from
+  the mean/std grid at pack time, ``choose_life_dtype``), int32
+  otherwise (the paper's 1e8-write endurance point needs int32). One
+  write decrements the counter by exactly 1; a cell is broken iff its
+  counter is <= 0 — the exact-arithmetic timeline:
+  ``life0 - k*decrement <= 0  <=>  ceil(life0/decrement) - k <= 0``.
+- ``stuck_bits`` — 2-bit stuck codes (value+1 in {0,1,2}), four cells per
+  uint8 lane along the last axis.
+
+There is deliberately NO broken-mask bank: broken is ``life_q <= 0``,
+readable from any checkpoint with no extra metadata, and a packed bit
+bank would have to be re-derived and re-written on the scan carry every
+step — pure waste on exactly the bytes this format exists to shrink.
+
+Timeline caveat at extreme means: the identity above assumes the f32
+engine's own subtraction is exact. Below ~2^24 (every int16 operating
+point, and the small-lifetime tail that actually breaks in any run) it
+is, and the two engines agree bit for bit. At f32 magnitudes whose ulp
+exceeds the decrement (the 1e8-write endurance point: ulp(1e8) = 8, so
+``life - 100`` rounds every write) the f32 engine accumulates rounding
+drift of ~50 writes per million — there the integer counters are the
+MORE faithful write-count semantics, not a bit-copy of the reference's
+rounding. scripts/check_kernel_parity.py pins the exact regime.
+
+Unpacking a lifetime returns the *mid-bin* value ``(q - 0.5)*decrement``:
+every zero-comparison the engine and the mitigation strategies perform
+(``> 0`` alive, ``<= 0`` broken, ``< 0`` remap flag) then agrees exactly
+with the packed semantics, and ``pack(unpack(q)) == q`` bit-for-bit —
+including negative counters from the init distribution's tail. What IS
+quantized (once, at pack time) is the sub-decrement remainder of the
+initial draw; observe-package lifetime min/mean counters consequently
+read at decrement resolution. Fault *transitions* (who breaks when, and
+to what stuck value) are exact — scripts/check_kernel_parity.py is the
+CI guard.
+
+Packing/unpacking of whole states runs on host at the sweep boundary
+(build, checkpoint up/down-grade, lane refill); inside the jitted step
+only `fail_packed` (native integer decrement + in-register stuck
+unpack) and `unpacked_view` (fused elementwise view for the strategy /
+counter consumers) run, so the scan carry — the bytes HBM moves every
+iteration — stays packed.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import engine as fault_engine
+
+#: groups a packed fault state carries (remap_slots passes through);
+#: broken is derived (life_q <= 0), never stored — see module docstring
+PACKED_GROUPS = ("life_q", "stuck_bits")
+
+#: sigma margin when sizing the lifetime counter dtype from the
+#: (mean, std) grid: P(|z| > 12) ~ 1e-33 per cell
+LIFE_DTYPE_MARGIN = 12.0
+
+
+def is_packed(state) -> bool:
+    """True for a packed fault state (engine.FaultState carries
+    "lifetimes"/"stuck"; the packed twin carries the bank groups)."""
+    return state is not None and "life_q" in state
+
+
+def choose_life_dtype(means, stds, decrement: float) -> str:
+    """"int16" when every configured (mean, std) pair keeps the
+    write-count range inside int16 with a 12-sigma margin, else
+    "int32". The choice is analytic (distribution bounds, not the
+    sample) so a later lane refill drawing from the same spec can never
+    overflow a bank sized here."""
+    means = np.atleast_1d(np.asarray(means, np.float64))
+    stds = np.atleast_1d(np.asarray(stds, np.float64))
+    hi = float(np.max(means + LIFE_DTYPE_MARGIN * stds)) / decrement
+    lo = float(np.min(means - LIFE_DTYPE_MARGIN * stds)) / decrement
+    if -32000.0 < lo and hi < 32000.0:
+        return "int16"
+    return "int32"
+
+
+def make_pack_spec(state: "fault_engine.FaultState", decrement: float,
+                   means=None, stds=None, pattern=None) -> dict:
+    """The static packing parameters: decrement (write quantum),
+    counter dtype, and each leaf's true last-axis length (the packed
+    banks pad it to a lane multiple). `state` may be single-config or
+    config-stacked — the last axis is the packing axis either way."""
+    if means is None:
+        means = [float(pattern.mean)] if pattern is not None else [0.0]
+    if stds is None:
+        stds = [float(pattern.std)] if pattern is not None else [0.0]
+    return {
+        "decrement": float(decrement),
+        "life_dtype": choose_life_dtype(means, stds, decrement),
+        "last_dim": {k: int(v.shape[-1])
+                     for k, v in state["lifetimes"].items()},
+    }
+
+
+def check_spec_bounds(spec: dict, mean: float, std: float):
+    """Raise if a (mean, std) spec could overflow the counter dtype the
+    banks were sized with (a self-healing extra-config spec added after
+    the int16 choice was frozen)."""
+    if spec["life_dtype"] == "int32":
+        return
+    if choose_life_dtype([mean], [std], spec["decrement"]) != "int16":
+        raise ValueError(
+            f"fault spec (mean={mean}, std={std}) exceeds the int16 "
+            "lifetime banks this packed sweep was built with; build the "
+            "runner with this spec present (the dtype choice covers "
+            "every known spec) or with packed_state=False")
+
+
+# ---------------------------------------------------------------------------
+# leaf-level pack/unpack
+
+def pack_lifetimes(life, decrement: float, dtype) -> np.ndarray:
+    """f32 lifetimes -> integer write counters (host, float64 division
+    so the 1e8 operating point's ceil lands on the right side)."""
+    q = np.ceil(np.asarray(life, np.float64) / float(decrement))
+    info = np.iinfo(np.dtype(dtype))
+    if q.size and (q.min() < info.min or q.max() > info.max):
+        raise ValueError(
+            f"lifetime write-counts [{q.min():.0f}, {q.max():.0f}] do "
+            f"not fit {np.dtype(dtype).name} banks")
+    # ceil(-0.x) is -0.0; + 0.0 normalizes so the int cast is exact
+    return (q + 0.0).astype(dtype)
+
+
+def unpack_lifetimes(life_q, decrement: float):
+    """Integer write counters -> mid-bin f32 lifetimes. Zero
+    comparisons (> 0, <= 0, < 0) agree exactly with the counter's, and
+    `pack_lifetimes` inverts this exactly (ceil(q - 0.5) == q)."""
+    return (life_q.astype(jnp.float32) - 0.5) * float(decrement)
+
+
+def pack_stuck(stuck) -> np.ndarray:
+    """Stuck values in {-1, 0, +1} -> 2-bit codes, 4 cells per uint8
+    along the last axis (host-side; stuck never changes in-step)."""
+    codes = (np.asarray(stuck) + 1.0).astype(np.uint8)  # {0,1,2}
+    pad = -codes.shape[-1] % 4
+    if pad:
+        codes = np.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, pad)])
+    codes = codes.reshape(codes.shape[:-1] + (-1, 4))
+    shifts = np.arange(4, dtype=np.uint8) * 2
+    return np.bitwise_or.reduce(codes << shifts, axis=-1).astype(np.uint8)
+
+
+def unpack_stuck(bank, last_dim: int):
+    """uint8 2-bit banks -> f32 stuck values shaped (..., last_dim).
+    jit/vmap-safe: the per-step consumers (fail clamp, crossbar stuck
+    tiles) unpack in fused elementwise ops, never storing the wide
+    form between steps."""
+    parts = [((bank >> (2 * i)) & 3) for i in range(4)]
+    codes = jnp.stack(parts, axis=-1).reshape(bank.shape[:-1] + (-1,))
+    return codes[..., :last_dim].astype(jnp.float32) - 1.0
+
+
+# ---------------------------------------------------------------------------
+# state-level pack/unpack (host boundary)
+
+def pack_state(state: "fault_engine.FaultState", spec: dict) -> dict:
+    """f32 FaultState -> packed banks (host). Extra groups
+    (remap_slots) ride along untouched."""
+    d, dtype = spec["decrement"], np.dtype(spec["life_dtype"])
+    life_q, stuck_bits = {}, {}
+    for k, life in state["lifetimes"].items():
+        life_q[k] = pack_lifetimes(life, d, dtype)
+        stuck_bits[k] = pack_stuck(state["stuck"][k])
+    out = {"life_q": life_q, "stuck_bits": stuck_bits}
+    for group in state:
+        if group not in ("lifetimes", "stuck"):
+            out[group] = state[group]
+    return out
+
+
+def unpack_state(packed: dict, spec: dict) -> "fault_engine.FaultState":
+    """Packed banks -> f32 FaultState (mid-bin lifetimes; see module
+    docstring for what that preserves exactly)."""
+    d = spec["decrement"]
+    lifetimes = {k: np.asarray(unpack_lifetimes(np.asarray(q), d))
+                 for k, q in packed["life_q"].items()}
+    stuck = {k: np.asarray(unpack_stuck(np.asarray(b),
+                                        spec["last_dim"][k]))
+             for k, b in packed["stuck_bits"].items()}
+    out: "fault_engine.FaultState" = {"lifetimes": lifetimes,
+                                      "stuck": stuck}
+    for group in packed:
+        if group not in PACKED_GROUPS:
+            out[group] = packed[group]
+    return out
+
+
+def convert_flat(arrays: Dict[str, np.ndarray], to_packed: bool,
+                 spec: dict) -> Dict[str, np.ndarray]:
+    """Convert a flat {"group/key": array} fault mapping (the
+    checkpoint / save_fault_states layout, engine.state_to_arrays)
+    between formats — the v2<->v3 checkpoint upgrade path."""
+    state = fault_engine.state_from_arrays(arrays)
+    if to_packed:
+        if is_packed(state):
+            return dict(arrays)
+        state = pack_state(state, spec)
+    else:
+        if not is_packed(state):
+            return dict(arrays)
+        state = unpack_state(state, spec)
+    return {name: np.asarray(v)
+            for name, v in fault_engine.iter_state_leaves(state)}
+
+
+# ---------------------------------------------------------------------------
+# in-step packed engine
+
+def unpacked_view(state: dict, spec: dict) -> "fault_engine.FaultState":
+    """A traced f32 view of a packed state for the engine's read-side
+    consumers (strategy flag matrices, fault counters, the hw-aware
+    broken/stuck masks). Fused elementwise — the view is never a scan
+    carry. Mid-bin lifetimes keep every zero-comparison exact."""
+    d = spec["decrement"]
+    view: "fault_engine.FaultState" = {
+        "lifetimes": {k: unpack_lifetimes(q, d)
+                      for k, q in state["life_q"].items()},
+        "stuck": {k: unpack_stuck(b, spec["last_dim"][k])
+                  for k, b in state["stuck_bits"].items()},
+    }
+    for group in state:
+        if group not in PACKED_GROUPS:
+            view[group] = state[group]
+    return view
+
+
+def fail_packed(fault_params: Dict[str, jax.Array], state: dict,
+                fault_diffs: Dict[str, jax.Array],
+                spec: dict) -> Tuple[Dict[str, jax.Array], dict]:
+    """engine.fail on the packed banks: the write decrement is a native
+    integer -1 on the counter bank, the stuck clamp unpacks its 2-bit
+    codes in-register, and broken stays derived (`life_q <= 0`) — the
+    wide f32 state never exists between steps. Timeline identical to
+    engine.fail (see module docstring)."""
+    new_params, new_life = {}, {}
+    for name, data in fault_params.items():
+        lq = state["life_q"][name]
+        diff = fault_diffs[name]
+        alive = lq > 0
+        written = jnp.abs(diff) >= fault_engine.EPSILON
+        lq2 = jnp.where(alive & written, lq - np.asarray(1, lq.dtype), lq)
+        broken = lq2 <= 0
+        stuck = unpack_stuck(state["stuck_bits"][name],
+                             spec["last_dim"][name])
+        new_params[name] = jnp.where(broken, stuck.astype(data.dtype),
+                                     data)
+        new_life[name] = lq2
+    return new_params, {**state, "life_q": new_life}
+
+
+def packed_nbytes(arrays: Dict[str, np.ndarray]) -> int:
+    """Total bytes of a flat fault mapping — the checkpoint-shrink
+    assertion's measure."""
+    return int(sum(np.asarray(v).nbytes for v in arrays.values()))

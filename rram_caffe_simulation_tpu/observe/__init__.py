@@ -28,15 +28,17 @@ from .counters import global_norm_sq, mean_abs, to_host, write_traffic_saved
 from .debug import OVERFLOW_LIMIT, PHASES, NetDebugSpec, sentinel_tree
 from .schema import SCHEMA_VERSION, validate_record
 from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
-                   debug_trace_lines, make_record, make_retry_record,
-                   make_setup_record, retry_line, sentinel_line,
-                   setup_line)
+                   debug_trace_lines, fault_redraw_line,
+                   make_fault_redraw_record, make_record,
+                   make_retry_record, make_setup_record, retry_line,
+                   sentinel_line, setup_line)
 from .trace import trace
 
 __all__ = [
     "SCHEMA_VERSION", "validate_record",
     "MetricsLogger", "JsonlSink", "CaffeLogSink", "make_record",
     "make_retry_record", "make_setup_record", "setup_line", "retry_line",
+    "make_fault_redraw_record", "fault_redraw_line",
     "debug_trace_lines", "sentinel_line",
     "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",
     "NetDebugSpec", "sentinel_tree", "PHASES", "OVERFLOW_LIMIT",

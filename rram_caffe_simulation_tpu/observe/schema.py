@@ -152,6 +152,8 @@ DEBUG_UPDATE_FIELDS = {
 #  "setup_seconds": 136.6,                       # caller's total wall
 #  "cache": {"compile": "hit", "dataset": "miss"},
 #  "cache_dir": "/var/cache/rram-tpu",
+#  "bytes_per_step_est": 1234567890,             # sweep runs only
+#  "fault_state_format": "packed",               # "f32" | "packed"
 #  "pipeline": {"depth": 2, "chunks": 100, "records": 100,
 #               "host_blocked_seconds": 0.021,
 #               "consumer_seconds": 3.4, "drain_seconds": 0.8,
@@ -165,6 +167,14 @@ DEBUG_UPDATE_FIELDS = {
 # (compile cache only), "disabled" = no cache dir configured,
 # "unused" = cache configured but this run had no such work (e.g. an
 # Input-fed bench performs no dataset decode).
+#
+# `bytes_per_step_est` (optional, sweep runs) is the runner's
+# estimated HBM bytes moved per sweep iteration (resident state read +
+# write, plus the dataset batch gather; activations excluded) and
+# `fault_state_format` the fault-bank layout behind it ("f32" = the
+# reference's float leaves, "packed" = the bit-packed counter banks of
+# fault/packed.py) — the fields the HBM-floor trajectory (BENCH r06+)
+# tracks.
 #
 # `pipeline` (optional) is the async-execution-layer accounting
 # (async_exec.PipelineStats): `depth` 0 = synchronous bookkeeping,
@@ -180,6 +190,8 @@ DEBUG_UPDATE_FIELDS = {
 
 SETUP_CACHE_STATES = ("hit", "miss", "partial", "disabled", "unused")
 
+FAULT_STATE_FORMATS = ("f32", "packed")
+
 SETUP_FIELDS = {
     "schema_version": (int, True),
     "type": (str, True),
@@ -190,6 +202,8 @@ SETUP_FIELDS = {
     "cache": (dict, True),
     "cache_dir": (str, False),
     "pipeline": (dict, False),
+    "bytes_per_step_est": (int, False),
+    "fault_state_format": (str, False),
 }
 
 SETUP_CACHE_FIELDS = {
@@ -245,6 +259,30 @@ RETRY_FIELDS = {
     "recovery": (str, False),       # reseed events only
     "eligible_iter": (int, False),  # requeue events: backoff target
     "diagnosis": (str, False),      # failed events: triage attribution
+}
+
+# --- fault_redraw records (restore fallback announcement) ---
+#
+# Emitted by Solver.restore when a snapshot PREDATES fault-state
+# capture (no .faultstate file next to the .solverstate): the run
+# continues with the freshly drawn lifetimes/stuck values from
+# construction — the reference's silent re-draw semantics
+# (failure_maker.cpp never snapshots fail_iterations_) — and this
+# record is the loud trail of that divergence from the
+# checkpoint-exact contract::
+#
+#     {"schema_version": 1, "type": "fault_redraw", "iter": 4000,
+#      "wall_time": 1722700000.1,
+#      "snapshot": "/runs/q_iter_4000.faultstate",
+#      "reason": "snapshot predates fault-state capture"}
+
+FAULT_REDRAW_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "snapshot": (str, True),    # the .faultstate path that was missing
+    "reason": (str, True),
 }
 
 # --- sentinel records (tripped numeric-health flags) ---
@@ -346,11 +384,16 @@ def _validate_setup(rec) -> list:
             if isinstance(val, str) and val not in SETUP_CACHE_STATES:
                 errs.append(f"setup.cache.{key}: unknown state {val!r} "
                             f"(expected one of {SETUP_CACHE_STATES})")
-    for key in ("decode_seconds", "compile_seconds", "setup_seconds"):
+    for key in ("decode_seconds", "compile_seconds", "setup_seconds",
+                "bytes_per_step_est"):
         val = rec.get(key)
         if isinstance(val, _NUM) and not isinstance(val, bool) \
                 and val < 0:
             errs.append(f"setup.{key}: must be >= 0")
+    fmt = rec.get("fault_state_format")
+    if isinstance(fmt, str) and fmt not in FAULT_STATE_FORMATS:
+        errs.append(f"setup.fault_state_format: unknown format {fmt!r} "
+                    f"(expected one of {FAULT_STATE_FORMATS})")
     pipe = rec.get("pipeline")
     if isinstance(pipe, dict):
         errs += _check_fields(pipe, PIPELINE_FIELDS, "setup.pipeline")
@@ -378,6 +421,16 @@ def _validate_retry(rec) -> list:
         if isinstance(val, int) and not isinstance(val, bool) \
                 and val < lo:
             errs.append(f"retry: {key} must be >= {lo}")
+    return errs
+
+
+def _validate_fault_redraw(rec) -> list:
+    errs = _check_fields(rec, FAULT_REDRAW_FIELDS, "fault_redraw")
+    errs += _check_iter(rec, "fault_redraw")
+    for key in ("snapshot", "reason"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"fault_redraw: {key} must be non-empty")
     return errs
 
 
@@ -411,6 +464,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_setup(rec)
     if rtype == "retry":
         return _check_version(rec) + _validate_retry(rec)
+    if rtype == "fault_redraw":
+        return _check_version(rec) + _validate_fault_redraw(rec)
     if rtype is not None:
         return [f"record: unknown record type {rtype!r}"]
     errs = _check_fields(rec, TOP_LEVEL, "record")

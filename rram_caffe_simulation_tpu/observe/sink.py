@@ -125,11 +125,37 @@ def retry_line(record: dict) -> str:
     return head + tail
 
 
+def make_fault_redraw_record(iteration: int, snapshot: str,
+                             reason: str) -> dict:
+    """The restore-fallback announcement (schema.py
+    FAULT_REDRAW_FIELDS): a snapshot with no fault-state file resumed
+    with the construction-time fresh draw — the reference's silent
+    re-draw semantics, made loud."""
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "type": "fault_redraw",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "snapshot": str(snapshot),
+        "reason": str(reason),
+    }
+
+
+def fault_redraw_line(record: dict) -> str:
+    """One-line text form of a `fault_redraw` record."""
+    return (f"Fault state RE-DRAWN at iteration {record.get('iter')}: "
+            f"{record.get('reason')} (expected "
+            f"{record.get('snapshot')}); resumed degradation will NOT "
+            "match the pre-snapshot trajectory")
+
+
 def make_setup_record(decode_s: float, compile_s: float,
                       compile_status: str, dataset_status: str,
                       cache_dir: Optional[str] = None,
                       setup_s: Optional[float] = None,
-                      pipeline: Optional[dict] = None) -> dict:
+                      pipeline: Optional[dict] = None,
+                      bytes_per_step_est: Optional[int] = None,
+                      fault_state_format: Optional[str] = None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -138,7 +164,9 @@ def make_setup_record(decode_s: float, compile_s: float,
     `pipeline` is the async-execution-layer accounting sub-record
     (async_exec.PipelineStats.record): host-blocked seconds per run,
     consumer concurrency, off-loop snapshot writes, group-setup
-    overlap."""
+    overlap. `bytes_per_step_est` / `fault_state_format` are the
+    HBM-floor fields (SweepRunner.bytes_per_step_est; "f32" |
+    "packed") the bytes-per-step trajectory tracks."""
     rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "setup",
@@ -153,6 +181,10 @@ def make_setup_record(decode_s: float, compile_s: float,
         rec["cache_dir"] = cache_dir
     if pipeline:
         rec["pipeline"] = dict(pipeline)
+    if bytes_per_step_est is not None:
+        rec["bytes_per_step_est"] = int(bytes_per_step_est)
+    if fault_state_format is not None:
+        rec["fault_state_format"] = str(fault_state_format)
     return rec
 
 
@@ -360,6 +392,10 @@ class CaffeLogSink:
             return
         if rtype == "retry":
             self._emit(retry_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "fault_redraw":
+            self._emit(fault_redraw_line(record))
             self._maybe_flush()
             return
         if rtype is not None:

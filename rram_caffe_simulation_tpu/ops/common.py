@@ -58,17 +58,20 @@ class InnerProductLayer(Layer):
         cb = getattr(ctx, "crossbar", None)
         cb = cb.get(self.name) if cb else None
         if cb is not None:
-            # Fused Pallas crossbar read: stuck mask + conductance noise +
-            # matmul in one kernel, noise drawn in VMEM (never in HBM).
-            # broken/stuck are shaped like the STORED weight.
+            # Fused Pallas crossbar read: stuck mask + conductance noise
+            # + optional ADC-grid quantization + matmul in one kernel,
+            # noise drawn and the grid applied in VMEM (never in HBM).
+            # broken/stuck are shaped like the STORED weight. Under the
+            # sweep's config vmap this dispatches to the config-batched
+            # kernel (fault/hw_aware.py ENGINE MATRIX).
             from ..fault.hw_aware import crossbar_matmul
-            broken, stuck, seed, sigma = cb
+            broken, stuck, seed, sigma, q_bits = cb
             y = crossbar_matmul(
                 x.astype(jnp.float32),
                 (w if self.transpose else w.T).astype(jnp.float32),
                 broken if self.transpose else broken.T,
                 (stuck if self.transpose else stuck.T).astype(jnp.float32),
-                seed, sigma).astype(bottoms[0].dtype)
+                seed, sigma, q_bits).astype(bottoms[0].dtype)
         else:
             y = jnp.dot(x, w if self.transpose else w.T,
                         preferred_element_type=bottoms[0].dtype)

@@ -37,7 +37,11 @@ def make_dp_step(solver, mesh: Mesh):
     axis via `shard_batch`. GSPMD inserts the gradient all-reduce.
     Returns (jitted_step, place_state).
     """
+    # hw_engine="jax": the fused pallas crossbar kernel has no GSPMD
+    # partitioning rule, so the dp wrapper pins the pure path like
+    # tp/pp/sp do (ENGINE MATRIX, fault/hw_aware.py)
     step = solver.make_train_step(
+        hw_engine="jax",
         compute_dtype=getattr(solver, "compute_dtype", None))
     repl = replicated(mesh)
 

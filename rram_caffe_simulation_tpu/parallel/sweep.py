@@ -28,9 +28,12 @@ from .mesh import make_mesh
 
 #: SweepRunner.checkpoint file format version (bumped on layout changes).
 #: v2 added the self-healing lane->config indirection (lane_map /
-#: lane_done / retry queue); restore() upgrades a v1 checkpoint by
-#: assuming the identity lane map and refuses anything else.
-CHECKPOINT_VERSION = 2
+#: lane_done / retry queue); v3 added the bit-packed fault-state banks
+#: (`fault_format` + `pack_spec` meta — fault/packed.py) and shrinks
+#: the per-config fault payload ~4x. restore() upgrades v1 (identity
+#: lane map assumed) and v2 (f32 fault leaves converted to the
+#: runner's format) checkpoints in place and refuses anything else.
+CHECKPOINT_VERSION = 3
 
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
@@ -139,10 +142,32 @@ class SweepRunner:
                  remat_segments: int = 0, config_block: int = 0,
                  precompile_chunk: int = 0,
                  pipeline_depth: Optional[int] = None,
-                 stall_timeout_s: Optional[float] = None):
+                 stall_timeout_s: Optional[float] = None,
+                 engine: str = "jax", packed_state: bool = False,
+                 dtype_policy=None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
+        # the bytes-per-step attack surface (ROADMAP item 3 / ISSUE 7):
+        # `engine` picks the hardware-aware forward ("jax" = the pure
+        # semantic-reference path, the byte-identical default; "pallas"
+        # = the config-batched fused crossbar kernel — the vmap over
+        # lanes dispatches to ONE (config, m, n, k)-grid launch);
+        # `packed_state` swaps the f32 fault leaves for the bit-packed
+        # banks (fault/packed.py, ~4x less resident fault HBM, fault
+        # transitions identical); `dtype_policy` ("ternary" | "int8")
+        # quantizes the fault-target weight reads through the
+        # quantize_ste ADC grid. See fault/hw_aware.py ENGINE MATRIX.
+        if engine == "auto":
+            engine = "jax"     # sweeps opt in to pallas explicitly
+        if engine not in ("jax", "pallas"):
+            raise ValueError(
+                f"unknown sweep engine {engine!r} (expected 'jax', "
+                "'pallas', or 'auto' — see the ENGINE MATRIX in "
+                "fault/hw_aware.py)")
+        self.engine = engine
+        self.dtype_policy = dtype_policy
+        self._pack_spec = None
         self.solver = solver
         self.n = n_configs
         self._closed = False
@@ -223,6 +248,18 @@ class SweepRunner:
         # runner switches the counters on)
         self.last_metrics = {}
 
+        if engine == "pallas" and set(self.mesh.axis_names) - {"config"}:
+            raise ValueError(
+                "SweepRunner(engine='pallas') supports config-only "
+                "meshes: the fused crossbar kernel has no GSPMD "
+                "partitioning rule for 'data'/'model' axes (the jax "
+                "engine shards everywhere — ENGINE MATRIX, "
+                "fault/hw_aware.py)")
+        if packed_state and "model" in self.mesh.axis_names:
+            raise ValueError(
+                "packed_state=True is not supported on a 'model'-axis "
+                "mesh: the TP PartitionSpecs split the weight dims the "
+                "uint8 banks pack 4/8-to-a-byte along")
         flat = solver._flat(solver.params)
         shapes = {k: flat[k].shape for k in solver._fault_keys}
         key = jax.random.fold_in(solver._key, 0xFA117)
@@ -234,6 +271,24 @@ class SweepRunner:
             # tracked remapping: every config starts at the identity map
             self.fault_states["remap_slots"] = jax.tree.map(
                 bcast, solver.fault_state["remap_slots"])
+        if packed_state:
+            # bit-pack the freshly stacked f32 draw into the resident
+            # banks (host, once at build): the counter dtype is sized
+            # analytically from EVERY configured (mean, std) so later
+            # lane refills drawing from the same specs can never
+            # overflow the banks
+            from ..fault import packed as fault_packed
+            fp_pat = solver.param.failure_pattern
+            self._pack_spec = fault_packed.make_pack_spec(
+                solver.fault_state, solver.fail_decrement,
+                means=(self._means if self._means is not None
+                       else [float(fp_pat.mean)]),
+                stds=(self._stds if self._stds is not None
+                      else [float(fp_pat.std)]))
+            self.fault_states = jax.tree.map(
+                jnp.asarray,
+                fault_packed.pack_state(self.fault_states,
+                                        self._pack_spec))
         self.params = jax.tree.map(bcast, solver.params)
         self.history = jax.tree.map(bcast, solver.history)
 
@@ -252,11 +307,15 @@ class SweepRunner:
                 g._rng = np.random.RandomState(g.seed)
                 self._genetics.append(g)
 
-        # Force the pure-JAX hardware-aware engine: the Monte-Carlo config
-        # axis vmaps the whole step, and perturb_weight vmaps cleanly
-        # where the Pallas crossbar kernel would not. compute_dtype (e.g.
-        # "bfloat16") halves the sweep's activation HBM traffic while
-        # masters/updates/fault state stay f32 (see make_train_step).
+        # Engine choice (ENGINE MATRIX, fault/hw_aware.py): "jax" vmaps
+        # the pure perturb_weight/quantize_ste path per config — the
+        # semantic reference and the byte-identical default; "pallas"
+        # vmaps the SAME step, but crossbar_matmul's custom_vmap rule
+        # collapses the config axis into one config-grid kernel launch,
+        # so per-lane faulty+noisy weights are formed in VMEM and never
+        # round-trip HBM. compute_dtype (e.g. "bfloat16") halves the
+        # sweep's activation HBM traffic while masters/updates/fault
+        # state stay f32 (see make_train_step).
         if compute_dtype is None:
             compute_dtype = getattr(solver, "compute_dtype", None)
         # remat_segments > 1: checkpointed segment forward (net/remat.py)
@@ -266,9 +325,18 @@ class SweepRunner:
         if remat_segments and remat_segments > 1:
             from ..net.remat import make_remat_apply
             apply_fn = make_remat_apply(solver.net, remat_segments)
-        base = solver.make_train_step(hw_engine="jax",
-                                      compute_dtype=compute_dtype,
-                                      apply_fn=apply_fn)
+        base = solver.make_train_step(
+            hw_engine=engine, compute_dtype=compute_dtype,
+            apply_fn=apply_fn, dtype_policy=dtype_policy,
+            fault_format="packed" if packed_state else "f32",
+            pack_spec=self._pack_spec)
+        # `engine` is the REQUEST; this is what actually runs — the
+        # fused kernel only engages when there is a per-lane weight
+        # materialization to eliminate (sigma > 0 or an ADC-grid
+        # policy; make_train_step's use_pallas gate), so engine="pallas"
+        # at sigma == 0 with no dtype_policy resolves to "jax". Bench
+        # attribution and any "which engine ran" reporting read THIS.
+        self.engine_resolved = getattr(base, "hw_engine_resolved", "jax")
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
@@ -456,6 +524,14 @@ class SweepRunner:
             self._cfg_specs[cfg] = {
                 "mean": float(spec.get("mean", fp.mean)),
                 "std": float(spec.get("std", fp.std))}
+            if self._pack_spec is not None:
+                # a spec queued AFTER the int16/int32 bank choice was
+                # frozen must still fit the banks — refuse now, not at
+                # an overflow deep inside a lane refill
+                from ..fault import packed as fault_packed
+                fault_packed.check_spec_bounds(
+                    self._pack_spec, self._cfg_specs[cfg]["mean"],
+                    self._cfg_specs[cfg]["std"])
             h.pending.append({"config": cfg, "attempt": 1,
                               "eligible_iter": int(self.iter)})
         self._healing = h
@@ -540,6 +616,13 @@ class SweepRunner:
         if "remap_slots" in (s.fault_state or {}):
             # tracked remapping restarts at the identity map
             st["remap_slots"] = s.fault_state["remap_slots"]
+        if self._pack_spec is not None:
+            # packed sweeps refill lanes in bank format (the dtype was
+            # sized for every known spec at build, so this cannot
+            # overflow; extra-config specs are bounds-checked at
+            # enable_self_healing time)
+            from ..fault import packed as fault_packed
+            st = fault_packed.pack_state(st, self._pack_spec)
         for name, v in fault_engine.iter_state_leaves(st):
             rows[f"fault/{name}"] = np.asarray(v)
         return rows
@@ -580,6 +663,27 @@ class SweepRunner:
                 genetic = pickle.loads(bytes(bytearray(gen)))[j]
             rows = {name: arr[j] for name, arr in data.items()
                     if name != "quarantine"}
+            # cross-format recovery: after restore() of a checkpoint in
+            # the OTHER fault format, _last_ckpt_path still points at
+            # that file — convert its fault rows to this runner's
+            # layout (same upgrade path as restore()) instead of
+            # degrading to a fresh re-init on the key mismatch
+            ck_fmt = meta.get("fault_format", "f32")
+            ck_spec = meta.get("pack_spec")
+            my_fmt = "packed" if self._pack_spec is not None else "f32"
+            if ck_fmt != my_fmt or (ck_fmt == "packed"
+                                    and ck_spec != self._pack_spec):
+                from ..fault import packed as fault_packed
+                bare = {n[len("fault/"):]: rows.pop(n)
+                        for n in [n for n in rows
+                                  if n.startswith("fault/")]}
+                if ck_fmt == "packed":
+                    bare = fault_packed.convert_flat(
+                        bare, to_packed=False, spec=ck_spec)
+                if my_fmt == "packed":
+                    bare = fault_packed.convert_flat(
+                        bare, to_packed=True, spec=self._pack_spec)
+                rows.update({f"fault/{n}": a for n, a in bare.items()})
             expected = set(self._state_arrays()) - {"quarantine"}
             if set(rows) != expected:
                 return None
@@ -639,9 +743,11 @@ class SweepRunner:
 
     def _lane_broken(self, lane: int) -> float:
         """Broken-cell fraction of one lane's fault-state slice (the
-        single census definition: fault_engine.broken_fraction)."""
-        sl = {"lifetimes": {k: v[lane] for k, v in
-                            self.fault_states["lifetimes"].items()}}
+        single census definition: fault_engine.broken_fraction, which
+        reads the f32 lifetimes or the packed counter banks alike)."""
+        group = "life_q" if "life_q" in self.fault_states else "lifetimes"
+        sl = {group: {k: v[lane] for k, v in
+                      self.fault_states[group].items()}}
         return float(fault_engine.broken_fraction(sl))
 
     def _emit_retry(self, rec: dict):
@@ -1049,16 +1155,37 @@ class SweepRunner:
             del self._chunk_fns[key]
             return self._run_chunk(k, *args)
 
+    def bytes_per_step_est(self) -> int:
+        """Estimated HBM bytes one sweep iteration moves: every
+        resident state leaf (config-stacked params, momentum history,
+        fault banks, quarantine mask) is read and written once per
+        step, plus the batch-gather read from the device dataset.
+        Activations are excluded (shape-dependent and largely fused) —
+        the estimate tracks the RESIDENT-state floor the packed /
+        quantized engines attack, not total traffic. bench.py divides
+        it by the measured step time for the achieved-bandwidth-floor
+        figure in the BENCH trajectory."""
+        total = 2 * sum(int(v.nbytes)
+                        for v in self._state_arrays().values())
+        if self._dataset is not None and self._ds_n:
+            total += sum(int(v.nbytes) // self._ds_n
+                         for v in self._dataset.values()) * self._ds_batch
+        return int(total)
+
     def setup_record(self, setup_s: Optional[float] = None) -> dict:
         """The schema-versioned `setup` record for this runner's cold
         start (observe/schema.py: decode/compile seconds + per-cache
-        hit/miss + the async-pipeline accounting); `setup_s` is the
-        caller's total setup wall clock."""
+        hit/miss + the async-pipeline accounting + the HBM-floor
+        fields: bytes_per_step_est and the fault-state format);
+        `setup_s` is the caller's total setup wall clock."""
         if self._consumer is not None:
             self.pipeline.consumer_s = self._consumer.consumer_s
         self.pipeline.snapshot_write_s = self._inline_write_s + (
             self._bg_writer.write_s if self._bg_writer is not None
             else 0.0)
+        self.setup.bytes_per_step = self.bytes_per_step_est()
+        self.setup.fault_format = ("packed" if self._pack_spec is not None
+                                   else "f32")
         return self.setup.record(setup_s)
 
     def _place_state(self):
@@ -1153,8 +1280,19 @@ class SweepRunner:
         flat = s._flat(self.params)
         fc_keys = list(s._iter_fc_keys())
         data = {k: np.array(flat[k]) for k, _ in fc_keys}
-        lifetimes = {k: np.asarray(self.fault_states["lifetimes"][k])
-                     for k in s._fault_keys}
+        if self._pack_spec is not None:
+            # host mid-bin view of the counter banks: the genetic
+            # search only compares lifetimes to zero, which the mid-bin
+            # values preserve exactly (fault/packed.py)
+            from ..fault import packed as fault_packed
+            lifetimes = {
+                k: np.asarray(fault_packed.unpack_lifetimes(
+                    np.asarray(self.fault_states["life_q"][k]),
+                    self._pack_spec["decrement"]))
+                for k in s._fault_keys}
+        else:
+            lifetimes = {k: np.asarray(self.fault_states["lifetimes"][k])
+                         for k in s._fault_keys}
         # quarantined lanes are frozen EVERYWHERE, including this host
         # path — the episodic swap search must not mutate params (or
         # advance its own RNG/prune-mask state) for a config whose
@@ -1533,13 +1671,21 @@ class SweepRunner:
 
     def save_fault_states(self, path: str, background: bool = True):
         """Write the config-stacked fault state (lifetimes, stuck
-        levels, remap slots) to `path` as an .npz archive. The hot loop
+        levels, remap slots) to `path` as an .npz archive — ALWAYS in
+        the canonical f32 layout, whatever the resident bank format:
+        the file is an analysis artifact, and raw `life_q`/`stuck_bits`
+        banks would be uninterpretable without the pack spec (mid-bin
+        lifetimes keep the broken census exact). The hot loop
         pays only the device fetch; serialization and the crash-safe
         temp-file + atomic-rename write happen on the background writer
         thread (`background=False` writes inline with the same
         atomicity). `wait_for_writes()` is the barrier; a writer error
         is sticky and re-raises at the next save/wait."""
         flat = fault_engine.state_to_arrays(self.fault_states)
+        if self._pack_spec is not None:
+            from ..fault import packed as fault_packed
+            flat = fault_packed.convert_flat(flat, to_packed=False,
+                                             spec=self._pack_spec)
 
         def write(tmp):
             with open(tmp, "wb") as f:
@@ -1625,6 +1771,11 @@ class SweepRunner:
         h = self._healing
         meta = {"version": CHECKPOINT_VERSION, "iter": int(self.iter),
                 "n_configs": int(self.n),
+                # v3: the fault leaves' format, and (when packed) the
+                # static packing parameters a reader needs to convert
+                "fault_format": ("packed" if self._pack_spec is not None
+                                 else "f32"),
+                "pack_spec": self._pack_spec,
                 "key": [int(x)
                         for x in np.asarray(self.solver._key).ravel()],
                 "seed": int(self.solver.seed),
@@ -1691,12 +1842,14 @@ class SweepRunner:
                              "(missing __meta__)")
         meta = _json.loads(bytes(bytearray(raw)).decode())
         found = meta.get("version")
-        if found not in (1, CHECKPOINT_VERSION):
+        if found not in (1, 2, CHECKPOINT_VERSION):
             raise ValueError(
                 f"checkpoint {path} has format version {found!r} but "
                 f"this build expects version {CHECKPOINT_VERSION} "
-                "(v1 checkpoints are upgraded in place: v1 has no lane "
-                "map, so the identity lane->config mapping is assumed)")
+                "(v1/v2 checkpoints are upgraded in place: v1 has no "
+                "lane map, so the identity lane->config mapping is "
+                "assumed; v1/v2 fault leaves are f32 and convert to "
+                "this runner's fault format on load)")
         if int(meta["n_configs"]) != self.n:
             raise ValueError(
                 f"checkpoint {path} holds {meta['n_configs']} configs "
@@ -1716,6 +1869,32 @@ class SweepRunner:
                 "genetic strategy (one has episodic search state, the "
                 "other does not); resume with the same solver strategy "
                 "configuration")
+        # fault-format upgrade (checkpoint v3): a v1/v2 checkpoint
+        # (always f32 fault leaves) restores into a packed runner by
+        # packing on load; a packed v3 checkpoint restores into an f32
+        # runner by unpacking with the spec it carries (mid-bin
+        # lifetimes — every zero comparison, and therefore every later
+        # transition, is preserved exactly). Identical formats load
+        # as-is, byte for byte.
+        ck_fmt = meta.get("fault_format", "f32")
+        my_fmt = "packed" if self._pack_spec is not None else "f32"
+        ck_spec = meta.get("pack_spec")
+        if ck_fmt != my_fmt or (ck_fmt == "packed"
+                                and ck_spec != self._pack_spec):
+            from ..fault import packed as fault_packed
+            flat_fault = {name[len("fault/"):]: arr
+                          for name, arr in data.items()
+                          if name.startswith("fault/")}
+            if ck_fmt == "packed":
+                flat_fault = fault_packed.convert_flat(
+                    flat_fault, to_packed=False, spec=ck_spec)
+            if my_fmt == "packed":
+                flat_fault = fault_packed.convert_flat(
+                    flat_fault, to_packed=True, spec=self._pack_spec)
+            data = {name: arr for name, arr in data.items()
+                    if not name.startswith("fault/")}
+            data.update({f"fault/{name}": arr
+                         for name, arr in flat_fault.items()})
         current = self._state_arrays()
         saved, live = set(data), set(current)
         if saved != live:

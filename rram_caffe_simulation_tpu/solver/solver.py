@@ -18,6 +18,7 @@ strategy boundary on its trigger iterations only.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -337,7 +338,9 @@ class Solver:
 
     def make_train_step(self, hw_engine: str = "auto",
                         compute_dtype=None, apply_fn=None,
-                        with_metrics=None, with_debug=None):
+                        with_metrics=None, with_debug=None,
+                        dtype_policy=None, fault_format: str = "f32",
+                        pack_spec=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs, metrics)
@@ -368,11 +371,27 @@ class Solver:
         those wrappers bypass the builder's capture sites.
 
         `hw_engine` selects how the hardware-aware forward (rram_forward)
-        reads fault-target weights, mirroring the reference's Caffe-vs-
-        cuDNN engine choice (layer_factory.cpp:38): "pallas" = the fused
-        crossbar_matmul kernel (noise drawn in VMEM); "jax" = pure
-        perturb_weight (vmappable — the sweep path forces this); "auto" =
-        pallas on the TPU backend, jax elsewhere.
+        reads fault-target weights: "jax" | "pallas" | "auto". The
+        engine-by-path behavior, the sweep's config-batched kernel
+        dispatch, and every fallback rule live in ONE place — the
+        ENGINE MATRIX in fault/hw_aware.py's module docstring.
+
+        `dtype_policy` (None | "ternary" | "int8") is the quantized
+        sweep compute mode (ISSUE 7 (c)): fault-target crossbar weights
+        are READ through the `quantize_ste` ADC-grid model (2 or 8
+        bits, straight-through gradients, accumulation in f32) — on the
+        pallas engine the quantization happens on the VMEM tile inside
+        the fused kernel. CIM-Explorer (arXiv 2505.14303) grounds
+        ternary as the realistic RRAM operating point; the stuck values
+        are already exactly on its {-1, 0, +1} grid. None keeps the
+        bit-exact f32/bf16 default.
+
+        `fault_format` "packed" (with the matching `pack_spec`,
+        fault/packed.py) runs the step against the bit-packed fault
+        banks: int16/int32 lifetime write counters (native integer
+        decrement), 2-bit stuck codes and 1-bit broken masks unpacked
+        in-register — fault transitions identical, ~4x less fault-state
+        HBM traffic per step. "f32" (default) is the reference layout.
 
         `compute_dtype` (e.g. "bfloat16") runs forward/backward in that
         dtype — MXU-native matmuls, halved HBM traffic on the
@@ -432,23 +451,74 @@ class Solver:
                     if param.HasField("rram_forward") and has_fault else 0.0)
         adc_bits = (int(param.rram_forward.adc_bits)
                     if param.HasField("rram_forward") and has_fault else 0)
+        # quantized sweep compute (ISSUE 7 (c)): the per-sweep dtype
+        # policy maps to a quantize_ste bit width on the fault-target
+        # crossbar cells
+        if dtype_policy in (None, "", "f32", "float32"):
+            q_bits = 0
+        elif dtype_policy == "ternary":
+            q_bits = 2
+        elif dtype_policy == "int8":
+            q_bits = 8
+        else:
+            raise ValueError(
+                f"unknown dtype_policy {dtype_policy!r} (expected None, "
+                "'ternary', or 'int8')")
+        if q_bits and not has_fault:
+            raise ValueError(
+                "dtype_policy quantizes the fault-target crossbar cells "
+                "and needs an active fault engine "
+                "(failure_pattern { type: 'gaussian' })")
+        if fault_format not in ("f32", "packed"):
+            raise ValueError(f"unknown fault_format {fault_format!r} "
+                             "(expected 'f32' or 'packed')")
+        packed_on = fault_format == "packed"
+        if packed_on:
+            if pack_spec is None:
+                raise ValueError("fault_format='packed' needs the "
+                                 "pack_spec the banks were built with "
+                                 "(fault/packed.py make_pack_spec)")
+            from ..fault import packed as fault_packed
         cdtype = jnp.dtype(compute_dtype) if compute_dtype else None
         if cdtype == jnp.float32:
             cdtype = None  # f32 is the native dtype; nothing to cast
-        # the Pallas crossbar custom_vjp is f32-typed end to end; under a
-        # lower compute_dtype only the pure perturb path partitions/casts
-        # cleanly
-        if cdtype is not None and hw_engine == "pallas":
-            raise ValueError(
-                f"hw_engine='pallas' is f32-only (the crossbar custom_vjp "
-                f"computes f32 cotangents) but compute_dtype={compute_dtype!r}"
-                "; drop compute_dtype or use hw_engine='jax'")
-        use_pallas = bool(hw_sigma) and cdtype is None and (
+        # the Pallas crossbar kernel itself is f32-typed end to end (the
+        # crossbar read models the analog array, which has no dtype
+        # knob): under a lower compute_dtype the call site casts
+        # x/w up to f32 around the fused kernel (ops/common.py) and the
+        # output/cotangents back down — activations keep the half-width
+        # HBM traffic, the crossbar read keeps f32 numerics. "auto"
+        # stays conservative and only engages pallas at native f32.
+        use_pallas = (bool(hw_sigma) or bool(q_bits)) and (
             hw_engine == "pallas" or
-            (hw_engine == "auto" and jax.default_backend() == "tpu"))
+            (hw_engine == "auto" and cdtype is None
+             and jax.default_backend() == "tpu"))
         # Weight (2-D crossbar) keys go through the fused kernel on the
         # pallas engine; biases always take the pure perturbation.
         crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
+
+        def _broken_stuck(fault_state, k):
+            """The read-side broken mask + stuck values of one fault
+            key, either format: packed compares the integer counter
+            bank and unpacks the 2-bit stuck codes in-register."""
+            if packed_on:
+                return (fault_state["life_q"][k] <= 0,
+                        fault_packed.unpack_stuck(
+                            fault_state["stuck_bits"][k],
+                            pack_spec["last_dim"][k]))
+            return (fault_state["lifetimes"][k] <= 0,
+                    fault_state["stuck"][k])
+
+        def _life_view(fault_state):
+            """f32 lifetimes for the strategy / counter consumers: the
+            identity on the f32 format, the fused mid-bin unpack on the
+            packed banks (zero-comparisons exact; min/mean at decrement
+            resolution — fault/packed.py)."""
+            if packed_on:
+                return {k: fault_packed.unpack_lifetimes(
+                            q, pack_spec["decrement"])
+                        for k, q in fault_state["life_q"].items()}
+            return fault_state["lifetimes"]
 
         def _to_run(tree):
             return jax.tree.map(
@@ -466,23 +536,28 @@ class Solver:
                 p_master = p
                 clean = flat(p)
                 crossbar = None
-                if hw_sigma:
+                if hw_sigma or q_bits:
                     from ..fault import hw_aware
                     fp = dict(clean)
                     crossbar = {} if use_pallas else None
                     for i, k in enumerate(fault_keys):
                         noise_key = jax.random.fold_in(
                             jax.random.fold_in(rng, 0x4A7), i)
+                        broken_k, stuck_k = _broken_stuck(fault_state, k)
                         if k in crossbar_keys:
                             seed = jax.random.randint(
                                 noise_key, (), 0, jnp.iinfo(jnp.int32).max)
                             crossbar[k.rsplit("/", 1)[0]] = (
-                                fault_state["lifetimes"][k] <= 0,
-                                fault_state["stuck"][k], seed, hw_sigma)
+                                broken_k, stuck_k, seed, hw_sigma, q_bits)
                         else:
+                            wk = fp[k]
+                            if q_bits:
+                                # ADC-grid read (quantize_ste): the
+                                # per-call dynamic range matches the
+                                # kernel's per-config max-abs scale
+                                wk = hw_aware.quantize_ste(wk, q_bits)
                             fp[k] = hw_aware.perturb_weight(
-                                fp[k], fault_state["lifetimes"][k] <= 0,
-                                fault_state["stuck"][k], noise_key,
+                                wk, broken_k, stuck_k, noise_key,
                                 hw_sigma)
                     p = unflat(fp, p)
                 run_batch = batch
@@ -501,12 +576,14 @@ class Solver:
                     compute_dtype=cdtype, **extra)
                 dbg_fwd = (spec.forward_values(p, blobs, trace_sites)
                            if debug_on else None)
-                if hw_sigma:
-                    # Conductance noise is a READ effect only: net.apply
-                    # copies the (perturbed) input tree into new_params, so
-                    # the stored fault-target weights must be restored to
-                    # their clean values before ApplyUpdate — otherwise
-                    # sigma*eps compounds into the parameters each step.
+                if hw_sigma or q_bits:
+                    # Conductance noise / ADC-grid quantization are READ
+                    # effects only: net.apply copies the (perturbed)
+                    # input tree into new_params, so the stored
+                    # fault-target weights must be restored to their
+                    # clean values before ApplyUpdate — otherwise
+                    # sigma*eps (or the quantization residual) compounds
+                    # into the parameters each step.
                     fn = flat(newp)
                     for k in fault_keys:
                         fn[k] = (clean[k] if cdtype is None
@@ -621,16 +698,28 @@ class Solver:
                         from ..observe import counters as obs_counters
                         writes_saved = obs_counters.write_traffic_saved(
                             fd_before, fd, fault_engine.EPSILON,
-                            lifetimes=(fault_state["lifetimes"]
+                            lifetimes=(_life_view(fault_state)
                                        if has_fault else None))
                     upd.update(fd)
                 if strategies.prune_orders is not None and has_fault:
+                    # the remap strategies read lifetimes/stuck (the
+                    # stuck-at-0 flag matrices); on the packed format
+                    # they consume the fused mid-bin view — flags
+                    # exact. The view is built INSIDE the cond
+                    # branches: a closure-captured traced value becomes
+                    # a cond operand, which would materialize the wide
+                    # f32 leaves every step instead of only on the
+                    # remap-trigger iterations.
+                    def _fs_view():
+                        return (fault_packed.unpacked_view(
+                                    fault_state, pack_spec)
+                                if packed_on else fault_state)
                     if strategies.remap_tracked:
                         def remap(dd):
                             d, u, slots = dd
                             return \
                                 fault_strategies.remap_fc_neurons_tracked(
-                                    d, u, fault_state, fc_pairs,
+                                    d, u, _fs_view(), fc_pairs,
                                     strategies.prune_orders, slots)
                         data, upd, new_slots = jax.lax.cond(
                             do_remap, remap, lambda dd: dd,
@@ -640,7 +729,7 @@ class Solver:
                     else:
                         def remap(dd):
                             return fault_strategies.remap_fc_neurons(
-                                dd[0], dd[1], fault_state, fc_pairs,
+                                dd[0], dd[1], _fs_view(), fc_pairs,
                                 strategies.prune_orders)
                         data, upd = jax.lax.cond(do_remap, remap,
                                                  lambda dd: dd,
@@ -658,13 +747,19 @@ class Solver:
                 data = {k: data[k] - upd[k] for k in data}
 
             # -- Fail (solver.cpp:305; failure_maker.cu:23-40) --
-            prev_life = (fault_state["lifetimes"] if has_fault else None)
+            prev_life = (_life_view(fault_state) if has_fault else None)
             with jax.named_scope("fail"):
                 if has_fault:
                     fp = {k: data[k] for k in fault_keys}
                     fd = {k: upd[k] for k in fault_keys}
-                    fp, fault_state = fault_engine.fail(
-                        fp, fault_state, fd, decrement)
+                    if packed_on:
+                        # native integer decrement on the counter banks
+                        # — transition timeline identical to fail()
+                        fp, fault_state = fault_packed.fail_packed(
+                            fp, fault_state, fd, pack_spec)
+                    else:
+                        fp, fault_state = fault_engine.fail(
+                            fp, fault_state, fd, decrement)
                     data.update(fp)
 
             # -- in-step telemetry (observe package, layer 1) --
@@ -687,7 +782,7 @@ class Solver:
                     }
                     if has_fault:
                         totals, per = fault_engine.fault_counters(
-                            prev_life, fault_state["lifetimes"])
+                            prev_life, _life_view(fault_state))
                         totals["writes_saved"] = writes_saved
                         metrics["fault"] = {**totals, "per_param": per}
 
@@ -719,6 +814,10 @@ class Solver:
         # metrics_on choice — enable_metrics after this point would be a
         # silent no-op, so it guards on the flag and raises instead
         self._step_baked = True
+        # the engine that will actually RUN: "pallas" only when the
+        # fused kernel engaged (the use_pallas gate above), so callers
+        # attribute throughput to the real path, not an inert flag
+        step.hw_engine_resolved = "pallas" if use_pallas else "jax"
         return step
 
     def _compiled_step(self):
@@ -1671,6 +1770,23 @@ class Solver:
             fault_file = fault_file[:-len(".h5")]
         if fault_file.endswith(".solverstate"):
             fault_file = fault_file[:-len(".solverstate")] + ".faultstate"
+        if self.fault_state is not None and not os.path.exists(fault_file):
+            # snapshot predates fault-state capture (or came from the
+            # reference, which never snapshots fail_iterations_): the
+            # run continues on the CONSTRUCTION-TIME fresh draw, so the
+            # resumed degradation trajectory diverges from what the
+            # snapshot's run would have seen. Loud, never silent: a
+            # console line always, plus a `fault_redraw` observe record
+            # when sinks are attached.
+            from ..observe import sink as obs_sink
+            rec = obs_sink.make_fault_redraw_record(
+                self.iter, fault_file,
+                "snapshot predates fault-state capture; lifetimes and "
+                "stuck values re-drawn from the failure_pattern")
+            print("WARNING: " + obs_sink.fault_redraw_line(rec),
+                  file=sys.stderr, flush=True)
+            if self.metrics_logger is not None:
+                self.metrics_logger.log(rec)
         if self.fault_state is not None and os.path.exists(fault_file):
             restored = fault_engine.fault_state_from_proto(
                 uio.read_proto_binary(fault_file, pb.NetParameter()))

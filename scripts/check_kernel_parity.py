@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""CI guard for the bytes-per-step engines (config-batched Pallas
+kernels + bit-packed fault state + quantized sweep compute): the
+attack configuration must be a pure LAYOUT/FUSION change, never a
+semantic one.
+
+Four checks against the pure-JAX f32 reference sweep (the `engine=jax,
+packed_state=False` semantic-reference path), all in one process on a
+deterministic operating point (sigma = 0 with the ternary ADC grid on,
+so the fused kernel engages with no stochastic term and losses are
+directly comparable):
+
+1. **Loss parity**: per-chunk per-config losses of the packed + Pallas
+   sweep match the reference within byte tolerance (1e-6 — on CPU
+   interpret mode they are bit-identical; real-TPU tiling may
+   reassociate reductions).
+2. **Fault-state exactness**: broken masks and stuck values after the
+   run — across a window where cells break — are EXACTLY equal (the
+   integer write counters share the f32 timeline by the ceil
+   identity).
+3. **Checkpoint shrink**: the packed checkpoint's fault payload is
+   >= 3x smaller than the f32 layout's (the acceptance floor; int16
+   counters + 2-bit stuck + 1-bit broken ~ 2.4 B/cell vs 8 B/cell).
+4. **Self-healing compatibility**: with a NaN-poisoned lane under the
+   packed + Pallas engine, the config retries to completion in a
+   reclaimed lane and the HEALTHY lanes' params/history/losses stay
+   byte-identical to an uninjected packed + Pallas run.
+
+    python scripts/check_kernel_parity.py
+
+Exit status: 0 = parity holds, 1 = any violation.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ITERS = 12
+CHUNK = 3
+N_CONFIGS = 3
+MEAN, STD = 250.0, 30.0   # cells break inside the 12-iter window
+LOSS_TOL = 1e-6
+
+
+def _solver(prefix: str):
+    import numpy as np
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    net = """
+    name: "ParityNet"
+    layer { name: "data" type: "Input" top: "data" top: "target"
+      input_param { shape { dim: 8 dim: 6 } shape { dim: 8 dim: 2 } } }
+    layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+      inner_product_param { num_output: 5
+        weight_filler { type: "gaussian" std: 0.5 }
+        bias_filler { type: "constant" value: 0.1 } } }
+    layer { name: "relu1" type: "ReLU" bottom: "fc1" top: "fc1" }
+    layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+      inner_product_param { num_output: 2
+        weight_filler { type: "gaussian" std: 0.5 }
+        bias_filler { type: "constant" value: 0.0 } } }
+    layer { name: "loss" type: "EuclideanLoss" bottom: "fc2"
+      bottom: "target" top: "loss" }
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(net, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10 ** 6
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = prefix
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = MEAN
+    sp.failure_pattern.std = STD
+    # deterministic crossbar read: the ternary grid engages the fused
+    # kernel; sigma stays 0 so jax/pallas noise streams cannot differ
+    sp.rram_forward.sigma = 0.0
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data,
+                                          "target": target})
+
+
+def _runner(workdir: str, tag: str, **kw):
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    return SweepRunner(_solver(os.path.join(workdir, tag)),
+                       n_configs=N_CONFIGS, dtype_policy="ternary",
+                       **kw)
+
+
+def _run_chunks(runner):
+    import numpy as np
+    losses = []
+    for _ in range(ITERS // CHUNK):
+        loss, _ = runner.step(CHUNK, chunk=CHUNK)
+        losses.append(np.asarray(loss))
+    return np.stack(losses)
+
+
+def _fault_census(runner):
+    """(broken, stuck) per fault key, format-independent."""
+    import numpy as np
+    from rram_caffe_simulation_tpu.fault import packed as fault_packed
+    fs = runner.fault_states
+    out = {}
+    if "life_q" in fs:
+        for k in fs["life_q"]:
+            out[k] = (np.asarray(fs["life_q"][k] <= 0),
+                      np.asarray(fault_packed.unpack_stuck(
+                          fs["stuck_bits"][k],
+                          runner._pack_spec["last_dim"][k])))
+    else:
+        for k in fs["lifetimes"]:
+            out[k] = (np.asarray(fs["lifetimes"][k] <= 0),
+                      np.asarray(fs["stuck"][k]))
+    return out
+
+
+def _lane_bytes(runner, lane):
+    import jax
+    import numpy as np
+    flat = runner.solver._flat(runner.params)
+    return ([np.asarray(v)[lane].tobytes() for v in flat.values()]
+            + [np.asarray(x)[lane].tobytes()
+               for x in jax.tree.leaves(runner.history)])
+
+
+def _poison(runner, lane):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    orig = runner.params["fc2"][0]
+    w = np.array(orig)
+    w[lane].flat[0] = np.nan
+    runner.params["fc2"][0] = jax.device_put(jnp.asarray(w),
+                                             orig.sharding)
+
+
+def main() -> int:
+    import numpy as np
+
+    failures = []
+    work = tempfile.mkdtemp(prefix="kernel_parity_")
+
+    # reference: pure-JAX engine, f32 fault leaves
+    ref = _runner(work, "ref")
+    ref_losses = _run_chunks(ref)
+
+    # the attack configuration: config-batched Pallas + packed banks
+    atk = _runner(work, "atk", engine="pallas", packed_state=True)
+    atk_losses = _run_chunks(atk)
+
+    # 1. loss parity within byte tolerance
+    diff = np.max(np.abs(ref_losses - atk_losses))
+    if not np.all(np.isfinite(atk_losses)) or diff > LOSS_TOL:
+        failures.append(
+            f"loss parity broke: max |ref - packed+pallas| = {diff!r} "
+            f"(tolerance {LOSS_TOL})\nref:\n{ref_losses}\n"
+            f"attack:\n{atk_losses}")
+    else:
+        print(f"loss parity OK (max diff {diff:.2e} over "
+              f"{ref_losses.size} per-config chunk losses)")
+
+    # 2. fault-state transitions exact
+    cen_ref, cen_atk = _fault_census(ref), _fault_census(atk)
+    broke_any = False
+    for k in cen_ref:
+        b_ref, s_ref = cen_ref[k]
+        b_atk, s_atk = cen_atk[k]
+        broke_any = broke_any or b_ref.any()
+        if not np.array_equal(b_ref, b_atk):
+            failures.append(f"broken mask diverged on {k}")
+        if not np.array_equal(s_ref, s_atk):
+            failures.append(f"stuck values diverged on {k}")
+    if not broke_any:
+        failures.append("no cell broke inside the window — the "
+                        "transition check tested nothing; lower MEAN")
+    if not failures:
+        print("fault-state transitions exact (cells broke in-window)")
+
+    # 3. packed checkpoint >= 3x smaller on the fault payload
+    p_ref = os.path.join(work, "ref.ckpt.npz")
+    p_atk = os.path.join(work, "atk.ckpt.npz")
+    ref.checkpoint(p_ref)
+    atk.checkpoint(p_atk)
+
+    def fault_bytes(path):
+        with np.load(path) as z:
+            return sum(int(z[k].nbytes) for k in z.files
+                       if k.startswith("fault/"))
+
+    fb_ref, fb_atk = fault_bytes(p_ref), fault_bytes(p_atk)
+    if fb_atk * 3 > fb_ref:
+        failures.append(
+            f"packed checkpoint fault payload not >= 3x smaller: "
+            f"{fb_atk} vs {fb_ref} f32 bytes ({fb_ref / fb_atk:.2f}x)")
+    else:
+        print(f"checkpoint shrink OK ({fb_ref} -> {fb_atk} fault "
+              f"bytes, {fb_ref / fb_atk:.2f}x)")
+
+    # 4. self-healing on the attack engine: poisoned lane retried,
+    #    healthy lanes byte-identical to the uninjected run
+    clean = _runner(work, "clean", engine="pallas", packed_state=True,
+                    pipeline_depth=0)
+    clean_losses, _ = clean.step(ITERS, chunk=CHUNK)
+    heal = _runner(work, "heal", engine="pallas", packed_state=True,
+                   pipeline_depth=0)
+    heal.enable_self_healing(budget=ITERS, max_retries=2)
+    heal.step(CHUNK, chunk=CHUNK)
+    _poison(heal, lane=1)
+    guard = 0
+    while not heal.healing_complete():
+        heal.step(CHUNK, chunk=CHUNK)
+        guard += 1
+        if guard > 40:
+            failures.append("self-healing never completed")
+            break
+    rep = heal.config_report()
+    if sorted(rep.get("completed", {})) != list(range(N_CONFIGS)):
+        failures.append(f"not every config completed under injection: "
+                        f"{rep}")
+    elif rep["completed"][1]["attempts"] < 2:
+        failures.append("poisoned config completed without a retry — "
+                        "the injection tested nothing")
+    else:
+        lc = np.asarray(clean_losses)
+        for lane in (0, 2):
+            if rep["completed"][lane]["loss"] != float(lc[lane]):
+                failures.append(
+                    f"healthy lane {lane} loss diverged under "
+                    f"injection: {rep['completed'][lane]['loss']!r} != "
+                    f"{float(lc[lane])!r}")
+            if _lane_bytes(clean, lane) != _lane_bytes(heal, lane):
+                failures.append(f"healthy lane {lane} params/history "
+                                "not byte-identical under injection")
+        if not failures:
+            print("self-healing on packed+pallas OK (poisoned config "
+                  "completed on attempt "
+                  f"{rep['completed'][1]['attempts']}, healthy lanes "
+                  "byte-identical)")
+
+    ref.close()
+    atk.close()
+    clean.close()
+    heal.close()
+
+    if failures:
+        print("\nKERNEL PARITY GUARD FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("kernel parity guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

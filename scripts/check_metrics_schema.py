@@ -96,6 +96,22 @@ SAMPLE_BAD_RETRY = {
     "event": "sideways", "recovery": "prayer",    # unknown enum values
 }
 
+# the restore-fallback announcement (Solver.restore with a snapshot
+# that predates fault-state capture — schema.py FAULT_REDRAW_FIELDS)
+SAMPLE_GOOD_FAULT_REDRAW = {
+    "schema_version": 1, "type": "fault_redraw", "iter": 4000,
+    "wall_time": 1722700000.0,
+    "snapshot": "/runs/q_iter_4000.faultstate",
+    "reason": "snapshot predates fault-state capture",
+}
+
+SAMPLE_BAD_FAULT_REDRAW = {
+    "schema_version": 1, "type": "fault_redraw", "iter": 4000,
+    "wall_time": 1722700000.0,
+    "snapshot": "",                                  # empty path
+    # reason missing entirely
+}
+
 # the debug_info deep-trace record types (observe/debug.py)
 SAMPLE_GOOD_DEBUG = {
     "schema_version": 1, "type": "debug_trace", "iter": 3,
@@ -142,6 +158,10 @@ SAMPLE_GOOD_SETUP = {
     "setup_seconds": 136.6,
     "cache": {"compile": "hit", "dataset": "miss"},
     "cache_dir": "/var/cache/rram-tpu",
+    # HBM-floor fields (sweep runs): estimated bytes one iteration
+    # moves and the fault-state bank layout behind the estimate
+    "bytes_per_step_est": 1234567890,
+    "fault_state_format": "packed",
     "pipeline": {"depth": 2, "chunks": 100, "records": 100,
                  "host_blocked_seconds": 0.021,
                  "consumer_seconds": 3.4, "drain_seconds": 0.8,
@@ -154,6 +174,8 @@ SAMPLE_BAD_SETUP = {
     "decode_seconds": -1.0,                          # negative time
     "compile_seconds": "fast",                       # not a number
     "cache": {"compile": "sideways"},                # bad state, no dataset
+    "bytes_per_step_est": -10,                       # negative bytes
+    "fault_state_format": "origami",                 # unknown format
     "pipeline": {"depth": 2,                         # chunks missing
                  "host_blocked_seconds": -0.5},      # negative time
 }
@@ -198,6 +220,7 @@ def main(argv=None) -> int:
                           ("quarantine", SAMPLE_GOOD_QUARANTINE),
                           ("lane_map", SAMPLE_GOOD_LANE_MAP),
                           ("retry", SAMPLE_GOOD_RETRY),
+                          ("fault_redraw", SAMPLE_GOOD_FAULT_REDRAW),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP)):
@@ -211,6 +234,7 @@ def main(argv=None) -> int:
                           ("quarantine", SAMPLE_BAD_QUARANTINE),
                           ("lane_map", SAMPLE_BAD_LANE_MAP),
                           ("retry", SAMPLE_BAD_RETRY),
+                          ("fault_redraw", SAMPLE_BAD_FAULT_REDRAW),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP)):
@@ -220,7 +244,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (7 good records accepted, 7 bad "
+        print("sample self-check OK (8 good records accepted, 8 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

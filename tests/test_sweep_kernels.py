@@ -1,0 +1,612 @@
+"""Config-batched sweep kernels, bit-packed fault state, quantized
+sweep mode (fault/hw_aware.py batched dispatch + fault/packed.py +
+Solver dtype_policy): parity against the pure-JAX semantic reference
+per lane (forward and VJP, bit-exact by the per-lane seeding design),
+pack/unpack round-trip exactness, checkpoint v3<->v2 format upgrades,
+and the quantized operating points' loss tolerance. The end-to-end
+packed+pallas sweep guard is scripts/check_kernel_parity.py; these
+tests pin the component contracts."""
+import json as _json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.fault import engine as fault_engine
+from rram_caffe_simulation_tpu.fault import hw_aware
+from rram_caffe_simulation_tpu.fault import packed as fault_packed
+from rram_caffe_simulation_tpu.observe.schema import validate_record
+from rram_caffe_simulation_tpu.parallel import SweepRunner
+from rram_caffe_simulation_tpu.parallel import sweep as sweep_mod
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+
+from test_fault import FAULT_NET, fault_solver
+
+
+def _sigma_solver(tmp_path, sigma=0.0, mean=250.0, std=30.0):
+    """fault_solver twin with the hardware-aware crossbar read armed
+    (rram_forward.sigma is a nested message, out of fault_solver's
+    setattr reach)."""
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 100
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = mean
+    sp.failure_pattern.std = std
+    sp.rram_forward.sigma = sigma
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data, "target": target})
+
+
+def _lanes(rng, cfg=3, m=48, k=72, n=40):
+    """Odd (non-128-multiple) per-lane operands for the batched kernel."""
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    ws = jnp.asarray(rng.randn(cfg, k, n), jnp.float32)
+    bs = jnp.asarray(rng.rand(cfg, k, n) < 0.1)
+    ss = jnp.asarray(rng.choice([-1.0, 0.0, 1.0], size=(cfg, k, n)),
+                     jnp.float32)
+    seeds = jnp.arange(11, 11 + cfg, dtype=jnp.int32)
+    return x, ws, bs, ss, seeds
+
+
+# ---------------------------------------------------------------------------
+# batched kernel vs per-lane reference
+
+
+def test_batched_dispatch_collapses_config_axis():
+    """vmap over (w, broken, stuck, seed) — the sweep's config axis —
+    must dispatch to ONE config-grid launch (no per-lane scan in the
+    jaxpr); any partial batching falls back to per-lane single kernels
+    under lax.map."""
+    x, ws, bs, ss, seeds = _lanes(np.random.RandomState(0))
+    batched = jax.make_jaxpr(jax.vmap(
+        lambda w, b, s, sd: hw_aware.crossbar_matmul(x, w, b, s, sd,
+                                                     0.05, 0)))(
+        ws, bs, ss, seeds)
+    txt = str(batched)
+    assert "scan" not in txt and "while" not in txt
+
+    mixed = jax.make_jaxpr(jax.vmap(
+        lambda b: hw_aware.crossbar_matmul(x, ws[0], b, ss[0], 7,
+                                           0.05, 0)))(bs)
+    assert "scan" in str(mixed) or "while" in str(mixed)
+
+
+def test_batched_matches_per_lane_shared_x():
+    """Shared-x batching (the genetic-eval pattern): the config-grid
+    launch is BIT-identical to per-lane single-config launches — each
+    lane is seeded with its own seed word and the same tile index, so
+    the noise streams match exactly, not statistically."""
+    x, ws, bs, ss, seeds = _lanes(np.random.RandomState(1))
+    got = jax.vmap(lambda w, b, s, sd: hw_aware.crossbar_matmul(
+        x, w, b, s, sd, 0.05, 0))(ws, bs, ss, seeds)
+    want = jnp.stack([hw_aware.crossbar_matmul(
+        x, ws[c], bs[c], ss[c], int(seeds[c]), 0.05, 0)
+        for c in range(ws.shape[0])])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_batched_matches_per_lane_batched_x():
+    """Per-lane x (the training-sweep pattern: upstream per-config
+    weights make every activation per-config) with the in-kernel
+    ADC-grid quantization on: still bit-identical per lane."""
+    rng = np.random.RandomState(2)
+    x, ws, bs, ss, seeds = _lanes(rng)
+    xs = jnp.asarray(rng.randn(ws.shape[0], *x.shape), jnp.float32)
+    got = jax.vmap(lambda xx, w, b, s, sd: hw_aware.crossbar_matmul(
+        xx, w, b, s, sd, 0.05, 2))(xs, ws, bs, ss, seeds)
+    want = jnp.stack([hw_aware.crossbar_matmul(
+        xs[c], ws[c], bs[c], ss[c], int(seeds[c]), 0.05, 2)
+        for c in range(ws.shape[0])])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_batched_sigma0_matches_pure_reference_extreme_lanes():
+    """sigma == 0 removes the only stochastic term: the batched kernel
+    must equal reference_crossbar_matmul exactly per lane, including an
+    all-broken lane (pure stuck-value read) and a no-broken lane."""
+    rng = np.random.RandomState(3)
+    x, ws, bs, ss, seeds = _lanes(rng)
+    bs = bs.at[0].set(True)      # lane 0: every cell broken
+    bs = bs.at[1].set(False)     # lane 1: nothing broken
+    got = jax.vmap(lambda w, b, s, sd: hw_aware.crossbar_matmul(
+        x, w, b, s, sd, 0.0, 0))(ws, bs, ss, seeds)
+    key = jax.random.PRNGKey(0)  # unused at sigma == 0
+    want = jnp.stack([hw_aware.reference_crossbar_matmul(
+        x, ws[c], bs[c], ss[c], key, 0.0)
+        for c in range(ws.shape[0])])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # the all-broken lane reads ONLY stuck values
+    np.testing.assert_allclose(np.asarray(got[0]),
+                               np.asarray(x @ ss[0]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batched_vjp_matches_per_lane():
+    """The batched VJP (training sweeps, not just inference): dx and dw
+    through the vmapped call are bit-identical to per-lane grads, with
+    the quantized grid on — straight-through to the clean masters."""
+    rng = np.random.RandomState(4)
+    x, ws, bs, ss, seeds = _lanes(rng)
+    xs = jnp.asarray(rng.randn(ws.shape[0], *x.shape), jnp.float32)
+
+    def loss(xx, w):
+        y = jax.vmap(lambda a, b, c, d, e: hw_aware.crossbar_matmul(
+            a, b, c, d, e, 0.05, 2))(xx, w, bs, ss, seeds)
+        return jnp.sum(y ** 2)
+
+    def loss_per(xx, w):
+        y = jnp.stack([hw_aware.crossbar_matmul(
+            xx[c], w[c], bs[c], ss[c], int(seeds[c]), 0.05, 2)
+            for c in range(ws.shape[0])])
+        return jnp.sum(y ** 2)
+
+    dx, dw = jax.grad(loss, argnums=(0, 1))(xs, ws)
+    rdx, rdw = jax.grad(loss_per, argnums=(0, 1))(xs, ws)
+    np.testing.assert_array_equal(np.asarray(dx), np.asarray(rdx))
+    np.testing.assert_array_equal(np.asarray(dw), np.asarray(rdw))
+
+
+@pytest.mark.parametrize("q_bits", [2, 8])
+def test_quantized_kernel_matches_reference_grid(q_bits):
+    """The in-VMEM quantization is quantize_ste's exact grid: at
+    sigma == 0 the kernel equals the pure reference with the same
+    q_bits — per-lane dynamic ranges (each config's own max-abs)."""
+    rng = np.random.RandomState(5)
+    x, ws, bs, ss, seeds = _lanes(rng)
+    got = jax.vmap(lambda w, b, s, sd: hw_aware.crossbar_matmul(
+        x, w, b, s, sd, 0.0, q_bits))(ws, bs, ss, seeds)
+    key = jax.random.PRNGKey(0)
+    want = jnp.stack([hw_aware.reference_crossbar_matmul(
+        x, ws[c], bs[c], ss[c], key, 0.0, q_bits)
+        for c in range(ws.shape[0])])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bit-packed fault state: pack/unpack exactness
+
+
+def test_pack_unpack_lifetime_roundtrip_exact():
+    """pack(unpack(q)) == q bit-for-bit, including the negative
+    counters the init distribution's tail produces, for both bank
+    dtypes; zero-comparisons agree between the f32 and counter views."""
+    rng = np.random.RandomState(6)
+    for dtype in ("int16", "int32"):
+        life = rng.normal(250.0, 120.0, size=(5, 37)).astype(np.float32)
+        life[0, :4] = [-450.0, -0.5, 0.0, 1e-3]   # negative/boundary
+        q = fault_packed.pack_lifetimes(life, 100.0, dtype)
+        assert q.dtype == np.dtype(dtype)
+        back = np.asarray(fault_packed.unpack_lifetimes(q, 100.0))
+        q2 = fault_packed.pack_lifetimes(back, 100.0, dtype)
+        np.testing.assert_array_equal(q, q2)
+        # broken/alive comparisons are exact either way; the mid-bin
+        # view is never exactly 0, so the remap flag (`< 0`,
+        # strategies.py) fires for exactly the broken (`<= 0`) cells
+        np.testing.assert_array_equal(back <= 0, q <= 0)
+        np.testing.assert_array_equal(back > 0, q > 0)
+        np.testing.assert_array_equal(back < 0, q <= 0)
+
+
+def test_pack_unpack_stuck_roundtrip_odd_dims():
+    """2-bit stuck codes (4 cells per uint8 lane) round-trip exactly on
+    last-axis lengths that are NOT multiples of the lane packing
+    factor (there is no broken bank — broken is `life_q <= 0`)."""
+    rng = np.random.RandomState(7)
+    for last in (1, 3, 8, 13, 64):
+        stuck = rng.choice([-1.0, 0.0, 1.0],
+                           size=(4, last)).astype(np.float32)
+        bank = fault_packed.pack_stuck(stuck)
+        assert bank.dtype == np.uint8
+        assert bank.shape[-1] == -(-last // 4)
+        np.testing.assert_array_equal(
+            np.asarray(fault_packed.unpack_stuck(jnp.asarray(bank),
+                                                 last)), stuck)
+    assert fault_packed.PACKED_GROUPS == ("life_q", "stuck_bits")
+
+
+def test_life_dtype_choice_and_spec_bounds():
+    """The counter dtype is sized analytically from the (mean, std)
+    grid — int16 when every spec fits with the 12-sigma margin — and a
+    spec added after the banks were frozen is bounds-checked loudly."""
+    assert fault_packed.choose_life_dtype([250.0], [30.0], 100.0) == \
+        "int16"
+    assert fault_packed.choose_life_dtype([1e8], [3e7], 100.0) == "int32"
+    spec = {"decrement": 100.0, "life_dtype": "int16", "last_dim": {}}
+    fault_packed.check_spec_bounds(spec, 250.0, 30.0)
+    with pytest.raises(ValueError, match="int16"):
+        fault_packed.check_spec_bounds(spec, 1e8, 3e7)
+    # int32 banks accept anything the engine can draw
+    fault_packed.check_spec_bounds(
+        {"decrement": 100.0, "life_dtype": "int32", "last_dim": {}},
+        1e8, 3e7)
+
+
+def test_state_roundtrip_and_convert_flat(tmp_path):
+    """Whole-state pack/unpack/pack is idempotent at the bank level,
+    and convert_flat (the checkpoint upgrade path) converts the flat
+    array mapping both directions, no-op'ing on matching formats."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    spec = fault_packed.make_pack_spec(s.fault_state, s.fail_decrement,
+                                       means=[250.0], stds=[30.0])
+    packed = fault_packed.pack_state(s.fault_state, spec)
+    assert fault_packed.is_packed(packed)
+    back = fault_packed.unpack_state(packed, spec)
+    repacked = fault_packed.pack_state(back, spec)
+    for k in packed["life_q"]:
+        np.testing.assert_array_equal(np.asarray(packed["life_q"][k]),
+                                      np.asarray(repacked["life_q"][k]))
+        np.testing.assert_array_equal(
+            np.asarray(packed["stuck_bits"][k]),
+            np.asarray(repacked["stuck_bits"][k]))
+
+    flat_f32 = fault_engine.state_to_arrays(s.fault_state)
+    flat_packed = fault_packed.convert_flat(flat_f32, True, spec)
+    assert fault_packed.packed_nbytes(flat_packed) * 3 <= \
+        fault_packed.packed_nbytes(flat_f32)
+    # no-op on matching format; round-trip preserves zero-comparisons
+    again = fault_packed.convert_flat(flat_packed, True, spec)
+    assert set(again) == set(flat_packed)
+    down = fault_packed.convert_flat(flat_packed, False, spec)
+    for k in s.fault_state["lifetimes"]:
+        np.testing.assert_array_equal(
+            down[f"lifetimes/{k}"] <= 0,
+            flat_f32[f"lifetimes/{k}"] <= 0)
+
+
+# ---------------------------------------------------------------------------
+# packed sweep vs f32 sweep
+
+
+def test_packed_sweep_bit_identical_to_f32():
+    """The whole point: per-config losses from a packed-state sweep are
+    BIT-identical to the f32 reference sweep (broken timelines agree
+    exactly by the ceil identity), across a window where cells break."""
+    import tempfile
+    from pathlib import Path
+    tmp = Path(tempfile.mkdtemp())
+    r_f32 = SweepRunner(fault_solver(tmp / "a", mean=250.0, std=30.0),
+                        n_configs=3)
+    r_pk = SweepRunner(fault_solver(tmp / "b", mean=250.0, std=30.0),
+                       n_configs=3, packed_state=True)
+    losses_f32, _ = r_f32.step(8, chunk=2)
+    losses_pk, _ = r_pk.step(8, chunk=2)
+    np.testing.assert_array_equal(np.asarray(losses_f32),
+                                  np.asarray(losses_pk))
+    for k in r_f32.fault_states["lifetimes"]:
+        broken_f32 = np.asarray(r_f32.fault_states["lifetimes"][k] <= 0)
+        broken_pk = np.asarray(r_pk.fault_states["life_q"][k] <= 0)
+        np.testing.assert_array_equal(broken_f32, broken_pk)
+        np.testing.assert_array_equal(
+            np.asarray(r_f32.fault_states["stuck"][k]),
+            np.asarray(fault_packed.unpack_stuck(
+                r_pk.fault_states["stuck_bits"][k],
+                r_pk._pack_spec["last_dim"][k])))
+    assert any(np.asarray(v <= 0).any()
+               for v in r_f32.fault_states["lifetimes"].values())
+    # the resident-state estimate the bench reports must shrink
+    assert r_pk.bytes_per_step_est() < r_f32.bytes_per_step_est()
+    rec = r_pk.setup_record(1.0)
+    assert rec["fault_state_format"] == "packed"
+    assert rec["bytes_per_step_est"] == r_pk.bytes_per_step_est()
+    assert validate_record(rec) == []
+
+
+def test_packed_checkpoint_is_3x_smaller(tmp_path):
+    """Acceptance criterion: the per-config fault payload in a packed
+    checkpoint is >= 3x smaller than the f32 layout's (int16 counters +
+    2-bit stuck + 1-bit broken vs two f32 leaves)."""
+    r_f32 = SweepRunner(fault_solver(tmp_path / "a", mean=250.0,
+                                     std=30.0), n_configs=3)
+    r_pk = SweepRunner(fault_solver(tmp_path / "b", mean=250.0,
+                                    std=30.0), n_configs=3,
+                       packed_state=True)
+    r_f32.step(2, chunk=2)
+    r_pk.step(2, chunk=2)
+    p_f32 = str(tmp_path / "f32.ckpt.npz")
+    p_pk = str(tmp_path / "packed.ckpt.npz")
+    r_f32.checkpoint(p_f32)
+    r_pk.checkpoint(p_pk)
+
+    def fault_bytes(path):
+        with np.load(path) as z:
+            return sum(z[k].nbytes for k in z.files
+                       if k.startswith("fault/"))
+
+    assert fault_bytes(p_pk) * 3 <= fault_bytes(p_f32)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint v3 <-> v2
+
+
+def _downgrade_to_v2(path):
+    """Strip the v3 meta keys from a checkpoint written by this build —
+    the exact layout a pre-packed-state build would have produced."""
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    meta = _json.loads(bytes(bytearray(data["__meta__"])).decode())
+    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 3
+    assert meta["fault_format"] == "f32"
+    del meta["fault_format"], meta["pack_spec"]
+    meta["version"] = 2
+    data["__meta__"] = np.frombuffer(_json.dumps(meta).encode(),
+                                     np.uint8)
+    np.savez(path, **data)
+
+
+def test_v2_checkpoint_restores_into_v3_runners(tmp_path):
+    """A v2 (f32-fault-leaves, no fault_format meta) checkpoint loads
+    into BOTH a v3 f32 runner (as-is) and a v3 packed runner (packed on
+    load), and the resumed runs match the uninterrupted reference
+    bit-for-bit on losses."""
+    mk = lambda d, **kw: SweepRunner(
+        fault_solver(tmp_path / d, mean=250.0, std=30.0), n_configs=3,
+        **kw)
+    ref = mk("ref")
+    ref.step(4, chunk=2)
+    ckpt = str(tmp_path / "v2.ckpt.npz")
+    ref.checkpoint(ckpt)
+    _downgrade_to_v2(ckpt)
+    want, _ = ref.step(4, chunk=2)
+
+    r_f32 = mk("f")
+    r_f32.restore(ckpt)
+    got_f32, _ = r_f32.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got_f32))
+
+    r_pk = mk("p", packed_state=True)
+    r_pk.restore(ckpt)
+    got_pk, _ = r_pk.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got_pk))
+
+
+def test_packed_v3_checkpoint_restores_into_f32_runner(tmp_path):
+    """Cross-format the other way: a packed v3 checkpoint restores into
+    an f32 runner (mid-bin unpack — every later transition exact), and
+    into another packed runner byte-for-byte."""
+    r_pk = SweepRunner(fault_solver(tmp_path / "a", mean=250.0,
+                                    std=30.0), n_configs=3,
+                       packed_state=True)
+    r_pk.step(4, chunk=2)
+    ckpt = str(tmp_path / "v3p.ckpt.npz")
+    r_pk.checkpoint(ckpt)
+    want, _ = r_pk.step(4, chunk=2)
+
+    r_f32 = SweepRunner(fault_solver(tmp_path / "b", mean=250.0,
+                                     std=30.0), n_configs=3)
+    r_f32.restore(ckpt)
+    got, _ = r_f32.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    r_pk2 = SweepRunner(fault_solver(tmp_path / "c", mean=250.0,
+                                     std=30.0), n_configs=3,
+                        packed_state=True)
+    r_pk2.restore(ckpt)
+    got2, _ = r_pk2.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got2))
+
+
+# ---------------------------------------------------------------------------
+# engine selection + quantized sweep mode end to end
+
+
+def test_pallas_engine_sweep_matches_jax_engine_exactly(tmp_path):
+    """sigma == 0 with the ternary grid on: the config-batched Pallas
+    engine (interpret mode off-TPU) has no stochastic term left, so its
+    sweep losses and fault transitions must match the pure-JAX
+    reference engine exactly — packed banks riding along."""
+    r_jax = SweepRunner(_sigma_solver(tmp_path / "j"), n_configs=2,
+                        engine="jax", dtype_policy="ternary")
+    r_pal = SweepRunner(_sigma_solver(tmp_path / "p"), n_configs=2,
+                        engine="pallas", dtype_policy="ternary",
+                        packed_state=True)
+    l_jax, _ = r_jax.step(4, chunk=2)
+    l_pal, _ = r_pal.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_jax), np.asarray(l_pal))
+    for k in r_jax.fault_states["lifetimes"]:
+        np.testing.assert_array_equal(
+            np.asarray(r_jax.fault_states["lifetimes"][k] <= 0),
+            np.asarray(r_pal.fault_states["life_q"][k] <= 0))
+
+
+def test_pallas_engine_sweep_with_noise_trains(tmp_path):
+    """sigma > 0 on the pallas engine: per-lane in-kernel noise streams
+    differ (the kernel's PRNG, not perturb_weight's), so losses diverge
+    across lanes but stay finite and the sweep trains."""
+    r = SweepRunner(_sigma_solver(tmp_path, sigma=0.05, mean=1e6,
+                                  std=10.0), n_configs=3,
+                    engine="pallas")
+    l0, _ = r.step(2, chunk=2)
+    l1, _ = r.step(10, chunk=2)
+    assert np.isfinite(np.asarray(l1)).all()
+    assert np.asarray(l1).mean() < np.asarray(l0).mean()
+    assert len(set(np.round(np.asarray(l1), 7).tolist())) > 1
+
+
+def test_quantized_mode_loss_tolerance(tmp_path):
+    """The accuracy contract of the quantized sweep mode on the
+    CIFAR-quick-shaped training loop (USAGE.md caveats): int8 tracks
+    the f32 loss curve within 2%, ternary stays finite and within 15%
+    (the CIM-Explorer binary/ternary operating point is a different
+    arithmetic, not a drop-in)."""
+    losses = {}
+    for policy in (None, "int8", "ternary"):
+        r = SweepRunner(fault_solver(tmp_path / str(policy), mean=1e6,
+                                     std=10.0), n_configs=2,
+                        dtype_policy=policy)
+        l, _ = r.step(10, chunk=2)
+        losses[policy] = np.asarray(l)
+    assert np.isfinite(losses["int8"]).all()
+    assert np.isfinite(losses["ternary"]).all()
+    np.testing.assert_allclose(losses["int8"], losses[None], rtol=0.02)
+    np.testing.assert_allclose(losses["ternary"], losses[None],
+                               rtol=0.15)
+    # the grids genuinely change the arithmetic (no silent f32 path)
+    assert not np.array_equal(losses["int8"], losses[None])
+    assert not np.array_equal(losses["ternary"], losses[None])
+
+
+def test_engine_and_policy_validation(tmp_path):
+    """Unknown engines / dtype policies fail loudly at build time, and
+    a quantized policy without an active fault engine is refused — no
+    silent f32 fallback anywhere."""
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    with pytest.raises(ValueError, match="engine"):
+        SweepRunner(s, n_configs=2, engine="cuda")
+    with pytest.raises(ValueError, match="dtype_policy"):
+        SweepRunner(s, n_configs=2, dtype_policy="fp4")
+    with pytest.raises(ValueError, match="pack_spec"):
+        s.make_train_step(fault_format="packed")
+    with pytest.raises(ValueError, match="fault_format"):
+        s.make_train_step(fault_format="origami")
+
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.snapshot_prefix = str(tmp_path / "snap2")
+    s_nofault = Solver(sp, train_feed=lambda: {})
+    with pytest.raises(ValueError, match="fault engine"):
+        s_nofault.make_train_step(dtype_policy="ternary")
+
+
+def test_restore_without_faultstate_announces_redraw(tmp_path, capsys):
+    """Satellite: a snapshot that predates fault-state capture resumes
+    on the construction-time fresh draw — LOUDLY (stderr line + a
+    schema-valid `fault_redraw` observe record), never silently."""
+
+    class ListSink:
+        def __init__(self):
+            self.records = []
+
+        def write(self, record):
+            self.records.append(record)
+
+    s = fault_solver(tmp_path, mean=350.0, std=20.0)
+    s.step(2)
+    model = s.snapshot()
+    state_file = model.replace(".caffemodel", ".solverstate")
+    fault_file = model.replace(".caffemodel", ".faultstate")
+    os.remove(fault_file)
+
+    s2 = fault_solver(tmp_path, mean=350.0, std=20.0)
+    sink = ListSink()
+    s2.enable_metrics(sink)
+    s2.restore(state_file)
+    err = capsys.readouterr().err
+    assert "RE-DRAWN" in err
+    recs = [r for r in sink.records if r.get("type") == "fault_redraw"]
+    assert len(recs) == 1
+    assert recs[0]["snapshot"] == fault_file
+    assert validate_record(recs[0]) == []
+
+    # with the file present, no announcement
+    s3 = fault_solver(tmp_path, mean=350.0, std=20.0)
+    s3.step(2)
+    s3.snapshot()
+    sink3 = ListSink()
+    s4 = fault_solver(tmp_path, mean=350.0, std=20.0)
+    s4.enable_metrics(sink3)
+    s4.restore(state_file)
+    assert not [r for r in sink3.records
+                if r.get("type") == "fault_redraw"]
+
+
+# ---------------------------------------------------------------------------
+# review regressions: artifact layout, cross-format recovery, resolution
+
+
+def test_packed_save_fault_states_canonical_layout(tmp_path):
+    """save_fault_states is an ANALYSIS artifact: a packed runner must
+    still write the canonical f32 layout (lifetimes/stuck keys, no raw
+    counter banks that need the pack spec to read), with the broken
+    census identical to the f32 twin's."""
+    r_f32 = SweepRunner(fault_solver(tmp_path / "a", mean=250.0,
+                                     std=30.0), n_configs=3)
+    r_pk = SweepRunner(fault_solver(tmp_path / "b", mean=250.0,
+                                    std=30.0), n_configs=3,
+                       packed_state=True)
+    r_f32.step(4, chunk=2)
+    r_pk.step(4, chunk=2)
+    p_f32 = r_f32.save_fault_states(str(tmp_path / "f.npz"),
+                                    background=False)
+    p_pk = r_pk.save_fault_states(str(tmp_path / "p.npz"),
+                                  background=False)
+    with np.load(p_f32) as zf, np.load(p_pk) as zp:
+        assert set(zf.files) == set(zp.files)
+        assert not [k for k in zp.files
+                    if k.startswith(("life_q/", "stuck_bits/"))]
+        for k in zp.files:
+            if k.startswith("lifetimes/"):
+                np.testing.assert_array_equal(zf[k] <= 0, zp[k] <= 0)
+            elif k.startswith("stuck/"):
+                np.testing.assert_array_equal(zf[k], zp[k])
+
+
+def test_ckpt_lane_recovery_survives_cross_format_restore(tmp_path):
+    """Escalating recovery after a CROSS-format restore: the retry
+    policy's checkpoint slice comes from _last_ckpt_path, which then
+    points at a file in the OTHER fault format — the rows must convert
+    (the restore() upgrade path), not silently degrade to a fresh
+    re-init on the leaf-name mismatch."""
+    mk = lambda d, **kw: SweepRunner(
+        fault_solver(tmp_path / d, mean=250.0, std=30.0), n_configs=3,
+        **kw)
+    ref = mk("ref")
+    ref.step(4, chunk=2)
+    ckpt = str(tmp_path / "f32.ckpt.npz")
+    ref.checkpoint(ckpt)
+
+    r_pk = mk("p", packed_state=True)
+    r_pk.restore(ckpt)             # f32 file, packed runner
+    got = r_pk._ckpt_lane_rows(1)
+    assert got is not None
+    rows, done, genetic = got
+    assert set(rows) == set(r_pk._state_arrays()) - {"quarantine"}
+    assert any(n.startswith("fault/life_q/") for n in rows)
+
+    r_pk2 = mk("p2", packed_state=True)
+    r_pk2.step(4, chunk=2)
+    pckpt = str(tmp_path / "pk.ckpt.npz")
+    r_pk2.checkpoint(pckpt)
+    r_f32 = mk("f")
+    r_f32.restore(pckpt)           # packed file, f32 runner
+    got2 = r_f32._ckpt_lane_rows(1)
+    assert got2 is not None
+    rows2, _, _ = got2
+    assert set(rows2) == set(r_f32._state_arrays()) - {"quarantine"}
+    assert any(n.startswith("fault/lifetimes/") for n in rows2)
+
+
+def test_engine_resolved_reflects_kernel_gate(tmp_path):
+    """runner.engine stores the REQUEST; runner.engine_resolved names
+    what actually runs — 'pallas' only when the fused kernel engaged
+    (sigma > 0 or an ADC-grid policy), so bench attribution cannot
+    report an inert flag."""
+    inert = SweepRunner(_sigma_solver(tmp_path / "a", sigma=0.0),
+                        n_configs=2, engine="pallas")
+    assert inert.engine == "pallas" and inert.engine_resolved == "jax"
+    armed = SweepRunner(_sigma_solver(tmp_path / "b", sigma=0.0),
+                        n_configs=2, engine="pallas",
+                        dtype_policy="ternary")
+    assert armed.engine_resolved == "pallas"
+    noisy = SweepRunner(_sigma_solver(tmp_path / "c", sigma=0.05),
+                        n_configs=2, engine="pallas")
+    assert noisy.engine_resolved == "pallas"
+    ref = SweepRunner(_sigma_solver(tmp_path / "d", sigma=0.05),
+                      n_configs=2, engine="jax")
+    assert ref.engine_resolved == "jax"
