@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Minimal sweep-service client: submit a small fault sweep to a local
+service and tail its per-request metrics stream.
+
+Start a service first (in another terminal; any solver with a pinned
+random_seed, a gaussian failure_pattern, and a Data layer)::
+
+    python -m rram_caffe_simulation_tpu.serve \
+        --solver models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt \
+        --service-dir /tmp/sweep-svc --lanes 8 --chunk 10
+
+then::
+
+    python examples/gaussian_failure/serve_demo.py \
+        --dir /tmp/sweep-svc --mean 500 --std 100 --configs 4 \
+        --iters 100 --tenant demo
+
+The script submits one request over the Unix-socket front door (or the
+durable spool when the socket is down), prints every lifecycle record
+from the request's own `requests/<id>.jsonl` stream as it lands —
+submitted -> admitted -> started -> config_done* -> completed/failed —
+and exits 0 on completed, 1 otherwise. The stream is per-request: a
+tenant tails their request without reading anyone else's records.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from rram_caffe_simulation_tpu.serve import ServeClient  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dir", required=True,
+                   help="the service's --service-dir")
+    p.add_argument("--mean", type=float, default=500.0,
+                   help="cell-lifetime mean for every config")
+    p.add_argument("--std", type=float, default=100.0,
+                   help="cell-lifetime std for every config")
+    p.add_argument("--configs", type=int, default=4,
+                   help="Monte-Carlo configs in the request")
+    p.add_argument("--iters", type=int, default=0,
+                   help="training iterations per config (0 = the "
+                        "service default)")
+    p.add_argument("--tenant", default="demo")
+    p.add_argument("--timeout", type=float, default=3600.0,
+                   help="give up tailing after this many seconds")
+    args = p.parse_args(argv)
+
+    client = ServeClient(args.dir)
+    req = {"tenant": args.tenant,
+           "configs": [{"mean": args.mean, "std": args.std}
+                       for _ in range(args.configs)]}
+    if args.iters:
+        req["iters"] = args.iters
+    out = client.submit(req)
+    rid = out["id"]
+    where = "front door" if client.ping() else \
+        "spool (service down — it will pick the request up)"
+    print(f"submitted {rid} via the {where}", flush=True)
+    if out.get("projected_s"):
+        print(f"projected turnaround ~{out['projected_s']:.0f} s",
+              flush=True)
+
+    last = None
+    for rec in client.tail(rid, timeout_s=args.timeout):
+        print(json.dumps(rec), flush=True)
+        last = rec
+    if last is None or last.get("event") not in ("completed", "failed",
+                                                 "rejected"):
+        print(f"gave up after {args.timeout:g} s; check later with: "
+              f"python -m rram_caffe_simulation_tpu.serve.serve_client "
+              f"--dir {args.dir} status {rid}", file=sys.stderr)
+        return 1
+    if last["event"] == "completed":
+        result = client.result(rid)
+        print("per-config results:")
+        for cfg, v in sorted(result.get("results", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            print(f"  config {cfg}: {v['status']}, final loss "
+                  f"{v['loss']:.6g}, broken fraction "
+                  f"{v['broken']:.4f}, {v['attempts']} attempt(s)")
+        return 0
+    print(f"request {rid} ended {last['event']}: "
+          f"{last.get('reason', 'no diagnosis')}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

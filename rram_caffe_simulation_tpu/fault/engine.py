@@ -12,7 +12,9 @@ Reference semantics (failure_maker.cpp:4-84, failure_maker.cu:6-60):
   if lifetime <= 0 the cell is broken and the weight is clamped to its stuck
   value; otherwise, if |grad| >= 1e-20 the lifetime is decremented by the
   batch size (hard-coded 100 in the reference, FIXME at failure_maker.cpp:75
-  — here it is the `decrement` argument), and a cell whose lifetime just
+  — here it is the `decrement` argument, wired from the
+  `Solver(fail_decrement=...)` constructor parameter with the reference
+  value 100 as the bit-identical default), and a cell whose lifetime just
   expired is clamped immediately.
 
 Here the whole engine is a pure function over a FaultState pytree so it jits,

@@ -30,7 +30,8 @@ from .schema import SCHEMA_VERSION, validate_record
 from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
                    debug_trace_lines, fault_redraw_line,
                    make_fault_redraw_record, make_record,
-                   make_retry_record, make_setup_record, retry_line,
+                   make_request_record, make_retry_record,
+                   make_setup_record, request_line, retry_line,
                    sentinel_line, setup_line)
 from .trace import trace
 
@@ -38,6 +39,7 @@ __all__ = [
     "SCHEMA_VERSION", "validate_record",
     "MetricsLogger", "JsonlSink", "CaffeLogSink", "make_record",
     "make_retry_record", "make_setup_record", "setup_line", "retry_line",
+    "make_request_record", "request_line",
     "make_fault_redraw_record", "fault_redraw_line",
     "debug_trace_lines", "sentinel_line",
     "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",

@@ -261,6 +261,54 @@ RETRY_FIELDS = {
     "diagnosis": (str, False),      # failed events: triage attribution
 }
 
+# --- request records (sweep-as-a-service lifecycle) ---
+#
+# One per lifecycle transition of a fault-sweep request submitted to a
+# resident SweepService (serve/): emitted into the service-wide metrics
+# stream AND the request's own `requests/<id>.jsonl` stream, so a
+# tenant can tail their request without reading anyone else's.
+# Events: "submitted" (spooled), "admitted" (queued into the live lane
+# work queue; `projected_s` is the admission controller's backlog
+# projection), "rejected" (admission control refused it — `reason`
+# names why, `projected_s` the projection that exceeded the SLO
+# window), "started" (first config seeded into a lane; `queue_s` is
+# the submit->first-lane wait), "config_done" (one config reached a
+# terminal state; `config` is its global id, `status`
+# completed|failed), "completed"/"failed" (every config terminal;
+# `latency_s` is the submit->terminal wall clock — the turnaround the
+# SLO is about, and what `summarize` digests), "preempted" (service
+# drained with the request in flight, state checkpointed), "resumed"
+# (a restarted service picked the request back up)::
+#
+#     {"schema_version": 1, "type": "request", "iter": 120,
+#      "wall_time": 1722700000.1, "request": "r-0007", "tenant": "alice",
+#      "event": "completed", "configs": 4, "done": 4, "latency_s": 93.2}
+
+REQUEST_EVENTS = ("submitted", "admitted", "rejected", "started",
+                  "config_done", "completed", "failed", "preempted",
+                  "resumed")
+
+REQUEST_STATUSES = ("completed", "failed")
+
+REQUEST_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "request": (str, True),
+    "tenant": (str, True),
+    "event": (str, True),
+    "configs": (int, False),       # configs in the request
+    "done": (int, False),          # terminal configs so far
+    "config": (int, False),        # config_done: global config id
+    "status": (str, False),        # config_done: completed | failed
+    "latency_s": (_NUM, False),    # terminal: submit -> terminal secs
+    "queue_s": (_NUM, False),      # started: submit -> first lane secs
+    "projected_s": (_NUM, False),  # admitted/rejected: backlog
+                                   # projection vs the SLO window
+    "reason": (str, False),        # rejected / failed: why
+}
+
 # --- fault_redraw records (restore fallback announcement) ---
 #
 # Emitted by Solver.restore when a snapshot PREDATES fault-state
@@ -424,6 +472,34 @@ def _validate_retry(rec) -> list:
     return errs
 
 
+def _validate_request(rec) -> list:
+    errs = _check_fields(rec, REQUEST_FIELDS, "request")
+    errs += _check_iter(rec, "request")
+    event = rec.get("event")
+    if isinstance(event, str) and event not in REQUEST_EVENTS:
+        errs.append(f"request: unknown event {event!r} "
+                    f"(expected one of {REQUEST_EVENTS})")
+    status = rec.get("status")
+    if isinstance(status, str) and status not in REQUEST_STATUSES:
+        errs.append(f"request: unknown status {status!r} "
+                    f"(expected one of {REQUEST_STATUSES})")
+    for key in ("request", "tenant"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"request: {key} must be non-empty")
+    for key, lo in (("configs", 1), ("done", 0), ("config", 0)):
+        val = rec.get(key)
+        if isinstance(val, int) and not isinstance(val, bool) \
+                and val < lo:
+            errs.append(f"request: {key} must be >= {lo}")
+    for key in ("latency_s", "queue_s", "projected_s"):
+        val = rec.get(key)
+        if isinstance(val, _NUM) and not isinstance(val, bool) \
+                and val < 0:
+            errs.append(f"request: {key} must be >= 0")
+    return errs
+
+
 def _validate_fault_redraw(rec) -> list:
     errs = _check_fields(rec, FAULT_REDRAW_FIELDS, "fault_redraw")
     errs += _check_iter(rec, "fault_redraw")
@@ -464,6 +540,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_setup(rec)
     if rtype == "retry":
         return _check_version(rec) + _validate_retry(rec)
+    if rtype == "request":
+        return _check_version(rec) + _validate_request(rec)
     if rtype == "fault_redraw":
         return _check_version(rec) + _validate_fault_redraw(rec)
     if rtype is not None:

@@ -125,6 +125,84 @@ def retry_line(record: dict) -> str:
     return head + tail
 
 
+def make_request_record(iteration: int, request: str, tenant: str,
+                        event: str, configs: Optional[int] = None,
+                        done: Optional[int] = None,
+                        config: Optional[int] = None,
+                        status: Optional[str] = None,
+                        latency_s: Optional[float] = None,
+                        queue_s: Optional[float] = None,
+                        projected_s: Optional[float] = None,
+                        reason: Optional[str] = None) -> dict:
+    """One sweep-as-a-service request lifecycle event (schema.py
+    REQUEST_FIELDS): submitted -> admitted|rejected -> started ->
+    config_done* -> completed|failed, plus preempted/resumed around a
+    service drain. `latency_s` on the terminal events is the
+    submit->terminal turnaround the service's SLO is about."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "request",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "request": str(request),
+        "tenant": str(tenant),
+        "event": str(event),
+    }
+    if configs is not None:
+        rec["configs"] = int(configs)
+    if done is not None:
+        rec["done"] = int(done)
+    if config is not None:
+        rec["config"] = int(config)
+    if status is not None:
+        rec["status"] = str(status)
+    if latency_s is not None:
+        rec["latency_s"] = round(float(latency_s), 4)
+    if queue_s is not None:
+        rec["queue_s"] = round(float(queue_s), 4)
+    if projected_s is not None:
+        rec["projected_s"] = round(float(projected_s), 4)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    return rec
+
+
+def request_line(record: dict) -> str:
+    """One-line text form of a `request` record."""
+    event = record.get("event")
+    head = (f"Sweep request {record.get('request')} "
+            f"(tenant {record.get('tenant')})")
+    if event == "config_done":
+        tail = (f": config {record.get('config')} "
+                f"{record.get('status', '?')} "
+                f"({record.get('done', '?')}/"
+                f"{record.get('configs', '?')} done)")
+    elif event in ("completed", "failed"):
+        tail = f" {event}"
+        if "latency_s" in record:
+            tail += f" in {record['latency_s']:g} s"
+        if record.get("reason"):
+            tail += f": {record['reason']}"
+    elif event == "rejected":
+        tail = " rejected by admission control"
+        if "projected_s" in record:
+            tail += f" (projected {record['projected_s']:g} s)"
+        if record.get("reason"):
+            tail += f": {record['reason']}"
+    elif event == "started":
+        tail = " started"
+        if "queue_s" in record:
+            tail += f" after {record['queue_s']:g} s queued"
+    elif event == "admitted":
+        tail = f" admitted ({record.get('configs', '?')} configs"
+        if "projected_s" in record:
+            tail += f", projected {record['projected_s']:g} s"
+        tail += ")"
+    else:
+        tail = f" {event}"
+    return head + tail
+
+
 def make_fault_redraw_record(iteration: int, snapshot: str,
                              reason: str) -> dict:
     """The restore-fallback announcement (schema.py
@@ -392,6 +470,10 @@ class CaffeLogSink:
             return
         if rtype == "retry":
             self._emit(retry_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "request":
+            self._emit(request_line(record))
             self._maybe_flush()
             return
         if rtype == "fault_redraw":

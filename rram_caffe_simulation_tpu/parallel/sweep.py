@@ -79,6 +79,9 @@ class _HealingState:
         self.lane_attempt = np.ones(n, dtype=np.int64)
         #: pending work: [{"config", "attempt", "eligible_iter"}]
         self.pending: List[dict] = []
+        #: per-config iteration-budget overrides (live submissions may
+        #: carry their own budget; absent = the sweep default `budget`)
+        self.cfg_budget: Dict[int, int] = {}
         #: config id -> result record (see SweepRunner.config_report)
         self.results: Dict[int, dict] = {}
         self.failures: Dict[int, dict] = {}
@@ -108,6 +111,8 @@ class _HealingState:
             "lane_done": [int(x) for x in self.lane_done],
             "lane_attempt": [int(x) for x in self.lane_attempt],
             "pending": list(self.pending),
+            "cfg_budget": {str(k): int(v)
+                           for k, v in self.cfg_budget.items()},
             "results": {str(k): v for k, v in self.results.items()},
             "failures": {str(k): v for k, v in self.failures.items()},
             "benign": sorted(int(x) for x in self.benign),
@@ -122,6 +127,8 @@ class _HealingState:
         h.lane_done = np.asarray(d["lane_done"], np.int64)
         h.lane_attempt = np.asarray(d["lane_attempt"], np.int64)
         h.pending = list(d["pending"])
+        h.cfg_budget = {int(k): int(v)
+                        for k, v in d.get("cfg_budget", {}).items()}
         h.results = {int(k): v for k, v in d["results"].items()}
         h.failures = {int(k): v for k, v in d["failures"].items()}
         h.benign = set(d["benign"])
@@ -174,6 +181,16 @@ class SweepRunner:
         # self-healing layer (enable_self_healing): lane->config work
         # queue, retry policy, completion ledger; None = plain sweep
         self._healing: Optional[_HealingState] = None
+        # sweep-as-a-service hooks (serve/ — the SweepService rides
+        # these instead of subclassing): an ordering policy for the
+        # refill queue (set_refill_policy: weighted-fair multi-tenant
+        # packing), a per-lane completion callback fired BEFORE the
+        # harvested lane is freed (per-request result capture), and
+        # the per-lane virtual-time mode armed by enable_self_healing
+        self._refill_policy = None
+        self.on_lane_complete = None
+        self._virtual_time = False
+        self._vstep_virtual = None
         self._means = None if means is None else np.asarray(means,
                                                             np.float64)
         self._stds = None if stds is None else np.asarray(stds,
@@ -330,6 +347,9 @@ class SweepRunner:
             apply_fn=apply_fn, dtype_policy=dtype_policy,
             fault_format="packed" if packed_state else "f32",
             pack_spec=self._pack_spec)
+        # retained for the virtual-time vmap variant (per-lane batch /
+        # iteration / rng axes — built lazily by enable_self_healing)
+        self._base_step = base
         # `engine` is the REQUEST; this is what actually runs — the
         # fused kernel only engages when there is a per-lane weight
         # materialization to eliminate (sigma > 0 or an ADC-grid
@@ -488,7 +508,9 @@ class SweepRunner:
     def enable_self_healing(self, budget: int, max_retries: int = 1,
                             backoff_iters: int = 0,
                             use_checkpoint: bool = True,
-                            extra_configs=None):
+                            extra_configs=None,
+                            start_empty: bool = False,
+                            virtual_time: bool = False):
         """Arm the self-healing layer: every resident config becomes a
         work-queue item with an iteration `budget` and at-least-once
         completion semantics. At chunk boundaries the dispatcher
@@ -509,16 +531,80 @@ class SweepRunner:
 
         The sweep is complete (`healing_complete()`) only when every
         requested config is completed or failed-with-diagnosis; see
-        `config_report()`."""
+        `config_report()`.
+
+        `start_empty=True` is the sweep-as-a-service mode (serve/): no
+        resident config is pre-assigned — every lane starts idle
+        (host-frozen) and ALL work arrives through the live
+        `submit_configs()` API, packed into lanes continuous-batching
+        style as it lands. `virtual_time=True` additionally gives every
+        lane its own iteration clock: the batch gather, the per-step
+        RNG stream (folded by CONFIG id, not lane index), the LR
+        schedule, and the remap cadence all follow the lane's OWN
+        progress — so a config's trained result depends only on
+        (spec, config id, attempt, budget, solver seed), never on when
+        it was seeded, which lane it landed in, or what else shared the
+        sweep. That schedule-independence is the service's
+        reproducibility contract (scripts/check_serve_contract.py);
+        it requires the device-resident dataset path and a config-only
+        mesh, and costs an n_lanes-wide batch gather per step."""
         if not self._pipeline_on:
             raise ValueError(
                 "self-healing needs the chunk bookkeeping path: build "
                 "the SweepRunner with pipeline_depth=0 (synchronous) or "
                 ">= 1 (consumer thread), not None")
+        if virtual_time:
+            if self._dataset is None:
+                raise ValueError(
+                    "virtual_time=True needs the device-resident "
+                    "dataset path (a materializable Data layer, "
+                    "preload=True): per-lane iteration clocks gather "
+                    "each lane's batch by its own index, which a "
+                    "sequential host feed cursor cannot replay")
+            if self.config_block:
+                raise ValueError(
+                    "virtual_time=True is incompatible with "
+                    "config_block (the blocked lax.map packs a shared "
+                    "batch across the block)")
+            if set(self.mesh.axis_names) - {"config"}:
+                raise ValueError(
+                    "virtual_time=True supports config-only meshes: "
+                    "the per-lane batch gather has no 'data'/'model' "
+                    "partitioning rule")
         h = _HealingState(self.n, budget, max_retries, backoff_iters,
                           use_checkpoint, self.iter)
+        if start_empty:
+            # service mode: no pre-assigned residents — every lane idle
+            # and host-frozen until a live submission seeds it
+            h.lane_cfg[:] = -1
+            h.benign = set(range(self.n))
+        self._healing = h
+        self._virtual_time = bool(virtual_time)
+        if virtual_time:
+            self._ensure_virtual_step()
+        if start_empty:
+            self._set_quarantine_bits(set_lanes=range(self.n))
+        if extra_configs:
+            self.submit_configs(extra_configs)
+        return self
+
+    def submit_configs(self, specs, budget: Optional[int] = None):
+        """Live continuous-batching submission: queue new config specs
+        ({"mean", "std"} dicts) into a self-healing sweep AFTER
+        construction. Freed lanes are re-seeded with queued configs at
+        the next chunk boundary — this is the host-side queue promoted
+        to the service's front door (ROADMAP item 2). `budget`
+        overrides the sweep default iteration budget for these configs
+        (heterogeneous requests train to their own horizons). Returns
+        the allocated config ids, the handles `config_report()` and
+        the completion ledger use."""
+        h = self._healing
+        if h is None:
+            raise ValueError("submit_configs() needs "
+                             "enable_self_healing() first")
         fp = self.solver.param.failure_pattern
-        for spec in (extra_configs or []):
+        ids = []
+        for spec in specs:
             cfg = h.next_config
             h.next_config += 1
             self._cfg_specs[cfg] = {
@@ -532,10 +618,26 @@ class SweepRunner:
                 fault_packed.check_spec_bounds(
                     self._pack_spec, self._cfg_specs[cfg]["mean"],
                     self._cfg_specs[cfg]["std"])
+            if budget is not None:
+                if int(budget) <= 0:
+                    raise ValueError("submit_configs budget must be "
+                                     f"> 0, got {budget!r}")
+                h.cfg_budget[cfg] = int(budget)
             h.pending.append({"config": cfg, "attempt": 1,
                               "eligible_iter": int(self.iter)})
-        self._healing = h
-        return self
+            ids.append(cfg)
+        return ids
+
+    def set_refill_policy(self, policy):
+        """Install an ordering policy for the lane-refill queue. At
+        each reclamation pass the eligible pending entries (dicts with
+        "config"/"attempt"/"eligible_iter") are passed as
+        `policy(entries, lane_map)` — `lane_map` the current
+        lane->config occupancy, -1 for the free lanes about to be
+        seeded — and consumed in the returned order. The SweepService
+        installs its weighted-fair multi-tenant policy here; None
+        restores the default (config id, attempt) order."""
+        self._refill_policy = policy
 
     def healing_complete(self) -> bool:
         """True when self-healing is armed and every requested config
@@ -741,6 +843,12 @@ class SweepRunner:
         self.quarantine = jax.device_put(
             jnp.asarray(m), self._replicated_sharding())
 
+    def _cfg_budget_of(self, cfg: int) -> int:
+        """The iteration budget of a config: its live-submission
+        override when one was given, else the sweep default."""
+        h = self._healing
+        return int(h.cfg_budget.get(int(cfg), h.budget))
+
     def _lane_broken(self, lane: int) -> float:
         """Broken-cell fraction of one lane's fault-state slice (the
         single census definition: fault_engine.broken_fraction, which
@@ -781,7 +889,8 @@ class SweepRunner:
         # --- completion harvest ---
         done_lanes = [l for l in range(self.n)
                       if h.lane_cfg[l] >= 0 and l not in h.benign
-                      and h.lane_done[l] >= h.budget]
+                      and h.lane_done[l] >=
+                      self._cfg_budget_of(h.lane_cfg[l])]
         if done_lanes:
             mask = np.asarray(self.quarantine)
             lvals = None
@@ -800,6 +909,11 @@ class SweepRunner:
                     "loss": (float(lvals[lane])
                              if lvals is not None else None),
                     "broken": self._lane_broken(lane)}
+                if self.on_lane_complete is not None:
+                    # service hook: the lane's state rows are still the
+                    # completed config's — capture results BEFORE the
+                    # lane is freed and possibly re-seeded below
+                    self.on_lane_complete(cfg, lane, h.results[cfg])
                 h.lane_cfg[lane] = -1
                 h.benign.add(lane)
                 newly_benign.append(lane)
@@ -852,6 +966,11 @@ class SweepRunner:
         eligible = sorted(
             (e for e in h.pending if e["eligible_iter"] <= self.iter),
             key=lambda e: (e["config"], e["attempt"]))
+        if free and eligible and self._refill_policy is not None:
+            # service scheduling seam: the policy (e.g. weighted-fair
+            # multi-tenant packing) re-orders who gets the freed lanes
+            eligible = list(self._refill_policy(
+                eligible, [int(c) for c in h.lane_cfg]))
         if free and eligible:
             if self._consumer is not None:
                 # barrier BEFORE mutating _quar_seen / the mask: chunks
@@ -899,9 +1018,10 @@ class SweepRunner:
         h = self._healing
         if h is None:
             return k
-        rem = [int(h.budget - h.lane_done[l]) for l in range(self.n)
+        rem = [int(self._cfg_budget_of(h.lane_cfg[l]) - h.lane_done[l])
+               for l in range(self.n)
                if h.lane_cfg[l] >= 0 and l not in h.benign
-               and h.lane_done[l] < h.budget]
+               and h.lane_done[l] < self._cfg_budget_of(h.lane_cfg[l])]
         if rem:
             k = min(k, min(rem))
         return max(k, 1)
@@ -1116,6 +1236,66 @@ class SweepRunner:
             return p, h, f, q, losses, outputs, mets
         return run
 
+    def _ensure_virtual_step(self):
+        """Build the per-lane virtual-time vmap variant of the step:
+        every axis per-lane — batch (each lane gathered its own), the
+        iteration scalar (per-lane clock, so the LR schedule follows
+        lane progress), the RNG key, and the remap flag. The quarantine
+        wrapper is the same one the shared-time step uses."""
+        if self._vstep_virtual is not None:
+            return
+        vstep = jax.vmap(self._base_step,
+                         in_axes=(0, 0, 0, 0, 0, 0, 0))
+        self._vstep_virtual = self._make_quarantine_step(
+            vstep, self.n, self._replicated_sharding())
+
+    def _make_chunk_run_virtual(self):
+        """The scanned k-iteration run under per-lane virtual time
+        (service mode): `its`/`starts` are (k, n) per-lane iteration
+        clocks and batch-gather offsets (offsets computed on the HOST
+        in arbitrary precision, like the shared-time path), `cfgs` the
+        (n,) config id per lane — the RNG stream identity, folded in
+        place of the lane index so a config's noise stream is the same
+        whichever lane it lands in — and `remaps` the (k, n) per-lane
+        remap cadence flags."""
+        B, N = self._ds_batch, self._ds_n
+        key = self.solver._key
+
+        def run(params, history, fault, quar, dataset, its, starts,
+                cfgs, remaps):
+            def one(carry, xs):
+                params_, history_, fault_, quar_ = carry
+                it_l, start_l, remap_l = xs          # (n,) each
+                rngs = jax.vmap(
+                    lambda t, c: jax.random.fold_in(
+                        jax.random.fold_in(key, t), c))(it_l, cfgs)
+                idx = (start_l[:, None] + jnp.arange(B)[None, :]) % N
+                batch_t = {name: arr[idx]
+                           for name, arr in dataset.items()}
+                p2, h2, f2, q2, loss, outputs, mets = \
+                    self._vstep_virtual(params_, history_, fault_,
+                                        quar_, batch_t, it_l, rngs,
+                                        remap_l)
+                return (p2, h2, f2, q2), (loss, outputs, mets)
+
+            (p, h, f, q), (losses, outputs, mets) = jax.lax.scan(
+                one, (params, history, fault, quar),
+                (its, starts, remaps))
+            return p, h, f, q, losses, outputs, mets
+        return run
+
+    def _run_chunk_virtual(self, k: int, *args):
+        """Dispatch one virtual-time chunk (lazy jit; the executable is
+        cached under its own key so shared-time chunk functions are
+        untouched)."""
+        key = (k, "virtual")
+        if key not in self._chunk_fns:
+            jfn = jax.jit(self._make_chunk_run_virtual(),
+                          donate_argnums=(0, 1, 2))
+            with self.setup.timed_compile():
+                self._chunk_fns[key] = jfn.lower(*args).compile()
+        return self._chunk_fns[key](*args)
+
     def _run_chunk(self, k: int, *args):
         """Dispatch one chunk = k scanned sweep iterations. On a
         tunneled/remote runtime each dispatch pays a fixed round-trip;
@@ -1232,11 +1412,27 @@ class SweepRunner:
     def _remap_due(self) -> bool:
         """Same start/period gating as Solver._remap_due — remapping stays
         active in sweeps (each config permutes by its own fault state)."""
+        return self._remap_due_at(self.iter)
+
+    def _remap_due_at(self, iteration: int) -> bool:
+        """Remap cadence at an arbitrary iteration clock — the virtual-
+        time path evaluates it per lane (each lane's own progress)."""
         st = self.solver.strategies
         if st.prune_orders is None:
             return False
-        times = self.iter + 1
+        times = iteration + 1
         return times >= st.remap_start and (
+            (times - st.remap_start) % st.remap_period == 0)
+
+    def _remap_due_grid(self, t: np.ndarray) -> np.ndarray:
+        """_remap_due_at over a whole (chunk, lanes) clock grid in one
+        vectorized pass — the virtual-time dispatch evaluates it every
+        chunk, and a per-element Python loop scales with the lane pool."""
+        st = self.solver.strategies
+        if st.prune_orders is None:
+            return np.zeros(t.shape, dtype=bool)
+        times = t + 1
+        return (times >= st.remap_start) & (
             (times - st.remap_start) % st.remap_period == 0)
 
     def _genetic_due_at(self, iteration: int) -> bool:
@@ -1590,22 +1786,48 @@ class SweepRunner:
                 self._maybe_genetic()
                 k = self._budget_chunk_cap(self._genetic_chunk_cap(
                     min(max(chunk, 1), iters - done)))
-                its, starts, remaps = [], [], []
-                for _ in range(k):
-                    its.append(self.iter)
-                    starts.append((self.iter * self._ds_batch) % self._ds_n)
-                    remaps.append(self._remap_due())
-                    self.iter += 1
                 rep = self._replicated_sharding()
                 put = lambda v: jax.device_put(v, rep)
-                (self.params, self.history, self.fault_states,
-                 self.quarantine, losses, outputs,
-                 mets) = self._run_chunk(
-                    k, self.params, self.history, self.fault_states,
-                    self.quarantine, self._dataset,
-                    put(jnp.asarray(its, jnp.int32)),
-                    put(jnp.asarray(starts, jnp.int32)),
-                    put(jnp.asarray(remaps)))
+                if self._virtual_time:
+                    # per-lane clocks: each occupied lane advances from
+                    # its OWN progress counter; idle/benign lanes are
+                    # mask-frozen, so their clock values are inert.
+                    # Gather offsets are exact host arithmetic (int64),
+                    # like the shared-time path's start computation.
+                    h = self._healing
+                    base = h.lane_done.astype(np.int64)       # (n,)
+                    offs = np.arange(k, dtype=np.int64)[:, None]
+                    t = base[None, :] + offs                  # (k, n)
+                    starts = (t * self._ds_batch) % self._ds_n
+                    remaps = self._remap_due_grid(t)
+                    cfgs = np.maximum(h.lane_cfg, 0).astype(np.int32)
+                    self.iter += k
+                    (self.params, self.history, self.fault_states,
+                     self.quarantine, losses, outputs,
+                     mets) = self._run_chunk_virtual(
+                        k, self.params, self.history,
+                        self.fault_states, self.quarantine,
+                        self._dataset,
+                        put(jnp.asarray(t, jnp.int32)),
+                        put(jnp.asarray(starts, jnp.int32)),
+                        put(jnp.asarray(cfgs)),
+                        put(jnp.asarray(remaps)))
+                else:
+                    its, starts, remaps = [], [], []
+                    for _ in range(k):
+                        its.append(self.iter)
+                        starts.append(
+                            (self.iter * self._ds_batch) % self._ds_n)
+                        remaps.append(self._remap_due())
+                        self.iter += 1
+                    (self.params, self.history, self.fault_states,
+                     self.quarantine, losses, outputs,
+                     mets) = self._run_chunk(
+                        k, self.params, self.history, self.fault_states,
+                        self.quarantine, self._dataset,
+                        put(jnp.asarray(its, jnp.int32)),
+                        put(jnp.asarray(starts, jnp.int32)),
+                        put(jnp.asarray(remaps)))
                 self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
                 self._after_dispatch(k, self.iter - 1, losses, outputs,
                                      mets, self.quarantine)
@@ -1779,6 +2001,10 @@ class SweepRunner:
                 "key": [int(x)
                         for x in np.asarray(self.solver._key).ravel()],
                 "seed": int(self.solver.seed),
+                # service mode: per-lane virtual-time clocks change the
+                # batch/RNG math, so a checkpoint written under one
+                # mode must not restore into the other
+                "virtual_time": bool(self._virtual_time),
                 "quarantined": sorted(self._quar_seen),
                 "lane_map": ([int(c) for c in h.lane_cfg] if h is not None
                              else list(range(self.n))),
@@ -1862,6 +2088,13 @@ class SweepRunner:
                 "same random_seed / failure_pattern the checkpoint was "
                 "written under, or the replayed iterations would "
                 "silently diverge")
+        if bool(meta.get("virtual_time", False)) != self._virtual_time:
+            raise ValueError(
+                f"checkpoint {path} was written with virtual_time="
+                f"{bool(meta.get('virtual_time', False))} but this "
+                f"runner has virtual_time={self._virtual_time}; the "
+                "per-lane clock changes the batch/RNG timeline, so "
+                "resume with the same enable_self_healing mode")
         gen = data.pop("__genetics__", None)
         if (gen is None) != (self._genetics is None):
             raise ValueError(

@@ -1,0 +1,35 @@
+"""Sweep-as-a-service (ROADMAP item 2): a resident fault-sweep server.
+
+- `SweepService` (service.py): the long-lived server — warm
+  `SweepRunner` lane pool, durable spool + Unix-socket front door,
+  continuous-batching lane packing, weighted-fair multi-tenant
+  scheduling, admission control, per-request metric streams, and
+  graceful drain/resume through the sweep checkpoint layer.
+- `Spool` (spool.py): the durable filesystem request queue
+  (pending/ -> active/ -> done/ atomic-rename lifecycle).
+- `ServeClient` (serve_client.py): the client library + CLI —
+  submit/status/result/wait/stats/drain/tail over the socket front
+  door, falling back to direct spool files when the socket is down.
+
+Run the server with ``python -m rram_caffe_simulation_tpu.serve`` (or
+``caffe serve``), the client with
+``python -m rram_caffe_simulation_tpu.serve.serve_client``.
+"""
+from .spool import Spool, make_request_id, normalize_request
+
+__all__ = ["SweepService", "DRAIN_EXIT", "Spool", "ServeClient",
+           "make_request_id", "normalize_request"]
+
+
+def __getattr__(name):
+    # lazy: `python -m ...serve.serve_client` must not pre-import the
+    # submodule through the package (runpy double-import warning), and
+    # client-only use should not even parse service.py
+    if name in ("SweepService", "DRAIN_EXIT"):
+        from . import service
+        return getattr(service, name)
+    if name == "ServeClient":
+        from .serve_client import ServeClient
+        return ServeClient
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

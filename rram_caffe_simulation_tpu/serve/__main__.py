@@ -1,0 +1,7 @@
+"""`python -m rram_caffe_simulation_tpu.serve` — run a sweep service."""
+import sys
+
+from .service import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Sweep-service client — library + CLI for the `serve/` front door.
+
+`ServeClient` talks to a running `SweepService` over its local
+Unix-socket front door (one JSON object per line in/out); when the
+socket is absent — the service is down, draining, or started with
+`--no-socket` — submission and status fall back to the DURABLE path,
+the filesystem spool itself, so a request can always be handed off
+(the queue outlives the server; that is the point of the spool).
+
+Like spool.py this module is dependency-free (no jax, no framework
+imports): a monitoring script or another host sharing the filesystem
+can use it without dragging in the accelerator stack.
+
+CLI (``python -m rram_caffe_simulation_tpu.serve.serve_client``)::
+
+    serve_client --dir /runs/svc submit --mean 500 --std 100 \
+        --configs 4 --iters 200 --tenant alice          # -> request id
+    serve_client --dir /runs/svc status  <id>
+    serve_client --dir /runs/svc wait    <id> --timeout 600
+    serve_client --dir /runs/svc result  <id>           # full payload
+    serve_client --dir /runs/svc tail    <id>           # follow records
+    serve_client --dir /runs/svc stats
+    serve_client --dir /runs/svc drain
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket as socket_mod
+import time
+from typing import Iterator, Optional
+
+from .spool import Spool
+
+#: states reported by `status()` that end a request's lifecycle
+TERMINAL_STATES = ("completed", "failed", "rejected")
+
+
+class ServeClient:
+    """Client handle for one service directory. `socket_path` defaults
+    to `<service_dir>/service.sock`; every op tries the socket first
+    and falls back to the spool files (submission stays durable even
+    while the service is down — it picks the request up on restart)."""
+
+    def __init__(self, service_dir: str,
+                 socket_path: Optional[str] = None,
+                 timeout_s: float = 10.0):
+        self.dir = os.path.abspath(service_dir)
+        self.socket_path = socket_path or os.path.join(self.dir,
+                                                       "service.sock")
+        self.timeout_s = float(timeout_s)
+        self._spool = None
+
+    # ------------------------------------------------------------------
+    # transport
+
+    def _call(self, msg: dict) -> Optional[dict]:
+        """One socket round-trip; None when the front door is down."""
+        if not os.path.exists(self.socket_path):
+            return None
+        sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                 socket_mod.SOCK_STREAM)
+        sock.settimeout(self.timeout_s)
+        try:
+            sock.connect(self.socket_path)
+            sock.sendall((json.dumps(msg) + "\n").encode())
+            buf = b""
+            while b"\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    return None
+                buf += chunk
+        except (OSError, socket_mod.timeout):
+            return None
+        finally:
+            sock.close()
+        resp = json.loads(buf.split(b"\n", 1)[0].decode())
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"service refused {msg.get('op')!r}: "
+                f"{resp.get('error', 'unknown error')}")
+        return resp
+
+    def _spool_handle(self) -> Spool:
+        if self._spool is None:
+            self._spool = Spool(os.path.join(self.dir, "spool"))
+        return self._spool
+
+    # ------------------------------------------------------------------
+    # ops
+
+    def ping(self) -> bool:
+        """True when the front door answers."""
+        return self._call({"op": "ping"}) is not None
+
+    def submit(self, request: dict) -> dict:
+        """Submit a fault-sweep request:
+        ``{"configs": [{"mean", "std"}, ...], "iters": N,
+        "tenant": "...", "id": optional}``. Returns {"id", "state",
+        "projected_s"?}. Socket down -> the request is spooled
+        directly (durable; validated again at pickup)."""
+        resp = self._call({"op": "submit", "request": request})
+        if resp is not None:
+            return {k: resp[k] for k in ("id", "state", "projected_s")
+                    if k in resp}
+        rid = self._spool_handle().submit(request)
+        return {"id": rid, "state": "pending", "projected_s": None}
+
+    def status(self, request_id: str) -> Optional[dict]:
+        """The request's current payload (spool file merged with the
+        service's live progress when it answers); None = unknown id."""
+        resp = self._call({"op": "status", "id": request_id})
+        if resp is not None:
+            return resp["request"]
+        return self._spool_handle().read(request_id)
+
+    def result(self, request_id: str) -> Optional[dict]:
+        """Alias of `status` — a terminal request's payload carries the
+        per-config results."""
+        return self.status(request_id)
+
+    def stats(self) -> Optional[dict]:
+        """Service-level snapshot (lanes, occupancy, projection,
+        per-tenant shares); None when the service is down (the spool
+        has no service-level view)."""
+        resp = self._call({"op": "stats"})
+        return resp["stats"] if resp is not None else None
+
+    def drain(self) -> bool:
+        """Ask the service to drain gracefully. Socket down -> drop the
+        durable DRAIN control file so the (re)started service drains at
+        its next beat. Always succeeds."""
+        if self._call({"op": "drain"}) is not None:
+            return True
+        with open(os.path.join(self.dir, "DRAIN"), "w"):
+            pass
+        return True
+
+    def wait(self, request_id: str, timeout_s: float = 600.0,
+             poll_s: float = 0.5) -> dict:
+        """Block until the request reaches a terminal state; returns
+        the terminal payload. TimeoutError after `timeout_s`."""
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            req = self.status(request_id)
+            if req is not None and req.get("status",
+                                           req.get("state")) \
+                    in TERMINAL_STATES:
+                return req
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"request {request_id} not terminal after "
+                    f"{timeout_s:g} s (last: "
+                    f"{(req or {}).get('status', 'unknown')})")
+            time.sleep(poll_s)
+
+    def records_path(self, request_id: str) -> str:
+        """The request's own JSONL metrics stream (one schema-validated
+        `request` record per lifecycle transition)."""
+        return os.path.join(self.dir, "requests",
+                            f"{request_id}.jsonl")
+
+    def tail(self, request_id: str, follow: bool = True,
+             poll_s: float = 0.25,
+             timeout_s: Optional[float] = None) -> Iterator[dict]:
+        """Yield the request's lifecycle records as they land; with
+        `follow`, keeps reading until a terminal record (or
+        `timeout_s`). The stream is per-request, so a tenant tails
+        their own request without seeing anyone else's."""
+        path = self.records_path(request_id)
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        pos = 0
+        while True:
+            if os.path.exists(path):
+                with open(path) as f:
+                    f.seek(pos)
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        rec = json.loads(line)
+                        yield rec
+                        if rec.get("event") in TERMINAL_STATES:
+                            return
+                    pos = f.tell()
+            if not follow:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            time.sleep(poll_s)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="rram-sweep-client",
+        description="client for the resident sweep service (serve/)")
+    p.add_argument("--dir", required=True,
+                   help="the service's --service-dir")
+    p.add_argument("--socket", default=None,
+                   help="socket path override (default "
+                        "<dir>/service.sock)")
+    sub = p.add_subparsers(dest="op", required=True)
+
+    sp = sub.add_parser("submit", help="submit a fault-sweep request")
+    sp.add_argument("--mean", type=float, action="append", default=[],
+                    help="per-config lifetime mean (repeat per config, "
+                         "or give one with --configs N)")
+    sp.add_argument("--std", type=float, action="append", default=[],
+                    help="per-config lifetime std (pairs with --mean)")
+    sp.add_argument("--configs", type=int, default=0,
+                    help="replicate a single --mean/--std into N "
+                         "configs")
+    sp.add_argument("--iters", type=int, default=0,
+                    help="iteration budget (0 = service default)")
+    sp.add_argument("--tenant", default="default")
+    sp.add_argument("--id", default=None,
+                    help="explicit request id (default: generated)")
+    sp.add_argument("--wait", action="store_true",
+                    help="block until terminal and print the result")
+    sp.add_argument("--timeout", type=float, default=600.0)
+
+    for op in ("status", "result"):
+        q = sub.add_parser(op)
+        q.add_argument("id")
+    w = sub.add_parser("wait", help="block until a request is terminal")
+    w.add_argument("id")
+    w.add_argument("--timeout", type=float, default=600.0)
+    t = sub.add_parser("tail", help="follow a request's record stream")
+    t.add_argument("id")
+    t.add_argument("--no-follow", action="store_true")
+    t.add_argument("--timeout", type=float, default=None)
+    sub.add_parser("stats")
+    sub.add_parser("drain")
+    sub.add_parser("ping")
+
+    args = p.parse_args(argv)
+    client = ServeClient(args.dir, socket_path=args.socket)
+
+    if args.op == "ping":
+        up = client.ping()
+        print("up" if up else "down (spool submissions still durable)")
+        return 0 if up else 1
+    if args.op == "submit":
+        means, stds = list(args.mean), list(args.std)
+        if len(means) != len(stds):
+            p.error("--mean and --std must pair up")
+        if not means:
+            p.error("submit needs at least one --mean/--std pair")
+        if args.configs:
+            if len(means) != 1:
+                p.error("--configs N replicates a SINGLE --mean/--std "
+                        "pair")
+            means, stds = means * args.configs, stds * args.configs
+        req = {"tenant": args.tenant,
+               "configs": [{"mean": m, "std": s}
+                           for m, s in zip(means, stds)]}
+        if args.iters:
+            req["iters"] = args.iters
+        if args.id:
+            req["id"] = args.id
+        out = client.submit(req)
+        if args.wait:
+            out = client.wait(out["id"], timeout_s=args.timeout)
+        print(json.dumps(out, indent=2))
+        return 0
+    if args.op in ("status", "result"):
+        req = client.status(args.id)
+        if req is None:
+            print(f"unknown request id {args.id!r}", file=sys.stderr)
+            return 1
+        print(json.dumps(req, indent=2))
+        return 0
+    if args.op == "wait":
+        req = client.wait(args.id, timeout_s=args.timeout)
+        print(json.dumps(req, indent=2))
+        return 0 if req.get("status") == "completed" else 1
+    if args.op == "tail":
+        for rec in client.tail(args.id, follow=not args.no_follow,
+                               timeout_s=args.timeout):
+            print(json.dumps(rec), flush=True)
+        return 0
+    if args.op == "stats":
+        stats = client.stats()
+        if stats is None:
+            print("service down (no socket); stats need a live "
+                  "service", file=sys.stderr)
+            return 1
+        print(json.dumps(stats, indent=2))
+        return 0
+    if args.op == "drain":
+        client.drain()
+        print("drain requested")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

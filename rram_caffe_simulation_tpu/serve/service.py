@@ -1,0 +1,1077 @@
+"""SweepService — the resident fault-sweep server (ROADMAP item 2).
+
+The production story for "millions of users" is not a CLI that pays a
+cold start per sweep: it is ONE long-lived process that holds the
+compiled chunk programs, the device-resident dataset, and a warm
+vectorized-lane pool, and feeds fault-sweep REQUESTS into the
+self-healing lane machinery continuous-batching style — a freed lane is
+re-seeded with the next queued request's configs at the very next chunk
+boundary (Caffe Barista, arXiv 2006.13829, made the same move for
+FPGAs inside the Caffe training loop; CIM-Explorer, arXiv 2505.14303,
+is the workload shape: large batches of heterogeneous crossbar-config
+evaluations whose TURNAROUND is what users feel).
+
+Execution model
+---------------
+The runner runs `enable_self_healing(start_empty=True,
+virtual_time=True)`: no pre-assigned resident configs, every lane idle
+until a submission seeds it, and every lane on its OWN iteration clock
+— so a request's results depend only on (spec, config id, attempt,
+budget, solver seed), never on co-tenants, arrival time, or lane
+placement. That schedule-independence is the service's reproducibility
+contract: results are byte-identical to a direct `SweepRunner`
+execution of the same submissions (scripts/check_serve_contract.py).
+
+Front doors
+-----------
+Requests arrive over a DURABLE queue: the filesystem spool
+(`<dir>/spool/pending`, one atomic JSON file per request — see
+spool.py) is the source of truth, and a local Unix-socket front door
+(serve_client.py is the library + CLI) is the convenience layer that
+validates, spools, and answers status/result/stats queries without the
+client touching the filesystem layout.
+
+On top ride:
+
+- **multi-tenant weighted fairness**: freed lanes are handed to the
+  tenant with the smallest weight-normalized lane share at each chunk
+  boundary (`tenant_weights`), with per-tenant lane-iteration
+  accounting in `stats()`;
+- **admission control with backpressure**: the projected backlog
+  turnaround (pending + in-flight lane-iterations over the measured
+  step rate) is compared against the configured SLO window
+  (`slo_seconds`) — policy "reject" refuses the request with the
+  projection in its terminal record, policy "queue" admits it but
+  flags the risk;
+- **per-request metric streams**: every lifecycle transition is a
+  schema-validated `request` record (observe/schema.py), written to
+  the service-wide metrics JSONL *and* the request's own
+  `requests/<id>.jsonl` so a tenant can tail their request alone;
+- **graceful drain**: SIGTERM (or the client's `drain` op) stops
+  admission, checkpoints the in-flight lanes through the existing v3
+  sweep checkpoint layer plus the request table, and exits 75
+  (EX_TEMPFAIL) — a restarted service resumes with ZERO lost work and
+  bit-identical results (virtual time makes the resumed trajectories
+  independent of the interruption).
+
+    python -m rram_caffe_simulation_tpu.serve \
+        --solver models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt \
+        --service-dir /runs/sweep-svc --lanes 256 --drain-when-idle
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket as socket_mod
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .spool import Spool, _atomic_write, normalize_request
+
+#: exit code of a drained service with in-flight requests checkpointed
+#: — EX_TEMPFAIL, the same "retry me" code the durable sweep driver
+#: uses, so schedulers restart the service with the same --service-dir
+#: and it resumes with zero lost work. A drain with nothing in flight
+#: exits 0.
+DRAIN_EXIT = 75
+
+#: AF_UNIX sun_path is ~104 bytes on the small end; refuse politely
+_MAX_SOCK_PATH = 100
+
+_TERMINAL = ("completed", "failed", "rejected")
+
+
+def _append_jsonl(path: str, rec: dict):
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+class SweepService:
+    """A resident sweep server over one warm `SweepRunner` lane pool.
+
+    `solver_param` is a solver prototxt path or SolverParameter; it
+    must pin `random_seed` (the request-result contract is keyed by
+    it) and configure a gaussian `failure_pattern` (per-request
+    mean/std override it per config). The net must have a
+    materializable Data layer — the service holds the decoded dataset
+    device-resident.
+
+    Single-threaded core: only `serve()`'s loop thread touches the
+    runner. The socket front door and `submit()` write spool files;
+    status/stats reads go through lock-protected snapshots.
+    """
+
+    def __init__(self, solver_param, service_dir: str, *,
+                 lanes: int = 8, chunk: int = 8,
+                 default_iters: int = 100, max_retries: int = 1,
+                 retry_backoff: int = 0,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 slo_seconds: float = 0.0, admission: str = "queue",
+                 poll_interval_s: float = 0.5,
+                 pipeline_depth: int = 0,
+                 socket_path: Optional[str] = "",
+                 allow_inject: bool = False,
+                 save_fault_results: bool = False,
+                 runner_kw: Optional[dict] = None):
+        from ..observe import JsonlSink
+        from ..parallel import SweepRunner
+        from ..solver import Solver
+        from ..utils.io import read_solver_param
+
+        if admission not in ("queue", "reject"):
+            raise ValueError(f"admission policy {admission!r} must be "
+                             "'queue' or 'reject'")
+        if int(default_iters) <= 0:
+            raise ValueError("default_iters must be > 0: it is the "
+                             "budget for requests that carry no "
+                             "'iters' of their own")
+        self.dir = os.path.abspath(service_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "requests"), exist_ok=True)
+        self.spool = Spool(os.path.join(self.dir, "spool"))
+        self.chunk = int(chunk)
+        self.default_iters = int(default_iters)
+        self.slo_seconds = float(slo_seconds)
+        self.admission = admission
+        self.poll_interval_s = float(poll_interval_s)
+        self.allow_inject = bool(allow_inject)
+        self.save_fault_results = bool(save_fault_results)
+        self.tenant_weights = {str(k): float(v)
+                               for k, v in (tenant_weights or {}).items()}
+        self._drain_flag = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._stats_view: dict = {}
+        #: request records emitted on the socket thread, queued for
+        #: the loop thread (the shared metrics sink is unlocked)
+        self._front_records: List[dict] = []
+        self._steps_per_sec = 0.0          # EMA of dispatch rate
+        self._first_timed_beat = True      # first beat pays jit compile
+        self._tenant_lane_iters: Dict[str, int] = {}
+        self._requests: Dict[str, dict] = {}   # id -> table entry
+        self._cfg_req: Dict[int, str] = {}     # global config id -> id
+        self._closed = False
+
+        param = (read_solver_param(solver_param)
+                 if isinstance(solver_param, (str, os.PathLike))
+                 else solver_param)
+        if param.random_seed < 0:
+            raise ValueError(
+                "SweepService needs solver random_seed >= 0: request "
+                "results are keyed by (spec, config id, seed), and a "
+                "wall-clock seed would break resume and the "
+                "reproducibility contract")
+        if not (param.HasField("failure_pattern")
+                and param.failure_pattern.type == "gaussian"):
+            raise ValueError(
+                "SweepService needs failure_pattern { type: 'gaussian' }"
+                " — requests override mean/std per config")
+        param.display = 0
+        param.ClearField("test_interval")
+
+        resuming = os.path.exists(self._state_path())
+        self.solver = Solver(param)
+        self.solver.enable_metrics(JsonlSink(
+            os.path.join(self.dir, "metrics.jsonl"), append=resuming,
+            unbuffered=True))
+        self.runner = SweepRunner(self.solver, n_configs=int(lanes),
+                                  pipeline_depth=int(pipeline_depth),
+                                  **(runner_kw or {}))
+        self.runner.enable_self_healing(
+            budget=self.default_iters, max_retries=int(max_retries),
+            backoff_iters=int(retry_backoff), start_empty=True,
+            virtual_time=True)
+        self.runner.set_refill_policy(self._fair_order)
+        self.runner.on_lane_complete = self._on_lane_complete
+        self._lane_results: Dict[int, dict] = {}   # cfg -> fault rows
+
+        if resuming:
+            self._resume()
+        self._update_stats_view()
+
+        self._sock_server = None
+        if socket_path is not None:
+            path = socket_path or os.path.join(self.dir, "service.sock")
+            if len(path) > _MAX_SOCK_PATH:
+                print(f"Sweep service: socket path {path!r} exceeds "
+                      f"{_MAX_SOCK_PATH} chars — front door disabled, "
+                      "spool submissions still work", flush=True)
+            else:
+                self._sock_server = _SocketServer(self, path)
+                self._sock_server.start()
+
+    # ------------------------------------------------------------------
+    # front door (thread-safe: spool writes + snapshots only)
+
+    def submit(self, request: dict) -> dict:
+        """Validate + spool a request (the in-process twin of the
+        socket `submit` op). Returns {"id", "state": "pending",
+        "projected_s"} — the projection is advisory; the admission
+        DECISION happens at pickup, where it is recorded."""
+        if request.get("inject_nan") is not None \
+                and not self.allow_inject:
+            raise ValueError("inject_nan is a test hook; start the "
+                             "service with allow_inject=True to use it")
+        # submit_seen rides the INITIAL atomic write: the loop thread
+        # may claim the file the instant it lands, so a follow-up
+        # update of the pending/ name could race a rename
+        req = normalize_request(dict(request, submit_seen=True),
+                                self.default_iters)
+        if self.spool.state_of(req["id"]) is not None:
+            raise ValueError(f"request id {req['id']!r} already "
+                             "exists in the spool")
+        # the 'submitted' record lands BEFORE the spool file: the loop
+        # thread may claim the file the instant it appears, and its
+        # 'admitted' append to requests/<id>.jsonl must not beat
+        # 'submitted' in the stream a tenant tails
+        self._emit_request(req, "submitted",
+                           configs=len(req["configs"]),
+                           front_door=True)
+        rid = self.spool.submit(req, self.default_iters)
+        # advisory projection from the lock-protected snapshot (this
+        # may run on the socket thread; the live healing state belongs
+        # to the loop thread — the admission DECISION happens there)
+        view = self.stats()
+        projected = None
+        rate = float(view.get("steps_per_sec") or 0.0) \
+            * int(view.get("lanes") or 0)
+        if rate > 0:
+            projected = (float(view.get("projected_s") or 0.0)
+                         + req["iters"] * len(req["configs"]) / rate)
+        return {"id": rid, "state": "pending",
+                "projected_s": projected}
+
+    def status(self, request_id: str) -> Optional[dict]:
+        """The request's spool payload merged with the live table
+        entry (progress counts) — None when unknown."""
+        req = self.spool.read(request_id)
+        if req is None:
+            return None
+        with self._stats_lock:
+            entry = self._requests.get(request_id)
+            if entry is not None:
+                req.update({k: entry[k] for k in
+                            ("status", "done", "configs_total")
+                            if k in entry})
+        return req
+
+    def stats(self) -> dict:
+        """Service-level snapshot: lanes, occupancy, measured dispatch
+        rate, backlog projection, per-tenant lane-share accounting."""
+        with self._stats_lock:
+            return dict(self._stats_view)
+
+    def drain(self):
+        """Request a graceful drain (same as SIGTERM on the CLI): the
+        loop stops admitting, checkpoints in-flight lanes + the request
+        table, and exits 75 (or 0 when nothing is in flight)."""
+        self._drain_flag.set()
+
+    # ------------------------------------------------------------------
+    # scheduling core (loop thread only)
+
+    def serve(self, max_beats: Optional[int] = None,
+              drain_when_idle: bool = False) -> int:
+        """The scheduling loop: admit pending spool requests, dispatch
+        one chunk across the lane pool, harvest terminal configs, emit
+        lifecycle records, repeat. Returns the process exit code: 0
+        (idle drain / `max_beats` reached / `drain_when_idle` and the
+        queue ran dry) or 75 (drained with in-flight work
+        checkpointed)."""
+        beats = 0
+        while True:
+            self._flush_front_records()
+            if self._drain_flag.is_set() or self._drain_file():
+                return self._drain_exit()
+            admitted = self._admit_pending()
+            worked = False
+            if not self.runner.healing_complete():
+                self._maybe_inject()
+                t0 = time.perf_counter()
+                self.runner.step(self.chunk, chunk=self.chunk)
+                dt = time.perf_counter() - t0
+                # occupancy sampled AFTER the step: configs seeded by
+                # the step's leading heal pass trained this chunk and
+                # must be credited to their tenant (configs that hit
+                # budget are harvested at the NEXT step's pass, so
+                # they are still visible here)
+                self._account_beat(self._tenant_occupancy(), dt)
+                worked = True
+            self._harvest()
+            self._update_stats_view()
+            self._write_state()
+            beats += 1
+            if max_beats is not None and beats >= max_beats:
+                return 0
+            if not worked and not admitted:
+                if drain_when_idle and not self.spool.pending_ids() \
+                        and not self._active_ids():
+                    return self._drain_exit()
+                # idle: wait for the spool, a signal, or the socket
+                self._drain_flag.wait(self.poll_interval_s)
+
+    def _active_ids(self) -> List[str]:
+        return [rid for rid, e in self._requests.items()
+                if e["status"] not in _TERMINAL]
+
+    def _drain_file(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, "DRAIN"))
+
+    def _admit_pending(self) -> int:
+        admitted = 0
+        for rid in self.spool.pending_ids():
+            try:
+                raw = self.spool.read(rid)
+            except ValueError as e:
+                # junk bytes dropped into pending/: quarantine the
+                # file (fresh done/ payload; the original content is
+                # unparseable) so one corrupt submission can never
+                # crash — or spin — the shared resident server
+                entry = self.spool.quarantine(
+                    rid, f"unparseable request file: {e}")
+                with self._stats_lock:
+                    self._requests[rid] = dict(entry, cfg_ids=[],
+                                               configs_total=0, done=0,
+                                               tenant="default")
+                self._emit_request(self._requests[rid], "rejected",
+                                  reason=entry["reason"])
+                continue
+            if raw is None:
+                continue
+            try:
+                # raw files may be dropped into pending/ by anything
+                # that can write the filesystem — re-validate here
+                req = normalize_request(dict(raw, id=rid),
+                                        self.default_iters)
+            except ValueError as e:
+                self._reject(dict(raw, id=rid,
+                                  tenant=str(raw.get("tenant")
+                                             or "default")),
+                             f"invalid request: {e}")
+                continue
+            if "submit_seen" not in raw:
+                # spooled directly (no front-door submit() call): the
+                # lifecycle still starts with a submitted record
+                self._emit_request(req, "submitted",
+                                   configs=len(req["configs"]))
+                self.spool.update(rid, "pending", {"submit_seen": True})
+            if req.get("inject_nan") is not None \
+                    and not self.allow_inject:
+                self._reject(req, "inject_nan is a test hook "
+                                  "(service started without "
+                                  "allow_inject)")
+                continue
+            extra = req["iters"] * len(req["configs"])
+            projected = self._projected_seconds(extra)
+            at_risk = (self.slo_seconds > 0 and projected
+                       and projected > self.slo_seconds)
+            if at_risk and self.admission == "reject":
+                self._reject(req, f"projected turnaround {projected:.0f}"
+                                  f" s exceeds the {self.slo_seconds:g}"
+                                  " s SLO window", projected)
+                continue
+            # scheduling quantum: budgets are rounded up to a chunk
+            # multiple so every lane's remaining work stays a multiple
+            # of the compiled chunk length (one executable, no
+            # per-request recompiles)
+            granted = -(-req["iters"] // self.chunk) * self.chunk
+            ids = self.runner.submit_configs(req["configs"],
+                                            budget=granted)
+            entry = {
+                "id": rid, "tenant": req["tenant"],
+                "cfg_ids": ids, "iters": req["iters"],
+                "iters_granted": granted,
+                "configs_total": len(ids), "done": 0,
+                "submit_time": float(req.get("submit_time",
+                                             time.time())),
+                "admit_time": time.time(), "start_time": None,
+                "status": "admitted", "results": {},
+                "inject_nan": req.get("inject_nan"),
+                "injected_attempt": {},
+            }
+            with self._stats_lock:
+                self._requests[rid] = entry
+                for cfg in ids:
+                    self._cfg_req[cfg] = rid
+            self.spool.claim(rid, {"cfg_ids": ids,
+                                   "iters": req["iters"],
+                                   "iters_granted": granted,
+                                   "status": "admitted"})
+            self._emit_request(entry, "admitted", configs=len(ids),
+                              projected_s=projected,
+                              reason=("slo at risk (queued anyway)"
+                                      if at_risk else None))
+            admitted += 1
+        return admitted
+
+    def _reject(self, req: dict, reason: str,
+                projected: Optional[float] = None):
+        rid = req["id"]
+        self.spool.finish(rid, {"status": "rejected",
+                                "reason": reason}, src="pending")
+        entry = {"id": rid,
+                 "tenant": str(req.get("tenant") or "default"),
+                 "cfg_ids": [],
+                 "configs_total": len(req.get("configs") or []),
+                 "done": 0, "status": "rejected",
+                 "submit_time": float(req.get("submit_time")
+                                      or time.time())}
+        with self._stats_lock:
+            self._requests[rid] = entry
+        self._emit_request(entry, "rejected", reason=reason,
+                          projected_s=projected)
+
+    def _projected_seconds(self, extra_iters: int = 0
+                           ) -> Optional[float]:
+        """Backlog projection: config-iterations outstanding (active
+        lanes' remaining budgets + queued configs' full budgets +
+        `extra_iters`) over the measured lane-pool rate. None until a
+        dispatch rate has been measured (everything admits)."""
+        if self._steps_per_sec <= 0:
+            return None
+        h = self.runner._healing
+        backlog = int(extra_iters)
+        for lane in range(self.runner.n):
+            cfg = int(h.lane_cfg[lane])
+            if cfg >= 0 and lane not in h.benign:
+                backlog += max(self.runner._cfg_budget_of(cfg)
+                               - int(h.lane_done[lane]), 0)
+        for e in h.pending:
+            backlog += self.runner._cfg_budget_of(int(e["config"]))
+        rate = self._steps_per_sec * self.runner.n   # lane-iters/sec
+        return backlog / rate if rate > 0 else None
+
+    def _tenant_occupancy(self) -> Dict[str, int]:
+        h = self.runner._healing
+        occ: Dict[str, int] = {}
+        for lane in range(self.runner.n):
+            cfg = int(h.lane_cfg[lane])
+            if cfg >= 0 and lane not in h.benign:
+                t = self._tenant_of_cfg(cfg)
+                occ[t] = occ.get(t, 0) + 1
+        return occ
+
+    def _account_beat(self, occupied: Dict[str, int], dt: float):
+        """Per-tenant lane-share accounting at the chunk boundary, and
+        the dispatch-rate EMA the admission controller divides by."""
+        for tenant, lanes in occupied.items():
+            self._tenant_lane_iters[tenant] = (
+                self._tenant_lane_iters.get(tenant, 0)
+                + lanes * self.chunk)
+        if dt > 0:
+            if self._first_timed_beat:
+                # this beat paid the chunk executable's jit compile
+                # (seconds on a beat that steady-states in ms) —
+                # seeding the EMA from it would project turnarounds
+                # ~100x too slow and spuriously reject every request
+                # under --admission reject until the EMA recovered
+                self._first_timed_beat = False
+                return
+            rate = self.chunk / dt
+            self._steps_per_sec = (rate if self._steps_per_sec <= 0
+                                   else 0.7 * self._steps_per_sec
+                                   + 0.3 * rate)
+
+    def _tenant_of_cfg(self, cfg: int) -> str:
+        rid = self._cfg_req.get(int(cfg))
+        if rid is None:
+            return "default"
+        return self._requests[rid]["tenant"]
+
+    def _weight(self, tenant: str) -> float:
+        w = self.tenant_weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    def _fair_order(self, entries, lane_map):
+        """Weighted-fair refill: hand each freed lane to the eligible
+        config whose tenant currently holds the smallest
+        weight-normalized lane share; ties break by (config id,
+        attempt) = submission order. Greedy water-filling — after each
+        pick the tenant's share grows, so a queue of one tenant cannot
+        starve the others no matter how many configs it spooled
+        first. Only the freed lanes' worth of picks is consumed this
+        boundary, so the greedy scan stops there — the backlog tail
+        keeps its (config, attempt) submission order."""
+        occ: Dict[str, float] = {}
+        free = 0
+        for cfg in lane_map:
+            if cfg >= 0:
+                t = self._tenant_of_cfg(cfg)
+                occ[t] = occ.get(t, 0.0) + 1.0
+            else:
+                free += 1
+        work = list(entries)
+        out = []
+        while work and len(out) < free:
+            best = min(work, key=lambda e: (
+                occ.get(self._tenant_of_cfg(e["config"]), 0.0)
+                / self._weight(self._tenant_of_cfg(e["config"])),
+                e["config"], e["attempt"]))
+            work.remove(best)
+            out.append(best)
+            t = self._tenant_of_cfg(best["config"])
+            occ[t] = occ.get(t, 0.0) + 1.0
+        return out + work
+
+    # ------------------------------------------------------------------
+    # harvest + lifecycle records
+
+    def _on_lane_complete(self, cfg: int, lane: int, result: dict):
+        """Runner hook, fired BEFORE a harvested lane is freed: capture
+        the completed config's fault-state rows while they are still
+        this config's (the refill overwrites them)."""
+        if not self.save_fault_results:
+            return
+        import numpy as np
+        from ..fault import engine as fault_engine
+        rows = {}
+        for name, v in fault_engine.iter_state_leaves(
+                self.runner.fault_states):
+            rows[name] = np.asarray(v[lane])
+        self._lane_results[int(cfg)] = rows
+
+    def _save_fault_rows(self, rid: str, cfg: int):
+        rows = self._lane_results.pop(int(cfg), None)
+        if rows is None:
+            return None
+        import numpy as np
+        name = f"{rid}.cfg{cfg}.faults.npz"
+        path = os.path.join(self.dir, "requests", name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **rows)
+        os.replace(tmp, path)
+        return name
+
+    def _harvest(self):
+        """Fold the runner's completion ledger into the request table:
+        per-config `config_done` records, `started` transitions, and
+        terminal completed/failed records with the submit->terminal
+        latency (the SLO-facing number)."""
+        rep = self.runner.config_report()
+        done = {**rep["completed"], **rep["failed"]}
+        active = rep["active"]
+        now = time.time()
+        for rid in list(self._active_ids()):
+            entry = self._requests[rid]
+            if entry["status"] == "admitted" \
+                    and any(c in active or c in done
+                            for c in entry["cfg_ids"]):
+                entry["status"] = "running"
+                entry["start_time"] = now
+                self._emit_request(
+                    entry, "started",
+                    queue_s=max(now - entry["submit_time"], 0.0))
+            for cfg in entry["cfg_ids"]:
+                key = str(cfg)
+                if key in entry["results"] or cfg not in done:
+                    continue
+                v = dict(done[cfg])
+                if self.save_fault_results:
+                    fname = self._save_fault_rows(rid, cfg)
+                    if fname:
+                        v["fault_npz"] = fname
+                entry["results"][key] = v
+                entry["done"] = len(entry["results"])
+                self._emit_request(entry, "config_done", config=cfg,
+                                  status=v["status"],
+                                  done=entry["done"],
+                                  configs=entry["configs_total"])
+            if entry["done"] == entry["configs_total"]:
+                failed = [c for c, v in entry["results"].items()
+                          if v["status"] == "failed"]
+                entry["status"] = "failed" if failed else "completed"
+                entry["latency_s"] = max(now - entry["submit_time"],
+                                         0.0)
+                reason = None
+                if failed:
+                    reason = "; ".join(
+                        f"config {c}: "
+                        f"{entry['results'][c].get('diagnosis', '?')}"
+                        for c in failed)
+                self.spool.finish(rid, {
+                    "status": entry["status"],
+                    "results": entry["results"],
+                    "latency_s": entry["latency_s"],
+                    "reason": reason})
+                self._emit_request(entry, entry["status"],
+                                  configs=entry["configs_total"],
+                                  done=entry["done"],
+                                  latency_s=entry["latency_s"],
+                                  reason=reason)
+
+    def _emit_request(self, entry: dict, event: str,
+                      front_door: bool = False, **kw):
+        from ..observe import make_request_record
+        kw = {k: v for k, v in kw.items() if v is not None}
+        rec = make_request_record(self.runner.iter, entry["id"],
+                                  entry.get("tenant", "default"),
+                                  event, **kw)
+        _append_jsonl(os.path.join(self.dir, "requests",
+                                   f"{entry['id']}.jsonl"), rec)
+        if front_door:
+            # called on the socket thread: the shared metrics sink is
+            # unlocked and the loop thread may be mid-write — queue
+            # the record for the next beat instead of interleaving
+            with self._stats_lock:
+                self._front_records.append(rec)
+            return
+        self._log_service_record(rec)
+
+    def _log_service_record(self, rec: dict):
+        if self.solver._metrics_enabled \
+                and self.solver.metrics_logger is not None:
+            self.solver.metrics_logger.log(rec)
+
+    def _flush_front_records(self):
+        """Drain front-door-queued records into the service-wide
+        metrics stream (loop thread / close only)."""
+        with self._stats_lock:
+            recs, self._front_records = self._front_records, []
+        for rec in recs:
+            self._log_service_record(rec)
+
+    # ------------------------------------------------------------------
+    # NaN-injection test hook (check_serve_contract.py)
+
+    def _maybe_inject(self):
+        """Poison the first config of any `inject_nan` request whose
+        lane has reached the requested virtual iteration (once per
+        attempt for "always", once total otherwise) — the deterministic
+        failure the CI guard drives through the retry machinery."""
+        if not self.allow_inject:
+            return
+        rep = None
+        for entry in self._requests.values():
+            spec = entry.get("inject_nan")
+            if spec is None or entry["status"] in _TERMINAL \
+                    or not entry["cfg_ids"]:
+                continue
+            if isinstance(spec, dict):
+                at_iter = int(spec.get("iter", 0))
+                always = bool(spec.get("always"))
+            else:
+                at_iter, always = int(spec), False
+            cfg = entry["cfg_ids"][0]
+            if rep is None:
+                rep = self.runner.config_report()
+            info = rep["active"].get(cfg)
+            if info is None or info["done"] < at_iter:
+                continue
+            attempt = info["attempt"]
+            seen = entry["injected_attempt"]
+            if seen and (not always or seen.get("attempt") == attempt):
+                continue
+            self._poison_lane(info["lane"])
+            entry["injected_attempt"] = {"attempt": attempt}
+            print(f"Injected NaN into request {entry['id']} config "
+                  f"{cfg} (lane {info['lane']}, attempt {attempt})",
+                  flush=True)
+
+    def _poison_lane(self, lane: int):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        key = self.solver._fault_keys[0]
+        layer, slot = key.rsplit("/", 1)
+        orig = self.runner.params[layer][int(slot)]
+        w = np.array(orig)
+        w[lane].flat[0] = np.nan
+        self.runner.params[layer][int(slot)] = jax.device_put(
+            jnp.asarray(w), orig.sharding)
+
+    # ------------------------------------------------------------------
+    # stats snapshot + state persistence + drain/resume
+
+    def _update_stats_view(self):
+        h = self.runner._healing
+        occupied = sum(1 for lane in range(self.runner.n)
+                       if h.lane_cfg[lane] >= 0
+                       and lane not in h.benign)
+        with self._stats_lock:
+            self._stats_view = {
+                "lanes": self.runner.n,
+                "occupied_lanes": occupied,
+                "pending_configs": len(h.pending),
+                "steps_per_sec": round(self._steps_per_sec, 4),
+                "projected_s": self._projected_seconds(),
+                "slo_seconds": self.slo_seconds or None,
+                "admission": self.admission,
+                "tenant_lane_iters": dict(self._tenant_lane_iters),
+                "requests": {
+                    s: sum(1 for e in self._requests.values()
+                           if e["status"] == s)
+                    for s in ("admitted", "running", "completed",
+                              "failed", "rejected", "preempted")},
+                "iter": int(self.runner.iter),
+            }
+
+    def _state_path(self) -> str:
+        return os.path.join(self.dir, "state.json")
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.dir, "checkpoint.npz")
+
+    def _write_state(self, with_checkpoint: bool = False):
+        state = {
+            "schema_version": 1,
+            "requests": self._requests,
+            "tenant_lane_iters": self._tenant_lane_iters,
+            "has_checkpoint": bool(with_checkpoint),
+            "iter": int(self.runner.iter),
+        }
+        _atomic_write(self._state_path(), state)
+
+    def _drain_exit(self) -> int:
+        """Stop admitting, checkpoint in-flight lanes + request table,
+        emit `preempted` records, report the exit code. The DRAIN
+        control file (the durable drain op) is consumed."""
+        try:
+            os.remove(os.path.join(self.dir, "DRAIN"))
+        except OSError:
+            pass
+        in_flight = self._active_ids()
+        if not in_flight and self.runner.healing_complete():
+            try:
+                os.remove(self._ckpt_path())
+            except OSError:
+                pass
+            self._write_state()
+            print("Sweep service drained idle (no in-flight "
+                  "requests); exit 0", flush=True)
+            return 0
+        self.runner.checkpoint(self._ckpt_path())
+        for rid in in_flight:
+            # visible in stats()/state.json; _resume recomputes
+            # admitted/running from start_time when the lanes restore
+            self._requests[rid]["status"] = "preempted"
+        self._write_state(with_checkpoint=True)
+        for rid in in_flight:
+            entry = self._requests[rid]
+            self._emit_request(entry, "preempted",
+                              configs=entry["configs_total"],
+                              done=entry.get("done", 0))
+        print(f"Sweep service drained with {len(in_flight)} in-flight "
+              f"request(s) checkpointed; exit {DRAIN_EXIT} — restart "
+              "with the same --service-dir to resume", flush=True)
+        return DRAIN_EXIT
+
+    def _resume(self):
+        """Restart path: restore the lane pool from the drain
+        checkpoint + request table. Requests whose configs the
+        checkpoint does not know (admitted after the last checkpoint —
+        only possible after a crash, not a graceful drain) are
+        re-admitted fresh: at-least-once completion, with the
+        re-execution being a legitimate fresh Monte-Carlo attempt."""
+        with open(self._state_path()) as f:
+            state = json.load(f)
+        self._tenant_lane_iters = {
+            str(k): int(v)
+            for k, v in state.get("tenant_lane_iters", {}).items()}
+        table = state.get("requests", {})
+        restored = False
+        if state.get("has_checkpoint") \
+                and os.path.exists(self._ckpt_path()):
+            self.runner.restore(self._ckpt_path())
+            restored = True
+        known = set()
+        if restored:
+            rep = self.runner.config_report()
+            known = (set(rep["completed"]) | set(rep["failed"])
+                     | set(rep["active"])
+                     | {int(e["config"]) for e in rep["pending"]})
+        for rid, entry in table.items():
+            entry = dict(entry)
+            entry.setdefault("injected_attempt", {})
+            if entry["status"] in _TERMINAL:
+                self._requests[rid] = entry
+                continue
+            if restored and all(int(c) in known
+                                for c in entry["cfg_ids"]):
+                entry["status"] = ("admitted"
+                                   if entry.get("start_time") is None
+                                   else "running")
+                self._requests[rid] = entry
+                for cfg in entry["cfg_ids"]:
+                    self._cfg_req[int(cfg)] = rid
+                self._emit_request(entry, "resumed",
+                                  configs=entry["configs_total"],
+                                  done=entry.get("done", 0))
+                continue
+            # unknown to the restored lanes: re-admit the whole
+            # request fresh from its active spool file
+            req = self.spool.read(rid)
+            if req is None:
+                continue
+            if req.get("state") == "done":
+                # crash landed between spool.finish and the beat's
+                # state write: the spool (source of truth) already has
+                # the terminal payload — adopt it, don't re-run
+                entry.update(status=req.get("status", "completed"),
+                             results=req.get("results",
+                                             entry.get("results", {})))
+                entry["done"] = len(entry.get("results") or {})
+                self._requests[rid] = entry
+                continue
+            self._readmit(rid, req, entry,
+                          "re-admitted (no checkpoint covered these "
+                          "configs)")
+        # reconcile spool active/ against the table: a request CLAIMED
+        # in a beat that crashed before its state write has an active/
+        # file and no table entry — without this scan it would never
+        # get lanes and never terminate (the at-least-once contract)
+        for req in self.spool.active():
+            rid = req.get("id")
+            if not rid or rid in self._requests:
+                continue
+            entry = {
+                "id": rid,
+                "tenant": str(req.get("tenant") or "default"),
+                "iters": req.get("iters", self.default_iters),
+                "iters_granted": req.get("iters_granted"),
+                "configs_total": len(req.get("configs") or []),
+                "submit_time": float(req.get("submit_time")
+                                     or time.time()),
+                "admit_time": time.time(),
+                "inject_nan": req.get("inject_nan"),
+                "injected_attempt": {},
+            }
+            self._readmit(rid, req, entry,
+                          "re-admitted (claimed before the crashed "
+                          "service recorded it)")
+        n = len([r for r in self._requests.values()
+                 if r["status"] not in _TERMINAL])
+        print(f"Sweep service resumed at iteration "
+              f"{self.runner.iter}: {n} in-flight request(s)",
+              flush=True)
+
+    def _readmit(self, rid: str, req: dict, entry: dict,
+                 reason: str):
+        """Allocate fresh lanes for a request whose previous configs
+        no checkpoint covers (at-least-once completion: the re-run is
+        a legitimate fresh Monte-Carlo attempt)."""
+        granted = int(entry.get("iters_granted")
+                      or -(-int(req.get("iters", self.default_iters))
+                           // self.chunk) * self.chunk)
+        ids = self.runner.submit_configs(req["configs"],
+                                         budget=granted)
+        entry.update(cfg_ids=ids, iters_granted=granted,
+                     status="admitted", done=0, results={},
+                     start_time=None)
+        with self._stats_lock:
+            self._requests[rid] = entry
+            for cfg in ids:
+                self._cfg_req[cfg] = rid
+        self.spool.update(rid, "active", {"cfg_ids": ids,
+                                          "iters_granted": granted})
+        self._emit_request(entry, "resumed",
+                          configs=entry["configs_total"], done=0,
+                          reason=reason)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._sock_server is not None:
+            self._sock_server.stop()
+        # the socket thread is down: any still-queued front-door
+        # records can flush without an interleaving writer
+        self._flush_front_records()
+        logger = self.solver.metrics_logger
+        self.runner.close()
+        if logger is not None:
+            logger.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+class _SocketServer(threading.Thread):
+    """Local Unix-socket front door: one JSON object per line in, one
+    per line out. Ops: ping, submit {request}, status {id},
+    result {id}, stats, drain. Runs on its own thread and touches only
+    the spool + lock-protected snapshots — never the runner."""
+
+    def __init__(self, service: SweepService, path: str):
+        super().__init__(daemon=True, name="serve-frontdoor")
+        self.service = service
+        self.path = path
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        self._sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                       socket_mod.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.25)
+        self._stopping = threading.Event()
+
+    def run(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket_mod.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                self._handle(conn)
+            except Exception:
+                pass
+            finally:
+                conn.close()
+        self._sock.close()
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+    def _handle(self, conn):
+        conn.settimeout(5.0)
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+            if len(buf) > 4 << 20:
+                raise ValueError("request line too large")
+        line = buf.split(b"\n", 1)[0]
+        try:
+            msg = json.loads(line.decode())
+            resp = self._dispatch(msg)
+        except Exception as e:
+            resp = {"ok": False, "error": str(e)}
+        conn.sendall((json.dumps(resp) + "\n").encode())
+
+    def _dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        svc = self.service
+        if op == "ping":
+            return {"ok": True, "pong": True, "dir": svc.dir}
+        if op == "submit":
+            out = svc.submit(msg.get("request") or {})
+            return {"ok": True, **out}
+        if op in ("status", "result"):
+            rid = msg.get("id", "")
+            req = svc.status(rid)
+            if req is None:
+                return {"ok": False,
+                        "error": f"unknown request id {rid!r}"}
+            return {"ok": True, "request": req}
+        if op == "stats":
+            return {"ok": True, "stats": svc.stats()}
+        if op == "drain":
+            svc.drain()
+            return {"ok": True, "draining": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def stop(self):
+        self._stopping.set()
+        self.join(timeout=2.0)
+
+
+def main(argv=None) -> int:
+    """`python -m rram_caffe_simulation_tpu.serve` / `caffe serve` —
+    run a sweep service until drained (SIGTERM, the client `drain` op,
+    or `--drain-when-idle`)."""
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="rram-sweep-serve",
+        description="resident fault-sweep service (see serve/service.py)")
+    p.add_argument("--solver", required=True,
+                   help="solver prototxt with a pinned random_seed, a "
+                        "gaussian failure_pattern, and a "
+                        "materializable Data layer")
+    p.add_argument("--service-dir", required=True,
+                   help="durable service root: spool/, requests/, "
+                        "metrics.jsonl, checkpoint + state on drain")
+    p.add_argument("--lanes", type=int, default=8,
+                   help="vectorized config lanes held warm (the "
+                        "continuous-batching pool width)")
+    p.add_argument("--chunk", type=int, default=8,
+                   help="scanned iterations per dispatch = the "
+                        "scheduling quantum (budgets round up to it)")
+    p.add_argument("--default-iters", type=int, default=100,
+                   help="iteration budget for requests that do not "
+                        "carry their own 'iters'")
+    p.add_argument("--max-retries", type=int, default=1)
+    p.add_argument("--retry-backoff", type=int, default=0)
+    p.add_argument("--slo-seconds", type=float, default=0.0,
+                   help="SLO window for the admission controller; 0 "
+                        "disables the projection check")
+    p.add_argument("--admission", default="queue",
+                   choices=["queue", "reject"],
+                   help="what to do when the projected backlog "
+                        "turnaround exceeds --slo-seconds")
+    p.add_argument("--tenant-weight", action="append", default=[],
+                   metavar="TENANT=W",
+                   help="weighted-fair share for a tenant (repeatable;"
+                        " default weight 1)")
+    p.add_argument("--poll-interval", type=float, default=0.5)
+    p.add_argument("--pipeline-depth", type=int, default=0)
+    p.add_argument("--no-socket", action="store_true",
+                   help="disable the Unix-socket front door (spool "
+                        "submissions only)")
+    p.add_argument("--drain-when-idle", action="store_true",
+                   help="exit 0 once the spool is empty and every "
+                        "request is terminal (batch/CI mode) instead "
+                        "of waiting for more work")
+    p.add_argument("--max-beats", type=int, default=0,
+                   help="stop after N scheduling beats (test hook); "
+                        "0 = unlimited")
+    p.add_argument("--allow-inject", action="store_true",
+                   help="TEST HOOK (check_serve_contract.py): honor "
+                        "requests' inject_nan poisoning field")
+    p.add_argument("--save-fault-results", action="store_true",
+                   help="write each completed config's fault-state "
+                        "rows to requests/<id>.cfg<N>.faults.npz "
+                        "(the byte-identity evidence the CI guard "
+                        "compares)")
+    args = p.parse_args(argv)
+
+    weights = {}
+    for spec in args.tenant_weight:
+        if "=" not in spec:
+            p.error(f"--tenant-weight {spec!r} must be TENANT=WEIGHT")
+        name, w = spec.rsplit("=", 1)
+        weights[name] = float(w)
+
+    service = SweepService(
+        args.solver, args.service_dir, lanes=args.lanes,
+        chunk=args.chunk, default_iters=args.default_iters,
+        max_retries=args.max_retries, retry_backoff=args.retry_backoff,
+        tenant_weights=weights, slo_seconds=args.slo_seconds,
+        admission=args.admission, poll_interval_s=args.poll_interval,
+        pipeline_depth=args.pipeline_depth,
+        socket_path=None if args.no_socket else "",
+        allow_inject=args.allow_inject,
+        save_fault_results=args.save_fault_results)
+
+    def _on_signal(signum, frame):
+        service.drain()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"Sweep service up: {service.runner.n} lanes, chunk "
+          f"{service.chunk}, spool {service.spool.root}", flush=True)
+    try:
+        code = service.serve(max_beats=args.max_beats or None,
+                             drain_when_idle=args.drain_when_idle)
+    finally:
+        service.close()
+    sys.stdout.flush()
+    return code
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,222 @@
+"""Durable filesystem spool — the sweep service's request queue.
+
+A request is one JSON file; its lifecycle is a rename walk through the
+state directories under ``<root>/spool``::
+
+    pending/   submitted, not yet picked up by the service
+    active/    admitted into the live lane work queue
+    done/      terminal (completed / failed / rejected) — the file now
+               carries the result payload too
+
+Every write is temp-file + atomic-rename (a crash can never leave a
+half-written request under a live name) and fsynced (the spool must
+survive the SIGKILL that follows a preemption SIGTERM — same contract
+as the sweep journal). Pending requests are processed in sorted
+filename order; auto-generated ids are zero-padded nanosecond
+timestamps, so "sorted" means "submission order" unless the caller
+chooses their own ordering by naming ids explicitly (the CI guard
+does, for determinism).
+
+The spool is intentionally dependency-free (no jax) so clients — the
+`serve_client` library, shell scripts, another host sharing a
+filesystem — can submit without importing the framework.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+#: request lifecycle states == spool subdirectory names
+STATES = ("pending", "active", "done")
+
+_ID_OK = set("abcdefghijklmnopqrstuvwxyz"
+             "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.")
+
+
+def make_request_id() -> str:
+    """A sortable request id: zero-padded wall-clock nanoseconds (so
+    lexicographic order == submission order) plus entropy against
+    same-nanosecond collisions."""
+    return f"r-{time.time_ns():020d}-{os.urandom(3).hex()}"
+
+
+def normalize_request(req: dict, default_iters: int = 0) -> dict:
+    """Validate + fill a request dict in place of a schema: `configs`
+    must be a non-empty list of {mean?, std?} spec objects, `iters` a
+    positive int (falls back to `default_iters`), `tenant` a short
+    name, `id` spool-filename-safe. Returns a normalized copy; raises
+    ValueError on junk — the front door refuses it before it ever
+    reaches the spool."""
+    if not isinstance(req, dict):
+        raise ValueError("request must be a JSON object")
+    out = dict(req)
+    rid = out.setdefault("id", make_request_id())
+    if not isinstance(rid, str) or not rid or len(rid) > 120 \
+            or not set(rid) <= _ID_OK:
+        raise ValueError(
+            f"request id {rid!r} must be a non-empty string of "
+            "[A-Za-z0-9._-], at most 120 chars (it becomes a spool "
+            "filename)")
+    tenant = out.setdefault("tenant", "default")
+    if not isinstance(tenant, str) or not tenant or len(tenant) > 64:
+        raise ValueError(f"tenant {tenant!r} must be a non-empty "
+                         "string of at most 64 chars")
+    configs = out.get("configs")
+    if not isinstance(configs, list) or not configs:
+        raise ValueError("request needs a non-empty 'configs' list of "
+                         "{mean, std} spec objects")
+    specs = []
+    for i, spec in enumerate(configs):
+        if not isinstance(spec, dict):
+            raise ValueError(f"configs[{i}] is not an object")
+        clean = {}
+        for key in ("mean", "std"):
+            if key in spec:
+                try:
+                    clean[key] = float(spec[key])
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"configs[{i}].{key} is not a number: "
+                        f"{spec[key]!r}") from None
+        specs.append(clean)
+    out["configs"] = specs
+    iters = out.get("iters") or default_iters
+    if not iters:
+        # no explicit budget and no default known HERE (e.g. the
+        # client's durable spool fallback, which cannot see the
+        # service's --default-iters): defer — the service re-validates
+        # with its own default at pickup
+        out.pop("iters", None)
+    else:
+        if not isinstance(iters, int) or isinstance(iters, bool) \
+                or iters <= 0:
+            raise ValueError(
+                f"request iters must be a positive int, got "
+                f"{out.get('iters')!r} (and the service has default "
+                f"{default_iters})")
+        out["iters"] = iters
+    out.setdefault("submit_time", time.time())
+    return out
+
+
+def _atomic_write(path: str, payload: dict):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class Spool:
+    """The service-side view of the request queue (see module
+    docstring). All mutation is rename-based and single-consumer: only
+    the service moves files out of pending/."""
+
+    def __init__(self, root: str):
+        self.root = root
+        for state in STATES:
+            os.makedirs(os.path.join(root, state), exist_ok=True)
+
+    def _dir(self, state: str) -> str:
+        return os.path.join(self.root, state)
+
+    def _path(self, state: str, request_id: str) -> str:
+        return os.path.join(self._dir(state), f"{request_id}.json")
+
+    def submit(self, request: dict, default_iters: int = 0) -> str:
+        """Validate + atomically spool a request into pending/.
+        Returns the request id. Duplicate ids are refused (a resubmit
+        must pick a new id — the old one's lifecycle is already on
+        disk)."""
+        req = normalize_request(request, default_iters)
+        rid = req["id"]
+        if self.state_of(rid) is not None:
+            raise ValueError(f"request id {rid!r} already exists in "
+                             "the spool")
+        _atomic_write(self._path("pending", rid), req)
+        return rid
+
+    def pending_ids(self) -> List[str]:
+        """Pending request ids in processing (filename) order."""
+        names = sorted(n for n in os.listdir(self._dir("pending"))
+                       if n.endswith(".json"))
+        return [n[:-len(".json")] for n in names]
+
+    def state_of(self, request_id: str) -> Optional[str]:
+        for state in STATES:
+            if os.path.exists(self._path(state, request_id)):
+                return state
+        return None
+
+    def read(self, request_id: str) -> Optional[dict]:
+        """The request's current payload, from whichever state dir it
+        lives in (None when unknown)."""
+        for state in STATES:
+            path = self._path(state, request_id)
+            try:
+                with open(path) as f:
+                    return dict(json.load(f), state=state)
+            except FileNotFoundError:
+                continue
+        return None
+
+    def claim(self, request_id: str, updates: Optional[dict] = None
+              ) -> dict:
+        """pending -> active (admission). Returns the payload, with
+        `updates` merged + persisted (e.g. the allocated config
+        ids)."""
+        return self._advance(request_id, "pending", "active", updates)
+
+    def finish(self, request_id: str, updates: Optional[dict] = None,
+               src: str = "active") -> dict:
+        """active (or pending, for rejections) -> done, merging the
+        terminal result payload into the file."""
+        return self._advance(request_id, src, "done", updates)
+
+    def _advance(self, request_id: str, src: str, dst: str,
+                 updates: Optional[dict]) -> dict:
+        path = self._path(src, request_id)
+        with open(path) as f:
+            req = json.load(f)
+        if updates:
+            req.update(updates)
+        _atomic_write(self._path(dst, request_id), req)
+        os.remove(path)
+        return req
+
+    def update(self, request_id: str, state: str, updates: dict
+               ) -> dict:
+        """Merge fields into a request file in place (no state move)."""
+        path = self._path(state, request_id)
+        with open(path) as f:
+            req = json.load(f)
+        req.update(updates)
+        _atomic_write(path, req)
+        return req
+
+    def quarantine(self, request_id: str, reason: str) -> dict:
+        """pending -> done for a file whose CONTENT cannot be parsed:
+        the done/ payload is written fresh (the original bytes are
+        junk) so the resident service never crashes — or spins — on a
+        corrupt submission."""
+        payload = {"id": request_id, "status": "rejected",
+                   "reason": reason, "submit_time": time.time()}
+        _atomic_write(self._path("done", request_id), payload)
+        try:
+            os.remove(self._path("pending", request_id))
+        except FileNotFoundError:
+            pass
+        return payload
+
+    def active(self) -> List[dict]:
+        """Every active request payload, in filename order."""
+        out = []
+        for name in sorted(os.listdir(self._dir("active"))):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(self._dir("active"), name)) as f:
+                out.append(json.load(f))
+        return out

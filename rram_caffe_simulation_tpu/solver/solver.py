@@ -99,7 +99,8 @@ class Solver:
     step(n), solve(), test_all(), snapshot(), restore(path)."""
 
     def __init__(self, param, train_feed: Optional[Callable] = None,
-                 test_feeds=None, compute_dtype=None):
+                 test_feeds=None, compute_dtype=None,
+                 fail_decrement: Optional[float] = None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
         # cold-start layer: when RRAM_TPU_CACHE_DIR is set, every jitted
@@ -174,8 +175,18 @@ class Solver:
         # --- RRAM fault engine + strategies (InitFailurePattern,
         # solver.cpp:15-41,134-148) ---
         self.fault_state = None
-        self.fail_decrement = 100.0  # reference hard-codes batch size 100
-        # (failure_maker.cpp:75 FIXME); override via attribute for other nets
+        # Per-iteration lifetime decrement = the training batch size in
+        # the reference semantics, but failure_maker.cpp:75 HARD-CODES
+        # 100 with a FIXME ("batch size is fixed to 100"). The
+        # constructor parameter resolves that FIXME; the default stays
+        # the reference value so existing runs are bit-identical.
+        if fail_decrement is None:
+            fail_decrement = 100.0
+        if not (float(fail_decrement) > 0):
+            raise ValueError(f"fail_decrement must be > 0, got "
+                             f"{fail_decrement!r} (the reference "
+                             "default is 100: failure_maker.cpp:75)")
+        self.fail_decrement = float(fail_decrement)
         self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
                             for r in self.net.failure_param_refs]
         if (param.HasField("failure_pattern")

@@ -560,6 +560,18 @@ def net_speed_benchmark(args):
     return time(args)
 
 
+@register
+def serve(args):
+    """Run the resident sweep service (serve/service.py): `caffe serve
+    -- --solver S --service-dir DIR ...` — everything after the command
+    goes to the service's own parser (USAGE.md "Sweep service")."""
+    from ..serve.service import main as serve_main
+    extra = []
+    if args.solver:
+        extra += ["--solver", args.solver]
+    return serve_main(extra + list(args.args))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="caffe", description="command line brew",
@@ -667,7 +679,8 @@ def main(argv=None):
                         or args.command == "extract_features"
                         or args.command in ("train_net", "finetune_net",
                                             "test_net",
-                                            "net_speed_benchmark"))
+                                            "net_speed_benchmark",
+                                            "serve"))
     if args.args and not takes_positional:
         p.error(f"unrecognized arguments: {' '.join(args.args)}")
     return BREW[args.command](args)

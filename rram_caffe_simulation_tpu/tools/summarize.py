@@ -123,11 +123,52 @@ def _fmt_num(v):
     return str(v)
 
 
+def _request_digest(requests):
+    """Digest of sweep-service `request` lifecycle records: per-event
+    counts, per-tenant turnaround, and the completion-latency spread
+    (the SLO-facing number)."""
+    by_event = {}
+    for r in requests:
+        by_event.setdefault(r.get("event", "?"), []).append(r)
+    parts = [f"{len(v)} {k}" for k, v in sorted(by_event.items())]
+    lines = [f"Service requests ({len(requests)} records): "
+             + ", ".join(parts)]
+    terminal = (by_event.get("completed", [])
+                + by_event.get("failed", []))
+    lat = sorted(r["latency_s"] for r in terminal
+                 if isinstance(r.get("latency_s"), (int, float)))
+    if lat:
+        mid = lat[len(lat) // 2]
+        lines.append(
+            f"Completion latency ({len(lat)} requests): "
+            f"min {lat[0]:g} s, p50 {mid:g} s, max {lat[-1]:g} s, "
+            f"mean {float(np.mean(lat)):g} s")
+    by_tenant = {}
+    for r in terminal:
+        by_tenant.setdefault(r.get("tenant", "?"), []).append(r)
+    for tenant in sorted(by_tenant):
+        rs = by_tenant[tenant]
+        n_fail = sum(1 for r in rs if r.get("event") == "failed")
+        tail = f", {n_fail} failed" if n_fail else ""
+        tlat = [r["latency_s"] for r in rs
+                if isinstance(r.get("latency_s"), (int, float))]
+        if tlat:
+            tail += f", mean latency {float(np.mean(tlat)):g} s"
+        lines.append(f"  tenant {tenant}: {len(rs)} request(s)"
+                     f"{tail}")
+    for r in by_event.get("failed", []):
+        if r.get("reason"):
+            lines.append(f"  request {r.get('request')} failed: "
+                         f"{r['reason']}")
+    return lines
+
+
 def summarize_metrics(path):
     """One-screen digest of a JSONL metrics log (schema: observe/schema.py
     / USAGE.md Observability)."""
     recs = []
     retries = []
+    requests = []
     n_typed = 0
     with open(path) as f:
         for line in f:
@@ -138,12 +179,20 @@ def summarize_metrics(path):
             if rec.get("type") == "retry":
                 retries.append(rec)
                 continue
+            if rec.get("type") == "request":
+                requests.append(rec)
+                continue
             if rec.get("type") is not None:
                 # debug_trace / sentinel records ride the same sink;
                 # the digest summarizes the display-interval metrics
                 n_typed += 1
                 continue
             recs.append(rec)
+    if not recs and requests:
+        # a per-request stream (sweep service) carries lifecycle
+        # records only — digest those without demanding metrics
+        return "\n".join([f"Metrics log: {path}"]
+                         + _request_digest(requests))
     if not recs:
         return f"{path}: no records"
     first, last = recs[0], recs[-1]
@@ -188,6 +237,8 @@ def summarize_metrics(path):
             diag = r.get("diagnosis") or "no diagnosis"
             lines.append(f"  config {r.get('config')} failed after "
                          f"{r.get('attempt')} attempt(s): {diag}")
+    if requests:
+        lines += _request_digest(requests)
     lmap = last.get("lane_map")
     if isinstance(lmap, list):
         # keep the one-screen contract: a 500-lane sweep's full map

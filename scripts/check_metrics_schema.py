@@ -96,6 +96,22 @@ SAMPLE_BAD_RETRY = {
     "event": "sideways", "recovery": "prayer",    # unknown enum values
 }
 
+# sweep-as-a-service request lifecycle events (schema.py
+# REQUEST_FIELDS): one per transition, emitted into the service-wide
+# metrics stream and the request's own requests/<id>.jsonl stream
+SAMPLE_GOOD_REQUEST = {
+    "schema_version": 1, "type": "request", "iter": 120,
+    "wall_time": 1722700000.0, "request": "r-0007", "tenant": "alice",
+    "event": "completed", "configs": 4, "done": 4, "latency_s": 93.2,
+}
+
+SAMPLE_BAD_REQUEST = {
+    "schema_version": 1, "type": "request", "iter": 120,
+    "wall_time": 1722700000.0, "request": "", "tenant": "alice",
+    "event": "vanished", "configs": 0,            # unknown event,
+    "status": "shrugged", "latency_s": -1.0,      # empty id, bad enums
+}
+
 # the restore-fallback announcement (Solver.restore with a snapshot
 # that predates fault-state capture — schema.py FAULT_REDRAW_FIELDS)
 SAMPLE_GOOD_FAULT_REDRAW = {
@@ -220,6 +236,7 @@ def main(argv=None) -> int:
                           ("quarantine", SAMPLE_GOOD_QUARANTINE),
                           ("lane_map", SAMPLE_GOOD_LANE_MAP),
                           ("retry", SAMPLE_GOOD_RETRY),
+                          ("request", SAMPLE_GOOD_REQUEST),
                           ("fault_redraw", SAMPLE_GOOD_FAULT_REDRAW),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
@@ -234,6 +251,7 @@ def main(argv=None) -> int:
                           ("quarantine", SAMPLE_BAD_QUARANTINE),
                           ("lane_map", SAMPLE_BAD_LANE_MAP),
                           ("retry", SAMPLE_BAD_RETRY),
+                          ("request", SAMPLE_BAD_REQUEST),
                           ("fault_redraw", SAMPLE_BAD_FAULT_REDRAW),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
@@ -244,7 +262,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (8 good records accepted, 8 bad "
+        print("sample self-check OK (9 good records accepted, 9 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

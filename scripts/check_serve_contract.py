@@ -1,0 +1,354 @@
+#!/usr/bin/env python
+"""CI guard for the sweep service (serve/): the completion contract,
+the reproducibility contract, drain durability, and the utilization
+SLO, all against one tiny generated LMDB.
+
+1. **Direct reference**: the same config specs run through a plain
+   `SweepRunner` (`enable_self_healing(start_empty=True,
+   virtual_time=True)` + `submit_configs`) — the ground truth the
+   service must reproduce byte-for-byte.
+2. **Service run**: an in-process `SweepService` takes a heterogeneous
+   two-tenant-plus request mix (different config counts, different
+   iteration budgets) and one `inject_nan`-poisoned request. Every
+   request must reach a terminal state — the poisoned one `failed`
+   WITH a triage diagnosis — and every healthy config's final loss and
+   fault-state rows must be byte-identical to the direct run.
+3. **Drain + restart**: the same mix again, but the service takes a
+   real mid-run SIGTERM, drains with exit 75 (checkpoint + request
+   table), and a NEW service process object on the same directory
+   resumes. Nothing may be lost, and every result must still be
+   byte-identical to run 2 (virtual time makes resumed trajectories
+   independent of the interruption).
+4. **Utilization**: with the saturating mix, mean steady-state lane
+   occupancy (from the existing `lane_map` metric records, while
+   enough work remains to fill the pool) must be >= 90%.
+
+    python scripts/check_serve_contract.py
+
+Exit status: 0 = every contract holds, 1 = any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LANES = 4
+CHUNK = 10
+MIN_OCCUPANCY = 0.90
+
+#: the heterogeneous request mix: (id, tenant, [(mean, std), ...],
+#: iters, inject). Ids sort in submission order (the spool processes
+#: pending/ in filename order) so config-id allocation is
+#: deterministic and the direct reference can replay it.
+REQUESTS = [
+    ("a-alice", "alice",
+     [(500, 100), (480, 100), (460, 100), (440, 100)], 40, None),
+    ("b-bob", "bob", [(520, 90), (450, 90), (430, 90)], 20, None),
+    ("c-carol", "carol", [(470, 85), (510, 85)], 40, None),
+    ("d-mallory", "mallory", [(490, 95)], 40,
+     {"iter": 15, "always": True}),
+]
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _write_solver(path: str, db: str):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+failure_pattern {{ type: "gaussian" mean: 500 std: 100 }}
+net_param {{
+  name: "serveguard"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _direct_reference(solver_path: str):
+    """Ground truth: the same specs through a plain SweepRunner in the
+    service's execution mode (empty start, live submission, per-lane
+    virtual time) — the budgets already service-rounded (all iters in
+    REQUESTS are CHUNK multiples)."""
+    import numpy as np
+    from rram_caffe_simulation_tpu.fault import engine as fault_engine
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    solver = Solver(solver_path)
+    runner = SweepRunner(solver, n_configs=LANES, pipeline_depth=0)
+    runner.enable_self_healing(budget=CHUNK, max_retries=1,
+                               start_empty=True, virtual_time=True)
+    rows_by_cfg = {}
+
+    def capture(cfg, lane, result):
+        rows_by_cfg[int(cfg)] = {
+            name: np.asarray(v[lane]).copy()
+            for name, v in fault_engine.iter_state_leaves(
+                runner.fault_states)}
+
+    runner.on_lane_complete = capture
+    cfg_of = {}
+    for rid, _tenant, specs, iters, _inject in REQUESTS:
+        ids = runner.submit_configs(
+            [{"mean": m, "std": s} for m, s in specs], budget=iters)
+        cfg_of[rid] = ids
+    while not runner.healing_complete():
+        runner.step(CHUNK, chunk=CHUNK)
+    rep = runner.config_report()
+    runner.close()
+    return cfg_of, rep["completed"], rows_by_cfg
+
+
+def _submit_all(service):
+    for rid, tenant, specs, iters, inject in REQUESTS:
+        req = {"id": rid, "tenant": tenant, "iters": iters,
+               "configs": [{"mean": m, "std": s} for m, s in specs]}
+        if inject is not None:
+            req["inject_nan"] = inject
+        service.submit(req)
+
+
+def _service_results(service_dir: str):
+    """(request payloads from the done/ spool, fault-npz bytes per
+    healthy config)."""
+    from rram_caffe_simulation_tpu.serve import Spool
+    spool = Spool(os.path.join(service_dir, "spool"))
+    out = {}
+    for rid, _tenant, _specs, _iters, _inject in REQUESTS:
+        out[rid] = spool.read(rid)
+    return out
+
+
+def _npz_rows(service_dir: str, fname: str):
+    import numpy as np
+    with np.load(os.path.join(service_dir, "requests", fname)) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+def _check_results(tag, results, cfg_of, direct_done, direct_rows,
+                   service_dir):
+    """Every request terminal; poisoned one failed with a diagnosis;
+    healthy configs byte-identical to the direct reference."""
+    import numpy as np
+    for rid, _tenant, specs, _iters, inject in REQUESTS:
+        req = results.get(rid)
+        if req is None or req.get("state") != "done":
+            return _fail(f"{tag}: request {rid} not terminal "
+                         f"(spool state {req and req.get('state')})")
+        status = req.get("status")
+        if inject is not None:
+            if status != "failed":
+                return _fail(f"{tag}: poisoned request {rid} ended "
+                             f"{status!r}, expected failed")
+            if not req.get("reason"):
+                return _fail(f"{tag}: poisoned request {rid} failed "
+                             "without a diagnosis")
+            continue
+        if status != "completed":
+            return _fail(f"{tag}: request {rid} ended {status!r} "
+                         f"(reason {req.get('reason')!r})")
+        if len(req.get("results", {})) != len(specs):
+            return _fail(f"{tag}: request {rid} has "
+                         f"{len(req.get('results', {}))} results for "
+                         f"{len(specs)} configs")
+        for i, cfg in enumerate(cfg_of[rid]):
+            v = req["results"].get(str(cfg))
+            if v is None:
+                return _fail(f"{tag}: request {rid} missing result "
+                             f"for config {cfg}")
+            ref = direct_done.get(cfg)
+            if ref is None:
+                return _fail(f"{tag}: direct reference never "
+                             f"completed config {cfg}")
+            if not (np.float64(v["loss"]).tobytes()
+                    == np.float64(ref["loss"]).tobytes()):
+                return _fail(
+                    f"{tag}: config {cfg} loss {v['loss']!r} != "
+                    f"direct {ref['loss']!r} (byte-identity broken)")
+            rows = _npz_rows(service_dir, v["fault_npz"])
+            for name, arr in direct_rows[cfg].items():
+                if name not in rows or rows[name].tobytes() \
+                        != arr.tobytes():
+                    return _fail(
+                        f"{tag}: config {cfg} fault rows {name!r} "
+                        "differ from the direct reference")
+    print(f"OK: {tag}: all {len(REQUESTS)} requests terminal, "
+          "poisoned request failed-with-diagnosis, healthy configs "
+          "byte-identical to the direct SweepRunner run")
+    return 0
+
+
+def _check_occupancy(service_dir: str) -> int:
+    """Steady-state occupancy from the existing lane_map records:
+    while remaining work could still fill the pool, idle lanes must
+    average < 10%."""
+    total_cfgs = sum(len(specs) for _, _, specs, _, _ in REQUESTS)
+    chunk_recs, done_iters = [], []
+    with open(os.path.join(service_dir, "metrics.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("type") == "request" \
+                    and rec.get("event") == "config_done":
+                done_iters.append(rec["iter"])
+            elif rec.get("type") is None \
+                    and isinstance(rec.get("lane_map"), list):
+                chunk_recs.append(rec)
+    occ = []
+    for rec in chunk_recs:
+        done = sum(1 for it in done_iters if it <= rec["iter"])
+        if total_cfgs - done < LANES:
+            continue   # the tail cannot saturate the pool
+        lm = rec["lane_map"]
+        occ.append(sum(1 for c in lm if c >= 0) / len(lm))
+    if not occ:
+        return _fail("occupancy: no steady-state lane_map records")
+    mean = sum(occ) / len(occ)
+    if mean < MIN_OCCUPANCY:
+        return _fail(f"occupancy: steady-state mean {mean:.3f} < "
+                     f"{MIN_OCCUPANCY} over {len(occ)} records "
+                     f"(min {min(occ):.3f})")
+    print(f"OK: occupancy: steady-state mean {mean:.1%} over "
+          f"{len(occ)} lane_map records (min {min(occ):.1%}, "
+          f">= {MIN_OCCUPANCY:.0%} required)")
+    return 0
+
+
+def main() -> int:
+    from rram_caffe_simulation_tpu.serve import (DRAIN_EXIT,
+                                                 SweepService)
+
+    tmp = tempfile.mkdtemp(prefix="serve_contract_")
+    db = os.path.join(tmp, "db")
+    solver = os.path.join(tmp, "solver.prototxt")
+    _build_db(db)
+    _write_solver(solver, db)
+
+    print("=== direct SweepRunner reference ===", flush=True)
+    cfg_of, direct_done, direct_rows = _direct_reference(solver)
+    if len(direct_done) != sum(len(s) for _, _, s, _, _ in REQUESTS):
+        return _fail("direct reference did not complete every config")
+
+    print("=== service run (uninterrupted) ===", flush=True)
+    dir1 = os.path.join(tmp, "svc1")
+    with SweepService(solver, dir1, lanes=LANES, chunk=CHUNK,
+                      default_iters=CHUNK, max_retries=1,
+                      socket_path=None, allow_inject=True,
+                      save_fault_results=True) as svc:
+        _submit_all(svc)
+        code = svc.serve(drain_when_idle=True)
+    if code != 0:
+        return _fail(f"uninterrupted service exited {code}, not 0")
+    # config-id allocation must match the direct replay
+    r1 = _service_results(dir1)
+    for rid, _t, _s, _i, inject in REQUESTS:
+        if inject is None and r1[rid].get("cfg_ids") != cfg_of[rid]:
+            return _fail(f"service allocated config ids "
+                         f"{r1[rid].get('cfg_ids')} for {rid}, direct "
+                         f"reference used {cfg_of[rid]}")
+    rc = _check_results("service", r1, cfg_of, direct_done,
+                        direct_rows, dir1)
+    if rc:
+        return rc
+    rc = _check_occupancy(dir1)
+    if rc:
+        return rc
+
+    print("=== service run (SIGTERM drain + restart) ===", flush=True)
+    dir2 = os.path.join(tmp, "svc2")
+    svc = SweepService(solver, dir2, lanes=LANES, chunk=CHUNK,
+                       default_iters=CHUNK, max_retries=1,
+                       socket_path=None, allow_inject=True,
+                       save_fault_results=True)
+    _submit_all(svc)
+    code = svc.serve(max_beats=3)
+    if code != 0:
+        svc.close()
+        return _fail(f"max_beats leg exited {code}")
+    in_flight = [rid for rid, e in svc._requests.items()
+                 if e["status"] not in ("completed", "failed",
+                                        "rejected")]
+    if not in_flight:
+        svc.close()
+        return _fail("nothing in flight after 3 beats — the drain leg "
+                     "would not test anything (shrink max_beats)")
+    old = signal.signal(signal.SIGTERM, lambda *_: svc.drain())
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        code = svc.serve()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        svc.close()
+    if code != DRAIN_EXIT:
+        return _fail(f"SIGTERM drain exited {code}, expected "
+                     f"{DRAIN_EXIT} with in-flight work")
+    print(f"drained with {len(in_flight)} request(s) in flight; "
+          "restarting", flush=True)
+    with SweepService(solver, dir2, lanes=LANES, chunk=CHUNK,
+                      default_iters=CHUNK, max_retries=1,
+                      socket_path=None, allow_inject=True,
+                      save_fault_results=True) as svc2:
+        code = svc2.serve(drain_when_idle=True)
+    if code != 0:
+        return _fail(f"resumed service exited {code}, not 0")
+    r2 = _service_results(dir2)
+    rc = _check_results("drain+restart", r2, cfg_of, direct_done,
+                        direct_rows, dir2)
+    if rc:
+        return rc
+    for rid, _t, _s, _i, inject in REQUESTS:
+        if inject is not None:
+            continue
+        a = {c: v["loss"] for c, v in r1[rid]["results"].items()}
+        b = {c: v["loss"] for c, v in r2[rid]["results"].items()}
+        if a != b:
+            return _fail(f"drain+restart: request {rid} losses "
+                         "diverged from the uninterrupted run")
+    print("OK: SIGTERM + restart lost nothing; results identical to "
+          "the uninterrupted service run")
+    print("serve contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

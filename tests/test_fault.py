@@ -205,7 +205,8 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc2" bottom: "target"
 """
 
 
-def fault_solver(tmp_path, mean=150.0, std=10.0, **kw):
+def fault_solver(tmp_path, mean=150.0, std=10.0, fail_decrement=None,
+                 **kw):
     sp = pb.SolverParameter()
     text_format.Parse(FAULT_NET, sp.net_param)
     sp.base_lr = 0.05
@@ -223,7 +224,44 @@ def fault_solver(tmp_path, mean=150.0, std=10.0, **kw):
     rng = np.random.RandomState(3)
     data = rng.randn(8, 6).astype(np.float32)
     target = rng.randn(8, 2).astype(np.float32)
-    return Solver(sp, train_feed=lambda: {"data": data, "target": target})
+    return Solver(sp, train_feed=lambda: {"data": data, "target": target},
+                  fail_decrement=fail_decrement)
+
+
+def test_fail_decrement_default_bit_identical(tmp_path):
+    """The reference hard-codes the per-iteration lifetime decrement to
+    batch size 100 (failure_maker.cpp:75 FIXME); the
+    `Solver(fail_decrement=...)` constructor parameter resolves the
+    FIXME with the reference value as the default — which must stay
+    bit-identical to an explicit 100."""
+    a = fault_solver(tmp_path / "a")
+    assert a.fail_decrement == 100.0
+    b = fault_solver(tmp_path / "b", fail_decrement=100.0)
+    a.step(3)
+    b.step(3)
+    for xa, xb in zip(jax.tree.leaves(a.params),
+                      jax.tree.leaves(b.params)):
+        assert np.asarray(xa).tobytes() == np.asarray(xb).tobytes()
+    for xa, xb in zip(jax.tree.leaves(a.fault_state),
+                      jax.tree.leaves(b.fault_state)):
+        assert np.asarray(xa).tobytes() == np.asarray(xb).tobytes()
+    assert a.broken_fraction() == b.broken_fraction()
+
+
+def test_fail_decrement_changes_fault_timeline(tmp_path):
+    # lifetimes ~N(150, 10): decrement 100/step breaks most cells by
+    # step 2, decrement 10/step breaks none within 3 steps
+    fast = fault_solver(tmp_path / "f")
+    fast.step(3)
+    slow = fault_solver(tmp_path / "s", fail_decrement=10.0)
+    slow.step(3)
+    assert fast.broken_fraction() > 0.5
+    assert slow.broken_fraction() == 0.0
+
+
+def test_fail_decrement_validates(tmp_path):
+    with pytest.raises(ValueError, match="fail_decrement"):
+        fault_solver(tmp_path, fail_decrement=0.0)
 
 
 def test_solver_collects_fault_params(tmp_path):

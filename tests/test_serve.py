@@ -1,0 +1,494 @@
+"""Sweep-as-a-service (serve/): the durable spool lifecycle, the
+client library's socket + spool-fallback paths, the `request` record
+type end to end (schema, sinks, summarize), weighted-fair refill
+ordering, admission control, and a small in-process service run whose
+results must match a direct SweepRunner execution. The full
+byte-identity + SIGTERM-drain + occupancy contract is CI-guarded by
+scripts/check_serve_contract.py; these tests pin the in-process
+pieces."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rram_caffe_simulation_tpu.observe import (CaffeLogSink,
+                                               make_request_record,
+                                               request_line,
+                                               validate_record)
+from rram_caffe_simulation_tpu.serve import (DRAIN_EXIT, ServeClient,
+                                             Spool, SweepService,
+                                             normalize_request)
+from rram_caffe_simulation_tpu.tools.summarize import summarize_metrics
+
+LANES = 2
+CHUNK = 4
+
+
+# ---------------------------------------------------------------------------
+# spool
+
+
+def test_normalize_request_rejects_junk():
+    with pytest.raises(ValueError, match="JSON object"):
+        normalize_request([1, 2])
+    with pytest.raises(ValueError, match="configs"):
+        normalize_request({"configs": []})
+    with pytest.raises(ValueError, match="id"):
+        normalize_request({"id": "bad/../id",
+                          "configs": [{"mean": 1}]})
+    with pytest.raises(ValueError, match="tenant"):
+        normalize_request({"tenant": "", "configs": [{"mean": 1}]})
+    with pytest.raises(ValueError, match="not a number"):
+        normalize_request({"configs": [{"mean": "soon"}]},
+                          default_iters=10)
+    with pytest.raises(ValueError, match="iters"):
+        normalize_request({"configs": [{"mean": 1}], "iters": -3})
+    # no request iters and no default known here (client-side durable
+    # spool fallback): deferred — the service fills its default at
+    # pickup rather than the client refusing a valid request
+    out = normalize_request({"configs": [{"mean": 1}]},
+                            default_iters=0)
+    assert "iters" not in out
+    out = normalize_request(
+        {"configs": [{"mean": 500, "std": 100}, {}]}, default_iters=8)
+    assert out["iters"] == 8 and out["tenant"] == "default"
+    assert out["configs"] == [{"mean": 500.0, "std": 100.0}, {}]
+    assert out["id"].startswith("r-") and "submit_time" in out
+
+
+def test_spool_lifecycle(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    rid = spool.submit({"id": "r-001", "configs": [{"mean": 5}]},
+                       default_iters=4)
+    assert rid == "r-001"
+    assert spool.state_of(rid) == "pending"
+    assert spool.pending_ids() == [rid]
+    with pytest.raises(ValueError, match="already exists"):
+        spool.submit({"id": "r-001", "configs": [{"mean": 5}]},
+                     default_iters=4)
+    req = spool.claim(rid, {"cfg_ids": [2, 3]})
+    assert spool.state_of(rid) == "active" and req["cfg_ids"] == [2, 3]
+    assert spool.pending_ids() == []
+    req = spool.finish(rid, {"status": "completed"})
+    assert spool.state_of(rid) == "done"
+    got = spool.read(rid)
+    assert got["status"] == "completed" and got["state"] == "done"
+    assert got["cfg_ids"] == [2, 3]
+    # no temp litter from the atomic writes
+    leftovers = [n for ns in (os.listdir(tmp_path / "spool" / d)
+                              for d in ("pending", "active", "done"))
+                 for n in ns if ".tmp." in n]
+    assert leftovers == []
+
+
+def test_spool_orders_pending_by_id(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    for rid in ("r-0003", "r-0001", "r-0002"):
+        spool.submit({"id": rid, "configs": [{"mean": 5}]},
+                     default_iters=4)
+    assert spool.pending_ids() == ["r-0001", "r-0002", "r-0003"]
+
+
+# ---------------------------------------------------------------------------
+# client fallback (no running service)
+
+
+def test_client_spool_fallback(tmp_path):
+    client = ServeClient(str(tmp_path / "svc"))
+    assert not client.ping()
+    out = client.submit({"id": "r-x", "tenant": "t",
+                         "configs": [{"mean": 5}], "iters": 4})
+    assert out == {"id": "r-x", "state": "pending",
+                   "projected_s": None}
+    req = client.status("r-x")
+    assert req["tenant"] == "t" and req["state"] == "pending"
+    assert client.status("r-unknown") is None
+    assert client.stats() is None
+    client.drain()   # socket down -> durable DRAIN control file
+    assert os.path.exists(tmp_path / "svc" / "DRAIN")
+
+
+# ---------------------------------------------------------------------------
+# request records: schema, line rendering, sinks, summarize
+
+
+def test_request_record_schema_good_and_bad():
+    for event, kw in [
+            ("submitted", dict(configs=3)),
+            ("admitted", dict(configs=3, projected_s=12.5)),
+            ("rejected", dict(reason="over SLO", projected_s=900.0)),
+            ("started", dict(queue_s=1.25)),
+            ("config_done", dict(config=7, status="completed",
+                                 done=1, configs=3)),
+            ("completed", dict(configs=3, done=3, latency_s=93.2)),
+            ("failed", dict(configs=3, done=3, latency_s=80.0,
+                            reason="config 7: non-finite loss")),
+            ("preempted", dict(configs=3, done=1)),
+            ("resumed", dict(configs=3, done=1))]:
+        rec = make_request_record(12, "r-0007", "alice", event, **kw)
+        assert validate_record(rec) == [], (event, validate_record(rec))
+    bad = make_request_record(12, "r-0007", "alice", "completed",
+                              latency_s=5.0)
+    bad["event"] = "vanished"
+    bad["status"] = "shrugged"
+    bad["latency_s"] = -2.0
+    bad["request"] = ""
+    errs = "\n".join(validate_record(bad))
+    for needle in ("unknown event", "unknown status", ">= 0",
+                   "non-empty"):
+        assert needle in errs
+
+
+def test_request_line_rendering():
+    line = request_line(make_request_record(
+        12, "r-7", "alice", "completed", configs=4, done=4,
+        latency_s=93.2))
+    assert "r-7" in line and "alice" in line
+    assert "completed in 93.2 s" in line
+    line = request_line(make_request_record(
+        5, "r-8", "bob", "config_done", config=9, status="completed",
+        done=2, configs=4))
+    assert "config 9 completed (2/4 done)" in line
+    line = request_line(make_request_record(
+        5, "r-9", "bob", "rejected", reason="over SLO",
+        projected_s=900.0))
+    assert "rejected by admission control" in line
+    assert "projected 900 s" in line and "over SLO" in line
+    line = request_line(make_request_record(
+        5, "r-10", "bob", "started", queue_s=1.5))
+    assert "started after 1.5 s queued" in line
+
+
+def test_caffe_log_sink_renders_request(tmp_path):
+    path = str(tmp_path / "log.txt")
+    sink = CaffeLogSink(path, net_name="n", unbuffered=True)
+    sink.write(make_request_record(3, "r-1", "alice", "admitted",
+                                   configs=2, projected_s=4.5))
+    sink.write(make_request_record(9, "r-1", "alice", "completed",
+                                   configs=2, done=2, latency_s=8.25))
+    sink.close()
+    text = open(path).read()
+    assert "Sweep request r-1 (tenant alice) admitted" in text
+    assert "completed in 8.25 s" in text
+
+
+def test_summarize_digests_request_latency(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    recs = [
+        make_request_record(0, "r-1", "alice", "submitted", configs=2),
+        make_request_record(8, "r-1", "alice", "completed", configs=2,
+                            done=2, latency_s=10.0),
+        make_request_record(9, "r-2", "bob", "completed", configs=1,
+                            done=1, latency_s=30.0),
+        make_request_record(9, "r-3", "bob", "failed", configs=1,
+                            done=1, latency_s=20.0,
+                            reason="config 5: poisoned"),
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    digest = summarize_metrics(path)
+    assert "Service requests (4 records)" in digest
+    assert "Completion latency (3 requests)" in digest
+    assert "min 10 s" in digest and "max 30 s" in digest
+    assert "tenant alice: 1 request(s), mean latency 10 s" in digest
+    assert "tenant bob: 2 request(s), 1 failed" in digest
+    assert "request r-3 failed: config 5: poisoned" in digest
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair refill ordering (pure host logic)
+
+
+def _bare_service(weights=None):
+    svc = SweepService.__new__(SweepService)
+    svc.tenant_weights = weights or {}
+    svc._requests = {}
+    svc._cfg_req = {}
+    return svc
+
+
+def _add_request(svc, rid, tenant, cfg_ids):
+    svc._requests[rid] = {"id": rid, "tenant": tenant,
+                          "cfg_ids": list(cfg_ids)}
+    for c in cfg_ids:
+        svc._cfg_req[c] = rid
+
+
+def test_fair_order_interleaves_tenants():
+    svc = _bare_service()
+    _add_request(svc, "a", "alice", [10, 11, 12, 13])
+    _add_request(svc, "b", "bob", [20, 21])
+    entries = [{"config": c, "attempt": 1, "eligible_iter": 0}
+               for c in (10, 11, 12, 13, 20, 21)]
+    order = [e["config"] for e in svc._fair_order(entries, [-1, -1])]
+    # alice spooled first but cannot starve bob: shares equalize
+    assert order[:2] in ([10, 20], [20, 10])
+    assert sorted(order) == [10, 11, 12, 13, 20, 21]
+    # only the 2 freed lanes' picks are fair-ordered; the backlog tail
+    # keeps submission order (it is re-ranked at the next boundary)
+    assert order[2:] == [c for c in (11, 12, 13, 21)
+                         if c not in order[:2]]
+    # with the whole pool free the full backlog is water-filled:
+    # bob's second config beats alice's third
+    full = [e["config"] for e in svc._fair_order(entries, [-1] * 6)]
+    assert full.index(21) < full.index(12)
+
+
+def test_fair_order_respects_weights_and_occupancy():
+    svc = _bare_service(weights={"alice": 2.0})
+    _add_request(svc, "a", "alice", [10, 11, 12, 13])
+    _add_request(svc, "b", "bob", [20, 21])
+    # alice already holds one lane (config 13), but her weight 2
+    # halves her normalized share, so after bob's first pick she wins
+    # the next lane — then the 1.0-vs-1.0 tie breaks by config id
+    entries = [{"config": c, "attempt": 1, "eligible_iter": 0}
+               for c in (10, 11, 20, 21)]
+    order = [e["config"]
+             for e in svc._fair_order(entries, [13, -1, -1])]
+    assert order == [20, 10, 11, 21]
+
+
+# ---------------------------------------------------------------------------
+# in-process service runs (tiny LMDB net, CPU)
+
+
+@pytest.fixture(scope="module")
+def serve_solver(tmp_path_factory):
+    root = tmp_path_factory.mktemp("serve")
+    db = str(root / "db")
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(db) as w:
+        for i in range(16):
+            img = rng.randint(0, 255, (1, 6, 6), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+    solver = str(root / "solver.prototxt")
+    with open(solver, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{root}/snap"
+failure_pattern {{ type: "gaussian" mean: 400 std: 80 }}
+net_param {{
+  name: "servetest"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 4 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+    return solver
+
+
+def _service(solver, d, **kw):
+    kw.setdefault("lanes", LANES)
+    kw.setdefault("chunk", CHUNK)
+    kw.setdefault("default_iters", CHUNK)
+    kw.setdefault("socket_path", None)
+    return SweepService(solver, str(d), **kw)
+
+
+def test_service_matches_direct_runner(serve_solver, tmp_path):
+    """The reproducibility contract in miniature: a two-tenant mix
+    through the service equals a direct SweepRunner execution of the
+    same specs, and every emitted record validates."""
+    specs_a = [{"mean": 400, "std": 80}, {"mean": 360, "std": 70}]
+    specs_b = [{"mean": 420, "std": 60}]
+    with _service(serve_solver, tmp_path / "svc") as svc:
+        svc.submit({"id": "r-a", "tenant": "alice",
+                    "configs": specs_a, "iters": 2 * CHUNK})
+        svc.submit({"id": "r-b", "tenant": "bob",
+                    "configs": specs_b, "iters": CHUNK})
+        assert svc.serve(drain_when_idle=True) == 0
+        ra, rb = svc.status("r-a"), svc.status("r-b")
+    assert ra["status"] == "completed" and rb["status"] == "completed"
+    assert ra["state"] == "done" and len(ra["results"]) == 2
+
+    # direct replay: same lane pool, same submission order
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.solver import Solver
+    runner = SweepRunner(Solver(serve_solver), n_configs=LANES,
+                         pipeline_depth=0)
+    runner.enable_self_healing(budget=CHUNK, max_retries=1,
+                               start_empty=True, virtual_time=True)
+    ids_a = runner.submit_configs(specs_a, budget=2 * CHUNK)
+    ids_b = runner.submit_configs(specs_b, budget=CHUNK)
+    while not runner.healing_complete():
+        runner.step(CHUNK, chunk=CHUNK)
+    rep = runner.config_report()
+    runner.close()
+    assert ra["cfg_ids"] == ids_a and rb["cfg_ids"] == ids_b
+    for req, ids in ((ra, ids_a), (rb, ids_b)):
+        for cfg in ids:
+            got = req["results"][str(cfg)]
+            want = rep["completed"][cfg]
+            assert got["loss"] == want["loss"], (cfg, got, want)
+            assert got["broken"] == want["broken"]
+            assert got["attempts"] == 1
+
+    # every record the service emitted is schema-valid, and the
+    # per-request stream carries the full lifecycle in order
+    svc_dir = tmp_path / "svc"
+    for rid, n_cfg in (("r-a", 2), ("r-b", 1)):
+        events = []
+        with open(svc_dir / "requests" / f"{rid}.jsonl") as f:
+            for line in f:
+                rec = json.loads(line)
+                assert validate_record(rec) == []
+                events.append(rec["event"])
+        assert events[0] == "submitted" and events[1] == "admitted"
+        assert events[2] == "started" and events[-1] == "completed"
+        assert events.count("config_done") == n_cfg
+    with open(svc_dir / "metrics.jsonl") as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    assert all(validate_record(r) == [] for r in recs)
+    assert any(r.get("type") == "request" for r in recs)
+
+
+def test_service_admission_reject(serve_solver, tmp_path):
+    with _service(serve_solver, tmp_path / "svc",
+                  slo_seconds=0.5, admission="reject") as svc:
+        # pretend the pool is measured VERY slow so any request
+        # projects past the SLO window
+        svc._steps_per_sec = 1e-6
+        svc.submit({"id": "r-big", "tenant": "alice",
+                    "configs": [{"mean": 400, "std": 80}],
+                    "iters": CHUNK})
+        svc.serve(max_beats=1)
+        req = svc.status("r-big")
+    assert req["status"] == "rejected" and req["state"] == "done"
+    assert "SLO window" in req["reason"]
+    rec = json.loads(open(
+        tmp_path / "svc" / "requests" / "r-big.jsonl"
+    ).read().splitlines()[-1])
+    assert rec["event"] == "rejected" and rec["projected_s"] > 0.5
+    assert validate_record(rec) == []
+
+
+def test_service_drain_and_resume(serve_solver, tmp_path):
+    d = tmp_path / "svc"
+    svc = _service(serve_solver, d)
+    svc.submit({"id": "r-1", "tenant": "alice",
+                "configs": [{"mean": 400, "std": 80}],
+                "iters": 3 * CHUNK})
+    assert svc.serve(max_beats=1) == 0
+    assert svc.status("r-1")["status"] in ("admitted", "running")
+    svc.drain()
+    assert svc.serve() == DRAIN_EXIT
+    svc.close()
+    assert os.path.exists(d / "checkpoint.npz")
+
+    with _service(serve_solver, d) as svc2:
+        assert svc2.serve(drain_when_idle=True) == 0
+        req = svc2.status("r-1")
+    assert req["status"] == "completed" and len(req["results"]) == 1
+    events = [json.loads(l)["event"]
+              for l in open(d / "requests" / "r-1.jsonl")]
+    assert "preempted" in events and "resumed" in events
+    assert events[-1] == "completed"
+    # the drain checkpoint is consumed on a clean finish
+    assert not svc2._active_ids()
+
+
+def test_junk_pending_files_quarantined_not_fatal(serve_solver,
+                                                  tmp_path):
+    """Anything that can write the filesystem can drop files into
+    spool/pending/ — unparseable bytes and valid-JSON-but-invalid
+    requests must be quarantined/rejected, never crash the shared
+    resident server."""
+    d = tmp_path / "svc"
+    with _service(serve_solver, d) as svc:
+        with open(d / "spool" / "pending" / "junk.json", "w") as f:
+            f.write("{not json at all")
+        with open(d / "spool" / "pending" / "noconfigs.json",
+                  "w") as f:
+            json.dump({"tenant": "x"}, f)
+        svc.submit({"id": "r-ok", "tenant": "alice",
+                    "configs": [{"mean": 400, "std": 80}],
+                    "iters": CHUNK})
+        assert svc.serve(drain_when_idle=True) == 0
+        assert svc.status("r-ok")["status"] == "completed"
+        junk = svc.status("junk")
+        assert junk["status"] == "rejected"
+        assert "unparseable" in junk["reason"]
+        bad = svc.status("noconfigs")
+        assert bad["status"] == "rejected"
+        assert "invalid request" in bad["reason"]
+
+
+def test_resume_readmits_orphaned_active(serve_solver, tmp_path):
+    """A request claimed into spool/active/ in a beat that crashed
+    before its state write has no table entry — resume must reconcile
+    the spool against the table or the request never terminates."""
+    d = tmp_path / "svc"
+    svc = _service(serve_solver, d)
+    svc.submit({"id": "r-1", "tenant": "alice",
+                "configs": [{"mean": 400, "std": 80}],
+                "iters": 2 * CHUNK})
+    assert svc.serve(max_beats=1) == 0
+    svc.drain()
+    assert svc.serve() == DRAIN_EXIT
+    svc.close()
+    # simulate the crash window: claimed, never recorded
+    spool = Spool(str(d / "spool"))
+    spool.submit({"id": "r-orphan", "tenant": "bob",
+                  "configs": [{"mean": 420, "std": 70}],
+                  "iters": CHUNK})
+    spool.claim("r-orphan")
+    with _service(serve_solver, d) as svc2:
+        assert svc2.serve(drain_when_idle=True) == 0
+        assert svc2.status("r-1")["status"] == "completed"
+        orphan = svc2.status("r-orphan")
+    assert orphan["status"] == "completed"
+    assert len(orphan["results"]) == 1
+    events = [json.loads(l)["event"]
+              for l in open(d / "requests" / "r-orphan.jsonl")]
+    assert "resumed" in events and events[-1] == "completed"
+
+
+def test_client_fallback_defers_iters_to_service(serve_solver,
+                                                 tmp_path):
+    """The durable spool fallback must accept a request with no
+    explicit iters (the service fills its --default-iters at
+    pickup)."""
+    d = tmp_path / "svc"
+    client = ServeClient(str(d))
+    out = client.submit({"id": "r-d", "tenant": "t",
+                         "configs": [{"mean": 400, "std": 80}]})
+    assert out["state"] == "pending"
+    assert "iters" not in client.status("r-d")
+    with _service(serve_solver, d) as svc:
+        assert svc.serve(drain_when_idle=True) == 0
+        req = svc.status("r-d")
+    assert req["status"] == "completed"
+    assert req["iters"] == CHUNK   # the service default
+
+
+def test_service_refuses_wallclock_seed(serve_solver, tmp_path):
+    from rram_caffe_simulation_tpu.utils.io import read_solver_param
+    param = read_solver_param(serve_solver)
+    param.ClearField("random_seed")
+    with pytest.raises(ValueError, match="random_seed"):
+        SweepService(param, str(tmp_path / "svc"), socket_path=None)
+
+
+def test_service_rejects_inject_without_flag(serve_solver, tmp_path):
+    with _service(serve_solver, tmp_path / "svc") as svc:
+        with pytest.raises(ValueError, match="inject_nan"):
+            svc.submit({"id": "r-evil", "tenant": "t",
+                        "configs": [{"mean": 400}], "iters": CHUNK,
+                        "inject_nan": {"iter": 1}})
