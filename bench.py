@@ -81,6 +81,16 @@ def main(argv=None):
                    default=int(os.environ.get("BENCH_REPEATS", "1")),
                    help="timed windows; min is reported, per-window "
                         "seconds land in extra.window_seconds")
+    # pod-scale row (ISSUE 9): "--mesh config=N" (or BENCH_MESH) runs
+    # the sweep config-SHARDED over N local devices as one GSPMD
+    # program — the bench row then reports chips=N and the aggregate
+    # configs/hour across the mesh. The default (no mesh) row stays the
+    # single-chip measurement for trajectory continuity; emit the mesh
+    # row as a separate invocation.
+    p.add_argument("--mesh", default=os.environ.get("BENCH_MESH", ""),
+                   help="mesh spec, e.g. 'config=4' or 'config=all' "
+                        "(every visible device); empty = the classic "
+                        "single-chip row")
     args = p.parse_args(argv)
     repeats = max(args.repeats, 1)
 
@@ -127,13 +137,21 @@ def main(argv=None):
     engine = ENGINE
     if engine == "auto":
         engine = "pallas" if jax.default_backend() == "tpu" else "jax"
+    # pod-scale path: lay the config axis over the requested mesh (the
+    # N-chip GSPMD program; make_mesh's sorted device order). The
+    # fused pallas kernel is single-process/config-only — a mesh spec
+    # keeps whatever engine resolves, the runner validates the combo.
+    mesh = None
+    if args.mesh:
+        from rram_caffe_simulation_tpu.parallel import mesh_from_spec
+        mesh = mesh_from_spec(args.mesh)
     # precompile_chunk: AOT-compile the CHUNK-step function on the main
     # thread while the LMDB decode runs on a background thread — the
     # two cold-start halves overlap instead of serializing
     runner = SweepRunner(solver, n_configs=N_CONFIGS, compute_dtype=DTYPE,
                          precompile_chunk=CHUNK, pipeline_depth=PIPELINE,
                          engine=engine, packed_state=PACKED,
-                         dtype_policy=DTYPE_POLICY)
+                         dtype_policy=DTYPE_POLICY, mesh=mesh)
     input_path = ("lmdb->transformer->device-resident dataset"
                   if runner._dataset is not None
                   else "host feed per step")
@@ -153,27 +171,50 @@ def main(argv=None):
     setup_rec = runner.setup_record(setup_s)
     runner.close()
 
-    n_chips = len(jax.devices())
+    # chips = the devices the sweep actually ran on: the whole mesh
+    # when config-sharded, every visible device on the classic row
+    n_chips = (len(runner.mesh.devices.ravel())
+               if args.mesh else len(jax.devices()))
     img_s_chip = N_CONFIGS * BATCH * STEPS / dt / n_chips
+    # aggregate across the mesh: the whole runner's throughput (the
+    # per-chip figure divides by chips)
     configs_per_hour = N_CONFIGS * STEPS / dt * 3600.0 / 5000.0
     # (configs/hour normalized to a 5k-iteration CIFAR-quick training run)
     # HBM-floor accounting (ROADMAP item 3): estimated resident-state
     # bytes one sweep iteration moves, and the bandwidth the min window
     # achieved against that floor — the trajectory r06+ tracks as the
     # packed/quantized engines shrink bytes-per-step
+    # bytes_per_step_est is already the PER-CHIP resident share (the
+    # runner divides config-sharded leaves by the shard count), so the
+    # achieved-bandwidth figure must NOT divide by chips again
     bytes_step = setup_rec.get("bytes_per_step_est") or 0
-    achieved_gb_s = bytes_step * STEPS / dt / 1e9 / n_chips
+    achieved_gb_s = bytes_step * STEPS / dt / 1e9
+
+    extra_mesh = {}
+    if args.mesh:
+        # the pod-scale row (chips > 1): the config axis sharded over
+        # the mesh as ONE jitted program — aggregate configs/hour is
+        # the scaling headline (acceptance: >= 0.8 * N x single-chip)
+        extra_mesh = {
+            "mesh": dict(runner.mesh.shape),
+            "configs_per_hour_aggregate": round(configs_per_hour, 2),
+            "configs_per_hour_per_chip": round(
+                configs_per_hour / n_chips, 2),
+        }
 
     print(json.dumps({
         "metric": "images/sec/chip under RRAM noise (CIFAR-10-quick, "
                   f"{N_CONFIGS}-config Monte-Carlo sweep, LMDB input"
-                  + (f", {DTYPE} compute" if DTYPE else "") + ")",
+                  + (f", {DTYPE} compute" if DTYPE else "")
+                  + (f", config-sharded over {n_chips} chips"
+                     if args.mesh else "") + ")",
         "value": round(img_s_chip, 1),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 2),
         "extra": {
             "fault_configs_swept_per_hour_5k_iters":
                 round(configs_per_hour, 2),
+            **extra_mesh,
             "input_path": input_path,
             "setup_seconds_incl_lmdb_decode_and_compile":
                 round(setup_s, 1),

@@ -44,6 +44,22 @@ permanently failed (partial results, diagnoses in the report), 75
 (EX_TEMPFAIL) = preempted or stalled mid-run (resume me).
 `scripts/check_lane_reclamation.py` is the CI guard.
 
+Pod-scale (the config axis sharded across a real mesh): launch ONE
+process per host with the same command plus `--num-processes/
+--process-id/--coordinator` (TPU pods autodetect all three — just pass
+`--multihost`). The config axis of every group then lays across ALL
+hosts' chips as one GSPMD program (make_mesh sorts devices by
+(process_index, id), so every host assembles the identical mesh);
+process 0 owns the journal/manifest/report, metrics land in
+per-process `metrics_gN.pP.jsonl` files, group checkpoints become v4
+DISTRIBUTED directories (per-process shard files under one
+manifest.json), and a SIGTERM delivered to ANY process drains ALL of
+them at the same chunk boundary (the preempt flag is agreed via a
+tiny allgather at every poll slice) — every process exits 75 and
+`--resume` restores onto the SAME or a DIFFERENT topology bit-exactly
+(the v4 resharding contract; scripts/check_pod_sweep.py is the CI
+guard). The run directory must be a filesystem every process sees.
+
     python examples/gaussian_failure/run_1000_sweep.py \
         [--configs 1000] [--group 500] [--iters 5000] [--chunk 50] \
         [--run-dir sweeps/run0]          # durable
@@ -103,10 +119,32 @@ def _read_journal(path: str):
     return recs
 
 
+def _ckpt_ready(path: str) -> bool:
+    """True when a usable checkpoint exists at `path`: the single-file
+    layout, or a v4 distributed directory whose manifest.json commit
+    record landed (a directory without one is an aborted write)."""
+    if os.path.isdir(path):
+        return os.path.exists(os.path.join(path, "manifest.json"))
+    return os.path.exists(path)
+
+
 def _ckpt_iter(path: str) -> int:
+    if os.path.isdir(path):
+        with open(os.path.join(path, "manifest.json")) as f:
+            return int(json.load(f)["meta"]["iter"])
     with np.load(path) as z:
         meta = json.loads(bytes(bytearray(z["__meta__"])).decode())
     return int(meta["iter"])
+
+
+def _ckpt_remove(path: str):
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    else:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 def _truncate_metrics(path: str, upto_iter: int):
@@ -199,7 +237,56 @@ def main(argv=None):
                         "iteration ITER; append ':always' to re-poison "
                         "every attempt (exercises the permanent-"
                         "failure path)")
+    p.add_argument("--multihost", action="store_true",
+                   help="pod mode: jax.distributed.initialize before "
+                        "anything touches the backend (TPU pods "
+                        "autodetect coordinator/count/id from the "
+                        "runtime; elsewhere pass the three flags "
+                        "below or the COORDINATOR_ADDRESS / "
+                        "NUM_PROCESSES / PROCESS_ID env vars). The "
+                        "config axis of every group then shards over "
+                        "ALL hosts' chips")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port (implies "
+                        "--multihost)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total process count (implies --multihost)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's id, 0-based (implies "
+                        "--multihost; process 0 owns the journal/"
+                        "manifest/report)")
     args = p.parse_args(argv)
+
+    # pod mode: the cluster must initialize BEFORE jax (even
+    # jax.devices()) initializes XLA — keep this above every
+    # rram_caffe_simulation_tpu import that could touch the backend
+    multi = (args.multihost or args.coordinator is not None
+             or args.num_processes is not None
+             or args.process_id is not None)
+    if multi:
+        from rram_caffe_simulation_tpu.parallel import multihost
+        multihost.initialize(args.coordinator, args.num_processes,
+                             args.process_id)
+    import jax
+    from rram_caffe_simulation_tpu.parallel import multihost
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    primary = pid == 0
+    if nproc > 1 and args.stall_timeout:
+        p.error("--stall-timeout is single-process (the emergency "
+                "checkpoint it writes is a collective the stalled "
+                "peers would never join)")
+
+    def _any_preempt(preempt: dict) -> bool:
+        """Global preemption agreement: a signal delivered to ANY
+        process preempts ALL of them at this same poll boundary.
+        Collective — every process calls at the same control-flow
+        points (free single-process)."""
+        got = multihost.process_any(bool(preempt))
+        if got and not preempt:
+            preempt.setdefault("signal", "PEER")
+            preempt.setdefault("t", time.monotonic())
+        return got
 
     os.chdir(REPO)
     run_dir = os.path.abspath(args.resume or args.run_dir) \
@@ -242,7 +329,24 @@ def main(argv=None):
     frontier = len(done_recs)
 
     def ckpt_path(gi):
+        # single-process: one .npz file; pod mode: a v4 distributed
+        # checkpoint DIRECTORY of per-process shard files (same name —
+        # SweepRunner.checkpoint/restore handle either layout)
         return os.path.join(run_dir, f"group_{gi}.ckpt.npz")
+
+    def metrics_path(gi, proc=None):
+        # per-process metrics files on a pod (each process journals its
+        # own stream; contents are identical modulo timing — process 0's
+        # is the canonical copy analysis tools read)
+        proc = pid if proc is None else proc
+        name = (f"metrics_g{gi}.jsonl" if nproc == 1
+                else f"metrics_g{gi}.p{proc}.jsonl")
+        return os.path.join(run_dir, name)
+
+    def journal(rec):
+        """One journal line — process 0 owns the journal on a pod."""
+        if primary:
+            _journal_append(journal_path, rec)
 
     def build_runner(gi, n_cfg):
         param = read_solver_param(args.solver)
@@ -265,9 +369,9 @@ def main(argv=None):
             # not sitting in a userspace buffer (one flush per chunk
             # record is noise next to the chunk's device time)
             solver.enable_metrics(JsonlSink(
-                os.path.join(run_dir, f"metrics_g{gi}.jsonl"),
+                metrics_path(gi),
                 append=(resuming and gi == frontier
-                        and os.path.exists(ckpt_path(gi))),
+                        and _ckpt_ready(ckpt_path(gi))),
                 unbuffered=True))
         # per-group block: groups at or under the block need no
         # blocking (they already fit the activation budget); an
@@ -337,7 +441,7 @@ def main(argv=None):
             "retry_backoff": args.retry_backoff,
             "configs": {str(c): ledger[c] for c in sorted(ledger)},
         }
-        if run_dir:
+        if run_dir and primary:
             path = os.path.join(run_dir, "sweep_report.json")
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
@@ -367,15 +471,20 @@ def main(argv=None):
         lane = runner.config_report()["active"].get(local, {}).get("lane")
         if lane is None:
             return
-        import jax
-        import jax.numpy as jnp
         key = runner.solver._fault_keys[0]
         layer, slot = key.rsplit("/", 1)
         orig = runner.params[layer][int(slot)]
-        w = np.array(orig)
-        w[lane].flat[0] = np.nan
-        runner.params[layer][int(slot)] = jax.device_put(
-            jnp.asarray(w), orig.sharding)
+
+        def _poison(row):
+            row = np.array(row)
+            row.flat[0] = np.nan
+            return row
+
+        # addressable-shard edit: on a pod only the process owning the
+        # lane's rows mutates anything; everyone rebuilds the handle
+        # from the same (byte-identical elsewhere) buffers
+        runner.params[layer][int(slot)] = runner._edit_leaf_rows(
+            orig, {int(lane): _poison})
         inject["done"] = True
         print(f"Injected NaN into config {inject['config']} "
               f"(lane {lane}) at iteration {runner.iter}", flush=True)
@@ -389,7 +498,7 @@ def main(argv=None):
 
     if run_dir:
         os.makedirs(run_dir, exist_ok=True)
-        if not resuming:
+        if not resuming and primary:
             with open(manifest_path, "w") as f:
                 json.dump({k: getattr(args, k) for k in MANIFEST_ARGS},
                           f, indent=2)
@@ -406,15 +515,20 @@ def main(argv=None):
         """Grace path: drain, checkpoint the in-flight group, journal
         the preemption, exit with the distinct 'retry me' code. The
         sweep report is written too (status "preempted") so partial
-        progress is inspectable while the run waits for its retry."""
+        progress is inspectable while the run waits for its retry.
+        On a pod every process runs this together (the preempt flag was
+        agreed via _any_preempt); the checkpoint decision is agreed
+        too — the collective v4 capture would deadlock if one process
+        thought its grace budget had run out and its peers did not."""
         left = args.grace_seconds - (time.monotonic() - preempt["t"])
+        do_ckpt = runner is not None and multihost.process_any(left > 0)
         wrote = None
-        if runner is not None and left > 0:
+        if do_ckpt:
             wrote = runner.checkpoint(ckpt_path(gi))
         if runner is not None:
             _merge_report(gi, runner.config_report())
             _close_runner(runner)
-        _journal_append(journal_path, {
+        journal({
             "event": "preempt", "signal": preempt["signal"],
             "group": gi,
             "iter": int(runner.iter) if runner is not None else 0,
@@ -439,7 +553,7 @@ def main(argv=None):
         if runner is not None:
             _merge_report(gi, runner.config_report())
         if run_dir:
-            _journal_append(journal_path, {
+            journal({
                 "event": "stall", "group": gi,
                 "iter": int(runner.iter) if runner is not None else 0,
                 "checkpoint": os.path.basename(wrote) if wrote else None})
@@ -498,19 +612,34 @@ def main(argv=None):
                         for i in range(n_cfg)}})
                 done += n_cfg
                 continue
-            if preempt:
+            if _any_preempt(preempt):
                 # signal landed between groups: the journal is already
                 # consistent, nothing in flight to checkpoint
                 _preempt_exit(None, gi)
             if runner is None:
                 restoring = (resuming and gi == frontier
-                             and os.path.exists(ckpt_path(gi)))
+                             and _ckpt_ready(ckpt_path(gi)))
                 if restoring:
+                    # cross-topology resume (v4 reshards state; the
+                    # metrics layout is named by process count): adopt
+                    # the previous topology's canonical stream when
+                    # ours does not exist yet, so the group's records
+                    # stay one coherent file
+                    if not os.path.exists(metrics_path(gi)):
+                        for cand in (
+                                os.path.join(run_dir,
+                                             f"metrics_g{gi}.jsonl"),
+                                os.path.join(
+                                    run_dir,
+                                    f"metrics_g{gi}.p0.jsonl")):
+                            if os.path.exists(cand):
+                                shutil.copyfile(cand, metrics_path(gi))
+                                break
                     # records beyond the checkpoint would duplicate
                     # once the restored state re-runs those chunks
-                    _truncate_metrics(
-                        os.path.join(run_dir, f"metrics_g{gi}.jsonl"),
-                        _ckpt_iter(ckpt_path(gi)))
+                    # (each process truncates its OWN metrics file)
+                    _truncate_metrics(metrics_path(gi),
+                                      _ckpt_iter(ckpt_path(gi)))
                 runner = build_runner(gi, n_cfg)
                 if restoring:
                     runner.restore(ckpt_path(gi))
@@ -529,7 +658,7 @@ def main(argv=None):
                     _maybe_inject(runner, gi)
                     runner.step(poll_every or args.iters,
                                 chunk=args.chunk)
-                    if preempt:
+                    if _any_preempt(preempt):
                         _preempt_exit(runner, gi)
                     if ck_every and not runner.healing_complete():
                         runner.checkpoint(ckpt_path(gi))
@@ -542,8 +671,7 @@ def main(argv=None):
                 # restored checkpoint already covered every iteration
                 # (preempted at the very end of the group): the final
                 # per-config losses are the last journaled chunk record
-                mrecs = [r for r in _read_journal(os.path.join(
-                             run_dir, f"metrics_g{gi}.jsonl"))
+                mrecs = [r for r in _read_journal(metrics_path(gi))
                          if r.get("type") is None]
                 for c, v in completed.items():
                     lane = v.get("lane")
@@ -592,7 +720,7 @@ def main(argv=None):
             # only AFTER the group's journal line below — exiting first
             # would discard a fully trained group on resume
             if run_dir:
-                _journal_append(journal_path, {
+                journal({
                     "event": "group", "group": gi, "n_configs": n_cfg,
                     "iters": args.iters,
                     "config_block": blocks_used[-1],
@@ -609,10 +737,8 @@ def main(argv=None):
                     "host_blocked_seconds": host_blocked_s[-1],
                     "checkpoint_write_seconds": round(pipe.get(
                         "checkpoint_write_seconds", 0.0), 4)})
-                try:
-                    os.remove(ckpt_path(gi))   # group done; ckpt stale
-                except OSError:
-                    pass
+                if primary:
+                    _ckpt_remove(ckpt_path(gi))  # group done; stale
             done += n_cfg
             tail = ""
             if retried:
@@ -623,14 +749,14 @@ def main(argv=None):
                   f"{dt / 60:.2f} min (broken mean {broken_mean:.3f})"
                   f"{tail}; {done}/{args.configs} done", flush=True)
             if gi + 1 < len(groups) and (gi + 1) not in done_recs:
-                if preempt:
+                if _any_preempt(preempt):
                     # don't burn grace budget building a group we are
                     # about to abandon (the with-block cancels the
                     # prefetch)
                     _preempt_exit(None, gi + 1)
                 runner = (build_runner(gi + 1, groups[gi + 1])
                           if args.no_overlap else prefetch.take())
-                if preempt:
+                if _any_preempt(preempt):
                     _preempt_exit(runner, gi + 1)
     total_min = (time.perf_counter() - t_total) / 60
     n_failed = sum(1 for v in ledger.values()
@@ -658,6 +784,10 @@ def main(argv=None):
         "host_blocked_seconds": host_blocked_s,
         "run_dir": run_dir or None,
         "groups_resumed": len(done_recs),
+        # pod mode: how the config axis was laid out (1 process /
+        # N chips is the classic single-host row)
+        "processes": nproc,
+        "chips": len(jax.devices()),
         # the completion contract's summary (full per-config ledger in
         # <run-dir>/sweep_report.json for durable runs)
         "status": status,
@@ -666,10 +796,11 @@ def main(argv=None):
         "retried_configs": sweep_report["retried"],
     }
     if run_dir:
-        _journal_append(journal_path, {"event": "done",
-                                       "configs": args.configs,
-                                       "status": status})
-    print(json.dumps(rec), flush=True)
+        journal({"event": "done", "configs": args.configs,
+                 "status": status})
+    if primary:
+        # one JSON line per RUN, not per process
+        print(json.dumps(rec), flush=True)
     if exit_code:
         sys.exit(exit_code)
     return rec

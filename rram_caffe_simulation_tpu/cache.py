@@ -149,6 +149,10 @@ class SetupStats:
         # (SweepRunner.bytes_per_step_est; "f32" | "packed")
         self.bytes_per_step = None
         self.fault_format = None
+        # pod-scale accounting (ISSUE 9): how many shards the config
+        # axis is laid over (1 = single chip; bytes_per_step is the
+        # PER-CHIP resident share under the mesh)
+        self.config_shards = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -181,7 +185,8 @@ class SetupStats:
             pipeline=(self.pipeline.record()
                       if self.pipeline is not None else None),
             bytes_per_step_est=self.bytes_per_step,
-            fault_state_format=self.fault_format)
+            fault_state_format=self.fault_format,
+            config_shards=self.config_shards)
 
 
 class _Timed:

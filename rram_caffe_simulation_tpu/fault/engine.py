@@ -103,6 +103,34 @@ def draw_rescaled_state(key: jax.Array, param_shapes: Dict[str, tuple],
     return {"lifetimes": life, "stuck": st["stuck"]}
 
 
+def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
+                    pattern: "pb.FailurePatternParameter",
+                    n_configs: int, means, stds,
+                    rows: Tuple[int, int] = None) -> FaultState:
+    """Rows [lo, hi) of the n_configs-stacked fault-state draw, exactly
+    as the full stack would hold them: the per-config keys are split
+    from `key` over the FULL config count and then sliced, so the draw
+    a pod shard makes for its own rows is bit-identical to the rows of
+    a single-host full draw. This is the sharded-draw kernel behind
+    `stack_fault_states` — on a config mesh each process materializes
+    only the 1/processes of the Monte-Carlo state its chips own, while
+    the global array (assembled from these blocks) never differs from
+    the single-process one."""
+    lo, hi = (0, n_configs) if rows is None else (int(rows[0]),
+                                                  int(rows[1]))
+    if not (0 <= lo <= hi <= n_configs):
+        raise ValueError(f"draw_state_rows rows [{lo}, {hi}) outside "
+                         f"[0, {n_configs})")
+    keys = jax.random.split(key, n_configs)[lo:hi]
+    mean = jnp.asarray(means, jnp.float32)[lo:hi]
+    std = jnp.asarray(stds, jnp.float32)[lo:hi]
+
+    def init_one(k, m, s):
+        return draw_rescaled_state(k, param_shapes, pattern, m, s)
+
+    return jax.vmap(init_one)(keys, mean, std)
+
+
 def fail(fault_params: Dict[str, jax.Array], state: FaultState,
          fault_diffs: Dict[str, jax.Array],
          decrement: float = 100.0) -> Tuple[Dict[str, jax.Array], FaultState]:

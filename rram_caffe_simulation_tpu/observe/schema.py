@@ -174,7 +174,10 @@ DEBUG_UPDATE_FIELDS = {
 # `fault_state_format` the fault-bank layout behind it ("f32" = the
 # reference's float leaves, "packed" = the bit-packed counter banks of
 # fault/packed.py) — the fields the HBM-floor trajectory (BENCH r06+)
-# tracks.
+# tracks. `config_shards` (optional, pod-scale sweeps) is how many
+# mesh shards the config axis spans — when > 1 the resident state is
+# spread over that many chips and `bytes_per_step_est` is the PER-CHIP
+# share.
 #
 # `pipeline` (optional) is the async-execution-layer accounting
 # (async_exec.PipelineStats): `depth` 0 = synchronous bookkeeping,
@@ -204,6 +207,7 @@ SETUP_FIELDS = {
     "pipeline": (dict, False),
     "bytes_per_step_est": (int, False),
     "fault_state_format": (str, False),
+    "config_shards": (int, False),
 }
 
 SETUP_CACHE_FIELDS = {
@@ -442,6 +446,10 @@ def _validate_setup(rec) -> list:
     if isinstance(fmt, str) and fmt not in FAULT_STATE_FORMATS:
         errs.append(f"setup.fault_state_format: unknown format {fmt!r} "
                     f"(expected one of {FAULT_STATE_FORMATS})")
+    shards = rec.get("config_shards")
+    if isinstance(shards, int) and not isinstance(shards, bool) \
+            and shards < 1:
+        errs.append("setup.config_shards: must be >= 1")
     pipe = rec.get("pipeline")
     if isinstance(pipe, dict):
         errs += _check_fields(pipe, PIPELINE_FIELDS, "setup.pipeline")

@@ -233,7 +233,8 @@ def make_setup_record(decode_s: float, compile_s: float,
                       setup_s: Optional[float] = None,
                       pipeline: Optional[dict] = None,
                       bytes_per_step_est: Optional[int] = None,
-                      fault_state_format: Optional[str] = None) -> dict:
+                      fault_state_format: Optional[str] = None,
+                      config_shards: Optional[int] = None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -244,7 +245,9 @@ def make_setup_record(decode_s: float, compile_s: float,
     consumer concurrency, off-loop snapshot writes, group-setup
     overlap. `bytes_per_step_est` / `fault_state_format` are the
     HBM-floor fields (SweepRunner.bytes_per_step_est; "f32" |
-    "packed") the bytes-per-step trajectory tracks."""
+    "packed") the bytes-per-step trajectory tracks; `config_shards`
+    (pod-scale sweeps) is how many mesh shards the config axis spans —
+    bytes_per_step_est is the PER-CHIP share under the mesh."""
     rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "setup",
@@ -263,6 +266,8 @@ def make_setup_record(decode_s: float, compile_s: float,
         rec["bytes_per_step_est"] = int(bytes_per_step_est)
     if fault_state_format is not None:
         rec["fault_state_format"] = str(fault_state_format)
+    if config_shards is not None:
+        rec["config_shards"] = int(config_shards)
     return rec
 
 

@@ -16,13 +16,15 @@ leading Monte-Carlo fault-configuration axis, replacing the reference's
 one-process-per-config sweep (run_different_mean.sh fans 3 configs over 3
 GPUs; here thousands of crossbar configs ride one TPU batch).
 """
-from .mesh import make_mesh, data_sharding, config_sharding, replicated
+from .mesh import (make_mesh, data_sharding, config_sharding, replicated,
+                   parse_mesh_shape, mesh_from_spec, global_put)
 from .dp import make_dp_step, shard_batch
 from .sweep import GroupPrefetcher, SweepRunner, stack_fault_states
 from .tp import tp_param_specs
 from .pp import pipeline_apply, stack_stage_params
 
 __all__ = ["make_mesh", "data_sharding", "config_sharding", "replicated",
+           "parse_mesh_shape", "mesh_from_spec", "global_put",
            "make_dp_step", "shard_batch", "SweepRunner", "GroupPrefetcher",
            "stack_fault_states", "tp_param_specs", "pipeline_apply",
            "stack_stage_params"]

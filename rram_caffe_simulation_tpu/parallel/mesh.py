@@ -22,8 +22,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def make_mesh(shape: Optional[dict] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a Mesh. `shape` maps axis name -> size, e.g.
-    {"config": 4, "data": 2}; defaults to all devices on one "data" axis."""
+    {"config": 4, "data": 2}; defaults to all devices on one "data" axis.
+
+    INVARIANT: devices are laid into the mesh sorted by
+    (process_index, id), so a multi-host mesh assembles IDENTICALLY on
+    every process from the same `jax.devices()` set — no host may see a
+    different axis layout, or the GSPMD programs the hosts compile
+    would disagree on which shard lives where. A process's devices thus
+    form a contiguous block of the flattened mesh, which is what makes
+    each host's share of a leading-axis sharding a contiguous row range
+    (the distributed-checkpoint shard layout and the self-healing
+    lane-row writes both lean on this). Callers passing an explicit
+    `devices` sequence get the same normalization.
+    """
     devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (d.process_index, d.id))
     if not shape:
         shape = {"data": len(devices)}
     sizes = list(shape.values())
@@ -31,6 +44,101 @@ def make_mesh(shape: Optional[dict] = None,
         raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
     arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, tuple(shape.keys()))
+
+
+def parse_mesh_shape(spec: str) -> dict:
+    """Parse a CLI mesh spec like "config=8" or "config=4,data=2" into
+    the `make_mesh` shape dict (insertion order = mesh axis order).
+    "config=all" sizes the axis to every visible device."""
+    shape = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"mesh spec entry {part!r} must be axis=N (e.g. "
+                "'config=8' or 'config=4,data=2')")
+        axis, n = part.split("=", 1)
+        axis = axis.strip()
+        n = n.strip()
+        size = len(jax.devices()) if n == "all" else int(n)
+        if size <= 0:
+            raise ValueError(f"mesh axis {axis!r} size must be > 0, "
+                             f"got {n!r}")
+        shape[axis] = size
+    if not shape:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return shape
+
+
+def mesh_from_spec(spec: str) -> Mesh:
+    """CLI front door: parse a "--mesh config=N" spec and build the
+    mesh over the FIRST N devices in (process_index, id) order (a
+    smaller-than-everything mesh uses the leading devices, matching
+    how every host would slice a pod)."""
+    shape = parse_mesh_shape(spec)
+    total = int(np.prod(list(shape.values())))
+    devices = sorted(jax.devices(),
+                     key=lambda d: (d.process_index, d.id))
+    if total > len(devices):
+        raise ValueError(f"mesh spec {spec!r} needs {total} devices "
+                         f"but only {len(devices)} are visible")
+    return make_mesh(shape, devices=devices[:total])
+
+
+def global_put(value, sharding: NamedSharding):
+    """`jax.device_put` that also works when `sharding` spans devices of
+    OTHER processes (a pod-wide mesh): device_put can only target
+    addressable devices, so the cross-process case assembles the global
+    array from this process's shards via `make_array_from_callback`.
+    Every process must hold the full host `value` (replicated inputs,
+    or per-process-identical computations); for big leaves where each
+    process should materialize only its own rows, use `put_rows`."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def put_rows(rows, lo: int, global_dim0: int, sharding: NamedSharding):
+    """Assemble a globally dim0-sharded array from this process's own
+    row block `rows` = global rows [lo, lo + len(rows)). Only the
+    shards this process addresses are ever read from `rows`, so each
+    host materializes 1/processes of the leaf — the distributed twin of
+    stacking the full config axis and device_put'ing it."""
+    arr = np.asarray(rows)
+    shape = (int(global_dim0),) + arr.shape[1:]
+
+    def cb(idx):
+        s0 = idx[0]
+        start = 0 if s0.start is None else s0.start
+        stop = shape[0] if s0.stop is None else s0.stop
+        if start < lo or stop > lo + arr.shape[0]:
+            raise ValueError(
+                f"put_rows: shard rows [{start}, {stop}) outside this "
+                f"process's block [{lo}, {lo + arr.shape[0]})")
+        return arr[(slice(start - lo, stop - lo),) + tuple(idx[1:])]
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def owned_row_ranges(sharding: NamedSharding, dim0: int):
+    """The sorted, de-duplicated [lo, hi) blocks of a dim0-sharded
+    array's leading axis that THIS process's devices hold (replicas —
+    e.g. the "data" axis of a (config, data) mesh — collapse to one
+    range). With `make_mesh`'s (process_index, id) device order these
+    are contiguous per process for a leading "config" axis."""
+    ranges = set()
+    for dev, idx in sharding.devices_indices_map((dim0,)).items():
+        if dev.process_index != jax.process_index():
+            continue
+        s0 = idx[0]
+        lo = 0 if s0.start is None else int(s0.start)
+        hi = dim0 if s0.stop is None else int(s0.stop)
+        ranges.add((lo, hi))
+    return sorted(ranges)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -63,6 +63,37 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def is_primary() -> bool:
+    """True on the process that owns the run's shared artifacts (the
+    journal, manifests, reports — process 0 by convention)."""
+    return jax.process_index() == 0
+
+
+def process_any(flag: bool) -> bool:
+    """Global OR of a per-process host flag — the coordination primitive
+    the durable sweep driver uses so a SIGTERM delivered to ONE process
+    drains ALL of them at the same chunk boundary. Collective: every
+    process must call it at the same point in its control flow.
+    Single-process it is free (no device work at all)."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(
+        np.asarray([bool(flag)], dtype=np.bool_))
+    return bool(np.any(got))
+
+
+def barrier(tag: str):
+    """Block until every process reaches this barrier (distributed
+    checkpoint commit ordering: shard files land on all hosts BEFORE
+    process 0 publishes the manifest). No-op single-process."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
 def local_replica_count(mesh, axis: str = "data") -> int:
     """How many of the mesh's `axis` replicas this process feeds (the
     per-host share of the weak-scaled global batch)."""

@@ -24,37 +24,40 @@ import numpy as np
 from .. import async_exec
 from .. import cache as _cache
 from ..fault import engine as fault_engine
-from .mesh import make_mesh
+from . import multihost
+from .mesh import (make_mesh, global_put, put_rows, config_sharding,
+                   owned_row_ranges)
 
 #: SweepRunner.checkpoint file format version (bumped on layout changes).
 #: v2 added the self-healing lane->config indirection (lane_map /
 #: lane_done / retry queue); v3 added the bit-packed fault-state banks
-#: (`fault_format` + `pack_spec` meta — fault/packed.py) and shrinks
-#: the per-config fault payload ~4x. restore() upgrades v1 (identity
-#: lane map assumed) and v2 (f32 fault leaves converted to the
-#: runner's format) checkpoints in place and refuses anything else.
-CHECKPOINT_VERSION = 3
+#: (`fault_format` + `pack_spec` meta — fault/packed.py, ~4x smaller
+#: fault payloads); v4 added the DISTRIBUTED layout — a checkpoint
+#: directory of per-process `shard_NNNNN.npz` row blocks under one
+#: `manifest.json` (written last: the commit record) plus a
+#: `global.npz` for replicated leaves — and resharding on restore: a
+#: checkpoint written on any config-shard topology restores onto any
+#: other (8 chips -> 4 -> 1) bit-exactly. restore() upgrades v1
+#: (identity lane map assumed), v2, and v3 (fault leaves converted to
+#: the runner's format) checkpoints in place and refuses anything else.
+CHECKPOINT_VERSION = 4
 
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
-                       n_configs: int, means=None, stds=None):
+                       n_configs: int, means=None, stds=None, rows=None):
     """n_configs independent fault-state draws, stacked on axis 0.
     `means`/`stds` optionally override pattern.mean/std per config
-    (the run_different_mean.sh / run_different_mean_var.sh grids)."""
-    keys = jax.random.split(key, n_configs)
-    mean = (jnp.asarray(means, jnp.float32) if means is not None
-            else jnp.full((n_configs,), float(pattern.mean), jnp.float32))
-    std = (jnp.asarray(stds, jnp.float32) if stds is not None
-           else jnp.full((n_configs,), float(pattern.std), jnp.float32))
-
-    def init_one(k, m, s):
-        # one draw rescaled from the pattern scalars to the per-config
-        # (mean, std) — the same kernel a self-healing lane refill uses
-        # for its fresh re-draw (engine.draw_rescaled_state)
-        return fault_engine.draw_rescaled_state(k, param_shapes, pattern,
-                                                m, s)
-
-    return jax.vmap(init_one)(keys, mean, std)
+    (the run_different_mean.sh / run_different_mean_var.sh grids).
+    `rows=(lo, hi)` draws only that row block of the stack — the
+    sharded-draw path (engine.draw_state_rows): a pod process
+    materializes just the configs its chips own, bit-identical to the
+    same rows of the full draw."""
+    mean = (np.asarray(means, np.float32) if means is not None
+            else np.full((n_configs,), float(pattern.mean), np.float32))
+    std = (np.asarray(stds, np.float32) if stds is not None
+           else np.full((n_configs,), float(pattern.std), np.float32))
+    return fault_engine.draw_state_rows(key, param_shapes, pattern,
+                                        n_configs, mean, std, rows=rows)
 
 
 class _HealingState:
@@ -257,6 +260,53 @@ class SweepRunner:
                 "for pure tensor parallelism without the Monte-Carlo axis "
                 "use Solver.enable_model_parallel instead")
         self.mesh = mesh
+        # pod mode: the mesh spans devices of OTHER processes (after
+        # multihost.initialize, jax.devices() covers every host and the
+        # default mesh above lays "config" across all of them). Host
+        # bookkeeping then runs identically on every process, big state
+        # leaves exist only as per-process row blocks, and every
+        # device_put is routed through the cross-process assembly path.
+        self._multiproc = any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(self.mesh.devices).ravel())
+        self._cfg_rows = None      # (lo, hi) config rows this process owns
+        if self._multiproc:
+            if "config" not in self.mesh.axis_names:
+                raise ValueError(
+                    "a multi-process SweepRunner mesh must carry a "
+                    "'config' axis — the config dim is what shards "
+                    "across hosts (make_mesh({'config': N}))")
+            if "model" in self.mesh.axis_names:
+                raise ValueError(
+                    "multi-process sweeps support 'config' (and "
+                    "'data') mesh axes only: the TP weight-dim "
+                    "shardings are not wired through the distributed "
+                    "checkpoint/refill row layout yet")
+            if engine == "pallas":
+                raise ValueError(
+                    "SweepRunner(engine='pallas') is single-process: "
+                    "the fused kernel's custom_vmap dispatch has no "
+                    "cross-host partitioning story (ENGINE MATRIX, "
+                    "fault/hw_aware.py)")
+            if solver.strategies.genetic is not None:
+                raise ValueError(
+                    "multi-process sweeps do not support the genetic "
+                    "strategy: its episodic search mutates host "
+                    "slices of the full config-stacked params, which "
+                    "no single process holds on a pod mesh")
+            if solver._watchdog is not None:
+                raise ValueError(
+                    "multi-process sweeps do not support the solver "
+                    "watchdog: its snapshot/halt servicing depends on "
+                    "consumer-thread timing, which is not coordinated "
+                    "across processes (quarantine + self-healing are "
+                    "— they act at deterministic chunk boundaries)")
+            if stall_timeout_s:
+                raise ValueError(
+                    "stall_timeout_s is single-process: the emergency "
+                    "checkpoint it writes is a collective the stalled "
+                    "peer processes would never join")
+            self._cfg_rows = self._owned_config_block()
         self.config_block = int(config_block or 0)
         self.iter = 0
         # last executed iteration's per-config metrics pytree (leading
@@ -280,10 +330,17 @@ class SweepRunner:
         flat = solver._flat(solver.params)
         shapes = {k: flat[k].shape for k in solver._fault_keys}
         key = jax.random.fold_in(solver._key, 0xFA117)
+        # sharded draw: on a pod mesh each process draws ONLY the config
+        # rows its chips own (engine.draw_state_rows splits the keys
+        # over the FULL count first, so the rows are bit-identical to a
+        # single-host full draw); _place_state then assembles the
+        # global arrays from the per-process blocks
+        n_local = (n_configs if self._cfg_rows is None
+                   else self._cfg_rows[1] - self._cfg_rows[0])
         self.fault_states = stack_fault_states(
             key, shapes, solver.param.failure_pattern, n_configs,
-            means=means, stds=stds)
-        bcast = lambda x: jnp.repeat(x[None], n_configs, axis=0)
+            means=means, stds=stds, rows=self._cfg_rows)
+        bcast = lambda x: jnp.repeat(x[None], n_local, axis=0)
         if "remap_slots" in (solver.fault_state or {}):
             # tracked remapping: every config starts at the identity map
             self.fault_states["remap_slots"] = jax.tree.map(
@@ -412,7 +469,8 @@ class SweepRunner:
         # tracing is on) has this and every later update frozen by
         # mask — one diverging config can no longer poison its group.
         vstep = self._make_quarantine_step(vstep, n_configs,
-                                           self._replicated_sharding())
+                                           self._replicated_sharding(),
+                                           replicate_out=self._multiproc)
         self._step = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._vstep = vstep
         # host-side quarantine bookkeeping: ids already diagnosed (so a
@@ -432,6 +490,12 @@ class SweepRunner:
         self._chunk_fns = {}
         self._aot_keys = set()
         self._eval_fns = {}
+        # cached replicate-gather jits (pod mode): identity with
+        # replicated out_shardings (the device all-gather behind full
+        # host fetches of sharded leaves) and the vectorized per-config
+        # broken census
+        self._rep_fn = None
+        self._bf_fn = None
         self._dataset = None
         self._ds_batch = 0
         self._ds_n = 0
@@ -442,7 +506,7 @@ class SweepRunner:
         # per-config quarantine mask, threaded through every dispatch
         # (replicated: n booleans — the per-leaf freeze masks broadcast
         # against whatever sharding the state carries)
-        self.quarantine = jax.device_put(
+        self.quarantine = global_put(
             jnp.zeros((n_configs,), jnp.bool_),
             self._replicated_sharding())
         if preload:
@@ -461,7 +525,8 @@ class SweepRunner:
             self._feed = None
 
     @staticmethod
-    def _make_quarantine_step(vstep, n: int, mask_sharding):
+    def _make_quarantine_step(vstep, n: int, mask_sharding,
+                              replicate_out: bool = False):
         """Wrap the config-vmapped step with the per-config NaN/Inf
         quarantine. A config whose loss comes back non-finite — or, when
         debug tracing / the watchdog is on, whose in-jit sentinels
@@ -486,6 +551,14 @@ class SweepRunner:
             # dispatches would invalidate the compiled executable's
             # input spec (it is a step input AND output)
             bad = jax.lax.with_sharding_constraint(bad, mask_sharding)
+            if replicate_out:
+                # pod mode: losses/outputs/metrics are the host
+                # bookkeeping's inputs and must be readable in full by
+                # EVERY process — pin them replicated (an all-gather of
+                # kilobytes per chunk; the big state stays sharded)
+                loss, outs, mets = jax.tree.map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v, mask_sharding), (loss, outs, mets))
             freeze = lambda old, new: jax.tree.map(
                 lambda o, v: jnp.where(
                     bad.reshape((n,) + (1,) * (v.ndim - 1)), o, v),
@@ -734,19 +807,14 @@ class SweepRunner:
         (_state_arrays rows, lane_done, genetic instance) — or None
         when no usable checkpoint exists (no checkpoint taken, config
         not in it, or it was already quarantined there)."""
-        import json as _json
         import pickle
         path = self._last_ckpt_path
         if not path or not os.path.exists(path):
             return None
         try:
             self.wait_for_writes()
-            with np.load(path) as z:
-                data = {k: z[k] for k in z.files}
-            raw = data.pop("__meta__", None)
-            if raw is None:
-                return None
-            meta = _json.loads(bytes(bytearray(raw)).decode())
+            # either layout: single .npz or the v4 distributed dir
+            data, meta, gen = self._load_checkpoint_data(path)
             if int(meta.get("version", 1)) < 2:
                 return None          # v1 has no lane map to slice by
             lane_map = list(meta.get("lane_map") or [])
@@ -757,7 +825,6 @@ class SweepRunner:
                 return None          # not a GOOD slice: already bad
             done = int(meta.get("lane_done",
                                 [meta["iter"]] * len(lane_map))[j])
-            gen = data.pop("__genetics__", None)
             genetic = None
             if self._genetics is not None:
                 if gen is None:
@@ -807,29 +874,61 @@ class SweepRunner:
                 return rows, done, genetic, "checkpoint"
         return self._fresh_rows(cfg, attempt), 0, None, "fresh"
 
+    @staticmethod
+    def _edit_leaf_rows(stacked, rows: Dict[int, object]):
+        """Return `stacked` (dim0 = lanes) with the given rows
+        replaced. Addressable-shard writes: only the shards THIS
+        process holds are copied and re-uploaded — a row owned by
+        another host is that host's edit (the healing bookkeeping is
+        deterministic and identical on every process), and every
+        untouched shard keeps its device buffer, so healthy lanes are
+        byte-preserved structurally. A row value may be a callable
+        `fn(current_row) -> new_row` (in-place-style edits, e.g. the
+        driver's NaN-injection hook) — it only runs on the owner."""
+        bufs = []
+        for shard in stacked.addressable_shards:
+            s0 = shard.index[0]
+            lo = 0 if s0.start is None else int(s0.start)
+            hi = (stacked.shape[0] if s0.stop is None
+                  else int(s0.stop))
+            local = None
+            for lane, row in rows.items():
+                if not lo <= int(lane) < hi:
+                    continue
+                if local is None:
+                    local = np.array(shard.data)
+                if callable(row):
+                    row = row(local[int(lane) - lo])
+                local[int(lane) - lo] = np.asarray(row)
+            bufs.append(shard.data if local is None
+                        else jax.device_put(local, shard.device))
+        return jax.make_array_from_single_device_arrays(
+            stacked.shape, stacked.sharding, bufs)
+
     def _write_lanes(self, updates: Dict[int, Dict[str, np.ndarray]]):
         """Overwrite the given lanes' rows of every stacked state leaf
-        (host round-trip, device_put back with the existing sharding).
-        Untouched lanes are byte-preserved — the healthy-lane
-        bit-exactness contract survives a refill."""
+        via addressable-shard writes (_edit_leaf_rows). Untouched lanes
+        are byte-preserved — the healthy-lane bit-exactness contract
+        survives a refill — and on a pod mesh each process edits only
+        the rows its chips own (no cross-host gather on the hot
+        path)."""
         cur = self._state_arrays()
         placed = dict(cur)
         names = sorted({n for rows in updates.values() for n in rows})
         for name in names:
             stacked = cur[name]
-            w = np.array(stacked)
-            for lane, rows in updates.items():
-                if name not in rows:
+            rows = {}
+            for lane, lrows in updates.items():
+                if name not in lrows:
                     continue
-                row = np.asarray(rows[name])
-                if tuple(row.shape) != tuple(w.shape[1:]):
+                row = np.asarray(lrows[name])
+                if tuple(row.shape) != tuple(stacked.shape[1:]):
                     raise ValueError(
                         f"lane refill: leaf {name!r} row has shape "
                         f"{tuple(row.shape)}, expected "
-                        f"{tuple(w.shape[1:])}")
-                w[lane] = row
-            placed[name] = jax.device_put(jnp.asarray(w),
-                                          stacked.sharding)
+                        f"{tuple(stacked.shape[1:])}")
+                rows[int(lane)] = row
+            placed[name] = self._edit_leaf_rows(stacked, rows)
         self._set_state_arrays(placed)
 
     def _set_quarantine_bits(self, set_lanes=(), clear_lanes=()):
@@ -840,8 +939,7 @@ class SweepRunner:
             m[lane] = True
         for lane in clear_lanes:
             m[lane] = False
-        self.quarantine = jax.device_put(
-            jnp.asarray(m), self._replicated_sharding())
+        self.quarantine = global_put(m, self._replicated_sharding())
 
     def _cfg_budget_of(self, cfg: int) -> int:
         """The iteration budget of a config: its live-submission
@@ -849,14 +947,20 @@ class SweepRunner:
         h = self._healing
         return int(h.cfg_budget.get(int(cfg), h.budget))
 
-    def _lane_broken(self, lane: int) -> float:
-        """Broken-cell fraction of one lane's fault-state slice (the
-        single census definition: fault_engine.broken_fraction, which
-        reads the f32 lifetimes or the packed counter banks alike)."""
-        group = "life_q" if "life_q" in self.fault_states else "lifetimes"
-        sl = {group: {k: v[lane] for k, v in
-                      self.fault_states[group].items()}}
-        return float(fault_engine.broken_fraction(sl))
+    def _gather_full(self, v) -> np.ndarray:
+        """Full host value of one (possibly cross-process-sharded)
+        leaf. Local/replicated arrays fetch directly; a pod-sharded
+        leaf goes through a cached identity jit with replicated
+        out_shardings (the device all-gather) — a COLLECTIVE, so every
+        process must call this at the same point."""
+        if isinstance(v, jax.Array) and not (
+                v.is_fully_addressable or v.is_fully_replicated):
+            if self._rep_fn is None:
+                self._rep_fn = jax.jit(
+                    lambda x: x,
+                    out_shardings=self._replicated_sharding())
+            v = self._rep_fn(v)
+        return np.asarray(v)
 
     def _emit_retry(self, rec: dict):
         from ..observe import sink as obs_sink
@@ -893,6 +997,9 @@ class SweepRunner:
                       self._cfg_budget_of(h.lane_cfg[l])]
         if done_lanes:
             mask = np.asarray(self.quarantine)
+            # one vectorized census for the whole harvest (on a pod
+            # mesh it is a collective every process joins here)
+            bf = self.broken_fractions()
             lvals = None
             if losses is not None:
                 lv = np.asarray(losses)
@@ -908,7 +1015,7 @@ class SweepRunner:
                     "iter": int(self.iter), "lane": int(lane),
                     "loss": (float(lvals[lane])
                              if lvals is not None else None),
-                    "broken": self._lane_broken(lane)}
+                    "broken": float(bf[lane])}
                 if self.on_lane_complete is not None:
                     # service hook: the lane's state rows are still the
                     # completed config's — capture results BEFORE the
@@ -919,7 +1026,16 @@ class SweepRunner:
                 newly_benign.append(lane)
 
         # --- failure reclamation (quarantined lanes) ---
-        if self._reclaim_flag.is_set():
+        reclaim = self._reclaim_flag.is_set()
+        if self._multiproc:
+            # the flag is set by each process's OWN consumer thread,
+            # whose timing is not synchronized across hosts — agree
+            # globally so every process reclaims at the SAME chunk
+            # boundary (after the drain below, the laggard's consumer
+            # has processed the same chunks and its bookkeeping
+            # matches; one tiny allgather per boundary)
+            reclaim = multihost.process_any(reclaim)
+        if reclaim:
             if self._consumer is not None:
                 # barrier: the diagnosis/announce bookkeeping of every
                 # dispatched chunk must land before attempts are voided
@@ -1247,7 +1363,8 @@ class SweepRunner:
         vstep = jax.vmap(self._base_step,
                          in_axes=(0, 0, 0, 0, 0, 0, 0))
         self._vstep_virtual = self._make_quarantine_step(
-            vstep, self.n, self._replicated_sharding())
+            vstep, self.n, self._replicated_sharding(),
+            replicate_out=self._multiproc)
 
     def _make_chunk_run_virtual(self):
         """The scanned k-iteration run under per-lane virtual time
@@ -1336,20 +1453,38 @@ class SweepRunner:
             return self._run_chunk(k, *args)
 
     def bytes_per_step_est(self) -> int:
-        """Estimated HBM bytes one sweep iteration moves: every
-        resident state leaf (config-stacked params, momentum history,
-        fault banks, quarantine mask) is read and written once per
-        step, plus the batch-gather read from the device dataset.
-        Activations are excluded (shape-dependent and largely fused) —
-        the estimate tracks the RESIDENT-state floor the packed /
-        quantized engines attack, not total traffic. bench.py divides
-        it by the measured step time for the achieved-bandwidth-floor
-        figure in the BENCH trajectory."""
-        total = 2 * sum(int(v.nbytes)
-                        for v in self._state_arrays().values())
+        """Estimated PER-CHIP HBM bytes one sweep iteration moves:
+        every resident state leaf (config-stacked params, momentum
+        history, fault banks, quarantine mask) is read and written once
+        per step, plus the batch-gather read from the device dataset.
+        Under a config (and data) mesh, sharded leaves count only their
+        per-shard resident slice — dividing by the shard count keeps
+        the bandwidth estimate honest when the state is spread over N
+        chips. Activations are excluded (shape-dependent and largely
+        fused) — the estimate tracks the RESIDENT-state floor the
+        packed / quantized engines attack, not total traffic. bench.py
+        divides it by the measured step time for the
+        achieved-bandwidth-floor figure in the BENCH trajectory."""
+        cshards = int(self.mesh.shape.get("config", 1))
+        dshards = int(self.mesh.shape.get("data", 1))
+        total = 0
+        for name, v in self._state_arrays().items():
+            nb = int(v.nbytes)
+            if name != "quarantine":
+                # config-stacked leaf: each chip holds 1/cshards of
+                # the rows (the replicated quarantine mask does not)
+                nb = -(-nb // cshards)
+            total += nb
+        total *= 2
         if self._dataset is not None and self._ds_n:
-            total += sum(int(v.nbytes) // self._ds_n
-                         for v in self._dataset.values()) * self._ds_batch
+            batch_bytes = sum(
+                int(v.nbytes) // self._ds_n
+                for v in self._dataset.values()) * self._ds_batch
+            # rows shard over "data" when the mesh has that axis
+            # (_dataset_sharding); the gather read scales down with it
+            if self._batch_sharding is not None:
+                batch_bytes = -(-batch_bytes // dshards)
+            total += batch_bytes
         return int(total)
 
     def setup_record(self, setup_s: Optional[float] = None) -> dict:
@@ -1366,7 +1501,45 @@ class SweepRunner:
         self.setup.bytes_per_step = self.bytes_per_step_est()
         self.setup.fault_format = ("packed" if self._pack_spec is not None
                                    else "f32")
+        self.setup.config_shards = int(self.mesh.shape.get("config", 1))
         return self.setup.record(setup_s)
+
+    def _owned_config_block(self) -> tuple:
+        """The contiguous [lo, hi) block of the config axis this
+        process's mesh devices own. Contiguity is make_mesh's
+        (process_index, id) device-order invariant; a hand-built mesh
+        that interleaves processes along the config axis is refused
+        here rather than silently mis-sharded."""
+        ranges = owned_row_ranges(config_sharding(self.mesh, ndim=1),
+                                  self.n)
+        if not ranges:
+            raise ValueError(
+                "this process owns no 'config' rows of the sweep mesh "
+                f"(process {jax.process_index()} of "
+                f"{jax.process_count()}; mesh {dict(self.mesh.shape)})")
+        lo, hi = ranges[0][0], ranges[-1][1]
+        if any(ranges[i][1] != ranges[i + 1][0]
+               for i in range(len(ranges) - 1)):
+            raise ValueError(
+                "this process's config rows are not contiguous "
+                f"({ranges}): build the mesh with make_mesh (devices "
+                "sorted by (process_index, id)) so each host owns one "
+                "config-row block")
+        return int(lo), int(hi)
+
+    def _place_rows(self, tree):
+        """Assemble config-stacked global arrays from this process's
+        local row block (the pod-mesh twin of tp.place_trees: every
+        leaf P('config', None, ...), no host ever materializing the
+        full stack)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        lo, _ = self._cfg_rows
+
+        def put(a):
+            sh = NamedSharding(
+                self.mesh, P("config", *([None] * (np.ndim(a) - 1))))
+            return put_rows(np.asarray(a), lo, self.n, sh)
+        return jax.tree.map(put, tree)
 
     def _place_state(self):
         from .mesh import data_sharding
@@ -1381,6 +1554,12 @@ class SweepRunner:
             (lambda ndim, lead=0: data_sharding(self.mesh, ndim=ndim,
                                                 lead=lead))
             if has_config and has_data else None)
+        if self._multiproc:
+            (self.params, self.history, self.fault_states) = (
+                self._place_rows(self.params),
+                self._place_rows(self.history),
+                self._place_rows(self.fault_states))
+            return
         if has_config or has_model:
             # A "model" axis additionally shards the big FC weights
             # Megatron-style WITHIN each config shard (parallel/tp.py):
@@ -1405,8 +1584,8 @@ class SweepRunner:
         sharding (replicated, or rows over "data") — explicit so the
         AOT-lowered executable's input spec matches exactly."""
         self._dataset = {
-            name: jax.device_put(jnp.asarray(v),
-                                 self._dataset_sharding(np.ndim(v)))
+            name: global_put(np.asarray(v),
+                             self._dataset_sharding(np.ndim(v)))
             for name, v in self._dataset.items()}
 
     def _remap_due(self) -> bool:
@@ -1787,7 +1966,7 @@ class SweepRunner:
                 k = self._budget_chunk_cap(self._genetic_chunk_cap(
                     min(max(chunk, 1), iters - done)))
                 rep = self._replicated_sharding()
-                put = lambda v: jax.device_put(v, rep)
+                put = lambda v: global_put(v, rep)
                 if self._virtual_time:
                     # per-lane clocks: each occupied lane advances from
                     # its OWN progress counter; idle/benign lanes are
@@ -1846,6 +2025,9 @@ class SweepRunner:
                     lambda i: jax.random.fold_in(
                         jax.random.fold_in(s._key, self.iter), i))(
                             jnp.arange(self.n))
+                if self._multiproc:
+                    rngs = global_put(np.asarray(rngs),
+                                      self._replicated_sharding())
                 (self.params, self.history, self.fault_states,
                  self.quarantine, loss, outputs, mets) = self._step(
                     self.params, self.history, self.fault_states,
@@ -1876,11 +2058,15 @@ class SweepRunner:
             batches = self._placed(
                 {kk: np.stack([sb[kk] for sb in subs]) for kk in subs[0]},
                 stacked=True)
+            put = ((lambda v: global_put(np.asarray(v),
+                                         self._replicated_sharding()))
+                   if self._multiproc else jnp.asarray)
             (self.params, self.history, self.fault_states,
              self.quarantine, losses, outputs, mets) = self._run_chunk(
                 k, self.params, self.history, self.fault_states,
                 self.quarantine, batches,
-                jnp.asarray(its, jnp.int32), jnp.asarray(remaps))
+                put(np.asarray(its, np.int32)),
+                put(np.asarray(remaps)))
             self.last_metrics = jax.tree.map(lambda x: x[-1], mets)
             self._after_dispatch(k, self.iter - 1, losses, outputs, mets,
                                  self.quarantine)
@@ -1903,15 +2089,31 @@ class SweepRunner:
         thread (`background=False` writes inline with the same
         atomicity). `wait_for_writes()` is the barrier; a writer error
         is sticky and re-raises at the next save/wait."""
-        flat = fault_engine.state_to_arrays(self.fault_states)
+        # pod mode: the config-sharded leaves all-gather to every host
+        # (collective — all processes call this together); only process
+        # 0 then writes the file, so the artifact lands exactly once on
+        # the shared run directory
+        flat = {name: self._gather_full(v)
+                for name, v in fault_engine.iter_state_leaves(
+                    self.fault_states)}
         if self._pack_spec is not None:
             from ..fault import packed as fault_packed
             flat = fault_packed.convert_flat(flat, to_packed=False,
                                              spec=self._pack_spec)
-
         def write(tmp):
             with open(tmp, "wb") as f:
                 np.savez(f, **flat)
+
+        if self._multiproc:
+            # synchronous on a pod: the barrier guarantees the file is
+            # on disk (and thus safe for any process to read) before
+            # anyone proceeds
+            t0 = time.perf_counter()
+            if multihost.is_primary():
+                async_exec.atomic_write(path, write)
+                self._inline_write_s += time.perf_counter() - t0
+            multihost.barrier(f"faults:{os.path.basename(path)}")
+            return path
 
         if background:
             if self._bg_writer is None:
@@ -1962,34 +2164,10 @@ class SweepRunner:
             for group, tree in self.fault_states.items()}
         self.quarantine = arrays["quarantine"]
 
-    def checkpoint(self, path: str, background: bool = False,
-                   _drain: bool = True) -> str:
-        """Capture the FULL resumable sweep state to `path` (.npz):
-        stacked params, solver histories, fault state, quarantine mask,
-        iteration, the solver RNG key (per-config stream roots),
-        genetic-strategy state, and — format v2 — the self-healing
-        layer's lane->config map, per-lane progress, retry counters,
-        and pending-config work queue. The async pipeline is drained to
-        a consistent chunk boundary first and any queued background
-        writes/snapshots land before the capture, so the file is always
-        a clean boundary; the write itself goes through the temp-file +
-        atomic-rename path (on the BackgroundWriter thread with
-        `background=True`), so a crash mid-write can never leave a
-        truncated checkpoint under the final name. `restore(path)` on a
-        runner built with the SAME configuration resumes BIT-EXACTLY
-        (scripts/check_resume_equivalence.py is the CI guard).
-        `_drain=False` is the stall-abort escape hatch: skip every
-        barrier that could block on a stuck thread and capture the
-        dispatcher's (consistent) device state as-is."""
-        import json as _json
-        import pickle
-        if _drain:
-            if self._consumer is not None:
-                self.pipeline.drain_s += self._consumer.drain()
-            self.wait_for_writes()
-            self.solver.wait_for_snapshots()
-        arrays = {name: np.asarray(v)
-                  for name, v in self._state_arrays().items()}
+    def _ckpt_meta(self) -> dict:
+        """The checkpoint meta block (shared by the single-file layout,
+        where it rides as the __meta__ array, and the distributed
+        layout, where it is manifest.json's "meta")."""
         h = self._healing
         meta = {"version": CHECKPOINT_VERSION, "iter": int(self.iter),
                 "n_configs": int(self.n),
@@ -2020,6 +2198,61 @@ class SweepRunner:
             # while the consumer thread may still own the dict)
             meta["healing"]["quar_diag"] = {
                 str(k): v for k, v in dict(self._quar_diag).items()}
+        return meta
+
+    def _ckpt_drain(self):
+        """The consistency barriers every checkpoint capture takes: the
+        async pipeline drained to a chunk boundary, queued background
+        writes and solver snapshots landed."""
+        if self._consumer is not None:
+            self.pipeline.drain_s += self._consumer.drain()
+        self.wait_for_writes()
+        self.solver.wait_for_snapshots()
+
+    def checkpoint(self, path: str, background: bool = False,
+                   _drain: bool = True,
+                   distributed: Optional[bool] = None) -> str:
+        """Capture the FULL resumable sweep state to `path`: stacked
+        params, solver histories, fault state, quarantine mask,
+        iteration, the solver RNG key (per-config stream roots),
+        genetic-strategy state, and the self-healing layer's
+        lane->config map, per-lane progress, retry counters, and
+        pending-config work queue. The async pipeline is drained to a
+        consistent chunk boundary first and any queued background
+        writes/snapshots land before the capture; every write goes
+        through the temp-file + atomic-rename path (on the
+        BackgroundWriter thread with `background=True`), so a crash
+        mid-write can never leave a truncated checkpoint under the
+        final name.
+
+        Layout (`distributed`, default = whether the mesh spans
+        processes): False writes ONE `.npz` file; True writes a
+        checkpoint DIRECTORY at `path` — per-process `shard_NNNNN.npz`
+        row blocks of every config-sharded leaf, a `global.npz` with
+        the replicated leaves (quarantine mask, genetic state), and a
+        `manifest.json` (written LAST after a cross-process barrier:
+        the commit record — a directory without it is an aborted
+        write). Distributed captures are synchronous (`background` is
+        ignored) and collective: every process must call together.
+
+        `restore(path)` on a runner built with the SAME configuration
+        resumes BIT-EXACTLY on ANY config-shard topology — a checkpoint
+        taken on 8 chips restores onto 4 or 1 and vice versa
+        (scripts/check_resume_equivalence.py and check_pod_sweep.py are
+        the CI guards). `_drain=False` is the stall-abort escape hatch:
+        skip every barrier that could block on a stuck thread and
+        capture the dispatcher's (consistent) device state as-is."""
+        import json as _json
+        import pickle
+        if distributed is None:
+            distributed = self._multiproc
+        if distributed:
+            return self._checkpoint_distributed(path, _drain=_drain)
+        if _drain:
+            self._ckpt_drain()
+        arrays = {name: self._gather_full(v)
+                  for name, v in self._state_arrays().items()}
+        meta = self._ckpt_meta()
         arrays["__meta__"] = np.frombuffer(
             _json.dumps(meta).encode(), np.uint8)
         if self._genetics is not None:
@@ -2032,7 +2265,26 @@ class SweepRunner:
             with open(tmp, "wb") as f:
                 np.savez(f, **arrays)
 
-        if background:
+        if os.path.isdir(path) and (not self._multiproc
+                                    or multihost.is_primary()):
+            # same-path overwrite across layouts: a resume onto a
+            # different topology can leave the PREVIOUS topology's
+            # distributed directory here, which os.replace cannot
+            # clobber with a file (a crash in the gap below restarts
+            # the group from scratch — the driver handles a missing
+            # checkpoint; on a pod only the writing process clears)
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        if self._multiproc:
+            # distributed=False on a pod: full gather above, one file,
+            # written by process 0 behind a commit barrier
+            t0 = time.perf_counter()
+            if multihost.is_primary():
+                async_exec.atomic_write(path, write)
+                self.pipeline.checkpoint_write_s += (
+                    time.perf_counter() - t0)
+            multihost.barrier(f"ckpt:{os.path.basename(path)}")
+        elif background:
             if self._bg_writer is None:
                 self._bg_writer = async_exec.BackgroundWriter()
             self._bg_writer.submit(path, write)
@@ -2045,21 +2297,158 @@ class SweepRunner:
         self._last_ckpt_path = path
         return path
 
-    def restore(self, path: str):
-        """Load a `checkpoint()` file into this runner. The runner must
-        have been built with the same configuration (n_configs, solver
-        seed, strategy mix) — mismatches raise instead of silently
-        diverging. Takes the background-write and snapshot barriers
-        first, so restoring while a queued checkpoint/snapshot is still
-        in flight can never read a half-landed file. Every leaf is
-        device-placed with the runner's existing sharding, so resume
-        works unchanged under (config, data, model) meshes."""
+    def _owned_rows_host(self, stacked, lo: int, hi: int) -> np.ndarray:
+        """Host copy of rows [lo, hi) of a dim0-sharded leaf, read from
+        this process's addressable shards only (replicas — the "data"
+        axis — collapse to one copy)."""
+        out = np.empty((hi - lo,) + tuple(stacked.shape[1:]),
+                       dtype=stacked.dtype)
+        filled = np.zeros(hi - lo, dtype=bool)
+        for shard in stacked.addressable_shards:
+            s0 = shard.index[0]
+            a = 0 if s0.start is None else int(s0.start)
+            b = stacked.shape[0] if s0.stop is None else int(s0.stop)
+            if a < lo or b > hi or filled[a - lo:b - lo].all():
+                continue
+            out[a - lo:b - lo] = np.asarray(shard.data)
+            filled[a - lo:b - lo] = True
+        if not filled.all():
+            raise ValueError(
+                f"rows [{lo}, {hi}) not fully covered by this "
+                "process's shards — distributed checkpoints need the "
+                "contiguous-block config layout make_mesh guarantees")
+        return out
+
+    def _checkpoint_distributed(self, path: str,
+                                _drain: bool = True) -> str:
+        """The v4 distributed layout: this process writes its own
+        config-row block of every sharded leaf as `shard_NNNNN.npz`
+        under the checkpoint DIRECTORY `path`; process 0 adds
+        `global.npz` (replicated leaves) and — after the all-shards
+        barrier — `manifest.json`, the commit record carrying the meta
+        block and the shard->rows index. Collective."""
         import json as _json
         import pickle
-        if self._consumer is not None:
-            self.pipeline.drain_s += self._consumer.drain()
-        self.wait_for_writes()
-        self.solver.wait_for_snapshots()
+        if "model" in self.mesh.axis_names:
+            raise ValueError(
+                "distributed checkpoints support 'config'/'data' "
+                "meshes only (TP weight-dim shards have no row-block "
+                "layout); use distributed=False")
+        if _drain:
+            self._ckpt_drain()
+        t0 = time.perf_counter()
+        lo, hi = (self._cfg_rows if self._cfg_rows is not None
+                  else (0, self.n))
+        leaves = self._state_arrays()
+        shard_arrays = {name: self._owned_rows_host(v, lo, hi)
+                        for name, v in leaves.items()
+                        if name != "quarantine"}
+        meta = self._ckpt_meta()
+        if self._multiproc:
+            from jax.experimental import multihost_utils
+            blocks = np.asarray(multihost_utils.process_allgather(
+                np.asarray([lo, hi], dtype=np.int64)))
+        else:
+            blocks = np.asarray([[lo, hi]], dtype=np.int64)
+        shards = [{"file": f"shard_{p:05d}.npz",
+                   "rows": [int(b[0]), int(b[1])]}
+                  for p, b in enumerate(blocks)]
+        if os.path.isfile(path):
+            # the inverse overwrite: a single-file checkpoint from a
+            # previous topology occupies the directory's name
+            if not self._multiproc or multihost.is_primary():
+                os.remove(path)
+            multihost.barrier(f"ckpt-clear:{os.path.basename(path)}")
+        os.makedirs(path, exist_ok=True)
+        pid = jax.process_index() if self._multiproc else 0
+
+        def write_shard(tmp):
+            with open(tmp, "wb") as f:
+                np.savez(f, **shard_arrays)
+
+        async_exec.atomic_write(
+            os.path.join(path, shards[pid]["file"]), write_shard)
+        if not self._multiproc or multihost.is_primary():
+            global_arrays = {
+                "quarantine": np.asarray(leaves["quarantine"])}
+            if self._genetics is not None:
+                global_arrays["__genetics__"] = np.frombuffer(
+                    pickle.dumps(self._genetics), np.uint8)
+
+            def write_global(tmp):
+                with open(tmp, "wb") as f:
+                    np.savez(f, **global_arrays)
+
+            async_exec.atomic_write(os.path.join(path, "global.npz"),
+                                    write_global)
+        # every shard (and global.npz) is on disk before the commit
+        # record names them; a second barrier keeps any process from
+        # racing ahead to read a manifest that is not there yet
+        multihost.barrier(f"ckpt-shards:{os.path.basename(path)}")
+        if not self._multiproc or multihost.is_primary():
+            manifest = {"meta": meta, "shards": shards,
+                        "leaves": sorted(shard_arrays)}
+
+            def write_manifest(tmp):
+                with open(tmp, "w") as f:
+                    _json.dump(manifest, f, indent=2)
+
+            async_exec.atomic_write(os.path.join(path, "manifest.json"),
+                                    write_manifest)
+        multihost.barrier(f"ckpt-commit:{os.path.basename(path)}")
+        self.pipeline.checkpoint_write_s += time.perf_counter() - t0
+        self._last_ckpt_path = path
+        return path
+
+    @staticmethod
+    def _load_checkpoint_data(path: str):
+        """(arrays, meta, genetics_bytes_or_None) from either
+        checkpoint layout: the single `.npz` file, or the v4
+        distributed directory — whose shard row blocks are assembled
+        back into full arrays here, which is what makes restore
+        topology-free (resharding = reading the same full arrays onto
+        a different mesh)."""
+        import json as _json
+        if os.path.isdir(path):
+            mpath = os.path.join(path, "manifest.json")
+            if not os.path.exists(mpath):
+                raise ValueError(
+                    f"{path} is not a committed distributed checkpoint "
+                    "(missing manifest.json — the write was interrupted "
+                    "before the commit record landed)")
+            with open(mpath) as f:
+                manifest = _json.load(f)
+            meta = manifest["meta"]
+            pieces: Dict[str, list] = {}
+            for sh in manifest["shards"]:
+                lo = int(sh["rows"][0])
+                with np.load(os.path.join(path, sh["file"])) as z:
+                    for name in z.files:
+                        pieces.setdefault(name, []).append((lo, z[name]))
+            data = {}
+            for name, blocks in pieces.items():
+                blocks.sort(key=lambda b: b[0])
+                off = 0
+                for b_lo, b_arr in blocks:
+                    if b_lo != off:
+                        raise ValueError(
+                            f"distributed checkpoint {path}: leaf "
+                            f"{name!r} rows are not a contiguous "
+                            f"partition (gap at row {off})")
+                    off += b_arr.shape[0]
+                data[name] = (blocks[0][1] if len(blocks) == 1 else
+                              np.concatenate([b[1] for b in blocks],
+                                             axis=0))
+            gen = None
+            gp = os.path.join(path, "global.npz")
+            if os.path.exists(gp):
+                with np.load(gp) as z:
+                    for name in z.files:
+                        if name == "__genetics__":
+                            gen = z[name]
+                        else:
+                            data[name] = z[name]
+            return data, meta, gen
         with np.load(path) as z:
             data = {k: z[k] for k in z.files}
         raw = data.pop("__meta__", None)
@@ -2067,15 +2456,36 @@ class SweepRunner:
             raise ValueError(f"{path} is not a SweepRunner checkpoint "
                              "(missing __meta__)")
         meta = _json.loads(bytes(bytearray(raw)).decode())
+        return data, meta, data.pop("__genetics__", None)
+
+    def restore(self, path: str):
+        """Load a `checkpoint()` into this runner — the single `.npz`
+        file or the v4 distributed directory alike. The runner must
+        have been built with the same configuration (n_configs, solver
+        seed, strategy mix) — mismatches raise instead of silently
+        diverging — but NOT the same topology: every leaf is re-placed
+        with THIS runner's shardings (resharding on resume), so a
+        checkpoint written on an 8-chip config mesh restores onto 4
+        chips, 1 chip, or a different process count with bit-exact
+        continuation. Takes the background-write and snapshot barriers
+        first, so restoring while a queued checkpoint/snapshot is still
+        in flight can never read a half-landed file."""
+        import pickle
+        if self._consumer is not None:
+            self.pipeline.drain_s += self._consumer.drain()
+        self.wait_for_writes()
+        self.solver.wait_for_snapshots()
+        data, meta, gen = self._load_checkpoint_data(path)
         found = meta.get("version")
-        if found not in (1, 2, CHECKPOINT_VERSION):
+        if found not in (1, 2, 3, CHECKPOINT_VERSION):
             raise ValueError(
                 f"checkpoint {path} has format version {found!r} but "
                 f"this build expects version {CHECKPOINT_VERSION} "
-                "(v1/v2 checkpoints are upgraded in place: v1 has no "
-                "lane map, so the identity lane->config mapping is "
-                "assumed; v1/v2 fault leaves are f32 and convert to "
-                "this runner's fault format on load)")
+                "(v1/v2/v3 checkpoints are upgraded in place: v1 has "
+                "no lane map, so the identity lane->config mapping is "
+                "assumed; pre-v3 fault leaves are f32 and convert to "
+                "this runner's fault format on load; v4 adds the "
+                "distributed directory layout)")
         if int(meta["n_configs"]) != self.n:
             raise ValueError(
                 f"checkpoint {path} holds {meta['n_configs']} configs "
@@ -2095,7 +2505,6 @@ class SweepRunner:
                 f"runner has virtual_time={self._virtual_time}; the "
                 "per-lane clock changes the batch/RNG timeline, so "
                 "resume with the same enable_self_healing mode")
-        gen = data.pop("__genetics__", None)
         if (gen is None) != (self._genetics is None):
             raise ValueError(
                 f"checkpoint {path} and this runner disagree on the "
@@ -2142,8 +2551,13 @@ class SweepRunner:
                 raise ValueError(
                     f"checkpoint {path}: leaf {name!r} has shape "
                     f"{tuple(arr.shape)}, expected {tuple(cur.shape)}")
-            placed[name] = jax.device_put(jnp.asarray(arr, cur.dtype),
-                                          cur.sharding)
+            # global_put = device_put on a local mesh, per-process shard
+            # assembly on a pod mesh — the resharding step: whatever
+            # topology wrote the checkpoint, the full host arrays land
+            # under THIS runner's shardings
+            placed[name] = global_put(
+                np.asarray(arr).astype(cur.dtype, copy=False),
+                cur.sharding)
         self._set_state_arrays(placed)
         self.iter = int(meta["iter"])
         self._quar_seen = {int(i) for i in meta.get("quarantined", [])}
@@ -2239,17 +2653,29 @@ class SweepRunner:
         dim shards over "data". Leading chunk and iter_size axes (when
         present) stay unsharded in front of it."""
         if self._batch_sharding is None:
-            return {k: jnp.asarray(v) for k, v in batch.items()}
+            if not self._multiproc:
+                return {k: jnp.asarray(v) for k, v in batch.items()}
+            # pod host feed: every process reads the same stream, so
+            # the batch replicates over the whole mesh
+            rep = self._replicated_sharding()
+            return {k: global_put(np.asarray(v), rep)
+                    for k, v in batch.items()}
         lead = (1 if stacked else 0) + (
             1 if max(self.solver.param.iter_size, 1) > 1 else 0)
-        return {k: jax.device_put(
-            jnp.asarray(v), self._batch_sharding(jnp.asarray(v).ndim, lead))
+        return {k: global_put(
+            np.asarray(v), self._batch_sharding(np.ndim(v), lead))
             for k, v in batch.items()}
 
     def broken_fractions(self) -> np.ndarray:
-        """Per-config broken-cell census."""
-        return np.asarray(jax.vmap(fault_engine.broken_fraction)(
-            self.fault_states))
+        """Per-config broken-cell census. Jitted with replicated
+        out_shardings: on a pod mesh the (n,) vector is all-gathered so
+        every process reads the full census (a collective — call from
+        the same point on every process)."""
+        if self._bf_fn is None:
+            self._bf_fn = jax.jit(
+                jax.vmap(fault_engine.broken_fraction),
+                out_shardings=self._replicated_sharding())
+        return np.asarray(self._bf_fn(self.fault_states))
 
     def sentinel_state(self):
         """Per-config numeric-health sentinel summaries from the last
@@ -2287,8 +2713,12 @@ class SweepRunner:
             def run(p, b):
                 blobs, _ = net.apply(p, b, adc_bits=adc_bits)
                 return {n: blobs[n] for n in net.output_names}
+            # pod mode: per-config outputs all-gather so every process
+            # reads the full vectors
             self._eval_fns[id(net)] = jax.jit(
-                jax.vmap(run, in_axes=(0, None)))
+                jax.vmap(run, in_axes=(0, None)),
+                out_shardings=(self._replicated_sharding()
+                               if self._multiproc else None))
         out = self._eval_fns[id(net)](self.params, batch)
         return {k: np.asarray(v) for k, v in out.items()}
 

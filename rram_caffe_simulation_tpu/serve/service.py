@@ -113,6 +113,7 @@ class SweepService:
                  socket_path: Optional[str] = "",
                  allow_inject: bool = False,
                  save_fault_results: bool = False,
+                 mesh=None,
                  runner_kw: Optional[dict] = None):
         from ..observe import JsonlSink
         from ..parallel import SweepRunner
@@ -174,8 +175,18 @@ class SweepService:
         self.solver.enable_metrics(JsonlSink(
             os.path.join(self.dir, "metrics.jsonl"), append=resuming,
             unbuffered=True))
+        # `mesh` lays the lane pool's config axis over a device mesh
+        # (make_mesh({"config": N}) or a parse_mesh_shape spec string):
+        # the service's N warm lanes then live as ONE config-sharded
+        # GSPMD program over N chips — same request/packing semantics,
+        # N x the resident pool per host. virtual_time requires a
+        # config-only mesh (the runner validates).
+        if isinstance(mesh, str):
+            from ..parallel import mesh_from_spec
+            mesh = mesh_from_spec(mesh)
         self.runner = SweepRunner(self.solver, n_configs=int(lanes),
                                   pipeline_depth=int(pipeline_depth),
+                                  mesh=mesh,
                                   **(runner_kw or {}))
         self.runner.enable_self_healing(
             budget=self.default_iters, max_retries=int(max_retries),
@@ -1036,6 +1047,11 @@ def main(argv=None) -> int:
                         "rows to requests/<id>.cfg<N>.faults.npz "
                         "(the byte-identity evidence the CI guard "
                         "compares)")
+    p.add_argument("--mesh", default="",
+                   help="config mesh for the lane pool, e.g. "
+                        "'config=4' or 'config=all' — the warm lanes "
+                        "shard over that many local chips as one "
+                        "GSPMD program; empty = single device")
     args = p.parse_args(argv)
 
     weights = {}
@@ -1054,7 +1070,8 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth,
         socket_path=None if args.no_socket else "",
         allow_inject=args.allow_inject,
-        save_fault_results=args.save_fault_results)
+        save_fault_results=args.save_fault_results,
+        mesh=args.mesh or None)
 
     def _on_signal(signum, frame):
         service.drain()

@@ -1,0 +1,415 @@
+#!/usr/bin/env python
+"""CI guard for pod-scale sweeps (ISSUE 9): the config axis sharded
+across a REAL 2-process jax.distributed cluster (gloo CPU collectives,
+2 virtual devices per process -> 4 global) must be indistinguishable
+from the single-process 4-device run of the same specs.
+
+Three checks, all through the real multi-group driver
+(`examples/gaussian_failure/run_1000_sweep.py`):
+
+1. **Sharded == local, byte for byte**: run the same tiny LMDB sweep
+   once single-process (4 virtual devices, mesh config=4) and once as
+   two spawned processes (2 devices each, the SAME global config=4
+   mesh assembled across hosts), with a NaN injected into one config so
+   the self-healing retry/refill path crosses the process boundary
+   (addressable-shard lane writes). Diff EVERYTHING durable: journal
+   group records, per-process metrics JSONL (which must also agree
+   BETWEEN the two processes), per-group fault-state .npz, and
+   sweep_report.json — timing fields excluded, everything else exact.
+
+2. **Coordinated SIGTERM drain**: send SIGTERM to ONE of the two
+   processes mid-run; the preempt flag must propagate (allgather at the
+   poll boundary) so BOTH processes drain at the same chunk boundary,
+   write one v4 DISTRIBUTED group checkpoint (per-process shard files
+   under a committed manifest.json), and exit 75 (EX_TEMPFAIL).
+
+3. **Resume across the preemption**: `--resume` the killed run with the
+   same 2-process topology and diff it against the uninterrupted run —
+   journal, metrics, fault npz, and report byte-identical (the v4
+   restore + journal/exit-code semantics preserved multi-process).
+
+    python scripts/check_pod_sweep.py
+
+Exit status: 0 = sharded run bit-exact and drain coordinated, 1 = any
+divergence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DRIVER = os.path.join(_REPO, "examples", "gaussian_failure",
+                      "run_1000_sweep.py")
+PREEMPTED_EXIT = 75
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s",
+                 "wall_seconds", "setup_overlap_seconds",
+                 "host_blocked_seconds", "checkpoint_write_seconds")
+
+ITERS = 240
+CHUNK = 10
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _write_solver(path: str, db: str):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+net_param {{
+  name: "podguard"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+def _base_args(solver: str, ckpt_every: int = 0):
+    args = [sys.executable, DRIVER, "--solver", solver,
+            "--configs", "4", "--group", "4", "--block", "0",
+            "--iters", str(ITERS), "--chunk", str(CHUNK),
+            "--mean", "300", "--std", "60", "--pipeline-depth", "2",
+            "--no-overlap", "--max-retries", "1",
+            "--inject-nan", "1@40"]
+    if ckpt_every:
+        args += ["--checkpoint-every", str(ckpt_every)]
+    return args
+
+
+def _run_single(solver: str, run_dir: str, ckpt_every: int = 0,
+                devices: int = 4):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count"
+                         f"={devices}")
+    return subprocess.run(
+        _base_args(solver, ckpt_every) + ["--run-dir", run_dir],
+        env=env, capture_output=True, text=True)
+
+
+def _spawn_pair(solver: str, run_flag: str, run_dir: str,
+                ckpt_every: int = 0):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    return [subprocess.Popen(
+        _base_args(solver, ckpt_every)
+        + [run_flag, run_dir, "--coordinator", coord,
+           "--num-processes", "2", "--process-id", str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+
+
+def _read_jsonl(path: str):
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    return recs
+
+
+def _strip(recs):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in recs]
+
+
+def _diff_runs(dir_single: str, dir_pod: str, failures: list,
+               label: str, pod_metrics: bool = True):
+    """Journal group records, metrics streams, fault npz, and
+    sweep_report must be byte-identical between two run dirs
+    (`pod_metrics` picks the second dir's metrics layout: per-process
+    .pN files for a 2-process run, the plain file otherwise)."""
+    import numpy as np
+    ja = [r for r in _read_jsonl(os.path.join(dir_single,
+                                              "journal.jsonl"))
+          if r.get("event") == "group"]
+    jb = [r for r in _read_jsonl(os.path.join(dir_pod, "journal.jsonl"))
+          if r.get("event") == "group"]
+    if not ja:
+        failures.append(f"{label}: reference journal has no group "
+                        "records (vacuous diff)")
+    if _strip(ja) != _strip(jb):
+        failures.append(f"{label}: journal group records diverge:\n"
+                        f"  a: {_strip(ja)!r}\n"
+                        f"  b: {_strip(jb)!r}")
+    ma = _read_jsonl(os.path.join(dir_single, "metrics_g0.jsonl"))
+    if not ma:
+        failures.append(f"{label}: reference metrics_g0 empty "
+                        "(vacuous diff)")
+    if pod_metrics:
+        mb0 = _read_jsonl(os.path.join(dir_pod, "metrics_g0.p0.jsonl"))
+        mb1 = _read_jsonl(os.path.join(dir_pod, "metrics_g0.p1.jsonl"))
+        if _strip(mb0) != _strip(mb1):
+            failures.append(f"{label}: the two processes' metrics "
+                            f"streams disagree ({len(mb0)} vs "
+                            f"{len(mb1)} records)")
+    else:
+        mb0 = _read_jsonl(os.path.join(dir_pod, "metrics_g0.jsonl"))
+    if _strip(ma) != _strip(mb0):
+        failures.append(f"{label}: metrics diverge from the reference "
+                        f"run ({len(ma)} vs {len(mb0)} records)")
+    fa = os.path.join(dir_single, "group_0_faults.npz")
+    fb = os.path.join(dir_pod, "group_0_faults.npz")
+    if not (os.path.exists(fa) and os.path.exists(fb)):
+        failures.append(f"{label}: missing fault npz "
+                        f"({fa if not os.path.exists(fa) else fb})")
+    else:
+        with np.load(fa) as za, np.load(fb) as zb:
+            if sorted(za.files) != sorted(zb.files):
+                failures.append(f"{label}: fault npz key sets differ")
+            else:
+                for name in za.files:
+                    if za[name].tobytes() != zb[name].tobytes():
+                        failures.append(
+                            f"{label}: fault leaf {name!r} not "
+                            "byte-identical across topologies")
+    ra = json.load(open(os.path.join(dir_single, "sweep_report.json")))
+    rb = json.load(open(os.path.join(dir_pod, "sweep_report.json")))
+    if ra != rb:
+        failures.append(f"{label}: sweep_report.json diverges")
+
+
+def _check_sharded_equals_local(work: str, solver: str, failures: list):
+    dir_one = os.path.join(work, "run_onechip")
+    dir_single = os.path.join(work, "run_single")
+    dir_pod = os.path.join(work, "run_pod")
+
+    # the acceptance reference: ONE device, the plain vmapped sweep
+    r = _run_single(solver, dir_one, devices=1)
+    if r.returncode != 0:
+        failures.append(f"single-device run failed ({r.returncode}):\n"
+                        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+    r = _run_single(solver, dir_single)
+    if r.returncode != 0:
+        failures.append(f"single-process run failed ({r.returncode}):\n"
+                        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+    # config=4 sharded over 4 local devices == the 1-device vmapped run
+    _diff_runs(dir_one, dir_single, failures, "sharded-vs-onechip",
+               pod_metrics=False)
+    if failures:
+        return
+    procs = _spawn_pair(solver, "--run-dir", dir_pod)
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            failures.append("pod run timed out (deadlocked "
+                            "collective?)")
+            return
+        logs.append(out)
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            failures.append(f"pod process {i} exited {p.returncode}:\n"
+                            f"{logs[i][-2000:]}")
+    if failures:
+        return
+    # the injected config must actually have crossed the retry path —
+    # otherwise the cross-process lane-refill write went unexercised
+    report = json.load(open(os.path.join(dir_pod, "sweep_report.json")))
+    if 1 not in report.get("retried", []):
+        failures.append("pod run: injected config 1 was never retried "
+                        f"(report retried={report.get('retried')!r}) — "
+                        "the cross-process lane-refill path went "
+                        "unexercised")
+    _diff_runs(dir_single, dir_pod, failures, "sharded-vs-local")
+    if not failures:
+        n = len(_read_jsonl(os.path.join(dir_pod,
+                                         "metrics_g0.p0.jsonl")))
+        print("pod sweep OK: 2-process config-sharded run byte-"
+              f"identical to single-process ({n} records compared, "
+              "injected config retried to completion)")
+
+
+def _check_preempt_resume(work: str, solver: str, failures: list):
+    dir_ref = os.path.join(work, "resume_ref")
+    dir_kill = os.path.join(work, "resume_kill")
+
+    # uninterrupted 2-process reference
+    procs = _spawn_pair(solver, "--run-dir", dir_ref, ckpt_every=40)
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        if p.returncode != 0:
+            failures.append(f"reference pod run: process {i} exited "
+                            f"{p.returncode}:\n{out[-2000:]}")
+    if failures:
+        return
+
+    # killed run: SIGTERM ONE process once group 0 is emitting records
+    procs = _spawn_pair(solver, "--run-dir", dir_kill, ckpt_every=40)
+    metrics0 = os.path.join(dir_kill, "metrics_g0.p0.jsonl")
+    deadline = time.monotonic() + 420
+    signaled = False
+    while time.monotonic() < deadline and procs[0].poll() is None:
+        if len(_read_jsonl(metrics0)) >= 2:
+            procs[0].send_signal(signal.SIGTERM)   # ONE process only
+            signaled = True
+            break
+        time.sleep(0.025)
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            failures.append("killed pod run hung after SIGTERM — the "
+                            "preempt flag did not propagate to the "
+                            "peer process")
+            return
+        logs.append(out)
+    if not signaled:
+        failures.append("never saw group 0 chunk records; SIGTERM not "
+                        f"sent (rcs {[p.returncode for p in procs]}):\n"
+                        f"{logs[0][-2000:]}")
+        return
+    for i, p in enumerate(procs):
+        if p.returncode != PREEMPTED_EXIT:
+            failures.append(
+                f"process {i} exited {p.returncode} after the "
+                f"(coordinated) preemption, expected {PREEMPTED_EXIT}"
+                f":\n{logs[i][-2000:]}")
+    if failures:
+        return
+    preempts = [r for r in _read_jsonl(os.path.join(dir_kill,
+                                                    "journal.jsonl"))
+                if r.get("event") == "preempt"]
+    if not preempts:
+        failures.append("killed run journaled no preempt event")
+        return
+    ck = preempts[-1].get("checkpoint")
+    if ck:
+        ck_path = os.path.join(dir_kill, ck)
+        if not os.path.isdir(ck_path):
+            failures.append(f"pod checkpoint {ck!r} is not a v4 "
+                            "distributed directory")
+        else:
+            names = sorted(os.listdir(ck_path))
+            for want in ("manifest.json", "shard_00000.npz",
+                         "shard_00001.npz"):
+                if want not in names:
+                    failures.append(
+                        f"distributed checkpoint missing {want} "
+                        f"(has {names})")
+
+    # resume with the same 2-process topology
+    procs = _spawn_pair(solver, "--resume", dir_kill, ckpt_every=40)
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        if p.returncode != 0:
+            failures.append(f"resumed pod run: process {i} exited "
+                            f"{p.returncode}:\n{out[-2000:]}")
+    if failures:
+        return
+    _diff_runs_pod_pod(dir_ref, dir_kill, failures)
+    if not failures:
+        it = preempts[-1].get("iter")
+        print("pod preemption OK: SIGTERM to one process drained both "
+              f"at iter {it}, v4 distributed checkpoint committed, "
+              "resume byte-identical to uninterrupted")
+
+
+def _diff_runs_pod_pod(dir_a: str, dir_b: str, failures: list):
+    import numpy as np
+    ja = [r for r in _read_jsonl(os.path.join(dir_a, "journal.jsonl"))
+          if r.get("event") == "group"]
+    jb = [r for r in _read_jsonl(os.path.join(dir_b, "journal.jsonl"))
+          if r.get("event") == "group"]
+    if _strip(ja) != _strip(jb):
+        failures.append("resume: journal group records diverge from "
+                        "the uninterrupted pod run")
+    for proc in (0, 1):
+        ma = _read_jsonl(os.path.join(dir_a, f"metrics_g0.p{proc}.jsonl"))
+        mb = _read_jsonl(os.path.join(dir_b, f"metrics_g0.p{proc}.jsonl"))
+        if not ma:
+            failures.append(f"resume: reference metrics p{proc} empty "
+                            "(vacuous diff)")
+        if _strip(ma) != _strip(mb):
+            failures.append(
+                f"resume: process {proc} metrics diverge "
+                f"({len(ma)} vs {len(mb)} records)")
+    fa = os.path.join(dir_a, "group_0_faults.npz")
+    fb = os.path.join(dir_b, "group_0_faults.npz")
+    with np.load(fa) as za, np.load(fb) as zb:
+        for name in za.files:
+            if za[name].tobytes() != zb[name].tobytes():
+                failures.append(f"resume: fault leaf {name!r} not "
+                                "byte-identical after resume")
+    ra = json.load(open(os.path.join(dir_a, "sweep_report.json")))
+    rb = json.load(open(os.path.join(dir_b, "sweep_report.json")))
+    if ra != rb:
+        failures.append("resume: sweep_report.json diverges")
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="pod_sweep_guard_")
+    failures: list = []
+    try:
+        db = os.path.join(work, "db")
+        solver = os.path.join(work, "solver.prototxt")
+        _build_db(db)
+        _write_solver(solver, db)
+        _check_sharded_equals_local(work, solver, failures)
+        if not failures:
+            _check_preempt_resume(work, solver, failures)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print("pod-sweep guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

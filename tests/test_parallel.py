@@ -23,6 +23,35 @@ def test_mesh_construction():
     assert mesh2.axis_names == ("config", "data")
 
 
+def test_make_mesh_device_order_deterministic():
+    """The multi-host invariant (ISSUE 9 satellite): devices are laid
+    into the mesh sorted by (process_index, id), whatever order the
+    caller hands them in — every process of a pod assembles the
+    IDENTICAL mesh, and each process's devices form one contiguous
+    block of the flattened mesh (the distributed-checkpoint row
+    layout)."""
+    devs = list(jax.devices())
+    shuffled = [devs[i] for i in (3, 0, 7, 5, 1, 6, 2, 4)]
+    mesh = make_mesh({"config": 8}, devices=shuffled)
+    laid = list(np.asarray(mesh.devices).ravel())
+    assert laid == sorted(devs, key=lambda d: (d.process_index, d.id))
+    # same order regardless of input permutation
+    mesh2 = make_mesh({"config": 8}, devices=list(reversed(devs)))
+    assert list(np.asarray(mesh2.devices).ravel()) == laid
+
+
+def test_parse_mesh_shape():
+    from rram_caffe_simulation_tpu.parallel import parse_mesh_shape
+    assert parse_mesh_shape("config=4") == {"config": 4}
+    assert parse_mesh_shape("config=2,data=2") == {"config": 2,
+                                                   "data": 2}
+    assert parse_mesh_shape("config=all") == {"config": 8}
+    with pytest.raises(ValueError, match="axis=N"):
+        parse_mesh_shape("config")
+    with pytest.raises(ValueError, match="> 0"):
+        parse_mesh_shape("config=0")
+
+
 def _cycling_feed(batch=8):
     """Deterministic feed producing a DIFFERENT batch per call."""
     state = {"i": 0}

@@ -492,3 +492,26 @@ def test_service_rejects_inject_without_flag(serve_solver, tmp_path):
             svc.submit({"id": "r-evil", "tenant": "t",
                         "configs": [{"mean": 400}], "iters": CHUNK,
                         "inject_nan": {"iter": 1}})
+
+
+def test_service_on_config_mesh_matches_single_device(serve_solver,
+                                                      tmp_path):
+    """ISSUE 9: the lane pool laid over a config mesh (one GSPMD
+    program across N local devices) serves byte-identical results to
+    the single-device service — the mesh is a capacity knob, never a
+    semantics knob."""
+    specs = [{"mean": 400, "std": 80}, {"mean": 360, "std": 70}]
+
+    def run(sub, **kw):
+        with _service(serve_solver, tmp_path / sub, **kw) as svc:
+            svc.submit({"id": "r-0", "tenant": "alice",
+                        "configs": specs, "iters": 2 * CHUNK})
+            assert svc.serve(drain_when_idle=True) == 0
+            return svc.status("r-0")
+
+    single = run("svc1")
+    import jax
+    assert len(jax.devices()) >= LANES    # the virtual 8-device mesh
+    meshed = run("svc2", mesh=f"config={LANES}")
+    assert meshed["status"] == "completed"
+    assert meshed["results"] == single["results"]

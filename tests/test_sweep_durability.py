@@ -519,3 +519,148 @@ def test_prefetch_cancel_closes_runner(tmp_path):
 
     # cancel with nothing in flight is a no-op
     pf.cancel()
+
+
+# ---------------------------------------------------------------------------
+# pod-scale sweeps: v4 distributed checkpoints + resharding on resume
+# (ISSUE 9; the cross-PROCESS half lives in scripts/check_pod_sweep.py —
+# these pin the single-process topology contracts on the virtual mesh)
+
+
+def _mesh_runner(tmp_path, n_dev, n=4, depth=0):
+    from rram_caffe_simulation_tpu.parallel import make_mesh
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    mesh = make_mesh({"config": n_dev}, devices=jax.devices()[:n_dev])
+    return SweepRunner(s, n_configs=n, mesh=mesh, pipeline_depth=depth)
+
+
+def _healing_snapshot(r):
+    from rram_caffe_simulation_tpu.fault import engine as fe
+    rep = r.config_report()
+    faults = {name: np.asarray(v).tobytes()
+              for name, v in fe.iter_state_leaves(r.fault_states)}
+    return rep, faults
+
+
+def _healing_to_completion(r, budget=12):
+    r.enable_self_healing(budget=budget)
+    while not r.healing_complete():
+        r.step(4, chunk=2)
+    return _healing_snapshot(r)
+
+
+def test_distributed_checkpoint_reshards_on_restore(tmp_path):
+    """The v4 resharding contract: checkpoint a self-healing sweep on a
+    config=4 mesh as a DISTRIBUTED directory, restore it on config=2
+    and on a single device, finish — losses, fault rows, and the
+    healing ledger must be byte-identical to the uninterrupted
+    config=4 run on every topology."""
+    r_ref = _mesh_runner(tmp_path / "ref", 4)
+    rep_ref, faults_ref = _healing_to_completion(r_ref)
+    r_ref.close()
+    assert len(rep_ref["completed"]) == 4   # vacuous-diff guard
+
+    r_a = _mesh_runner(tmp_path / "a", 4)
+    r_a.enable_self_healing(budget=12)
+    r_a.step(6, chunk=2)
+    ckpt = r_a.checkpoint(str(tmp_path / "pod.ckpt"), distributed=True)
+    r_a.close()
+    assert os.path.isdir(ckpt)
+    names = sorted(os.listdir(ckpt))
+    assert "manifest.json" in names         # the commit record
+    assert "shard_00000.npz" in names
+    assert "global.npz" in names
+
+    for n_dev, sub in ((4, "r4"), (2, "r2"), (1, "r1")):
+        r = _mesh_runner(tmp_path / sub, n_dev)
+        r.enable_self_healing(budget=12)
+        r.restore(ckpt)
+        assert r.iter == 6
+        while not r.healing_complete():
+            r.step(4, chunk=2)
+        rep, faults = _healing_snapshot(r)
+        assert rep["completed"] == rep_ref["completed"], \
+            f"healing ledger diverged on the config={n_dev} restore"
+        assert faults == faults_ref, \
+            f"fault rows diverged on the config={n_dev} restore"
+        r.close()
+
+
+def test_single_file_checkpoint_restores_across_meshes(tmp_path):
+    """The classic single-file layout reshards too: a checkpoint taken
+    on config=4 restores onto config=1 (and back) with bit-exact
+    continuation — restore() re-places every leaf with the target
+    runner's shardings."""
+    r_full = _mesh_runner(tmp_path / "full", 4)
+    loss_full, _ = r_full.step(8, chunk=2)
+
+    r_a = _mesh_runner(tmp_path / "part", 4)
+    r_a.step(4, chunk=2)
+    ckpt = r_a.checkpoint(str(tmp_path / "x.ckpt.npz"))
+    r_a.close()
+    assert os.path.isfile(ckpt)             # non-distributed layout
+
+    r_b = _mesh_runner(tmp_path / "res", 1)
+    loss_b, _ = r_b.restore(ckpt).step(4, chunk=2)
+    _bit_equal(loss_full, loss_b)
+    _bit_equal(r_full.solver._flat(r_full.params),
+               r_b.solver._flat(r_b.params))
+    _bit_equal(r_full.fault_states, r_b.fault_states)
+    r_full.close()
+    r_b.close()
+
+
+def test_distributed_checkpoint_without_manifest_refused(tmp_path):
+    """A distributed directory whose manifest.json never landed is an
+    aborted write — restore must refuse it loudly, not guess."""
+    r, _ = _runner(tmp_path, depth=0)
+    r.step(2, chunk=2)
+    ckpt = r.checkpoint(str(tmp_path / "torn.ckpt"), distributed=True)
+    os.remove(os.path.join(ckpt, "manifest.json"))
+    with pytest.raises(ValueError, match="manifest.json"):
+        r.restore(ckpt)
+    r.close()
+
+
+def test_escalating_recovery_reads_distributed_checkpoint(tmp_path):
+    """_ckpt_lane_rows understands the v4 directory layout: after a
+    distributed checkpoint, a retried config's first re-seed restores
+    its checkpointed lane slice (recovery='checkpoint'), not a fresh
+    re-init."""
+    from rram_caffe_simulation_tpu.parallel import make_mesh
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    sink = ListSink()
+    s.enable_metrics(sink)
+    mesh = make_mesh({"config": 2}, devices=jax.devices()[:2])
+    r = SweepRunner(s, n_configs=2, mesh=mesh, pipeline_depth=0)
+    r.enable_self_healing(budget=40, max_retries=1)
+    r.step(4, chunk=2)
+    r.checkpoint(str(tmp_path / "h.ckpt"), distributed=True)
+    _poison(r, 1, key="fc1")
+    while not r.healing_complete():
+        r.step(8, chunk=2)
+    reseeds = [rec for rec in sink.records
+               if rec.get("type") == "retry"
+               and rec.get("event") == "reseed"]
+    assert any(rec.get("recovery") == "checkpoint" for rec in reseeds), \
+        f"no checkpoint-slice recovery in {reseeds!r}"
+    r.close()
+
+
+def test_bytes_per_step_est_divides_by_config_shards(tmp_path):
+    """Satellite: the setup record's bandwidth estimate is the PER-CHIP
+    resident share — config-sharded leaves divide by the shard count
+    (the replicated quarantine mask does not)."""
+    r4 = _mesh_runner(tmp_path / "m4", 4)
+    r1 = _mesh_runner(tmp_path / "m1", 1)
+    est4, est1 = r4.bytes_per_step_est(), r1.bytes_per_step_est()
+    # quarantine: 4 bools replicated, counted full in both
+    quar = 2 * int(np.asarray(r4.quarantine).nbytes)
+    assert est4 - quar == (est1 - quar) // 4
+    rec = r4.setup_record()
+    assert rec["config_shards"] == 4
+    assert rec["bytes_per_step_est"] == est4
+    from rram_caffe_simulation_tpu.observe.schema import validate_record
+    assert validate_record(rec) == []
+    r4.close()
+    r1.close()
