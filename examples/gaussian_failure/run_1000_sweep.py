@@ -96,7 +96,11 @@ PARTIAL_EXIT = 65
 #: resumed run cannot silently diverge from the original configuration.
 MANIFEST_ARGS = ("configs", "group", "block", "iters", "chunk", "mean",
                  "std", "pipeline_depth", "solver", "checkpoint_every",
-                 "max_retries", "retry_backoff")
+                 "max_retries", "retry_backoff", "process")
+
+#: the fault-process spec every pre-process-registry run dir trained
+#: under (and the --process default)
+DEFAULT_PROCESS = "endurance_stuck_at"
 
 
 def _journal_append(path: str, rec: dict):
@@ -192,6 +196,13 @@ def main(argv=None):
         "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt"),
         help="solver prototxt the per-group Solver is built from "
              "(failure pattern / seed / display are overridden here)")
+    p.add_argument("--process", default=None,
+                   help="fault-process stack spec (fault/processes/ "
+                        "registry; default endurance_stuck_at — the "
+                        "reference model). Pinned in the run-dir "
+                        "manifest: --resume refuses a mismatched "
+                        "process instead of replaying the wrong "
+                        "physics")
     p.add_argument("--pipeline-depth", type=int, default=2,
                    help="in-flight chunks whose host bookkeeping the "
                         "consumer thread hides; 0 = synchronous "
@@ -299,6 +310,31 @@ def main(argv=None):
     if resuming:
         with open(manifest_path) as f:
             manifest = json.load(f)
+        # fault-process pin: the manifest names the physics the run
+        # trained under; an explicit conflicting --process on resume is
+        # refused here (and the checkpoint meta's own v5 pin would
+        # refuse too) rather than silently replaying the wrong model.
+        # Specs compare CANONICALIZED (stack order / param formatting
+        # normalized) so an equivalent spelling resumes fine; an
+        # unparseable spec falls back to a raw-string compare and lets
+        # the Solver raise the parse diagnosis.
+        pinned = manifest.get("process") or DEFAULT_PROCESS
+
+        def _canon(spec):
+            try:
+                from rram_caffe_simulation_tpu.fault.processes import \
+                    FaultSpec
+                return FaultSpec.parse(spec).canonical()
+            except Exception:
+                return str(spec).strip()
+
+        if args.process is not None \
+                and _canon(args.process) != _canon(pinned):
+            p.error(
+                f"--resume {run_dir} was trained under fault process "
+                f"{pinned!r} (manifest pin) but --process requests "
+                f"{args.process!r}; resume without --process, or with "
+                "the pinned spec")
         for key in MANIFEST_ARGS:
             # .get: manifests written before a flag existed resume with
             # the current default (e.g. pre-self-healing run dirs have
@@ -306,7 +342,10 @@ def main(argv=None):
             setattr(args, key, manifest.get(key, getattr(args, key)))
         print(f"Resuming {run_dir}: manifest restored "
               f"({args.configs} configs, groups of {args.group}, "
-              f"{args.iters} iters)", flush=True)
+              f"{args.iters} iters, process "
+              f"{args.process or DEFAULT_PROCESS})", flush=True)
+    if args.process is None:
+        args.process = DEFAULT_PROCESS
 
     from rram_caffe_simulation_tpu.observe import JsonlSink
     from rram_caffe_simulation_tpu.parallel import (GroupPrefetcher,
@@ -356,7 +395,8 @@ def main(argv=None):
         param.random_seed = 7 + gi
         param.display = 0
         param.ClearField("test_interval")
-        solver = Solver(param, compute_dtype="bfloat16")
+        solver = Solver(param, compute_dtype="bfloat16",
+                        fault_process=args.process)
         if run_dir:
             # per-group sweep records (one per chunk, per-config loss
             # vectors + quarantine ids); the in-flight group resumes
@@ -775,6 +815,7 @@ def main(argv=None):
                                            / (total_min / 60), 1),
         "v4_8_projection_minutes": round(total_min / 8, 2),
         "compute_dtype": "bfloat16",
+        "process": args.process,
         "pipeline_depth": args.pipeline_depth,
         "overlapped_groups": not args.no_overlap,
         # per-group async accounting: setup seconds hidden behind the

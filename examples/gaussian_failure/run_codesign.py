@@ -1,0 +1,214 @@
+"""Hardware co-design explorer: sweep (fault-process mix x sigma x
+adc_bits x lifetime distribution x mitigation strategy) jointly and
+report the Pareto front.
+
+The 1000-config sweep machinery explores the (mean, std) lifetime grid
+inside one jitted program; this driver adds the axes that change the
+TRACED program — which fault physics runs (fault/processes/ registry),
+the crossbar read-noise sigma, the ADC resolution (`quantize_ste`, the
+NEON tradeoff), and the mitigation strategy — by bucketing the joint
+grid with `fault.codesign.group_static`: one compiled SweepRunner per
+static bucket, the (mean, std) entries riding its vectorized lanes.
+
+Outputs (under --out):
+
+- `results.jsonl` — one record per evaluated config: every axis value
+  plus `loss` (final per-config loss), `broken` (final broken-cell
+  fraction), `adc_cost_bits` (adc_bits, with 0 = full precision
+  counted as 32 — the hardware-cost proxy a cheaper ADC improves), and
+  `wall_seconds` for the bucket.
+- `pareto_report.json` — the non-dominated front over
+  (--metric-x, --metric-y), default (loss, adc_cost_bits): the
+  accuracy-vs-ADC-cost curve, with the process mix and mitigation
+  strategy as the free design variables along it.
+
+    python examples/gaussian_failure/run_codesign.py \
+        --processes endurance_stuck_at,read_disturb \
+        --adc-bits 2,4 --sigmas 0.0 --iters 300 --out codesign0
+
+Exit code 0 = report written with a non-degenerate front, 65 = the
+front collapsed to a single point (axes exposed no tradeoff — widen
+them), 2 = usage error.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..", "..")
+sys.path.insert(0, REPO)
+
+DEGENERATE_EXIT = 65
+
+
+def _floats(text):
+    return [float(x) for x in str(text).split(",") if x.strip()]
+
+
+def _ints(text):
+    return [int(x) for x in str(text).split(",") if x.strip()]
+
+
+def _strs(text):
+    return [x.strip() for x in str(text).split(",") if x.strip()]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0])
+    p.add_argument("--solver", default=(
+        "models/cifar10_quick/cifar10_quick_lmdb_solver.prototxt"),
+        help="solver prototxt each bucket's Solver is built from "
+             "(failure pattern / rram_forward / strategy / seed are "
+             "overridden per bucket here)")
+    p.add_argument("--processes", default="endurance_stuck_at",
+                   help="comma-separated fault-process specs "
+                        "(fault/processes/ syntax; ':' params and '+' "
+                        "stacks allowed — commas inside a spec are "
+                        "not, use one-param processes or defaults)")
+    p.add_argument("--sigmas", default="0.0",
+                   help="comma-separated crossbar read-noise sigmas")
+    p.add_argument("--adc-bits", default="0,4",
+                   help="comma-separated ADC resolutions (0 = full "
+                        "precision; 1 is invalid — symmetric quantizer"
+                        ")")
+    p.add_argument("--strategies", default="none",
+                   help="comma-separated mitigation strategies: none "
+                        "or threshold:T (e.g. threshold:0.001)")
+    p.add_argument("--means", default="400,800",
+                   help="comma-separated lifetime means (the per-lane "
+                        "Monte-Carlo axis)")
+    p.add_argument("--stds", default="100",
+                   help="comma-separated lifetime stds (crossed with "
+                        "--means)")
+    p.add_argument("--iters", type=int, default=300)
+    p.add_argument("--chunk", type=int, default=25)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--metric-x", default="loss",
+                   help="quality metric (minimized unless "
+                        "--maximize-x)")
+    p.add_argument("--metric-y", default="adc_cost_bits",
+                   help="hardware-cost metric (minimized unless "
+                        "--maximize-y)")
+    p.add_argument("--maximize-x", action="store_true")
+    p.add_argument("--maximize-y", action="store_true")
+    p.add_argument("--out", required=True,
+                   help="output directory (results.jsonl + "
+                        "pareto_report.json)")
+    args = p.parse_args(argv)
+
+    os.chdir(REPO)
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+
+    from rram_caffe_simulation_tpu.fault import codesign
+    from rram_caffe_simulation_tpu.fault.processes import FaultSpec
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+    from rram_caffe_simulation_tpu.utils.io import read_solver_param
+
+    axes = {
+        "process": [FaultSpec.parse(s).canonical()
+                    for s in _strs(args.processes)],
+        "sigma": _floats(args.sigmas),
+        "adc_bits": _ints(args.adc_bits),
+        "strategy": _strs(args.strategies),
+        "mean": _floats(args.means),
+        "std": _floats(args.stds),
+    }
+    if any(b == 1 for b in axes["adc_bits"]):
+        p.error("--adc-bits 1 is invalid (a symmetric quantizer with "
+                "2^(bits-1)-1 == 0 levels); use 0 or >= 2")
+    grid = codesign.expand_grid(axes)
+    groups = codesign.group_static(grid)
+    print(f"Co-design grid: {len(grid)} configs in {len(groups)} "
+          f"compiled buckets "
+          f"({' x '.join(f'{k}={len(v)}' for k, v in axes.items())})",
+          flush=True)
+
+    def build_solver(process, sigma, adc_bits, strategy):
+        param = read_solver_param(args.solver)
+        param.failure_pattern.type = "gaussian"
+        param.random_seed = args.seed
+        param.display = 0
+        param.ClearField("test_interval")
+        if sigma or adc_bits:
+            param.rram_forward.sigma = float(sigma)
+            param.rram_forward.adc_bits = int(adc_bits)
+        if strategy != "none":
+            kind, _, val = strategy.partition(":")
+            if kind != "threshold":
+                p.error(f"unknown strategy {strategy!r} (none or "
+                        "threshold:T)")
+            sp = param.failure_strategy.add()
+            sp.type = "threshold"
+            sp.threshold = float(val or 0.0)
+        return Solver(param, fault_process=process)
+
+    results = []
+    results_path = os.path.join(out_dir, "results.jsonl")
+    with open(results_path, "w") as rf:
+        for key, cfgs in sorted(groups.items()):
+            process, sigma, adc_bits, strategy = key
+            means = [c["mean"] for c in cfgs]
+            stds = [c["std"] for c in cfgs]
+            t0 = time.perf_counter()
+            solver = build_solver(process, sigma, adc_bits, strategy)
+            with SweepRunner(solver, n_configs=len(cfgs), means=means,
+                             stds=stds, pipeline_depth=0) as runner:
+                losses, _ = runner.step(args.iters, chunk=args.chunk)
+                broken = runner.broken_fractions()
+            dt = time.perf_counter() - t0
+            losses = np.ravel(np.asarray(losses, np.float64))
+            for i, cfg in enumerate(cfgs):
+                rec = dict(cfg)
+                rec["loss"] = float(losses[i])
+                rec["broken"] = float(broken[i])
+                # hardware-cost proxy: a full-precision read
+                # (adc_bits 0) costs a 32-bit converter, not a free one
+                rec["adc_cost_bits"] = int(adc_bits) if adc_bits else 32
+                rec["wall_seconds"] = round(dt, 3)
+                results.append(rec)
+                rf.write(json.dumps(rec) + "\n")
+            print(f"  bucket process={process} sigma={sigma:g} "
+                  f"adc_bits={adc_bits} strategy={strategy}: "
+                  f"{len(cfgs)} lanes x {args.iters} iters in "
+                  f"{dt:.1f} s (mean loss "
+                  f"{float(np.nanmean(losses)):.4f})", flush=True)
+
+    report = codesign.make_report(
+        results, args.metric_x, args.metric_y,
+        maximize_x=args.maximize_x, maximize_y=args.maximize_y,
+        axes=axes)
+    report_path = os.path.join(out_dir, "pareto_report.json")
+    tmp = f"{report_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2)
+    os.replace(tmp, report_path)
+    print(f"Pareto front ({args.metric_x} vs {args.metric_y}): "
+          f"{report['front_size']} of {report['evaluated']} configs "
+          f"non-dominated ({report['dominated']} dominated); report "
+          f"at {report_path}", flush=True)
+    for rec in report["front"]:
+        print("  front: "
+              + ", ".join(f"{k}={rec[k]}" for k in
+                          ("process", "sigma", "adc_bits", "strategy",
+                           "mean", "std"))
+              + f" -> {args.metric_x}={rec.get(args.metric_x)}, "
+                f"{args.metric_y}={rec.get(args.metric_y)}",
+              flush=True)
+    if report["degenerate"]:
+        print("Front is DEGENERATE (a single point): the axes exposed "
+              "no tradeoff — widen --adc-bits / --processes / "
+              "--sigmas", flush=True)
+        sys.exit(DEGENERATE_EXIT)
+    return report
+
+
+if __name__ == "__main__":
+    main()

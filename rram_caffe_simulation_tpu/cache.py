@@ -153,6 +153,10 @@ class SetupStats:
         # axis is laid over (1 = single chip; bytes_per_step is the
         # PER-CHIP resident share under the mesh)
         self.config_shards = None
+        # fault-physics accounting (ISSUE 10): the process stack +
+        # explicit params this run trains under (FaultSpec.to_model —
+        # {"spec": canonical, "processes": {...}})
+        self.fault_model = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -186,7 +190,8 @@ class SetupStats:
                       if self.pipeline is not None else None),
             bytes_per_step_est=self.bytes_per_step,
             fault_state_format=self.fault_format,
-            config_shards=self.config_shards)
+            config_shards=self.config_shards,
+            fault_model=self.fault_model)
 
 
 class _Timed:

@@ -38,6 +38,36 @@ def create_layer(layer_param, phase: int) -> "Layer":
     return LAYER_REGISTRY[t](layer_param, phase)
 
 
+# --- fault-process registry (fault/processes/) ------------------------
+# The same string->class seam the layer registry gives the net builder,
+# applied to time-dependent fault processes: a new fault physics model
+# is a registration, not a solver edit (ROADMAP item 5's engine-choice
+# seam, layer_factory.cpp:38 in the reference).
+
+FAULT_PROCESS_REGISTRY: dict[str, type] = {}
+
+
+def register_fault_process(name: str) -> Callable[[type], type]:
+    def wrap(cls: type) -> type:
+        if name in FAULT_PROCESS_REGISTRY:
+            raise KeyError(f"Fault process {name!r} registered twice")
+        FAULT_PROCESS_REGISTRY[name] = cls
+        cls.process_name = name
+        return cls
+    return wrap
+
+
+def create_fault_process(name: str, params: Optional[dict] = None):
+    """String->process creation (the CreateLayer twin for fault
+    physics). `params` is the process's free-form parameter dict from
+    the FaultSpec."""
+    if name not in FAULT_PROCESS_REGISTRY:
+        raise KeyError(
+            f"Unknown fault process {name!r}; registered: "
+            f"{sorted(FAULT_PROCESS_REGISTRY)}")
+    return FAULT_PROCESS_REGISTRY[name](params or {})
+
+
 @dataclasses.dataclass
 class LayerContext:
     """Trace-time context threaded through every layer apply.

@@ -14,10 +14,14 @@ from .engine import (FaultState, init_fault_state, fail, broken_fraction,
                      fault_state_from_proto)
 from .strategies import (threshold_diffs, remap_fc_neurons, sort_fc_neurons,
                          GeneticStrategy, build_strategies)
+from .processes import (FaultProcess, FaultSpec, ProcessStack,
+                        register_fault_process)
 
 __all__ = [
     "FaultState", "init_fault_state", "fail", "broken_fraction",
     "fault_counters", "fault_state_to_proto", "fault_state_from_proto",
     "threshold_diffs", "remap_fc_neurons", "sort_fc_neurons",
     "GeneticStrategy", "build_strategies",
+    "FaultProcess", "FaultSpec", "ProcessStack",
+    "register_fault_process",
 ]

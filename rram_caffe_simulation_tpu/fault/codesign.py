@@ -1,0 +1,155 @@
+"""Hardware co-design sweeps: joint axes over fault physics and
+hardware knobs, reduced to Pareto fronts.
+
+The sweep machinery (SweepRunner + the self-healing/service layers)
+explores the per-config (mean, std) lifetime grid inside ONE jitted
+program; this module adds the axes that change the TRACED program —
+the fault-process mix (fault/processes/), the crossbar read-noise sigma
+and ADC resolution (`rram_forward` / quantize_ste, the NEON arXiv
+2211.05730 tradeoff), and the mitigation strategy — and the reducer
+that turns the resulting per-config records into a co-design answer:
+the Pareto front over a quality metric vs. a hardware-cost metric
+(XBTorch's unified nonideality + co-design framing, arXiv 2601.07086).
+
+The split is deliberate:
+
+- `expand_grid(axes)` — the cartesian config grid, each entry a flat
+  dict of axis values.
+- `group_static(configs)` — buckets the grid by the STATIC axes
+  (process, sigma, adc_bits, strategy): every bucket compiles to one
+  program and vmaps its (mean, std) entries as sweep lanes; lifetime
+  axes stay per-lane. The grouping is what keeps a 2-process x
+  2-adc_bits x 25-(mean,std) grid at 4 compiles, not 100.
+- `pareto_front(records, metric_x, metric_y)` — the non-dominated
+  subset (both metrics minimized by default; pass `maximize_*` for
+  accuracy-style metrics), over plain dicts loaded from the per-config
+  JSONL results.
+- `make_report(...)` — the `pareto_report.json` payload the
+  `run_codesign.py` driver writes.
+
+Everything here is dependency-light (numpy only) so analysis tooling
+can load results without the framework.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: axes whose values change the traced program — one compiled sweep
+#: per distinct combination; everything else rides the config lanes
+STATIC_AXES = ("process", "sigma", "adc_bits", "strategy")
+
+#: per-lane axes (the Monte-Carlo lifetime-distribution grid)
+LANE_AXES = ("mean", "std")
+
+
+def expand_grid(axes: Dict[str, Sequence]) -> List[dict]:
+    """Cartesian product of the given axes: {axis: [values]} -> one
+    flat dict per combination. Unknown axis names are carried through
+    verbatim (they land in the result records untouched)."""
+    if not axes:
+        return []
+    names = sorted(axes)
+    for n in names:
+        vals = axes[n]
+        if not isinstance(vals, (list, tuple)) or not len(vals):
+            raise ValueError(f"co-design axis {n!r} needs a non-empty "
+                             f"list of values, got {vals!r}")
+    return [dict(zip(names, combo))
+            for combo in itertools.product(*(axes[n] for n in names))]
+
+
+def static_key(cfg: dict) -> Tuple:
+    """The compile-identity of a config: its static-axis values (absent
+    axes read as their neutral defaults)."""
+    return (str(cfg.get("process", "endurance_stuck_at")),
+            float(cfg.get("sigma", 0.0) or 0.0),
+            int(cfg.get("adc_bits", 0) or 0),
+            str(cfg.get("strategy", "none") or "none"))
+
+
+def group_static(configs: Iterable[dict]) -> Dict[Tuple, List[dict]]:
+    """Bucket a config grid by `static_key` — each bucket is one
+    compiled sweep whose entries differ only along the lane axes."""
+    groups: Dict[Tuple, List[dict]] = {}
+    for cfg in configs:
+        groups.setdefault(static_key(cfg), []).append(dict(cfg))
+    return groups
+
+
+def _metric(rec: dict, name: str) -> Optional[float]:
+    v = rec.get(name)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    v = float(v)
+    if v != v:                      # NaN never dominates anything
+        return None
+    return v
+
+
+def pareto_front(records: Sequence[dict], metric_x: str, metric_y: str,
+                 maximize_x: bool = False, maximize_y: bool = False
+                 ) -> Tuple[List[dict], int]:
+    """The non-dominated subset of `records` under (metric_x,
+    metric_y), both minimized unless `maximize_*`. Records missing
+    either metric (or carrying NaN — a failed config) are excluded
+    from the comparison entirely. Returns (front sorted by metric_x,
+    dominated_count). Ties: a record equal on both metrics to a front
+    member joins the front (it is not dominated)."""
+    pts = []
+    for rec in records:
+        x, y = _metric(rec, metric_x), _metric(rec, metric_y)
+        if x is None or y is None:
+            continue
+        pts.append((x if not maximize_x else -x,
+                    y if not maximize_y else -y, rec))
+    front = []
+    dominated = 0
+    for x, y, rec in pts:
+        if any(ox <= x and oy <= y and (ox < x or oy < y)
+               for ox, oy, _ in pts):
+            dominated += 1
+        else:
+            front.append((x, y, rec))
+    front.sort(key=lambda p: (p[0], p[1]))
+    return [rec for _, _, rec in front], dominated
+
+
+def load_results(path: str) -> List[dict]:
+    """Per-config result records from a JSONL file (one object per
+    line; blank lines skipped) — the driver's results.jsonl, or any
+    sweep metrics log whose records carry the chosen metrics."""
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def make_report(records: Sequence[dict], metric_x: str, metric_y: str,
+                maximize_x: bool = False, maximize_y: bool = False,
+                axes: Optional[dict] = None) -> dict:
+    """The `pareto_report.json` payload: the front (full records, best
+    metric_x first), the dominated count, and a degeneracy verdict —
+    `degenerate` is True when the front collapses to a single point
+    (or fewer), i.e. the axes exposed no actual tradeoff."""
+    front, dominated = pareto_front(records, metric_x, metric_y,
+                                    maximize_x, maximize_y)
+    distinct = {( _metric(r, metric_x), _metric(r, metric_y))
+                for r in front}
+    report = {
+        "schema_version": 1,
+        "metric_x": metric_x, "metric_y": metric_y,
+        "maximize_x": bool(maximize_x), "maximize_y": bool(maximize_y),
+        "evaluated": len(records),
+        "dominated": dominated,
+        "front_size": len(front),
+        "degenerate": len(distinct) < 2,
+        "front": list(front),
+    }
+    if axes:
+        report["axes"] = {k: list(v) for k, v in axes.items()}
+    return report

@@ -106,7 +106,8 @@ def draw_rescaled_state(key: jax.Array, param_shapes: Dict[str, tuple],
 def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
                     pattern: "pb.FailurePatternParameter",
                     n_configs: int, means, stds,
-                    rows: Tuple[int, int] = None) -> FaultState:
+                    rows: Tuple[int, int] = None,
+                    process=None) -> FaultState:
     """Rows [lo, hi) of the n_configs-stacked fault-state draw, exactly
     as the full stack would hold them: the per-config keys are split
     from `key` over the FULL config count and then sliced, so the draw
@@ -115,7 +116,12 @@ def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
     `stack_fault_states` — on a config mesh each process materializes
     only the 1/processes of the Monte-Carlo state its chips own, while
     the global array (assembled from these blocks) never differs from
-    the single-process one."""
+    the single-process one.
+
+    `process` (a fault/processes ProcessStack) routes the per-config
+    draw through the configured fault-process stack; None keeps the
+    legacy endurance kernel (which the default stack delegates to, so
+    the two spellings draw byte-identical rows)."""
     lo, hi = (0, n_configs) if rows is None else (int(rows[0]),
                                                   int(rows[1]))
     if not (0 <= lo <= hi <= n_configs):
@@ -126,6 +132,8 @@ def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
     std = jnp.asarray(stds, jnp.float32)[lo:hi]
 
     def init_one(k, m, s):
+        if process is not None:
+            return process.draw_rescaled(k, param_shapes, pattern, m, s)
         return draw_rescaled_state(k, param_shapes, pattern, m, s)
 
     return jax.vmap(init_one)(keys, mean, std)
@@ -205,11 +213,15 @@ def broken_fraction(state: FaultState) -> jax.Array:
 
     Accepts both state formats: f32 lifetimes and the bit-packed
     write-counter banks (fault/packed.py) share the `<= 0` broken
-    semantics, so the census is one definition either way."""
+    semantics, so the census is one definition either way. A state
+    with no lifetime-bearing group (a decay-only fault-process stack,
+    e.g. pure conductance_drift) has no broken cells by definition."""
     broken = 0
     total = 0
     lives = (state["life_q"] if "life_q" in state
-             else state["lifetimes"])
+             else state.get("lifetimes", {}))
+    if not lives:
+        return jnp.float32(0.0)
     for life in lives.values():
         broken = broken + jnp.sum(life <= 0)
         total += life.size
@@ -261,7 +273,7 @@ def state_from_arrays(arrays: Dict[str, np.ndarray]) -> FaultState:
 def fault_state_to_proto(state: FaultState) -> "pb.NetParameter":
     from ..utils.io import array_to_blob
     out = pb.NetParameter(name="fault_state")
-    for name in sorted(state["lifetimes"]):
+    for name in sorted(state.get("lifetimes", {})):
         lp = out.layer.add()
         lp.name = name
         lp.type = "FaultState"
@@ -276,20 +288,42 @@ def fault_state_to_proto(state: FaultState) -> "pb.NetParameter":
         array_to_blob(
             np.asarray(state["remap_slots"][gid], np.float64),
             lp.blobs.add())
+    # fault-process extension groups (e.g. conductance_drift's
+    # drift_age/drift_rate) serialize generically, one entry per leaf,
+    # typed "FaultLeaf:<group>" — so any registered process's state
+    # survives the snapshot without a wire-format change
+    for group in sorted(state):
+        if group in ("lifetimes", "stuck", "remap_slots"):
+            continue
+        for name in sorted(state[group]):
+            lp = out.layer.add()
+            lp.name = name
+            lp.type = f"FaultLeaf:{group}"
+            array_to_blob(np.asarray(state[group][name]), lp.blobs.add())
     return out
 
 
 def fault_state_from_proto(proto: "pb.NetParameter") -> FaultState:
     from ..utils.io import blob_to_array
     lifetimes, stuck, slots = {}, {}, {}
+    extra: Dict[str, dict] = {}
     for lp in proto.layer:
         if lp.type == "RemapSlots":
             slots[lp.name] = jnp.asarray(blob_to_array(lp.blobs[0]),
                                          jnp.int32)
             continue
+        if lp.type.startswith("FaultLeaf:"):
+            group = lp.type[len("FaultLeaf:"):]
+            extra.setdefault(group, {})[lp.name] = jnp.asarray(
+                blob_to_array(lp.blobs[0]))
+            continue
         lifetimes[lp.name] = jnp.asarray(blob_to_array(lp.blobs[0]))
         stuck[lp.name] = jnp.asarray(blob_to_array(lp.blobs[1]))
-    out: FaultState = {"lifetimes": lifetimes, "stuck": stuck}
+    out: FaultState = {}
+    if lifetimes:
+        out["lifetimes"] = lifetimes
+        out["stuck"] = stuck
     if slots:
         out["remap_slots"] = slots
+    out.update(extra)
     return out

@@ -244,20 +244,34 @@ def unpacked_view(state: dict, spec: dict) -> "fault_engine.FaultState":
 
 
 def fail_packed(fault_params: Dict[str, jax.Array], state: dict,
-                fault_diffs: Dict[str, jax.Array],
-                spec: dict) -> Tuple[Dict[str, jax.Array], dict]:
+                fault_diffs: Dict[str, jax.Array], spec: dict,
+                mode: str = "write") -> Tuple[Dict[str, jax.Array], dict]:
     """engine.fail on the packed banks: the write decrement is a native
     integer -1 on the counter bank, the stuck clamp unpacks its 2-bit
     codes in-register, and broken stays derived (`life_q <= 0`) — the
     wide f32 state never exists between steps. Timeline identical to
-    engine.fail (see module docstring)."""
+    engine.fail (see module docstring).
+
+    `mode` is the fault-process decrement policy (fault/processes/):
+    "write" (default, the endurance semantics — decrement on written
+    steps only), "always" (read disturb — every step is a read), or
+    "never" (permanent fault maps — the counter field is static)."""
     new_params, new_life = {}, {}
     for name, data in fault_params.items():
         lq = state["life_q"][name]
         diff = fault_diffs[name]
         alive = lq > 0
-        written = jnp.abs(diff) >= fault_engine.EPSILON
-        lq2 = jnp.where(alive & written, lq - np.asarray(1, lq.dtype), lq)
+        if mode == "write":
+            written = jnp.abs(diff) >= fault_engine.EPSILON
+            lq2 = jnp.where(alive & written,
+                            lq - np.asarray(1, lq.dtype), lq)
+        elif mode == "always":
+            lq2 = jnp.where(alive, lq - np.asarray(1, lq.dtype), lq)
+        elif mode == "never":
+            lq2 = lq
+        else:
+            raise ValueError(f"unknown fail_packed mode {mode!r} "
+                             "(expected 'write', 'always', or 'never')")
         broken = lq2 <= 0
         stuck = unpack_stuck(state["stuck_bits"][name],
                              spec["last_dim"][name])

@@ -1,0 +1,226 @@
+"""Pluggable time-dependent fault processes (ROADMAP item 4).
+
+The fault engine stops being one hard-coded failure mode: each physics
+model is a `FaultProcess` registered by name (`core/registry.py`, the
+same string->class seam the layer factory uses), and a `FaultSpec`
+selects + parameterizes a process STACK that composes inside the
+jitted train step's Fail phase:
+
+    endurance_stuck_at                      # the reference model (default)
+    conductance_drift:nu=0.2,sigma=0.1      # retention loss
+    read_disturb:reads_per_step=400         # read-stress wear
+    permanent_fault_map:fraction=0.05       # static defect maps
+    endurance_stuck_at+conductance_drift    # composed stack
+
+Spec syntax: `name[:k=v[,k=v...]]` joined by `+`. Stacks normalize to a
+deterministic canonical order (decay processes first, the clamp family
+last — base.py explains why) and a canonical string, which is what the
+sweep checkpoint meta (v5), the run-dir manifest, and the service spool
+pin so a resume/restore can refuse a mismatched process instead of
+silently replaying the wrong physics.
+
+Every process owns declared state groups in the one FaultState pytree,
+so `engine.iter_state_leaves`, the packed banks, checkpoint v5,
+`draw_state_rows` pod-sharded draws, and self-healing lane refill all
+work generically — a new fault model is a registration, not a solver
+edit.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from ...core.registry import (FAULT_PROCESS_REGISTRY,
+                              create_fault_process,
+                              register_fault_process)
+from .base import FaultProcess
+# importing the built-ins registers them
+from .endurance import EnduranceStuckAt
+from .drift import ConductanceDrift
+from .read_disturb import ReadDisturb
+from .permanent import PermanentFaultMap
+
+DEFAULT_PROCESS = "endurance_stuck_at"
+
+
+def _parse_value(text: str):
+    try:
+        return float(text)
+    except ValueError:
+        return text
+
+
+class FaultSpec:
+    """A parsed process-stack selection: [(name, params), ...].
+
+    `parse` accepts the CLI/spool spec syntax; `build` instantiates the
+    ProcessStack; `canonical()` is the normalized string two specs are
+    compared by (sorted params, canonical stack order) — the pin the
+    checkpoint meta / run manifest carry."""
+
+    def __init__(self, processes: List[Tuple[str, dict]]):
+        if not processes:
+            raise ValueError("FaultSpec needs at least one process")
+        self.processes = [(str(n), dict(p)) for n, p in processes]
+
+    @classmethod
+    def parse(cls, text) -> "FaultSpec":
+        if isinstance(text, FaultSpec):
+            return text
+        if text is None or not str(text).strip():
+            text = DEFAULT_PROCESS
+        procs = []
+        for part in str(text).split("+"):
+            part = part.strip()
+            if not part:
+                raise ValueError(
+                    f"empty process entry in fault spec {text!r}")
+            name, _, ptext = part.partition(":")
+            name = name.strip()
+            params = {}
+            if ptext.strip():
+                for kv in ptext.split(","):
+                    k, sep, v = kv.partition("=")
+                    if not sep or not k.strip():
+                        raise ValueError(
+                            f"bad parameter {kv!r} in fault spec "
+                            f"{text!r} (expected key=value)")
+                    params[k.strip()] = _parse_value(v.strip())
+            procs.append((name, params))
+        return cls(procs)
+
+    def build(self) -> "ProcessStack":
+        return ProcessStack([create_fault_process(n, p)
+                             for n, p in self.processes])
+
+    def canonical(self) -> str:
+        return self.build().canonical()
+
+    def to_model(self) -> dict:
+        """The observe `setup` record's `fault_model` field: the
+        canonical spec plus each process's explicit params."""
+        stack = self.build()
+        model = {"spec": stack.canonical()}
+        params = {p.process_name: dict(p.params)
+                  for p in stack.processes if p.params}
+        if params:
+            model["processes"] = params
+        return model
+
+    def __repr__(self):
+        return f"FaultSpec({self.canonical()!r})"
+
+
+class ProcessStack:
+    """An ordered, validated composition of fault processes sharing one
+    FaultState pytree. Normalized order: decay first, clamp last (at
+    most one clamp process — two lifetime timelines over the same cells
+    have no composition semantics); state groups merge disjointly."""
+
+    def __init__(self, processes: List[FaultProcess]):
+        if not processes:
+            raise ValueError("ProcessStack needs at least one process")
+        order = {"decay": 0, "clamp": 1}
+        self.processes = sorted(
+            processes, key=lambda p: (order.get(p.phase, 2),
+                                      p.process_name))
+        names = [p.process_name for p in self.processes]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"fault process listed twice in stack: {names}")
+        clamps = [p for p in self.processes if p.phase == "clamp"]
+        if len(clamps) > 1:
+            raise ValueError(
+                "a fault-process stack supports at most one clamp "
+                "(lifetime-bearing) process; got "
+                f"{[p.process_name for p in clamps]}")
+
+    # --- static properties --------------------------------------------
+    @property
+    def has_lifetimes(self) -> bool:
+        return any(p.has_lifetimes for p in self.processes)
+
+    @property
+    def supports_packed(self) -> bool:
+        return (self.has_lifetimes
+                and all(p.supports_packed for p in self.processes))
+
+    def unpackable(self) -> List[str]:
+        """Names of the processes blocking the packed banks ([] when
+        supports_packed)."""
+        if not self.has_lifetimes:
+            return [p.process_name for p in self.processes]
+        return [p.process_name for p in self.processes
+                if not p.supports_packed]
+
+    def write_quantum(self, decrement: float) -> float:
+        for p in self.processes:
+            if p.has_lifetimes:
+                return p.write_quantum(decrement)
+        return float(decrement)
+
+    def canonical(self) -> str:
+        return "+".join(p.canonical() for p in self.processes)
+
+    # --- state ---------------------------------------------------------
+    def _merge(self, parts: List[dict]) -> dict:
+        state: dict = {}
+        for st in parts:
+            for group in st:
+                if group in state:
+                    raise ValueError(
+                        f"fault-process state group {group!r} declared "
+                        "by two processes in the stack")
+            state.update(st)
+        return state
+
+    def init_state(self, key: jax.Array, shapes: Dict[str, tuple],
+                   pattern) -> dict:
+        # process 0 consumes the raw key so the default single-process
+        # stack draws the byte-identical state the legacy engine drew
+        return self._merge([
+            p.init_state(key if i == 0 else jax.random.fold_in(key, i),
+                         shapes, pattern)
+            for i, p in enumerate(self.processes)])
+
+    def draw_rescaled(self, key: jax.Array, shapes: Dict[str, tuple],
+                      pattern, mean, std) -> dict:
+        return self._merge([
+            p.draw_rescaled(
+                key if i == 0 else jax.random.fold_in(key, i),
+                shapes, pattern, mean, std)
+            for i, p in enumerate(self.processes)])
+
+    # --- the in-step transform ----------------------------------------
+    def fail(self, fault_params, state, fault_diffs, decrement):
+        for p in self.processes:
+            fault_params, state = p.fail(fault_params, state,
+                                         fault_diffs, decrement)
+        return fault_params, state
+
+    def fail_packed(self, fault_params, state, fault_diffs, pack_spec):
+        for p in self.processes:
+            fault_params, state = p.fail_packed(fault_params, state,
+                                                fault_diffs, pack_spec)
+        return fault_params, state
+
+    # --- observe contributions ----------------------------------------
+    def counters(self, state, life_view) -> dict:
+        out = {}
+        for p in self.processes:
+            c = p.counters(state, life_view)
+            if c:
+                out[p.process_name] = c
+        return out
+
+    def __repr__(self):
+        return f"<ProcessStack {self.canonical()!r}>"
+
+
+__all__ = [
+    "FaultProcess", "FaultSpec", "ProcessStack", "DEFAULT_PROCESS",
+    "FAULT_PROCESS_REGISTRY", "register_fault_process",
+    "create_fault_process", "EnduranceStuckAt", "ConductanceDrift",
+    "ReadDisturb", "PermanentFaultMap",
+]

@@ -1,0 +1,149 @@
+"""The fault-process contract: what a time-dependent fault physics
+model must provide to compose inside the jitted train step.
+
+A process owns a set of STATE GROUPS — named subtrees of the FaultState
+pytree, one leaf per fault-target parameter — and a pure
+``fail(params, state, diffs, decrement)`` transform applied at the
+step's Fail phase (solver.cpp:305 ordering). Groups are merged across
+the stack (fault/processes/__init__.py ProcessStack), so every piece of
+generic machinery keyed on the state tree — ``engine.iter_state_leaves``
+checkpointing, the packed banks, ``draw_state_rows`` sharded draws,
+self-healing lane refills — works for any process mix with no
+per-process special cases.
+
+Two phases order a stack deterministically: ``decay`` processes
+(conductance drift) mutate weight VALUES and run first; ``clamp``
+processes (the stuck-at family) pin broken cells to their stuck values
+and run last, so a cell that is both drifting and broken ends the step
+at its stuck value, exactly as a physically dead cell would. At most
+one clamp process per stack — two lifetime timelines over the same
+cells have no composition semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class FaultProcess:
+    """Base fault process. Subclasses register via
+    ``core.registry.register_fault_process`` and implement the state /
+    transform hooks below.
+
+    ``params`` is the free-form parameter dict parsed from the
+    FaultSpec (``name:key=value,...``); unknown keys raise so a typo'd
+    spec fails loudly at construction, not silently at analysis time.
+    """
+
+    process_name = "?"
+    #: "decay" processes run before "clamp" processes in a stack
+    phase = "clamp"
+    #: whether this process carries the canonical lifetimes/stuck
+    #: groups (the clamp family) — the census / strategy sources
+    has_lifetimes = False
+    #: whether the process's state survives the fault/packed.py bank
+    #: round-trip (lifetime counters + 2-bit stuck codes; extra f32
+    #: groups always ride the banks untouched)
+    supports_packed = False
+    #: parameter names this process accepts (spec validation)
+    param_names: Tuple[str, ...] = ()
+
+    def __init__(self, params: Optional[dict] = None):
+        params = dict(params or {})
+        unknown = set(params) - set(self.param_names)
+        if unknown:
+            raise ValueError(
+                f"fault process {self.process_name!r} does not accept "
+                f"parameter(s) {sorted(unknown)}; known: "
+                f"{sorted(self.param_names)}")
+        self.params = params
+
+    # --- state ---------------------------------------------------------
+    def init_state(self, key: jax.Array, shapes: Dict[str, tuple],
+                   pattern) -> dict:
+        """Draw this process's state groups for the given fault-target
+        parameter shapes (the GaussianFailureMaker-ctor moment)."""
+        raise NotImplementedError
+
+    def draw_rescaled(self, key: jax.Array, shapes: Dict[str, tuple],
+                      pattern, mean, std) -> dict:
+        """One independent per-config draw with the lifetime
+        distribution re-anchored to (mean, std) — the kernel the
+        config-stacked sweep vmaps over and the self-healing lane
+        refill calls. Processes without a lifetime distribution ignore
+        (mean, std) and just draw independently under `key`."""
+        raise NotImplementedError
+
+    # --- the in-step transform ----------------------------------------
+    def fail(self, fault_params: Dict[str, jax.Array], state: dict,
+             fault_diffs: Dict[str, jax.Array],
+             decrement: float) -> Tuple[Dict[str, jax.Array], dict]:
+        """One fault step (pure): returns (params', state').
+        `decrement` is the solver's write quantum (fail_decrement, the
+        reference's batch size) — processes free to ignore it."""
+        raise NotImplementedError
+
+    def fail_packed(self, fault_params, state, fault_diffs,
+                    pack_spec: dict):
+        """`fail` against the bit-packed banks (fault/packed.py); only
+        called when `supports_packed`."""
+        raise NotImplementedError(
+            f"fault process {self.process_name!r} has no packed-state "
+            "path (supports_packed is False)")
+
+    # --- observe contributions ----------------------------------------
+    def counters(self, state: dict,
+                 life_view: Dict[str, jax.Array]) -> dict:
+        """This process's census contributions to the step's metrics
+        tree (traced reductions; `life_view` is the f32 lifetimes view,
+        unpacked mid-bin under the packed banks, {} when the stack has
+        none). Returns {counter_name: scalar}. The default is the
+        clamp family's broken count — the ONE census definition every
+        lifetime-bearing process shares; lifetime-less processes
+        contribute nothing unless they override."""
+        if not self.has_lifetimes:
+            return {}
+        broken = sum((jnp.sum(v <= 0).astype(jnp.int32)
+                      for v in life_view.values()), jnp.int32(0))
+        return {"broken": broken}
+
+    # --- packing -------------------------------------------------------
+    def write_quantum(self, decrement: float) -> float:
+        """The lifetime quantum the packed counter banks divide by
+        (``ceil(lifetime / quantum)``). The endurance default is the
+        solver's write decrement; a process whose timeline advances by
+        a different per-step amount (read disturb) returns that."""
+        return float(decrement)
+
+    # --- spec round-trip ----------------------------------------------
+    def canonical_params(self) -> str:
+        """Deterministic ``k=v,...`` rendering of the explicitly given
+        params (sorted keys, %g floats) — the spec-equality basis the
+        checkpoint / run-manifest pinning compares."""
+        parts = []
+        for k in sorted(self.params):
+            v = self.params[k]
+            parts.append(f"{k}={v:g}" if isinstance(v, float)
+                         else f"{k}={v}")
+        return ",".join(parts)
+
+    def canonical(self) -> str:
+        p = self.canonical_params()
+        return f"{self.process_name}:{p}" if p else self.process_name
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.canonical()!r}>"
+
+
+def float_param(params: dict, name: str, default: float) -> float:
+    """A spec parameter as float (spec values arrive as str or
+    number)."""
+    v = params.get(name, default)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"fault-process parameter {name}={v!r} is not a number"
+        ) from None

@@ -1,0 +1,114 @@
+"""`conductance_drift` — retention loss: programmed conductances decay
+toward a drift target on a LOG time axis, re-anchored by writes.
+
+Physics (the XBTorch-style retention model, arXiv 2601.07086; the
+classic PCM/RRAM empirical law is G(t) = G0 * (t/t0)^-nu, i.e. linear
+decay of log-conductance in log-time): a cell programmed at step t0
+holds its value briefly, then relaxes toward the drift target with a
+rate that FALLS as 1/t — most of the drift happens right after
+programming. Here the per-cell weight follows
+
+    w(age+1) = target + (w(age) - target) * exp(-rate * dlog)
+    dlog     = log1p(age+1) - log1p(age)
+
+so the cumulative decay after `a` unwritten steps is
+``exp(-rate * log1p(a)) = (1+a)^-rate`` — the power law exactly. A
+WRITE (|diff| >= 1e-20, the same epsilon the endurance engine uses)
+re-anchors the cell: its age clock resets to 0 and the freshly
+programmed value takes no decay that step.
+
+"Gaussian": the per-cell rate is log-normally spread around `nu`
+(``rate = nu * exp(sigma * z)``, z ~ N(0,1) drawn once at init) —
+device-to-device drift-coefficient variation, the measured reality of
+drift coefficients — making the decay field a frozen random draw that
+jits, vmaps per config, and checkpoints like any other state leaf.
+
+State groups (both f32, riding every generic state mechanism —
+checkpoints, packed banks (untouched pass-through), sharded draws,
+lane refills):
+
+- ``drift_age``  — steps since the cell was last written
+- ``drift_rate`` — the per-cell frozen decay rate
+
+Parameters: ``target`` (default 0.0 — full retention loss relaxes the
+cell to its erased level), ``nu`` (median drift coefficient, default
+0.1), ``sigma`` (log-normal rate spread, default 0.0).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.registry import register_fault_process
+from .. import engine as fault_engine
+from .base import FaultProcess, float_param
+
+
+@register_fault_process("conductance_drift")
+class ConductanceDrift(FaultProcess):
+
+    phase = "decay"
+    has_lifetimes = False
+    supports_packed = True   # its f32 groups pass through the banks
+    param_names = ("target", "nu", "sigma")
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.target = float_param(self.params, "target", 0.0)
+        self.nu = float_param(self.params, "nu", 0.1)
+        self.sigma = float_param(self.params, "sigma", 0.0)
+        if self.nu < 0:
+            raise ValueError(f"conductance_drift nu must be >= 0, got "
+                             f"{self.nu!r}")
+
+    def init_state(self, key, shapes, pattern):
+        age, rate = {}, {}
+        for name in sorted(shapes):
+            key, k_rate = jax.random.split(key)
+            shape = shapes[name]
+            age[name] = jnp.zeros(shape, jnp.float32)
+            z = jax.random.normal(k_rate, shape, dtype=jnp.float32)
+            rate[name] = jnp.float32(self.nu) * jnp.exp(
+                jnp.float32(self.sigma) * z)
+        return {"drift_age": age, "drift_rate": rate}
+
+    def draw_rescaled(self, key, shapes, pattern, mean, std):
+        # drift has no lifetime distribution; (mean, std) parameterize
+        # the clamp process of the stack — each config just gets an
+        # independent rate-field draw under its own key
+        return self.init_state(key, shapes, pattern)
+
+    def fail(self, fault_params, state, fault_diffs, decrement):
+        new_params, new_age = {}, {}
+        target = jnp.float32(self.target)
+        for name, w in fault_params.items():
+            age = state["drift_age"][name]
+            rate = state["drift_rate"][name]
+            written = jnp.abs(fault_diffs[name]) >= fault_engine.EPSILON
+            age1 = jnp.where(written, 0.0, age + 1.0)
+            # log-time increment; 0 for re-anchored (written) cells, so
+            # the freshly programmed value takes no decay this step
+            dlog = jnp.where(written, 0.0,
+                             jnp.log1p(age1) - jnp.log1p(age))
+            decay = jnp.exp(-rate * dlog)
+            new_params[name] = (target
+                                + (w - target) * decay.astype(w.dtype))
+            new_age[name] = age1
+        return new_params, {**state, "drift_age": new_age}
+
+    def fail_packed(self, fault_params, state, fault_diffs, pack_spec):
+        # drift's groups are f32 either way — the packed banks only
+        # reshape the clamp family's lifetimes/stuck
+        return self.fail(fault_params, state, fault_diffs,
+                         pack_spec["decrement"])
+
+    def counters(self, state, life_view):
+        drifted = jnp.int32(0)
+        age_sum = jnp.float32(0.0)
+        n = 0
+        for v in state["drift_age"].values():
+            drifted = drifted + jnp.sum(v > 0).astype(jnp.int32)
+            age_sum = age_sum + jnp.sum(v)
+            n += v.size
+        return {"drifted": drifted,
+                "age_mean": age_sum / max(n, 1)}

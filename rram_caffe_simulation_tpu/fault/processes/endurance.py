@@ -1,0 +1,46 @@
+"""`endurance_stuck_at` — the reference fault model (failure_maker.cpp/
+.cu) behind the process interface.
+
+Every hook DELEGATES to the exact engine functions the solver called
+before the registry existed (engine.init_fault_state / fail /
+draw_rescaled_state, packed.fail_packed), so routing the default stack
+through the registry traces to the byte-identical program —
+``scripts/check_fault_processes.py`` is the CI guard that pins it
+(losses, fault transitions, and snapshot files all byte-equal to a
+direct engine.fail shim).
+"""
+from __future__ import annotations
+
+from ...core.registry import register_fault_process
+from .. import engine as fault_engine
+from .base import FaultProcess
+
+
+@register_fault_process("endurance_stuck_at")
+class EnduranceStuckAt(FaultProcess):
+    """Endurance-driven stuck-at faults: per-cell lifetimes drawn
+    ~ N(mean, std) are decremented by the write quantum on every
+    written step (|diff| >= 1e-20); an expired cell clamps to its
+    stuck value in {-1, 0, +1} forever (FailKernel,
+    failure_maker.cu:23-40)."""
+
+    phase = "clamp"
+    has_lifetimes = True
+    supports_packed = True
+    param_names = ()
+
+    def init_state(self, key, shapes, pattern):
+        return fault_engine.init_fault_state(key, shapes, pattern)
+
+    def draw_rescaled(self, key, shapes, pattern, mean, std):
+        return fault_engine.draw_rescaled_state(key, shapes, pattern,
+                                                mean, std)
+
+    def fail(self, fault_params, state, fault_diffs, decrement):
+        return fault_engine.fail(fault_params, state, fault_diffs,
+                                 decrement)
+
+    def fail_packed(self, fault_params, state, fault_diffs, pack_spec):
+        from .. import packed as fault_packed
+        return fault_packed.fail_packed(fault_params, state,
+                                        fault_diffs, pack_spec)

@@ -1,0 +1,136 @@
+"""`permanent_fault_map` — static manufacturing-defect maps: a fixed
+set of cells is stuck from step 0 and nothing evolves.
+
+This is the fault model of the systolic-array fault-aware
+pruning/remapping literature (arXiv 1802.04657, whose remap strategy is
+directly analogous to the fork's): faults come from fabrication, are
+known from a post-manufacturing test, and do NOT accumulate with use —
+so the interesting question is purely spatial (which mitigation
+strategy recovers accuracy for a given map), which is exactly what the
+co-design sweep explores.
+
+State reuses the canonical lifetimes/stuck groups so every strategy
+flag matrix, census, checkpoint, and packed-bank path works unchanged:
+lifetimes are a CONSTANT field of -1.0 (faulty: <= 0 broken, < 0 remap
+flag) / +1.0 (healthy), never decremented (``mode="never"`` on the
+packed banks).
+
+The map comes from one of:
+
+- ``map=PATH`` — a .npz with ``<layer/slot>/broken`` (nonzero = faulty)
+  and ``<layer/slot>/stuck`` ({-1, 0, +1}) arrays per fault-target
+  parameter, shapes matching the net (the post-manufacturing test
+  artifact; missing keys mean that parameter is fault-free).
+- ``fraction=F`` — each cell faulty i.i.d. with probability F, stuck
+  values drawn from the pattern's failure_prob splits (the synthetic
+  yield model). Per-config sweep draws are independent maps — a
+  Monte-Carlo over defect placement at fixed yield.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.registry import register_fault_process
+from .. import engine as fault_engine
+from .base import FaultProcess, float_param
+
+
+@register_fault_process("permanent_fault_map")
+class PermanentFaultMap(FaultProcess):
+
+    phase = "clamp"
+    has_lifetimes = True
+    supports_packed = True
+    param_names = ("map", "fraction")
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.map_path = self.params.get("map")
+        self.fraction = None
+        if "fraction" in self.params:
+            self.fraction = float_param(self.params, "fraction", 0.0)
+            if not 0.0 <= self.fraction <= 1.0:
+                raise ValueError(
+                    f"permanent_fault_map fraction must be in [0, 1], "
+                    f"got {self.fraction!r}")
+        if (self.map_path is None) == (self.fraction is None):
+            raise ValueError(
+                "permanent_fault_map needs exactly one of map=PATH "
+                "(a .npz defect map) or fraction=F (i.i.d. synthetic "
+                "yield)")
+        self._loaded = None
+
+    # --- map source ----------------------------------------------------
+    def _load_map(self, shapes):
+        if self._loaded is None:
+            with np.load(self.map_path) as z:
+                self._loaded = {k: np.asarray(z[k]) for k in z.files}
+        life, stuck = {}, {}
+        for name, shape in shapes.items():
+            b = self._loaded.get(f"{name}/broken")
+            s = self._loaded.get(f"{name}/stuck")
+            if b is None:
+                b = np.zeros(shape, bool)
+            if s is None:
+                s = np.zeros(shape, np.float32)
+            if tuple(b.shape) != tuple(shape) \
+                    or tuple(s.shape) != tuple(shape):
+                raise ValueError(
+                    f"permanent_fault_map {self.map_path}: entry "
+                    f"{name!r} has shape {tuple(np.shape(b))}/"
+                    f"{tuple(np.shape(s))}, expected {tuple(shape)}")
+            bad = set(np.unique(np.asarray(s, np.float32))) - {-1.0,
+                                                               0.0, 1.0}
+            if bad:
+                raise ValueError(
+                    f"permanent_fault_map {self.map_path}: {name!r} "
+                    f"stuck values {sorted(bad)} outside {{-1, 0, +1}}")
+            life[name] = jnp.where(jnp.asarray(b, bool), -1.0,
+                                   1.0).astype(jnp.float32)
+            stuck[name] = jnp.asarray(s, jnp.float32)
+        return {"lifetimes": life, "stuck": stuck}
+
+    def _draw_map(self, key, shapes, pattern):
+        split1, split2 = fault_engine._stuck_splits(pattern)
+        frac = float(self.fraction)
+        life, stuck = {}, {}
+        for name in sorted(shapes):
+            key, k_b, k_s = jax.random.split(key, 3)
+            shape = shapes[name]
+            broken = jax.random.uniform(k_b, shape) < frac
+            life[name] = jnp.where(broken, -1.0,
+                                   1.0).astype(jnp.float32)
+            u = jax.random.uniform(k_s, shape, dtype=jnp.float32)
+            stuck[name] = jnp.where(
+                u < split1, -1.0,
+                jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+        return {"lifetimes": life, "stuck": stuck}
+
+    # --- state ---------------------------------------------------------
+    def init_state(self, key, shapes, pattern):
+        if self.map_path is not None:
+            return self._load_map(shapes)
+        return self._draw_map(key, shapes, pattern)
+
+    def draw_rescaled(self, key, shapes, pattern, mean, std):
+        # no lifetime distribution to rescale: file maps are identical
+        # per config (the chip IS the chip); fraction maps draw an
+        # independent defect placement per config key
+        return self.init_state(key, shapes, pattern)
+
+    # --- the (static) transform ---------------------------------------
+    def fail(self, fault_params, state, fault_diffs, decrement):
+        new_params = {}
+        for name, data in fault_params.items():
+            broken = state["lifetimes"][name] <= 0
+            new_params[name] = jnp.where(broken, state["stuck"][name],
+                                         data)
+        return new_params, state
+
+    def fail_packed(self, fault_params, state, fault_diffs, pack_spec):
+        from .. import packed as fault_packed
+        return fault_packed.fail_packed(fault_params, state,
+                                        fault_diffs, pack_spec,
+                                        mode="never")

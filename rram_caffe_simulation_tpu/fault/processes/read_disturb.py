@@ -1,0 +1,86 @@
+"""`read_disturb` — read-stress wear: every crossbar READ (not write)
+costs lifetime, so cells expire on the forward-pass clock.
+
+In a crossbar, inference itself stresses the cells: each forward pass
+applies the read voltage across every device once per input row, and a
+cell that has been read past its disturb limit flips and sticks
+(XBTorch's read-disturb nonideality, arXiv 2601.07086). The state is
+the endurance family's — lifetimes ~ N(mean, std), stuck values in
+{-1, 0, +1} — but the decrement fires EVERY step, written or not,
+by the per-layer read-count estimate: under the Caffe frontend every
+fault-target matrix is read exactly once per sample per forward, so
+reads/step = the training batch size — the same quantity the
+reference's write decrement hard-codes (failure_maker.cpp:75), which is
+why ``reads_per_step`` defaults to the solver's write quantum and is
+overridable per process instance (``read_disturb:reads_per_step=400``
+models a shared array serving 4 logical reads per sample).
+
+Packed banks: the int write counters of fault/packed.py carry the read
+budget directly — ``ceil(lifetime / reads_per_step)`` decremented by a
+native integer 1 every step (``mode="always"``), transitions exact by
+the same ceil identity the endurance counters use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.registry import register_fault_process
+from .. import engine as fault_engine
+from .base import FaultProcess, float_param
+
+
+@register_fault_process("read_disturb")
+class ReadDisturb(FaultProcess):
+
+    phase = "clamp"
+    has_lifetimes = True
+    supports_packed = True
+    param_names = ("reads_per_step",)
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.reads_per_step = self.params.get("reads_per_step")
+        if self.reads_per_step is not None:
+            self.reads_per_step = float_param(
+                self.params, "reads_per_step", 0.0)
+            if not self.reads_per_step > 0:
+                raise ValueError(
+                    f"read_disturb reads_per_step must be > 0, got "
+                    f"{self.reads_per_step!r}")
+
+    def _reads(self, decrement: float) -> float:
+        # default: the per-layer read-count estimate = batch rows per
+        # forward = the solver's write quantum (see module docstring)
+        return (self.reads_per_step if self.reads_per_step is not None
+                else float(decrement))
+
+    def write_quantum(self, decrement: float) -> float:
+        return self._reads(decrement)
+
+    def init_state(self, key, shapes, pattern):
+        return fault_engine.init_fault_state(key, shapes, pattern)
+
+    def draw_rescaled(self, key, shapes, pattern, mean, std):
+        return fault_engine.draw_rescaled_state(key, shapes, pattern,
+                                                mean, std)
+
+    def fail(self, fault_params, state, fault_diffs, decrement):
+        reads = self._reads(decrement)
+        new_params, new_life = {}, {}
+        for name, data in fault_params.items():
+            life = state["lifetimes"][name]
+            stuck = state["stuck"][name]
+            alive = life > 0
+            # unconditional: the read happens whether or not the solver
+            # wrote the cell this step
+            life2 = jnp.where(alive, life - reads, life)
+            broken = life2 <= 0
+            new_params[name] = jnp.where(broken, stuck, data)
+            new_life[name] = life2
+        return new_params, {**state, "lifetimes": new_life}
+
+    def fail_packed(self, fault_params, state, fault_diffs, pack_spec):
+        from .. import packed as fault_packed
+        return fault_packed.fail_packed(fault_params, state,
+                                        fault_diffs, pack_spec,
+                                        mode="always")

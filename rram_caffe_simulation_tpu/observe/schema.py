@@ -19,7 +19,10 @@ Top-level record::
                "writes_saved": 4096,
                "per_param": {"fc1/0": {"broken": 100, "newly_expired": 5,
                                        "life_min": -35.0,
-                                       "life_mean": 8.9e7}}}}
+                                       "life_mean": 8.9e7}},
+               "per_process": {"endurance_stuck_at": {"broken": 120},
+                               "conductance_drift": {
+                                   "drifted": 9000, "age_mean": 41.2}}}}
 
 `fault` is present only when the solver runs a fault engine; `seed` only
 on the first record a Solver writes — so once per run segment: a
@@ -104,6 +107,11 @@ FAULT_FIELDS = {
     "life_mean": (_NUM, True),
     "writes_saved": (int, True),
     "per_param": (dict, False),
+    # per-process census contributions (fault/processes/): counter name
+    # -> number (or per-config list) keyed by the process that produced
+    # it, e.g. {"endurance_stuck_at": {"broken": 120},
+    # "conductance_drift": {"drifted": 9000, "age_mean": 41.2}}
+    "per_process": (dict, False),
 }
 
 PER_PARAM_FIELDS = {
@@ -208,6 +216,18 @@ SETUP_FIELDS = {
     "bytes_per_step_est": (int, False),
     "fault_state_format": (str, False),
     "config_shards": (int, False),
+    "fault_model": (dict, False),
+}
+
+# `fault_model` (optional, fault-engine runs) names the fault-process
+# stack the run trains under (fault/processes/): `spec` is the
+# canonical process-spec string ("endurance_stuck_at",
+# "conductance_drift:nu=0.2+endurance_stuck_at", ...) and `processes`
+# the per-process explicit parameter dicts (numbers or strings),
+# present only when any process was parameterized.
+FAULT_MODEL_FIELDS = {
+    "spec": (str, True),
+    "processes": (dict, False),
 }
 
 SETUP_CACHE_FIELDS = {
@@ -450,6 +470,26 @@ def _validate_setup(rec) -> list:
     if isinstance(shards, int) and not isinstance(shards, bool) \
             and shards < 1:
         errs.append("setup.config_shards: must be >= 1")
+    fm = rec.get("fault_model")
+    if isinstance(fm, dict):
+        errs += _check_fields(fm, FAULT_MODEL_FIELDS,
+                              "setup.fault_model")
+        spec = fm.get("spec")
+        if isinstance(spec, str) and not spec:
+            errs.append("setup.fault_model.spec: must be non-empty")
+        procs = fm.get("processes")
+        if isinstance(procs, dict):
+            for pname, params in procs.items():
+                if not isinstance(params, dict):
+                    errs.append(f"setup.fault_model.processes"
+                                f"[{pname!r}]: not an object")
+                    continue
+                for k, v in params.items():
+                    if isinstance(v, bool) \
+                            or not isinstance(v, _NUM + (str,)):
+                        errs.append(
+                            f"setup.fault_model.processes[{pname!r}]."
+                            f"{k}: not a number or string")
     pipe = rec.get("pipeline")
     if isinstance(pipe, dict):
         errs += _check_fields(pipe, PIPELINE_FIELDS, "setup.pipeline")
@@ -586,4 +626,16 @@ def validate_record(rec) -> list:
                     continue
                 errs += _check_fields(entry, PER_PARAM_FIELDS,
                                       f"fault.per_param[{key!r}]")
+        pp = fault.get("per_process")
+        if isinstance(pp, dict):
+            for pname, entry in pp.items():
+                if not isinstance(entry, dict) or not entry:
+                    errs.append(f"fault.per_process[{pname!r}]: not a "
+                                "non-empty object of counters")
+                    continue
+                for cname, v in entry.items():
+                    if not _check_value(v, _NUM):
+                        errs.append(
+                            f"fault.per_process[{pname!r}].{cname}: "
+                            "not a number (or per-config list)")
     return errs

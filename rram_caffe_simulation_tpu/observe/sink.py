@@ -234,7 +234,8 @@ def make_setup_record(decode_s: float, compile_s: float,
                       pipeline: Optional[dict] = None,
                       bytes_per_step_est: Optional[int] = None,
                       fault_state_format: Optional[str] = None,
-                      config_shards: Optional[int] = None) -> dict:
+                      config_shards: Optional[int] = None,
+                      fault_model: Optional[dict] = None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -247,7 +248,11 @@ def make_setup_record(decode_s: float, compile_s: float,
     HBM-floor fields (SweepRunner.bytes_per_step_est; "f32" |
     "packed") the bytes-per-step trajectory tracks; `config_shards`
     (pod-scale sweeps) is how many mesh shards the config axis spans —
-    bytes_per_step_est is the PER-CHIP share under the mesh."""
+    bytes_per_step_est is the PER-CHIP share under the mesh.
+    `fault_model` (fault-engine runs) names the fault-process stack and
+    its explicit parameters ({"spec": canonical_spec, "processes":
+    {name: params}} — fault/processes/FaultSpec.to_model), so a log is
+    attributable to the physics that produced it."""
     rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "setup",
@@ -268,6 +273,8 @@ def make_setup_record(decode_s: float, compile_s: float,
         rec["fault_state_format"] = str(fault_state_format)
     if config_shards is not None:
         rec["config_shards"] = int(config_shards)
+    if fault_model is not None:
+        rec["fault_model"] = dict(fault_model)
     return rec
 
 
@@ -282,10 +289,15 @@ def setup_line(record: dict) -> str:
         ptail = (f"; pipeline depth {pipe.get('depth', 0)}: host blocked "
                  f"{pipe.get('host_blocked_seconds', 0):g} s over "
                  f"{pipe.get('chunks', 0)} chunks")
+    fm = record.get("fault_model")
+    ftail = ""
+    if isinstance(fm, dict) and fm.get("spec"):
+        ftail = f"; fault model {fm['spec']}"
     return (f"Setup: decode {record.get('decode_seconds', 0):g} s, "
             f"compile {record.get('compile_seconds', 0):g} s{extra} "
             f"(compile cache {cache.get('compile', '?')}, "
-            f"dataset cache {cache.get('dataset', '?')})" + ptail)
+            f"dataset cache {cache.get('dataset', '?')})" + ptail
+            + ftail)
 
 
 class MetricsLogger:

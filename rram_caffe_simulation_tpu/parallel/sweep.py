@@ -37,27 +37,40 @@ from .mesh import (make_mesh, global_put, put_rows, config_sharding,
 #: `manifest.json` (written last: the commit record) plus a
 #: `global.npz` for replicated leaves — and resharding on restore: a
 #: checkpoint written on any config-shard topology restores onto any
-#: other (8 chips -> 4 -> 1) bit-exactly. restore() upgrades v1
-#: (identity lane map assumed), v2, and v3 (fault leaves converted to
-#: the runner's format) checkpoints in place and refuses anything else.
-CHECKPOINT_VERSION = 4
+#: other (8 chips -> 4 -> 1) bit-exactly; v5 added the pluggable
+#: fault-process stack (fault/processes/) — the meta carries the
+#: canonical `fault_process` spec and restore() refuses a mismatched
+#: process (a v1-v4 checkpoint is implicitly the endurance_stuck_at
+#: default, so legacy stuck-at state upgrades in place). restore()
+#: upgrades v1 (identity lane map assumed), v2, v3 (fault leaves
+#: converted to the runner's format), and v4 checkpoints in place and
+#: refuses anything else.
+CHECKPOINT_VERSION = 5
+
+#: the implicit fault process of every pre-v5 checkpoint
+_LEGACY_PROCESS = "endurance_stuck_at"
 
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
-                       n_configs: int, means=None, stds=None, rows=None):
+                       n_configs: int, means=None, stds=None, rows=None,
+                       process=None):
     """n_configs independent fault-state draws, stacked on axis 0.
     `means`/`stds` optionally override pattern.mean/std per config
     (the run_different_mean.sh / run_different_mean_var.sh grids).
     `rows=(lo, hi)` draws only that row block of the stack — the
     sharded-draw path (engine.draw_state_rows): a pod process
     materializes just the configs its chips own, bit-identical to the
-    same rows of the full draw."""
+    same rows of the full draw. `process` (a fault/processes
+    ProcessStack) draws through the configured fault-process stack;
+    None = the legacy endurance kernel (bit-identical to the default
+    stack)."""
     mean = (np.asarray(means, np.float32) if means is not None
             else np.full((n_configs,), float(pattern.mean), np.float32))
     std = (np.asarray(stds, np.float32) if stds is not None
            else np.full((n_configs,), float(pattern.std), np.float32))
     return fault_engine.draw_state_rows(key, param_shapes, pattern,
-                                        n_configs, mean, std, rows=rows)
+                                        n_configs, mean, std, rows=rows,
+                                        process=process)
 
 
 class _HealingState:
@@ -339,7 +352,8 @@ class SweepRunner:
                    else self._cfg_rows[1] - self._cfg_rows[0])
         self.fault_states = stack_fault_states(
             key, shapes, solver.param.failure_pattern, n_configs,
-            means=means, stds=stds, rows=self._cfg_rows)
+            means=means, stds=stds, rows=self._cfg_rows,
+            process=solver.fault_process)
         bcast = lambda x: jnp.repeat(x[None], n_local, axis=0)
         if "remap_slots" in (solver.fault_state or {}):
             # tracked remapping: every config starts at the identity map
@@ -350,11 +364,25 @@ class SweepRunner:
             # banks (host, once at build): the counter dtype is sized
             # analytically from EVERY configured (mean, std) so later
             # lane refills drawing from the same specs can never
-            # overflow the banks
+            # overflow the banks. The write quantum comes from the
+            # fault-process stack (the endurance default is the
+            # solver's fail_decrement; read_disturb substitutes its
+            # per-step read count), and a stack whose state cannot ride
+            # the banks refuses here rather than corrupting silently.
             from ..fault import packed as fault_packed
+            stack = solver.fault_process
+            if stack is not None and not stack.supports_packed:
+                raise ValueError(
+                    "packed_state=True is not supported by fault "
+                    f"process(es) {stack.unpackable()} of the "
+                    f"configured stack {stack.canonical()!r} (no "
+                    "lifetime counters to bank); build with "
+                    "packed_state=False")
             fp_pat = solver.param.failure_pattern
+            quantum = (stack.write_quantum(solver.fail_decrement)
+                       if stack is not None else solver.fail_decrement)
             self._pack_spec = fault_packed.make_pack_spec(
-                solver.fault_state, solver.fail_decrement,
+                solver.fault_state, quantum,
                 means=(self._means if self._means is not None
                        else [float(fp_pat.mean)]),
                 stds=(self._stds if self._stds is not None
@@ -786,8 +814,15 @@ class SweepRunner:
         key = jax.random.fold_in(
             jax.random.fold_in(
                 jax.random.fold_in(s._key, 0xFA117), cfg), attempt)
-        st = fault_engine.draw_rescaled_state(
-            key, shapes, s.param.failure_pattern, mean, std)
+        if s.fault_process is not None:
+            # the configured fault-process stack draws the refill rows
+            # (the default endurance stack delegates to the legacy
+            # kernel — bit-identical)
+            st = s.fault_process.draw_rescaled(
+                key, shapes, s.param.failure_pattern, mean, std)
+        else:
+            st = fault_engine.draw_rescaled_state(
+                key, shapes, s.param.failure_pattern, mean, std)
         if "remap_slots" in (s.fault_state or {}):
             # tracked remapping restarts at the identity map
             st["remap_slots"] = s.fault_state["remap_slots"]
@@ -1502,6 +1537,8 @@ class SweepRunner:
         self.setup.fault_format = ("packed" if self._pack_spec is not None
                                    else "f32")
         self.setup.config_shards = int(self.mesh.shape.get("config", 1))
+        fs = getattr(self.solver, "fault_spec", None)
+        self.setup.fault_model = fs.to_model() if fs is not None else None
         return self.setup.record(setup_s)
 
     def _owned_config_block(self) -> tuple:
@@ -2164,6 +2201,12 @@ class SweepRunner:
             for group, tree in self.fault_states.items()}
         self.quarantine = arrays["quarantine"]
 
+    def _process_canonical(self) -> str:
+        """The canonical fault-process spec this runner trains under —
+        the v5 checkpoint pin restore() compares."""
+        fs = getattr(self.solver, "fault_spec", None)
+        return fs.canonical() if fs is not None else _LEGACY_PROCESS
+
     def _ckpt_meta(self) -> dict:
         """The checkpoint meta block (shared by the single-file layout,
         where it rides as the __meta__ array, and the distributed
@@ -2176,6 +2219,11 @@ class SweepRunner:
                 "fault_format": ("packed" if self._pack_spec is not None
                                  else "f32"),
                 "pack_spec": self._pack_spec,
+                # v5: the fault physics this state was trained under —
+                # restoring into a different process stack would replay
+                # the wrong transition timeline, so restore() refuses a
+                # mismatch
+                "fault_process": self._process_canonical(),
                 "key": [int(x)
                         for x in np.asarray(self.solver._key).ravel()],
                 "seed": int(self.solver.seed),
@@ -2477,19 +2525,32 @@ class SweepRunner:
         self.solver.wait_for_snapshots()
         data, meta, gen = self._load_checkpoint_data(path)
         found = meta.get("version")
-        if found not in (1, 2, 3, CHECKPOINT_VERSION):
+        if found not in (1, 2, 3, 4, CHECKPOINT_VERSION):
             raise ValueError(
                 f"checkpoint {path} has format version {found!r} but "
                 f"this build expects version {CHECKPOINT_VERSION} "
-                "(v1/v2/v3 checkpoints are upgraded in place: v1 has "
+                "(v1-v4 checkpoints are upgraded in place: v1 has "
                 "no lane map, so the identity lane->config mapping is "
                 "assumed; pre-v3 fault leaves are f32 and convert to "
                 "this runner's fault format on load; v4 adds the "
-                "distributed directory layout)")
+                "distributed directory layout; v5 pins the fault-"
+                "process spec — pre-v5 state is endurance_stuck_at)")
         if int(meta["n_configs"]) != self.n:
             raise ValueError(
                 f"checkpoint {path} holds {meta['n_configs']} configs "
                 f"but this runner was built with {self.n}")
+        # v5 fault-process pin: legacy (pre-v5) checkpoints are
+        # implicitly the endurance default — they upgrade in place into
+        # an endurance runner and refuse anything else
+        ck_proc = meta.get("fault_process", _LEGACY_PROCESS)
+        my_proc = self._process_canonical()
+        if str(ck_proc) != my_proc:
+            raise ValueError(
+                f"checkpoint {path} was trained under fault process "
+                f"{ck_proc!r} but this runner runs {my_proc!r}; "
+                "restoring across fault physics would replay the wrong "
+                "transition timeline — resume with the same "
+                "fault_process spec the checkpoint was written under")
         key = [int(x) for x in np.asarray(self.solver._key).ravel()]
         if list(meta["key"]) != key:
             raise ValueError(

@@ -328,6 +328,12 @@ class SweepService:
     def _drain_file(self) -> bool:
         return os.path.exists(os.path.join(self.dir, "DRAIN"))
 
+    def _process_canonical(self) -> str:
+        """The canonical fault-process spec the resident runner trains
+        under (fault/processes/) — what a request's optional `process`
+        pin is compared against."""
+        return self.runner._process_canonical()
+
     def _admit_pending(self) -> int:
         admitted = 0
         for rid in self.spool.pending_ids():
@@ -372,6 +378,27 @@ class SweepService:
                                   "(service started without "
                                   "allow_inject)")
                 continue
+            want_proc = req.get("process")
+            if want_proc is not None:
+                # the resident lane pool trains ONE compiled fault-
+                # process stack; a request pinning a different physics
+                # is refused rather than silently mis-served. The pin
+                # is compared CANONICALIZED (FaultSpec normalizes stack
+                # order and param formatting) so any equivalent
+                # spelling of the same physics is accepted.
+                from ..fault.processes import FaultSpec
+                mine = self._process_canonical()
+                try:
+                    want_canon = FaultSpec.parse(want_proc).canonical()
+                except Exception as e:
+                    self._reject(req, f"unparseable fault-process pin "
+                                      f"{want_proc!r}: {e}")
+                    continue
+                if want_canon != mine:
+                    self._reject(req, f"request pins fault process "
+                                      f"{want_canon!r} but this "
+                                      f"service runs {mine!r}")
+                    continue
             extra = req["iters"] * len(req["configs"])
             projected = self._projected_seconds(extra)
             at_risk = (self.slo_seconds > 0 and projected

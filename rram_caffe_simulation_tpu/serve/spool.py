@@ -82,6 +82,19 @@ def normalize_request(req: dict, default_iters: int = 0) -> dict:
                         f"{spec[key]!r}") from None
         specs.append(clean)
     out["configs"] = specs
+    proc = out.get("process")
+    if proc is not None:
+        # optional fault-process pin (fault/processes/ spec syntax):
+        # the resident service trains ONE compiled process stack, so a
+        # request naming a different one is refused at admission (the
+        # service compares this string against its runner's canonical
+        # spec) instead of silently training the wrong physics
+        if not isinstance(proc, str) or not proc.strip() \
+                or len(proc) > 256:
+            raise ValueError(
+                f"request process {proc!r} must be a non-empty "
+                "fault-process spec string (at most 256 chars)")
+        out["process"] = proc.strip()
     iters = out.get("iters") or default_iters
     if not iters:
         # no explicit budget and no default known HERE (e.g. the

@@ -100,7 +100,8 @@ class Solver:
 
     def __init__(self, param, train_feed: Optional[Callable] = None,
                  test_feeds=None, compute_dtype=None,
-                 fail_decrement: Optional[float] = None):
+                 fail_decrement: Optional[float] = None,
+                 fault_process=None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
         # cold-start layer: when RRAM_TPU_CACHE_DIR is set, every jitted
@@ -187,6 +188,17 @@ class Solver:
                              f"{fail_decrement!r} (the reference "
                              "default is 100: failure_maker.cpp:75)")
         self.fail_decrement = float(fail_decrement)
+        # Fault-process selection (fault/processes/ registry, ROADMAP
+        # item 4): `fault_process` is a spec string ("endurance_stuck_at"
+        # — the reference model and bit-identical default — or e.g.
+        # "endurance_stuck_at+conductance_drift:nu=0.2") or a FaultSpec.
+        # The stack owns the fault-state groups and the in-step Fail
+        # transform; the default single-endurance stack delegates to
+        # the legacy engine functions, so it traces to the identical
+        # program (scripts/check_fault_processes.py is the CI guard).
+        from ..fault.processes import DEFAULT_PROCESS, FaultSpec
+        self.fault_spec = FaultSpec.parse(fault_process)
+        self.fault_process = None   # ProcessStack once the engine is on
         self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
                             for r in self.net.failure_param_refs]
         if (param.HasField("failure_pattern")
@@ -206,11 +218,21 @@ class Solver:
                 and param.failure_pattern.type == "gaussian"):
             # Like FailureMaker::CreateMaker (failure_maker.hpp:23-30), any
             # other type (e.g. "none") means no fault engine.
+            self.fault_process = self.fault_spec.build()
             self._key, k_fault = jax.random.split(self._key)
             shapes = {k: self._flat(self.params)[k].shape
                       for k in self._fault_keys}
-            self.fault_state = fault_engine.init_fault_state(
+            self.fault_state = self.fault_process.init_state(
                 k_fault, shapes, param.failure_pattern)
+        elif self.fault_spec.canonical() != DEFAULT_PROCESS:
+            # a non-default process selection with no active engine
+            # would silently train fault-free physics the user did not
+            # ask for
+            raise ValueError(
+                f"fault_process {self.fault_spec.canonical()!r} is "
+                "configured but no fault engine is active — it needs "
+                "failure_pattern { type: 'gaussian' } and at least one "
+                "fault-target layer")
         if (param.HasField("rram_forward")
                 and (param.rram_forward.sigma or param.rram_forward.adc_bits)
                 and self.fault_state is None):
@@ -227,12 +249,33 @@ class Solver:
             raise ValueError(
                 "rram_forward.adc_bits = 1 gives a symmetric quantizer "
                 "zero levels (2^(bits-1)-1 == 0); use adc_bits >= 2")
+        if (param.HasField("rram_forward")
+                and (param.rram_forward.sigma
+                     or param.rram_forward.adc_bits)
+                and self.fault_process is not None
+                and not self.fault_process.has_lifetimes):
+            raise ValueError(
+                "rram_forward reads the broken/stuck masks of a "
+                "clamp-family fault process (endurance_stuck_at, "
+                "read_disturb, permanent_fault_map), but the configured "
+                f"stack {self.fault_spec.canonical()!r} has none")
         flat0 = self._flat(self.params)
         hidden_sizes = [int(flat0[w].shape[0])
                         for w, _ in self.fc_pairs[:-1]]
         self.strategies = fault_strategies.build_strategies(
             param, self.fc_pairs, prune_net_loader=self._load_prune_net,
             hidden_sizes=hidden_sizes)
+        if (self.fault_process is not None
+                and not self.fault_process.has_lifetimes
+                and (self.strategies.prune_orders is not None
+                     or self.strategies.genetic is not None)):
+            # the remap/genetic mitigation strategies are defined over
+            # the lifetimes/stuck flag matrices (strategy.cpp:36-45)
+            raise ValueError(
+                "the remap/genetic failure strategies read the "
+                "lifetimes/stuck state of a clamp-family fault "
+                "process, but the configured stack "
+                f"{self.fault_spec.canonical()!r} has none")
         if self.strategies.remap_tracked:
             if self.fault_state is None:
                 raise ValueError(
@@ -429,6 +472,13 @@ class Solver:
         fc_pairs = self.fc_pairs
         strategies = self.strategies
         decrement = self.fail_decrement
+        # the configured fault-process stack (fault/processes/) owns the
+        # Fail transform; a solver whose fault_state was installed
+        # out-of-band (tests) falls back to the default endurance stack
+        # — the exact legacy engine semantics
+        process = self.fault_process
+        if process is None and self.fault_state is not None:
+            process = self.fault_spec.build()
         lr_mults = {fault_engine.param_key(r.layer_name, r.slot): r.lr_mult
                     for r in owner_refs}
         decay_mults = {fault_engine.param_key(r.layer_name, r.slot):
@@ -529,7 +579,9 @@ class Solver:
                 return {k: fault_packed.unpack_lifetimes(
                             q, pack_spec["decrement"])
                         for k, q in fault_state["life_q"].items()}
-            return fault_state["lifetimes"]
+            # a decay-only process stack (no clamp family) carries no
+            # lifetime groups; consumers treat {} as "no census"
+            return fault_state.get("lifetimes", {})
 
         def _to_run(tree):
             return jax.tree.map(
@@ -709,7 +761,7 @@ class Solver:
                         from ..observe import counters as obs_counters
                         writes_saved = obs_counters.write_traffic_saved(
                             fd_before, fd, fault_engine.EPSILON,
-                            lifetimes=(_life_view(fault_state)
+                            lifetimes=((_life_view(fault_state) or None)
                                        if has_fault else None))
                     upd.update(fd)
                 if strategies.prune_orders is not None and has_fault:
@@ -763,13 +815,16 @@ class Solver:
                 if has_fault:
                     fp = {k: data[k] for k in fault_keys}
                     fd = {k: upd[k] for k in fault_keys}
+                    # the process stack applies each configured fault
+                    # physics in canonical order (decay first, clamp
+                    # last); the default endurance stack delegates to
+                    # engine.fail / fault_packed.fail_packed — the
+                    # byte-identical legacy path
                     if packed_on:
-                        # native integer decrement on the counter banks
-                        # — transition timeline identical to fail()
-                        fp, fault_state = fault_packed.fail_packed(
+                        fp, fault_state = process.fail_packed(
                             fp, fault_state, fd, pack_spec)
                     else:
-                        fp, fault_state = fault_engine.fail(
+                        fp, fault_state = process.fail(
                             fp, fault_state, fd, decrement)
                     data.update(fp)
 
@@ -796,6 +851,13 @@ class Solver:
                             prev_life, _life_view(fault_state))
                         totals["writes_saved"] = writes_saved
                         metrics["fault"] = {**totals, "per_param": per}
+                        # per-process census contributions (broken /
+                        # drifted columns) — the observe tree names the
+                        # physics that produced each number
+                        pp = process.counters(fault_state,
+                                              _life_view(fault_state))
+                        if pp:
+                            metrics["fault"]["per_process"] = pp
 
             # -- debug_info deep trace + sentinels (observe/debug.py) --
             if debug_on:
@@ -1790,10 +1852,14 @@ class Solver:
             # console line always, plus a `fault_redraw` observe record
             # when sinks are attached.
             from ..observe import sink as obs_sink
+            active = (self.fault_spec.canonical()
+                      if getattr(self, "fault_spec", None) is not None
+                      else "endurance_stuck_at")
             rec = obs_sink.make_fault_redraw_record(
                 self.iter, fault_file,
-                "snapshot predates fault-state capture; lifetimes and "
-                "stuck values re-drawn from the failure_pattern")
+                "snapshot predates fault-state capture; fault state "
+                f"re-drawn from the failure_pattern (active fault "
+                f"process: {active})")
             print("WARNING: " + obs_sink.fault_redraw_line(rec),
                   file=sys.stderr, flush=True)
             if self.metrics_logger is not None:
@@ -1801,7 +1867,27 @@ class Solver:
         if self.fault_state is not None and os.path.exists(fault_file):
             restored = fault_engine.fault_state_from_proto(
                 uio.read_proto_binary(fault_file, pb.NetParameter()))
-            saved, live = set(restored["lifetimes"]), set(self._fault_keys)
+            # remap_slots excluded: a pre-extension snapshot restarts
+            # the tracked map at identity (handled below)
+            live_groups = set(self.fault_state) - {"remap_slots"}
+            saved_groups = set(restored) - {"remap_slots"}
+            if saved_groups != live_groups:
+                # e.g. a .faultstate written under a different fault-
+                # process stack (drift groups present/absent): adopting
+                # it would KeyError at the next traced step or silently
+                # drop saved physics state
+                active = (self.fault_spec.canonical()
+                          if getattr(self, "fault_spec", None)
+                          is not None else "endurance_stuck_at")
+                raise ValueError(
+                    f"fault state in {fault_file} carries state groups "
+                    f"{sorted(saved_groups)} but this solver's fault "
+                    f"process {active!r} expects "
+                    f"{sorted(live_groups)}; resume with the same "
+                    "fault_process the snapshot was taken under")
+            saved = set(restored.get("lifetimes", {}))
+            live = (set(self._fault_keys)
+                    if "lifetimes" in self.fault_state else set())
             if saved != live:
                 # e.g. failure_pattern.conv_also toggled across the
                 # snapshot boundary: adopting the file's key set would

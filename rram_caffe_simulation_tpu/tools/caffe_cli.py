@@ -79,7 +79,8 @@ def train(args):
         sys.exit("Give a snapshot to resume OR weights to finetune, "
                  "not both")
     solver = Solver(args.solver,
-                    compute_dtype=args.compute_dtype or None)
+                    compute_dtype=args.compute_dtype or None,
+                    fault_process=args.fault_process)
     if args.metrics_out:
         # observe package layer 2: one record per display interval.
         # Extension picks the sink — .jsonl gets the schema-versioned
@@ -639,6 +640,16 @@ def main(argv=None):
                         "print a diagnostic naming the offending phase/"
                         "layer and stop ('halt'), or snapshot first "
                         "via the SIGINT snapshot path ('snapshot')")
+    p.add_argument("--fault-process", "--fault_process",
+                   default="endurance_stuck_at", dest="fault_process",
+                   help="train: fault-process stack spec "
+                        "(fault/processes/ registry) — e.g. "
+                        "endurance_stuck_at (default, the reference "
+                        "model), conductance_drift:nu=0.2, "
+                        "read_disturb, permanent_fault_map:fraction="
+                        "0.05, or a '+'-joined stack like "
+                        "endurance_stuck_at+conductance_drift; needs "
+                        "an active failure_pattern in the solver")
     p.add_argument("--cache-dir", default="",
                    help="cold-start cache root (overrides the "
                         "RRAM_TPU_CACHE_DIR env var): <dir>/xla holds "

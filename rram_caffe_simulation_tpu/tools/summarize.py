@@ -123,6 +123,14 @@ def _fmt_num(v):
     return str(v)
 
 
+def _scalarize(v):
+    """A sweep record's per-config vector digests as its mean; scalars
+    pass through."""
+    if isinstance(v, list):
+        return float(np.mean(v)) if v else None
+    return v
+
+
 def _request_digest(requests):
     """Digest of sweep-service `request` lifecycle records: per-event
     counts, per-tenant turnaround, and the completion-latency spread
@@ -272,6 +280,19 @@ def summarize_metrics(path):
                 lines.append(f"  {key:20s} broken="
                              f"{_fmt_num(e.get('broken'))} "
                              f"life_mean={_fmt_num(e.get('life_mean'))}")
+        pp = fault.get("per_process")
+        if isinstance(pp, dict):
+            # per-process census columns (fault/processes/): broken /
+            # drifted counts keyed by the physics that produced them;
+            # sweep records carry per-config vectors — digest the mean
+            for pname in sorted(pp):
+                entry = pp[pname]
+                if not isinstance(entry, dict):
+                    continue
+                cols = " ".join(
+                    f"{c}={_fmt_num(_scalarize(entry[c]))}"
+                    for c in sorted(entry))
+                lines.append(f"  process {pname:20s} {cols}")
     return "\n".join(lines)
 
 

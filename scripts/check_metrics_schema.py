@@ -45,11 +45,19 @@ SAMPLE_GOOD = {
               "life_min": -35.0, "life_mean": 9.1e7, "writes_saved": 4096,
               "per_param": {"fc1/0": {"broken": 100, "newly_expired": 5,
                                       "life_min": -35.0,
-                                      "life_mean": 8.9e7}}},
+                                      "life_mean": 8.9e7}},
+              # per-process census contributions (fault/processes/)
+              "per_process": {"endurance_stuck_at": {"broken": 120},
+                              "conductance_drift": {"drifted": 9000,
+                                                    "age_mean": 41.2}}},
 }
 
 SAMPLE_BAD = {"schema_version": 1, "iter": -3, "loss": "NaN-ish",
-              "fault": {"broken_total": 1.5}}
+              "fault": {"broken_total": 1.5,
+                        # counters must be non-empty objects of numbers
+                        "per_process": {"conductance_drift": {},
+                                        "read_disturb": {
+                                            "broken": "lots"}}}}
 
 # a sweep record with quarantined configs (per-config loss vector +
 # the quarantine id list the NaN/Inf quarantine surfaced)
@@ -183,6 +191,11 @@ SAMPLE_GOOD_SETUP = {
                  "consumer_seconds": 3.4, "drain_seconds": 0.8,
                  "snapshot_write_seconds": 1.2,
                  "setup_overlap_seconds": 12.1},
+    # the fault-process stack + explicit params the run trains under
+    # (fault/processes/FaultSpec.to_model)
+    "fault_model": {"spec": "conductance_drift:nu=0.2"
+                            "+endurance_stuck_at",
+                    "processes": {"conductance_drift": {"nu": 0.2}}},
 }
 
 SAMPLE_BAD_SETUP = {
@@ -192,6 +205,9 @@ SAMPLE_BAD_SETUP = {
     "cache": {"compile": "sideways"},                # bad state, no dataset
     "bytes_per_step_est": -10,                       # negative bytes
     "fault_state_format": "origami",                 # unknown format
+    "fault_model": {"spec": "",                      # empty spec
+                    "processes": {"conductance_drift": {
+                        "nu": [0.2]}}},              # not number/string
     "pipeline": {"depth": 2,                         # chunks missing
                  "host_blocked_seconds": -0.5},      # negative time
 }

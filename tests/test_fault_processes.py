@@ -1,0 +1,596 @@
+"""Fault-process subsystem tests (fault/processes/, ISSUE 10): the
+registry + FaultSpec surface, per-process physics semantics, stack
+composition, the solver/sweep integration, checkpoint v5 round-trips
+(incl. packed-state interplay and the v4->v5 legacy upgrade), and the
+observe-schema extensions (`fault_model` setup field, `per_process`
+census counters)."""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.core.registry import (
+    FAULT_PROCESS_REGISTRY, create_fault_process, register_fault_process)
+from rram_caffe_simulation_tpu.fault import engine, codesign
+from rram_caffe_simulation_tpu.fault.processes import (
+    ConductanceDrift, EnduranceStuckAt, FaultSpec, PermanentFaultMap,
+    ProcessStack, ReadDisturb)
+from rram_caffe_simulation_tpu.observe.schema import validate_record
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+
+
+def make_pattern(mean=1000.0, std=0.0):
+    return pb.FailurePatternParameter(type="gaussian", mean=mean,
+                                      std=std)
+
+
+SHAPES = {"ip/0": (6, 4), "ip/1": (4,)}
+
+
+def fault_solver(prefix, fault_process=None, mean=300.0, std=50.0,
+                 metrics_sink=None):
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+base_lr: 0.05 lr_policy: "fixed" momentum: 0.9 type: "SGD"
+max_iter: 1000 display: 1 random_seed: 3
+net_param {
+  name: "t"
+  layer { name: "data" type: "Input" top: "data" top: "target"
+    input_param { shape { dim: 8 dim: 6 } shape { dim: 8 dim: 4 } } }
+  layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param { num_output: 4
+      weight_filler { type: "xavier" } } }
+  layer { name: "loss" type: "EuclideanLoss" bottom: "ip"
+    bottom: "target" top: "loss" }
+}
+""", sp)
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = mean
+    sp.failure_pattern.std = std
+    sp.snapshot_prefix = str(prefix)
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 4).astype(np.float32)
+    s = Solver(sp, train_feed=lambda: {"data": data, "target": target},
+               fault_process=fault_process)
+    if metrics_sink is not None:
+        s.enable_metrics(metrics_sink)
+    return s
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def state_bytes(state):
+    return {n: np.asarray(v).tobytes()
+            for n, v in engine.iter_state_leaves(state)}
+
+
+# ---------------------------------------------------------------------------
+# registry + spec surface
+
+def test_registry_contents_and_errors():
+    assert set(FAULT_PROCESS_REGISTRY) >= {
+        "endurance_stuck_at", "conductance_drift", "read_disturb",
+        "permanent_fault_map"}
+    with pytest.raises(KeyError, match="Unknown fault process"):
+        create_fault_process("bit_rot")
+    with pytest.raises(KeyError, match="registered twice"):
+        register_fault_process("endurance_stuck_at")(object)
+
+
+def test_unknown_process_param_raises():
+    with pytest.raises(ValueError, match="does not accept"):
+        ConductanceDrift({"mu": 0.1})
+
+
+def test_spec_parse_and_canonical():
+    s = FaultSpec.parse("endurance_stuck_at+conductance_drift"
+                        ":sigma=0.1, nu=0.2")
+    # canonical order: decay before clamp, params sorted
+    assert s.canonical() == ("conductance_drift:nu=0.2,sigma=0.1"
+                             "+endurance_stuck_at")
+    # order-insensitive equality via canonical
+    s2 = FaultSpec.parse("conductance_drift:nu=0.2,sigma=0.1"
+                         "+endurance_stuck_at")
+    assert s.canonical() == s2.canonical()
+    assert FaultSpec.parse(None).canonical() == "endurance_stuck_at"
+    assert FaultSpec.parse("").canonical() == "endurance_stuck_at"
+    with pytest.raises(ValueError, match="key=value"):
+        FaultSpec.parse("conductance_drift:nu")
+    with pytest.raises(KeyError, match="Unknown fault process"):
+        FaultSpec.parse("bit_rot").build()
+
+
+def test_spec_to_model_schema_shape():
+    model = FaultSpec.parse("conductance_drift:nu=0.2").to_model()
+    assert model["spec"] == "conductance_drift:nu=0.2"
+    assert model["processes"] == {"conductance_drift": {"nu": 0.2}}
+    assert "processes" not in FaultSpec.parse(None).to_model()
+
+
+def test_stack_composition_rules():
+    with pytest.raises(ValueError, match="at most one clamp"):
+        ProcessStack([EnduranceStuckAt(), ReadDisturb()])
+    with pytest.raises(ValueError, match="listed twice"):
+        ProcessStack([ConductanceDrift(), ConductanceDrift()])
+    stack = ProcessStack([EnduranceStuckAt(), ConductanceDrift()])
+    # clamp runs last whatever the construction order
+    assert [p.process_name for p in stack.processes] == [
+        "conductance_drift", "endurance_stuck_at"]
+    assert stack.has_lifetimes and stack.supports_packed
+    drift_only = ProcessStack([ConductanceDrift()])
+    assert not drift_only.has_lifetimes
+    assert not drift_only.supports_packed
+    assert drift_only.unpackable() == ["conductance_drift"]
+
+
+# ---------------------------------------------------------------------------
+# per-process physics
+
+def test_endurance_delegates_byte_identically():
+    key = jax.random.PRNGKey(11)
+    pat = make_pattern(mean=500.0, std=100.0)
+    stack = FaultSpec.parse("endurance_stuck_at").build()
+    assert state_bytes(stack.init_state(key, SHAPES, pat)) == \
+        state_bytes(engine.init_fault_state(key, SHAPES, pat))
+    assert state_bytes(
+        stack.draw_rescaled(key, SHAPES, pat, 800.0, 90.0)) == \
+        state_bytes(engine.draw_rescaled_state(key, SHAPES, pat,
+                                               800.0, 90.0))
+
+
+def test_drift_reanchors_on_write_and_decays_log_time():
+    d = ConductanceDrift({"nu": 0.5, "target": 0.0})
+    state = d.init_state(jax.random.PRNGKey(0), {"w": (1, 4)},
+                         make_pattern())
+    w = {"w": jnp.full((1, 4), 2.0)}
+    written = {"w": jnp.asarray([[1.0, 0.0, 0.0, 0.0]])}
+    # step 1: cell 0 written (re-anchored, no decay); others decay
+    w1, st1 = d.fail(w, state, written, 100.0)
+    a1 = np.asarray(st1["drift_age"]["w"])[0]
+    v1 = np.asarray(w1["w"])[0]
+    assert a1[0] == 0.0 and a1[1] == 1.0
+    assert v1[0] == 2.0               # re-anchored: untouched
+    assert v1[1] < 2.0                # drifting toward target 0
+    # cumulative decay after a unwritten steps is (1+a)^-nu exactly
+    rate = float(np.asarray(state["drift_rate"]["w"])[0, 1])
+    assert np.isclose(v1[1], 2.0 * (1 + 1) ** -rate, rtol=1e-5)
+    # step 2, nothing written: the log-time increment SHRINKS
+    none = {"w": jnp.zeros((1, 4))}
+    w2, st2 = d.fail(w1, st1, none, 100.0)
+    v2 = np.asarray(w2["w"])[0]
+    assert np.isclose(v2[1], 2.0 * (1 + 2) ** -rate, rtol=1e-5)
+    assert (v1[1] - v2[1]) < (2.0 - v1[1])   # decelerating decay
+    # written cell now ages too (no write this step)
+    assert np.asarray(st2["drift_age"]["w"])[0, 0] == 1.0
+
+
+def test_read_disturb_decrements_without_writes():
+    rd = ReadDisturb()
+    state = {"lifetimes": {"w": jnp.asarray([[150.0, 50.0, -5.0]])},
+             "stuck": {"w": jnp.asarray([[0.0, -1.0, 1.0]])}}
+    w = {"w": jnp.full((1, 3), 0.5)}
+    zero_diffs = {"w": jnp.zeros((1, 3))}
+    # zero diffs would freeze the endurance timeline; reads still wear
+    w1, st1 = rd.fail(w, state, zero_diffs, 100.0)
+    life = np.asarray(st1["lifetimes"]["w"])[0]
+    vals = np.asarray(w1["w"])[0]
+    assert life[0] == 50.0 and vals[0] == 0.5
+    assert life[1] == -50.0 and vals[1] == -1.0   # broke on the read
+    assert life[2] == -5.0 and vals[2] == 1.0     # already broken
+    # explicit reads_per_step overrides the write-quantum default
+    rd2 = ReadDisturb({"reads_per_step": 25.0})
+    assert rd2.write_quantum(100.0) == 25.0
+    assert rd.write_quantum(100.0) == 100.0
+
+
+def test_permanent_fault_map_is_static():
+    pm = PermanentFaultMap({"fraction": 0.5})
+    pat = make_pattern()
+    state = pm.init_state(jax.random.PRNGKey(1), {"w": (8, 8)}, pat)
+    life = np.asarray(state["lifetimes"]["w"])
+    assert set(np.unique(life)) <= {-1.0, 1.0}
+    assert 0.2 < (life < 0).mean() < 0.8
+    w = {"w": jnp.full((8, 8), 0.5)}
+    diffs = {"w": jnp.ones((8, 8))}
+    w1, st1 = pm.fail(w, state, diffs, 100.0)
+    # no dynamics: state unchanged however much is written
+    assert state_bytes(st1) == state_bytes(state)
+    vals = np.asarray(w1["w"])
+    stuck = np.asarray(state["stuck"]["w"])
+    assert np.array_equal(vals[life < 0], stuck[life < 0])
+    assert np.all(vals[life > 0] == 0.5)
+    with pytest.raises(ValueError, match="exactly one of"):
+        PermanentFaultMap({})
+    with pytest.raises(ValueError, match="exactly one of"):
+        PermanentFaultMap({"fraction": 0.1, "map": "x.npz"})
+
+
+def test_permanent_fault_map_from_file(tmp_path):
+    path = str(tmp_path / "map.npz")
+    broken = np.zeros((6, 4), bool)
+    broken[0, 0] = broken[2, 3] = True
+    stuck = np.zeros((6, 4), np.float32)
+    stuck[0, 0] = -1.0
+    np.savez(path, **{"ip/0/broken": broken, "ip/0/stuck": stuck})
+    pm = PermanentFaultMap({"map": path})
+    state = pm.init_state(jax.random.PRNGKey(0), SHAPES,
+                          make_pattern())
+    life = np.asarray(state["lifetimes"]["ip/0"])
+    assert (life < 0).sum() == 2
+    # missing keys = fault-free parameter
+    assert np.all(np.asarray(state["lifetimes"]["ip/1"]) > 0)
+    # per-config file maps are identical (the chip IS the chip)
+    a = pm.draw_rescaled(jax.random.PRNGKey(1), SHAPES, make_pattern(),
+                         1.0, 2.0)
+    assert state_bytes(a) == state_bytes(state)
+    bad = str(tmp_path / "bad.npz")
+    np.savez(bad, **{"ip/0/broken": np.zeros((2, 2), bool),
+                     "ip/0/stuck": np.zeros((2, 2), np.float32)})
+    with pytest.raises(ValueError, match="shape"):
+        PermanentFaultMap({"map": bad}).init_state(
+            jax.random.PRNGKey(0), SHAPES, make_pattern())
+
+
+# ---------------------------------------------------------------------------
+# solver integration
+
+def test_solver_endurance_matches_legacy_shim(tmp_path):
+    class LegacyShim:
+        has_lifetimes = True
+
+        def fail(self, p, s, d, dec):
+            return engine.fail(p, s, d, dec)
+
+        def counters(self, s, lv):
+            return {}
+
+    a = fault_solver(tmp_path / "a")
+    b = fault_solver(tmp_path / "b")
+    b.fault_process = LegacyShim()
+    la, lb = [], []
+    for _ in range(8):
+        a.step(1)
+        la.append(a._materialize_smoothed_loss())
+        b.step(1)
+        lb.append(b._materialize_smoothed_loss())
+    assert la == lb
+    assert state_bytes(a.fault_state) == state_bytes(b.fault_state)
+
+
+def test_solver_drift_stack_trains_and_snapshots(tmp_path):
+    proc = "endurance_stuck_at+conductance_drift:nu=0.3"
+    s = fault_solver(tmp_path / "d", proc)
+    assert sorted(s.fault_state) == ["drift_age", "drift_rate",
+                                     "lifetimes", "stuck"]
+    s.step(5)
+    model = s.snapshot()
+    state_file = model.replace(".caffemodel", ".solverstate")
+    s2 = fault_solver(tmp_path / "d", proc)
+    s2.restore(state_file)
+    assert state_bytes(s.fault_state) == state_bytes(s2.fault_state)
+    # a default-process solver must refuse the drift .faultstate
+    s3 = fault_solver(tmp_path / "d")
+    with pytest.raises(ValueError, match="fault process"):
+        s3.restore(state_file)
+
+
+def test_solver_redraw_announcement_names_process(tmp_path, capsys):
+    proc = "endurance_stuck_at+conductance_drift:nu=0.2"
+    s = fault_solver(tmp_path / "r", proc)
+    s.step(2)
+    model = s.snapshot()
+    state_file = model.replace(".caffemodel", ".solverstate")
+    os.remove(model.replace(".caffemodel", ".faultstate"))
+    sink = ListSink()
+    s2 = fault_solver(tmp_path / "r", proc, metrics_sink=sink)
+    s2.restore(state_file)
+    err = capsys.readouterr().err
+    assert "RE-DRAWN" in err
+    assert "conductance_drift:nu=0.2+endurance_stuck_at" in err
+    recs = [r for r in sink.records
+            if r.get("type") == "fault_redraw"]
+    assert len(recs) == 1 and validate_record(recs[0]) == []
+    assert "conductance_drift" in recs[0]["reason"]
+
+
+def test_solver_rejects_process_without_engine(tmp_path):
+    sp = pb.SolverParameter()
+    text_format.Parse("""
+base_lr: 0.1 lr_policy: "fixed" type: "SGD" max_iter: 10 display: 0
+random_seed: 1
+net_param {
+  name: "nofault"
+  layer { name: "data" type: "Input" top: "data"
+    input_param { shape { dim: 2 dim: 3 } } }
+  layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param { num_output: 2
+      weight_filler { type: "xavier" } } }
+  layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" top: "l" }
+}
+""", sp)
+    sp.snapshot_prefix = str(tmp_path / "s")
+    with pytest.raises(ValueError, match="no fault engine"):
+        Solver(sp, train_feed=lambda: {},
+               fault_process="conductance_drift")
+
+
+def test_metrics_carry_per_process_counters(tmp_path):
+    sink = ListSink()
+    s = fault_solver(tmp_path / "m",
+                     "endurance_stuck_at+conductance_drift:nu=0.2",
+                     metrics_sink=sink)
+    s.step(3)
+    recs = [r for r in sink.records if r.get("type") is None]
+    assert recs
+    pp = recs[-1]["fault"]["per_process"]
+    assert set(pp) == {"endurance_stuck_at", "conductance_drift"}
+    assert pp["conductance_drift"]["drifted"] >= 0
+    assert "age_mean" in pp["conductance_drift"]
+    assert pp["endurance_stuck_at"]["broken"] == \
+        recs[-1]["fault"]["broken_total"]
+    assert all(validate_record(r) == [] for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# sweep integration: checkpoint v5, packed interplay, refill draws
+
+def _sweep(tmp_path, tag, fault_process=None, packed=False, n=3):
+    s = fault_solver(tmp_path / tag, fault_process, mean=300.0,
+                     std=50.0)
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    return SweepRunner(s, n_configs=n, means=[200.0, 300.0, 400.0][:n],
+                       stds=[40.0, 50.0, 60.0][:n], pipeline_depth=0,
+                       packed_state=packed)
+
+
+def test_checkpoint_v5_meta_and_roundtrip(tmp_path):
+    proc = "endurance_stuck_at+conductance_drift:nu=0.3"
+    r = _sweep(tmp_path, "a", proc)
+    r.step(4, chunk=2)
+    ck = r.checkpoint(str(tmp_path / "v5.ckpt.npz"))
+    with np.load(ck) as z:
+        meta = json.loads(bytes(bytearray(z["__meta__"])).decode())
+        names = set(z.files)
+    assert meta["version"] == 5
+    assert meta["fault_process"] == \
+        "conductance_drift:nu=0.3+endurance_stuck_at"
+    assert {"fault/drift_age/ip/0", "fault/drift_rate/ip/0",
+            "fault/lifetimes/ip/0"} <= names
+    l_ref, _ = r.step(4, chunk=2)
+    ref = {n: np.asarray(v).tobytes()
+           for n, v in r._state_arrays().items()}
+    r.close()
+
+    r2 = _sweep(tmp_path, "b", proc)
+    r2.restore(ck)
+    l_res, _ = r2.step(4, chunk=2)
+    res = {n: np.asarray(v).tobytes()
+           for n, v in r2._state_arrays().items()}
+    assert np.array_equal(np.asarray(l_ref), np.asarray(l_res))
+    assert ref == res
+    r2.close()
+
+
+def test_checkpoint_process_mismatch_refused(tmp_path):
+    r = _sweep(tmp_path, "a", "read_disturb")
+    r.step(2, chunk=2)
+    ck = r.checkpoint(str(tmp_path / "rd.ckpt.npz"))
+    r.close()
+    r2 = _sweep(tmp_path, "b")          # endurance default
+    with pytest.raises(ValueError, match="fault process"):
+        r2.restore(ck)
+    r2.close()
+
+
+def test_v4_checkpoint_upgrades_as_endurance(tmp_path):
+    r = _sweep(tmp_path, "a")
+    r.step(4, chunk=2)
+    ck = r.checkpoint(str(tmp_path / "v5.ckpt.npz"))
+    l_ref, _ = r.step(2, chunk=2)
+    r.close()
+    # rewrite the meta to the v4 shape (no fault_process pin)
+    with np.load(ck) as z:
+        data = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(bytearray(data["__meta__"])).decode())
+    meta["version"] = 4
+    meta.pop("fault_process")
+    data["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                     np.uint8)
+    v4 = str(tmp_path / "v4.ckpt.npz")
+    np.savez(v4, **data)
+    # upgrades into the endurance default...
+    r2 = _sweep(tmp_path, "b")
+    r2.restore(v4)
+    l_res, _ = r2.step(2, chunk=2)
+    assert np.array_equal(np.asarray(l_ref), np.asarray(l_res))
+    r2.close()
+    # ...and refuses a non-default process runner
+    r3 = _sweep(tmp_path, "c", "read_disturb")
+    with pytest.raises(ValueError, match="fault process"):
+        r3.restore(v4)
+    r3.close()
+
+
+def test_read_disturb_packed_matches_f32(tmp_path):
+    rp = _sweep(tmp_path, "p", "read_disturb", packed=True, n=2)
+    assert rp._pack_spec is not None
+    rp.step(4, chunk=2)
+    bf_packed = rp.broken_fractions()
+    rp.close()
+    rf = _sweep(tmp_path, "f", "read_disturb", n=2)
+    rf.step(4, chunk=2)
+    assert np.array_equal(bf_packed, rf.broken_fractions())
+    rf.close()
+
+
+def test_packed_with_drift_rides_banks_and_restores(tmp_path):
+    proc = "endurance_stuck_at+conductance_drift:nu=0.3"
+    r = _sweep(tmp_path, "pd", proc, packed=True, n=2)
+    # drift groups ride the packed state untouched (f32), the
+    # lifetime/stuck groups bank
+    assert "drift_age" in r.fault_states
+    assert "life_q" in r.fault_states
+    r.step(4, chunk=2)
+    ck = r.checkpoint(str(tmp_path / "pd.ckpt.npz"))
+    l_ref, _ = r.step(2, chunk=2)
+    r.close()
+    r2 = _sweep(tmp_path, "pd2", proc, packed=True, n=2)
+    r2.restore(ck)
+    l_res, _ = r2.step(2, chunk=2)
+    assert np.array_equal(np.asarray(l_ref), np.asarray(l_res))
+    r2.close()
+
+
+def test_packed_refused_without_lifetime_process(tmp_path):
+    s = fault_solver(tmp_path / "x", "conductance_drift:nu=0.2")
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    with pytest.raises(ValueError, match="packed_state"):
+        SweepRunner(s, n_configs=2, pipeline_depth=0,
+                    packed_state=True)
+
+
+def test_self_healing_refill_draws_via_process(tmp_path):
+    """A reclaimed lane of a drift-stack sweep re-seeds with the full
+    process state (drift groups included) and healthy lanes stay
+    byte-preserved."""
+    proc = "endurance_stuck_at+conductance_drift:nu=0.2"
+    r = _sweep(tmp_path, "h", proc)
+    r.enable_self_healing(budget=8, max_retries=1)
+    rows = r._fresh_rows(1, 2)
+    assert any(n.startswith("fault/drift_age/") for n in rows)
+    assert any(n.startswith("fault/lifetimes/") for n in rows)
+    r.step(8, chunk=2)
+    assert r.healing_complete()
+    r.close()
+
+
+def test_setup_record_fault_model(tmp_path):
+    r = _sweep(tmp_path, "s", "conductance_drift:nu=0.2"
+                              "+endurance_stuck_at")
+    rec = r.setup_record()
+    assert validate_record(rec) == []
+    assert rec["fault_model"]["spec"] == \
+        "conductance_drift:nu=0.2+endurance_stuck_at"
+    assert rec["fault_model"]["processes"] == {
+        "conductance_drift": {"nu": 0.2}}
+    from rram_caffe_simulation_tpu.observe.sink import setup_line
+    assert "fault model conductance_drift:nu=0.2" in setup_line(rec)
+    r.close()
+
+
+def test_summarize_digests_per_process(tmp_path):
+    from rram_caffe_simulation_tpu.tools.summarize import \
+        summarize_metrics
+    path = str(tmp_path / "run.jsonl")
+    rec = {"schema_version": 1, "iter": 10, "wall_time": 1.0,
+           "loss": 0.5, "lr": 0.01, "step_latency_s": 0.01,
+           "iters_per_s": 100.0,
+           "fault": {"broken_total": 12, "newly_expired": 1,
+                     "life_min": -3.0, "life_mean": 100.0,
+                     "writes_saved": 0,
+                     "per_process": {
+                         "endurance_stuck_at": {"broken": 12},
+                         "conductance_drift": {"drifted": [5, 7],
+                                               "age_mean": 3.5}}}}
+    assert validate_record(rec) == []
+    with open(path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    out = summarize_metrics(path)
+    assert "process endurance_stuck_at" in out
+    assert "process conductance_drift" in out
+    assert "drifted=6" in out            # per-config vector -> mean
+
+
+def test_spool_request_process_pin():
+    from rram_caffe_simulation_tpu.serve.spool import normalize_request
+    req = normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                             "process": " read_disturb "})
+    assert req["process"] == "read_disturb"
+    assert "process" not in normalize_request(
+        {"configs": [{"mean": 1.0}], "iters": 10})
+    with pytest.raises(ValueError, match="process"):
+        normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                           "process": ""})
+    with pytest.raises(ValueError, match="process"):
+        normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                           "process": 7})
+
+
+# ---------------------------------------------------------------------------
+# co-design reducers
+
+def test_codesign_grid_and_grouping():
+    axes = {"process": ["a", "b"], "adc_bits": [2, 4],
+            "mean": [100.0, 200.0], "std": [10.0]}
+    grid = codesign.expand_grid(axes)
+    assert len(grid) == 8
+    groups = codesign.group_static(grid)
+    assert len(groups) == 4              # process x adc_bits
+    assert all(len(v) == 2 for v in groups.values())
+    with pytest.raises(ValueError, match="non-empty"):
+        codesign.expand_grid({"sigma": []})
+
+
+def test_codesign_pareto_front():
+    recs = [
+        {"loss": 1.0, "bits": 8, "tag": "hi"},
+        {"loss": 2.0, "bits": 2, "tag": "lo"},
+        {"loss": 2.5, "bits": 2, "tag": "dominated"},
+        {"loss": 1.5, "bits": 8, "tag": "dominated2"},
+        {"loss": float("nan"), "bits": 2, "tag": "failed"},
+        {"bits": 4, "tag": "no-loss"},
+    ]
+    front, dominated = codesign.pareto_front(recs, "loss", "bits")
+    assert [r["tag"] for r in front] == ["hi", "lo"]
+    assert dominated == 2                # NaN/missing excluded entirely
+    rep = codesign.make_report(recs, "loss", "bits")
+    assert rep["front_size"] == 2 and not rep["degenerate"]
+    assert rep["evaluated"] == 6
+    # a one-point front is degenerate
+    rep1 = codesign.make_report(recs[:1], "loss", "bits")
+    assert rep1["degenerate"]
+    # maximize flips dominance
+    front_max, _ = codesign.pareto_front(recs[:2], "loss", "bits",
+                                         maximize_x=True,
+                                         maximize_y=True)
+    assert [r["tag"] for r in front_max] == ["lo", "hi"]
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+def test_run_1000_sweep_resume_refuses_process_mismatch(tmp_path):
+    import runpy
+    import sys
+    run_dir = tmp_path / "rd"
+    run_dir.mkdir()
+    with open(run_dir / "manifest.json", "w") as f:
+        json.dump({"configs": 4, "group": 4, "block": 0, "iters": 10,
+                   "chunk": 5, "mean": 300.0, "std": 50.0,
+                   "pipeline_depth": 0, "solver": "x.prototxt",
+                   "checkpoint_every": 0, "max_retries": 1,
+                   "retry_backoff": 0,
+                   "process": "endurance_stuck_at"}, f)
+    driver = os.path.join(os.path.dirname(__file__), "..", "examples",
+                          "gaussian_failure", "run_1000_sweep.py")
+    argv = sys.argv
+    sys.argv = ["run_1000_sweep.py", "--resume", str(run_dir),
+                "--process", "conductance_drift"]
+    try:
+        with pytest.raises(SystemExit) as ei:
+            runpy.run_path(driver, run_name="__main__")
+        assert ei.value.code == 2        # argparse usage error
+    finally:
+        sys.argv = argv

@@ -318,10 +318,10 @@ def test_v1_checkpoint_upgrades_with_identity_lane_map(tmp_path):
     with np.load(ckpt) as z:
         data = {k: z[k] for k in z.files}
     meta = _json.loads(bytes(bytearray(data["__meta__"])).decode())
-    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 4
+    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 5
     meta = {k: v for k, v in meta.items()
             if k not in ("lane_map", "lane_done", "healing",
-                         "fault_format", "pack_spec")}
+                         "fault_format", "pack_spec", "fault_process")}
     meta["version"] = 1
     data["__meta__"] = np.frombuffer(_json.dumps(meta).encode(),
                                      np.uint8)

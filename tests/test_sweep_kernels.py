@@ -339,9 +339,9 @@ def _downgrade_to_v2(path):
     with np.load(path) as z:
         data = {k: z[k] for k in z.files}
     meta = _json.loads(bytes(bytearray(data["__meta__"])).decode())
-    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 4
+    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 5
     assert meta["fault_format"] == "f32"
-    del meta["fault_format"], meta["pack_spec"]
+    del meta["fault_format"], meta["pack_spec"], meta["fault_process"]
     meta["version"] = 2
     data["__meta__"] = np.frombuffer(_json.dumps(meta).encode(),
                                      np.uint8)
