@@ -79,6 +79,12 @@ def main(argv=None):
     p.add_argument("--strategies", default="none",
                    help="comma-separated mitigation strategies: none "
                         "or threshold:T (e.g. threshold:0.001)")
+    p.add_argument("--tiles", default="1x1",
+                   help="comma-separated tiled-crossbar-mapping specs "
+                        "(fault/mapping.py TileSpec syntax: '1x1' = "
+                        "untiled, 'GRxGC' grids, 'cells=RxC' physical "
+                        "arrays) — the CIM-Explorer mapping axis, "
+                        "swept jointly with the rest")
     p.add_argument("--means", default="400,800",
                    help="comma-separated lifetime means (the per-lane "
                         "Monte-Carlo axis)")
@@ -106,6 +112,7 @@ def main(argv=None):
     os.makedirs(out_dir, exist_ok=True)
 
     from rram_caffe_simulation_tpu.fault import codesign
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
     from rram_caffe_simulation_tpu.fault.processes import FaultSpec
     from rram_caffe_simulation_tpu.parallel import SweepRunner
     from rram_caffe_simulation_tpu.proto import pb
@@ -118,6 +125,11 @@ def main(argv=None):
         "sigma": _floats(args.sigmas),
         "adc_bits": _ints(args.adc_bits),
         "strategy": _strs(args.strategies),
+        # canonicalized up front so the records/report carry the
+        # canonical tile spec per config (equivalent spellings bucket
+        # into one compiled sweep)
+        "tiles": [TileSpec.parse(s).canonical()
+                  for s in _strs(args.tiles)],
         "mean": _floats(args.means),
         "std": _floats(args.stds),
     }
@@ -131,7 +143,7 @@ def main(argv=None):
           f"({' x '.join(f'{k}={len(v)}' for k, v in axes.items())})",
           flush=True)
 
-    def build_solver(process, sigma, adc_bits, strategy):
+    def build_solver(process, sigma, adc_bits, strategy, tiles):
         param = read_solver_param(args.solver)
         param.failure_pattern.type = "gaussian"
         param.random_seed = args.seed
@@ -148,17 +160,18 @@ def main(argv=None):
             sp = param.failure_strategy.add()
             sp.type = "threshold"
             sp.threshold = float(val or 0.0)
-        return Solver(param, fault_process=process)
+        return Solver(param, fault_process=process, tile_spec=tiles)
 
     results = []
     results_path = os.path.join(out_dir, "results.jsonl")
     with open(results_path, "w") as rf:
         for key, cfgs in sorted(groups.items()):
-            process, sigma, adc_bits, strategy = key
+            process, sigma, adc_bits, strategy, tiles = key
             means = [c["mean"] for c in cfgs]
             stds = [c["std"] for c in cfgs]
             t0 = time.perf_counter()
-            solver = build_solver(process, sigma, adc_bits, strategy)
+            solver = build_solver(process, sigma, adc_bits, strategy,
+                                  tiles)
             with SweepRunner(solver, n_configs=len(cfgs), means=means,
                              stds=stds, pipeline_depth=0) as runner:
                 losses, _ = runner.step(args.iters, chunk=args.chunk)
@@ -176,7 +189,8 @@ def main(argv=None):
                 results.append(rec)
                 rf.write(json.dumps(rec) + "\n")
             print(f"  bucket process={process} sigma={sigma:g} "
-                  f"adc_bits={adc_bits} strategy={strategy}: "
+                  f"adc_bits={adc_bits} strategy={strategy} "
+                  f"tiles={tiles}: "
                   f"{len(cfgs)} lanes x {args.iters} iters in "
                   f"{dt:.1f} s (mean loss "
                   f"{float(np.nanmean(losses)):.4f})", flush=True)
@@ -198,14 +212,18 @@ def main(argv=None):
         print("  front: "
               + ", ".join(f"{k}={rec[k]}" for k in
                           ("process", "sigma", "adc_bits", "strategy",
-                           "mean", "std"))
+                           "tiles", "mean", "std"))
               + f" -> {args.metric_x}={rec.get(args.metric_x)}, "
                 f"{args.metric_y}={rec.get(args.metric_y)}",
               flush=True)
     if report["degenerate"]:
+        culprits = report.get("collapsed_axes") or []
+        named = (f" collapsed axis(es): {', '.join(culprits)} — widen "
+                 "those" if culprits else
+                 " — widen --adc-bits / --processes / --sigmas / "
+                 "--tiles")
         print("Front is DEGENERATE (a single point): the axes exposed "
-              "no tradeoff — widen --adc-bits / --processes / "
-              "--sigmas", flush=True)
+              f"no tradeoff;{named}", flush=True)
         sys.exit(DEGENERATE_EXIT)
     return report
 

@@ -1,0 +1,198 @@
+"""ImageNet-resolution tiled-crossbar fault sweep bench (ROADMAP item 1
+deliverable / ISSUE 11 acceptance): a VGG-class FC layer at 224x224
+input resolution, its weight matrix split across multiple physical
+crossbar tiles (fault/mapping.py), trained as a config-SHARDED
+Monte-Carlo fault sweep with the per-tile fault census flowing through
+the observe schema.
+
+The net is a deliberately small VGG-shaped head — one strided conv +
+pool feeding an fc6-style InnerProduct — so the bench runs anywhere,
+but the LAYER is the real thing: 224x224x3 input, an FC crossbar
+bigger than one physical array (stored (512, 784); under the default
+``cells=256x256`` mapping that is a 2x4 = 8-tile grid, each tile with
+its own independent fault draw and its own ADC on the analog partial
+sums). The sweep's config axis lays over every visible device
+(``TILED_BENCH_MESH``, default ``config=all``) as ONE GSPMD program —
+the PR 9 pod path — and metrics records carry ``fault.per_tile``
+(schema-validated here before the row is printed).
+
+Environment knobs:
+
+  TILED_BENCH_CONFIGS   sweep lanes (default 8)
+  TILED_BENCH_STEPS     timed steps (default 30)
+  TILED_BENCH_CHUNK     scan chunk (default 10)
+  TILED_BENCH_BATCH     images per step per config (default 8)
+  TILED_BENCH_TILES     TileSpec (default cells=256x256)
+  TILED_BENCH_MESH      mesh spec (default config=all; '' = no mesh)
+  TILED_BENCH_DEVICES   on CPU hosts: force N virtual devices
+                        (default 4; set before JAX initializes)
+
+Prints exactly ONE JSON line on stdout.
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, "..", "..")
+sys.path.insert(0, REPO)
+
+# on a CPU host, shard the config axis over virtual devices so the row
+# exercises the REAL config-sharded program (chips > 1); harmless when
+# XLA_FLAGS is already set or a real accelerator is attached
+_NDEV = int(os.environ.get("TILED_BENCH_DEVICES", "4"))
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", "") and _NDEV > 1 \
+        and os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_NDEV}")
+
+N_CONFIGS = int(os.environ.get("TILED_BENCH_CONFIGS", "8"))
+STEPS = int(os.environ.get("TILED_BENCH_STEPS", "30"))
+CHUNK = int(os.environ.get("TILED_BENCH_CHUNK", "10"))
+BATCH = int(os.environ.get("TILED_BENCH_BATCH", "8"))
+TILES = os.environ.get("TILED_BENCH_TILES", "cells=256x256")
+MESH = os.environ.get("TILED_BENCH_MESH", "config=all")
+
+NET = """
+name: "VGGTiledHead"
+layer { name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: %(batch)d dim: 3 dim: 224 dim: 224 }
+                shape { dim: %(batch)d dim: 10 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 8 stride: 8
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 0 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 4 stride: 4 } }
+layer { name: "fc6" type: "InnerProduct" bottom: "pool1" top: "fc6"
+  inner_product_param { num_output: 512
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.1 } } }
+layer { name: "relu6" type: "ReLU" bottom: "fc6" top: "fc6" }
+layer { name: "fc7" type: "InnerProduct" bottom: "fc6" top: "fc7"
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc7"
+  bottom: "label" top: "loss" }
+"""
+
+
+def main():
+    import numpy as np
+    from google.protobuf import text_format
+
+    import jax
+
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    from rram_caffe_simulation_tpu.observe import schema as obs_schema
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    from rram_caffe_simulation_tpu.parallel.mesh import mesh_from_spec
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    sp = pb.SolverParameter()
+    text_format.Parse(NET % {"batch": BATCH}, sp.net_param)
+    sp.base_lr = 0.0002   # stable on the random-data proxy batch
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10 ** 9
+    sp.display = 0
+    sp.random_seed = 11
+    sp.snapshot_prefix = "/tmp/tiled_imagenet_bench"
+    # lifetimes sized so cells BREAK inside the timed window — the
+    # per-tile census then shows real spatial structure, not zeros
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = STEPS * 50.0
+    sp.failure_pattern.std = STEPS * 15.0
+    sp.rram_forward.sigma = 0.0
+    sp.rram_forward.adc_bits = 4     # the per-tile ADC width
+
+    rng = np.random.RandomState(5)
+    data = rng.randn(BATCH, 3, 224, 224).astype(np.float32)
+    label = rng.randn(BATCH, 10).astype(np.float32)
+    solver = Solver(sp, train_feed=lambda: {"data": data,
+                                            "label": label},
+                    tile_spec=TILES)
+
+    class _Sink:
+        def __init__(self):
+            self.records = []
+
+        def write(self, rec):
+            self.records.append(rec)
+
+    sink = _Sink()
+    solver.enable_metrics(sink)
+    sp.display = CHUNK   # records at chunk boundaries
+
+    tspec = TileSpec.parse(TILES)
+    flat = solver._flat(solver.params)
+    grids = {k: list(tspec.grid(v.shape))
+             for k, v in flat.items()
+             if k in solver._fault_keys and v.ndim == 2}
+
+    mesh = mesh_from_spec(MESH) if MESH else None
+    t0 = time.perf_counter()
+    runner = SweepRunner(solver, n_configs=N_CONFIGS, mesh=mesh,
+                         pipeline_depth=0)
+    runner.step(CHUNK, chunk=CHUNK)   # compile + warmup
+    jax.block_until_ready(runner.params)
+    setup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    runner.step(STEPS, chunk=CHUNK)
+    jax.block_until_ready(runner.params)
+    dt = time.perf_counter() - t0
+
+    # the last fault-bearing record's per-tile census, schema-checked
+    recs = [r for r in sink.records if "fault" in r]
+    assert recs, "no fault metrics record emitted"
+    last = recs[-1]
+    errs = obs_schema.validate_record(last)
+    assert not errs, f"per-tile record failed schema: {errs}"
+    pt = last["fault"].get("per_tile") or {}
+    census = {}
+    for k, e in pt.items():
+        bf = np.asarray(e["broken_frac"], np.float64)
+        census[k] = {
+            "grid": (np.asarray(e["grid"]).reshape(-1, 2)[0].tolist()),
+            "tiles": int(bf.shape[-1]),
+            "broken_frac_mean": round(float(bf.mean()), 4),
+            "broken_frac_max": round(float(bf.max()), 4),
+        }
+    broken = runner.broken_fractions()
+    n_chips = len(np.asarray(runner.mesh.devices).ravel())
+    img_s = N_CONFIGS * BATCH * STEPS / dt
+    runner.close()
+
+    print(json.dumps({
+        "metric": "images/sec/chip, ImageNet-resolution tiled-crossbar "
+                  f"fault sweep ({N_CONFIGS} configs config-sharded "
+                  f"over {n_chips} chips, tiles={tspec.canonical()})",
+        "value": round(img_s / n_chips, 2),
+        "unit": "img/s/chip",
+        "extra": {
+            "input_resolution": "3x224x224",
+            "tile_spec": tspec.canonical(),
+            "tile_grids": grids,
+            "per_tile_census_final": census,
+            "broken_fraction_mean": round(float(np.mean(broken)), 4),
+            "mesh": dict(runner.mesh.shape),
+            "chips": n_chips,
+            "n_configs": N_CONFIGS, "batch": BATCH,
+            "steps_timed": STEPS, "chunk": CHUNK,
+            "seconds": round(dt, 3),
+            "setup_seconds": round(setup_s, 1),
+            "configs_per_hour_aggregate": round(
+                N_CONFIGS * STEPS / dt * 3600.0 / 5000.0, 2),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

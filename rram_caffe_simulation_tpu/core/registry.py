@@ -90,6 +90,16 @@ class LayerContext:
     # Which hw_engine value populates this (and every fallback rule)
     # is documented ONCE: the ENGINE MATRIX in fault/hw_aware.py.
     crossbar: Optional[dict] = None
+    # Tiled crossbar mapping (fault/mapping.py, static): maps a
+    # fault-target layer name -> (tr, tc) tile cell dims over its
+    # STORED weight shape. A listed layer computes its matmul as
+    # per-tile ADC-quantized partial sums accumulated across the
+    # K-tile axis (adc_bits per tile instead of one whole-output ADC)
+    # — on the pure path via hw_aware.tiled_crossbar_matmul, on the
+    # pallas path by folding the tile grid + ADC into the fused
+    # kernel. Only multi-tile layers are listed; the default 1x1 spec
+    # populates nothing and traces the untiled program.
+    tiles: Optional[dict] = None
     # Mixed precision (Solver compute_dtype, static): layers that CREATE
     # float data inside the graph (DummyData fillers) emit it in this
     # dtype so generated blobs match the cast parameters.

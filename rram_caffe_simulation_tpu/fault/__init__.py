@@ -16,6 +16,7 @@ from .strategies import (threshold_diffs, remap_fc_neurons, sort_fc_neurons,
                          GeneticStrategy, build_strategies)
 from .processes import (FaultProcess, FaultSpec, ProcessStack,
                         register_fault_process)
+from .mapping import TileSpec
 
 __all__ = [
     "FaultState", "init_fault_state", "fail", "broken_fraction",
@@ -23,5 +24,5 @@ __all__ = [
     "threshold_diffs", "remap_fc_neurons", "sort_fc_neurons",
     "GeneticStrategy", "build_strategies",
     "FaultProcess", "FaultSpec", "ProcessStack",
-    "register_fault_process",
+    "register_fault_process", "TileSpec",
 ]

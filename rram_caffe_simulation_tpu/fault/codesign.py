@@ -37,11 +37,25 @@ import json
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 #: axes whose values change the traced program — one compiled sweep
-#: per distinct combination; everything else rides the config lanes
-STATIC_AXES = ("process", "sigma", "adc_bits", "strategy")
+#: per distinct combination; everything else rides the config lanes.
+#: "tiles" is the crossbar-mapping axis (fault/mapping.py TileSpec):
+#: the tile grid decides both the fault draw's Monte-Carlo space and
+#: the per-tile ADC structure of the traced read — the CIM-Explorer
+#: tile-mapping sweep axis, searched jointly with the rest.
+STATIC_AXES = ("process", "sigma", "adc_bits", "strategy", "tiles")
 
 #: per-lane axes (the Monte-Carlo lifetime-distribution grid)
 LANE_AXES = ("mean", "std")
+
+
+def _tiles_canonical(v) -> str:
+    """Canonicalize a tiles axis value so equivalent spellings bucket
+    together. A malformed spec raises (mapping.canonical is loud) —
+    a corrupted axis value must not become a plausible-looking
+    bucket in the report. mapping.py's parse layer is pure Python,
+    so this keeps the numpy-only import story."""
+    from .mapping import canonical
+    return canonical(v)
 
 
 def expand_grid(axes: Dict[str, Sequence]) -> List[dict]:
@@ -66,7 +80,8 @@ def static_key(cfg: dict) -> Tuple:
     return (str(cfg.get("process", "endurance_stuck_at")),
             float(cfg.get("sigma", 0.0) or 0.0),
             int(cfg.get("adc_bits", 0) or 0),
-            str(cfg.get("strategy", "none") or "none"))
+            str(cfg.get("strategy", "none") or "none"),
+            _tiles_canonical(cfg.get("tiles", "1x1") or "1x1"))
 
 
 def group_static(configs: Iterable[dict]) -> Dict[Tuple, List[dict]]:
@@ -129,27 +144,67 @@ def load_results(path: str) -> List[dict]:
     return recs
 
 
+def _axis_distinct(records: Sequence[dict], name: str) -> set:
+    """The distinct values an axis takes across records (tiles values
+    canonicalized; absent = not counted)."""
+    vals = set()
+    for r in records:
+        if name in r:
+            v = r[name]
+            vals.add(_tiles_canonical(v) if name == "tiles" else
+                     (str(v) if not isinstance(v, (int, float)) else v))
+    return vals
+
+
+def collapsed_axes(records: Sequence[dict], front: Sequence[dict],
+                   axes: Optional[dict] = None) -> List[str]:
+    """Which design axes COLLAPSED on the Pareto front: axes that were
+    actually swept (more than one distinct value across the evaluated
+    records) but whose front members all share one value — the named
+    culprits behind a degenerate front ("widen THIS axis"). Considers
+    the declared `axes` when given, else every known static + lane
+    axis present in the records."""
+    names = (sorted(axes) if axes
+             else [n for n in STATIC_AXES + LANE_AXES
+                   if any(n in r for r in records)])
+    out = []
+    for n in names:
+        swept = _axis_distinct(records, n)
+        on_front = _axis_distinct(front, n)
+        if len(swept) > 1 and len(on_front) <= 1:
+            out.append(n)
+    return out
+
+
 def make_report(records: Sequence[dict], metric_x: str, metric_y: str,
                 maximize_x: bool = False, maximize_y: bool = False,
                 axes: Optional[dict] = None) -> dict:
     """The `pareto_report.json` payload: the front (full records, best
     metric_x first), the dominated count, and a degeneracy verdict —
     `degenerate` is True when the front collapses to a single point
-    (or fewer), i.e. the axes exposed no actual tradeoff."""
+    (or fewer), with `collapsed_axes` NAMING the swept axes whose
+    values all fell off the front (the axes to widen). Each front
+    record's `tiles` value (when present) is recorded in canonical
+    TileSpec form under `front_tiles` so the winning crossbar mappings
+    read off the report directly."""
     front, dominated = pareto_front(records, metric_x, metric_y,
                                     maximize_x, maximize_y)
     distinct = {( _metric(r, metric_x), _metric(r, metric_y))
                 for r in front}
     report = {
-        "schema_version": 1,
+        "schema_version": 2,
         "metric_x": metric_x, "metric_y": metric_y,
         "maximize_x": bool(maximize_x), "maximize_y": bool(maximize_y),
         "evaluated": len(records),
         "dominated": dominated,
         "front_size": len(front),
         "degenerate": len(distinct) < 2,
+        "collapsed_axes": collapsed_axes(records, front, axes),
         "front": list(front),
     }
+    if any("tiles" in r for r in front):
+        report["front_tiles"] = [
+            _tiles_canonical(r.get("tiles", "1x1")) for r in front]
     if axes:
         report["axes"] = {k: list(v) for k, v in axes.items()}
     return report

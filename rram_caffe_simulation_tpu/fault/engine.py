@@ -61,7 +61,8 @@ def _stuck_splits(pattern: "pb.FailurePatternParameter") -> Tuple[float, float]:
 
 
 def init_fault_state(key: jax.Array, param_shapes: Dict[str, tuple],
-                     pattern: "pb.FailurePatternParameter") -> FaultState:
+                     pattern: "pb.FailurePatternParameter",
+                     tiles=None) -> FaultState:
     """Draw lifetimes and stuck values for every fault-target param.
 
     Mirrors the GaussianFailureMaker constructor (failure_maker.cpp:4-53):
@@ -69,32 +70,52 @@ def init_fault_state(key: jax.Array, param_shapes: Dict[str, tuple],
     see its FIXME about int conversion), stuck values from one uniform draw
     against the cumulative splits (FailureThresholdKernel,
     failure_maker.cu:6-16).
+
+    `tiles` (a fault/mapping.py TileSpec) splits every 2-D param into
+    fault-INDEPENDENT crossbar tiles: the per-param draw keys are
+    folded per tile (tile-major) so each physical array gets its own
+    draw, reproducible for any grid. The per-param key chain
+    (`split(key, 3)` per param, in dict order) is unchanged, and a
+    single-tile param (or `tiles=None` / the default 1x1 spec) takes
+    the unfolded legacy path — byte-identical to the untiled draw.
     """
+    from . import mapping as fault_mapping
     split1, split2 = _stuck_splits(pattern)
     mean, std = float(pattern.mean), float(pattern.std)
+
+    def life_draw(k, shape):
+        return mean + std * jax.random.normal(k, shape,
+                                              dtype=jnp.float32)
+
+    def stuck_draw(k, shape):
+        u = jax.random.uniform(k, shape, dtype=jnp.float32)
+        return jnp.where(
+            u < split1, -1.0,
+            jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+
     lifetimes, stuck = {}, {}
     for name, shape in param_shapes.items():
         key, k_life, k_stuck = jax.random.split(key, 3)
-        lifetimes[name] = mean + std * jax.random.normal(
-            k_life, shape, dtype=jnp.float32)
-        u = jax.random.uniform(k_stuck, shape, dtype=jnp.float32)
-        stuck[name] = jnp.where(
-            u < split1, -1.0,
-            jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+        lifetimes[name] = fault_mapping.tiled_draw(k_life, shape, tiles,
+                                                   life_draw)
+        stuck[name] = fault_mapping.tiled_draw(k_stuck, shape, tiles,
+                                               stuck_draw)
     return {"lifetimes": lifetimes, "stuck": stuck}
 
 
 def draw_rescaled_state(key: jax.Array, param_shapes: Dict[str, tuple],
                         pattern: "pb.FailurePatternParameter",
-                        mean, std) -> FaultState:
+                        mean, std, tiles=None) -> FaultState:
     """One independent fault-state draw whose lifetime distribution is
     rescaled from the pattern's (mean, std) to the given per-config
     pair: the standard-normal component of the base draw is kept and
     re-anchored, exactly as the sweep's per-config mean/std grids do
     (run_different_mean.sh / run_different_mean_var.sh). This is the
     single-config kernel `stack_fault_states` vmaps over, and what the
-    self-healing lane refill uses for a fresh draw on one lane."""
-    st = init_fault_state(key, param_shapes, pattern)
+    self-healing lane refill uses for a fresh draw on one lane.
+    `tiles` is the per-(param, tile) independent-draw spec of
+    `init_fault_state`."""
+    st = init_fault_state(key, param_shapes, pattern, tiles=tiles)
     base_m, base_s = float(pattern.mean), float(pattern.std)
     life = {}
     for name, v in st["lifetimes"].items():
@@ -107,7 +128,7 @@ def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
                     pattern: "pb.FailurePatternParameter",
                     n_configs: int, means, stds,
                     rows: Tuple[int, int] = None,
-                    process=None) -> FaultState:
+                    process=None, tiles=None) -> FaultState:
     """Rows [lo, hi) of the n_configs-stacked fault-state draw, exactly
     as the full stack would hold them: the per-config keys are split
     from `key` over the FULL config count and then sliced, so the draw
@@ -121,7 +142,10 @@ def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
     `process` (a fault/processes ProcessStack) routes the per-config
     draw through the configured fault-process stack; None keeps the
     legacy endurance kernel (which the default stack delegates to, so
-    the two spellings draw byte-identical rows)."""
+    the two spellings draw byte-identical rows). `tiles` (a
+    fault/mapping.py TileSpec) is the per-(param, tile) independent-
+    draw spec on the legacy path — a ProcessStack carries its own tile
+    spec, pinned at build."""
     lo, hi = (0, n_configs) if rows is None else (int(rows[0]),
                                                   int(rows[1]))
     if not (0 <= lo <= hi <= n_configs):
@@ -134,7 +158,8 @@ def draw_state_rows(key: jax.Array, param_shapes: Dict[str, tuple],
     def init_one(k, m, s):
         if process is not None:
             return process.draw_rescaled(k, param_shapes, pattern, m, s)
-        return draw_rescaled_state(k, param_shapes, pattern, m, s)
+        return draw_rescaled_state(k, param_shapes, pattern, m, s,
+                                   tiles=tiles)
 
     return jax.vmap(init_one)(keys, mean, std)
 

@@ -62,6 +62,17 @@ rule); and a
 vmap batching pattern that does not batch ALL of w/broken/stuck/seed
 (x may be shared or per-config) runs the single-config kernel per lane
 under `lax.map` (identical numerics, no fusion win).
+
+Tiled crossbar mapping (fault/mapping.py): a static `tiles =
+(bk, bn, adc_bits)` parameter re-shapes the kernel's K/N block grid to
+the layer's physical tile grid — each (j, k) block reads its tile's
+independent fault slice — and quantizes every tile's analog partial
+sum through an adc_bits-wide ADC before the accumulator add (the M
+grid pins to one block so the in-kernel dynamic range matches the pure
+path's per-call `quantize_ste`). `tiled_crossbar_matmul` is the
+pure-path twin, used by the jax engine's layer path and the parity
+guard (scripts/check_tiled_mapping.py). `tiles=None` (the default 1x1
+spec) builds the exact historical kernels.
 """
 from __future__ import annotations
 
@@ -144,28 +155,61 @@ def _w_eff(w, broken, stuck, sigma, eps, q_levels, scale):
     sequence every kernel variant shares: optional ADC-grid
     quantization, forward-only conductance noise (`eps=None` skips the
     multiply: the sigma == 0 sweep builds no PRNG at all), stuck
-    clamp."""
+    clamp. Under the tiled mapping (fault/mapping.py) the (bk, bn)
+    block handed in IS one crossbar tile, so `broken`/`stuck` are that
+    tile's independent fault slice — the block grid and the tile grid
+    are the same object.
+
+    Both perturbations replay the pure path's straight-through
+    arithmetic (`base + (f(base) - base)`, quantize_ste /
+    perturb_weight) instead of emitting `f(base)` directly: the two
+    spellings can differ by an ulp where the subtract-then-add round
+    trip rounds, and the engine-parity guards compare bit for bit."""
     if q_levels:
-        w = _quantize_tile(w, scale, q_levels)
-    if eps is not None:
-        w = w * (1.0 + sigma * eps)
-    return jnp.where(broken > 0, stuck, w)
+        w = w + (_quantize_tile(w, scale, q_levels) - w)
+    noisy = w * (1.0 + sigma * eps) if eps is not None else w
+    return w + (jnp.where(broken > 0, stuck, noisy) - w)
+
+
+def _adc_read(part, adc_levels: float):
+    """One tile's analog partial sum through its ADC: quantize_ste's
+    forward formula with the tile's own dynamic range (max-abs over the
+    whole partial product — under the tiled mapping the M grid is a
+    single block, so the in-kernel reduction sees the same values the
+    pure path's per-call `quantize_ste` does, bit for bit; zero
+    padding cannot raise an abs-max). The `part + (q - part)` shape
+    replays quantize_ste's STE arithmetic EXACTLY — emitting `q`
+    directly would differ by an ulp on values where the subtract-then-
+    add round-trip rounds, and the tiled-mapping CI guard compares the
+    engines bit for bit."""
+    if not adc_levels:
+        return part
+    q = _quantize_tile(part, jnp.max(jnp.abs(part)), adc_levels)
+    return part + (q - part)
 
 
 def _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref, sigma, eps,
-                q_levels=0.0, scale=None):
+                q_levels=0.0, scale=None, adc_levels=0.0):
+    """One (block, tile) MAC + accumulate. `adc_levels` is the tiled
+    mapping's per-tile ADC (fault/mapping.py): the analog partial sum
+    of THIS tile is quantized before the digital accumulation across
+    the K-tile grid axis — `o_ref` models the digital accumulator, the
+    dot models the in-array analog MAC."""
     w_eff = _w_eff(w_ref[:], broken_ref[:], stuck_ref[:], sigma, eps,
                    q_levels, scale)
-    o_ref[:] += jnp.dot(x_ref[:], w_eff,
-                        preferred_element_type=jnp.float32)
+    part = jnp.dot(x_ref[:], w_eff, preferred_element_type=jnp.float32)
+    o_ref[:] += _adc_read(part, adc_levels)
 
 
-def _make_crossbar_kernel(q_levels: float):
+def _make_crossbar_kernel(q_levels: float, adc_levels: float = 0.0):
     """One (bm, bn) output tile, accumulating over the K grid axis; the
     weight tile is quantized + perturbed in VMEM before hitting the MXU.
     The PRNG is seeded per (j, k) tile so every x-tile sees the SAME
     weight noise. `q_levels` is static: 0 builds the exact historical
-    kernel signature (no scale input)."""
+    kernel signature (no scale input). `adc_levels` is the tiled
+    mapping's per-tile ADC on the partial-sum accumulator (see
+    `_apply_tile`; the tiled launch pins the M grid to one block so the
+    in-block dynamic range is the whole partial product's)."""
     from jax.experimental.pallas import tpu as pltpu
     import jax.experimental.pallas as pl
 
@@ -193,11 +237,12 @@ def _make_crossbar_kernel(q_levels: float):
         eps = _gauss_tile(w_ref[:].shape)
         _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
                     sigma_ref[0], eps, q_levels,
-                    scale_ref[0] if q_levels else None)
+                    scale_ref[0] if q_levels else None, adc_levels)
     return kernel
 
 
-def _make_crossbar_kernel_hostnoise(q_levels: float):
+def _make_crossbar_kernel_hostnoise(q_levels: float,
+                                    adc_levels: float = 0.0):
     """Interpret-mode twin for off-TPU hosts: identical math, but the
     Gaussian draw arrives as an input (pltpu's in-kernel PRNG has no CPU
     interpret lowering)."""
@@ -218,17 +263,44 @@ def _make_crossbar_kernel_hostnoise(q_levels: float):
 
         _apply_tile(x_ref, w_ref, broken_ref, stuck_ref, o_ref,
                     sigma_ref[0], eps_ref[:], q_levels,
-                    scale_ref[0] if q_levels else None)
+                    scale_ref[0] if q_levels else None, adc_levels)
     return kernel
 
 
+def _m_block(m: int) -> int:
+    """The single M-block size of a tiled launch: the whole batch in
+    one 8-aligned block. ONE definition shared by the kernel launch
+    (`_tile_blocks`) and the pure twin (`tiled_crossbar_matmul`) —
+    the per-lane bit-exactness contract between the engines
+    (scripts/check_tiled_mapping.py) hangs on both padding the dot to
+    the identical shape."""
+    return max(8, -(-int(m) // 8) * 8)
+
+
+def _tile_blocks(tiles, m: int):
+    """Resolve a static `tiles` kernel parameter — (bk, bn, adc_bits),
+    the crossbar-view tile cell dims + the per-tile ADC width
+    (fault/mapping.py via ops/common.py) — into pallas launch knobs:
+    (bm, bn, bk, adc_levels). The kernel's (j, k) block grid then IS
+    the crossbar tile grid, its broken/stuck blocks the per-tile fault
+    slices. The M axis is pinned to ONE block (bm >= m, 8-aligned) so
+    the per-tile partial product — whose in-block abs-max is the ADC's
+    dynamic range — covers the full batch, exactly like the pure
+    path's per-call `quantize_ste` range."""
+    bk_t, bn_t, adc_bits = tiles
+    return _m_block(m), int(bn_t), int(bk_t), _q_levels(int(adc_bits))
+
+
 def _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits=0,
-                    bm=128, bn=128, bk=128):
+                    tiles=None, bm=128, bn=128, bk=128):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     m, kdim = x.shape
     _, n = w.shape
+    adc_levels = 0.0
+    if tiles is not None:
+        bm, bn, bk, adc_levels = _tile_blocks(tiles, m)
 
     def pad(a, r, c):
         return jnp.pad(a, ((0, -a.shape[0] % r), (0, -a.shape[1] % c)))
@@ -257,7 +329,7 @@ def _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits=0,
     sig = jnp.asarray([sigma], jnp.float32)
     if on_tpu:
         out = pl.pallas_call(
-            _make_crossbar_kernel(levels),
+            _make_crossbar_kernel(levels, adc_levels),
             in_specs=[smem] + scale_spec + [            # seed (+ scale)
                       pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
                       wspec, wspec, wspec,
@@ -268,7 +340,7 @@ def _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits=0,
         eps = jax.random.normal(jax.random.PRNGKey(seed), wp.shape,
                                 jnp.float32)
         out = pl.pallas_call(
-            _make_crossbar_kernel_hostnoise(levels),
+            _make_crossbar_kernel_hostnoise(levels, adc_levels),
             in_specs=scale_spec + [
                       pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
                       wspec, wspec, wspec, wspec,
@@ -285,7 +357,7 @@ def _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits=0,
 # weight matrices never round-trip HBM (ROADMAP item 3 / ISSUE 7 (a))
 
 def _make_batched_kernel(q_levels: float, draw_noise: bool,
-                         x_batched: bool):
+                         x_batched: bool, adc_levels: float = 0.0):
     """The config-grid twin of `_make_crossbar_kernel`: grid axis 0 is
     the config lane; each lane is seeded with ITS OWN seed word and the
     SAME (j*nk + k) tile index, so per-lane noise streams are
@@ -329,13 +401,14 @@ def _make_batched_kernel(q_levels: float, draw_noise: bool,
                        sigma_ref[0] if draw_noise else None, eps,
                        q_levels, scale_ref[c] if q_levels else None)
         xt = x_ref[0] if x_batched else x_ref[:]
-        o_ref[0] += jnp.dot(xt, w_eff,
-                            preferred_element_type=jnp.float32)
+        part = jnp.dot(xt, w_eff, preferred_element_type=jnp.float32)
+        o_ref[0] += _adc_read(part, adc_levels)
     return kernel
 
 
 def _make_batched_kernel_hostnoise(q_levels: float, draw_noise: bool,
-                                   x_batched: bool):
+                                   x_batched: bool,
+                                   adc_levels: float = 0.0):
     """Interpret-mode twin of `_make_batched_kernel` (per-lane Gaussian
     draws arrive as a (config, K, N) input)."""
     import jax.experimental.pallas as pl
@@ -358,13 +431,13 @@ def _make_batched_kernel_hostnoise(q_levels: float, draw_noise: bool,
                        eps_ref[0] if draw_noise else None,
                        q_levels, scale_ref[c] if q_levels else None)
         xt = x_ref[0] if x_batched else x_ref[:]
-        o_ref[0] += jnp.dot(xt, w_eff,
-                            preferred_element_type=jnp.float32)
+        part = jnp.dot(xt, w_eff, preferred_element_type=jnp.float32)
+        o_ref[0] += _adc_read(part, adc_levels)
     return kernel
 
 
 def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
-                            bm=128, bn=128, bk=128):
+                            tiles=None, bm=128, bn=128, bk=128):
     """The config-batched launch: x (M, K) SHARED across lanes or
     (C, M, K) per lane; w/broken/stuck (C, K, N) and seeds (C,) per
     lane; one pallas_call over grid (C, gm, gn, gk). Every lane's
@@ -377,6 +450,9 @@ def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
     x_batched = x.ndim == 3
     m, kdim = x.shape[-2:]
     n = w.shape[2]
+    adc_levels = 0.0
+    if tiles is not None:
+        bm, bn, bk, adc_levels = _tile_blocks(tiles, m)
 
     def pad2(a, r, c):
         return jnp.pad(a, ((0, -a.shape[0] % r), (0, -a.shape[1] % c)))
@@ -413,7 +489,7 @@ def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
     sig = jnp.asarray([sigma], jnp.float32)
     if on_tpu:
         out = pl.pallas_call(
-            _make_batched_kernel(levels, draw, x_batched),
+            _make_batched_kernel(levels, draw, x_batched, adc_levels),
             in_specs=[smem] + scale_spec + [xspec, wspec, wspec, wspec,
                                             smem],
             **common,
@@ -424,7 +500,8 @@ def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
                         seeds)] if draw else [])
         eps_spec = [wspec] if draw else []
         out = pl.pallas_call(
-            _make_batched_kernel_hostnoise(levels, draw, x_batched),
+            _make_batched_kernel_hostnoise(levels, draw, x_batched,
+                                           adc_levels),
             in_specs=scale_spec + [xspec, wspec, wspec, wspec]
             + eps_spec + [smem],
             interpret=True,
@@ -434,7 +511,7 @@ def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
 
 
 @functools.lru_cache(maxsize=None)
-def _vmappable_forward(sigma: float, q_bits: int):
+def _vmappable_forward(sigma: float, q_bits: int, tiles=None):
     """The engine-dispatch seam between the single-config and the
     config-batched kernel: an unbatched call lowers to the single
     kernel; a vmap over (w, broken, stuck, seed) — the Monte-Carlo
@@ -447,14 +524,15 @@ def _vmappable_forward(sigma: float, q_bits: int):
 
     @jax.custom_batching.custom_vmap
     def fwd(x, w, broken, stuck, seed):
-        return _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits)
+        return _pallas_forward(x, w, broken, stuck, seed, sigma, q_bits,
+                               tiles)
 
     @fwd.def_vmap
     def _rule(axis_size, in_batched, x, w, broken, stuck, seed):
         xb, wb, bb, sb, seedb = in_batched
         if wb and bb and sb and seedb:
             out = _pallas_forward_batched(x, w, broken, stuck, seed,
-                                          sigma, q_bits)
+                                          sigma, q_bits, tiles)
         else:
             # mixed batching (e.g. per-lane fault masks with shared
             # weights): run the single kernel per lane — unbatched
@@ -464,14 +542,16 @@ def _vmappable_forward(sigma: float, q_bits: int):
                 take = lambda v, b: v[i] if b else v
                 return _pallas_forward(
                     take(x, xb), take(w, wb), take(broken, bb),
-                    take(stuck, sb), take(seed, seedb), sigma, q_bits)
+                    take(stuck, sb), take(seed, seedb), sigma, q_bits,
+                    tiles)
             out = jax.lax.map(one, jnp.arange(axis_size))
         return out, True
     return fwd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0,
+                    tiles=None):
     """y = x @ where(broken, stuck, quantize(w) * (1 + sigma*eps)) as
     one fused Pallas kernel (noise generated and the optional q_bits
     ADC-grid quantization applied in VMEM, never materialized in HBM).
@@ -482,22 +562,35 @@ def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0):
     symmetric-uniform grid `quantize_ste` models). Backward is
     straight-through against the CLEAN masked weights.
 
+    `tiles` (static, hashable) engages the tiled crossbar mapping
+    (fault/mapping.py): a `(bk, bn, adc_bits)` tuple sets the kernel's
+    K/N block grid to the layer's crossbar tile grid — each (j, k)
+    block then reads ITS tile's independent fault slice — and
+    quantizes every tile's analog partial sum through an
+    adc_bits-wide ADC before the accumulator add (the per-tile readout
+    NEON assumes; `tiled_crossbar_matmul` is the pure-path twin).
+    None (the 1x1 default) builds the exact historical kernel.
+
     vmap over (w, broken, stuck, seed) — the sweep's config axis, with
     x shared or per-config — dispatches to the config-batched kernel
     (one launch for every lane, per-lane noise streams bit-identical to
     per-lane single launches); see the ENGINE MATRIX in the module
     docstring."""
-    return _vmappable_forward(float(sigma), int(q_bits))(
+    return _vmappable_forward(float(sigma), int(q_bits), tiles)(
         x, w, broken.astype(jnp.float32), stuck.astype(jnp.float32),
         seed)
 
 
-def _cm_fwd(x, w, broken, stuck, seed, sigma, q_bits):
-    y = crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits)
+def _cm_fwd(x, w, broken, stuck, seed, sigma, q_bits, tiles):
+    y = crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits,
+                        tiles)
     return y, (x, w, broken, stuck)
 
 
-def _cm_bwd(sigma, q_bits, res, g):
+def _cm_bwd(sigma, q_bits, tiles, res, g):
+    # the per-tile ADC (tiles) is a forward-only perturbation like the
+    # output quantize_ste it generalizes: straight-through, so the
+    # backward is the SAME clean-masked-weight product either way
     x, w, broken, stuck = res
     wv = w
     if q_bits:
@@ -518,11 +611,63 @@ def _cm_bwd(sigma, q_bits, res, g):
 crossbar_matmul.defvjp(_cm_fwd, _cm_bwd)
 
 
+def tiled_crossbar_matmul(x, w_eff, bk: int, bn: int, adc_bits: int,
+                          preferred_element_type=None):
+    """The tiled crossbar read over an ALREADY-effective weight matrix
+    (fault/mapping.py):
+
+        y[:, jt] = sum_kt quantize_ste(x[:, kt] @ w_eff[kt, jt])
+
+    — each (kt, jt) cell block is one physical crossbar tile whose
+    analog MAC output passes through its own `adc_bits`-wide ADC
+    (dynamic per-tile range, quantize_ste's per-call default) before
+    the digital accumulation across the K-tile axis. This is the pure
+    twin of the kernel's `_apply_tile` + `_adc_read` sequence (the
+    check_tiled_mapping.py parity axis) AND the jax-engine layer path
+    (ops/common.py — there `w_eff` is the perturbed weight the solver
+    installed). Straight-through gradients throughout (`quantize_ste`
+    carries the STE identity).
+
+    Blocks are zero-padded to the kernel's exact launch shapes
+    (8-aligned M block, full (bk, bn) tiles) before the dot: padding
+    changes no value (zero rows/cols contribute zero, an abs-max is
+    never raised by zeros) but it makes every dot the SAME shaped op
+    the kernel runs, so the two engines round identically and the
+    per-lane comparison in scripts/check_tiled_mapping.py can be
+    bit-exact instead of tolerance-based."""
+    bk, bn = int(bk), int(bn)
+    K, N = w_eff.shape
+    m = x.shape[0]
+    bm = _m_block(m)
+    xp = jnp.pad(x, ((0, bm - m), (0, -K % bk)))
+    wp = jnp.pad(w_eff, ((0, -K % bk), (0, -N % bn)))
+    Kp, Np = wp.shape
+    cols = []
+    for n0 in range(0, Np, bn):
+        acc = None
+        for k0 in range(0, Kp, bk):
+            part = jnp.dot(xp[:, k0:k0 + bk], wp[k0:k0 + bk,
+                                                 n0:n0 + bn],
+                           preferred_element_type=preferred_element_type)
+            part = quantize_ste(part, int(adc_bits))
+            acc = part if acc is None else acc + part
+        cols.append(acc)
+    y = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
+    return y[:m, :N]
+
+
 def reference_crossbar_matmul(x, w, broken, stuck, key, sigma: float,
-                              q_bits: int = 0):
+                              q_bits: int = 0, tiles=None):
     """Pure-JAX semantic reference for crossbar_matmul (exact match at
     sigma == 0; same distribution otherwise, different noise stream).
     `q_bits` mirrors the kernel's in-VMEM quantization through
-    `quantize_ste` — same grid, same straight-through forward values."""
+    `quantize_ste` — same grid, same straight-through forward values.
+    `tiles` = the kernel's (bk, bn, adc_bits) tiled-mapping parameter:
+    the matmul becomes per-tile ADC-quantized partial sums accumulated
+    across the K-tile axis (`tiled_crossbar_matmul`)."""
     wq = quantize_ste(w, q_bits) if q_bits else w
-    return x @ perturb_weight(wq, broken, stuck, key, sigma)
+    w_eff = perturb_weight(wq, broken, stuck, key, sigma)
+    if tiles is not None:
+        return tiled_crossbar_matmul(x, w_eff, tiles[0], tiles[1],
+                                     tiles[2])
+    return x @ w_eff

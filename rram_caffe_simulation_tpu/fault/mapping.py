@@ -1,0 +1,285 @@
+"""Tiled crossbar mapping: weight matrices split across fault-independent
+crossbar tiles (ROADMAP item 1; the mapping axis CIM-Explorer, arXiv
+2505.14303, sweeps and the multi-tile layer model NEON, arXiv 2211.05730,
+assumes for per-tile ADC readout).
+
+The reference (and this port before ISSUE 11) maps every fault-target
+weight matrix onto ONE idealized crossbar: a single fault draw covers
+the whole matrix, and the whole analog accumulation is read through one
+ADC. Real arrays are bounded (128x128 .. 512x512 cells), so an
+ImageNet-class FC layer spans MANY physical tiles, and that changes the
+physics in two ways this module models:
+
+1. **Fault independence per tile** — each physical array is its own
+   die area with its own defect/endurance statistics, so every tile of
+   a layer gets an INDEPENDENT fault draw: the per-parameter draw key
+   is folded per (layer, tile) in tile-major order, making any tile
+   grid reproducible from (seed, spec) alone. A 1x1 grid takes the
+   unfolded legacy key path and is **byte-identical** to the untiled
+   draw (CI-guarded by scripts/check_tiled_mapping.py).
+
+2. **Per-tile ADC partial sums** — the analog MAC happens inside one
+   tile; crossing tiles means going through that tile's ADC and
+   accumulating DIGITALLY. The effective read of a tiled layer is
+   ``y[:, jt] = sum_kt quantize_ste(x[:, kt] @ w_eff[kt, jt])`` —
+   `quantize_ste` applied per tile-column partial product before the
+   K-tile summation, on both the pure-JAX path and the Pallas kernel
+   (fault/hw_aware.py, where the kernel's (j, k) block grid IS the
+   tile grid).
+
+`TileSpec` is the canonical selection object (the PR 10 `FaultSpec`
+shape: parse / canonical string / equality by canonical form), pinned
+end to end: `Solver(tile_spec=)` / proto `rram_forward.tiles` /
+`caffe_cli --tiles`, sweep checkpoint meta (v6 — restore refuses a
+mismatch, v1-v5 upgrade as the implicit default), serve admission, the
+co-design "tiles" axis, and the observe layer's `fault.per_tile`
+census.
+
+Spec syntax (canonical forms shown):
+
+- ``"1x1"`` — the default: one tile per weight matrix, byte-identical
+  to the untiled program.
+- ``"GRxGC"`` (grid form, e.g. ``"2x4"``) — split every fault-target
+  2-D weight into at most GR x GC tiles (ceil-divided cell blocks over
+  the STORED dims; a matrix smaller than the grid clamps to one cell
+  tile minimum, so every tile is non-empty).
+- ``"cells=RxC"`` (physical form, e.g. ``"cells=256x256"``) — tiles of
+  at most R x C cells, the CIM-Explorer array-size axis; the per-layer
+  tile GRID is auto-derived as (ceil(d0/R), ceil(d1/C)).
+
+Tiles are defined over the STORED 2-D weight shape (Caffe layout); the
+consuming layer maps them onto the crossbar (K, N) view through its
+own `transpose` flag. Non-2-D fault targets (biases; conv kernels
+under `conv_also`) always resolve to a single tile — they are not
+crossbar matrices.
+
+This module keeps its parse/geometry layer dependency-light (pure
+Python) so analysis tooling — fault/codesign.py, the serve admission
+check, summarize — can canonicalize specs without importing JAX; the
+draw/census helpers import jax lazily.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+#: hard cap on tiles per layer: a census/draw loop is unrolled per tile
+#: at trace time, so a pathological spec (e.g. grid 512x512 on a small
+#: matrix is clamped anyway, but cells=1x1 on fc6 would be ~100M tiles)
+#: must fail loudly instead of hanging the tracer
+MAX_TILES_PER_LAYER = 4096
+
+_GRID_RE = re.compile(r"^(\d+)x(\d+)$")
+_CELLS_RE = re.compile(r"^cells=(\d+)x(\d+)$")
+
+#: the canonical spec of every untiled program (and of pre-v6 sweep
+#: checkpoints, which are implicitly untiled)
+DEFAULT_TILES = "1x1"
+
+
+class TileSpec:
+    """A parsed tile-mapping selection: `mode` is "grid" (a and b are
+    the per-layer tile-grid bounds) or "cells" (a and b are the
+    per-tile cell bounds). Compared by `canonical()` — the pin the
+    checkpoint meta / serve admission / co-design axis carry."""
+
+    def __init__(self, mode: str, a: int, b: int):
+        if mode not in ("grid", "cells"):
+            raise ValueError(f"unknown TileSpec mode {mode!r}")
+        a, b = int(a), int(b)
+        if a < 1 or b < 1:
+            raise ValueError(
+                f"TileSpec dims must be >= 1, got {a}x{b}")
+        if mode == "grid" and a * b > MAX_TILES_PER_LAYER:
+            raise ValueError(
+                f"TileSpec grid {a}x{b} exceeds {MAX_TILES_PER_LAYER} "
+                "tiles per layer (the per-tile draw/census unrolls at "
+                "trace time)")
+        self.mode = mode
+        self.a = a
+        self.b = b
+
+    # --- parsing / canonical form -------------------------------------
+    @classmethod
+    def parse(cls, text) -> "TileSpec":
+        if isinstance(text, TileSpec):
+            return text
+        if text is None or not str(text).strip():
+            text = DEFAULT_TILES
+        text = str(text).strip().lower()
+        m = _GRID_RE.match(text)
+        if m:
+            return cls("grid", int(m.group(1)), int(m.group(2)))
+        m = _CELLS_RE.match(text)
+        if m:
+            return cls("cells", int(m.group(1)), int(m.group(2)))
+        raise ValueError(
+            f"bad tile spec {text!r}: expected 'GRxGC' (a per-layer "
+            "tile grid, e.g. '2x4'; '1x1' = untiled) or 'cells=RxC' "
+            "(cells per tile, e.g. 'cells=256x256')")
+
+    def canonical(self) -> str:
+        if self.mode == "cells":
+            return f"cells={self.a}x{self.b}"
+        return f"{self.a}x{self.b}"
+
+    @property
+    def is_default(self) -> bool:
+        """True for the 1x1 grid — every layer a single tile, the
+        untiled byte-identical program."""
+        return self.mode == "grid" and self.a == 1 and self.b == 1
+
+    # --- per-layer geometry -------------------------------------------
+    def tile_dims(self, shape) -> Tuple[int, int]:
+        """Cells per tile (tr, tc) over a STORED 2-D shape. Grid form
+        ceil-divides the dims; cells form clamps to the matrix."""
+        if len(shape) != 2:
+            raise ValueError(
+                f"tile_dims is defined over 2-D shapes, got {shape}")
+        d0, d1 = int(shape[0]), int(shape[1])
+        if self.mode == "cells":
+            return min(self.a, d0), min(self.b, d1)
+        return -(-d0 // min(self.a, d0)), -(-d1 // min(self.b, d1))
+
+    def grid(self, shape) -> Tuple[int, int]:
+        """The effective tile grid (gr, gc) for a stored shape: always
+        derived from `tile_dims` (so grid-form requests larger than the
+        matrix clamp down and every tile is non-empty); non-2-D shapes
+        are a single tile by definition."""
+        if len(shape) != 2:
+            return (1, 1)
+        tr, tc = self.tile_dims(shape)
+        gr = -(-int(shape[0]) // tr)
+        gc = -(-int(shape[1]) // tc)
+        if gr * gc > MAX_TILES_PER_LAYER:
+            raise ValueError(
+                f"tile spec {self.canonical()!r} maps shape "
+                f"{tuple(shape)} onto {gr}x{gc} = {gr * gc} tiles, "
+                f"over the {MAX_TILES_PER_LAYER}-tile per-layer cap "
+                "(the per-tile draw/census unrolls at trace time); "
+                "use bigger tiles")
+        return gr, gc
+
+    def n_tiles(self, shape) -> int:
+        gr, gc = self.grid(shape)
+        return gr * gc
+
+    def bounds(self, shape) -> Tuple[List[Tuple[int, int]],
+                                     List[Tuple[int, int]]]:
+        """([row (lo, hi)...], [col (lo, hi)...]) cell-block boundaries
+        over a stored 2-D shape, tile-major (row blocks outer)."""
+        tr, tc = self.tile_dims(shape)
+        return (split_bounds(int(shape[0]), tr),
+                split_bounds(int(shape[1]), tc))
+
+    def tile_slices(self, shape):
+        """Yield (tile_index, (r0, r1, c0, c1)) in tile-major order —
+        the ONE definition of tile enumeration the draw fold, the
+        census, and the kernels share (tile_index is what the draw key
+        is folded by)."""
+        rb, cb = self.bounds(shape)
+        t = 0
+        for (r0, r1) in rb:
+            for (c0, c1) in cb:
+                yield t, (r0, r1, c0, c1)
+                t += 1
+
+    def __eq__(self, other):
+        return (isinstance(other, TileSpec)
+                and self.canonical() == other.canonical())
+
+    def __hash__(self):
+        return hash(self.canonical())
+
+    def __repr__(self):
+        return f"TileSpec({self.canonical()!r})"
+
+
+def split_bounds(n: int, t: int) -> List[Tuple[int, int]]:
+    """Ceil-split [0, n) into blocks of at most t cells: the last block
+    may be smaller, every block is non-empty."""
+    return [(lo, min(n, lo + t)) for lo in range(0, n, t)]
+
+
+def canonical(text) -> str:
+    """Parse-and-normalize a spec string (the serve-admission /
+    co-design comparison helper)."""
+    return TileSpec.parse(text).canonical()
+
+
+# ---------------------------------------------------------------------------
+# per-(layer, tile) independent draws
+
+def tiled_draw(key, shape, tiles, draw_fn):
+    """Assemble one parameter's draw tile by tile: `draw_fn(key, shape)`
+    is called once per tile with the key folded by the tile index
+    (tile-major, `TileSpec.tile_slices` order), and the blocks are
+    concatenated back into the full stored shape — so any tile grid is
+    reproducible from (key, spec) alone and tile (i, j)'s cells depend
+    only on (key, tile index, tile shape).
+
+    The single-tile case (tiles None / the default spec / a non-2-D
+    shape / a matrix one tile covers) calls `draw_fn(key, shape)`
+    directly with the UNFOLDED key — byte-identical to the pre-tiling
+    draw, which is the 1x1 identity contract the CI guard pins."""
+    grid = ((1, 1) if tiles is None or len(shape) != 2
+            else tiles.grid(shape))
+    if grid[0] * grid[1] == 1:
+        return draw_fn(key, tuple(shape))
+    import jax
+    import jax.numpy as jnp
+    rb, cb = tiles.bounds(shape)
+    t = 0
+    rows = []
+    for (r0, r1) in rb:
+        blocks = []
+        for (c0, c1) in cb:
+            blocks.append(draw_fn(jax.random.fold_in(key, t),
+                                  (r1 - r0, c1 - c0)))
+            t += 1
+        rows.append(blocks[0] if len(blocks) == 1
+                    else jnp.concatenate(blocks, axis=1))
+    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# tile-resolved fault census (the observe `fault.per_tile` block)
+
+def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
+    """Traced per-tile census reductions for ONE 2-D fault leaf:
+    broken-cell fraction, minimum remaining lifetime, and the stuck-
+    value histogram of the BROKEN cells per tile (how many dead cells
+    read -1 / 0 / +1 — the spatial defect map per physical array).
+
+    Returns {"grid": i32[2], "broken_frac": f32[T], "life_min": f32[T],
+    "stuck_neg"/"stuck_zero"/"stuck_pos": i32[T]} with T = gr * gc in
+    tile-major order. Under the sweep's config vmap each array gains
+    the leading config axis; `counters.to_host` listifies them for the
+    metrics record (schema: observe/schema.py PER_TILE_FIELDS)."""
+    import jax.numpy as jnp
+    gr, gc = tiles.grid(life.shape)
+    broken_frac, life_min = [], []
+    s_neg, s_zero, s_pos = [], [], []
+    for _, (r0, r1, c0, c1) in tiles.tile_slices(life.shape):
+        lt = life[r0:r1, c0:c1]
+        st = stuck[r0:r1, c0:c1]
+        broken = lt <= 0
+        broken_frac.append(jnp.mean(broken.astype(jnp.float32)))
+        life_min.append(jnp.min(lt).astype(jnp.float32))
+        s_neg.append(jnp.sum(broken & (st == -1.0)).astype(jnp.int32))
+        s_zero.append(jnp.sum(broken & (st == 0.0)).astype(jnp.int32))
+        s_pos.append(jnp.sum(broken & (st == 1.0)).astype(jnp.int32))
+    return {
+        "grid": jnp.asarray([gr, gc], jnp.int32),
+        "broken_frac": jnp.stack(broken_frac),
+        "life_min": jnp.stack(life_min),
+        "stuck_neg": jnp.stack(s_neg),
+        "stuck_zero": jnp.stack(s_zero),
+        "stuck_pos": jnp.stack(s_pos),
+    }
+
+
+__all__ = [
+    "TileSpec", "DEFAULT_TILES", "MAX_TILES_PER_LAYER", "canonical",
+    "split_bounds", "tiled_draw", "per_tile_counters",
+]

@@ -90,9 +90,13 @@ class FaultSpec:
             procs.append((name, params))
         return cls(procs)
 
-    def build(self) -> "ProcessStack":
+    def build(self, tiles=None) -> "ProcessStack":
+        """Instantiate the stack. `tiles` (a fault/mapping.py TileSpec)
+        pins the tiled crossbar mapping every state draw of this stack
+        uses — per-(param, tile) independent fault draws; None / the
+        default 1x1 spec keeps the untiled byte-identical draw."""
         return ProcessStack([create_fault_process(n, p)
-                             for n, p in self.processes])
+                             for n, p in self.processes], tiles=tiles)
 
     def canonical(self) -> str:
         return self.build().canonical()
@@ -118,9 +122,19 @@ class ProcessStack:
     most one clamp process — two lifetime timelines over the same cells
     have no composition semantics); state groups merge disjointly."""
 
-    def __init__(self, processes: List[FaultProcess]):
+    def __init__(self, processes: List[FaultProcess], tiles=None):
         if not processes:
             raise ValueError("ProcessStack needs at least one process")
+        # the tiled crossbar mapping (fault/mapping.py) every draw this
+        # stack makes follows: each 2-D fault target's tiles get
+        # independent draws under per-(param, tile) folded keys. None
+        # (or the default 1x1 spec) = the untiled byte-identical draw.
+        from ..mapping import TileSpec
+        self.tiles = None
+        if tiles is not None:
+            tiles = TileSpec.parse(tiles)
+            if not tiles.is_default:
+                self.tiles = tiles
         order = {"decay": 0, "clamp": 1}
         self.processes = sorted(
             processes, key=lambda p: (order.get(p.phase, 2),
@@ -181,7 +195,7 @@ class ProcessStack:
         # stack draws the byte-identical state the legacy engine drew
         return self._merge([
             p.init_state(key if i == 0 else jax.random.fold_in(key, i),
-                         shapes, pattern)
+                         shapes, pattern, tiles=self.tiles)
             for i, p in enumerate(self.processes)])
 
     def draw_rescaled(self, key: jax.Array, shapes: Dict[str, tuple],
@@ -189,7 +203,7 @@ class ProcessStack:
         return self._merge([
             p.draw_rescaled(
                 key if i == 0 else jax.random.fold_in(key, i),
-                shapes, pattern, mean, std)
+                shapes, pattern, mean, std, tiles=self.tiles)
             for i, p in enumerate(self.processes)])
 
     # --- the in-step transform ----------------------------------------

@@ -62,18 +62,24 @@ class FaultProcess:
 
     # --- state ---------------------------------------------------------
     def init_state(self, key: jax.Array, shapes: Dict[str, tuple],
-                   pattern) -> dict:
+                   pattern, tiles=None) -> dict:
         """Draw this process's state groups for the given fault-target
-        parameter shapes (the GaussianFailureMaker-ctor moment)."""
+        parameter shapes (the GaussianFailureMaker-ctor moment).
+        `tiles` (a fault/mapping.py TileSpec, or None) is the tiled
+        crossbar mapping: each 2-D param's tiles must get INDEPENDENT
+        draws under per-tile folded keys (`mapping.tiled_draw` is the
+        shared assembler; a single tile = the unfolded legacy draw,
+        byte-identical)."""
         raise NotImplementedError
 
     def draw_rescaled(self, key: jax.Array, shapes: Dict[str, tuple],
-                      pattern, mean, std) -> dict:
+                      pattern, mean, std, tiles=None) -> dict:
         """One independent per-config draw with the lifetime
         distribution re-anchored to (mean, std) — the kernel the
         config-stacked sweep vmaps over and the self-healing lane
         refill calls. Processes without a lifetime distribution ignore
-        (mean, std) and just draw independently under `key`."""
+        (mean, std) and just draw independently under `key`. `tiles`
+        as in `init_state`."""
         raise NotImplementedError
 
     # --- the in-step transform ----------------------------------------

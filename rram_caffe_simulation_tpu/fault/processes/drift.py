@@ -61,22 +61,32 @@ class ConductanceDrift(FaultProcess):
             raise ValueError(f"conductance_drift nu must be >= 0, got "
                              f"{self.nu!r}")
 
-    def init_state(self, key, shapes, pattern):
+    def init_state(self, key, shapes, pattern, tiles=None):
+        from .. import mapping as fault_mapping
         age, rate = {}, {}
+
+        def rate_draw(k, shape):
+            z = jax.random.normal(k, shape, dtype=jnp.float32)
+            return jnp.float32(self.nu) * jnp.exp(
+                jnp.float32(self.sigma) * z)
+
         for name in sorted(shapes):
             key, k_rate = jax.random.split(key)
             shape = shapes[name]
             age[name] = jnp.zeros(shape, jnp.float32)
-            z = jax.random.normal(k_rate, shape, dtype=jnp.float32)
-            rate[name] = jnp.float32(self.nu) * jnp.exp(
-                jnp.float32(self.sigma) * z)
+            # the frozen rate field is a fault draw too: each crossbar
+            # tile is its own die area, so its drift-coefficient
+            # variation draws independently under the tile-folded key
+            rate[name] = fault_mapping.tiled_draw(k_rate, shape, tiles,
+                                                  rate_draw)
         return {"drift_age": age, "drift_rate": rate}
 
-    def draw_rescaled(self, key, shapes, pattern, mean, std):
+    def draw_rescaled(self, key, shapes, pattern, mean, std,
+                      tiles=None):
         # drift has no lifetime distribution; (mean, std) parameterize
         # the clamp process of the stack — each config just gets an
         # independent rate-field draw under its own key
-        return self.init_state(key, shapes, pattern)
+        return self.init_state(key, shapes, pattern, tiles=tiles)
 
     def fail(self, fault_params, state, fault_diffs, decrement):
         new_params, new_age = {}, {}

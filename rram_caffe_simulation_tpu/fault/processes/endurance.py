@@ -29,12 +29,14 @@ class EnduranceStuckAt(FaultProcess):
     supports_packed = True
     param_names = ()
 
-    def init_state(self, key, shapes, pattern):
-        return fault_engine.init_fault_state(key, shapes, pattern)
+    def init_state(self, key, shapes, pattern, tiles=None):
+        return fault_engine.init_fault_state(key, shapes, pattern,
+                                             tiles=tiles)
 
-    def draw_rescaled(self, key, shapes, pattern, mean, std):
+    def draw_rescaled(self, key, shapes, pattern, mean, std,
+                      tiles=None):
         return fault_engine.draw_rescaled_state(key, shapes, pattern,
-                                                mean, std)
+                                                mean, std, tiles=tiles)
 
     def fail(self, fault_params, state, fault_diffs, decrement):
         return fault_engine.fail(fault_params, state, fault_diffs,

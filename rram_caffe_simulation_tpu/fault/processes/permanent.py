@@ -92,33 +92,47 @@ class PermanentFaultMap(FaultProcess):
             stuck[name] = jnp.asarray(s, jnp.float32)
         return {"lifetimes": life, "stuck": stuck}
 
-    def _draw_map(self, key, shapes, pattern):
+    def _draw_map(self, key, shapes, pattern, tiles=None):
+        from .. import mapping as fault_mapping
         split1, split2 = fault_engine._stuck_splits(pattern)
         frac = float(self.fraction)
+
+        def life_draw(k, shape):
+            broken = jax.random.uniform(k, shape) < frac
+            return jnp.where(broken, -1.0, 1.0).astype(jnp.float32)
+
+        def stuck_draw(k, shape):
+            u = jax.random.uniform(k, shape, dtype=jnp.float32)
+            return jnp.where(
+                u < split1, -1.0,
+                jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+
         life, stuck = {}, {}
         for name in sorted(shapes):
             key, k_b, k_s = jax.random.split(key, 3)
             shape = shapes[name]
-            broken = jax.random.uniform(k_b, shape) < frac
-            life[name] = jnp.where(broken, -1.0,
-                                   1.0).astype(jnp.float32)
-            u = jax.random.uniform(k_s, shape, dtype=jnp.float32)
-            stuck[name] = jnp.where(
-                u < split1, -1.0,
-                jnp.where(u < split2, 0.0, 1.0)).astype(jnp.float32)
+            # per-tile independent yield draws: defects are a per-die
+            # statistic, so every crossbar tile rolls its own
+            life[name] = fault_mapping.tiled_draw(k_b, shape, tiles,
+                                                  life_draw)
+            stuck[name] = fault_mapping.tiled_draw(k_s, shape, tiles,
+                                                   stuck_draw)
         return {"lifetimes": life, "stuck": stuck}
 
     # --- state ---------------------------------------------------------
-    def init_state(self, key, shapes, pattern):
+    def init_state(self, key, shapes, pattern, tiles=None):
         if self.map_path is not None:
+            # file maps carry the measured per-cell defects verbatim —
+            # the tile structure is already IN the measurement
             return self._load_map(shapes)
-        return self._draw_map(key, shapes, pattern)
+        return self._draw_map(key, shapes, pattern, tiles=tiles)
 
-    def draw_rescaled(self, key, shapes, pattern, mean, std):
+    def draw_rescaled(self, key, shapes, pattern, mean, std,
+                      tiles=None):
         # no lifetime distribution to rescale: file maps are identical
         # per config (the chip IS the chip); fraction maps draw an
         # independent defect placement per config key
-        return self.init_state(key, shapes, pattern)
+        return self.init_state(key, shapes, pattern, tiles=tiles)
 
     # --- the (static) transform ---------------------------------------
     def fail(self, fault_params, state, fault_diffs, decrement):

@@ -57,12 +57,14 @@ class ReadDisturb(FaultProcess):
     def write_quantum(self, decrement: float) -> float:
         return self._reads(decrement)
 
-    def init_state(self, key, shapes, pattern):
-        return fault_engine.init_fault_state(key, shapes, pattern)
+    def init_state(self, key, shapes, pattern, tiles=None):
+        return fault_engine.init_fault_state(key, shapes, pattern,
+                                             tiles=tiles)
 
-    def draw_rescaled(self, key, shapes, pattern, mean, std):
+    def draw_rescaled(self, key, shapes, pattern, mean, std,
+                      tiles=None):
         return fault_engine.draw_rescaled_state(key, shapes, pattern,
-                                                mean, std)
+                                                mean, std, tiles=tiles)
 
     def fail(self, fault_params, state, fault_diffs, decrement):
         reads = self._reads(decrement)

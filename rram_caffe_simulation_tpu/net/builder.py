@@ -245,6 +245,7 @@ class Net:
               iteration=None, with_updates: bool = False,
               start: Optional[str] = None, end: Optional[str] = None,
               adc_bits: int = 0, crossbar: Optional[dict] = None,
+              tiles: Optional[dict] = None,
               compute_dtype=None, seq_mesh=None, seq_impl: str = "ring",
               probes: Optional[dict] = None,
               trace_sites: Optional[dict] = None):
@@ -255,7 +256,10 @@ class Net:
         stats) is requested. `adc_bits` (static) turns on the hardware-aware
         ADC output quantization in crossbar (InnerProduct) layers;
         `crossbar` routes named InnerProduct layers through the fused
-        Pallas conductance-noise kernel (see LayerContext.crossbar).
+        Pallas conductance-noise kernel (see LayerContext.crossbar);
+        `tiles` switches named InnerProduct layers to the tiled
+        crossbar mapping — per-tile ADC partial sums over per-layer
+        tile grids (see LayerContext.tiles / fault/mapping.py).
 
         Debug capture points (observe/debug.py — the `debug_info` deep
         trace; both default off and add NOTHING to the traced program
@@ -270,7 +274,7 @@ class Net:
         batch = batch or {}
         ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration,
                            adc_bits=adc_bits, crossbar=crossbar,
-                           compute_dtype=compute_dtype,
+                           tiles=tiles, compute_dtype=compute_dtype,
                            seq_mesh=seq_mesh, seq_impl=seq_impl)
         run_layers = self.layer_range(start, end)
         produced_in_range = {t for l in run_layers for t in l.lp.top}

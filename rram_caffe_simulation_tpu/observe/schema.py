@@ -22,7 +22,14 @@ Top-level record::
                                        "life_mean": 8.9e7}},
                "per_process": {"endurance_stuck_at": {"broken": 120},
                                "conductance_drift": {
-                                   "drifted": 9000, "age_mean": 41.2}}}}
+                                   "drifted": 9000, "age_mean": 41.2}},
+               "per_tile": {"fc1/0": {          # tiled mapping only
+                   "grid": [2, 2],              # tile rows x cols
+                   "broken_frac": [0.1, 0.0, 0.2, 0.05],
+                   "life_min": [-35.0, 12.0, -3.0, 88.0],
+                   "stuck_neg": [3, 0, 5, 1],   # broken cells reading
+                   "stuck_zero": [9, 0, 11, 4], # -1 / 0 / +1 per tile
+                   "stuck_pos": [2, 0, 4, 1]}}}}
 
 `fault` is present only when the solver runs a fault engine; `seed` only
 on the first record a Solver writes — so once per run segment: a
@@ -112,6 +119,13 @@ FAULT_FIELDS = {
     # it, e.g. {"endurance_stuck_at": {"broken": 120},
     # "conductance_drift": {"drifted": 9000, "age_mean": 41.2}}
     "per_process": (dict, False),
+    # tile-resolved census (fault/mapping.py per_tile_counters, only
+    # under a non-default tile spec): per 2-D fault target, the tile
+    # grid plus per-tile vectors in tile-major order — broken-cell
+    # fraction, min remaining lifetime, and the broken-cell stuck
+    # histogram (counts reading -1/0/+1). Under a sweep every vector
+    # gains a leading per-config axis (lists of lists).
+    "per_tile": (dict, False),
 }
 
 PER_PARAM_FIELDS = {
@@ -119,6 +133,15 @@ PER_PARAM_FIELDS = {
     "newly_expired": (int, True),
     "life_min": (_NUM, True),
     "life_mean": (_NUM, True),
+}
+
+PER_TILE_FIELDS = {
+    "grid": (list, True),
+    "broken_frac": (list, True),
+    "life_min": (list, True),
+    "stuck_neg": (list, True),
+    "stuck_zero": (list, True),
+    "stuck_pos": (list, True),
 }
 
 # --- debug_trace records (the structured debug_info trace) ---
@@ -638,4 +661,13 @@ def validate_record(rec) -> list:
                         errs.append(
                             f"fault.per_process[{pname!r}].{cname}: "
                             "not a number (or per-config list)")
+        pt = fault.get("per_tile")
+        if isinstance(pt, dict):
+            for key, entry in pt.items():
+                if not isinstance(entry, dict):
+                    errs.append(f"fault.per_tile[{key!r}]: not an "
+                                "object")
+                    continue
+                errs += _check_fields(entry, PER_TILE_FIELDS,
+                                      f"fault.per_tile[{key!r}]")
     return errs

@@ -57,13 +57,29 @@ class InnerProductLayer(Layer):
         w = params[0]
         cb = getattr(ctx, "crossbar", None)
         cb = cb.get(self.name) if cb else None
+        # Tiled crossbar mapping (fault/mapping.py via ctx.tiles): this
+        # layer's weight spans multiple physical arrays, so its read is
+        # per-tile ADC-quantized partial sums accumulated across the
+        # K-tile axis. (tr, tc) are the tile cell dims over the STORED
+        # weight; the crossbar (K, N) view swaps them under the default
+        # Caffe (num_output, K) layout.
+        tl = getattr(ctx, "tiles", None)
+        tl = tl.get(self.name) if tl else None
+        adc = getattr(ctx, "adc_bits", 0)
+        kernel_tiles = None
+        if tl is not None:
+            tr, tc = tl
+            bk, bn = (tr, tc) if self.transpose else (tc, tr)
+            kernel_tiles = (int(bk), int(bn), int(adc))
         if cb is not None:
             # Fused Pallas crossbar read: stuck mask + conductance noise
             # + optional ADC-grid quantization + matmul in one kernel,
             # noise drawn and the grid applied in VMEM (never in HBM).
             # broken/stuck are shaped like the STORED weight. Under the
             # sweep's config vmap this dispatches to the config-batched
-            # kernel (fault/hw_aware.py ENGINE MATRIX).
+            # kernel (fault/hw_aware.py ENGINE MATRIX). A tiled layer
+            # folds its tile grid + per-tile ADC into the kernel
+            # (block grid == tile grid).
             from ..fault.hw_aware import crossbar_matmul
             broken, stuck, seed, sigma, q_bits = cb
             y = crossbar_matmul(
@@ -71,16 +87,28 @@ class InnerProductLayer(Layer):
                 (w if self.transpose else w.T).astype(jnp.float32),
                 broken if self.transpose else broken.T,
                 (stuck if self.transpose else stuck.T).astype(jnp.float32),
-                seed, sigma, q_bits).astype(bottoms[0].dtype)
+                seed, sigma, q_bits,
+                kernel_tiles).astype(bottoms[0].dtype)
+        elif kernel_tiles is not None:
+            # jax engine, tiled: the stored weight already carries the
+            # perturbed/faulty read values (the solver installs them);
+            # this layer owns the partial-sum structure + per-tile ADC.
+            from ..fault.hw_aware import tiled_crossbar_matmul
+            y = tiled_crossbar_matmul(
+                x, w if self.transpose else w.T, kernel_tiles[0],
+                kernel_tiles[1], kernel_tiles[2],
+                preferred_element_type=bottoms[0].dtype)
         else:
             y = jnp.dot(x, w if self.transpose else w.T,
                         preferred_element_type=bottoms[0].dtype)
-        if getattr(ctx, "adc_bits", 0):
+        if adc and tl is None:
             # Hardware-aware ADC: the crossbar's bitline currents (the
             # matmul output, pre-bias — the bias lives in digital) are
-            # read through a adc_bits-wide converter.
+            # read through a adc_bits-wide converter. A TILED layer has
+            # already paid its ADC per tile-column partial sum — the
+            # whole-output converter would double-quantize.
             from ..fault.hw_aware import quantize_ste
-            y = quantize_ste(y, ctx.adc_bits)
+            y = quantize_ste(y, adc)
         if self.bias_term:
             y = y + params[1]
         return [y.reshape(self.out_shape[:-1] + (self.num_output,))], None

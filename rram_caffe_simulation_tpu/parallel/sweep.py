@@ -41,19 +41,25 @@ from .mesh import (make_mesh, global_put, put_rows, config_sharding,
 #: fault-process stack (fault/processes/) — the meta carries the
 #: canonical `fault_process` spec and restore() refuses a mismatched
 #: process (a v1-v4 checkpoint is implicitly the endurance_stuck_at
-#: default, so legacy stuck-at state upgrades in place). restore()
+#: default, so legacy stuck-at state upgrades in place); v6 added the
+#: tiled crossbar mapping (fault/mapping.py) — the meta pins the
+#: canonical `tile_spec` and restore() refuses a mismatch (a v1-v5
+#: checkpoint is implicitly the untiled "1x1" mapping). restore()
 #: upgrades v1 (identity lane map assumed), v2, v3 (fault leaves
-#: converted to the runner's format), and v4 checkpoints in place and
-#: refuses anything else.
-CHECKPOINT_VERSION = 5
+#: converted to the runner's format), v4, and v5 checkpoints in place
+#: and refuses anything else.
+CHECKPOINT_VERSION = 6
 
 #: the implicit fault process of every pre-v5 checkpoint
 _LEGACY_PROCESS = "endurance_stuck_at"
 
+#: the implicit tile mapping of every pre-v6 checkpoint (untiled)
+_LEGACY_TILES = "1x1"
+
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
                        n_configs: int, means=None, stds=None, rows=None,
-                       process=None):
+                       process=None, tiles=None):
     """n_configs independent fault-state draws, stacked on axis 0.
     `means`/`stds` optionally override pattern.mean/std per config
     (the run_different_mean.sh / run_different_mean_var.sh grids).
@@ -63,14 +69,16 @@ def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
     same rows of the full draw. `process` (a fault/processes
     ProcessStack) draws through the configured fault-process stack;
     None = the legacy endurance kernel (bit-identical to the default
-    stack)."""
+    stack). `tiles` (a fault/mapping.py TileSpec) gives each crossbar
+    tile of every 2-D param an independent draw on the legacy path —
+    a ProcessStack carries its own tile spec, pinned at build."""
     mean = (np.asarray(means, np.float32) if means is not None
             else np.full((n_configs,), float(pattern.mean), np.float32))
     std = (np.asarray(stds, np.float32) if stds is not None
            else np.full((n_configs,), float(pattern.std), np.float32))
     return fault_engine.draw_state_rows(key, param_shapes, pattern,
                                         n_configs, mean, std, rows=rows,
-                                        process=process)
+                                        process=process, tiles=tiles)
 
 
 class _HealingState:
@@ -353,7 +361,8 @@ class SweepRunner:
         self.fault_states = stack_fault_states(
             key, shapes, solver.param.failure_pattern, n_configs,
             means=means, stds=stds, rows=self._cfg_rows,
-            process=solver.fault_process)
+            process=solver.fault_process,
+            tiles=getattr(solver, "tile_spec", None))
         bcast = lambda x: jnp.repeat(x[None], n_local, axis=0)
         if "remap_slots" in (solver.fault_state or {}):
             # tracked remapping: every config starts at the identity map
@@ -822,7 +831,8 @@ class SweepRunner:
                 key, shapes, s.param.failure_pattern, mean, std)
         else:
             st = fault_engine.draw_rescaled_state(
-                key, shapes, s.param.failure_pattern, mean, std)
+                key, shapes, s.param.failure_pattern, mean, std,
+                tiles=getattr(s, "tile_spec", None))
         if "remap_slots" in (s.fault_state or {}):
             # tracked remapping restarts at the identity map
             st["remap_slots"] = s.fault_state["remap_slots"]
@@ -2207,6 +2217,13 @@ class SweepRunner:
         fs = getattr(self.solver, "fault_spec", None)
         return fs.canonical() if fs is not None else _LEGACY_PROCESS
 
+    def _tile_canonical(self) -> str:
+        """The canonical tiled-crossbar-mapping spec this runner trains
+        under (fault/mapping.py) — the v6 checkpoint pin restore()
+        compares, and what serve admission pins per request."""
+        ts = getattr(self.solver, "tile_spec", None)
+        return ts.canonical() if ts is not None else _LEGACY_TILES
+
     def _ckpt_meta(self) -> dict:
         """The checkpoint meta block (shared by the single-file layout,
         where it rides as the __meta__ array, and the distributed
@@ -2224,6 +2241,11 @@ class SweepRunner:
                 # the wrong transition timeline, so restore() refuses a
                 # mismatch
                 "fault_process": self._process_canonical(),
+                # v6: the tiled crossbar mapping the fault state was
+                # drawn (and the crossbar read traced) under — a
+                # different tile grid is a different Monte-Carlo space,
+                # so restore() refuses a mismatch
+                "tile_spec": self._tile_canonical(),
                 "key": [int(x)
                         for x in np.asarray(self.solver._key).ravel()],
                 "seed": int(self.solver.seed),
@@ -2525,16 +2547,18 @@ class SweepRunner:
         self.solver.wait_for_snapshots()
         data, meta, gen = self._load_checkpoint_data(path)
         found = meta.get("version")
-        if found not in (1, 2, 3, 4, CHECKPOINT_VERSION):
+        if found not in (1, 2, 3, 4, 5, CHECKPOINT_VERSION):
             raise ValueError(
                 f"checkpoint {path} has format version {found!r} but "
                 f"this build expects version {CHECKPOINT_VERSION} "
-                "(v1-v4 checkpoints are upgraded in place: v1 has "
+                "(v1-v5 checkpoints are upgraded in place: v1 has "
                 "no lane map, so the identity lane->config mapping is "
                 "assumed; pre-v3 fault leaves are f32 and convert to "
                 "this runner's fault format on load; v4 adds the "
                 "distributed directory layout; v5 pins the fault-"
-                "process spec — pre-v5 state is endurance_stuck_at)")
+                "process spec — pre-v5 state is endurance_stuck_at; "
+                "v6 pins the tile spec — pre-v6 state is the untiled "
+                "1x1 mapping)")
         if int(meta["n_configs"]) != self.n:
             raise ValueError(
                 f"checkpoint {path} holds {meta['n_configs']} configs "
@@ -2551,6 +2575,21 @@ class SweepRunner:
                 "restoring across fault physics would replay the wrong "
                 "transition timeline — resume with the same "
                 "fault_process spec the checkpoint was written under")
+        # v6 tile-spec pin: pre-v6 checkpoints are implicitly the
+        # untiled 1x1 mapping — they upgrade in place into an untiled
+        # runner and refuse a tiled one (the tile grid decides both
+        # the fault draw's Monte-Carlo space and the traced crossbar
+        # read; restoring across mappings would silently continue a
+        # DIFFERENT experiment)
+        ck_tiles = meta.get("tile_spec", _LEGACY_TILES)
+        my_tiles = self._tile_canonical()
+        if str(ck_tiles) != my_tiles:
+            raise ValueError(
+                f"checkpoint {path} was trained under tile spec "
+                f"{ck_tiles!r} but this runner maps crossbars as "
+                f"{my_tiles!r}; resume with the same tile_spec the "
+                "checkpoint was written under (fault/mapping.py — "
+                "pre-v6 checkpoints are the untiled '1x1' mapping)")
         key = [int(x) for x in np.asarray(self.solver._key).ravel()]
         if list(meta["key"]) != key:
             raise ValueError(

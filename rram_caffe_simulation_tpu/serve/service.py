@@ -399,6 +399,28 @@ class SweepService:
                                       f"{want_canon!r} but this "
                                       f"service runs {mine!r}")
                     continue
+            want_tiles = req.get("tiles")
+            if want_tiles is not None:
+                # same contract as the physics pin: the resident lane
+                # pool compiled ONE tiled crossbar mapping
+                # (fault/mapping.py) — its fault draws and per-tile
+                # ADC reads are baked into the warm program — so a
+                # request pinning a different mapping is refused at
+                # admission. Compared CANONICALIZED so equivalent
+                # spellings are accepted.
+                from ..fault.mapping import TileSpec
+                mine_t = self.runner._tile_canonical()
+                try:
+                    want_t = TileSpec.parse(want_tiles).canonical()
+                except Exception as e:
+                    self._reject(req, f"unparseable tile-mapping pin "
+                                      f"{want_tiles!r}: {e}")
+                    continue
+                if want_t != mine_t:
+                    self._reject(req, f"request pins tile mapping "
+                                      f"{want_t!r} but this service "
+                                      f"maps crossbars as {mine_t!r}")
+                    continue
             extra = req["iters"] * len(req["configs"])
             projected = self._projected_seconds(extra)
             at_risk = (self.slo_seconds > 0 and projected

@@ -95,6 +95,21 @@ def normalize_request(req: dict, default_iters: int = 0) -> dict:
                 f"request process {proc!r} must be a non-empty "
                 "fault-process spec string (at most 256 chars)")
         out["process"] = proc.strip()
+    tiles = out.get("tiles")
+    if tiles is not None:
+        # optional tiled-crossbar-mapping pin (fault/mapping.py spec
+        # syntax, e.g. "cells=256x256"): like the process pin, the
+        # resident service trains ONE compiled tile mapping, so a
+        # request naming a different one is refused at admission
+        # (canonicalized comparison happens in the service — this
+        # spool layer stays dependency-free)
+        if not isinstance(tiles, str) or not tiles.strip() \
+                or len(tiles) > 64:
+            raise ValueError(
+                f"request tiles {tiles!r} must be a non-empty tile-"
+                "mapping spec string (at most 64 chars, e.g. '1x1' "
+                "or 'cells=256x256')")
+        out["tiles"] = tiles.strip()
     iters = out.get("iters") or default_iters
     if not iters:
         # no explicit budget and no default known HERE (e.g. the

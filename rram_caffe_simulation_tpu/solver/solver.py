@@ -101,7 +101,7 @@ class Solver:
     def __init__(self, param, train_feed: Optional[Callable] = None,
                  test_feeds=None, compute_dtype=None,
                  fail_decrement: Optional[float] = None,
-                 fault_process=None):
+                 fault_process=None, tile_spec=None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
         # cold-start layer: when RRAM_TPU_CACHE_DIR is set, every jitted
@@ -199,6 +199,17 @@ class Solver:
         from ..fault.processes import DEFAULT_PROCESS, FaultSpec
         self.fault_spec = FaultSpec.parse(fault_process)
         self.fault_process = None   # ProcessStack once the engine is on
+        # Tiled crossbar mapping (fault/mapping.py, ISSUE 11): the
+        # `tile_spec` constructor parameter (CLI `--tiles`) wins over
+        # the proto `rram_forward.tiles` field; the default "1x1" is
+        # one tile per weight matrix — the untiled byte-identical
+        # program. A non-default spec splits every fault-target 2-D
+        # weight into fault-independent tiles (per-tile draws) and
+        # switches its read to per-tile ADC partial sums.
+        from ..fault.mapping import TileSpec
+        if tile_spec is None and param.HasField("rram_forward"):
+            tile_spec = getattr(param.rram_forward, "tiles", "") or None
+        self.tile_spec = TileSpec.parse(tile_spec)
         self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
                             for r in self.net.failure_param_refs]
         if (param.HasField("failure_pattern")
@@ -218,7 +229,8 @@ class Solver:
                 and param.failure_pattern.type == "gaussian"):
             # Like FailureMaker::CreateMaker (failure_maker.hpp:23-30), any
             # other type (e.g. "none") means no fault engine.
-            self.fault_process = self.fault_spec.build()
+            self.fault_process = self.fault_spec.build(
+                tiles=self.tile_spec)
             self._key, k_fault = jax.random.split(self._key)
             shapes = {k: self._flat(self.params)[k].shape
                       for k in self._fault_keys}
@@ -233,6 +245,17 @@ class Solver:
                 "configured but no fault engine is active — it needs "
                 "failure_pattern { type: 'gaussian' } and at least one "
                 "fault-target layer")
+        if not self.tile_spec.is_default and self.fault_state is None:
+            # tiling partitions the fault draw and the crossbar read of
+            # the fault-target weights; with no engine there is nothing
+            # to tile, and silently training untiled would report
+            # results for a mapping the user did not ask for
+            raise ValueError(
+                f"tile_spec {self.tile_spec.canonical()!r} is "
+                "configured but no fault engine is active — tiled "
+                "crossbar mapping needs failure_pattern "
+                "{ type: 'gaussian' } and at least one fault-target "
+                "layer")
         if (param.HasField("rram_forward")
                 and (param.rram_forward.sigma or param.rram_forward.adc_bits)
                 and self.fault_state is None):
@@ -390,6 +413,27 @@ class Solver:
     # ------------------------------------------------------------------
     # the jitted train step
 
+    def _tiles_ctx(self):
+        """Tiled crossbar mapping (fault/mapping.py): per-layer tile
+        cell dims over the STORED weight shape, for every fault-target
+        FC weight the configured spec splits into more than one tile —
+        the `tiles` kwarg Net.apply threads to the layers, shared by
+        the TRAIN step and test-phase inference (the chip reads every
+        crossbar through its tiles, train or test). The default 1x1
+        spec (and every single-tile layer) populates nothing, so the
+        untiled traced program is byte-identical — the contract
+        scripts/check_tiled_mapping.py guards. None when untiled."""
+        tspec = getattr(self, "tile_spec", None)
+        if tspec is None or tspec.is_default:
+            return None
+        flat_shapes = self._flat(self.params)
+        out = {}
+        for wkey, _ in self.fc_pairs:
+            shape = flat_shapes[wkey].shape
+            if len(shape) == 2 and tspec.n_tiles(shape) > 1:
+                out[wkey.rsplit("/", 1)[0]] = tspec.tile_dims(shape)
+        return out or None
+
     def make_train_step(self, hw_engine: str = "auto",
                         compute_dtype=None, apply_fn=None,
                         with_metrics=None, with_debug=None,
@@ -478,7 +522,8 @@ class Solver:
         # — the exact legacy engine semantics
         process = self.fault_process
         if process is None and self.fault_state is not None:
-            process = self.fault_spec.build()
+            process = self.fault_spec.build(
+                tiles=getattr(self, "tile_spec", None))
         lr_mults = {fault_engine.param_key(r.layer_name, r.slot): r.lr_mult
                     for r in owner_refs}
         decay_mults = {fault_engine.param_key(r.layer_name, r.slot):
@@ -557,6 +602,15 @@ class Solver:
         # Weight (2-D crossbar) keys go through the fused kernel on the
         # pallas engine; biases always take the pure perturbation.
         crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
+        tspec = getattr(self, "tile_spec", None)
+        tiles_ctx = self._tiles_ctx() if has_fault else None
+        if tiles_ctx is not None and apply_fn is not None:
+            raise ValueError(
+                "tiled crossbar mapping is not supported with a custom "
+                "apply_fn (pipeline/sequence parallelism, remat "
+                "sweeps): those wrappers bypass the layer context that "
+                "carries the per-layer tile grids. Train with "
+                "tile_spec='1x1' or without the wrapper.")
 
         def _broken_stuck(fault_state, k):
             """The read-side broken mask + stuck values of one fault
@@ -633,6 +687,11 @@ class Solver:
                 trace_sites = {} if debug_on else None
                 extra = ({"probes": pr, "trace_sites": trace_sites}
                          if debug_on else {})
+                if tiles_ctx is not None:
+                    # only passed when populated: a custom apply_fn
+                    # (gated above to the untiled spec) need not grow
+                    # the kwarg
+                    extra = {**extra, "tiles": tiles_ctx}
                 blobs, loss, newp = (apply_fn or net.apply)(
                     p, run_batch, rng=rng, iteration=it, with_updates=True,
                     adc_bits=adc_bits, crossbar=crossbar,
@@ -858,6 +917,28 @@ class Solver:
                                               _life_view(fault_state))
                         if pp:
                             metrics["fault"]["per_process"] = pp
+                        # tile-resolved fault census (fault/mapping.py
+                        # per_tile_counters): broken fraction, min
+                        # lifetime, and the broken-cell stuck histogram
+                        # PER CROSSBAR TILE of every 2-D fault target —
+                        # only under a non-default tile spec, so the
+                        # default metrics tree (and program) is
+                        # unchanged
+                        if (tspec is not None
+                                and not tspec.is_default):
+                            from ..fault import mapping as fmapping
+                            lv = _life_view(fault_state)
+                            pt = {}
+                            for k in fault_keys:
+                                life_k = lv.get(k)
+                                if life_k is None or life_k.ndim != 2:
+                                    continue
+                                _, stuck_k = _broken_stuck(fault_state,
+                                                           k)
+                                pt[k] = fmapping.per_tile_counters(
+                                    life_k, stuck_k, tspec)
+                            if pt:
+                                metrics["fault"]["per_tile"] = pt
 
             # -- debug_info deep trace + sentinels (observe/debug.py) --
             if debug_on:
@@ -1664,10 +1745,19 @@ class Solver:
             adc_bits = (int(self.param.rram_forward.adc_bits)
                         if self.param.HasField("rram_forward")
                         and self.fault_state is not None else 0)
+            # the tiled crossbar mapping applies to test reads too —
+            # the chip's tiles (and their per-tile ADCs) are the same
+            # silicon either phase; evaluating untiled would report
+            # accuracy for a different hardware mapping than the one
+            # being trained/swept
+            tiles_ctx = (self._tiles_ctx()
+                         if self.fault_state is not None else None)
+            extra = ({"tiles": tiles_ctx}
+                     if tiles_ctx is not None else {})
 
             def run(params, batch, rng):
                 blobs, loss = net.apply(params, batch, rng=rng,
-                                        adc_bits=adc_bits)
+                                        adc_bits=adc_bits, **extra)
                 out = {n: blobs[n] for n in net.output_names}
                 if self.param.test_compute_loss:
                     out["__loss"] = loss

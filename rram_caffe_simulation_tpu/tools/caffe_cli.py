@@ -80,7 +80,8 @@ def train(args):
                  "not both")
     solver = Solver(args.solver,
                     compute_dtype=args.compute_dtype or None,
-                    fault_process=args.fault_process)
+                    fault_process=args.fault_process,
+                    tile_spec=args.tiles or None)
     if args.metrics_out:
         # observe package layer 2: one record per display interval.
         # Extension picks the sink — .jsonl gets the schema-versioned
@@ -650,6 +651,17 @@ def main(argv=None):
                         "0.05, or a '+'-joined stack like "
                         "endurance_stuck_at+conductance_drift; needs "
                         "an active failure_pattern in the solver")
+    p.add_argument("--tiles", default="",
+                   help="train: tiled crossbar mapping spec "
+                        "(fault/mapping.py TileSpec) — '1x1' "
+                        "(default, untiled), 'GRxGC' (a per-layer "
+                        "tile grid, e.g. 2x4), or 'cells=RxC' "
+                        "(physical array size, e.g. cells=256x256; "
+                        "per-layer grids auto-derived). Each tile "
+                        "gets an independent fault draw and per-tile "
+                        "ADC partial sums; overrides the solver's "
+                        "rram_forward.tiles field; needs an active "
+                        "failure_pattern")
     p.add_argument("--cache-dir", default="",
                    help="cold-start cache root (overrides the "
                         "RRAM_TPU_CACHE_DIR env var): <dir>/xla holds "

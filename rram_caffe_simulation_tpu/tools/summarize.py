@@ -293,6 +293,35 @@ def summarize_metrics(path):
                     f"{c}={_fmt_num(_scalarize(entry[c]))}"
                     for c in sorted(entry))
                 lines.append(f"  process {pname:20s} {cols}")
+        pt = fault.get("per_tile")
+        if isinstance(pt, dict):
+            # tile-resolved census (fault/mapping.py): one line per
+            # tiled fault target — the tile grid, the worst tile's
+            # broken fraction + index, the minimum remaining lifetime,
+            # and the broken-cell stuck histogram totals. Sweep
+            # records carry per-config vectors: the digest reduces
+            # over configs AND tiles (worst case / totals).
+            for key in sorted(pt):
+                e = pt[key]
+                if not isinstance(e, dict):
+                    continue
+                grid = np.asarray(e.get("grid", [])).reshape(-1)
+                gtxt = (f"{int(grid[0])}x{int(grid[1])}"
+                        if grid.size >= 2 else "?")
+                bf = np.asarray(e.get("broken_frac", 0.0), np.float64)
+                lm = np.asarray(e.get("life_min", 0.0), np.float64)
+                # tiles are the LAST axis (a sweep prepends configs):
+                # report the worst tile's index in tile-major order
+                n_tiles = bf.shape[-1] if bf.ndim else 1
+                tile_idx = int(np.argmax(bf.reshape(-1))) % n_tiles
+                hist = "/".join(
+                    str(int(np.sum(np.asarray(e.get(c, 0)))))
+                    for c in ("stuck_neg", "stuck_zero", "stuck_pos"))
+                lines.append(
+                    f"  tiles   {key:20s} grid={gtxt} "
+                    f"broken_frac_max={_fmt_num(float(bf.max()))}"
+                    f"@t{tile_idx} life_min={_fmt_num(float(lm.min()))}"
+                    f" stuck(-1/0/+1)={hist}")
     return "\n".join(lines)
 
 

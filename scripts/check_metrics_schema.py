@@ -59,6 +59,39 @@ SAMPLE_BAD = {"schema_version": 1, "iter": -3, "loss": "NaN-ish",
                                         "read_disturb": {
                                             "broken": "lots"}}}}
 
+# tile-resolved fault census (fault/mapping.py per-tile mapping): the
+# per-tile vectors ride fault.per_tile keyed by fault target; a sweep
+# record nests them per config (lists of lists)
+SAMPLE_GOOD_PER_TILE = {
+    "schema_version": 1, "iter": 80, "wall_time": 1722700000.0,
+    "loss": 0.6, "lr": 0.01, "step_latency_s": 0.01,
+    "iters_per_s": 90.0,
+    "fault": {"broken_total": 31, "newly_expired": 2,
+              "life_min": -12.0, "life_mean": 4.1e7, "writes_saved": 0,
+              "per_tile": {"fc1/0": {
+                  "grid": [2, 2],
+                  "broken_frac": [0.1, 0.0, 0.2, 0.05],
+                  "life_min": [-12.0, 55.0, -3.0, 90.0],
+                  "stuck_neg": [3, 0, 5, 1],
+                  "stuck_zero": [9, 0, 11, 4],
+                  "stuck_pos": [2, 0, 4, 1]}}},
+}
+
+SAMPLE_BAD_PER_TILE = {
+    "schema_version": 1, "iter": 80, "wall_time": 1722700000.0,
+    "loss": 0.6, "lr": 0.01, "step_latency_s": 0.01,
+    "iters_per_s": 90.0,
+    "fault": {"broken_total": 31, "newly_expired": 2,
+              "life_min": -12.0, "life_mean": 4.1e7, "writes_saved": 0,
+              # missing grid/life_min; broken_frac not a list; and one
+              # entry is not an object at all
+              "per_tile": {"fc1/0": {"broken_frac": 0.1,
+                                     "stuck_neg": [3],
+                                     "stuck_zero": [9],
+                                     "stuck_pos": [2]},
+                           "fc2/0": "everywhere"}},
+}
+
 # a sweep record with quarantined configs (per-config loss vector +
 # the quarantine id list the NaN/Inf quarantine surfaced)
 SAMPLE_GOOD_QUARANTINE = {
@@ -249,6 +282,7 @@ def main(argv=None) -> int:
     if args.sample:
         n_bad = 0
         for name, rec in (("metrics", SAMPLE_GOOD),
+                          ("per_tile", SAMPLE_GOOD_PER_TILE),
                           ("quarantine", SAMPLE_GOOD_QUARANTINE),
                           ("lane_map", SAMPLE_GOOD_LANE_MAP),
                           ("retry", SAMPLE_GOOD_RETRY),
@@ -264,6 +298,7 @@ def main(argv=None) -> int:
                     print(f"  {e}")
                 return 1
         for name, rec in (("metrics", SAMPLE_BAD),
+                          ("per_tile", SAMPLE_BAD_PER_TILE),
                           ("quarantine", SAMPLE_BAD_QUARANTINE),
                           ("lane_map", SAMPLE_BAD_LANE_MAP),
                           ("retry", SAMPLE_BAD_RETRY),
@@ -278,7 +313,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (9 good records accepted, 9 bad "
+        print("sample self-check OK (10 good records accepted, 10 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

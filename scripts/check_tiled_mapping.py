@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""CI guard for the tiled crossbar mapping (fault/mapping.py): the
+default mapping must be a NO-OP and the tiled program must agree across
+engines.
+
+Four checks, all in one process on a deterministic operating point
+(sigma = 0, the ternary ADC grid engaging the fused kernel, per-tile
+adc_bits = 4):
+
+1. **1x1 identity (jax engine)**: a sweep built with
+   ``tile_spec="1x1"`` is BYTE-identical to the untiled build — per-
+   chunk per-config losses, config-stacked params/history, and the
+   fault-state leaves all compare by bytes (the tiled draw must take
+   the unfolded legacy key path and the tiled read must never engage).
+2. **1x1 identity (pallas + packed banks + a self-healing refill)**:
+   the same byte comparison on the attack configuration
+   (engine="pallas", packed_state=True) with a NaN-poisoned lane, so
+   the identity covers the packed refill draw (`draw_rescaled_state`
+   through the stack's tile spec) and the reclaimed lane's re-seed.
+3. **Tiled engine parity**: a multi-tile sweep (``tile_spec="2x2"``)
+   on the pallas engine produces per-lane losses BIT-exact to the
+   pure-JAX engine's — the kernel's (j, k) block grid with per-tile
+   fault slices + in-kernel per-tile ADC against
+   `tiled_crossbar_matmul`'s partial-sum structure.
+4. **Mismatched-tile-spec restore refused**: a checkpoint written
+   under "2x2" must refuse to restore into a "1x1" runner (and vice
+   versa) with an error naming both specs — the v6 checkpoint pin.
+
+    python scripts/check_tiled_mapping.py
+
+Exit status: 0 = all hold, 1 = any violation.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ITERS = 12
+CHUNK = 3
+N_CONFIGS = 3
+MEAN, STD = 250.0, 30.0   # cells break inside the 12-iter window
+
+
+def _solver(prefix: str, tiles=None):
+    import numpy as np
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    net = """
+    name: "TiledNet"
+    layer { name: "data" type: "Input" top: "data" top: "target"
+      input_param { shape { dim: 8 dim: 6 } shape { dim: 8 dim: 2 } } }
+    layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+      inner_product_param { num_output: 5
+        weight_filler { type: "gaussian" std: 0.5 }
+        bias_filler { type: "constant" value: 0.1 } } }
+    layer { name: "relu1" type: "ReLU" bottom: "fc1" top: "fc1" }
+    layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+      inner_product_param { num_output: 2
+        weight_filler { type: "gaussian" std: 0.5 }
+        bias_filler { type: "constant" value: 0.0 } } }
+    layer { name: "loss" type: "EuclideanLoss" bottom: "fc2"
+      bottom: "target" top: "loss" }
+    """
+    sp = pb.SolverParameter()
+    text_format.Parse(net, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10 ** 6
+    sp.display = 0
+    sp.random_seed = 7
+    sp.snapshot_prefix = prefix
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = MEAN
+    sp.failure_pattern.std = STD
+    # sigma 0 + per-tile ADC: deterministic, and the ternary grid
+    # below engages the fused kernel on the pallas engine
+    sp.rram_forward.sigma = 0.0
+    sp.rram_forward.adc_bits = 4
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data,
+                                          "target": target},
+                  tile_spec=tiles)
+
+
+def _runner(workdir: str, tag: str, tiles=None, **kw):
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    return SweepRunner(_solver(os.path.join(workdir, tag), tiles),
+                       n_configs=N_CONFIGS, dtype_policy="ternary",
+                       pipeline_depth=0, **kw)
+
+
+def _run_chunks(runner, iters=ITERS):
+    import numpy as np
+    losses = []
+    for _ in range(iters // CHUNK):
+        loss, _ = runner.step(CHUNK, chunk=CHUNK)
+        losses.append(np.asarray(loss))
+    return np.stack(losses)
+
+
+def _state_bytes(runner):
+    """Flat name -> bytes of every resumable leaf (params, history,
+    fault state incl. packed banks)."""
+    import numpy as np
+    return {name: np.asarray(v).tobytes()
+            for name, v in runner._state_arrays().items()}
+
+
+def _compare_states(failures, tag, a, b, prefix=""):
+    """`prefix` narrows the comparison (e.g. "fault/"): the cross-
+    ENGINE checks compare losses bit-exact and fault transitions byte-
+    exact, but not momentum banks — the two engines' backward dots
+    have different block shapes, so gradients agree only to rounding
+    (the same contract check_kernel_parity.py pins for the untiled
+    kernel). The same-engine 1x1 identity checks compare EVERYTHING."""
+    sa, sb = _state_bytes(a), _state_bytes(b)
+    if set(sa) != set(sb):
+        failures.append(f"{tag}: leaf name sets differ "
+                        f"({sorted(set(sa) ^ set(sb))})")
+        return
+    bad = [k for k in sa if k.startswith(prefix) and sa[k] != sb[k]]
+    if bad:
+        failures.append(f"{tag}: leaves not byte-identical: {bad}")
+
+
+def _poison(runner, lane):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    orig = runner.params["fc2"][0]
+    w = np.array(orig)
+    w[lane].flat[0] = np.nan
+    runner.params["fc2"][0] = jax.device_put(jnp.asarray(w),
+                                             orig.sharding)
+
+
+def _heal_to_completion(runner, failures, tag):
+    runner.enable_self_healing(budget=ITERS, max_retries=2)
+    runner.step(CHUNK, chunk=CHUNK)
+    _poison(runner, lane=1)
+    guard = 0
+    while not runner.healing_complete():
+        runner.step(CHUNK, chunk=CHUNK)
+        guard += 1
+        if guard > 40:
+            failures.append(f"{tag}: self-healing never completed")
+            break
+    return runner.config_report()
+
+
+def main() -> int:
+    import numpy as np
+
+    failures = []
+    work = tempfile.mkdtemp(prefix="tiled_mapping_")
+
+    # 1. 1x1 identity on the jax engine
+    ref = _runner(work, "ref")
+    t11 = _runner(work, "t11", tiles="1x1")
+    l_ref = _run_chunks(ref)
+    l_t11 = _run_chunks(t11)
+    if l_ref.tobytes() != l_t11.tobytes():
+        failures.append("1x1 (jax) losses not byte-identical to "
+                        f"untiled:\n{l_ref}\nvs\n{l_t11}")
+    _compare_states(failures, "1x1 (jax) state", ref, t11)
+    if not failures:
+        print("1x1 identity OK on the jax engine (losses + every "
+              "state leaf byte-identical)")
+    ref.close()
+    t11.close()
+
+    # 2. 1x1 identity on pallas + packed banks, THROUGH a self-healing
+    #    refill (the reclaimed lane's fresh draw must also take the
+    #    unfolded key path)
+    hr = _runner(work, "heal_ref", engine="pallas", packed_state=True)
+    ht = _runner(work, "heal_t11", tiles="1x1", engine="pallas",
+                 packed_state=True)
+    rep_r = _heal_to_completion(hr, failures, "untiled packed+pallas")
+    rep_t = _heal_to_completion(ht, failures, "1x1 packed+pallas")
+    if rep_r != rep_t:
+        failures.append(
+            "1x1 (packed+pallas, self-healing) config report diverged "
+            f"from untiled:\n{rep_r}\nvs\n{rep_t}")
+    _compare_states(failures, "1x1 (packed+pallas, healed) state",
+                    hr, ht)
+    if not failures:
+        att = rep_t.get("completed", {}).get(1, {}).get("attempts", 0)
+        if att < 2:
+            failures.append("poisoned config completed without a "
+                            "retry — the refill path was not exercised")
+        else:
+            print("1x1 identity OK on packed+pallas incl. a "
+                  f"self-healing refill (poisoned config retried "
+                  f"{att} attempts, reports + state byte-identical)")
+    hr.close()
+    ht.close()
+
+    # 3. tiled (2x2) pallas == tiled pure-JAX, bit-exact per lane
+    tj = _runner(work, "tiled_jax", tiles="2x2")
+    tp = _runner(work, "tiled_pallas", tiles="2x2", engine="pallas")
+    l_tj = _run_chunks(tj)
+    l_tp = _run_chunks(tp)
+    if tp.engine_resolved != "pallas":
+        failures.append("tiled pallas runner resolved to "
+                        f"{tp.engine_resolved!r} — the kernel parity "
+                        "check tested nothing")
+    if l_tj.tobytes() != l_tp.tobytes():
+        failures.append("tiled pallas losses not bit-exact to tiled "
+                        f"pure-JAX:\n{l_tj}\nvs\n{l_tp}")
+    _compare_states(failures, "tiled engine-parity state", tj, tp,
+                    prefix="fault/")
+    if not failures:
+        print("tiled 2x2 engine parity OK (pallas == pure-JAX: "
+              "per-lane losses bit-exact, fault transitions "
+              "byte-identical)")
+
+    # broken cells must actually appear in-window or the census and
+    # the per-tile fault slices tested nothing
+    if float(tj.broken_fractions().max()) <= 0:
+        failures.append("no cell broke inside the window — lower MEAN")
+
+    # 4. mismatched-tile-spec restore refused, naming both specs
+    ck = os.path.join(work, "tiled.ckpt.npz")
+    tj.checkpoint(ck)
+    other = _runner(work, "untiled_restore")
+    try:
+        other.restore(ck)
+        failures.append("restore of a 2x2 checkpoint into a 1x1 "
+                        "runner was NOT refused")
+    except ValueError as e:
+        msg = str(e)
+        if "2x2" not in msg or "1x1" not in msg:
+            failures.append("tile-spec refusal does not name both "
+                            f"specs: {msg!r}")
+        else:
+            print("mismatched-tile-spec restore refused loudly "
+                  "(names both specs)")
+    other.close()
+    tj.close()
+    tp.close()
+
+    if failures:
+        print("\nTILED MAPPING GUARD FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("tiled mapping guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

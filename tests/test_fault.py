@@ -206,7 +206,7 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc2" bottom: "target"
 
 
 def fault_solver(tmp_path, mean=150.0, std=10.0, fail_decrement=None,
-                 **kw):
+                 tile_spec=None, adc_bits=0, **kw):
     sp = pb.SolverParameter()
     text_format.Parse(FAULT_NET, sp.net_param)
     sp.base_lr = 0.05
@@ -219,13 +219,16 @@ def fault_solver(tmp_path, mean=150.0, std=10.0, fail_decrement=None,
     sp.failure_pattern.type = "gaussian"
     sp.failure_pattern.mean = mean
     sp.failure_pattern.std = std
+    if adc_bits:
+        sp.rram_forward.sigma = 0.0
+        sp.rram_forward.adc_bits = adc_bits
     for k, v in kw.items():
         setattr(sp, k, v)
     rng = np.random.RandomState(3)
     data = rng.randn(8, 6).astype(np.float32)
     target = rng.randn(8, 2).astype(np.float32)
     return Solver(sp, train_feed=lambda: {"data": data, "target": target},
-                  fail_decrement=fail_decrement)
+                  fail_decrement=fail_decrement, tile_spec=tile_spec)
 
 
 def test_fail_decrement_default_bit_identical(tmp_path):
@@ -422,3 +425,311 @@ def test_conv_also_under_sweep(tmp_path):
     assert np.isfinite(np.asarray(loss)).all()
     frac = runner.broken_fractions()
     assert frac[0] > 0.9 and frac[1] < 0.1
+
+
+# ---------------------------------------------------------------------------
+# Tiled crossbar mapping (fault/mapping.py, ISSUE 11)
+
+def test_tilespec_parse_and_canonical():
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    assert TileSpec.parse(None).is_default
+    assert TileSpec.parse("").canonical() == "1x1"
+    assert TileSpec.parse("1x1").is_default
+    assert TileSpec.parse("2x4").canonical() == "2x4"
+    assert not TileSpec.parse("2x4").is_default
+    assert TileSpec.parse("CELLS=256x256").canonical() == "cells=256x256"
+    ts = TileSpec.parse("2x4")
+    assert TileSpec.parse(ts) is ts          # pass-through
+    assert TileSpec.parse("2x4") == TileSpec.parse("2x4")
+    assert TileSpec.parse("2x4") != TileSpec.parse("cells=2x4")
+    for bad in ("2x", "x2", "0x1", "tiles=2x2", "2x2x2", "cells=0x4"):
+        with pytest.raises(ValueError):
+            TileSpec.parse(bad)
+
+
+def test_tilespec_geometry():
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    g = TileSpec.parse("2x2")
+    assert g.tile_dims((10, 6)) == (5, 3)
+    assert g.grid((10, 6)) == (2, 2)
+    assert g.bounds((10, 6)) == ([(0, 5), (5, 10)], [(0, 3), (3, 6)])
+    # a grid larger than the matrix clamps: every tile non-empty
+    big = TileSpec.parse("64x64")
+    assert big.grid((3, 2)) == (3, 2)
+    assert big.tile_dims((3, 2)) == (1, 1)
+    # cells form derives the per-layer grid (CIM-Explorer array axis)
+    c = TileSpec.parse("cells=4x4")
+    assert c.tile_dims((10, 6)) == (4, 4)
+    assert c.grid((10, 6)) == (3, 2)
+    # non-2-D shapes are a single tile by definition
+    assert c.grid((7,)) == (1, 1)
+    assert c.n_tiles((2, 3, 4, 4)) == 1
+    # tile-major enumeration is the draw-fold / census order
+    idx = [t for t, _ in g.tile_slices((10, 6))]
+    assert idx == [0, 1, 2, 3]
+
+
+def test_tiled_draw_identity_and_independence():
+    """The 1x1 contract: tiles=None, the default spec, and any
+    single-tile layer draw the BYTE-identical state; multi-tile grids
+    draw independently per tile, deterministically."""
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    key = jax.random.PRNGKey(0)
+    shapes = {"fc1/0": (10, 6), "fc1/1": (6,), "fc2/0": (6, 4)}
+    pat = make_pattern(mean=400.0, std=100.0)
+    base = init_fault_state(key, shapes, pat)
+    t11 = init_fault_state(key, shapes, pat,
+                           tiles=TileSpec.parse("1x1"))
+    for g in base:
+        for k in base[g]:
+            assert (np.asarray(base[g][k]).tobytes()
+                    == np.asarray(t11[g][k]).tobytes())
+    ts = TileSpec.parse("2x2")
+    t22 = init_fault_state(key, shapes, pat, tiles=ts)
+    t22b = init_fault_state(key, shapes, pat, tiles=ts)
+    for g in t22:
+        for k in t22[g]:
+            assert t22[g][k].shape == base[g][k].shape
+            assert (np.asarray(t22[g][k]).tobytes()
+                    == np.asarray(t22b[g][k]).tobytes())
+    # 2-D params draw differently (per-tile folded keys); the 1-D bias
+    # is a single tile and stays byte-identical
+    assert (np.asarray(t22["lifetimes"]["fc1/0"]).tobytes()
+            != np.asarray(base["lifetimes"]["fc1/0"]).tobytes())
+    assert (np.asarray(t22["lifetimes"]["fc1/1"]).tobytes()
+            == np.asarray(base["lifetimes"]["fc1/1"]).tobytes())
+    # tiles are independent draws: no two tiles of the lifetimes field
+    # share their block bytes
+    life = np.asarray(t22["lifetimes"]["fc1/0"])
+    blocks = [life[r0:r1, c0:c1].tobytes()
+              for _, (r0, r1, c0, c1) in ts.tile_slices((10, 6))]
+    assert len(set(blocks)) == len(blocks)
+
+
+def test_tiled_crossbar_matmul_semantics():
+    """y[:, jt] = sum_kt quantize_ste(x[:, kt] @ w[kt, jt]) — per-tile
+    ADC of analog partial sums, digital accumulation across K tiles."""
+    from rram_caffe_simulation_tpu.fault.hw_aware import (
+        quantize_ste, tiled_crossbar_matmul)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 10).astype(np.float32))
+    w = jnp.asarray(rng.randn(10, 6).astype(np.float32))
+    got = np.asarray(tiled_crossbar_matmul(x, w, 5, 3, 4))
+    want = np.zeros((8, 6), np.float32)
+    for n0 in range(0, 6, 3):
+        acc = np.zeros((8, 3), np.float32)
+        for k0 in range(0, 10, 5):
+            part = np.asarray(x)[:, k0:k0 + 5] @ np.asarray(w)[
+                k0:k0 + 5, n0:n0 + 3]
+            acc = acc + np.asarray(quantize_ste(jnp.asarray(part), 4))
+        want[:, n0:n0 + 3] = acc
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+    # adc_bits=0: the pure tiled sum equals the plain matmul
+    got0 = np.asarray(tiled_crossbar_matmul(x, w, 5, 3, 0))
+    np.testing.assert_allclose(got0, np.asarray(x) @ np.asarray(w),
+                               rtol=0, atol=1e-5)
+
+
+def test_per_tile_counters_exact():
+    from rram_caffe_simulation_tpu.fault.mapping import (
+        TileSpec, per_tile_counters)
+    ts = TileSpec.parse("2x2")
+    rng = np.random.RandomState(2)
+    life = jnp.asarray(rng.randn(10, 6).astype(np.float32)) * 100
+    stuck = jnp.asarray(rng.choice([-1.0, 0.0, 1.0],
+                                   (10, 6)).astype(np.float32))
+    pc = {k: np.asarray(v)
+          for k, v in per_tile_counters(life, stuck, ts).items()}
+    assert list(pc["grid"]) == [2, 2]
+    ln, sn = np.asarray(life), np.asarray(stuck)
+    for t, (r0, r1, c0, c1) in ts.tile_slices((10, 6)):
+        lt, st = ln[r0:r1, c0:c1], sn[r0:r1, c0:c1]
+        broken = lt <= 0
+        assert pc["broken_frac"][t] == pytest.approx(broken.mean())
+        assert pc["life_min"][t] == lt.min()
+        assert pc["stuck_neg"][t] == int((broken & (st == -1)).sum())
+        assert pc["stuck_zero"][t] == int((broken & (st == 0)).sum())
+        assert pc["stuck_pos"][t] == int((broken & (st == 1)).sum())
+
+
+def test_solver_1x1_tiling_byte_identical(tmp_path):
+    """The acceptance contract: TileSpec('1x1') (and no spec at all)
+    trains the byte-identical program."""
+    a = fault_solver(tmp_path / "a", adc_bits=4)
+    b = fault_solver(tmp_path / "b", adc_bits=4, tile_spec="1x1")
+    a.step(6)
+    b.step(6)
+    assert (a._materialize_smoothed_loss()
+            == b._materialize_smoothed_loss())
+    fa, fb = a._flat(a.params), b._flat(b.params)
+    for k in fa:
+        assert np.asarray(fa[k]).tobytes() == np.asarray(fb[k]).tobytes()
+    for g in a.fault_state:
+        for k in a.fault_state[g]:
+            assert (np.asarray(a.fault_state[g][k]).tobytes()
+                    == np.asarray(b.fault_state[g][k]).tobytes())
+
+
+def test_solver_tiles_require_fault_engine(tmp_path):
+    sp = pb.SolverParameter()
+    text_format.Parse(FAULT_NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 100
+    sp.random_seed = 7
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "none"
+    with pytest.raises(ValueError, match="no fault engine"):
+        Solver(sp, tile_spec="2x2")
+
+
+def test_solver_tiles_from_proto_field(tmp_path):
+    """rram_forward.tiles configures the mapping; the constructor
+    parameter wins when both are given."""
+    s = fault_solver(tmp_path, adc_bits=4, tile_spec=None)
+    assert s.tile_spec.is_default
+    sp = pb.SolverParameter()
+    sp.CopyFrom(s.param)
+    sp.rram_forward.tiles = "2x2"
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    feed = lambda: {"data": data, "target": target}
+    s2 = Solver(sp, train_feed=feed)
+    assert s2.tile_spec.canonical() == "2x2"
+    s3 = Solver(sp, train_feed=feed, tile_spec="cells=4x4")
+    assert s3.tile_spec.canonical() == "cells=4x4"
+
+
+def test_per_tile_census_record_and_summarize(tmp_path, capsys):
+    """A tiled run's metrics records carry the schema-valid
+    fault.per_tile block and summarize renders the per-tile digest."""
+    import json
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    from rram_caffe_simulation_tpu.observe import schema as obs_schema
+    from rram_caffe_simulation_tpu.tools import summarize
+
+    s = fault_solver(tmp_path, adc_bits=4, tile_spec="2x2", display=2)
+    path = tmp_path / "metrics.jsonl"
+    s.enable_metrics(JsonlSink(str(path), unbuffered=True))
+    s.step(6)
+    recs = [json.loads(l) for l in
+            path.read_text().strip().splitlines()]
+    recs = [r for r in recs if "fault" in r]
+    assert recs, "no fault-bearing metrics record written"
+    pt = recs[-1]["fault"].get("per_tile")
+    assert pt and "fc1/0" in pt and "fc2/0" in pt
+    assert pt["fc1/0"]["grid"] == [2, 2]
+    assert len(pt["fc1/0"]["broken_frac"]) == 4
+    for r in recs:
+        assert obs_schema.validate_record(r) == []
+    # the 1-D biases carry no tile census
+    assert "fc1/1" not in pt
+    # summarize digests a per-tile line
+    summarize.main([str(path)])
+    out = capsys.readouterr().out
+    assert "tiles" in out and "broken_frac_max" in out
+    assert "grid=2x2" in out
+
+
+def test_untiled_record_has_no_per_tile(tmp_path):
+    """Default runs must not grow a per_tile block (byte/shape
+    identity of the default metrics tree)."""
+    import json
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    s = fault_solver(tmp_path, adc_bits=4, display=2)
+    path = tmp_path / "metrics.jsonl"
+    s.enable_metrics(JsonlSink(str(path), unbuffered=True))
+    s.step(4)
+    recs = [json.loads(l) for l in
+            path.read_text().strip().splitlines()]
+    for r in recs:
+        assert "per_tile" not in r.get("fault", {})
+
+
+def test_spool_request_tiles_pin():
+    from rram_caffe_simulation_tpu.serve.spool import normalize_request
+    req = normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                             "tiles": " cells=256x256 "})
+    assert req["tiles"] == "cells=256x256"
+    assert "tiles" not in normalize_request(
+        {"configs": [{"mean": 1.0}], "iters": 10})
+    with pytest.raises(ValueError, match="tiles"):
+        normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                           "tiles": ""})
+    with pytest.raises(ValueError, match="tiles"):
+        normalize_request({"configs": [{"mean": 1.0}], "iters": 10,
+                           "tiles": 7})
+
+
+def test_codesign_tiles_axis_and_collapsed_verdict():
+    """The co-design mapping axis: equivalent spellings bucket into one
+    compiled sweep, and a degenerate front NAMES the collapsed axis."""
+    from rram_caffe_simulation_tpu.fault import codesign
+    assert "tiles" in codesign.STATIC_AXES
+    k1 = codesign.static_key({"tiles": "CELLS=256x256", "mean": 1.0})
+    k2 = codesign.static_key({"tiles": "cells=256x256", "mean": 2.0})
+    assert k1 == k2
+    assert codesign.static_key({"mean": 1.0})[-1] == "1x1"
+    # two tile specs, but only one survives on the front -> the verdict
+    # names "tiles" as the collapsed axis
+    recs = [
+        {"tiles": "1x1", "mean": 100.0, "loss": 1.0, "bits": 4},
+        {"tiles": "2x2", "mean": 100.0, "loss": 2.0, "bits": 4},
+    ]
+    rep = codesign.make_report(recs, "loss", "bits")
+    assert rep["degenerate"] is True
+    assert "tiles" in rep["collapsed_axes"]
+    assert "mean" not in rep["collapsed_axes"]   # never swept
+    assert rep["front_tiles"] == ["1x1"]
+    # a front keeping both specs is not collapsed on the tiles axis
+    recs2 = [
+        {"tiles": "1x1", "mean": 100.0, "loss": 1.0, "bits": 8},
+        {"tiles": "2x2", "mean": 100.0, "loss": 2.0, "bits": 4},
+    ]
+    rep2 = codesign.make_report(recs2, "loss", "bits")
+    assert rep2["degenerate"] is False
+    assert "tiles" not in rep2["collapsed_axes"]
+
+
+def test_tiled_test_phase_reads_through_tiles(tmp_path):
+    """Test-phase inference follows the tile mapping too: with
+    IDENTICAL params/fault state, a tiled solver's test scores differ
+    from an untiled one's (per-tile ADC partial sums vs one
+    whole-output ADC) — evaluating untiled would report accuracy for
+    a different hardware mapping than the one being swept."""
+    def with_test(tiles):
+        sp = pb.SolverParameter()
+        text_format.Parse(FAULT_NET, sp.net_param)
+        sp.base_lr = 0.05
+        sp.lr_policy = "fixed"
+        sp.max_iter = 100
+        sp.display = 0
+        sp.random_seed = 7
+        sp.snapshot_prefix = str(tmp_path / "snap")
+        sp.failure_pattern.type = "gaussian"
+        sp.failure_pattern.mean = 50.0    # broken from step 0
+        sp.failure_pattern.std = 10.0
+        sp.rram_forward.sigma = 0.0
+        sp.rram_forward.adc_bits = 3
+        sp.test_iter.append(1)
+        sp.test_interval = 10 ** 6
+        sp.test_compute_loss = True
+        rng = np.random.RandomState(3)
+        data = rng.randn(8, 6).astype(np.float32)
+        target = rng.randn(8, 2).astype(np.float32)
+        feed = lambda: {"data": data, "target": target}
+        return Solver(sp, train_feed=feed, test_feeds=[feed],
+                      tile_spec=tiles)
+
+    a = with_test(None)
+    b = with_test("3x2")
+    # identical weights + fault state: isolate the READ path
+    b.params = jax.tree.map(lambda x: x, a.params)
+    b.fault_state = {g: dict(v) for g, v in a.fault_state.items()}
+    # one fault step so broken cells clamp into the stored weights
+    a.step(1)
+    b.params, b.fault_state = a.params, a.fault_state
+    sa, sb = a.test(0), b.test(0)
+    assert all(np.isfinite(v) for v in sb.values())
+    assert sa != sb

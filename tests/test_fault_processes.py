@@ -363,7 +363,7 @@ def test_checkpoint_v5_meta_and_roundtrip(tmp_path):
     with np.load(ck) as z:
         meta = json.loads(bytes(bytearray(z["__meta__"])).decode())
         names = set(z.files)
-    assert meta["version"] == 5
+    assert meta["version"] == 6
     assert meta["fault_process"] == \
         "conductance_drift:nu=0.3+endurance_stuck_at"
     assert {"fault/drift_age/ip/0", "fault/drift_rate/ip/0",

@@ -318,7 +318,7 @@ def test_v1_checkpoint_upgrades_with_identity_lane_map(tmp_path):
     with np.load(ckpt) as z:
         data = {k: z[k] for k in z.files}
     meta = _json.loads(bytes(bytearray(data["__meta__"])).decode())
-    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 5
+    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 6
     meta = {k: v for k, v in meta.items()
             if k not in ("lane_map", "lane_done", "healing",
                          "fault_format", "pack_spec", "fault_process")}

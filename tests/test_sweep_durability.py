@@ -664,3 +664,67 @@ def test_bytes_per_step_est_divides_by_config_shards(tmp_path):
     assert validate_record(rec) == []
     r4.close()
     r1.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint v6: the tiled-crossbar-mapping pin (fault/mapping.py)
+
+def _tiled_runner(tmp_path, tiles, n=3):
+    s = fault_solver(tmp_path, mean=250.0, std=30.0, adc_bits=4,
+                     tile_spec=tiles)
+    return SweepRunner(s, n_configs=n, pipeline_depth=0)
+
+
+def test_checkpoint_v6_tile_pin_roundtrip(tmp_path):
+    """A tiled sweep's checkpoint restores bit-exact into a runner
+    with the SAME tile spec, and refuses a different one naming both
+    specs (the v6 pin)."""
+    r = _tiled_runner(tmp_path / "a", "2x2")
+    r.step(4, chunk=2)
+    ckpt = r.checkpoint(str(tmp_path / "tiled.ckpt.npz"))
+    r2 = _tiled_runner(tmp_path / "b", "2x2")
+    r2.restore(ckpt)
+    assert r2.iter == 4
+    _bit_equal(r.fault_states, r2.fault_states)
+    # an untiled runner must refuse the tiled checkpoint...
+    r3 = _tiled_runner(tmp_path / "c", None)
+    with pytest.raises(ValueError, match="2x2.*1x1"):
+        r3.restore(ckpt)
+    # ...and a tiled runner must refuse an untiled checkpoint
+    r3.step(4, chunk=2)
+    ckpt_flat = r3.checkpoint(str(tmp_path / "flat.ckpt.npz"))
+    r4 = _tiled_runner(tmp_path / "d", "2x2")
+    with pytest.raises(ValueError, match="1x1.*2x2"):
+        r4.restore(ckpt_flat)
+    for rr in (r, r2, r3, r4):
+        rr.close()
+
+
+def test_checkpoint_v5_upgrades_as_untiled(tmp_path):
+    """A pre-v6 checkpoint (no tile_spec in its meta) is implicitly
+    the untiled 1x1 mapping: it restores into an untiled runner and
+    refuses a tiled one."""
+    import json
+    r = _tiled_runner(tmp_path / "a", None)
+    r.step(4, chunk=2)
+    path = str(tmp_path / "v5.ckpt.npz")
+    r.checkpoint(path)
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(bytearray(data["__meta__"])).decode())
+    assert meta["version"] == 6 and meta["tile_spec"] == "1x1"
+    meta["version"] = 5
+    del meta["tile_spec"]
+    data["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                     np.uint8)
+    np.savez(path, **data)
+
+    r2 = _tiled_runner(tmp_path / "b", None)
+    r2.restore(path)
+    assert r2.iter == 4
+    _bit_equal(r.fault_states, r2.fault_states)
+    r3 = _tiled_runner(tmp_path / "c", "2x2")
+    with pytest.raises(ValueError, match="1x1.*2x2"):
+        r3.restore(path)
+    for rr in (r, r2, r3):
+        rr.close()

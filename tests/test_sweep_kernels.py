@@ -339,7 +339,7 @@ def _downgrade_to_v2(path):
     with np.load(path) as z:
         data = {k: z[k] for k in z.files}
     meta = _json.loads(bytes(bytearray(data["__meta__"])).decode())
-    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 5
+    assert meta["version"] == sweep_mod.CHECKPOINT_VERSION == 6
     assert meta["fault_format"] == "f32"
     del meta["fault_format"], meta["pack_spec"], meta["fault_process"]
     meta["version"] = 2
