@@ -231,8 +231,18 @@ def main(argv=None):
             # the bytes-per-step attack surface (ISSUE 7): which
             # crossbar engine / fault-state banks / ADC-grid policy ran,
             # the resident-state bytes one iteration moves, and the
-            # bandwidth the timed window sustained against that floor
+            # bandwidth the timed window sustained against that floor.
+            # `engine` is ALWAYS the resolved engine from the runner —
+            # a mesh row can never claim a kernel that actually ran
+            # pure JAX; when the request fell back, the schema-
+            # validated reason rides along (ISSUE 13)
             "engine": runner.engine_resolved,
+            **({"engine_fallback_reason": runner.engine_fallback_reason}
+               if runner.engine_fallback_reason else {}),
+            # the fused ApplyUpdate+Fail kernel tail (fault/fused.py):
+            # True when the packed banks were read-modified-written in
+            # VMEM instead of streamed as separate HBM ops
+            "fused_epilogue": runner.fused_epilogue_resolved,
             "fault_state_format": setup_rec.get("fault_state_format",
                                                 "f32"),
             "dtype_policy": DTYPE_POLICY or "off",

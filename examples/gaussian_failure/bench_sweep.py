@@ -39,6 +39,20 @@ def main(argv=None):
                    help="async dispatch pipeline depth (SweepRunner "
                         "pipeline_depth); 0 = synchronous per-chunk "
                         "bookkeeping")
+    p.add_argument("--engine", default="jax",
+                   choices=("jax", "pallas", "auto"),
+                   help="crossbar engine request (ENGINE MATRIX); the "
+                        "row records engine_resolved — what actually "
+                        "ran after any loud fallback")
+    p.add_argument("--dtype-policy", default="",
+                   help="'' | ternary | int8 quantized sweep compute "
+                        "(what arms the pallas kernel at sigma == 0)")
+    p.add_argument("--packed", action="store_true",
+                   help="bit-packed fault banks (fault/packed.py)")
+    p.add_argument("--mesh", default="",
+                   help="mesh spec, e.g. 'config=4': shard the config "
+                        "axis; the pallas engine runs shard_map'd "
+                        "under it (ISSUE 13)")
     args = p.parse_args(argv)
     # a trailing partial chunk would jit-compile inside the timed window
     args.iters = max(args.iters // args.chunk, 1) * args.chunk
@@ -60,13 +74,19 @@ def main(argv=None):
         param.random_seed = 7
         param.display = 0
         solver = Solver(param)
+        mesh = None
+        if args.mesh:
+            from rram_caffe_simulation_tpu.parallel import mesh_from_spec
+            mesh = mesh_from_spec(args.mesh)
         runner = SweepRunner(
             solver, n_configs=n_cfg,
             # same default as bench.py so the two benches measure the
             # same arithmetic under an identical environment
             compute_dtype=os.environ.get("BENCH_DTYPE", "bfloat16")
             or None,
-            pipeline_depth=args.pipeline_depth)
+            pipeline_depth=args.pipeline_depth,
+            engine=args.engine, dtype_policy=args.dtype_policy or None,
+            packed_state=args.packed, mesh=mesh)
         runner.step(max(args.warmup, args.chunk), chunk=args.chunk)
         jax.block_until_ready(runner.params)
         t0 = time.perf_counter()
@@ -77,13 +97,28 @@ def main(argv=None):
         cfg_hours = n_cfg * steps_per_s * 3600 / args.contract_iters
         img_s = n_cfg * steps_per_s * 100
         pipe = runner.setup_record().get("pipeline", {})
+        n_chips = len(np.asarray(runner.mesh.devices).ravel())
         runner.close()
         results.append({
             "n_configs": n_cfg, "steps_per_s": round(steps_per_s, 2),
-            "img_per_s_per_chip": round(img_s),
-            "configs_per_hour_per_chip": round(cfg_hours, 1),
+            "img_per_s_per_chip": round(img_s / n_chips),
+            # what actually RAN (the runner resolves engine fallbacks
+            # loudly, ISSUE 13) — a mesh row can never claim a kernel
+            # that fell back to pure JAX
+            "engine_requested": args.engine,
+            "engine_resolved": runner.engine_resolved,
+            **({"engine_fallback_reason": runner.engine_fallback_reason}
+               if runner.engine_fallback_reason else {}),
+            "fused_epilogue": runner.fused_epilogue_resolved,
+            "chips": n_chips,
+            # cfg_hours is the WHOLE runner's rate; per-chip figures
+            # divide by the mesh size so a --mesh row cannot inflate
+            # the single-chip contract (the 8-chip projection below
+            # multiplies the per-chip rate back up)
+            "configs_per_hour_aggregate": round(cfg_hours, 1),
+            "configs_per_hour_per_chip": round(cfg_hours / n_chips, 1),
             "minutes_for_1000_configs_1chip":
-                round(1000 / cfg_hours * 60, 1),
+                round(1000 / (cfg_hours / n_chips) * 60, 1),
             "loss_finite": bool(np.isfinite(loss).all()),
             # dispatcher host-blocked seconds across all dispatched
             # chunks (observe `setup` record pipeline shape)

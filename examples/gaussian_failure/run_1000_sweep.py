@@ -203,6 +203,20 @@ def main(argv=None):
                         "manifest: --resume refuses a mismatched "
                         "process instead of replaying the wrong "
                         "physics")
+    p.add_argument("--engine", default="jax",
+                   choices=("jax", "pallas", "auto"),
+                   help="hardware-aware crossbar engine (ENGINE "
+                        "MATRIX, fault/hw_aware.py); 'pallas' runs "
+                        "config-sharded under the mesh via shard_map "
+                        "and falls back LOUDLY where it cannot — the "
+                        "resolution lands in sweep_report.json")
+    p.add_argument("--dtype-policy", default="",
+                   help="quantized sweep compute ('' | ternary | "
+                        "int8): fault-target weight reads through the "
+                        "quantize_ste ADC grid — also what arms the "
+                        "pallas kernel at sigma == 0")
+    p.add_argument("--packed-state", action="store_true",
+                   help="bit-packed fault banks (fault/packed.py)")
     p.add_argument("--pipeline-depth", type=int, default=2,
                    help="in-flight chunks whose host bookkeeping the "
                         "consumer thread hides; 0 = synchronous "
@@ -426,7 +440,26 @@ def main(argv=None):
         runner = SweepRunner(solver, n_configs=n_cfg, config_block=block,
                              precompile_chunk=args.chunk,
                              pipeline_depth=args.pipeline_depth,
-                             stall_timeout_s=args.stall_timeout or None)
+                             stall_timeout_s=args.stall_timeout or None,
+                             engine=args.engine,
+                             dtype_policy=args.dtype_policy or None,
+                             packed_state=args.packed_state)
+        # engine attribution for sweep_report.json: what actually RAN
+        # (the runner resolves fallbacks loudly), never the request.
+        # Groups can resolve differently (config_block is computed per
+        # group size), so a disagreement reports "mixed" and a stale
+        # fallback reason is cleared when no group carries one — the
+        # report can never pin a kernel label on a jax run
+        engine_info["engine_requested"] = args.engine
+        prev = engine_info.get("engine_resolved")
+        engine_info["engine_resolved"] = (
+            runner.engine_resolved
+            if prev in (None, runner.engine_resolved) else "mixed")
+        if runner.engine_fallback_reason:
+            engine_info["engine_fallback_reason"] = \
+                runner.engine_fallback_reason
+        elif engine_info["engine_resolved"] == runner.engine_resolved:
+            engine_info.pop("engine_fallback_reason", None)
         # the completion contract: every config trains for --iters
         # iterations or fails with a diagnosis after its retry budget;
         # quarantined lanes are reclaimed and re-seeded at chunk
@@ -443,6 +476,9 @@ def main(argv=None):
     for n_cfg in groups[:-1]:
         offsets.append(offsets[-1] + n_cfg)
     ledger: dict = {}
+    #: engine attribution, filled by the first build_runner (identical
+    #: across groups: same solver flags, same mesh)
+    engine_info: dict = {}
 
     def _merge_report(gi, report):
         off = offsets[gi]
@@ -479,6 +515,7 @@ def main(argv=None):
             "completed": n_done, "failed": failed, "retried": retried,
             "max_retries": args.max_retries,
             "retry_backoff": args.retry_backoff,
+            **engine_info,
             "configs": {str(c): ledger[c] for c in sorted(ledger)},
         }
         if run_dir and primary:

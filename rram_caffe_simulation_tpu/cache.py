@@ -153,6 +153,9 @@ class SetupStats:
         # axis is laid over (1 = single chip; bytes_per_step is the
         # PER-CHIP resident share under the mesh)
         self.config_shards = None
+        # loud-fallback accounting (ISSUE 13): why an engine="pallas"
+        # request resolved to the jax engine (None = no fallback)
+        self.engine_fallback_reason = None
         # fault-physics accounting (ISSUE 10): the process stack +
         # explicit params this run trains under (FaultSpec.to_model —
         # {"spec": canonical, "processes": {...}})
@@ -191,7 +194,8 @@ class SetupStats:
             bytes_per_step_est=self.bytes_per_step,
             fault_state_format=self.fault_format,
             config_shards=self.config_shards,
-            fault_model=self.fault_model)
+            fault_model=self.fault_model,
+            engine_fallback_reason=self.engine_fallback_reason)
 
 
 class _Timed:

@@ -1,0 +1,200 @@
+"""Fused ApplyUpdate + Fail epilogue: the SGD weight update and the
+packed fault transition as ONE Pallas kernel per fault-target leaf.
+
+The unfused step streams each fault key through three separate HBM
+round trips at the tail of every iteration: ApplyUpdate reads
+(data, upd) and writes data', then `fail_packed` reads
+(data', upd, life_q, stuck_bits) and writes (data'', life_q') — the
+packed banks this format exists to shrink are still touched by two
+distinct ops. Here the whole tail is one launch: a (data, upd, life_q,
+stuck_bits) tile is read into VMEM once, the update subtract, the
+counter decrement, the broken comparison, and the in-register 2-bit
+stuck unpack all happen on the tile, and (data', life_q') are written
+back once — the banks are read-modified-written in VMEM (ROADMAP
+item 3 / ISSUE 13 tentpole (2)).
+
+Semantics are EXACTLY the unfused `data - upd` followed by
+`fault_packed.fail_packed`: every op is the same elementwise jnp
+arithmetic (the stuck unpack calls packed.unpack_stuck itself), so the
+fused path is bit-identical to the unfused one on every backend —
+`scripts/check_kernel_parity.py` pins losses AND raw bank bytes.
+
+`mode` is the fault-process decrement policy (fault/processes/):
+"write" (endurance — decrement on written steps only), "always" (read
+disturb — every step is a read), "never" (permanent fault maps).
+Which processes fuse is declared by `FaultProcess.fused_mode`
+(fault/processes/base.py); a stack the epilogue cannot express (decay
+processes mutate values BETWEEN the update and the clamp) falls back
+to the unfused path — `ProcessStack.supports_fused_epilogue`.
+
+vmap over all four operands — the sweep's config axis — dispatches to
+one config-grid launch; `shard_mesh` additionally runs the dispatch
+under `shard_map` over the mesh's "config" axis (hw_aware.
+config_shard_map — each shard read-modify-writes only its own config
+rows' banks).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import engine as fault_engine
+from . import packed as fault_packed
+
+#: fault-process decrement policies the epilogue can express
+#: (fault_packed.fail_packed's mode vocabulary)
+FUSED_MODES = ("write", "always", "never")
+
+
+def _epilogue_tile(data, upd, lq, bank, mode: str):
+    """One (rows, lanes) tile of the fused tail — the ONE definition of
+    the arithmetic, shared by the single and config-batched kernels and
+    (transitively, op for op) by the unfused path it must match bit for
+    bit: ApplyUpdate's subtract, fail_packed's counter decrement /
+    derived broken mask / in-register stuck unpack, the clamp."""
+    new = data - upd
+    alive = lq > 0
+    one = jnp.asarray(1, lq.dtype)
+    if mode == "write":
+        written = jnp.abs(upd) >= fault_engine.EPSILON
+        lq2 = jnp.where(alive & written, lq - one, lq)
+    elif mode == "always":
+        lq2 = jnp.where(alive, lq - one, lq)
+    else:                          # "never": static counter field
+        lq2 = lq
+    broken = lq2 <= 0
+    # the tile is padded to the bank's full 4-cells-per-byte width, so
+    # the unpack needs no last_dim slice (padding columns are cut by
+    # the caller); unpack_stuck IS the unfused path's unpack
+    stuck = fault_packed.unpack_stuck(bank, bank.shape[-1] * 4)
+    return jnp.where(broken, stuck.astype(new.dtype), new), lq2
+
+
+def _make_fused_kernel(mode: str):
+    """Elementwise kernel body — one block covers the whole (padded)
+    leaf, so `[...]` indexing serves both the single-config (rows,
+    lanes) and the config-batched (1, rows, lanes) block shapes."""
+    def kernel(data_ref, upd_ref, lq_ref, bank_ref, od_ref, olq_ref):
+        od, olq = _epilogue_tile(data_ref[...], upd_ref[...],
+                                 lq_ref[...], bank_ref[...], mode)
+        od_ref[...] = od
+        olq_ref[...] = olq
+    return kernel
+
+
+def _rows(a):
+    """Collapse a leaf to 2-D (rows, last): the packing axis is the
+    last axis, everything else is rows (biases become one row)."""
+    return a.reshape((1, -1) if a.ndim == 1 else (-1, a.shape[-1]))
+
+
+def _pad_last(a, width: int):
+    return jnp.pad(a, ((0, 0),) * (a.ndim - 1)
+                   + ((0, width - a.shape[-1]),))
+
+
+def _fused_call(data, upd, lq, bank, mode: str):
+    """Single-config launch: one whole-leaf block (these are per-config
+    leaf tiles — at most a few MB, comfortably VMEM-resident)."""
+    import jax.experimental.pallas as pl
+
+    shape, L = data.shape, data.shape[-1]
+    Lp = bank.shape[-1] * 4
+    d2, u2, l2 = (_pad_last(_rows(a), Lp) for a in (data, upd, lq))
+    b2 = _rows(bank)
+    out = pl.pallas_call(
+        _make_fused_kernel(mode),
+        out_shape=(jax.ShapeDtypeStruct(d2.shape, data.dtype),
+                   jax.ShapeDtypeStruct(l2.shape, lq.dtype)),
+        interpret=jax.default_backend() != "tpu",
+    )(d2, u2, l2, b2)
+    return (out[0][..., :L].reshape(shape),
+            out[1][..., :L].reshape(shape))
+
+
+def _fused_call_batched(data, upd, lq, bank, mode: str):
+    """Config-batched launch: grid axis 0 is the config lane, each
+    lane's whole leaf one block — one launch updates every lane's
+    params and read-modify-writes every lane's banks."""
+    import jax.experimental.pallas as pl
+
+    cfg, shape, L = data.shape[0], data.shape, data.shape[-1]
+    Lp = bank.shape[-1] * 4
+    r3 = lambda a: a.reshape((a.shape[0], 1, -1) if a.ndim == 2
+                             else (a.shape[0], -1, a.shape[-1]))
+    d3, u3, l3 = (_pad_last(r3(a), Lp) for a in (data, upd, lq))
+    b3 = r3(bank)
+    spec = lambda a: pl.BlockSpec((1,) + a.shape[1:], lambda c: (c, 0, 0))
+    out = pl.pallas_call(
+        _make_fused_kernel(mode),
+        grid=(cfg,),
+        in_specs=[spec(d3), spec(u3), spec(l3), spec(b3)],
+        out_specs=(spec(d3), spec(l3)),
+        out_shape=(jax.ShapeDtypeStruct(d3.shape, data.dtype),
+                   jax.ShapeDtypeStruct(l3.shape, lq.dtype)),
+        interpret=jax.default_backend() != "tpu",
+    )(d3, u3, l3, b3)
+    return (out[0][..., :L].reshape(shape),
+            out[1][..., :L].reshape(shape))
+
+
+@functools.lru_cache(maxsize=None)
+def _vmappable_fused(mode: str, shard_mesh=None):
+    """The dispatch seam (hw_aware._vmappable_forward's twin): an
+    unbatched call is one single-config launch; the sweep's vmap over
+    (data, upd, life_q, stuck_bits) collapses into one config-grid
+    launch; mixed batching falls back to per-lane launches under
+    lax.map. `shard_mesh` wraps the dispatch in shard_map over the
+    config axis — each shard read-modify-writes its own rows' banks."""
+    import jax.custom_batching
+
+    @jax.custom_batching.custom_vmap
+    def fused(data, upd, lq, bank):
+        return _fused_call(data, upd, lq, bank, mode)
+
+    @fused.def_vmap
+    def _rule(axis_size, in_batched, data, upd, lq, bank):
+        db = in_batched[0]
+
+        def dispatch(data, upd, lq, bank):
+            if all(in_batched):
+                return _fused_call_batched(data, upd, lq, bank, mode)
+            from .hw_aware import per_lane_map
+            return per_lane_map(
+                lambda *lane: _fused_call(*lane, mode),
+                (data, upd, lq, bank), in_batched)
+
+        if shard_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from .hw_aware import config_shard_map
+            # outputs are config-stacked data/life_q: one leading
+            # config dim (already on `data` when it is batched)
+            nd = np.ndim(data) + (0 if db else 1) - 1
+            cspec = lambda n: P("config", *([None] * n))
+            out = config_shard_map(
+                dispatch, shard_mesh, (data, upd, lq, bank),
+                in_batched, out_specs=(cspec(nd), cspec(nd)))
+        else:
+            out = dispatch(data, upd, lq, bank)
+        return out, (True, True)
+    return fused
+
+
+def fused_update_fail(data, upd, life_q, stuck_bits, mode: str = "write",
+                      shard_mesh=None):
+    """(data', life_q') = the fused tail of one step for one fault
+    leaf: data' = where(broken', stuck, data - upd) with the counter
+    bank decremented per `mode` — bit-identical to `data - upd`
+    followed by `fault_packed.fail_packed` (module docstring). `data`
+    holds the PRE-update values (ApplyUpdate is fused in); `upd` the
+    post-strategy update; `life_q`/`stuck_bits` the packed banks.
+    vmap over all four = the sweep's config axis; `shard_mesh` (static)
+    runs the dispatch sharded over the mesh's "config" axis."""
+    if mode not in FUSED_MODES:
+        raise ValueError(f"unknown fused epilogue mode {mode!r} "
+                         f"(expected one of {FUSED_MODES})")
+    return _vmappable_fused(mode, shard_mesh)(data, upd, life_q,
+                                              stuck_bits)

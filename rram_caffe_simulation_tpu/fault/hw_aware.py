@@ -50,6 +50,15 @@ choice, layer_factory.cpp:38):
                                                 SweepRunner(engine=...))
   ==========  ================================  ==============================
 
+Under the mesh (ISSUE 13): a config-ONLY mesh — single-process
+multi-chip or a multi-host pod — runs the kernel SHARDED: the
+custom_vmap seam wraps the config-batched launch in `shard_map` over
+the "config" axis (`crossbar_matmul(..., shard_mesh=mesh)`, set by
+the SweepRunner), so each shard issues one launch over its own config
+rows with the same per-lane seed words — bit-identical to the
+single-process launch (scripts/check_pod_sweep.py). The fused
+ApplyUpdate+Fail epilogue (fault/fused.py) shard_maps identically.
+
 Fallbacks (every one loud or semantics-preserving, never silent wrong
 answers): under a `compute_dtype` below f32 the kernel still computes
 in f32 — the call site (ops/common.py) casts x/w up around the fused
@@ -57,8 +66,10 @@ call and the output/cotangents back down, so activations keep the
 half-width HBM traffic while the crossbar read keeps f32 numerics
 ("auto" stays conservative and engages pallas only at native f32; an
 explicit hw_engine="pallas" composes with any compute_dtype); the
-dp/tp/pp wrappers force "jax" (the kernel has no GSPMD partitioning
-rule); and a
+dp/tp/pp wrappers force "jax", and a sweep mesh with "data"/"model"
+axes resolves engine="pallas" to "jax" LOUDLY (one-time stderr line +
+the observe `setup` record's `engine_fallback_reason` field — the
+kernel has no GSPMD partitioning rule off the config axis); and a
 vmap batching pattern that does not batch ALL of w/broken/stuck/seed
 (x may be shared or per-config) runs the single-config kernel per lane
 under `lax.map` (identical numerics, no fusion win).
@@ -510,8 +521,53 @@ def _pallas_forward_batched(x, w, broken, stuck, seeds, sigma, q_bits=0,
     return out[:, :m, :n]
 
 
+def config_shard_specs(args, in_batched, axis: str = "config"):
+    """PartitionSpecs for a config-batched operand list under the
+    sweep's mesh: batched operands shard their leading (config) dim
+    over `axis`, unbatched operands replicate. Shared by the crossbar
+    seam below and the fused fail+update epilogue (fault/fused.py) —
+    ONE definition so every kernel the sweep launches under `shard_map`
+    agrees on which rows live where."""
+    from jax.sharding import PartitionSpec as P
+    return tuple(
+        P(axis, *([None] * (np.ndim(a) - 1))) if b
+        else P(*([None] * np.ndim(a)))
+        for a, b in zip(args, in_batched))
+
+
+def per_lane_map(fn, args, in_batched):
+    """The mixed-batching fallback every config-batched kernel seam
+    shares: `lax.map` of the single-lane `fn` over the batched
+    operands' rows — unbatched operands stay closure-captured, nothing
+    is broadcast-materialized. The row count comes from the operands'
+    LOCAL shapes, so the same fallback is correct inside a shard_map
+    body (shard-local rows) and outside it (the full axis)."""
+    n_rows = [a.shape[0] for a, b in zip(args, in_batched) if b][0]
+
+    def one(i):
+        return fn(*[a[i] if b else a
+                    for a, b in zip(args, in_batched)])
+    return jax.lax.map(one, jnp.arange(n_rows))
+
+
+def config_shard_map(fn, mesh, args, in_batched, out_specs):
+    """Run a config-batched dispatch under `shard_map` over the mesh's
+    "config" axis: each shard sees ONLY its local config-row block of
+    the batched operands (per-lane seed words ride with the rows, so
+    per-lane noise streams are bit-identical to the unsharded launch)
+    and issues one local kernel launch — the pod-scale dispatch ROADMAP
+    item 3 / ISSUE 13 asks for. `check_rep=False`: the body holds
+    pallas_call / lax.map primitives the replication checker cannot
+    analyze; the out_specs are the contract."""
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh,
+                     in_specs=config_shard_specs(args, in_batched),
+                     out_specs=out_specs, check_rep=False)(*args)
+
+
 @functools.lru_cache(maxsize=None)
-def _vmappable_forward(sigma: float, q_bits: int, tiles=None):
+def _vmappable_forward(sigma: float, q_bits: int, tiles=None,
+                       shard_mesh=None):
     """The engine-dispatch seam between the single-config and the
     config-batched kernel: an unbatched call lowers to the single
     kernel; a vmap over (w, broken, stuck, seed) — the Monte-Carlo
@@ -519,7 +575,14 @@ def _vmappable_forward(sigma: float, q_bits: int, tiles=None):
     per-config (the training sweep: upstream per-config weights batch
     every activation) — dispatches to ONE config-grid launch; any other
     pattern falls back to per-lane single kernels under lax.map
-    (identical numerics, no fusion)."""
+    (identical numerics, no fusion).
+
+    `shard_mesh` (static, a config-axis jax Mesh or None) is the pod
+    dispatch: the whole rule body runs under `shard_map` over the
+    mesh's "config" axis, so each shard issues one batched launch over
+    its LOCAL config rows — same per-lane seed words, bit-identical to
+    the single-process launch (tests/test_sweep_kernels.py +
+    scripts/check_pod_sweep.py pin it)."""
     import jax.custom_batching
 
     @jax.custom_batching.custom_vmap
@@ -529,29 +592,35 @@ def _vmappable_forward(sigma: float, q_bits: int, tiles=None):
 
     @fwd.def_vmap
     def _rule(axis_size, in_batched, x, w, broken, stuck, seed):
-        xb, wb, bb, sb, seedb = in_batched
-        if wb and bb and sb and seedb:
-            out = _pallas_forward_batched(x, w, broken, stuck, seed,
-                                          sigma, q_bits, tiles)
-        else:
+        wb, bb, sb, seedb = in_batched[1:]   # x may be shared
+
+        def dispatch(x, w, broken, stuck, seed):
+            if wb and bb and sb and seedb:
+                return _pallas_forward_batched(x, w, broken, stuck,
+                                               seed, sigma, q_bits,
+                                               tiles)
             # mixed batching (e.g. per-lane fault masks with shared
-            # weights): run the single kernel per lane — unbatched
-            # operands stay closure-captured, nothing is
-            # broadcast-materialized
-            def one(i):
-                take = lambda v, b: v[i] if b else v
-                return _pallas_forward(
-                    take(x, xb), take(w, wb), take(broken, bb),
-                    take(stuck, sb), take(seed, seedb), sigma, q_bits,
-                    tiles)
-            out = jax.lax.map(one, jnp.arange(axis_size))
+            # weights): single kernel per lane (`per_lane_map` —
+            # identical numerics, no fusion win)
+            return per_lane_map(
+                lambda *lane: _pallas_forward(*lane, sigma, q_bits,
+                                              tiles),
+                (x, w, broken, stuck, seed), in_batched)
+
+        if shard_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            out = config_shard_map(
+                dispatch, shard_mesh, (x, w, broken, stuck, seed),
+                in_batched, out_specs=P("config", None, None))
+        else:
+            out = dispatch(x, w, broken, stuck, seed)
         return out, True
     return fwd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0,
-                    tiles=None):
+                    tiles=None, shard_mesh=None):
     """y = x @ where(broken, stuck, quantize(w) * (1 + sigma*eps)) as
     one fused Pallas kernel (noise generated and the optional q_bits
     ADC-grid quantization applied in VMEM, never materialized in HBM).
@@ -575,19 +644,28 @@ def crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits=0,
     x shared or per-config — dispatches to the config-batched kernel
     (one launch for every lane, per-lane noise streams bit-identical to
     per-lane single launches); see the ENGINE MATRIX in the module
-    docstring."""
-    return _vmappable_forward(float(sigma), int(q_bits), tiles)(
+    docstring.
+
+    `shard_mesh` (static, a jax Mesh with a "config" axis, or None) is
+    the pod-scale dispatch: the config-batched launch runs under
+    `shard_map` over that axis, one local launch per shard over its
+    own config rows — bit-identical to the unsharded launch (the
+    per-lane seed words travel with the rows). The SweepRunner sets it
+    when engine="pallas" runs on a config-sharded mesh."""
+    return _vmappable_forward(float(sigma), int(q_bits), tiles,
+                              shard_mesh)(
         x, w, broken.astype(jnp.float32), stuck.astype(jnp.float32),
         seed)
 
 
-def _cm_fwd(x, w, broken, stuck, seed, sigma, q_bits, tiles):
+def _cm_fwd(x, w, broken, stuck, seed, sigma, q_bits, tiles,
+            shard_mesh):
     y = crossbar_matmul(x, w, broken, stuck, seed, sigma, q_bits,
-                        tiles)
+                        tiles, shard_mesh)
     return y, (x, w, broken, stuck)
 
 
-def _cm_bwd(sigma, q_bits, tiles, res, g):
+def _cm_bwd(sigma, q_bits, tiles, shard_mesh, res, g):
     # the per-tile ADC (tiles) is a forward-only perturbation like the
     # output quantize_ste it generalizes: straight-through, so the
     # backward is the SAME clean-masked-weight product either way

@@ -168,6 +168,28 @@ class ProcessStack:
         return [p.process_name for p in self.processes
                 if not p.supports_packed]
 
+    @property
+    def supports_fused_epilogue(self) -> bool:
+        """Whether the step may fuse ApplyUpdate + Fail into one kernel
+        (fault/fused.py): exactly one process, and it declares a
+        `fused_mode` (the clamp family's counter-decrement tails). A
+        multi-process stack never fuses — a decay process mutates
+        weight VALUES between the update and the clamp, which the
+        fused subtract-decrement-clamp tail cannot express."""
+        return (len(self.processes) == 1
+                and self.processes[0].fused_mode is not None)
+
+    def fused_unsupported_reason(self) -> str:
+        """Why the fused epilogue cannot engage (callers record this
+        as the fallback reason; '' when supports_fused_epilogue)."""
+        if self.supports_fused_epilogue:
+            return ""
+        if len(self.processes) > 1:
+            return (f"multi-process stack {self.canonical()!r} (decay "
+                    "runs between update and clamp)")
+        return (f"process {self.processes[0].process_name!r} declares "
+                "no fused_mode")
+
     def write_quantum(self, decrement: float) -> float:
         for p in self.processes:
             if p.has_lifetimes:
@@ -218,6 +240,19 @@ class ProcessStack:
             fault_params, state = p.fail_packed(fault_params, state,
                                                 fault_diffs, pack_spec)
         return fault_params, state
+
+    def fail_fused(self, fault_params, state, fault_diffs, pack_spec,
+                   shard_mesh=None):
+        """The fused ApplyUpdate+Fail epilogue (fault/fused.py);
+        `fault_params` carries PRE-update values. Only callable when
+        `supports_fused_epilogue` (single fusable clamp process)."""
+        if not self.supports_fused_epilogue:
+            raise ValueError(
+                "fused epilogue unsupported: "
+                + self.fused_unsupported_reason())
+        return self.processes[0].fail_fused(fault_params, state,
+                                            fault_diffs, pack_spec,
+                                            shard_mesh=shard_mesh)
 
     # --- observe contributions ----------------------------------------
     def counters(self, state, life_view) -> dict:

@@ -47,6 +47,12 @@ class FaultProcess:
     #: round-trip (lifetime counters + 2-bit stuck codes; extra f32
     #: groups always ride the banks untouched)
     supports_packed = False
+    #: the fused ApplyUpdate+Fail epilogue's decrement policy for this
+    #: process (fault/fused.py: "write" | "always" | "never"), or None
+    #: when its transform cannot be expressed as the fused kernel's
+    #: subtract + counter-decrement + clamp tail (decay processes
+    #: mutate values between the update and the clamp)
+    fused_mode: Optional[str] = None
     #: parameter names this process accepts (spec validation)
     param_names: Tuple[str, ...] = ()
 
@@ -98,6 +104,30 @@ class FaultProcess:
         raise NotImplementedError(
             f"fault process {self.process_name!r} has no packed-state "
             "path (supports_packed is False)")
+
+    def fail_fused(self, fault_params, state, fault_diffs,
+                   pack_spec: dict, shard_mesh=None):
+        """The fused ApplyUpdate+Fail epilogue (fault/fused.py): one
+        Pallas launch per leaf subtracts the update AND applies this
+        process's packed fault transition, read-modify-writing the
+        banks in VMEM. `fault_params` holds the PRE-update values;
+        `fault_diffs` the post-strategy updates. Bit-identical to
+        ``data - diff`` followed by `fail_packed` — only called when
+        `fused_mode` is set."""
+        if self.fused_mode is None:
+            raise NotImplementedError(
+                f"fault process {self.process_name!r} has no fused "
+                "epilogue (fused_mode is None)")
+        from .. import fused as fault_fused
+        new_params, new_life = {}, {}
+        for name, data in fault_params.items():
+            nd, nl = fault_fused.fused_update_fail(
+                data, fault_diffs[name], state["life_q"][name],
+                state["stuck_bits"][name], mode=self.fused_mode,
+                shard_mesh=shard_mesh)
+            new_params[name] = nd
+            new_life[name] = nl
+        return new_params, {**state, "life_q": new_life}
 
     # --- observe contributions ----------------------------------------
     def counters(self, state: dict,

@@ -27,6 +27,8 @@ class EnduranceStuckAt(FaultProcess):
     phase = "clamp"
     has_lifetimes = True
     supports_packed = True
+    #: fused epilogue (fault/fused.py): decrement on written steps only
+    fused_mode = "write"
     param_names = ()
 
     def init_state(self, key, shapes, pattern, tiles=None):

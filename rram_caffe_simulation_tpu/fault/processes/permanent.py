@@ -43,6 +43,8 @@ class PermanentFaultMap(FaultProcess):
     phase = "clamp"
     has_lifetimes = True
     supports_packed = True
+    #: fused epilogue (fault/fused.py): the counter field is static
+    fused_mode = "never"
     param_names = ("map", "fraction")
 
     def __init__(self, params=None):

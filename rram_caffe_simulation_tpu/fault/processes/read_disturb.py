@@ -35,6 +35,8 @@ class ReadDisturb(FaultProcess):
     phase = "clamp"
     has_lifetimes = True
     supports_packed = True
+    #: fused epilogue (fault/fused.py): every step is a read
+    fused_mode = "always"
     param_names = ("reads_per_step",)
 
     def __init__(self, params=None):

@@ -208,7 +208,11 @@ DEBUG_UPDATE_FIELDS = {
 # tracks. `config_shards` (optional, pod-scale sweeps) is how many
 # mesh shards the config axis spans — when > 1 the resident state is
 # spread over that many chips and `bytes_per_step_est` is the PER-CHIP
-# share.
+# share. `engine_fallback_reason` (optional, non-empty) is the
+# loud-fallback contract: why an engine="pallas" request resolved to
+# the jax engine (dp/tp mesh axes, no crossbar read to fuse,
+# non-divisible config axis, non-TPU auto resolution, ...) — omitted
+# entirely when the requested engine ran.
 #
 # `pipeline` (optional) is the async-execution-layer accounting
 # (async_exec.PipelineStats): `depth` 0 = synchronous bookkeeping,
@@ -240,6 +244,7 @@ SETUP_FIELDS = {
     "fault_state_format": (str, False),
     "config_shards": (int, False),
     "fault_model": (dict, False),
+    "engine_fallback_reason": (str, False),
 }
 
 # `fault_model` (optional, fault-engine runs) names the fault-process
@@ -493,6 +498,10 @@ def _validate_setup(rec) -> list:
     if isinstance(shards, int) and not isinstance(shards, bool) \
             and shards < 1:
         errs.append("setup.config_shards: must be >= 1")
+    fb = rec.get("engine_fallback_reason")
+    if isinstance(fb, str) and not fb:
+        errs.append("setup.engine_fallback_reason: must be non-empty "
+                    "(omit the field when no fallback happened)")
     fm = rec.get("fault_model")
     if isinstance(fm, dict):
         errs += _check_fields(fm, FAULT_MODEL_FIELDS,

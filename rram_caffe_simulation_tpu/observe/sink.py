@@ -235,7 +235,9 @@ def make_setup_record(decode_s: float, compile_s: float,
                       bytes_per_step_est: Optional[int] = None,
                       fault_state_format: Optional[str] = None,
                       config_shards: Optional[int] = None,
-                      fault_model: Optional[dict] = None) -> dict:
+                      fault_model: Optional[dict] = None,
+                      engine_fallback_reason: Optional[str] = None
+                      ) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -275,6 +277,11 @@ def make_setup_record(decode_s: float, compile_s: float,
         rec["config_shards"] = int(config_shards)
     if fault_model is not None:
         rec["fault_model"] = dict(fault_model)
+    if engine_fallback_reason is not None:
+        # the loud-fallback contract (ISSUE 13): why an
+        # engine="pallas" request resolved to the jax engine, so the
+        # log can never attribute a jax run to the kernel
+        rec["engine_fallback_reason"] = str(engine_fallback_reason)
     return rec
 
 

@@ -81,14 +81,17 @@ class InnerProductLayer(Layer):
             # folds its tile grid + per-tile ADC into the kernel
             # (block grid == tile grid).
             from ..fault.hw_aware import crossbar_matmul
-            broken, stuck, seed, sigma, q_bits = cb
+            broken, stuck, seed, sigma, q_bits = cb[:5]
+            # optional 6th element: the config-sharded mesh the sweep's
+            # batched kernel dispatch shard_maps over (ISSUE 13)
+            shard_mesh = cb[5] if len(cb) > 5 else None
             y = crossbar_matmul(
                 x.astype(jnp.float32),
                 (w if self.transpose else w.T).astype(jnp.float32),
                 broken if self.transpose else broken.T,
                 (stuck if self.transpose else stuck.T).astype(jnp.float32),
                 seed, sigma, q_bits,
-                kernel_tiles).astype(bottoms[0].dtype)
+                kernel_tiles, shard_mesh).astype(bottoms[0].dtype)
         elif kernel_tiles is not None:
             # jax engine, tiled: the stored weight already carries the
             # perturbed/faulty read values (the solver installs them);

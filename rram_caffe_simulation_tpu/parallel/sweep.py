@@ -13,6 +13,7 @@ mean/std grid sweeps.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
@@ -55,6 +56,23 @@ _LEGACY_PROCESS = "endurance_stuck_at"
 
 #: the implicit tile mapping of every pre-v6 checkpoint (untiled)
 _LEGACY_TILES = "1x1"
+
+#: engine-fallback reasons already announced on stderr (one line per
+#: process per distinct reason — loud, not spammy)
+_ENGINE_FALLBACK_WARNED: set = set()
+
+
+def _warn_engine_fallback(reason: str):
+    """One-time stderr notice that an engine="pallas" request resolved
+    to the jax engine (the loud-fallback contract, ISSUE 13): the same
+    reason also lands in `SweepRunner.engine_fallback_reason` and the
+    observe `setup` record's `engine_fallback_reason` field, so bench
+    rows and logs can never attribute a jax run to the kernel."""
+    if reason in _ENGINE_FALLBACK_WARNED:
+        return
+    _ENGINE_FALLBACK_WARNED.add(reason)
+    print(f"[sweep] engine='pallas' resolved to 'jax': {reason}",
+          file=sys.stderr)
 
 
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
@@ -175,7 +193,7 @@ class SweepRunner:
                  pipeline_depth: Optional[int] = None,
                  stall_timeout_s: Optional[float] = None,
                  engine: str = "jax", packed_state: bool = False,
-                 dtype_policy=None):
+                 dtype_policy=None, fused_epilogue=None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -188,7 +206,10 @@ class SweepRunner:
         # banks (fault/packed.py, ~4x less resident fault HBM, fault
         # transitions identical); `dtype_policy` ("ternary" | "int8")
         # quantizes the fault-target weight reads through the
-        # quantize_ste ADC grid. See fault/hw_aware.py ENGINE MATRIX.
+        # quantize_ste ADC grid; `fused_epilogue` (None=auto) fuses the
+        # SGD update + packed fault transition into the kernel tail
+        # (fault/fused.py — banks read-modified-written in VMEM).
+        # See fault/hw_aware.py ENGINE MATRIX.
         if engine == "auto":
             engine = "jax"     # sweeps opt in to pallas explicitly
         if engine not in ("jax", "pallas"):
@@ -303,12 +324,6 @@ class SweepRunner:
                     "'data') mesh axes only: the TP weight-dim "
                     "shardings are not wired through the distributed "
                     "checkpoint/refill row layout yet")
-            if engine == "pallas":
-                raise ValueError(
-                    "SweepRunner(engine='pallas') is single-process: "
-                    "the fused kernel's custom_vmap dispatch has no "
-                    "cross-host partitioning story (ENGINE MATRIX, "
-                    "fault/hw_aware.py)")
             if solver.strategies.genetic is not None:
                 raise ValueError(
                     "multi-process sweeps do not support the genetic "
@@ -336,13 +351,37 @@ class SweepRunner:
         # runner switches the counters on)
         self.last_metrics = {}
 
-        if engine == "pallas" and set(self.mesh.axis_names) - {"config"}:
-            raise ValueError(
-                "SweepRunner(engine='pallas') supports config-only "
-                "meshes: the fused crossbar kernel has no GSPMD "
-                "partitioning rule for 'data'/'model' axes (the jax "
-                "engine shards everywhere — ENGINE MATRIX, "
-                "fault/hw_aware.py)")
+        # engine="pallas" under a mesh (ISSUE 13): a config-only mesh
+        # runs the kernel SHARDED — the custom_vmap seam wraps the
+        # config-batched launch in shard_map over the "config" axis,
+        # each shard (and each POD PROCESS) issuing one launch over
+        # its own config rows with the same per-lane seed words, so
+        # the sharded program is bit-identical to the single-process
+        # launch. What the kernel cannot express falls back to the
+        # jax engine LOUDLY: the reason lands on
+        # `engine_fallback_reason` (and the observe `setup` record)
+        # plus a one-time stderr line — never a silent wrong
+        # attribution (dp/tp meshes shard the jax engine as before).
+        self.engine_fallback_reason = None
+        self._shard_mesh = None
+        if engine == "pallas":
+            other_axes = sorted(set(self.mesh.axis_names) - {"config"})
+            cshards = int(self.mesh.shape.get("config", 1))
+            if other_axes:
+                self.engine_fallback_reason = (
+                    f"mesh axes {other_axes} have no kernel "
+                    "partitioning rule — dp/tp sweeps run the jax "
+                    "engine (ENGINE MATRIX, fault/hw_aware.py)")
+            elif self._multiproc and self.config_block:
+                self.engine_fallback_reason = (
+                    "config_block under a multi-process mesh hides "
+                    "the config axis from the shard_map dispatch "
+                    "(the blocked lax.map re-batches it per block)")
+            elif cshards > 1 and not self.config_block:
+                self._shard_mesh = self.mesh
+            if self.engine_fallback_reason is not None:
+                engine = "jax"
+                _warn_engine_fallback(self.engine_fallback_reason)
         if packed_state and "model" in self.mesh.axis_names:
             raise ValueError(
                 "packed_state=True is not supported on a 'model'-axis "
@@ -440,7 +479,8 @@ class SweepRunner:
             hw_engine=engine, compute_dtype=compute_dtype,
             apply_fn=apply_fn, dtype_policy=dtype_policy,
             fault_format="packed" if packed_state else "f32",
-            pack_spec=self._pack_spec)
+            pack_spec=self._pack_spec, shard_mesh=self._shard_mesh,
+            fused_epilogue=fused_epilogue)
         # retained for the virtual-time vmap variant (per-lane batch /
         # iteration / rng axes — built lazily by enable_self_healing)
         self._base_step = base
@@ -451,6 +491,20 @@ class SweepRunner:
         # at sigma == 0 with no dtype_policy resolves to "jax". Bench
         # attribution and any "which engine ran" reporting read THIS.
         self.engine_resolved = getattr(base, "hw_engine_resolved", "jax")
+        if self.engine_fallback_reason is None:
+            # step-level resolution (the use_pallas gate): surface it
+            # with the same loudness as the mesh-level fallbacks above
+            self.engine_fallback_reason = getattr(
+                base, "hw_engine_fallback_reason", None)
+            if (self.engine == "pallas"
+                    and self.engine_fallback_reason is not None):
+                _warn_engine_fallback(self.engine_fallback_reason)
+        # fused ApplyUpdate+Fail epilogue resolution (fault/fused.py):
+        # True only when the kernel tail actually compiled in
+        self.fused_epilogue_resolved = getattr(
+            base, "fused_epilogue_resolved", False)
+        self.fused_epilogue_reason = getattr(
+            base, "fused_epilogue_reason", None)
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
@@ -1547,6 +1601,10 @@ class SweepRunner:
         self.setup.fault_format = ("packed" if self._pack_spec is not None
                                    else "f32")
         self.setup.config_shards = int(self.mesh.shape.get("config", 1))
+        # the loud-fallback contract (ISSUE 13): why engine="pallas"
+        # resolved to "jax", schema-validated so log consumers can
+        # attribute throughput to the path that actually ran
+        self.setup.engine_fallback_reason = self.engine_fallback_reason
         fs = getattr(self.solver, "fault_spec", None)
         self.setup.fault_model = fs.to_model() if fs is not None else None
         return self.setup.record(setup_s)

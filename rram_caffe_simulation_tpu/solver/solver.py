@@ -438,7 +438,8 @@ class Solver:
                         compute_dtype=None, apply_fn=None,
                         with_metrics=None, with_debug=None,
                         dtype_policy=None, fault_format: str = "f32",
-                        pack_spec=None):
+                        pack_spec=None, shard_mesh=None,
+                        fused_epilogue=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs, metrics)
@@ -500,7 +501,28 @@ class Solver:
         layers upcast internally for stable log/exp, and masters are
         delta-merged so a pass-through parameter is preserved BIT-EXACT
         (no bf16 round-trip of the weights; only genuinely self-updated
-        state like BatchNorm moving stats takes the cast delta)."""
+        state like BatchNorm moving stats takes the cast delta).
+
+        `shard_mesh` (a jax Mesh with a "config" axis, or None) is the
+        pod-scale kernel dispatch (ISSUE 13): the pallas engine's
+        config-batched launches — the crossbar read AND the fused
+        epilogue — run under `shard_map` over that axis, one local
+        launch per shard, bit-identical to the unsharded program. The
+        SweepRunner sets it; single-config training leaves it None.
+
+        `fused_epilogue` (None | True | False) controls the fused
+        ApplyUpdate+Fail kernel tail (fault/fused.py): the SGD
+        subtract and the packed fault transition of every fault-target
+        leaf become ONE kernel that read-modify-writes the packed
+        banks in VMEM. None (default) auto-engages when the pallas
+        engine, the packed banks, and a single fusable clamp process
+        (endurance_stuck_at, read_disturb, permanent_fault_map) line
+        up — drift stacks fall back to the unfused path; True raises
+        if it cannot engage; False forces the unfused tail. The
+        resolution lands on `step.fused_epilogue_resolved` /
+        `step.fused_epilogue_reason` (and the engine fallback on
+        `step.hw_engine_fallback_reason`) — bit-identical either way
+        (scripts/check_kernel_parity.py)."""
         net = self.net
         param = self.param
         solver_type = self.type
@@ -599,9 +621,51 @@ class Solver:
             hw_engine == "pallas" or
             (hw_engine == "auto" and cdtype is None
              and jax.default_backend() == "tpu"))
+        # why an explicit/auto pallas request resolved to "jax" — the
+        # loud-fallback contract (ISSUE 13): callers (SweepRunner ->
+        # observe `setup` record engine_fallback_reason) surface this
+        # instead of silently reporting an inert flag
+        engine_fallback_reason = None
+        if not use_pallas:
+            if hw_engine == "pallas":
+                engine_fallback_reason = (
+                    "no crossbar read to fuse (rram_forward.sigma == 0 "
+                    "and no ADC-grid dtype_policy): the kernel would "
+                    "eliminate no per-lane weight materialization")
+            elif hw_engine == "auto" and (hw_sigma or q_bits):
+                engine_fallback_reason = (
+                    "auto engine stays on jax: non-TPU backend"
+                    if jax.default_backend() != "tpu"
+                    else "auto engine stays on jax: sub-f32 "
+                         "compute_dtype (explicit engine='pallas' "
+                         "composes with it)")
         # Weight (2-D crossbar) keys go through the fused kernel on the
         # pallas engine; biases always take the pure perturbation.
         crossbar_keys = {w for w, _ in fc_pairs} if use_pallas else set()
+        # fused ApplyUpdate+Fail epilogue (fault/fused.py, ISSUE 13):
+        # None = auto (fuse whenever the pallas engine, the packed
+        # banks, and a fusable single-clamp process stack line up);
+        # True = required (raise if it cannot engage); False = off.
+        fused_on = False
+        fused_reason = None
+        if fused_epilogue is None or fused_epilogue:
+            if not use_pallas:
+                fused_reason = ("pallas engine not engaged "
+                                "(the epilogue is its kernel tail)")
+            elif not packed_on:
+                fused_reason = ("needs the packed fault banks "
+                                "(fault_format='packed')")
+            elif process is None:
+                fused_reason = "no fault-process stack"
+            elif not getattr(process, "supports_fused_epilogue", False):
+                fused_reason = process.fused_unsupported_reason()
+            else:
+                fused_on = True
+            if fused_epilogue and not fused_on:
+                raise ValueError(
+                    f"fused_epilogue=True cannot engage: {fused_reason}")
+        else:
+            fused_reason = "disabled (fused_epilogue=False)"
         tspec = getattr(self, "tile_spec", None)
         tiles_ctx = self._tiles_ctx() if has_fault else None
         if tiles_ctx is not None and apply_fn is not None:
@@ -665,7 +729,8 @@ class Solver:
                             seed = jax.random.randint(
                                 noise_key, (), 0, jnp.iinfo(jnp.int32).max)
                             crossbar[k.rsplit("/", 1)[0]] = (
-                                broken_k, stuck_k, seed, hw_sigma, q_bits)
+                                broken_k, stuck_k, seed, hw_sigma,
+                                q_bits, shard_mesh)
                         else:
                             wk = fp[k]
                             if q_bits:
@@ -866,7 +931,13 @@ class Solver:
                 upd_data_dbg = spec.values_for_keys(data, upd_keys)
                 upd_diff_dbg = spec.values_for_keys(upd, upd_keys)
             with jax.named_scope("apply_update"):
-                data = {k: data[k] - upd[k] for k in data}
+                # under the fused epilogue the fault keys' subtract
+                # moves INTO the Fail kernel (one VMEM read-modify-
+                # write of params + banks); everything else updates
+                # here as always
+                fused_keys = set(fault_keys) if fused_on else ()
+                data = {k: (data[k] if k in fused_keys
+                            else data[k] - upd[k]) for k in data}
 
             # -- Fail (solver.cpp:305; failure_maker.cu:23-40) --
             prev_life = (_life_view(fault_state) if has_fault else None)
@@ -878,8 +949,15 @@ class Solver:
                     # physics in canonical order (decay first, clamp
                     # last); the default endurance stack delegates to
                     # engine.fail / fault_packed.fail_packed — the
-                    # byte-identical legacy path
-                    if packed_on:
+                    # byte-identical legacy path. The fused epilogue
+                    # (fault/fused.py) folds the pending update
+                    # subtract and the packed transition into one
+                    # kernel launch per leaf — bit-identical.
+                    if fused_on:
+                        fp, fault_state = process.fail_fused(
+                            fp, fault_state, fd, pack_spec,
+                            shard_mesh=shard_mesh)
+                    elif packed_on:
                         fp, fault_state = process.fail_packed(
                             fp, fault_state, fd, pack_spec)
                     else:
@@ -970,8 +1048,13 @@ class Solver:
         self._step_baked = True
         # the engine that will actually RUN: "pallas" only when the
         # fused kernel engaged (the use_pallas gate above), so callers
-        # attribute throughput to the real path, not an inert flag
+        # attribute throughput to the real path, not an inert flag —
+        # with the loud-fallback reason and the fused-epilogue
+        # resolution riding along for the observe `setup` record
         step.hw_engine_resolved = "pallas" if use_pallas else "jax"
+        step.hw_engine_fallback_reason = engine_fallback_reason
+        step.fused_epilogue_resolved = fused_on
+        step.fused_epilogue_reason = None if fused_on else fused_reason
         return step
 
     def _compiled_step(self):

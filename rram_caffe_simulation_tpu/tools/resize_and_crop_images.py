@@ -123,7 +123,14 @@ def main(argv=None):
     jobs = [(s, n, args.output_folder, args.dimension)
             for s, n in zip(srcs, names)]
     if args.num_clients > 1 and len(jobs) > 1:
-        with multiprocessing.Pool(args.num_clients) as pool:
+        # spawn, not fork: this tool is importable from processes that
+        # already hold runtime threads (jax initializes a thread pool
+        # on first use), and a bare os.fork() there inherits held
+        # locks — a deadlock, not a theoretical one. spawn re-execs a
+        # clean interpreter per worker; _job and the job tuples are
+        # module-level/picklable, which is all spawn needs.
+        ctx = multiprocessing.get_context("spawn")
+        with ctx.Pool(args.num_clients) as pool:
             ok = sum(pool.map(_job, jobs))
     else:
         ok = sum(_job(j) for j in jobs)

@@ -26,6 +26,15 @@ directly comparable):
    reclaimed lane and the HEALTHY lanes' params/history/losses stay
    byte-identical to an uninjected packed + Pallas run.
 
+5. **Fused epilogue parity** (ISSUE 13): the attack configuration
+   auto-engages the fused ApplyUpdate+Fail kernel tail
+   (fault/fused.py — packed banks read-modified-written in VMEM);
+   an explicitly UNFUSED twin (`fused_epilogue=False`) must produce
+   byte-identical losses AND byte-identical packed fault banks
+   (raw life_q / stuck_bits bytes), so the fusion is provably a pure
+   layout change. The check also asserts the attack runner really
+   fused (no vacuous pass against two unfused runs).
+
     python scripts/check_kernel_parity.py
 
 Exit status: 0 = parity holds, 1 = any violation.
@@ -157,8 +166,14 @@ def main() -> int:
     ref_losses = _run_chunks(ref)
 
     # the attack configuration: config-batched Pallas + packed banks
+    # (+ the fused ApplyUpdate+Fail epilogue, which auto-engages here)
     atk = _runner(work, "atk", engine="pallas", packed_state=True)
     atk_losses = _run_chunks(atk)
+    if not atk.fused_epilogue_resolved:
+        failures.append(
+            "attack runner did not engage the fused epilogue "
+            f"(reason: {atk.fused_epilogue_reason!r}) — the fused "
+            "parity checks below would be vacuous")
 
     # 1. loss parity within byte tolerance
     diff = np.max(np.abs(ref_losses - atk_losses))
@@ -187,6 +202,30 @@ def main() -> int:
                         "transition check tested nothing; lower MEAN")
     if not failures:
         print("fault-state transitions exact (cells broke in-window)")
+
+    # 2b. fused epilogue == unfused path, byte for byte (ISSUE 13):
+    #     same losses, same raw packed-bank bytes
+    unf = _runner(work, "unfused", engine="pallas", packed_state=True,
+                  fused_epilogue=False)
+    unf_losses = _run_chunks(unf)
+    if np.asarray(atk_losses).tobytes() != \
+            np.asarray(unf_losses).tobytes():
+        failures.append("fused epilogue losses not byte-identical to "
+                        "the unfused path")
+    else:
+        bank_ok = True
+        for group in ("life_q", "stuck_bits"):
+            for k in atk.fault_states[group]:
+                a = np.asarray(atk.fault_states[group][k])
+                b = np.asarray(unf.fault_states[group][k])
+                if a.tobytes() != b.tobytes():
+                    failures.append(f"fused epilogue diverged on "
+                                    f"packed bank {group}/{k}")
+                    bank_ok = False
+        if bank_ok:
+            print("fused epilogue OK (losses + packed fault banks "
+                  "byte-identical to the unfused path)")
+    unf.close()
 
     # 3. packed checkpoint >= 3x smaller on the fault payload
     p_ref = os.path.join(work, "ref.ckpt.npz")

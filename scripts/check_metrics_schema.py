@@ -219,6 +219,10 @@ SAMPLE_GOOD_SETUP = {
     # moves and the fault-state bank layout behind the estimate
     "bytes_per_step_est": 1234567890,
     "fault_state_format": "packed",
+    # the loud-fallback contract (ISSUE 13): why engine="pallas"
+    # resolved to "jax" — omitted when the requested engine ran
+    "engine_fallback_reason": "mesh axes ['data'] have no kernel "
+                              "partitioning rule",
     "pipeline": {"depth": 2, "chunks": 100, "records": 100,
                  "host_blocked_seconds": 0.021,
                  "consumer_seconds": 3.4, "drain_seconds": 0.8,
@@ -238,6 +242,7 @@ SAMPLE_BAD_SETUP = {
     "cache": {"compile": "sideways"},                # bad state, no dataset
     "bytes_per_step_est": -10,                       # negative bytes
     "fault_state_format": "origami",                 # unknown format
+    "engine_fallback_reason": "",                    # empty reason
     "fault_model": {"spec": "",                      # empty spec
                     "processes": {"conductance_drift": {
                         "nu": [0.2]}}},              # not number/string

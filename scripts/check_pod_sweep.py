@@ -28,6 +28,17 @@ Three checks, all through the real multi-group driver
    journal, metrics, fault npz, and report byte-identical (the v4
    restore + journal/exit-code semantics preserved multi-process).
 
+4. **Pallas under the mesh** (ISSUE 13): the same 2-process cluster
+   run with `--engine pallas --dtype-policy ternary --packed-state`
+   (the ADC grid arms the kernel at sigma == 0; the shard_map seam
+   gives each process one config-batched launch over its own rows,
+   the fused epilogue read-modify-writes its banks in VMEM) must be
+   byte-identical to the single-process 4-device run of the same
+   flags. Fallback-aware: parity is asserted on whatever engine
+   RESOLVES, and the resolution must be recorded in
+   sweep_report.json (`engine_requested` / `engine_resolved`), so a
+   silent jax fallback can never masquerade as a kernel result.
+
     python scripts/check_pod_sweep.py
 
 Exit status: 0 = sharded run bit-exact and drain coordinated, 1 = any
@@ -107,7 +118,17 @@ net_param {{
 """)
 
 
-def _base_args(solver: str, ckpt_every: int = 0):
+#: the pallas-under-the-mesh flags (check 4): ternary arms the kernel
+#: at sigma == 0 (deterministic — losses byte-comparable), packed
+#: banks engage the fused epilogue; shorter window (interpret-mode
+#: kernels on CPU), injection still at iter 40 so the sharded-lane
+#: refill path is exercised under the kernel too
+PALLAS_ITERS = 80
+PALLAS_EXTRA = ("--engine", "pallas", "--dtype-policy", "ternary",
+                "--packed-state", "--iters", str(PALLAS_ITERS))
+
+
+def _base_args(solver: str, ckpt_every: int = 0, extra=()):
     args = [sys.executable, DRIVER, "--solver", solver,
             "--configs", "4", "--group", "4", "--block", "0",
             "--iters", str(ITERS), "--chunk", str(CHUNK),
@@ -116,26 +137,26 @@ def _base_args(solver: str, ckpt_every: int = 0):
             "--inject-nan", "1@40"]
     if ckpt_every:
         args += ["--checkpoint-every", str(ckpt_every)]
-    return args
+    return args + list(extra)     # trailing flags win (argparse)
 
 
 def _run_single(solver: str, run_dir: str, ckpt_every: int = 0,
-                devices: int = 4):
+                devices: int = 4, extra=()):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count"
                          f"={devices}")
     return subprocess.run(
-        _base_args(solver, ckpt_every) + ["--run-dir", run_dir],
+        _base_args(solver, ckpt_every, extra) + ["--run-dir", run_dir],
         env=env, capture_output=True, text=True)
 
 
 def _spawn_pair(solver: str, run_flag: str, run_dir: str,
-                ckpt_every: int = 0):
+                ckpt_every: int = 0, extra=()):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=2")
     return [subprocess.Popen(
-        _base_args(solver, ckpt_every)
+        _base_args(solver, ckpt_every, extra)
         + [run_flag, run_dir, "--coordinator", coord,
            "--num-processes", "2", "--process-id", str(i)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -270,6 +291,72 @@ def _check_sharded_equals_local(work: str, solver: str, failures: list):
               "injected config retried to completion)")
 
 
+def _check_pallas_under_mesh(work: str, solver: str, failures: list):
+    """Check 4: engine='pallas' on the REAL 2-process cluster —
+    fallback-aware byte-parity with the single-process run of the same
+    flags, plus the recorded engine resolution."""
+    dir_single = os.path.join(work, "pallas_single")
+    dir_pod = os.path.join(work, "pallas_pod")
+
+    r = _run_single(solver, dir_single, extra=PALLAS_EXTRA)
+    if r.returncode != 0:
+        failures.append(
+            f"single-process pallas run failed ({r.returncode}):\n"
+            f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+        return
+    rep_path = os.path.join(dir_single, "sweep_report.json")
+    report = json.load(open(rep_path))
+    for key in ("engine_requested", "engine_resolved"):
+        if key not in report:
+            failures.append(f"pallas run: {key} not recorded in "
+                            "sweep_report.json — a fallback could "
+                            "masquerade as a kernel result")
+    if report.get("engine_requested") != "pallas":
+        failures.append("pallas run recorded engine_requested="
+                        f"{report.get('engine_requested')!r}")
+    if failures:
+        return
+
+    procs = _spawn_pair(solver, "--run-dir", dir_pod,
+                        extra=PALLAS_EXTRA)
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            failures.append("pod pallas run timed out (deadlocked "
+                            "collective in the shard_map dispatch?)")
+            return
+        logs.append(out)
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            failures.append(f"pod pallas process {i} exited "
+                            f"{p.returncode}:\n{logs[i][-2000:]}")
+    if failures:
+        return
+    # parity on whatever engine RESOLVED (fallback-aware), and the two
+    # topologies must agree on what that was
+    _diff_runs(dir_single, dir_pod, failures, "pallas-sharded-vs-local")
+    rp = json.load(open(os.path.join(dir_pod, "sweep_report.json")))
+    if rp.get("engine_resolved") != report.get("engine_resolved"):
+        failures.append(
+            "engine resolution differs across topologies: single "
+            f"{report.get('engine_resolved')!r} vs pod "
+            f"{rp.get('engine_resolved')!r}")
+    if not failures:
+        tail = (""
+                if report.get("engine_resolved") == "pallas"
+                else " (resolved to "
+                f"{report.get('engine_resolved')!r}: "
+                f"{report.get('engine_fallback_reason')!r})")
+        print("pod pallas OK: 2-process engine='pallas' run byte-"
+              f"identical to single-process, resolution "
+              f"{report.get('engine_resolved')!r} recorded in both "
+              f"reports{tail}")
+
+
 def _check_preempt_resume(work: str, solver: str, failures: list):
     dir_ref = os.path.join(work, "resume_ref")
     dir_kill = os.path.join(work, "resume_kill")
@@ -399,6 +486,8 @@ def main() -> int:
         _build_db(db)
         _write_solver(solver, db)
         _check_sharded_equals_local(work, solver, failures)
+        if not failures:
+            _check_pallas_under_mesh(work, solver, failures)
         if not failures:
             _check_preempt_resume(work, solver, failures)
     finally:

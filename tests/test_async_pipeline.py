@@ -250,8 +250,13 @@ def test_background_snapshot_crash_never_replaces_good_file(tmp_path,
         raise IOError("disk full")       # ...that never completes
 
     monkeypatch.setattr(uio, "write_proto_binary", partial_then_crash)
-    s.snapshot()                          # same iter -> same filenames
+    # the sticky writer error may surface on a LATER submit inside this
+    # same snapshot() (the writer thread can process the poisoned model
+    # write between the model and state submits — scheduling-dependent
+    # on a loaded host) or at the wait barrier; both are the sticky
+    # contract, so accept either surfacing point
     with pytest.raises(IOError, match="disk full"):
+        s.snapshot()                      # same iter -> same filenames
         s.wait_for_snapshots()
     monkeypatch.setattr(uio, "write_proto_binary", real)
 

@@ -592,6 +592,210 @@ def test_ckpt_lane_recovery_survives_cross_format_restore(tmp_path):
     assert any(n.startswith("fault/lifetimes/") for n in rows2)
 
 
+def _cfg_mesh(n: int):
+    """A config-only mesh over the first n virtual CPU devices
+    (conftest forces an 8-device host)."""
+    from rram_caffe_simulation_tpu.parallel.mesh import make_mesh
+    return make_mesh({"config": n}, devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# shard_map dispatch (ISSUE 13): pallas under the config-sharded mesh
+
+
+def test_config_sharded_pallas_bit_exact_vs_single_device(tmp_path):
+    """The tentpole contract: a config-SHARDED Pallas sweep (shard_map
+    over the config axis — each shard one batched launch over its own
+    rows) is bit-exact vs the single-device Pallas sweep AND vs the
+    pure-JAX reference (sigma == 0 + ternary: no stochastic term), on
+    losses and on the raw packed fault banks."""
+    mk = lambda d, mesh, **kw: SweepRunner(
+        _sigma_solver(tmp_path / d), n_configs=4, mesh=mesh,
+        dtype_policy="ternary", **kw)
+    r_jax = mk("j", _cfg_mesh(1))
+    r_one = mk("o", _cfg_mesh(1), engine="pallas", packed_state=True)
+    r_sh = mk("s", _cfg_mesh(4), engine="pallas", packed_state=True)
+    assert r_sh.engine_resolved == "pallas"
+    assert r_sh.engine_fallback_reason is None
+    assert r_sh._shard_mesh is not None      # the shard_map dispatch
+    assert r_one._shard_mesh is None         # 1 shard: plain launch
+    l_jax, _ = r_jax.step(8, chunk=2)
+    l_one, _ = r_one.step(8, chunk=2)
+    l_sh, _ = r_sh.step(8, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_jax), np.asarray(l_one))
+    np.testing.assert_array_equal(np.asarray(l_one), np.asarray(l_sh))
+    for group in ("life_q", "stuck_bits"):
+        for k in r_one.fault_states[group]:
+            assert (np.asarray(r_one.fault_states[group][k]).tobytes()
+                    == np.asarray(r_sh.fault_states[group][k]).tobytes())
+    # fault transitions also agree with the f32 reference timeline
+    for k in r_jax.fault_states["lifetimes"]:
+        np.testing.assert_array_equal(
+            np.asarray(r_jax.fault_states["lifetimes"][k] <= 0),
+            np.asarray(r_sh.fault_states["life_q"][k] <= 0))
+    assert any(np.asarray(v <= 0).any()
+               for v in r_jax.fault_states["lifetimes"].values())
+
+
+def test_sharded_pallas_self_healing_refill(tmp_path):
+    """A NaN-poisoned lane on a config-SHARDED Pallas sweep retries to
+    completion through the sharded-lane refill write, and the healthy
+    lanes stay bit-identical to an uninjected sharded run."""
+    mk = lambda d: SweepRunner(
+        _sigma_solver(tmp_path / d), n_configs=4, mesh=_cfg_mesh(2),
+        engine="pallas", dtype_policy="ternary", packed_state=True,
+        pipeline_depth=0)
+    clean = mk("clean")
+    clean_losses, _ = clean.step(8, chunk=2)
+    heal = mk("heal")
+    heal.enable_self_healing(budget=8, max_retries=2)
+    heal.step(2, chunk=2)
+    # poison a lane on the SECOND shard (lane 3 lives on device 1)
+    orig = heal.params["fc2"][0]
+    w = np.array(orig)
+    w[3].flat[0] = np.nan
+    heal.params["fc2"][0] = jax.device_put(jnp.asarray(w),
+                                           orig.sharding)
+    for _ in range(40):
+        if heal.healing_complete():
+            break
+        heal.step(2, chunk=2)
+    rep = heal.config_report()
+    assert sorted(rep["completed"]) == [0, 1, 2, 3]
+    assert rep["completed"][3]["attempts"] >= 2
+    lc = np.asarray(clean_losses)
+    for lane in (0, 1, 2):
+        assert rep["completed"][lane]["loss"] == float(lc[lane])
+
+
+def test_engine_fallback_loud_and_recorded(tmp_path, capsys):
+    """engine='pallas' no longer raises on dp/tp meshes — it falls
+    back to the jax engine LOUDLY: a one-time stderr line, the reason
+    on runner.engine_fallback_reason, and the schema-validated
+    `engine_fallback_reason` field of the observe `setup` record."""
+    from rram_caffe_simulation_tpu.parallel.mesh import make_mesh
+    import rram_caffe_simulation_tpu.parallel.sweep as sm
+    sm._ENGINE_FALLBACK_WARNED.clear()
+    mesh = make_mesh({"config": 2, "data": 2},
+                     devices=jax.devices()[:4])
+    r = SweepRunner(_sigma_solver(tmp_path / "dp"), n_configs=4,
+                    mesh=mesh, engine="pallas",
+                    dtype_policy="ternary")
+    assert r.engine == "pallas" and r.engine_resolved == "jax"
+    assert "data" in r.engine_fallback_reason
+    err = capsys.readouterr().err
+    assert "resolved to 'jax'" in err
+    rec = r.setup_record(1.0)
+    assert rec["engine_fallback_reason"] == r.engine_fallback_reason
+    assert validate_record(rec) == []
+    # one-time: a second runner with the same reason does not re-warn
+    r2 = SweepRunner(_sigma_solver(tmp_path / "dp2"), n_configs=4,
+                     mesh=mesh, engine="pallas",
+                     dtype_policy="ternary")
+    assert "resolved to 'jax'" not in capsys.readouterr().err
+    # the sigma==0/no-policy gate is loud too, with its own reason
+    sm._ENGINE_FALLBACK_WARNED.clear()
+    inert = SweepRunner(_sigma_solver(tmp_path / "inert"), n_configs=2,
+                        engine="pallas")
+    assert inert.engine_resolved == "jax"
+    assert "sigma" in inert.engine_fallback_reason
+    assert "resolved to 'jax'" in capsys.readouterr().err
+    # no fallback -> no field, record still schema-valid
+    armed = SweepRunner(_sigma_solver(tmp_path / "armed"), n_configs=2,
+                        engine="pallas", dtype_policy="ternary")
+    assert armed.engine_fallback_reason is None
+    rec2 = armed.setup_record(1.0)
+    assert "engine_fallback_reason" not in rec2
+    assert validate_record(rec2) == []
+
+
+# ---------------------------------------------------------------------------
+# fused ApplyUpdate+Fail epilogue (fault/fused.py)
+
+
+def test_fused_epilogue_bit_identical_and_reported(tmp_path):
+    """The fused kernel tail auto-engages on pallas+packed with the
+    default endurance stack and is byte-identical to the unfused path
+    on losses AND raw packed banks; fused_epilogue=False forces the
+    unfused tail."""
+    mk = lambda d, **kw: SweepRunner(
+        _sigma_solver(tmp_path / d), n_configs=3, engine="pallas",
+        dtype_policy="ternary", packed_state=True, **kw)
+    fused = mk("f")
+    assert fused.fused_epilogue_resolved
+    unfused = mk("u", fused_epilogue=False)
+    assert not unfused.fused_epilogue_resolved
+    assert "disabled" in unfused.fused_epilogue_reason
+    lf, _ = fused.step(8, chunk=2)
+    lu, _ = unfused.step(8, chunk=2)
+    assert np.asarray(lf).tobytes() == np.asarray(lu).tobytes()
+    for group in ("life_q", "stuck_bits"):
+        for k in fused.fault_states[group]:
+            assert (np.asarray(fused.fault_states[group][k]).tobytes()
+                    == np.asarray(
+                        unfused.fault_states[group][k]).tobytes())
+
+
+def test_fused_epilogue_per_process_support(tmp_path):
+    """The FaultProcess fusion table: endurance_stuck_at and
+    read_disturb fuse (their packed transitions are counter-decrement
+    tails); a drift stack falls back to the unfused path with the
+    blocking stack named; fused_epilogue=True on an unfusable combo
+    raises instead of silently unfusing."""
+    from test_fault import FAULT_NET
+
+    def proc_solver(d, process):
+        sp = pb.SolverParameter()
+        text_format.Parse(FAULT_NET, sp.net_param)
+        sp.base_lr = 0.05
+        sp.lr_policy = "fixed"
+        sp.max_iter = 100
+        sp.display = 0
+        sp.random_seed = 7
+        sp.snapshot_prefix = str(tmp_path / d / "snap")
+        sp.failure_pattern.type = "gaussian"
+        sp.failure_pattern.mean = 250.0
+        sp.failure_pattern.std = 30.0
+        rng = np.random.RandomState(3)
+        data = rng.randn(8, 6).astype(np.float32)
+        target = rng.randn(8, 2).astype(np.float32)
+        return Solver(sp, fault_process=process,
+                      train_feed=lambda: {"data": data,
+                                          "target": target})
+
+    # read_disturb fuses, and the fused run matches its unfused twin
+    mk = lambda d, **kw: SweepRunner(
+        proc_solver(d, "read_disturb"), n_configs=2, engine="pallas",
+        dtype_policy="ternary", packed_state=True, **kw)
+    rd = mk("rd")
+    assert rd.fused_epilogue_resolved
+    rd_un = mk("rd_u", fused_epilogue=False)
+    l_f, _ = rd.step(6, chunk=2)
+    l_u, _ = rd_un.step(6, chunk=2)
+    assert np.asarray(l_f).tobytes() == np.asarray(l_u).tobytes()
+    for k in rd.fault_states["life_q"]:
+        assert (np.asarray(rd.fault_states["life_q"][k]).tobytes()
+                == np.asarray(rd_un.fault_states["life_q"][k]).tobytes())
+
+    # a drift stack cannot fuse (decay runs between update and clamp)
+    drift = SweepRunner(
+        proc_solver("dr", "endurance_stuck_at+conductance_drift:nu=0.1"),
+        n_configs=2, engine="pallas", dtype_policy="ternary",
+        packed_state=True)
+    assert not drift.fused_epilogue_resolved
+    assert "conductance_drift" in drift.fused_epilogue_reason
+    with pytest.raises(ValueError, match="fused_epilogue"):
+        SweepRunner(
+            proc_solver("dr2",
+                        "endurance_stuck_at+conductance_drift:nu=0.1"),
+            n_configs=2, engine="pallas", dtype_policy="ternary",
+            packed_state=True, fused_epilogue=True)
+    # without the pallas engine there is no kernel tail to fuse into
+    with pytest.raises(ValueError, match="fused_epilogue"):
+        SweepRunner(proc_solver("j", None), n_configs=2,
+                    packed_state=True, fused_epilogue=True)
+
+
 def test_engine_resolved_reflects_kernel_gate(tmp_path):
     """runner.engine stores the REQUEST; runner.engine_resolved names
     what actually runs — 'pallas' only when the fused kernel engaged
