@@ -70,6 +70,12 @@ ENGINE = os.environ.get("BENCH_ENGINE", "auto")
 # weight reads through the quantize_ste ADC grid. Opt-in — it changes
 # the arithmetic (RESULTS.md "Quantized & packed sweeps" caveats).
 DTYPE_POLICY = os.environ.get("BENCH_DTYPE_POLICY", "") or None
+# host span tracer (observe/spans.py): armed AFTER warmup so the
+# timed windows carry a per-phase attribution (extra.phase_breakdown —
+# dispatch / host-blocked / checkpoint / prefetch seconds, the r08+
+# rows' where-do-the-microseconds-go split). Host-side microseconds
+# per chunk; BENCH_TRACE=0 drops it for a paranoid clean-timing run.
+TRACE = os.environ.get("BENCH_TRACE", "1") not in ("", "0")
 
 
 def main(argv=None):
@@ -159,6 +165,11 @@ def main(argv=None):
     jax.block_until_ready(runner.params)
     setup_s = time.perf_counter() - t_setup
 
+    # span tracing starts AFTER warmup: the phase breakdown attributes
+    # the TIMED windows, not the compile/decode cold start (which the
+    # setup record already splits)
+    tracer = runner.enable_tracing() if TRACE else None
+
     windows = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -169,6 +180,15 @@ def main(argv=None):
     # the setup record is taken AFTER the timed windows so its pipeline
     # accounting covers the whole run's chunks, not just the warmup
     setup_rec = runner.setup_record(setup_s)
+    phase_extra = {}
+    if tracer is not None:
+        # span-derived attribution of the timed windows' HOST seconds
+        # (observe/spans.py bench_phase_breakdown documents the
+        # bucket definitions; checkpoint/prefetch are zero on this
+        # bench — rows share one shape)
+        from rram_caffe_simulation_tpu.observe import spans as obs_spans
+        phase_extra = {"phase_breakdown":
+                       obs_spans.bench_phase_breakdown(tracer.events())}
     runner.close()
 
     # chips = the devices the sweep actually ran on: the whole mesh
@@ -248,6 +268,7 @@ def main(argv=None):
             "dtype_policy": DTYPE_POLICY or "off",
             "bytes_per_step_est": bytes_step,
             "achieved_bandwidth_gb_s_per_chip": round(achieved_gb_s, 2),
+            **phase_extra,
             "steps_timed": STEPS, "batch": BATCH, "chunk": CHUNK,
             "n_configs": N_CONFIGS, "chips": n_chips,
             "seconds": round(dt, 3),

@@ -53,6 +53,9 @@ def main(argv=None):
                    help="mesh spec, e.g. 'config=4': shard the config "
                         "axis; the pallas engine runs shard_map'd "
                         "under it (ISSUE 13)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip the span tracer (observe/spans.py) — "
+                        "drops the row's phase_breakdown attribution")
     args = p.parse_args(argv)
     # a trailing partial chunk would jit-compile inside the timed window
     args.iters = max(args.iters // args.chunk, 1) * args.chunk
@@ -89,6 +92,9 @@ def main(argv=None):
             packed_state=args.packed, mesh=mesh)
         runner.step(max(args.warmup, args.chunk), chunk=args.chunk)
         jax.block_until_ready(runner.params)
+        # armed after warmup: the phase breakdown attributes the timed
+        # window only (observe/spans.py)
+        tracer = None if args.no_trace else runner.enable_tracing()
         t0 = time.perf_counter()
         loss, _ = runner.step(args.iters, chunk=args.chunk)
         jax.block_until_ready(runner.params)
@@ -98,6 +104,17 @@ def main(argv=None):
         img_s = n_cfg * steps_per_s * 100
         pipe = runner.setup_record().get("pipeline", {})
         n_chips = len(np.asarray(runner.mesh.devices).ravel())
+        phase_extra = {}
+        if tracer is not None:
+            # span-derived host attribution for the timed window
+            # (dispatch / host-blocked / checkpoint / prefetch — the
+            # r08+ rows carry the split, not just totals; bucket
+            # definitions live in observe/spans.py)
+            from rram_caffe_simulation_tpu.observe import \
+                spans as obs_spans
+            phase_extra = {"phase_breakdown":
+                           obs_spans.bench_phase_breakdown(
+                               tracer.events())}
         runner.close()
         results.append({
             "n_configs": n_cfg, "steps_per_s": round(steps_per_s, 2),
@@ -125,6 +142,7 @@ def main(argv=None):
             "pipeline_depth": args.pipeline_depth,
             "host_blocked_seconds":
                 round(pipe.get("host_blocked_seconds", 0.0), 4),
+            **phase_extra,
         })
         print(json.dumps(results[-1]))
 

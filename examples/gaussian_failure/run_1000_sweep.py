@@ -255,6 +255,15 @@ def main(argv=None):
                         "a stalled chunk aborts the run with a "
                         "best-effort checkpoint and exit 75 instead of "
                         "hanging; 0 = disabled")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the host span tracer (observe/spans.py): "
+                        "dispatch/consume/drain/heal/checkpoint spans "
+                        "as schema-validated `span` records in each "
+                        "group's metrics stream, per-process Perfetto "
+                        "trace files under <run-dir>/trace/ and — on a "
+                        "clean finish — one merged timeline "
+                        "(trace/merged.trace.json) covering every "
+                        "process's dispatcher and consumer threads")
     p.add_argument("--inject-nan", default="",
                    help="TEST HOOK (check_lane_reclamation.py): "
                         "'CFG@ITER' poisons global config CFG's params "
@@ -362,10 +371,29 @@ def main(argv=None):
         args.process = DEFAULT_PROCESS
 
     from rram_caffe_simulation_tpu.observe import JsonlSink
+    from rram_caffe_simulation_tpu.observe import spans as obs_spans
     from rram_caffe_simulation_tpu.parallel import (GroupPrefetcher,
                                                     SweepRunner)
     from rram_caffe_simulation_tpu.solver import Solver
     from rram_caffe_simulation_tpu.utils.io import read_solver_param
+
+    # one tracer for the WHOLE run (all groups share it, so the merged
+    # timeline shows group boundaries and the prefetched builds that
+    # overlap them); each runner drains it into its own group's
+    # metrics stream at step() returns
+    tracer = (obs_spans.SpanTracer(process_index=pid) if args.trace
+              else None)
+    if tracer is not None:
+        tracer.set_thread_role("dispatcher")
+
+    def _write_trace():
+        """Per-process Perfetto export under <run-dir>/trace/ (no-op
+        without --trace / --run-dir)."""
+        if tracer is None or not run_dir:
+            return None
+        tdir = os.path.join(run_dir, "trace")
+        return tracer.write_chrome_trace(
+            os.path.join(tdir, f"spans.p{pid}.trace.json"))
 
     groups = [args.group] * (args.configs // args.group)
     if args.configs % args.group:
@@ -444,6 +472,8 @@ def main(argv=None):
                              engine=args.engine,
                              dtype_policy=args.dtype_policy or None,
                              packed_state=args.packed_state)
+        if tracer is not None:
+            runner.enable_tracing(tracer)
         # engine attribution for sweep_report.json: what actually RAN
         # (the runner resolves fallbacks loudly), never the request.
         # Groups can resolve differently (config_block is computed per
@@ -610,6 +640,9 @@ def main(argv=None):
             "group": gi,
             "iter": int(runner.iter) if runner is not None else 0,
             "checkpoint": os.path.basename(wrote) if wrote else None})
+        # best-effort post-mortem timeline (per-process file only —
+        # no merge barrier on the preempt path)
+        _write_trace()
         _write_report("preempted", PREEMPTED_EXIT)
         print(f"Preempted by {preempt['signal']} in group {gi}"
               + (f"; checkpoint {wrote}" if wrote
@@ -634,6 +667,7 @@ def main(argv=None):
                 "event": "stall", "group": gi,
                 "iter": int(runner.iter) if runner is not None else 0,
                 "checkpoint": os.path.basename(wrote) if wrote else None})
+            _write_trace()
             _write_report("preempted", PREEMPTED_EXIT)
             print(f"Stalled in group {gi}: {err}"
                   + (f"; checkpoint {wrote}" if wrote else ""),
@@ -666,6 +700,7 @@ def main(argv=None):
     # step, a preemption sys.exit) cancels any in-flight build instead
     # of leaking its consumer threads
     with GroupPrefetcher() as prefetch:
+        prefetch.tracer = tracer
         for gi, n_cfg in enumerate(groups):
             if gi in done_recs:
                 rec = done_recs[gi]
@@ -836,6 +871,29 @@ def main(argv=None):
                 if _any_preempt(preempt):
                     _preempt_exit(runner, gi + 1)
     total_min = (time.perf_counter() - t_total) / 60
+    if tracer is not None and run_dir:
+        # per-process export, then ONE merged Perfetto timeline: the
+        # barrier guarantees every process's file is on disk before
+        # process 0 folds them (pid = process index, tid = thread role
+        # — both processes' dispatcher/consumer threads stay
+        # distinguished on the shared wall-clock base)
+        _write_trace()
+        if nproc > 1:
+            multihost.barrier("trace-export")
+        if primary:
+            tdir = os.path.join(run_dir, "trace")
+            # merge THIS topology's files only (range(nproc), not a
+            # directory glob): a preempted higher-process-count
+            # attempt leaves stale spans.pN files behind, and a glob
+            # would fold a phantom process into the merged timeline
+            parts = [p for p in
+                     (os.path.join(tdir, f"spans.p{i}.trace.json")
+                      for i in range(nproc))
+                     if os.path.exists(p)]
+            from rram_caffe_simulation_tpu.observe.spans import \
+                merge_chrome_traces
+            merge_chrome_traces(
+                parts, os.path.join(tdir, "merged.trace.json"))
     n_failed = sum(1 for v in ledger.values()
                    if v.get("status") == "failed")
     status = "partial" if n_failed else "clean"

@@ -76,6 +76,12 @@ class OrderedConsumer:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self.consumer_s = 0.0    # seconds the thread spent in fn
+        #: optional observe.spans.SpanTracer: each consumed item
+        #: becomes one `span_name` span on this (named) thread, so a
+        #: Perfetto timeline shows the consumer's concurrency against
+        #: the dispatcher. None = no tracing, zero overhead.
+        self.tracer = None
+        self.span_name = name
         # heartbeat: monotonic timestamp of the consumer's last sign of
         # life (item picked up or finished). With `stall_timeout` set, a
         # submit/drain that would block while the heartbeat is staler
@@ -116,7 +122,11 @@ class OrderedConsumer:
                 if self._error is None:
                     t0 = time.perf_counter()
                     self._fn(item)
-                    self.consumer_s += time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    self.consumer_s += dt
+                    if self.tracer is not None:
+                        self.tracer.complete(self.span_name, dt,
+                                             cat="host")
             except BaseException as e:   # surfaced at next submit/drain
                 self._error = e
             finally:
@@ -220,7 +230,18 @@ class BackgroundWriter:
     def __init__(self, depth: int = 2):
         self._consumer = OrderedConsumer(self._write, depth=depth,
                                          name="snapshot-writer")
+        self._consumer.span_name = "write"
         self.write_s = 0.0       # total off-loop serialize+write seconds
+
+    @property
+    def tracer(self):
+        """Optional SpanTracer: each queued write becomes one "write"
+        span on the snapshot-writer thread."""
+        return self._consumer.tracer
+
+    @tracer.setter
+    def tracer(self, tracer):
+        self._consumer.tracer = tracer
 
     def _write(self, item):
         path, write_fn = item

@@ -22,7 +22,14 @@ observable in three layers:
    forward/backward/update mean-abs lines (net.cpp:618-668 format)
    computed inside the jitted step, in-jit NaN/Inf/overflow sentinels
    with first-bad-layer attribution, and the host-side divergence
-   watchdog policy (`Solver.enable_watchdog` / `--watchdog`).
+   watchdog policy (`Solver.enable_watchdog` / `--watchdog`);
+5. span tracing (spans.py): the host-side wall-clock substrate — a
+   ring-buffered thread-safe `SpanTracer` over the sweep/service
+   lifecycle (dispatch/consume/drain/heal/checkpoint spans, request
+   lifetimes), exported as schema-validated `span` JSONL records and
+   Perfetto-loadable Chrome-trace timelines, plus the utilization
+   layer (lane-occupancy rollups, SLO burn-rate accounting, per-phase
+   time breakdowns) that `summarize --timeline` renders.
 """
 from .counters import global_norm_sq, mean_abs, to_host, write_traffic_saved
 from .debug import OVERFLOW_LIMIT, PHASES, NetDebugSpec, sentinel_tree
@@ -33,6 +40,9 @@ from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
                    make_request_record, make_retry_record,
                    make_setup_record, request_line, retry_line,
                    sentinel_line, setup_line)
+from .spans import (OccupancyAggregator, SloAccountant, SpanTracer,
+                    latency_percentiles, make_span_record,
+                    merge_chrome_traces, phase_breakdown, span_line)
 from .trace import trace
 
 __all__ = [
@@ -45,4 +55,7 @@ __all__ = [
     "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",
     "NetDebugSpec", "sentinel_tree", "PHASES", "OVERFLOW_LIMIT",
     "trace",
+    "SpanTracer", "OccupancyAggregator", "SloAccountant",
+    "make_span_record", "span_line", "merge_chrome_traces",
+    "phase_breakdown", "latency_percentiles",
 ]

@@ -45,7 +45,9 @@ membership's onset while the rest of the group continued.
 Further record types are keyed by a `"type"` field (records without one
 are the metrics record above): `setup` — one per process cold start,
 the decode/compile breakdown plus per-cache hit/miss (documented inline
-below) — and two that carry the `debug_info` deep traces:
+below) — `retry`, `request`, `fault_redraw`, `span` (host-side time
+spans from observe/spans.py, documented inline below), and two that
+carry the `debug_info` deep traces:
 
 ``debug_trace`` — one per iteration while `debug_info: true`, the
 structured twin of the reference's ForwardDebugInfo / BackwardDebugInfo
@@ -385,6 +387,43 @@ FAULT_REDRAW_FIELDS = {
     "reason": (str, True),
 }
 
+# --- span records (host-side time spans, observe/spans.py) ---
+#
+# One per completed tracer span or instant event (SpanTracer
+# drain_records): the host-side timing substrate of the sweep/service
+# lifecycle — per-chunk dispatch/consume/drain, heal passes,
+# checkpoint/snapshot writes, prefetched group builds, serve beats,
+# and request lifetimes (linked by `id`). `kind` is "span" (has a
+# real duration) or "instant" (a point event: reseed, quarantine, a
+# request lifecycle transition — dur_s is 0). `thread` is the thread
+# ROLE the event was recorded on (dispatcher / chunk-consumer /
+# snapshot-writer / group-prefetch / ...), `process` the JAX process
+# index — together the (pid, tid) of the Perfetto export. `wall_time`
+# here is the span's START (the tracer's wall-anchored monotonic
+# base), unlike the other record types' emission time::
+#
+#     {"schema_version": 1, "type": "span", "iter": 120,
+#      "wall_time": 1722700000.1, "name": "dispatch", "cat": "sweep",
+#      "kind": "span", "dur_s": 0.0123, "thread": "dispatcher",
+#      "process": 0, "args": {"k": 10}}
+
+SPAN_KINDS = ("span", "instant")
+
+SPAN_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "name": (str, True),
+    "cat": (str, True),
+    "kind": (str, True),
+    "dur_s": (_NUM, True),
+    "thread": (str, True),
+    "process": (int, True),
+    "id": (str, False),       # links events of one entity (request id)
+    "args": (dict, False),    # small JSON-scalar annotations
+}
+
 # --- sentinel records (tripped numeric-health flags) ---
 
 SENTINEL_PHASES = ("forward", "backward", "update", "fault", "loss")
@@ -590,6 +629,37 @@ def _validate_fault_redraw(rec) -> list:
     return errs
 
 
+def _validate_span(rec) -> list:
+    errs = _check_fields(rec, SPAN_FIELDS, "span")
+    errs += _check_iter(rec, "span")
+    kind = rec.get("kind")
+    if isinstance(kind, str) and kind not in SPAN_KINDS:
+        errs.append(f"span: unknown kind {kind!r} "
+                    f"(expected one of {SPAN_KINDS})")
+    for key in ("name", "cat", "thread", "id"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val and (key != "id"
+                                                 or "id" in rec):
+            errs.append(f"span: {key} must be non-empty")
+    dur = rec.get("dur_s")
+    if isinstance(dur, _NUM) and not isinstance(dur, bool) and dur < 0:
+        errs.append("span: dur_s must be >= 0")
+    if isinstance(kind, str) and kind == "instant" \
+            and isinstance(dur, _NUM) and not isinstance(dur, bool) \
+            and dur != 0:
+        errs.append("span: an instant event must have dur_s == 0")
+    proc = rec.get("process")
+    if isinstance(proc, int) and not isinstance(proc, bool) and proc < 0:
+        errs.append("span: process must be >= 0")
+    args = rec.get("args")
+    if isinstance(args, dict):
+        for k, v in args.items():
+            if v is not None and not isinstance(v, (str, bool)) \
+                    and not isinstance(v, _NUM):
+                errs.append(f"span: args[{k!r}] must be a JSON scalar")
+    return errs
+
+
 def _validate_sentinel(rec) -> list:
     errs = _check_fields(rec, SENTINEL_FIELDS, "sentinel")
     errs += _check_iter(rec, "sentinel")
@@ -624,6 +694,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_request(rec)
     if rtype == "fault_redraw":
         return _check_version(rec) + _validate_fault_redraw(rec)
+    if rtype == "span":
+        return _check_version(rec) + _validate_span(rec)
     if rtype is not None:
         return [f"record: unknown record type {rtype!r}"]
     errs = _check_fields(rec, TOP_LEVEL, "record")

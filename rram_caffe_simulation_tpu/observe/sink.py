@@ -19,10 +19,12 @@ records a one-line trip notice.
 """
 from __future__ import annotations
 
+import atexit
 import datetime
 import json
 import os
 import time
+import weakref
 from typing import Optional
 
 from .schema import SCHEMA_VERSION
@@ -329,6 +331,31 @@ class MetricsLogger:
                 close()
 
 
+def _register_atexit_flush(sink):
+    """Crash-post-mortem guard for the buffered file sinks: an
+    unhandled exception unwinds past every `close()` call, and up to
+    `flush_every - 1` tail records — the beats right before the crash,
+    exactly the ones a post-mortem needs — would die in the userspace
+    buffer. `atexit` handlers run on interpreter exit even after an
+    unhandled exception, so each sink registers a weakly-bound flush
+    (a weakref: the registry must not keep closed sinks alive for the
+    process lifetime) and unregisters it on `close()`. Returns the
+    callback so `close()` can unregister."""
+    ref = weakref.ref(sink)
+
+    def _flush_at_exit():
+        s = ref()
+        if s is None:
+            return
+        try:
+            s.flush()
+        except Exception:
+            pass   # the interpreter is dying; best effort only
+
+    atexit.register(_flush_at_exit)
+    return _flush_at_exit
+
+
 class _FlushPolicy:
     """Buffered-write policy shared by the file sinks: flush after
     `flush_every` records, or once `flush_secs` seconds have passed
@@ -378,6 +405,7 @@ class JsonlSink:
         self.path = path
         self._policy = _FlushPolicy(unbuffered, flush_every, flush_secs)
         self._f = open(path, "a" if append else "w")
+        self._atexit_cb = _register_atexit_flush(self)
 
     def write(self, record: dict):
         self._f.write(json.dumps(record) + "\n")
@@ -391,6 +419,7 @@ class JsonlSink:
             self._policy.flushed()
 
     def close(self):
+        atexit.unregister(self._atexit_cb)
         if not self._f.closed:
             self._f.close()
 
@@ -456,6 +485,7 @@ class CaffeLogSink:
         had_content = append and os.path.exists(path) \
             and os.path.getsize(path) > 0
         self._f = open(path, "a" if append else "w")
+        self._atexit_cb = _register_atexit_flush(self)
         if not had_content:
             # one banner per log: extract_seconds measures elapsed time
             # from the FIRST 'Solving' line, so a resumed segment keeps
@@ -504,6 +534,11 @@ class CaffeLogSink:
             self._emit(fault_redraw_line(record))
             self._maybe_flush()
             return
+        if rtype == "span":
+            from .spans import span_line
+            self._emit(span_line(record))
+            self._maybe_flush()
+            return
         if rtype is not None:
             return  # unknown typed records are not Caffe-shaped; skip
         it = record["iter"]
@@ -533,5 +568,6 @@ class CaffeLogSink:
             self._policy.flushed()
 
     def close(self):
+        atexit.unregister(self._atexit_cb)
         if not self._f.closed:
             self._f.close()

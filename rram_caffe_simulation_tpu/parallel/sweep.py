@@ -278,6 +278,11 @@ class SweepRunner:
         self._record_t0 = None     # perf_counter at the last sink record
         self._bg_writer = None     # lazy BackgroundWriter (fault states)
         self._inline_write_s = 0.0  # save_fault_states(background=False)
+        # span tracing (observe/spans.py, enable_tracing): None = off —
+        # every instrumented site is behind a `is not None` guard, so
+        # an untraced run emits nothing and pays nothing
+        self._tracer = None
+        self._trace_dir = None
         from ..data import dataset_cache
         if dataset_cache.dataset_cache_dir() is not None:
             # a cache dir IS configured; "unused" (vs "disabled") until
@@ -668,6 +673,79 @@ class SweepRunner:
     def __exit__(self, exc_type, exc, tb):
         self.close()
         return False
+
+    def enable_tracing(self, tracer=None, profile_dir: Optional[str] = None,
+                       capacity: int = 0):
+        """Arm the host-side span tracer (observe/spans.py, ISSUE 14):
+        per-chunk dispatch / consume / drain spans across the
+        dispatcher and consumer threads, heal passes, checkpoint /
+        restore / fault-state-save spans, background snapshot writes,
+        and healing lifecycle instants (requeue / reseed / failed /
+        quarantine). Spans are host wall-clock observations only — the
+        jitted programs, losses, and fault state are untouched
+        (scripts/check_trace_spans.py pins byte-identity), and with no
+        tracer armed the instrumented sites are `None`-guarded no-ops.
+
+        Span records drain into the solver's metric sinks (as
+        schema-validated `span` JSONL records) at every step() return
+        — after the consumer barrier, so the single-writer sink
+        discipline holds. `profile_dir` additionally writes a
+        Perfetto-loadable Chrome-trace file
+        (`spans.p<process>.trace.json`, pid = jax.process_index, tid =
+        thread role) on close(), next to any `jax.profiler` device
+        traces captured under the same directory. Pass an existing
+        `tracer` to share one timeline across runners (the multi-group
+        driver) or with a serving layer. Returns the tracer."""
+        from ..observe import spans as obs_spans
+        if tracer is None:
+            tracer = obs_spans.SpanTracer(
+                capacity=capacity or obs_spans.DEFAULT_CAPACITY,
+                process_index=jax.process_index())
+        self._tracer = tracer
+        if threading.current_thread() is threading.main_thread():
+            # name the main thread's track; worker threads already
+            # carry useful names (chunk-consumer / snapshot-writer /
+            # group-prefetch), and a runner built ON such a thread
+            # (GroupPrefetcher) must not relabel it
+            tracer.set_thread_role("dispatcher")
+        if self._consumer is not None:
+            self._consumer.tracer = tracer
+            self._consumer.span_name = "consume"
+        if self._bg_writer is not None:
+            self._bg_writer.tracer = tracer
+        if profile_dir is not None:
+            self._trace_dir = profile_dir
+        return tracer
+
+    def _drain_spans(self):
+        """Emit not-yet-drained span records through the solver's
+        metric sinks. Dispatcher thread only, AFTER a consumer barrier
+        (the sinks are unlocked single-writer files)."""
+        tr = self._tracer
+        if tr is None:
+            return
+        logger = (self.solver.metrics_logger
+                  if self.solver._metrics_enabled else None)
+        if logger is None:
+            return
+        for rec in tr.drain_records():
+            logger.log(rec)
+
+    def write_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Perfetto/Chrome-trace export of this runner's
+        tracer; default path is `<profile_dir>/spans.p<process>.json`
+        from enable_tracing(profile_dir=...). Returns the path (None
+        when tracing is off or no destination is known)."""
+        tr = self._tracer
+        if tr is None:
+            return None
+        if path is None:
+            if self._trace_dir is None:
+                return None
+            path = os.path.join(
+                self._trace_dir,
+                f"spans.p{tr.process_index}.trace.json")
+        return tr.write_chrome_trace(path)
 
     def enable_self_healing(self, budget: int, max_retries: int = 1,
                             backoff_iters: int = 0,
@@ -1064,6 +1142,14 @@ class SweepRunner:
     def _emit_retry(self, rec: dict):
         from ..observe import sink as obs_sink
         print(obs_sink.retry_line(rec), flush=True)
+        if self._tracer is not None:
+            # healing lifecycle as timeline instants: requeue / reseed
+            # / failed markers on the dispatcher track, linkable to the
+            # retry records by (iter, config)
+            self._tracer.instant(
+                rec["event"], cat="healing", iteration=rec["iter"],
+                args={"config": rec["config"], "lane": rec["lane"],
+                      "attempt": rec["attempt"]})
         if self.solver._metrics_enabled \
                 and self.solver.metrics_logger is not None:
             self.solver.metrics_logger.log(rec)
@@ -1082,6 +1168,8 @@ class SweepRunner:
         h = self._healing
         if h is None:
             return False
+        t_heal = (time.perf_counter() if self._tracer is not None
+                  else 0.0)
         refilled, newly_benign = [], []
         if k:
             occupied = h.lane_cfg >= 0
@@ -1224,6 +1312,12 @@ class SweepRunner:
         if not complete and (refilled or newly_benign):
             self._set_quarantine_bits(set_lanes=newly_benign,
                                       clear_lanes=refilled)
+        if self._tracer is not None:
+            self._tracer.complete(
+                "heal", time.perf_counter() - t_heal, cat="healing",
+                iteration=self.iter,
+                args={"refilled": len(refilled),
+                      "harvested": len(newly_benign)})
         return complete
 
     def _budget_chunk_cap(self, k: int) -> int:
@@ -1508,9 +1602,22 @@ class SweepRunner:
         if key not in self._chunk_fns:
             jfn = jax.jit(self._make_chunk_run_virtual(),
                           donate_argnums=(0, 1, 2))
+            t0 = time.perf_counter()
             with self.setup.timed_compile():
                 self._chunk_fns[key] = jfn.lower(*args).compile()
-        return self._chunk_fns[key](*args)
+            if self._tracer is not None:
+                self._tracer.complete("compile",
+                                      time.perf_counter() - t0,
+                                      iteration=self.iter,
+                                      args={"k": k})
+        tr = self._tracer
+        if tr is None:
+            return self._chunk_fns[key](*args)
+        t0 = time.perf_counter()
+        out = self._chunk_fns[key](*args)
+        tr.complete("dispatch", time.perf_counter() - t0,
+                    iteration=self.iter, args={"k": k})
+        return out
 
     def _run_chunk(self, k: int, *args):
         """Dispatch one chunk = k scanned sweep iterations. On a
@@ -1539,11 +1646,26 @@ class SweepRunner:
         if key not in self._chunk_fns:
             jfn = jax.jit(self._make_chunk_run(with_dataset=key[1]),
                           donate_argnums=(0, 1, 2))
+            t0 = time.perf_counter()
             with self.setup.timed_compile():
                 self._chunk_fns[key] = jfn.lower(*args).compile()
+            if self._tracer is not None:
+                self._tracer.complete("compile",
+                                      time.perf_counter() - t0,
+                                      iteration=self.iter,
+                                      args={"k": k})
         fn = self._chunk_fns[key]
+        tr = self._tracer
         try:
-            return fn(*args)
+            t0 = time.perf_counter()
+            out = fn(*args)
+            if tr is not None:
+                # the dispatch span: building + enqueueing the chunk's
+                # device work (JAX async dispatch returns handles; the
+                # device time itself lives in the jax.profiler trace)
+                tr.complete("dispatch", time.perf_counter() - t0,
+                            iteration=self.iter, args={"k": k})
+            return out
         except (TypeError, ValueError):
             if key not in self._aot_keys:
                 raise
@@ -1878,6 +2000,14 @@ class SweepRunner:
         if not new:
             return ids
         self._quar_seen.update(new)
+        if self._tracer is not None:
+            for i in new:
+                self._tracer.instant(
+                    "quarantine", cat="healing",
+                    iteration=int(iteration),
+                    args={"lane": int(i),
+                          "config": (int(lane_map[i])
+                                     if lane_map is not None else int(i))})
         for i in new:
             where = self._quarantine_entry(i, mets, stacked)
             # triage note for the retry policy's permanent-failure
@@ -1978,12 +2108,28 @@ class SweepRunner:
             return
         item = (k, last_it, losses, outputs, mets, stacked, quar,
                 lane_map, benign)
+        tr = self._tracer
         if self._consumer is not None:
-            self.pipeline.host_blocked_s += self._consumer.submit(item)
+            blocked = self._consumer.submit(item)
+            self.pipeline.host_blocked_s += blocked
+            if tr is not None:
+                # backpressure: the dispatcher stalled on a full
+                # pipeline queue (the consumer's "consume" spans show
+                # what it was busy with)
+                tr.complete("submit_wait", blocked, iteration=last_it,
+                            args={"k": k})
         else:
             t0 = time.perf_counter()
             self._consume_chunk(item)
-            self.pipeline.host_blocked_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.pipeline.host_blocked_s += dt
+            if tr is not None:
+                # synchronous bookkeeping: the consume runs inline on
+                # the dispatcher thread — same span name as the
+                # pipelined consumer's, the thread role tells them
+                # apart
+                tr.complete("consume", dt, cat="host",
+                            iteration=last_it, args={"k": k})
 
     def _finish_step(self, losses, outputs, stacked=True):
         """End-of-step result materialization: drain the consumer (the
@@ -1991,8 +2137,13 @@ class SweepRunner:
         iteration's host (loss, outputs)."""
         if self._pipeline_on:
             if self._consumer is not None:
-                self.pipeline.drain_s += self._consumer.drain()
+                waited = self._consumer.drain()
+                self.pipeline.drain_s += waited
+                if self._tracer is not None:
+                    self._tracer.complete("drain", waited,
+                                          iteration=self.iter)
             self._service_watchdog()
+            self._drain_spans()
             return self._last_host
         t0 = time.perf_counter()
         if stacked:
@@ -2001,6 +2152,7 @@ class SweepRunner:
         else:
             out = (np.asarray(losses), jax.tree.map(np.asarray, outputs))
         self.pipeline.host_blocked_s += time.perf_counter() - t0
+        self._drain_spans()
         return out
 
     def step(self, iters: int = 1, chunk: int = 1):
@@ -2133,11 +2285,17 @@ class SweepRunner:
                 if self._multiproc:
                     rngs = global_put(np.asarray(rngs),
                                       self._replicated_sharding())
+                t0 = (time.perf_counter() if self._tracer is not None
+                      else 0.0)
                 (self.params, self.history, self.fault_states,
                  self.quarantine, loss, outputs, mets) = self._step(
                     self.params, self.history, self.fault_states,
                     self.quarantine, batch, jnp.int32(self.iter), rngs,
                     self._remap_due())
+                if self._tracer is not None:
+                    self._tracer.complete(
+                        "dispatch", time.perf_counter() - t0,
+                        iteration=self.iter, args={"k": 1})
                 self.last_metrics = mets
                 self._after_dispatch(1, self.iter, loss, outputs, mets,
                                      self.quarantine, stacked=False)
@@ -2218,16 +2376,27 @@ class SweepRunner:
                 async_exec.atomic_write(path, write)
                 self._inline_write_s += time.perf_counter() - t0
             multihost.barrier(f"faults:{os.path.basename(path)}")
+            if self._tracer is not None:
+                self._tracer.complete(
+                    "save_faults", time.perf_counter() - t0,
+                    iteration=self.iter,
+                    args={"path": os.path.basename(path)})
             return path
 
         if background:
             if self._bg_writer is None:
                 self._bg_writer = async_exec.BackgroundWriter()
+                self._bg_writer.tracer = self._tracer
             self._bg_writer.submit(path, write)
         else:
             t0 = time.perf_counter()
             async_exec.atomic_write(path, write)
-            self._inline_write_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._inline_write_s += dt
+            if self._tracer is not None:
+                self._tracer.complete(
+                    "save_faults", dt, iteration=self.iter,
+                    args={"path": os.path.basename(path)})
         return path
 
     # ------------------------------------------------------------------
@@ -2376,6 +2545,8 @@ class SweepRunner:
             distributed = self._multiproc
         if distributed:
             return self._checkpoint_distributed(path, _drain=_drain)
+        t_ckpt = (time.perf_counter() if self._tracer is not None
+                  else 0.0)
         if _drain:
             self._ckpt_drain()
         arrays = {name: self._gather_full(v)
@@ -2415,11 +2586,17 @@ class SweepRunner:
         elif background:
             if self._bg_writer is None:
                 self._bg_writer = async_exec.BackgroundWriter()
+                self._bg_writer.tracer = self._tracer
             self._bg_writer.submit(path, write)
         else:
             t0 = time.perf_counter()
             async_exec.atomic_write(path, write)
             self.pipeline.checkpoint_write_s += time.perf_counter() - t0
+        if self._tracer is not None:
+            self._tracer.complete(
+                "checkpoint", time.perf_counter() - t_ckpt,
+                iteration=self.iter,
+                args={"path": os.path.basename(path)})
         # remember the latest checkpoint: the retry policy's escalating
         # recovery re-seeds a failed config from this file's lane slice
         self._last_ckpt_path = path
@@ -2462,6 +2639,8 @@ class SweepRunner:
                 "distributed checkpoints support 'config'/'data' "
                 "meshes only (TP weight-dim shards have no row-block "
                 "layout); use distributed=False")
+        t_ckpt = (time.perf_counter() if self._tracer is not None
+                  else 0.0)
         if _drain:
             self._ckpt_drain()
         t0 = time.perf_counter()
@@ -2525,6 +2704,12 @@ class SweepRunner:
                                     write_manifest)
         multihost.barrier(f"ckpt-commit:{os.path.basename(path)}")
         self.pipeline.checkpoint_write_s += time.perf_counter() - t0
+        if self._tracer is not None:
+            self._tracer.complete(
+                "checkpoint", time.perf_counter() - t_ckpt,
+                iteration=self.iter,
+                args={"path": os.path.basename(path),
+                      "distributed": True})
         self._last_ckpt_path = path
         return path
 
@@ -2599,6 +2784,8 @@ class SweepRunner:
         first, so restoring while a queued checkpoint/snapshot is still
         in flight can never read a half-landed file."""
         import pickle
+        t_restore = (time.perf_counter() if self._tracer is not None
+                     else 0.0)
         if self._consumer is not None:
             self.pipeline.drain_s += self._consumer.drain()
         self.wait_for_writes()
@@ -2778,6 +2965,11 @@ class SweepRunner:
         # a watchdog halt belongs to the abandoned timeline; restoring
         # an earlier checkpoint must let the sweep run again
         self._stop = False
+        if self._tracer is not None:
+            self._tracer.complete(
+                "restore", time.perf_counter() - t_restore,
+                iteration=self.iter,
+                args={"path": os.path.basename(path)})
         return self
 
     def wait_for_writes(self):
@@ -2800,6 +2992,10 @@ class SweepRunner:
                 self._consumer.drain()
             if self._bg_writer is not None:
                 self._bg_writer.wait()
+            # final span flush + Perfetto export (both after the
+            # barriers above, so every consumer/writer span landed)
+            self._drain_spans()
+            self.write_trace()
         finally:
             if self._consumer is not None:
                 self._consumer.close()
@@ -2901,6 +3097,11 @@ class GroupPrefetcher:
         self._box: dict = {}
         self.last_build_s = 0.0   # the prefetched build's own wall time
         self.last_wait_s = 0.0    # how long take() still had to block
+        #: optional observe.spans.SpanTracer: each prefetched build
+        #: becomes one "group_build" span on the group-prefetch thread
+        #: (the overlapped cold-start seconds, visible against the
+        #: current group's dispatch spans)
+        self.tracer = None
 
     def __enter__(self):
         return self
@@ -2919,6 +3120,8 @@ class GroupPrefetcher:
                                "take() it first")
         box = self._box = {}
 
+        tracer = self.tracer
+
         def run():
             t0 = time.perf_counter()
             try:
@@ -2927,6 +3130,9 @@ class GroupPrefetcher:
                 box["error"] = e
             finally:
                 box["seconds"] = time.perf_counter() - t0
+                if tracer is not None:
+                    tracer.complete("group_build", box["seconds"],
+                                    cat="setup")
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="group-prefetch")

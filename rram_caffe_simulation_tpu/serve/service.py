@@ -114,8 +114,11 @@ class SweepService:
                  allow_inject: bool = False,
                  save_fault_results: bool = False,
                  mesh=None,
+                 trace: bool = False,
+                 profile_dir: Optional[str] = None,
                  runner_kw: Optional[dict] = None):
         from ..observe import JsonlSink
+        from ..observe.spans import OccupancyAggregator, SloAccountant
         from ..parallel import SweepRunner
         from ..solver import Solver
         from ..utils.io import read_solver_param
@@ -195,6 +198,23 @@ class SweepService:
         self.runner.set_refill_policy(self._fair_order)
         self.runner.on_lane_complete = self._on_lane_complete
         self._lane_results: Dict[int, dict] = {}   # cfg -> fault rows
+        # span tracing (observe/spans.py): request lifetimes as async
+        # spans linked by request id, beat/admit/harvest spans on the
+        # loop thread, the runner's dispatch/consume/heal spans — one
+        # shared tracer, one merged timeline. Span records ride the
+        # service-wide metrics stream; the Perfetto export lands under
+        # `profile_dir` (default <service-dir>/trace) on close.
+        self._tracer = None
+        if trace:
+            self._tracer = self.runner.enable_tracing(
+                profile_dir=profile_dir
+                or os.path.join(self.dir, "trace"))
+        # utilization layer (always on — plain host arithmetic): exact
+        # per-beat lane occupancy, and the SLO ledger comparing each
+        # terminal request's achieved turnaround against the admission
+        # controller's EMA projection (stats()["slo"])
+        self._occ = OccupancyAggregator()
+        self._slo = SloAccountant(self.slo_seconds)
 
         if resuming:
             self._resume()
@@ -292,9 +312,17 @@ class SweepService:
         beats = 0
         while True:
             self._flush_front_records()
+            self._drain_spans()
             if self._drain_flag.is_set() or self._drain_file():
                 return self._drain_exit()
+            t_admit = (time.perf_counter() if self._tracer is not None
+                       else 0.0)
             admitted = self._admit_pending()
+            if self._tracer is not None and admitted:
+                self._tracer.complete(
+                    "admit", time.perf_counter() - t_admit, cat="serve",
+                    iteration=self.runner.iter,
+                    args={"admitted": admitted})
             worked = False
             if not self.runner.healing_complete():
                 self._maybe_inject()
@@ -307,8 +335,19 @@ class SweepService:
                 # budget are harvested at the NEXT step's pass, so
                 # they are still visible here)
                 self._account_beat(self._tenant_occupancy(), dt)
+                if self._tracer is not None:
+                    self._tracer.complete(
+                        "beat", dt, cat="serve",
+                        iteration=self.runner.iter,
+                        args={"beat": beats})
                 worked = True
+            t_harvest = (time.perf_counter() if self._tracer is not None
+                         else 0.0)
             self._harvest()
+            if self._tracer is not None and worked:
+                self._tracer.complete(
+                    "harvest", time.perf_counter() - t_harvest,
+                    cat="serve", iteration=self.runner.iter)
             self._update_stats_view()
             self._write_state()
             beats += 1
@@ -446,6 +485,10 @@ class SweepService:
                                              time.time())),
                 "admit_time": time.time(), "start_time": None,
                 "status": "admitted", "results": {},
+                # the admission controller's projection, kept so the
+                # terminal record (and the SLO ledger) can compare
+                # projected vs achieved turnaround
+                "projected_s": projected,
                 "inject_nan": req.get("inject_nan"),
                 "injected_attempt": {},
             }
@@ -514,6 +557,10 @@ class SweepService:
     def _account_beat(self, occupied: Dict[str, int], dt: float):
         """Per-tenant lane-share accounting at the chunk boundary, and
         the dispatch-rate EMA the admission controller divides by."""
+        # exact lane-iteration occupancy per beat (observe/spans.py
+        # OccupancyAggregator; the fleet bar is >90 % sustained)
+        self._occ.add_counts(sum(occupied.values()), self.runner.n,
+                             weight=self.chunk)
         for tenant, lanes in occupied.items():
             self._tenant_lane_iters[tenant] = (
                 self._tenant_lane_iters.get(tenant, 0)
@@ -654,10 +701,15 @@ class SweepService:
                     "results": entry["results"],
                     "latency_s": entry["latency_s"],
                     "reason": reason})
+                # SLO burn-rate ledger: achieved turnaround vs the
+                # admission EMA's projection (stats()["slo"])
+                self._slo.record(entry["tenant"], entry["latency_s"],
+                                 projected_s=entry.get("projected_s"))
                 self._emit_request(entry, entry["status"],
                                   configs=entry["configs_total"],
                                   done=entry["done"],
                                   latency_s=entry["latency_s"],
+                                  projected_s=entry.get("projected_s"),
                                   reason=reason)
 
     def _emit_request(self, entry: dict, event: str,
@@ -667,6 +719,7 @@ class SweepService:
         rec = make_request_record(self.runner.iter, entry["id"],
                                   entry.get("tenant", "default"),
                                   event, **kw)
+        self._trace_request(entry, event, rec)
         _append_jsonl(os.path.join(self.dir, "requests",
                                    f"{entry['id']}.jsonl"), rec)
         if front_door:
@@ -682,6 +735,41 @@ class SweepService:
         if self.solver._metrics_enabled \
                 and self.solver.metrics_logger is not None:
             self.solver.metrics_logger.log(rec)
+
+    def _trace_request(self, entry: dict, event: str, rec: dict):
+        """Request lifecycle on the span timeline: one ASYNC span per
+        request (submitted/resumed -> terminal, linked by request id —
+        it outlives any one beat and thread) plus an instant per
+        transition. Thread-safe: `submitted` can land on the socket
+        thread."""
+        tr = self._tracer
+        if tr is None:
+            return
+        rid = entry["id"]
+        it = int(rec.get("iter", 0))
+        tenant = entry.get("tenant", "default")
+        if event in ("submitted", "resumed"):
+            tr.async_begin("request", id=rid, cat="request",
+                           iteration=it, args={"tenant": tenant})
+        tr.instant(event, cat="request", iteration=it, id=rid,
+                   args={"tenant": tenant})
+        if event in _TERMINAL + ("preempted",):
+            args = {"tenant": tenant, "event": event}
+            if "latency_s" in rec:
+                args["latency_s"] = rec["latency_s"]
+            tr.async_end("request", id=rid, cat="request",
+                         iteration=it, args=args)
+
+    def _drain_spans(self):
+        """Route not-yet-drained span records into the service-wide
+        metrics stream (loop thread / close only — same single-writer
+        discipline as `_flush_front_records`). The runner drains the
+        shared tracer at every step() return too; the tracer's cursor
+        makes the two drains disjoint."""
+        if self._tracer is None:
+            return
+        for rec in self._tracer.drain_records():
+            self._log_service_record(rec)
 
     def _flush_front_records(self):
         """Drain front-door-queued records into the service-wide
@@ -764,6 +852,14 @@ class SweepService:
                     for s in ("admitted", "running", "completed",
                               "failed", "rejected", "preempted")},
                 "iter": int(self.runner.iter),
+                # utilization layer (observe/spans.py): exact
+                # lane-iteration occupancy across every beat so far,
+                # and the per-tenant SLO ledger (achieved turnaround,
+                # violation/burn rates, projection bias vs the
+                # admission EMA) — None until a beat / a terminal
+                # request lands
+                "occupancy": self._occ.summary(),
+                "slo": self._slo.summary(),
             }
 
     def _state_path(self) -> str:
@@ -1101,6 +1197,17 @@ def main(argv=None) -> int:
                         "'config=4' or 'config=all' — the warm lanes "
                         "shard over that many local chips as one "
                         "GSPMD program; empty = single device")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the span tracer (observe/spans.py): "
+                        "request lifetimes + beat/dispatch/consume "
+                        "spans as schema-validated `span` records in "
+                        "metrics.jsonl, and a Perfetto-loadable "
+                        "Chrome-trace file on drain")
+    p.add_argument("--profile-dir", default="",
+                   help="where the Perfetto trace export lands "
+                        "(default <service-dir>/trace); share it with "
+                        "a jax.profiler capture to view host spans "
+                        "alongside device traces")
     args = p.parse_args(argv)
 
     weights = {}
@@ -1120,7 +1227,8 @@ def main(argv=None) -> int:
         socket_path=None if args.no_socket else "",
         allow_inject=args.allow_inject,
         save_fault_results=args.save_fault_results,
-        mesh=args.mesh or None)
+        mesh=args.mesh or None,
+        trace=args.trace, profile_dir=args.profile_dir or None)
 
     def _on_signal(signum, frame):
         service.drain()

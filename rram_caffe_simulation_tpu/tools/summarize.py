@@ -12,9 +12,23 @@ range, loss trajectory endpoints, step latency/throughput, and the
 final fault census.
 
     python -m rram_caffe_simulation_tpu.tools.summarize run.jsonl
+
+Several logs — or a run/service DIRECTORY — merge into one ordered
+digest: per-process replicas of one stream (`metrics_gN.pP.jsonl`,
+the pod layout where every process journals identical bookkeeping)
+collapse to the lowest process's canonical copy, and distinct streams
+(per-group files, a service's `metrics.jsonl`) concatenate in natural
+order. `--timeline` renders the span-tracer view instead (observe/
+spans.py): fleet-wide lane occupancy from the `lane_map` records,
+the per-phase host time breakdown from `span` records, and
+per-request latency percentiles from the `request` lifecycle records.
+
+    python -m rram_caffe_simulation_tpu.tools.summarize <run-dir> --timeline
 """
 import argparse
 import json
+import os
+import re
 
 import numpy as np
 
@@ -171,31 +185,135 @@ def _request_digest(requests):
     return lines
 
 
-def summarize_metrics(path):
-    """One-screen digest of a JSONL metrics log (schema: observe/schema.py
-    / USAGE.md Observability)."""
+def _natural_key(name):
+    """Sort "metrics_g2" before "metrics_g10" (numeric runs compare as
+    numbers, not strings)."""
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", name)]
+
+
+_PROC_RE = re.compile(r"^(?P<stem>.+)\.p(?P<proc>\d+)\.jsonl$")
+
+
+def _expand_metric_paths(paths):
+    """Directories (a sweep run dir, a service dir) expand to their
+    `metrics*.jsonl` streams in natural order; files pass through."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            names = sorted(
+                (f for f in os.listdir(p)
+                 if f.startswith("metrics") and f.endswith(".jsonl")),
+                key=_natural_key)
+            if not names:
+                raise FileNotFoundError(
+                    f"{p}: no metrics*.jsonl streams in directory")
+            out += [os.path.join(p, n) for n in names]
+        else:
+            out.append(p)
+    return out
+
+
+def _read_records(path):
     recs = []
-    retries = []
-    requests = []
-    n_typed = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            if rec.get("type") == "retry":
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def merge_metric_streams(paths):
+    """Fold several metric files into one ordered record list.
+
+    Per-process replicas of one stream (`<stem>.pP.jsonl` — the pod
+    layout, where every process journals identical bookkeeping modulo
+    timing) collapse to the LOWEST process's canonical copy — EXCEPT
+    `span` records, which are process-LOCAL (each process's tracer
+    drains into its own file) and are unioned across every replica so
+    a fleet timeline covers every host. Distinct streams (per-group
+    files, a service log) concatenate in the given order. Returns
+    (records, notes): notes flag collapsed replicas and any replica
+    whose NON-span record count disagrees with its canonical copy
+    (bookkeeping divergence — worth a look, never fatal here; span
+    counts legitimately differ per process)."""
+    groups = {}
+    order = []
+    for p in paths:
+        m = _PROC_RE.match(os.path.basename(p))
+        if m:
+            stem = os.path.join(os.path.dirname(p), m.group("stem"))
+            proc = int(m.group("proc"))
+        else:
+            stem, proc = p, 0
+        if stem not in groups:
+            groups[stem] = {}
+            order.append(stem)
+        groups[stem][proc] = p
+    records, notes = [], []
+    for stem in order:
+        procs = groups[stem]
+        parsed = {pr: _read_records(procs[pr]) for pr in sorted(procs)}
+        lead = min(parsed)
+        merged = list(parsed[lead])
+        if len(parsed) > 1:
+            for pr in sorted(parsed):
+                if pr != lead:
+                    merged += [r for r in parsed[pr]
+                               if r.get("type") == "span"]
+            nonspan = {pr: sum(1 for r in rs
+                               if r.get("type") != "span")
+                       for pr, rs in parsed.items()}
+            base = nonspan[lead]
+            diverged = [pr for pr, c in nonspan.items() if c != base]
+            note = (f"{os.path.basename(stem)}: merged "
+                    f"{len(parsed)} process replicas "
+                    f"(p{lead} canonical; per-process span records "
+                    "unioned)")
+            if diverged:
+                note += (f"; non-span record counts DIVERGE across "
+                         f"processes ({nonspan})")
+            notes.append(note)
+        records.append((stem, merged))
+    return records, notes
+
+
+def _classify(streams):
+    """Split merged stream records into the digest buckets."""
+    recs, retries, requests, spans = [], [], [], []
+    n_typed = 0
+    for _, stream in streams:
+        for rec in stream:
+            rtype = rec.get("type")
+            if rtype == "retry":
                 retries.append(rec)
-                continue
-            if rec.get("type") == "request":
+            elif rtype == "request":
                 requests.append(rec)
-                continue
-            if rec.get("type") is not None:
-                # debug_trace / sentinel records ride the same sink;
-                # the digest summarizes the display-interval metrics
+            elif rtype == "span":
+                spans.append(rec)
+            elif rtype is not None:
+                # debug_trace / sentinel / setup records ride the same
+                # sink; the digest summarizes the display-interval
+                # metrics
                 n_typed += 1
-                continue
-            recs.append(rec)
+            else:
+                recs.append(rec)
+    return recs, retries, requests, spans, n_typed
+
+
+def summarize_metrics(paths):
+    """One-screen digest of one or more JSONL metrics logs (schema:
+    observe/schema.py / USAGE.md Observability). `paths` is a single
+    path or a list; per-process pod replicas collapse and streams
+    concatenate (merge_metric_streams)."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    files = _expand_metric_paths(paths)
+    streams, notes = merge_metric_streams(files)
+    recs, retries, requests, spans, n_typed = _classify(streams)
+    path = files[0] if len(files) == 1 else \
+        f"{len(files)} files, {len(streams)} stream(s)"
     if not recs and requests:
         # a per-request stream (sweep service) carries lifecycle
         # records only — digest those without demanding metrics
@@ -204,10 +322,13 @@ def summarize_metrics(path):
     if not recs:
         return f"{path}: no records"
     first, last = recs[0], recs[-1]
-    lines = [f"Metrics log: {path}",
+    lines = [f"Metrics log: {path}"] + notes + [
              f"Records: {len(recs)} (schema v"
              f"{first.get('schema_version', '?')})",
              f"Iterations: {first.get('iter')} .. {last.get('iter')}"]
+    if spans:
+        lines.append(f"Span records: {len(spans)} "
+                     "(host time spans; --timeline digests them)")
     if n_typed:
         lines.append(f"Deep-trace records: {n_typed} "
                      "(debug_trace/sentinel, not summarized)")
@@ -325,21 +446,161 @@ def summarize_metrics(path):
     return "\n".join(lines)
 
 
+def summarize_timeline(paths):
+    """The span-tracer view of a run/service directory (or explicit
+    files): fleet-wide lane occupancy (exact lane-iteration accounting
+    over every process's `lane_map` records), the per-phase host time
+    breakdown from `span` records, healing/lifecycle instants, and
+    per-request latency percentiles with the projected-vs-achieved
+    comparison the SLO accounting is about."""
+    from ..observe.spans import (OccupancyAggregator,
+                                 latency_percentiles, phase_breakdown)
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    files = _expand_metric_paths(paths)
+    streams, notes = merge_metric_streams(files)
+    recs, retries, requests, spans, _ = _classify(streams)
+    lines = [f"Timeline: {len(files)} file(s), "
+             f"{len(streams)} stream(s)"] + notes
+
+    # --- fleet-wide lane occupancy (ROADMAP item 2's >90 % bar) ---
+    occ = OccupancyAggregator()
+    for _, stream in streams:
+        prev = -1
+        for r in stream:
+            if r.get("type") is not None:
+                continue
+            lmap = r.get("lane_map")
+            it = r.get("iter")
+            if isinstance(lmap, list) and isinstance(it, int):
+                occ.add(lmap, weight=max(it - prev, 1))
+            if isinstance(it, int):
+                prev = it
+    osum = occ.summary()
+    if osum:
+        lines.append(
+            f"Fleet lane occupancy: {osum['occupancy'] * 100:.1f}% "
+            f"({osum['occupied_lane_iters']}/"
+            f"{osum['total_lane_iters']} lane-iters over "
+            f"{osum['beats']} beats, {osum['lanes']} lanes; "
+            f"per-beat min {osum['min_beat_occupancy'] * 100:.0f}% / "
+            f"max {osum['max_beat_occupancy'] * 100:.0f}%)")
+    else:
+        lines.append("Fleet lane occupancy: no lane_map records "
+                     "(not a self-healing sweep)")
+
+    # --- per-phase host time breakdown (span records) ---
+    if spans:
+        real = [s for s in spans if s.get("kind") == "span"]
+        instants = [s for s in spans if s.get("kind") == "instant"]
+        threads = sorted({s.get("thread", "?") for s in spans})
+        procs = sorted({s.get("process", 0) for s in spans})
+        lines.append(f"Spans: {len(real)} spans + {len(instants)} "
+                     f"instants across processes {procs}, threads "
+                     f"{threads}")
+        pb_ = phase_breakdown(spans)
+        # no percent-of-total column: spans NEST ('beat' contains the
+        # runner's dispatch/drain/heal of that step) and threads
+        # overlap by design, so name sums are not a partition of any
+        # wall clock — report absolute seconds against the traced
+        # window instead
+        window = 0.0
+        if real:
+            window = (max(s["wall_time"] + s.get("dur_s", 0.0)
+                          for s in real)
+                      - min(s["wall_time"] for s in real))
+        lines.append(f"Host phase breakdown over a {window:.3f} s "
+                     "traced window (span seconds; spans nest and "
+                     "threads overlap — names do not sum to wall "
+                     "time):")
+        for name, secs in sorted(pb_.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:14s} {secs:10.4f} s")
+        if instants:
+            by_name = {}
+            for s in instants:
+                by_name[s["name"]] = by_name.get(s["name"], 0) + 1
+            lines.append("Instant events: " + ", ".join(
+                f"{v} {k}" for k, v in sorted(by_name.items())))
+    else:
+        lines.append("Spans: none (run without tracing armed)")
+    if retries:
+        by_event = {}
+        for r in retries:
+            by_event.setdefault(r.get("event", "?"), []).append(r)
+        lines.append("Healing events: " + ", ".join(
+            f"{len(v)} {k}" for k, v in sorted(by_event.items())))
+
+    # --- per-request latency percentiles (the SLO-facing numbers) ---
+    terminal = [r for r in requests
+                if r.get("event") in ("completed", "failed")
+                and isinstance(r.get("latency_s"), (int, float))]
+    if terminal:
+        pct = latency_percentiles([r["latency_s"] for r in terminal])
+        lines.append(
+            f"Request latency ({pct['n']} terminal requests): "
+            f"p50 {pct['p50_s']:g} s, p90 {pct['p90_s']:g} s, "
+            f"p99 {pct['p99_s']:g} s, max {pct['max_s']:g} s")
+        by_tenant = {}
+        for r in terminal:
+            by_tenant.setdefault(r.get("tenant", "?"), []).append(r)
+        for tenant in sorted(by_tenant):
+            rs = by_tenant[tenant]
+            tp = latency_percentiles([r["latency_s"] for r in rs])
+            lines.append(f"  tenant {tenant}: n={tp['n']} "
+                         f"p50 {tp['p50_s']:g} s max {tp['max_s']:g} s")
+        proj = [(r["latency_s"], r["projected_s"]) for r in terminal
+                if isinstance(r.get("projected_s"), (int, float))
+                and r["projected_s"] > 0]
+        if proj:
+            bias = float(np.mean([lat / p for lat, p in proj]))
+            lines.append(
+                f"Projected vs achieved ({len(proj)} requests with an "
+                f"admission projection): mean achieved/projected = "
+                f"{bias:.2f}x"
+                + (" (projection flattered the backlog)" if bias > 1
+                   else ""))
+    elif requests:
+        lines.append(f"Requests: {len(requests)} lifecycle records, "
+                     "none terminal with a latency yet")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("prototxt",
-                   help="net prototxt to summarize, or a JSONL metrics "
-                        "log (auto-detected) to digest")
+    p.add_argument("paths", nargs="+", metavar="prototxt|jsonl|dir",
+                   help="net prototxt to summarize, or one or more "
+                        "JSONL metrics logs / run directories "
+                        "(auto-detected) to digest as one merged "
+                        "stream")
     p.add_argument("--phase", default="TRAIN", choices=["TRAIN", "TEST"])
     p.add_argument("--flops", action="store_true",
                    help="add an analytic forward-FLOPs column "
                         "(conv/deconv/inner-product MACs x 2)")
+    p.add_argument("--timeline", action="store_true",
+                   help="render the span-tracer view: fleet lane "
+                        "occupancy, per-phase host time breakdown, "
+                        "and per-request latency percentiles")
     args = p.parse_args(argv)
     from .parse_log import is_jsonl
-    if is_jsonl(args.prototxt):
-        print(summarize_metrics(args.prototxt))
+    # metrics mode needs EVERY input to be a metrics source — a stray
+    # prototxt among several paths must be a usage error, not a
+    # json.loads traceback
+    metricsish = all(os.path.isdir(p_) or is_jsonl(p_)
+                     for p_ in args.paths)
+    if args.timeline:
+        if not metricsish:
+            p.error("--timeline needs JSONL metrics logs or run "
+                    "directories, not a net prototxt")
+        print(summarize_timeline(args.paths))
         return 0
-    net_param = uio.read_net_param(args.prototxt)
+    if metricsish:
+        print(summarize_metrics(args.paths))
+        return 0
+    if len(args.paths) > 1:
+        p.error("multiple inputs must all be JSONL metrics logs or "
+                "run directories (net summarization takes one "
+                "prototxt)")
+    net_param = uio.read_net_param(args.paths[0])
     phase = pb.TRAIN if args.phase == "TRAIN" else pb.TEST
     print(summarize(net_param, phase, flops=args.flops))
     return 0

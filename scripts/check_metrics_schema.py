@@ -207,6 +207,25 @@ SAMPLE_BAD_SENTINEL = {
     "nan": 1, "inf": False, "overflow": False,       # nan not a bool
 }
 
+# host-side time spans (observe/spans.py SpanTracer.drain_records):
+# one per completed span or instant event — the sweep/service
+# lifecycle's wall-clock substrate, linked by `id` for long-lived
+# entities (serve requests)
+SAMPLE_GOOD_SPAN = {
+    "schema_version": 1, "type": "span", "iter": 120,
+    "wall_time": 1722700000.0, "name": "dispatch", "cat": "sweep",
+    "kind": "span", "dur_s": 0.0123, "thread": "dispatcher",
+    "process": 0, "args": {"k": 10},
+}
+
+SAMPLE_BAD_SPAN = {
+    "schema_version": 1, "type": "span", "iter": 120,
+    "wall_time": 1722700000.0, "name": "", "cat": "sweep",
+    "kind": "sideways", "dur_s": -0.5,           # unknown kind,
+    "thread": "dispatcher", "process": -1,       # negative duration,
+    "args": {"k": [1, 2]},                       # empty name, bad pid,
+}                                                # non-scalar arg
+
 # the cold-start breakdown record (cache.py / observe.make_setup_record),
 # including the async-pipeline accounting (async_exec.PipelineStats)
 SAMPLE_GOOD_SETUP = {
@@ -293,6 +312,7 @@ def main(argv=None) -> int:
                           ("retry", SAMPLE_GOOD_RETRY),
                           ("request", SAMPLE_GOOD_REQUEST),
                           ("fault_redraw", SAMPLE_GOOD_FAULT_REDRAW),
+                          ("span", SAMPLE_GOOD_SPAN),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP)):
@@ -309,6 +329,7 @@ def main(argv=None) -> int:
                           ("retry", SAMPLE_BAD_RETRY),
                           ("request", SAMPLE_BAD_REQUEST),
                           ("fault_redraw", SAMPLE_BAD_FAULT_REDRAW),
+                          ("span", SAMPLE_BAD_SPAN),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP)):
@@ -318,7 +339,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (10 good records accepted, 10 bad "
+        print("sample self-check OK (11 good records accepted, 11 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

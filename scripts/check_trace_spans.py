@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""CI guard for sweep-scale tracing (ISSUE 14): arming the span tracer
+must change NOTHING the sweep computes, and what it exports must be
+real — a loadable Chrome-trace/Perfetto timeline and schema-valid
+`span` records whose lifecycle matches the run's.
+
+Four checks:
+
+1. **Tracing is free**: the same tiny LMDB sweep through the real
+   driver (`examples/gaussian_failure/run_1000_sweep.py`) with and
+   without `--trace` — journal group records, final fault-state .npz
+   bytes, sweep_report.json, and the NON-span metric records (timing
+   fields excluded) must be identical; the traced run must emit
+   schema-valid `span` records covering the dispatcher AND consumer
+   threads.
+
+2. **The export is valid Chrome-trace JSON**: `trace/merged.trace.json`
+   parses, every event carries the Chrome-trace required keys, "X"
+   events have non-negative microsecond durations, and the thread
+   metadata distinguishes the dispatcher from the chunk-consumer.
+
+3. **A 2-process pod run merges into ONE timeline** (the acceptance
+   bar): a REAL 2-process gloo cluster with `--trace` produces
+   per-process exports merged into one file carrying BOTH pids, each
+   with dispatcher+consumer thread tracks, and
+   `summarize --timeline <run-dir>` reports the fleet-wide lane
+   occupancy from its merged per-process metric streams.
+
+4. **Every request has a matching closed span**: an in-process
+   `SweepService(trace=True)` run to idle-drain leaves, for every
+   terminal request record, a closed `span` record (cat "request")
+   with that request id — and `summarize --timeline` on the service
+   dir reports per-request latency percentiles.
+
+    python scripts/check_trace_spans.py
+
+Exit status: 0 = all checks hold, 1 = any divergence.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DRIVER = os.path.join(_REPO, "examples", "gaussian_failure",
+                      "run_1000_sweep.py")
+_SCHEMA_PATH = os.path.join(_REPO, "rram_caffe_simulation_tpu",
+                            "observe", "schema.py")
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s",
+                 "wall_seconds", "setup_overlap_seconds",
+                 "host_blocked_seconds", "checkpoint_write_seconds")
+
+ITERS = 60
+CHUNK = 10
+
+
+def _load_schema():
+    spec = importlib.util.spec_from_file_location("_metrics_schema",
+                                                  _SCHEMA_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_db(path: str):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def _write_solver(path: str, db: str, seed: int = 3):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: {seed}
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+failure_pattern {{ type: "gaussian" mean: 300 std: 60 }}
+net_param {{
+  name: "traceguard"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+def _base_args(solver: str, extra=()):
+    return [sys.executable, DRIVER, "--solver", solver,
+            "--configs", "4", "--group", "4", "--block", "0",
+            "--iters", str(ITERS), "--chunk", str(CHUNK),
+            "--mean", "300", "--std", "60", "--pipeline-depth", "2",
+            "--no-overlap"] + list(extra)
+
+
+def _run_single(solver: str, run_dir: str, extra=(), devices: int = 1):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count"
+                         f"={devices}")
+    return subprocess.run(
+        _base_args(solver, extra) + ["--run-dir", run_dir],
+        env=env, capture_output=True, text=True)
+
+
+def _read_jsonl(path: str):
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    return recs
+
+
+def _strip(recs):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in recs]
+
+
+def _summarize_timeline(target: str, failures: list, label: str) -> str:
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "rram_caffe_simulation_tpu.tools.summarize", target,
+         "--timeline"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=_REPO,
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        failures.append(f"{label}: summarize --timeline failed "
+                        f"({r.returncode}):\n{r.stderr[-2000:]}")
+        return ""
+    return r.stdout
+
+
+# ---------------------------------------------------------------------------
+# check 1+2: tracing is free, and the export is valid
+
+
+def _check_tracing_is_free(work: str, solver: str, failures: list):
+    import numpy as np
+    schema = _load_schema()
+    dir_off = os.path.join(work, "run_off")
+    dir_on = os.path.join(work, "run_on")
+    for d, extra in ((dir_off, ()), (dir_on, ("--trace",))):
+        r = _run_single(solver, d, extra)
+        if r.returncode != 0:
+            failures.append(
+                f"driver run {os.path.basename(d)} failed "
+                f"({r.returncode}):\n{r.stdout[-2000:]}\n"
+                f"{r.stderr[-2000:]}")
+            return
+
+    ja = [r for r in _read_jsonl(os.path.join(dir_off, "journal.jsonl"))
+          if r.get("event") == "group"]
+    jb = [r for r in _read_jsonl(os.path.join(dir_on, "journal.jsonl"))
+          if r.get("event") == "group"]
+    if not ja or _strip(ja) != _strip(jb):
+        failures.append("tracing changed the journal group records "
+                        f"(losses/fault census):\n  off: {_strip(ja)!r}"
+                        f"\n  on:  {_strip(jb)!r}")
+    with np.load(os.path.join(dir_off, "group_0_faults.npz")) as za, \
+            np.load(os.path.join(dir_on, "group_0_faults.npz")) as zb:
+        if sorted(za.files) != sorted(zb.files):
+            failures.append("tracing changed the fault npz key set")
+        else:
+            for name in za.files:
+                if za[name].tobytes() != zb[name].tobytes():
+                    failures.append(f"tracing changed fault leaf "
+                                    f"{name!r} (not byte-identical)")
+    ra = json.load(open(os.path.join(dir_off, "sweep_report.json")))
+    rb = json.load(open(os.path.join(dir_on, "sweep_report.json")))
+    if ra != rb:
+        failures.append("tracing changed sweep_report.json")
+
+    ma = _read_jsonl(os.path.join(dir_off, "metrics_g0.jsonl"))
+    mb = _read_jsonl(os.path.join(dir_on, "metrics_g0.jsonl"))
+    spans = [r for r in mb if r.get("type") == "span"]
+    mb_nospan = [r for r in mb if r.get("type") != "span"]
+    if any(r.get("type") == "span" for r in ma):
+        failures.append("untraced run emitted span records")
+    if not spans:
+        failures.append("traced run emitted no span records")
+    if _strip(ma) != _strip(mb_nospan):
+        failures.append(
+            "the non-span record stream differs between traced and "
+            f"untraced runs ({len(ma)} vs {len(mb_nospan)} records)")
+    for rec in spans:
+        errs = schema.validate_record(rec)
+        if errs:
+            failures.append(f"span record fails its schema: {errs}")
+            break
+    threads = {r.get("thread") for r in spans}
+    if not {"dispatcher", "chunk-consumer"} <= threads:
+        failures.append("span records do not cover both the "
+                        f"dispatcher and consumer threads ({threads})")
+    names = {r.get("name") for r in spans}
+    for want in ("dispatch", "consume", "heal"):
+        if want not in names:
+            failures.append(f"no {want!r} span in the traced run "
+                            f"(got {sorted(names)})")
+
+    _check_chrome_trace(os.path.join(dir_on, "trace",
+                                     "merged.trace.json"),
+                        failures, expect_pids={0})
+    if not failures:
+        print(f"trace-free OK: traced run byte-identical to untraced "
+              f"({len(ma)} metric records, {len(spans)} span records, "
+              "valid merged Chrome trace)")
+    return dir_on
+
+
+def _check_chrome_trace(path: str, failures: list, expect_pids):
+    if not os.path.exists(path):
+        failures.append(f"missing Perfetto export {path}")
+        return
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except ValueError as e:
+        failures.append(f"{path} is not valid JSON: {e}")
+        return
+    evs = payload.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        failures.append(f"{path}: traceEvents missing or empty")
+        return
+    pids = set()
+    threads_by_pid: dict = {}
+    for e in evs:
+        for key in ("name", "ph", "pid", "tid", "ts") \
+                if e.get("ph") != "M" else ("name", "ph", "pid"):
+            if key not in e:
+                failures.append(f"{path}: event missing {key!r}: {e!r}")
+                return
+        pids.add(e["pid"])
+        if e.get("ph") == "X" and e.get("dur", 0) < 0:
+            failures.append(f"{path}: negative X duration: {e!r}")
+            return
+        if e.get("ph") == "M" and e["name"] == "thread_name":
+            threads_by_pid.setdefault(e["pid"], set()).add(
+                e["args"]["name"])
+    if pids != set(expect_pids):
+        failures.append(f"{path}: expected pids {sorted(expect_pids)}, "
+                        f"got {sorted(pids)}")
+    for pid in expect_pids:
+        have = threads_by_pid.get(pid, set())
+        if not {"dispatcher", "chunk-consumer"} <= have:
+            failures.append(
+                f"{path}: process {pid} does not distinguish the "
+                f"dispatcher and consumer threads ({sorted(have)})")
+
+
+# ---------------------------------------------------------------------------
+# check 3: 2-process pod run -> one merged timeline + fleet occupancy
+
+
+def _check_pod_merged_timeline(work: str, solver: str, failures: list):
+    run_dir = os.path.join(work, "run_pod")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    procs = [subprocess.Popen(
+        _base_args(solver, ("--trace",))
+        + ["--run-dir", run_dir, "--coordinator", coord,
+           "--num-processes", "2", "--process-id", str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            failures.append("pod trace run timed out")
+            return
+        logs.append(out)
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            failures.append(f"pod trace process {i} exited "
+                            f"{p.returncode}:\n{logs[i][-2000:]}")
+    if failures:
+        return
+    tdir = os.path.join(run_dir, "trace")
+    for f in ("spans.p0.trace.json", "spans.p1.trace.json",
+              "merged.trace.json"):
+        if not os.path.exists(os.path.join(tdir, f)):
+            failures.append(f"pod trace run missing trace/{f}")
+    if failures:
+        return
+    _check_chrome_trace(os.path.join(tdir, "merged.trace.json"),
+                        failures, expect_pids={0, 1})
+    out = _summarize_timeline(run_dir, failures, "pod timeline")
+    if out and "Fleet lane occupancy:" not in out:
+        failures.append("summarize --timeline did not report fleet "
+                        f"lane occupancy:\n{out[:2000]}")
+    if out and "merged 2 process replicas" not in out:
+        failures.append("summarize --timeline did not merge the "
+                        f"per-process metric streams:\n{out[:2000]}")
+    if not failures:
+        print("pod timeline OK: 2-process run merged into one "
+              "Perfetto trace (both pids, dispatcher+consumer "
+              "threads) and summarize --timeline reports fleet "
+              "occupancy")
+
+
+# ---------------------------------------------------------------------------
+# check 4: every request record has a matching closed span
+
+
+def _check_request_spans(work: str, failures: list):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    from rram_caffe_simulation_tpu.serve.service import SweepService
+    schema = _load_schema()
+    root = os.path.join(work, "serve")
+    os.makedirs(root, exist_ok=True)
+    db = os.path.join(root, "db")
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(db) as w:
+        for i in range(16):
+            img = rng.randint(0, 255, (1, 6, 6), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+    solver = os.path.join(root, "solver.prototxt")
+    _write_solver(solver, db, seed=3)
+    svc_dir = os.path.join(root, "svc")
+    svc = SweepService(solver, svc_dir, lanes=4, chunk=4,
+                       default_iters=4, socket_path=None,
+                       slo_seconds=300.0, trace=True)
+    try:
+        svc.submit({"id": "r-1", "tenant": "alice",
+                    "configs": [{"mean": 300, "std": 60}], "iters": 4})
+        svc.submit({"id": "r-2", "tenant": "bob",
+                    "configs": [{"mean": 320, "std": 50}], "iters": 8})
+        code = svc.serve(drain_when_idle=True)
+        stats = svc.stats()
+    finally:
+        svc.close()
+    if code != 0:
+        failures.append(f"serve run exited {code}, expected 0")
+    recs = _read_jsonl(os.path.join(svc_dir, "metrics.jsonl"))
+    for rec in recs:
+        errs = schema.validate_record(rec)
+        if errs:
+            failures.append(f"service record fails schema: {errs}")
+            break
+    requests = [r for r in recs if r.get("type") == "request"]
+    terminal = {r["request"] for r in requests
+                if r.get("event") in ("completed", "failed",
+                                      "rejected")}
+    if not terminal:
+        failures.append("serve run produced no terminal requests "
+                        "(vacuous check)")
+    req_spans = [r for r in recs if r.get("type") == "span"
+                 and r.get("cat") == "request"
+                 and r.get("kind") == "span"]
+    for rid in sorted(terminal):
+        if not any(s.get("id") == rid for s in req_spans):
+            failures.append(f"request {rid} reached a terminal record "
+                            "but has no matching closed span")
+    if not (stats.get("slo") or {}).get("_total"):
+        failures.append("stats() carries no SLO ledger after "
+                        "terminal requests")
+    if not stats.get("occupancy"):
+        failures.append("stats() carries no occupancy rollup after "
+                        "worked beats")
+    out = _summarize_timeline(svc_dir, failures, "serve timeline")
+    if out and "Request latency" not in out:
+        failures.append("summarize --timeline did not report request "
+                        f"latency percentiles:\n{out[:2000]}")
+    if not failures:
+        print(f"request spans OK: {len(terminal)} terminal requests "
+              "each matched by a closed span; SLO ledger + occupancy "
+              "in stats(); timeline digest reports latency "
+              "percentiles")
+
+
+def main() -> int:
+    failures: list = []
+    work = tempfile.mkdtemp(prefix="trace_spans_guard_")
+    try:
+        db = os.path.join(work, "db")
+        _build_db(db)
+        solver = os.path.join(work, "solver.prototxt")
+        _write_solver(solver, db)
+        _check_tracing_is_free(work, solver, failures)
+        if not failures:
+            _check_pod_merged_timeline(work, solver, failures)
+        if not failures:
+            _check_request_spans(work, failures)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    if failures:
+        print("check_trace_spans FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("check_trace_spans OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

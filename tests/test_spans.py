@@ -1,0 +1,584 @@
+"""Span tracing + utilization layer (observe/spans.py, ISSUE 14):
+tracer semantics (nesting, thread-safety, ring overflow, async spans),
+the schema-validated `span` record type, the Perfetto/Chrome-trace
+export structure, zero-overhead-when-disabled on a real sweep (the
+non-span record stream and the trained state are identical), the
+occupancy aggregator and SLO burn-rate math against hand-computed
+sequences, the multi-stream summarize merge + --timeline digest, and
+the buffered-sink atexit flush (crash post-mortems keep the tail
+records). The end-to-end driver/2-process contract is CI-guarded by
+scripts/check_trace_spans.py."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rram_caffe_simulation_tpu import async_exec
+from rram_caffe_simulation_tpu.observe import spans as obs_spans
+from rram_caffe_simulation_tpu.observe.schema import validate_record
+from rram_caffe_simulation_tpu.observe.sink import JsonlSink
+from rram_caffe_simulation_tpu.parallel import SweepRunner
+from rram_caffe_simulation_tpu.tools import summarize as summ
+
+from test_fault import fault_solver
+
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s")
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+# ---------------------------------------------------------------------------
+# tracer semantics
+
+
+def test_span_nesting_and_record_shape():
+    tr = obs_spans.SpanTracer(process_index=2)
+    tr.set_thread_role("dispatcher")
+    with tr.span("outer", iteration=3, args={"k": 4}):
+        time.sleep(0.002)
+        with tr.span("inner", cat="host"):
+            time.sleep(0.001)
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["inner", "outer"]  # close order
+    inner, outer = evs
+    # nesting: the inner span lies inside the outer's [t, t+dur]
+    assert outer["t"] <= inner["t"]
+    assert inner["t"] + inner["dur"] <= outer["t"] + outer["dur"] + 1e-6
+    assert outer["dur"] >= inner["dur"]
+    assert outer["thread"] == "dispatcher"
+    recs = tr.drain_records()
+    assert len(recs) == 2
+    for rec in recs:
+        assert validate_record(rec) == []
+        assert rec["process"] == 2
+    assert recs[1]["name"] == "outer"
+    assert recs[1]["iter"] == 3
+    assert recs[1]["args"] == {"k": 4}
+    # the cursor: a second drain emits nothing, new events only
+    assert tr.drain_records() == []
+    tr.instant("reseed", cat="healing")
+    more = tr.drain_records()
+    assert [r["name"] for r in more] == ["reseed"]
+    assert more[0]["kind"] == "instant" and more[0]["dur_s"] == 0.0
+
+
+def test_tracer_thread_safety_and_roles():
+    tr = obs_spans.SpanTracer()
+    n_threads, n_each = 4, 200
+    errs = []
+
+    def work(i):
+        try:
+            for j in range(n_each):
+                with tr.span(f"w{i}", iteration=j):
+                    pass
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,), name=f"t{i}")
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    evs = tr.events()
+    assert len(evs) == n_threads * n_each
+    # unnamed-role threads report their threading name
+    assert {e["thread"] for e in evs} == {f"t{i}"
+                                          for i in range(n_threads)}
+    # seqs are unique and monotone (the drain cursor depends on it)
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_ring_overflow_drops_oldest_and_counts():
+    tr = obs_spans.SpanTracer(capacity=10)
+    for i in range(25):
+        tr.instant(f"e{i}")
+    evs = tr.events()
+    assert len(evs) == 10
+    assert [e["name"] for e in evs] == [f"e{i}" for i in range(15, 25)]
+    assert tr.dropped == 15
+
+
+def test_drain_after_ring_overflow():
+    """The drain cursor walks the undrained SUFFIX only; events the
+    ring dropped before a drain are simply gone (counted in dropped),
+    and a drain right after overflow emits exactly the survivors."""
+    tr = obs_spans.SpanTracer(capacity=8)
+    for i in range(4):
+        tr.instant(f"a{i}")
+    assert [r["name"] for r in tr.drain_records()] \
+        == [f"a{i}" for i in range(4)]
+    for i in range(12):          # overflows: drops a0..a3 + b0..b3
+        tr.instant(f"b{i}")
+    recs = tr.drain_records()
+    assert [r["name"] for r in recs] == [f"b{i}" for i in range(4, 12)]
+    assert tr.dropped == 8
+    assert tr.drain_records() == []
+
+
+def test_summarize_rejects_mixed_multi_path_inputs(tmp_path):
+    """A stray prototxt among several inputs is a usage error, not a
+    json.loads traceback (net summarization takes exactly one)."""
+    proto = tmp_path / "net.prototxt"
+    proto.write_text('name: "n"\n')
+    with pytest.raises(SystemExit) as e:
+        summ.main([str(proto), str(proto)])
+    assert e.value.code == 2          # argparse usage error
+
+
+def test_async_span_links_by_id():
+    tr = obs_spans.SpanTracer()
+    tr.async_begin("request", id="r-7", iteration=1,
+                   args={"tenant": "a"})
+    assert tr.open_async() == [("request", "request", "r-7")]
+    time.sleep(0.002)
+    tr.async_end("request", id="r-7", iteration=9,
+                 args={"event": "completed"})
+    assert tr.open_async() == []
+    (ev,) = tr.events()
+    assert ev["id"] == "r-7" and ev["dur"] >= 0.002
+    assert ev["args"] == {"tenant": "a", "event": "completed"}
+    rec = tr.drain_records()[0]
+    assert rec["id"] == "r-7"
+    assert validate_record(rec) == []
+    # an end with no begin still records the terminal transition
+    tr.async_end("request", id="orphan")
+    (ev2,) = [e for e in tr.events() if e.get("id") == "orphan"]
+    assert ev2["dur"] == 0.0
+
+
+def test_span_record_schema_good_and_bad():
+    good = {"schema_version": 1, "type": "span", "iter": 10,
+            "wall_time": 1722700000.0, "name": "dispatch",
+            "cat": "sweep", "kind": "span", "dur_s": 0.01,
+            "thread": "dispatcher", "process": 0, "args": {"k": 5}}
+    assert validate_record(good) == []
+    bad = dict(good, kind="sideways", dur_s=-1.0, name="",
+               process=-2, args={"k": [1, 2]})
+    errs = validate_record(bad)
+    assert any("unknown kind" in e for e in errs)
+    assert any("dur_s" in e for e in errs)
+    assert any("name" in e for e in errs)
+    assert any("process" in e for e in errs)
+    assert any("args" in e for e in errs)
+    # an instant with a nonzero duration is an emission bug
+    errs = validate_record(dict(good, kind="instant", dur_s=0.5))
+    assert any("instant" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome-trace export
+
+
+def test_chrome_trace_golden_structure(tmp_path):
+    tr = obs_spans.SpanTracer(process_index=1)
+    tr.set_thread_role("dispatcher")
+    with tr.span("dispatch", iteration=5):
+        pass
+    tr.instant("reseed", cat="healing", iteration=6)
+    tr.async_begin("request", id="r-1")
+    tr.async_end("request", id="r-1")
+    tr.async_begin("request", id="r-open")   # left open (drained svc)
+    path = tr.write_chrome_trace(str(tmp_path / "t.trace.json"))
+    with open(path) as f:
+        payload = json.load(f)
+    assert set(payload) == {"traceEvents", "displayTimeUnit"}
+    evs = payload["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    pname = next(e for e in meta if e["name"] == "process_name")
+    assert pname["pid"] == 1 and pname["args"]["name"] == "sweep p1"
+    tnames = [e["args"]["name"] for e in meta
+              if e["name"] == "thread_name"]
+    assert "dispatcher" in tnames
+    (x,) = [e for e in evs if e["ph"] == "X"]
+    assert x["name"] == "dispatch" and x["pid"] == 1
+    assert x["dur"] >= 0 and x["ts"] > 0
+    assert x["args"]["iter"] == 5
+    (i,) = [e for e in evs if e["ph"] == "i"]
+    assert i["name"] == "reseed" and i["s"] == "t"
+    bs = [e for e in evs if e["ph"] == "b"]
+    es = [e for e in evs if e["ph"] == "e"]
+    assert {b["id"] for b in bs} == {"r-1", "r-open"}
+    assert [e["id"] for e in es] == ["r-1"]     # open span: "b" only
+
+
+def test_merge_chrome_traces(tmp_path):
+    paths = []
+    for pid in (0, 1):
+        tr = obs_spans.SpanTracer(process_index=pid)
+        with tr.span("dispatch"):
+            pass
+        paths.append(tr.write_chrome_trace(
+            str(tmp_path / f"spans.p{pid}.trace.json")))
+    out = obs_spans.merge_chrome_traces(
+        paths, str(tmp_path / "merged.trace.json"))
+    with open(out) as f:
+        merged = json.load(f)["traceEvents"]
+    assert {e["pid"] for e in merged} == {0, 1}
+    xs = [e for e in merged if e.get("ph") == "X"]
+    assert len(xs) == 2
+
+
+# ---------------------------------------------------------------------------
+# utilization layer math
+
+
+def test_occupancy_aggregator_exact():
+    occ = obs_spans.OccupancyAggregator()
+    # hand-computed: beat 1: 2/4 lanes for 10 iters = 20/40;
+    # beat 2: 4/4 for 5 iters = 20/20; beat 3: 1/4 for 1 iter = 1/4
+    occ.add([0, 3, -1, -1], weight=10)
+    occ.add([0, 3, 7, 9], weight=5)
+    occ.add([-1, -1, 5, -1])
+    s = occ.summary()
+    assert s["beats"] == 3 and s["lanes"] == 4
+    assert s["occupied_lane_iters"] == 20 + 20 + 1
+    assert s["total_lane_iters"] == 40 + 20 + 4
+    assert s["occupancy"] == round(41 / 64, 4)
+    assert s["min_beat_occupancy"] == 0.25
+    assert s["max_beat_occupancy"] == 1.0
+    assert obs_spans.OccupancyAggregator().summary() is None
+
+
+def test_slo_burn_rate_math():
+    slo = obs_spans.SloAccountant(slo_seconds=10.0)
+    slo.record("a", 5.0, projected_s=4.0)    # ratio 1.25
+    slo.record("a", 15.0, projected_s=20.0)  # ratio 0.75, violation
+    slo.record("b", 2.0)                     # no projection
+    s = slo.summary()
+    a = s["a"]
+    assert a["requests"] == 2
+    assert a["mean_latency_s"] == 10.0
+    assert a["violations"] == 1 and a["violation_rate"] == 0.5
+    assert a["burn_rate"] == 1.0             # mean(latency)/slo
+    assert a["projection_bias"] == 1.0       # (1.25 + 0.75) / 2
+    b = s["b"]
+    assert b["burn_rate"] == 0.2 and "projection_bias" not in b
+    t = s["_total"]
+    assert t["requests"] == 3 and t["max_latency_s"] == 15.0
+    assert t["violation_rate"] == round(1 / 3, 4)
+    assert obs_spans.SloAccountant().summary() is None
+
+
+def test_latency_percentiles_nearest_rank():
+    vals = list(range(1, 101))            # 1..100
+    p = obs_spans.latency_percentiles(vals)
+    assert (p["p50_s"], p["p90_s"], p["p99_s"], p["max_s"]) \
+        == (50.0, 90.0, 99.0, 100.0)
+    p = obs_spans.latency_percentiles([7.0])
+    assert p == {"n": 1, "p50_s": 7.0, "p90_s": 7.0, "p99_s": 7.0,
+                 "max_s": 7.0}
+    assert obs_spans.latency_percentiles([]) is None
+
+
+def test_bench_phase_breakdown_buckets():
+    events = [
+        {"kind": "span", "name": "dispatch", "thread": "dispatcher",
+         "dur": 1.0},
+        {"kind": "span", "name": "submit_wait", "thread": "dispatcher",
+         "dur": 0.25},
+        {"kind": "span", "name": "drain", "thread": "dispatcher",
+         "dur": 0.25},
+        {"kind": "span", "name": "consume", "thread": "dispatcher",
+         "dur": 0.5},                       # sync: dispatcher-blocked
+        {"kind": "span", "name": "consume", "thread": "chunk-consumer",
+         "dur": 2.0},                       # pipelined: overlapped
+        {"kind": "span", "name": "checkpoint", "thread": "dispatcher",
+         "dur": 0.125},
+        {"kind": "span", "name": "write", "thread": "snapshot-writer",
+         "dur": 0.125},
+        {"kind": "span", "name": "group_build",
+         "thread": "group-prefetch", "dur": 3.0},
+    ]
+    pb = obs_spans.bench_phase_breakdown(events)
+    assert pb == {"dispatch_seconds": 1.0,
+                  "host_blocked_seconds": 1.0,     # 0.25+0.25+0.5
+                  "consumer_thread_seconds": 2.0,
+                  "checkpoint_seconds": 0.25,      # checkpoint+write
+                  "prefetch_seconds": 3.0}
+
+
+def test_caffe_log_sink_renders_span_records(tmp_path):
+    from rram_caffe_simulation_tpu.observe.sink import CaffeLogSink
+    path = str(tmp_path / "c.log")
+    sink = CaffeLogSink(path, unbuffered=True)
+    sink.write(obs_spans.make_span_record(
+        {"kind": "span", "name": "dispatch", "cat": "sweep",
+         "t": 1e9, "dur": 0.0123, "thread": "dispatcher", "iter": 7}))
+    sink.write(obs_spans.make_span_record(
+        {"kind": "instant", "name": "reseed", "cat": "healing",
+         "t": 1e9, "dur": 0.0, "thread": "dispatcher", "iter": 8,
+         "id": "r-1"}))
+    sink.close()
+    text = open(path).read()
+    assert "Span sweep/dispatch [dispatcher]: 0.0123 s (iteration 7)" \
+        in text
+    assert "Span healing/reseed [dispatcher] at iteration 8 id=r-1" \
+        in text
+
+
+def test_phase_breakdown_sums_by_name_and_thread():
+    events = [
+        {"kind": "span", "name": "dispatch", "thread": "d", "dur": 1.0},
+        {"kind": "span", "name": "dispatch", "thread": "d", "dur": 0.5},
+        {"kind": "span", "name": "consume", "thread": "c", "dur": 2.0},
+        {"kind": "instant", "name": "reseed", "thread": "d", "dur": 0.0},
+        # span JSONL records (dur_s) mix in transparently
+        {"kind": "span", "name": "consume", "thread": "d", "dur_s": 0.25},
+    ]
+    assert obs_spans.phase_breakdown(events) == {
+        "dispatch": 1.5, "consume": 2.25}
+    by = obs_spans.phase_breakdown(events, by_thread=True)
+    assert by == {("dispatch", "d"): 1.5, ("consume", "c"): 2.0,
+                  ("consume", "d"): 0.25}
+
+
+# ---------------------------------------------------------------------------
+# sweep integration: spans on, byte-identity off
+
+
+def _sweep(tmp_path, depth=2, traced=False, trace_dir=None):
+    s = fault_solver(tmp_path, mean=250.0, std=30.0)
+    sink = ListSink()
+    s.enable_metrics(sink)
+    r = SweepRunner(s, n_configs=3, pipeline_depth=depth)
+    tracer = None
+    if traced:
+        tracer = r.enable_tracing(profile_dir=trace_dir)
+    r.enable_self_healing(budget=8, max_retries=1)
+    while not r.healing_complete():
+        r.step(4, chunk=2)
+    return r, sink, tracer
+
+
+def _strip(recs):
+    return [{k: v for k, v in r.items() if k not in TIMING_FIELDS}
+            for r in recs]
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_tracing_zero_overhead_when_disabled(tmp_path, depth):
+    """The acceptance contract: arming the tracer changes NOTHING the
+    device computes — losses and fault leaves byte-identical, the
+    non-span record stream identical (timing fields excluded), and an
+    untraced run emits no span records at all."""
+    ra, sink_a, tracer = _sweep(tmp_path / "on", depth, traced=True)
+    rb, sink_b, _ = _sweep(tmp_path / "off", depth, traced=False)
+    assert not any(x.get("type") == "span" for x in sink_b.records)
+    spans = [x for x in sink_a.records if x.get("type") == "span"]
+    assert spans, "traced run emitted no span records"
+    for rec in spans:
+        assert validate_record(rec) == []
+    a = _strip([x for x in sink_a.records if x.get("type") != "span"])
+    b = _strip(sink_b.records)
+    assert a == b
+    import jax
+    for xa, xb in zip(jax.tree.leaves(ra.fault_states),
+                      jax.tree.leaves(rb.fault_states)):
+        assert np.asarray(xa).tobytes() == np.asarray(xb).tobytes()
+    ra.close()
+    rb.close()
+
+
+def test_sweep_spans_cover_both_threads_and_export(tmp_path):
+    r, sink, tracer = _sweep(tmp_path, depth=2, traced=True,
+                             trace_dir=str(tmp_path / "prof"))
+    ck = r.checkpoint(str(tmp_path / "ck.npz"))
+    r.close()     # writes the Perfetto file
+    spans = [x for x in sink.records if x.get("type") == "span"]
+    names = {x["name"] for x in spans}
+    assert {"dispatch", "consume", "drain", "heal",
+            "checkpoint"} <= names
+    threads = {x["thread"] for x in spans}
+    assert {"dispatcher", "chunk-consumer"} <= threads
+    ck_span = next(x for x in spans if x["name"] == "checkpoint")
+    assert ck_span["args"]["path"] == os.path.basename(ck)
+    path = tmp_path / "prof" / "spans.p0.trace.json"
+    assert path.exists()
+    payload = json.loads(path.read_text())
+    assert any(e.get("ph") == "X" for e in payload["traceEvents"])
+
+
+def test_ordered_consumer_and_writer_spans(tmp_path):
+    tr = obs_spans.SpanTracer()
+    seen = []
+    c = async_exec.OrderedConsumer(seen.append, depth=2)
+    c.tracer = tr
+    c.span_name = "consume"
+    for i in range(3):
+        c.submit(i)
+    c.drain()
+    c.close()
+    assert seen == [0, 1, 2]
+    assert [e["name"] for e in tr.events()] == ["consume"] * 3
+    assert {e["thread"] for e in tr.events()} == {"chunk-consumer"}
+    w = async_exec.BackgroundWriter()
+    w.tracer = tr
+    w.submit(str(tmp_path / "x.bin"),
+             lambda tmp: open(tmp, "wb").write(b"hi"))
+    w.wait()
+    w.close()
+    writes = [e for e in tr.events() if e["name"] == "write"]
+    assert len(writes) == 1
+    assert writes[0]["thread"] == "snapshot-writer"
+
+
+# ---------------------------------------------------------------------------
+# summarize: stream merge + timeline
+
+
+def _write_jsonl(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _mrec(it, lane_map=None, loss=0.5):
+    rec = {"schema_version": 1, "iter": it, "wall_time": 1e9 + it,
+           "loss": loss, "lr": 0.01, "step_latency_s": 0.01,
+           "iters_per_s": 100.0}
+    if lane_map is not None:
+        rec["lane_map"] = lane_map
+    return rec
+
+
+def test_merge_metric_streams_collapses_pod_replicas(tmp_path):
+    d = tmp_path
+    _write_jsonl(d / "metrics_g0.p0.jsonl", [_mrec(9), _mrec(19)])
+    _write_jsonl(d / "metrics_g0.p1.jsonl", [_mrec(9), _mrec(19)])
+    _write_jsonl(d / "metrics_g1.p0.jsonl", [_mrec(9)])
+    _write_jsonl(d / "metrics_g1.p1.jsonl", [_mrec(9)])
+    files = summ._expand_metric_paths([str(d)])
+    streams, notes = summ.merge_metric_streams(files)
+    # two streams (g0, g1), replicas collapsed to p0's copy
+    assert [len(recs) for _, recs in streams] == [2, 1]
+    assert len(notes) == 2
+    assert all("2 process replicas" in n for n in notes)
+    digest = summ.summarize_metrics([str(d)])
+    assert "2 stream(s)" in digest
+    assert "Records: 3" in digest
+
+
+def test_merge_unions_process_local_spans(tmp_path):
+    """Span records are PROCESS-local (each tracer drains into its own
+    file): the replica collapse must keep the canonical bookkeeping
+    once but union spans from every process, or a fleet timeline
+    silently shows process 0 only."""
+    def span_rec(proc, dur):
+        return obs_spans.make_span_record(
+            {"kind": "span", "name": "dispatch", "cat": "sweep",
+             "t": 1e9, "dur": dur, "thread": "dispatcher", "iter": 9},
+            process_index=proc)
+    _write_jsonl(tmp_path / "metrics_g0.p0.jsonl",
+                 [_mrec(9), span_rec(0, 1.0)])
+    _write_jsonl(tmp_path / "metrics_g0.p1.jsonl",
+                 [_mrec(9), span_rec(1, 2.0)])
+    streams, notes = summ.merge_metric_streams(
+        summ._expand_metric_paths([str(tmp_path)]))
+    (_, recs), = streams
+    spans = [r for r in recs if r.get("type") == "span"]
+    assert {s["process"] for s in spans} == {0, 1}
+    assert sum(1 for r in recs if r.get("type") != "span") == 1
+    assert any("span records unioned" in n for n in notes)
+    out = summ.summarize_timeline([str(tmp_path)])
+    assert "processes [0, 1]" in out
+    # both processes' dispatch seconds aggregate (1.0 + 2.0)
+    assert "dispatch           3.0000 s" in out
+
+
+def test_expand_orders_groups_naturally(tmp_path):
+    for gi in (0, 2, 10):
+        _write_jsonl(tmp_path / f"metrics_g{gi}.jsonl", [_mrec(gi)])
+    files = summ._expand_metric_paths([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == [
+        "metrics_g0.jsonl", "metrics_g2.jsonl", "metrics_g10.jsonl"]
+
+
+def test_summarize_timeline_digest(tmp_path):
+    recs = [
+        _mrec(9, lane_map=[0, 1, -1, -1]),     # 10 iters at 2/4
+        _mrec(19, lane_map=[0, 1, 2, 3]),      # 10 iters at 4/4
+        obs_spans.make_span_record(
+            {"kind": "span", "name": "dispatch", "cat": "sweep",
+             "t": 1e9, "dur": 1.5, "thread": "dispatcher", "iter": 9}),
+        obs_spans.make_span_record(
+            {"kind": "span", "name": "consume", "cat": "host",
+             "t": 1e9, "dur": 0.5, "thread": "chunk-consumer",
+             "iter": 9}),
+        obs_spans.make_span_record(
+            {"kind": "instant", "name": "reseed", "cat": "healing",
+             "t": 1e9, "dur": 0.0, "thread": "dispatcher", "iter": 12}),
+        {"schema_version": 1, "type": "request", "iter": 19,
+         "wall_time": 1e9, "request": "r-1", "tenant": "alice",
+         "event": "completed", "latency_s": 4.0, "projected_s": 2.0},
+        {"schema_version": 1, "type": "request", "iter": 19,
+         "wall_time": 1e9, "request": "r-2", "tenant": "bob",
+         "event": "failed", "latency_s": 8.0},
+    ]
+    _write_jsonl(tmp_path / "metrics.jsonl", recs)
+    out = summ.summarize_timeline([str(tmp_path / "metrics.jsonl")])
+    # occupancy: (2*10 + 4*10) / (4*10 + 4*10) = 60/80 = 75%
+    assert "Fleet lane occupancy: 75.0% (60/80 lane-iters" in out
+    assert "dispatch" in out and "75.0%" in out
+    assert "1 reseed" in out
+    # latency percentiles over [4, 8]
+    assert "Request latency (2 terminal requests)" in out
+    assert "p50 4 s" in out and "max 8 s" in out
+    assert "tenant alice" in out and "tenant bob" in out
+    # projected-vs-achieved: 4/2 = 2x
+    assert "mean achieved/projected = 2.00x" in out
+
+
+# ---------------------------------------------------------------------------
+# buffered-sink atexit flush (crash post-mortems keep the tail)
+
+
+def test_jsonl_sink_atexit_flush_registered(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(path, flush_every=64)
+    sink.write({"iter": 0})
+    # buffered: nothing on disk yet
+    assert open(path).read() == ""
+    # the registered atexit callback flushes the tail
+    sink._atexit_cb()
+    assert len(open(path).read().splitlines()) == 1
+    sink.close()
+    # close unregisters: the callback is now a no-op on a closed file
+    sink._atexit_cb()
+
+
+@pytest.mark.slow
+def test_buffered_sink_survives_unhandled_exception(tmp_path):
+    """End to end: a process that buffers records and dies on an
+    unhandled exception still lands every record (the atexit flush) —
+    the crash-post-mortem contract."""
+    path = str(tmp_path / "crash.jsonl")
+    code = (
+        "from rram_caffe_simulation_tpu.observe.sink import JsonlSink\n"
+        f"s = JsonlSink({path!r}, flush_every=1000)\n"
+        "for i in range(5):\n"
+        "    s.write({'iter': i})\n"
+        "raise RuntimeError('boom')\n")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode != 0 and "boom" in r.stderr
+    lines = open(path).read().splitlines()
+    assert [json.loads(x)["iter"] for x in lines] == [0, 1, 2, 3, 4]
