@@ -58,6 +58,7 @@ def _on_event(name: str, **kw):
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None,
+                             min_compile_time_s: Optional[float] = None,
                              ) -> Optional[str]:
     """Wire the persistent XLA compilation cache to
     `<cache_dir>/xla` (cache_dir resolved via `resolve_cache_dir`).
@@ -68,11 +69,31 @@ def enable_compilation_cache(cache_dir: Optional[str] = None,
     the CLI, benches). An EXPLICIT directory (CLI flag) is latched:
     later bare calls — e.g. Solver.__init__'s env-var hook — keep it
     rather than demoting to the env var, so `--cache-dir` wins for the
-    whole process as its help text promises. Min-compile-time/size
-    thresholds are zeroed so even millisecond-scale step functions
-    (tiny CI nets) persist — the whole point is that NO second compile
-    of the same program ever happens on this machine."""
+    whole process as its help text promises. By default the
+    min-compile-time/size thresholds are zeroed so even
+    millisecond-scale step functions (tiny CI nets) persist — the
+    whole point is that NO second compile of the same program ever
+    happens on this machine. An EXPLICIT `min_compile_time_s` is
+    latched like the directory (later bare calls keep it): fleet
+    workers pass 0.05 s to keep eager tiny-op executables OUT of the
+    cache, because deserializing the swarm of sub-millisecond
+    eager-primitive entries the zeroed threshold admits intermittently
+    SEGFAULTS on this jaxlib (faulthandler pinned it to
+    apply_primitive on a convert_element_type hit; the fleet guard's
+    swap machinery found it). The chunk executables that matter for
+    the hot-swap-as-cache-hit contract compile far above 0.05 s, and
+    eager ops recompile fresh in microseconds."""
     if not cache_dir and _state["explicit"] and _state["dir"]:
+        if min_compile_time_s is not None:
+            # the dir is latched but an explicit threshold still
+            # applies — dropping it here would silently re-admit the
+            # eager tiny-op entries the caller is guarding against
+            with _lock:
+                _state["min_compile_time_s"] = float(min_compile_time_s)
+            import jax
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(min_compile_time_s))
         return _state["dir"]
     d = resolve_cache_dir(cache_dir)
     if d is None:
@@ -82,9 +103,15 @@ def enable_compilation_cache(cache_dir: Optional[str] = None,
     os.makedirs(xla_dir, exist_ok=True)
     with _lock:
         changed = _state["dir"] != d
+        if min_compile_time_s is not None:
+            # explicit threshold latches, like the explicit dir — a
+            # later bare call (Solver.__init__) must not demote a
+            # fleet worker's 0.05 s back to the zeroed default
+            _state["min_compile_time_s"] = float(min_compile_time_s)
         jax.config.update("jax_enable_compilation_cache", True)
         jax.config.update("jax_compilation_cache_dir", xla_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          _state.get("min_compile_time_s", 0.0))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         if changed:
             # JAX latches its cache-in-use decision at the FIRST compile
@@ -107,6 +134,51 @@ def cache_dir() -> Optional[str]:
     """The active cache root (None until enable_compilation_cache
     succeeds)."""
     return _state["dir"]
+
+
+def clone_cache(src_root: str, dst_root: str) -> int:
+    """Snapshot a warm cache root into a PRIVATE one by hard-linking
+    every completed entry (`xla/` executables + `datasets/` decoded
+    arrays). Returns the number of entries linked.
+
+    Why this exists: N live jax processes sharing ONE persistent
+    compilation cache is unsafe — concurrent compile/deserialize
+    activity against the shared directory intermittently yields
+    corrupt executables (observed on the CPU backend as garbage
+    numerics, SIGSEGV, and glibc heap-corruption aborts; the fleet
+    guard's isolation bisect pinned it: 3/3 clean without the shared
+    cache, 3/3 corrupt with it). A fleet worker therefore snapshots
+    the shared warm cache at startup and points jax at its own copy:
+    hits (and the hot-swap-as-cache-hit contract) survive, while no
+    two live processes ever touch the same cache files. Hard links
+    make the snapshot O(entries) metadata work — entries are
+    immutable and writers replace via temp-file + rename, which
+    breaks links instead of mutating shared bytes. In-flight temp
+    files are skipped."""
+    linked = 0
+    for sub in ("xla", "datasets"):
+        src = os.path.join(src_root, sub)
+        if not os.path.isdir(src):
+            continue
+        for dirpath, _dirs, files in os.walk(src):
+            rel = os.path.relpath(dirpath, src)
+            dst_dir = os.path.join(dst_root, sub,
+                                   "" if rel == "." else rel)
+            os.makedirs(dst_dir, exist_ok=True)
+            for name in files:
+                if ".tmp" in name:
+                    continue   # a writer mid-flight; not an entry yet
+                dst = os.path.join(dst_dir, name)
+                if os.path.exists(dst):
+                    continue
+                try:
+                    os.link(os.path.join(dirpath, name), dst)
+                except OSError:
+                    # cross-device or link-unfriendly fs: copy instead
+                    import shutil
+                    shutil.copy2(os.path.join(dirpath, name), dst)
+                linked += 1
+    return linked
 
 
 def compile_cache_stats() -> dict:

@@ -38,8 +38,8 @@ from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
                    debug_trace_lines, fault_redraw_line,
                    make_fault_redraw_record, make_record,
                    make_request_record, make_retry_record,
-                   make_setup_record, request_line, retry_line,
-                   sentinel_line, setup_line)
+                   make_setup_record, make_worker_record, request_line,
+                   retry_line, sentinel_line, setup_line, worker_line)
 from .spans import (OccupancyAggregator, SloAccountant, SpanTracer,
                     latency_percentiles, make_span_record,
                     merge_chrome_traces, phase_breakdown, span_line)
@@ -51,6 +51,7 @@ __all__ = [
     "make_retry_record", "make_setup_record", "setup_line", "retry_line",
     "make_request_record", "request_line",
     "make_fault_redraw_record", "fault_redraw_line",
+    "make_worker_record", "worker_line",
     "debug_trace_lines", "sentinel_line",
     "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",
     "NetDebugSpec", "sentinel_tree", "PHASES", "OVERFLOW_LIMIT",

@@ -45,9 +45,10 @@ membership's onset while the rest of the group continued.
 Further record types are keyed by a `"type"` field (records without one
 are the metrics record above): `setup` — one per process cold start,
 the decode/compile breakdown plus per-cache hit/miss (documented inline
-below) — `retry`, `request`, `fault_redraw`, `span` (host-side time
-spans from observe/spans.py, documented inline below), and two that
-carry the `debug_info` deep traces:
+below) — `retry`, `request`, `worker` (fleet-service worker lifecycle,
+serve/fleet/), `fault_redraw`, `span` (host-side time spans from
+observe/spans.py, documented inline below), and two that carry the
+`debug_info` deep traces:
 
 ``debug_trace`` — one per iteration while `debug_info: true`, the
 structured twin of the reference's ForwardDebugInfo / BackwardDebugInfo
@@ -363,6 +364,52 @@ REQUEST_FIELDS = {
     "reason": (str, False),        # rejected / failed: why
 }
 
+# --- worker records (fleet-service worker lifecycle, serve/fleet/) ---
+#
+# One per fleet-worker lifecycle event: the FleetController emits
+# registered/assigned/requeued/swap_requested/dead/drain_requested/
+# spawned into the fleet-wide `fleet.jsonl` stream, and each worker
+# emits its own `swap` (with the measured hot-swap latency and the
+# persistent-compile-cache counter delta that proves the swap hit
+# disk instead of recompiling) and `heartbeat` records into its own
+# service metrics stream. `pinned` is the worker's compiled program
+# set — canonical fault-process spec, dtype_policy ("f32" when none),
+# net name, canonical tile-mapping spec, and a mesh descriptor —
+# what the router matches requests against::
+#
+#     {"schema_version": 1, "type": "worker", "iter": 40,
+#      "wall_time": 1722700000.1, "worker": "w0", "event": "swap",
+#      "pinned": {"process": "conductance_drift:nu=0.2",
+#                 "dtype_policy": "f32", "net": "quick",
+#                 "tiles": "1x1", "mesh": "single"},
+#      "swap_s": 1.9, "cache_hits": 12, "cache_misses": 0}
+
+WORKER_EVENTS = ("registered", "heartbeat", "assigned", "requeued",
+                 "swap_requested", "swap", "swap_refused", "dead",
+                 "removed", "spawned", "drain_requested")
+
+WORKER_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "worker": (str, True),
+    "event": (str, True),
+    "request": (str, False),        # assigned / requeued: which request
+    "pinned": (dict, False),        # the compiled program set (strings)
+    "lanes": (int, False),
+    "occupied_lanes": (int, False),
+    "pending_configs": (int, False),
+    "swap_s": (_NUM, False),        # swap: measured hot-swap latency
+    "resident": (bool, False),      # swap: True = the target program
+                                    # set was PARKED in memory and
+                                    # re-activated (zero compiles);
+                                    # False = fresh build
+    "cache_hits": (int, False),     # swap: compile-cache counter delta
+    "cache_misses": (int, False),
+    "reason": (str, False),         # dead / requeued: why
+}
+
 # --- fault_redraw records (restore fallback announcement) ---
 #
 # Emitted by Solver.restore when a snapshot PREDATES fault-state
@@ -619,6 +666,36 @@ def _validate_request(rec) -> list:
     return errs
 
 
+def _validate_worker(rec) -> list:
+    errs = _check_fields(rec, WORKER_FIELDS, "worker")
+    errs += _check_iter(rec, "worker")
+    event = rec.get("event")
+    if isinstance(event, str) and event not in WORKER_EVENTS:
+        errs.append(f"worker: unknown event {event!r} "
+                    f"(expected one of {WORKER_EVENTS})")
+    for key in ("worker", "request", "reason"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"worker: {key} must be non-empty")
+    for key in ("lanes", "occupied_lanes", "pending_configs",
+                "cache_hits", "cache_misses"):
+        val = rec.get(key)
+        if isinstance(val, int) and not isinstance(val, bool) \
+                and val < 0:
+            errs.append(f"worker: {key} must be >= 0")
+    swap_s = rec.get("swap_s")
+    if isinstance(swap_s, _NUM) and not isinstance(swap_s, bool) \
+            and swap_s < 0:
+        errs.append("worker: swap_s must be >= 0")
+    pinned = rec.get("pinned")
+    if isinstance(pinned, dict):
+        for k, v in pinned.items():
+            if not isinstance(v, str) or not v:
+                errs.append(f"worker: pinned[{k!r}] must be a "
+                            "non-empty string")
+    return errs
+
+
 def _validate_fault_redraw(rec) -> list:
     errs = _check_fields(rec, FAULT_REDRAW_FIELDS, "fault_redraw")
     errs += _check_iter(rec, "fault_redraw")
@@ -694,6 +771,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_request(rec)
     if rtype == "fault_redraw":
         return _check_version(rec) + _validate_fault_redraw(rec)
+    if rtype == "worker":
+        return _check_version(rec) + _validate_worker(rec)
     if rtype == "span":
         return _check_version(rec) + _validate_span(rec)
     if rtype is not None:

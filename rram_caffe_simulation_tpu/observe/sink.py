@@ -205,6 +205,91 @@ def request_line(record: dict) -> str:
     return head + tail
 
 
+def make_worker_record(iteration: int, worker: str, event: str,
+                       request: Optional[str] = None,
+                       pinned: Optional[dict] = None,
+                       lanes: Optional[int] = None,
+                       occupied_lanes: Optional[int] = None,
+                       pending_configs: Optional[int] = None,
+                       swap_s: Optional[float] = None,
+                       resident: Optional[bool] = None,
+                       cache_hits: Optional[int] = None,
+                       cache_misses: Optional[int] = None,
+                       reason: Optional[str] = None) -> dict:
+    """One fleet-worker lifecycle event (schema.py WORKER_FIELDS):
+    registered/assigned/requeued/swap_requested/dead/... from the
+    FleetController's stream, swap/heartbeat from the worker's own.
+    `swap_s` + `cache_hits`/`cache_misses` on a `swap` record are the
+    evidence a hot program swap was a compile-cache hit, not a cold
+    start."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "worker",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "worker": str(worker),
+        "event": str(event),
+    }
+    if request is not None:
+        rec["request"] = str(request)
+    if pinned is not None:
+        rec["pinned"] = {str(k): str(v) for k, v in pinned.items()}
+    if lanes is not None:
+        rec["lanes"] = int(lanes)
+    if occupied_lanes is not None:
+        rec["occupied_lanes"] = int(occupied_lanes)
+    if pending_configs is not None:
+        rec["pending_configs"] = int(pending_configs)
+    if swap_s is not None:
+        rec["swap_s"] = round(float(swap_s), 4)
+    if resident is not None:
+        rec["resident"] = bool(resident)
+    if cache_hits is not None:
+        rec["cache_hits"] = int(cache_hits)
+    if cache_misses is not None:
+        rec["cache_misses"] = int(cache_misses)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    return rec
+
+
+def worker_line(record: dict) -> str:
+    """One-line text form of a `worker` record."""
+    event = record.get("event")
+    head = f"Fleet worker {record.get('worker')}"
+    if event == "swap":
+        tail = " hot-swapped"
+        pinned = record.get("pinned") or {}
+        if pinned.get("process"):
+            tail += f" to process {pinned['process']}"
+        if "swap_s" in record:
+            tail += f" in {record['swap_s']:g} s"
+        if record.get("resident"):
+            tail += " (resident program reactivated)"
+        if "cache_hits" in record:
+            tail += (f" (compile cache: {record['cache_hits']} hits"
+                     f"/{record.get('cache_misses', 0)} misses)")
+    elif event in ("assigned", "requeued"):
+        tail = f" {event}"
+        if record.get("request"):
+            tail += f" request {record['request']}"
+        if record.get("reason"):
+            tail += f": {record['reason']}"
+    elif event == "dead":
+        tail = " declared dead"
+        if record.get("reason"):
+            tail += f": {record['reason']}"
+    elif event == "registered":
+        tail = f" registered ({record.get('lanes', '?')} lanes"
+        pinned = record.get("pinned") or {}
+        if pinned.get("process"):
+            tail += f", process {pinned['process']}"
+        tail += ")"
+    else:
+        tail = f" {event}"
+    return head + tail
+
+
 def make_fault_redraw_record(iteration: int, snapshot: str,
                              reason: str) -> dict:
     """The restore-fallback announcement (schema.py
@@ -404,7 +489,16 @@ class JsonlSink:
             os.makedirs(d, exist_ok=True)
         self.path = path
         self._policy = _FlushPolicy(unbuffered, flush_every, flush_secs)
-        self._f = open(path, "a" if append else "w")
+        if not append:
+            # truncate, then reopen in APPEND mode: every write lands
+            # at the file's CURRENT end, not at this handle's private
+            # offset. With one sink the two are the same; with several
+            # sinks alternating on one stream (a fleet worker's parked
+            # resident services share the service dir), a positioned
+            # "w" handle resuming after another sink appended would
+            # silently OVERWRITE the records written in between.
+            open(path, "w").close()
+        self._f = open(path, "a")
         self._atexit_cb = _register_atexit_flush(self)
 
     def write(self, record: dict):
@@ -484,7 +578,12 @@ class CaffeLogSink:
         self._policy = _FlushPolicy(unbuffered, flush_every, flush_secs)
         had_content = append and os.path.exists(path) \
             and os.path.getsize(path) > 0
-        self._f = open(path, "a" if append else "w")
+        if not append:
+            # truncate + reopen append, like JsonlSink: several sinks
+            # alternating on one stream must never resume a positioned
+            # "w" handle over records another sink appended
+            open(path, "w").close()
+        self._f = open(path, "a")
         self._atexit_cb = _register_atexit_flush(self)
         if not had_content:
             # one banner per log: extract_seconds measures elapsed time
@@ -532,6 +631,10 @@ class CaffeLogSink:
             return
         if rtype == "fault_redraw":
             self._emit(fault_redraw_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "worker":
+            self._emit(worker_line(record))
             self._maybe_flush()
             return
         if rtype == "span":

@@ -249,6 +249,13 @@ class SweepRunner:
         # consumer -> dispatcher signal that a quarantine was observed
         # and a reclamation pass is due at the next chunk boundary
         self._reclaim_flag = threading.Event()
+        # collective-safe stall handling (multi-process only): a local
+        # StallError is NOTED here instead of raised, and the abort is
+        # process_any-agreed at the next chunk boundary so every
+        # process joins the emergency-checkpoint collective
+        self._stall_error: Optional[BaseException] = None
+        self._stall_armed = bool(stall_timeout_s) \
+            and pipeline_depth is not None and bool(pipeline_depth)
         #: lane -> triage info noted by the bookkeeping path when a
         #: quarantine is announced (read by the dispatcher AFTER a
         #: consumer drain, so the hand-off needs no extra lock)
@@ -335,18 +342,15 @@ class SweepRunner:
                     "strategy: its episodic search mutates host "
                     "slices of the full config-stacked params, which "
                     "no single process holds on a pod mesh")
-            if solver._watchdog is not None:
-                raise ValueError(
-                    "multi-process sweeps do not support the solver "
-                    "watchdog: its snapshot/halt servicing depends on "
-                    "consumer-thread timing, which is not coordinated "
-                    "across processes (quarantine + self-healing are "
-                    "— they act at deterministic chunk boundaries)")
-            if stall_timeout_s:
-                raise ValueError(
-                    "stall_timeout_s is single-process: the emergency "
-                    "checkpoint it writes is a collective the stalled "
-                    "peer processes would never join")
+            # watchdog + stall detection are collective-safe (ISSUE
+            # 15, lifting the last two single-process-only guards):
+            # the watchdog trip is process_any-agreed at each chunk
+            # boundary (after a consumer drain, so every process's
+            # bookkeeping has noted the same quarantine event), and a
+            # stalled consumer defers its abort to the next boundary
+            # where all processes agree and JOIN the emergency
+            # checkpoint collective instead of one process writing it
+            # unilaterally (the deadlock the old raise guarded against)
             self._cfg_rows = self._owned_config_block()
         self.config_block = int(config_block or 0)
         self.iter = 0
@@ -1223,10 +1227,9 @@ class SweepRunner:
             # matches; one tiny allgather per boundary)
             reclaim = multihost.process_any(reclaim)
         if reclaim:
-            if self._consumer is not None:
-                # barrier: the diagnosis/announce bookkeeping of every
-                # dispatched chunk must land before attempts are voided
-                self.pipeline.drain_s += self._consumer.drain()
+            # barrier: the diagnosis/announce bookkeeping of every
+            # dispatched chunk must land before attempts are voided
+            self._drain_consumer()
             self._reclaim_flag.clear()
             mask = np.asarray(self.quarantine)
             for lane in np.flatnonzero(mask):
@@ -1275,14 +1278,13 @@ class SweepRunner:
             eligible = list(self._refill_policy(
                 eligible, [int(c) for c in h.lane_cfg]))
         if free and eligible:
-            if self._consumer is not None:
-                # barrier BEFORE mutating _quar_seen / the mask: chunks
-                # dispatched pre-refill carry the freed lane's set mask
-                # bit, and a stale item processed after the discard
-                # below would re-mark the lane as seen — permanently
-                # suppressing the announcement (and reclaim flag) of a
-                # later genuine quarantine of the re-seeded config
-                self.pipeline.drain_s += self._consumer.drain()
+            # barrier BEFORE mutating _quar_seen / the mask: chunks
+            # dispatched pre-refill carry the freed lane's set mask
+            # bit, and a stale item processed after the discard
+            # below would re-mark the lane as seen — permanently
+            # suppressing the announcement (and reclaim flag) of a
+            # later genuine quarantine of the re-seeded config
+            self._drain_consumer()
             updates = {}
             for lane in free:
                 if not eligible:
@@ -2064,11 +2066,37 @@ class SweepRunner:
         or stop the whole sweep ("halt"). Runs on the dispatcher
         thread only — checkpoint() drains the consumer, which would
         deadlock if called from the consumer itself. Returns True when
-        the sweep should stop."""
+        the sweep should stop.
+
+        Multi-process: the trip is process_any-AGREED at the chunk
+        boundary (the reclaim-flag pattern) — consumer-thread timing
+        differs across hosts, so one host's noted event must not have
+        it checkpoint/halt alone. After agreement every process drains
+        its consumer; the chunks are identical across processes, so
+        the laggard's bookkeeping notes the SAME quarantine before the
+        policy acts, and the snapshot checkpoint / sticky halt land on
+        every process at the same boundary."""
+        if self._multiproc and self.solver._watchdog is not None:
+            with self._watchdog_lock:
+                peek = self._watchdog_event is not None
+            if not multihost.process_any(peek):
+                return self._stop
+            self._drain_consumer()
         with self._watchdog_lock:
             ev, self._watchdog_event = self._watchdog_event, None
         if ev is None:
-            return self._stop
+            if self._multiproc and self.solver._watchdog is not None:
+                # agreed trip the drain still did not localize here
+                # (defensive — identical chunks should have): act on
+                # the device-side quarantine mask, which IS globally
+                # consistent
+                ev = {"iter": int(self.iter),
+                      "configs": [int(i) for i in
+                                  np.flatnonzero(
+                                      np.asarray(self.quarantine))],
+                      "policy": self.solver._watchdog}
+            else:
+                return self._stop
         names = ", ".join(str(i) for i in ev["configs"])
         print(f"Sweep watchdog tripped at iteration {ev['iter']}: "
               f"config {names} quarantined", flush=True)
@@ -2110,7 +2138,16 @@ class SweepRunner:
                 lane_map, benign)
         tr = self._tracer
         if self._consumer is not None:
-            blocked = self._consumer.submit(item)
+            try:
+                blocked = self._consumer.submit(item)
+            except async_exec.StallError as e:
+                if not self._multiproc:
+                    raise
+                # collective-safe: note the stall, drop this chunk's
+                # bookkeeping (the run is aborting anyway), and let
+                # `_agree_stall` below align the abort across processes
+                self._note_stall(e)
+                blocked = 0.0
             self.pipeline.host_blocked_s += blocked
             if tr is not None:
                 # backpressure: the dispatcher stalled on a full
@@ -2118,6 +2155,7 @@ class SweepRunner:
                 # what it was busy with)
                 tr.complete("submit_wait", blocked, iteration=last_it,
                             args={"k": k})
+            self._agree_stall()
         else:
             t0 = time.perf_counter()
             self._consume_chunk(item)
@@ -2137,11 +2175,21 @@ class SweepRunner:
         iteration's host (loss, outputs)."""
         if self._pipeline_on:
             if self._consumer is not None:
-                waited = self._consumer.drain()
+                try:
+                    waited = self._consumer.drain()
+                except async_exec.StallError as e:
+                    if not self._multiproc:
+                        raise
+                    self._note_stall(e)
+                    waited = 0.0
                 self.pipeline.drain_s += waited
                 if self._tracer is not None:
                     self._tracer.complete("drain", waited,
                                           iteration=self.iter)
+                # step() returns are lockstep across processes: agree
+                # a stall here too so a stall in the FINAL chunk's
+                # bookkeeping cannot end the run looking clean
+                self._agree_stall()
             self._service_watchdog()
             self._drain_spans()
             return self._last_host
@@ -2184,7 +2232,20 @@ class SweepRunner:
         a best-effort checkpoint WITHOUT draining the stuck consumer,
         abandon it so nothing blocks on it again, and make the stop
         sticky. The caller decides whether to resume elsewhere (the
-        durable driver journals the stall and exits EX_TEMPFAIL)."""
+        durable driver journals the stall and exits EX_TEMPFAIL).
+
+        Multi-process: only a COLLECTIVE-agreed stall (e.collective,
+        raised by `_agree_stall` at a chunk boundary on every process
+        at once) writes the checkpoint — it is a cross-process
+        collective all peers are now positioned to join. A unilateral
+        StallError under a pod mesh (defensive: the boundary catches
+        should prevent it) skips the checkpoint rather than deadlock
+        peers inside a gather they never entered."""
+        if self._multiproc and not getattr(e, "collective", False):
+            if self._consumer is not None:
+                self._consumer.abandon()
+            self._stop = True
+            return e
         path = (f"{self.solver.param.snapshot_prefix}"
                 f"_sweep_stall_iter_{self.iter}.ckpt.npz")
         try:
@@ -2198,6 +2259,51 @@ class SweepRunner:
             self._consumer.abandon()
         self._stop = True
         return e
+
+    def _note_stall(self, e: async_exec.StallError):
+        """Multi-process local-stall path: remember the first stall,
+        abandon the consumer (its sticky error makes every later
+        submit/drain return immediately instead of blocking), and keep
+        dispatching until `_agree_stall` aligns the abort on a chunk
+        boundary every process reaches."""
+        if self._stall_error is None:
+            self._stall_error = e
+            print("Sweep consumer stalled on this process; deferring "
+                  "the abort to the next chunk boundary so every "
+                  "process joins the emergency checkpoint", flush=True)
+        if self._consumer is not None:
+            self._consumer.abandon()
+
+    def _agree_stall(self):
+        """Chunk-boundary stall agreement (multi-process, stall
+        detection armed): one tiny allgather per boundary — the same
+        lockstep discipline as the reclaim flag. When ANY process
+        noted a stall, every process raises the collective StallError
+        together, so `_on_stall`'s emergency checkpoint is a joint
+        collective, not a unilateral deadlock."""
+        if not (self._multiproc and self._stall_armed):
+            return
+        if not multihost.process_any(self._stall_error is not None):
+            return
+        e = self._stall_error or async_exec.StallError(
+            "consumer stalled on a peer process (collective-agreed "
+            "abort)")
+        e.collective = True
+        raise e
+
+    def _drain_consumer(self):
+        """Consumer barrier with the multi-process stall contract: a
+        local StallError is noted for the next boundary agreement
+        instead of raised (single-process keeps the immediate-raise
+        semantics)."""
+        if self._consumer is None:
+            return
+        try:
+            self.pipeline.drain_s += self._consumer.drain()
+        except async_exec.StallError as e:
+            if not self._multiproc:
+                raise
+            self._note_stall(e)
 
     def _step_impl(self, iters: int, chunk: int):
         if self._stop:
@@ -2501,8 +2607,7 @@ class SweepRunner:
         """The consistency barriers every checkpoint capture takes: the
         async pipeline drained to a chunk boundary, queued background
         writes and solver snapshots landed."""
-        if self._consumer is not None:
-            self.pipeline.drain_s += self._consumer.drain()
+        self._drain_consumer()
         self.wait_for_writes()
         self.solver.wait_for_snapshots()
 
@@ -2786,8 +2891,7 @@ class SweepRunner:
         import pickle
         t_restore = (time.perf_counter() if self._tracer is not None
                      else 0.0)
-        if self._consumer is not None:
-            self.pipeline.drain_s += self._consumer.drain()
+        self._drain_consumer()
         self.wait_for_writes()
         self.solver.wait_for_snapshots()
         data, meta, gen = self._load_checkpoint_data(path)
@@ -2962,6 +3066,10 @@ class SweepRunner:
         self._record_t0 = None
         with self._watchdog_lock:
             self._watchdog_event = None
+        # a noted-but-unagreed stall belongs to the abandoned timeline
+        # too (the consumer itself stays abandoned — its sticky error
+        # keeps drains non-blocking)
+        self._stall_error = None
         # a watchdog halt belongs to the abandoned timeline; restoring
         # an earlier checkpoint must let the sweep run again
         self._stop = False

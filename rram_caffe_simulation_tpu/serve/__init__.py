@@ -9,7 +9,12 @@
   (pending/ -> active/ -> done/ atomic-rename lifecycle).
 - `ServeClient` (serve_client.py): the client library + CLI —
   submit/status/result/wait/stats/drain/tail over the socket front
-  door, falling back to direct spool files when the socket is down.
+  door, falling back to direct spool files when the socket is down;
+  pointed at a FLEET directory it aggregates across workers.
+- `fleet/` (serve.fleet): one durable spool feeding N pod-backed
+  workers — pinned-program routing, hot program swap, dead-worker
+  requeue, backlog-EMA scaling (ROADMAP item 2 at its designed
+  scale).
 
 Run the server with ``python -m rram_caffe_simulation_tpu.serve`` (or
 ``caffe serve``), the client with

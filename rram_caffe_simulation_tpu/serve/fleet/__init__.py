@@ -1,0 +1,49 @@
+"""Fleet service (ROADMAP item 2): one durable spool, N pod-backed
+workers, pinned-program routing and hot swap.
+
+- `FleetController` (controller.py): the scheduling head — routes the
+  shared spool's requests to matching warm workers, hot-swaps a
+  victim's compiled program set when nothing matches, requeues a dead
+  worker's in-flight requests (at-least-once), and scales the worker
+  count on the projected-backlog EMA.
+- `FleetWorker` (worker.py): one pod-backed `SweepService` wrapped
+  with registration, heartbeats, and the hot-swap machinery (swap =
+  re-place state + compile-cache hit).
+- `WorkerTable` (table.py): registration + heartbeats through the
+  fleet directory; dependency-free.
+- `router` / `scaler`: the pure host-side decision logic — pin
+  matching, least-loaded choice, swap-victim selection, backlog-EMA
+  scale decisions — unit-testable without devices
+  (tests/test_fleet.py); `scripts/check_fleet.py` is the CI guard for
+  the whole subsystem (mixed-physics byte-identity, SIGKILL requeue,
+  cache-hit swaps, fleet occupancy).
+
+Run the controller with ``python -m rram_caffe_simulation_tpu.serve.fleet``
+and workers with ``python -m rram_caffe_simulation_tpu.serve.fleet.worker``.
+"""
+from .router import (effective_pins, pick_swap_victim, pick_worker,
+                     request_pins, requeue_plan, route, swap_target,
+                     worker_load, worker_matches)
+from .scaler import BacklogScaler
+from .table import PIN_KEYS, WorkerTable
+
+__all__ = [
+    "FleetController", "FleetWorker", "WorkerTable", "BacklogScaler",
+    "PIN_KEYS", "request_pins", "effective_pins", "worker_matches",
+    "worker_load", "pick_worker", "pick_swap_victim", "swap_target",
+    "route", "requeue_plan",
+]
+
+
+def __getattr__(name):
+    # lazy like serve/__init__: the pure router/scaler/table layer
+    # must import without the framework; controller pulls in observe,
+    # worker pulls in the whole service stack
+    if name == "FleetController":
+        from .controller import FleetController
+        return FleetController
+    if name == "FleetWorker":
+        from .worker import FleetWorker
+        return FleetWorker
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,7 @@
+"""``python -m rram_caffe_simulation_tpu.serve.fleet`` — the fleet
+controller CLI (see controller.py)."""
+import sys
+
+from .controller import main
+
+sys.exit(main())

@@ -1,0 +1,558 @@
+"""FleetController — one durable spool, N pod-backed workers.
+
+The fleet lifts the single-service architecture one level (ROADMAP
+item 2): the durable filesystem spool becomes a SHARED queue at
+``<fleet>/spool``, and each worker is a full `SweepService` (warm
+vectorized lane pool, possibly on its own mesh topology) living under
+``<fleet>/workers/<wid>/`` with its pinned program set registered in
+the worker table (table.py). The controller is pure host-side
+scheduling — it never touches a device:
+
+- **route** (router.py): each pending fleet request moves into the
+  matching warm worker's own spool (an atomic cross-directory copy +
+  fleet-spool claim), least-loaded first; when no worker matches the
+  request's (process, dtype_policy, net, tiles) pins, the least-loaded
+  swappable worker gets a hot-swap command — the AOT compile cache +
+  fault-process/tile registry seams make the swap a re-place +
+  compile-cache hit, not a cold start (the worker proves it with the
+  cache counter delta on its `swap` record);
+- **harvest**: a worker's terminal spool file folds back into the
+  fleet spool's done/, so `ServeClient` against the fleet directory
+  sees one queue end to end;
+- **reap**: a worker whose heartbeat goes stale past
+  `heartbeat_timeout_s` is declared dead (`worker` record), its
+  in-flight requests REQUEUE onto the fleet spool (at-least-once —
+  the PR 6 completion contract, lifted one level), and its row leaves
+  the table;
+- **scale** (scaler.py): the admission controller's projected-backlog
+  EMA, computed fleet-wide, spawns workers from `--worker-cmd` (up to
+  `--max-workers`) or drains an idle one.
+
+Run it with ``python -m rram_caffe_simulation_tpu.serve.fleet`` next
+to N ``...serve.fleet.worker`` processes sharing the fleet directory.
+The controller itself needs no accelerator stack — request-pin
+canonicalization lazily imports the fault registry and falls back to
+raw string comparison when the framework is absent (a monitoring
+host can run it against a shared filesystem).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import socket
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+_HOSTNAME = socket.gethostname()
+
+from ..spool import Spool, _atomic_write, normalize_request
+from .router import (request_pins, requeue_plan, route, worker_load)
+from .scaler import BacklogScaler
+from .table import WorkerTable
+
+#: fields the controller strips when copying a request between spools
+#: (stale bookkeeping from a previous claimant must not ride along)
+_BOOKKEEPING = ("cfg_ids", "iters_granted", "status", "worker",
+                "submit_seen", "state")
+
+
+def _append_jsonl(path: str, rec: dict):
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def canonicalize_pins(pins: Dict[str, str]) -> Dict[str, str]:
+    """Run request pins through the registry canonicalizers so any
+    equivalent spelling routes to the same worker. Lazy imports: with
+    the framework absent (a bare monitoring host) raw strings compare
+    as-is — workers registered canonical spellings, so canonical
+    requests still route. An unparseable spec raises ValueError (the
+    request is rejected at the fleet door, same contract as service
+    admission)."""
+    out = dict(pins)
+    if "process" in out:
+        try:
+            from ...fault.processes import FaultSpec
+        except ImportError:
+            pass
+        else:
+            out["process"] = FaultSpec.parse(out["process"]).canonical()
+    if "tiles" in out:
+        try:
+            from ...fault.mapping import TileSpec
+        except ImportError:
+            pass
+        else:
+            out["tiles"] = TileSpec.parse(out["tiles"]).canonical()
+    return out
+
+
+class FleetController:
+    """The scheduling head of one fleet directory."""
+
+    def __init__(self, fleet_dir: str, *,
+                 heartbeat_timeout_s: float = 10.0,
+                 poll_interval_s: float = 0.5,
+                 default_iters: int = 100,
+                 scaler: Optional[BacklogScaler] = None,
+                 worker_cmd: Optional[str] = None):
+        self.dir = os.path.abspath(fleet_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.spool = Spool(os.path.join(self.dir, "spool"))
+        self.table = WorkerTable(self.dir)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.default_iters = int(default_iters)
+        self.scaler = scaler
+        self.worker_cmd = worker_cmd
+        self.metrics_path = os.path.join(self.dir, "fleet.jsonl")
+        self._beats = 0
+        #: request id -> {"worker", "attempt"} for routed, unharvested
+        #: requests (persisted in state.json across restarts)
+        self.assignments: Dict[str, dict] = {}
+        #: worker id -> swap target pins, while a swap command is out
+        self.pending_swaps: Dict[str, Dict[str, str]] = {}
+        self._next_ordinal = 0
+        self._spawned: Dict[str, subprocess.Popen] = {}
+        self._worker_spools: Dict[str, Spool] = {}
+        #: routed-but-unservable backlog measured by the LAST routing
+        #: pass — the scaler reads this instead of re-parsing every
+        #: pending file a second time per beat
+        self._pending_backlog_iters = 0
+        if os.path.exists(self._state_path()):
+            self._load_state()
+        # crash-window recovery: a request CLAIMED in a beat that died
+        # before its state write is active in the fleet spool (the
+        # claim persisted the worker/attempt fields) but absent from
+        # the loaded assignments — rebuild those entries, or the
+        # request would never harvest and never requeue
+        for req in self.spool.active():
+            rid = req.get("id")
+            if rid and rid not in self.assignments \
+                    and req.get("worker"):
+                self.assignments[rid] = {
+                    "worker": str(req["worker"]),
+                    "attempt": int(req.get("attempt", 1))}
+
+    # ------------------------------------------------------------------
+    # persistence + records
+
+    def _state_path(self) -> str:
+        return os.path.join(self.dir, "state.json")
+
+    def _load_state(self):
+        with open(self._state_path()) as f:
+            state = json.load(f)
+        self.assignments = dict(state.get("assignments", {}))
+        self.pending_swaps = dict(state.get("pending_swaps", {}))
+        self._next_ordinal = int(state.get("next_ordinal", 0))
+
+    def _write_state(self):
+        _atomic_write(self._state_path(), {
+            "schema_version": 1,
+            "assignments": self.assignments,
+            "pending_swaps": self.pending_swaps,
+            "next_ordinal": self._next_ordinal,
+        })
+
+    def _emit(self, wid: str, event: str, **kw):
+        from ...observe import make_worker_record
+        kw = {k: v for k, v in kw.items() if v is not None}
+        _append_jsonl(self.metrics_path,
+                      make_worker_record(self._beats, wid, event, **kw))
+
+    def _worker_spool(self, wid: str) -> Spool:
+        sp = self._worker_spools.get(wid)
+        if sp is None:
+            sp = Spool(os.path.join(self.table.worker_dir(wid),
+                                    "spool"))
+            self._worker_spools[wid] = sp
+        return sp
+
+    # ------------------------------------------------------------------
+    # the beat
+
+    def beat(self) -> dict:
+        """One scheduling pass: reap dead workers, harvest terminal
+        requests, route pending ones, apply a scale decision. Returns
+        a summary dict (what the CLI prints at --verbose)."""
+        self._beats += 1
+        rows = self.table.rows()
+        self._reconcile_swaps(rows)
+        dead = self._reap(rows)
+        for wid in dead:
+            rows.pop(wid, None)
+        harvested = self._harvest()
+        routed = self._route_pending(rows)
+        scale = self._apply_scale(rows)
+        self._write_state()
+        return {"beat": self._beats, "workers": sorted(rows),
+                "dead": dead, "harvested": harvested,
+                "routed": routed, "scale": scale,
+                "pending": len(self.spool.pending_ids()),
+                "assigned": len(self.assignments)}
+
+    def _reconcile_swaps(self, rows: Dict[str, dict]):
+        """Clear a pending swap once the worker re-registered with the
+        target pins, and overlay still-pending targets onto the rows
+        so the router matches against what the worker is BECOMING. A
+        consumed command WITHOUT the re-pin is the worker's refusal
+        protocol (e.g. an unknown net) — drop the overlay so the
+        worker is not wedged out of routing and victim selection
+        forever (workers publish the new pins BEFORE clearing the
+        command, so applied swaps never look like refusals)."""
+        for wid, target in list(self.pending_swaps.items()):
+            row = rows.get(wid)
+            if row is None:
+                continue   # reaped or departed; _reap cleans up
+            if self.table.read_swap(wid) is None:
+                if (row.get("pinned") or {}) != target:
+                    self._emit(wid, "swap_refused", pinned=target,
+                               reason="worker consumed the command "
+                                      "without re-pinning; routing "
+                                      "overlay dropped")
+                del self.pending_swaps[wid]
+            else:
+                row["pending_swap"] = target
+
+    def _dead_reason(self, row: dict, now: float) -> Optional[str]:
+        """Why this row's worker counts as dead: a vanished same-host
+        pid (fast path — a SIGKILL is seen within one beat, and a
+        worker busy inside a long swap rebuild is NOT declared dead
+        just for missing heartbeats) or a stale heartbeat (the
+        cross-host fallback)."""
+        idle_s = now - float(row.get("heartbeat_time", 0))
+        pid = row.get("pid")
+        if pid and row.get("host") == _HOSTNAME:
+            try:
+                os.kill(int(pid), 0)
+            except ProcessLookupError:
+                return f"process {pid} is gone"
+            except (OSError, ValueError):
+                pass
+            else:
+                # alive but silent: a swap rebuild legitimately blocks
+                # heartbeats for a while, so a live pid gets a 10x
+                # grace before a wedged worker is finally reaped
+                if idle_s > 10 * self.heartbeat_timeout_s:
+                    return (f"process {pid} alive but heartbeat "
+                            f"stale for {idle_s:.1f} s (10x the "
+                            f"{self.heartbeat_timeout_s:g} s timeout)")
+                return None
+        if idle_s > self.heartbeat_timeout_s:
+            return (f"heartbeat stale for {idle_s:.1f} s (timeout "
+                    f"{self.heartbeat_timeout_s:g} s)")
+        return None
+
+    def _reap(self, rows: Dict[str, dict]) -> List[str]:
+        """Declare dead workers (vanished pid / stale heartbeat) and
+        requeue their unfinished requests onto the fleet spool
+        (at-least-once)."""
+        now = time.time()
+        reasons = {wid: self._dead_reason(row, now)
+                   for wid, row in rows.items()}
+        dead = [wid for wid, r in reasons.items() if r is not None]
+        for wid in dead:
+            self._emit(wid, "dead", reason=reasons[wid],
+                       pinned=rows[wid].get("pinned"))
+            # work it finished before dying harvests normally; only
+            # unfinished assignments requeue
+            finished = {}
+            wspool = self._worker_spool(wid)
+            for rid, a in self.assignments.items():
+                if a.get("worker") == wid \
+                        and wspool.state_of(rid) == "done":
+                    finished[rid] = "done"
+            for rid in requeue_plan(self.assignments, [wid], finished):
+                self._requeue(rid, wid)
+            self.table.remove(wid)
+            self.pending_swaps.pop(wid, None)
+            self._spawned.pop(wid, None)
+        return dead
+
+    def _requeue(self, rid: str, wid: str):
+        try:
+            self.spool.requeue(rid)
+        except FileNotFoundError:
+            # never claimed / already terminal at fleet level: there
+            # is nothing to resume, and a leaked assignment would hold
+            # _fleet_idle() False forever
+            self.assignments.pop(rid, None)
+            return
+        # best effort: scrub the dead worker's copy so a restarted
+        # process with the same name cannot double-run it
+        wspool = self._worker_spool(wid)
+        for state in ("pending", "active"):
+            try:
+                os.remove(wspool._path(state, rid))
+            except OSError:
+                pass
+        del self.assignments[rid]
+        self._emit(wid, "requeued", request=rid,
+                   reason="worker died with the request in flight; "
+                          "requeued onto survivors (at-least-once)")
+
+    def _harvest(self) -> List[str]:
+        """Fold workers' terminal spool files into the fleet done/."""
+        done = []
+        for rid, a in list(self.assignments.items()):
+            wid = a["worker"]
+            req = self._worker_spool(wid).read(rid)
+            if req is None or req.get("state") != "done":
+                continue
+            payload = {k: req[k] for k in
+                       ("status", "results", "latency_s", "reason")
+                       if req.get(k) is not None}
+            payload["worker"] = wid
+            self.spool.finish(rid, payload)
+            del self.assignments[rid]
+            done.append(rid)
+        return done
+
+    def _route_pending(self, rows: Dict[str, dict]) -> List[str]:
+        routed = []
+        self._pending_backlog_iters = 0
+        for rid in self.spool.pending_ids():
+            try:
+                raw = self.spool.read(rid)
+                if raw is None:
+                    continue
+                req = normalize_request(dict(raw, id=rid), 0)
+                pins = canonicalize_pins(request_pins(req))
+            except ValueError as e:
+                self.spool.quarantine(rid, f"invalid request: {e}")
+                continue
+            wid, swap = route(pins, rows)
+            if wid is None:
+                # no (swappable) worker yet; the scaler sees the
+                # stranded lane-iterations this same beat
+                self._pending_backlog_iters += (
+                    int(req.get("iters") or self.default_iters)
+                    * len(req.get("configs") or []))
+                continue
+            if swap is not None:
+                self.table.command_swap(wid, swap)
+                self.pending_swaps[wid] = swap
+                rows[wid] = dict(rows[wid], pending_swap=swap)
+                self._emit(wid, "swap_requested", request=rid,
+                           pinned=swap)
+            clean = {k: v for k, v in req.items()
+                     if k not in _BOOKKEEPING}
+            try:
+                self._worker_spool(wid).submit(clean)
+            except ValueError as e:
+                # the worker already knows this id (e.g. a crashed
+                # controller re-routing after the copy landed): treat
+                # as assigned rather than duplicating the file
+                if "already exists" not in str(e):
+                    self.spool.quarantine(rid, str(e))
+                    continue
+            attempt = int(raw.get("requeues", 0)) + 1
+            self.spool.claim(rid, {"worker": wid, "attempt": attempt})
+            self.assignments[rid] = {"worker": wid, "attempt": attempt}
+            # the routed load is visible to the next pick immediately
+            rows[wid] = dict(
+                rows[wid],
+                pending_configs=int(rows[wid].get("pending_configs", 0))
+                + len(req.get("configs") or []))
+            self._emit(wid, "assigned", request=rid)
+            routed.append(rid)
+        return routed
+
+    # ------------------------------------------------------------------
+    # scaling
+
+    def _apply_scale(self, rows: Dict[str, dict]) -> int:
+        if self.scaler is None:
+            return 0
+        rate = sum(float(r.get("steps_per_sec", 0.0))
+                   * int(r.get("lanes", 0)) for r in rows.values())
+        # unrouted backlog measured by this beat's routing pass (no
+        # second read of the pending files), plus the workers' own
+        # queued configs
+        backlog = self._pending_backlog_iters + sum(
+            int(r.get("pending_configs", 0)) * self.default_iters
+            for r in rows.values())
+        idle = [wid for wid, r in rows.items()
+                if worker_load(r) == 0 and not r.get("pending_swap")
+                and not any(a["worker"] == wid
+                            for a in self.assignments.values())]
+        # spawned-but-not-yet-registered workers count toward the
+        # fleet size: a jax worker takes seconds-to-minutes to build
+        # and register, and re-deciding against the registered count
+        # alone would launch a new process every beat of that window
+        starting = sum(1 for wid, p in self._spawned.items()
+                       if p.poll() is None and wid not in rows)
+        decision = self.scaler.decide(backlog, rate,
+                                      len(rows) + starting,
+                                      idle_workers=len(idle))
+        if decision > 0:
+            self._spawn_worker()
+        elif decision < 0 and idle:
+            victim = min(idle, key=lambda w: (worker_load(rows[w]), w))
+            with open(os.path.join(self.table.worker_dir(victim),
+                                   "DRAIN"), "w"):
+                pass
+            self._emit(victim, "drain_requested",
+                       reason="scale-down: fleet projection under the "
+                              "low-water mark with an idle worker")
+        return decision
+
+    def _spawn_worker(self) -> Optional[str]:
+        """Scale up: launch a worker process from the --worker-cmd
+        template ({name} and {fleet} substitute). Fresh names only —
+        reusing a dead worker's directory would resurrect its stale
+        state."""
+        if self.worker_cmd is None:
+            return None
+        # genuinely fresh names: skip ordinals whose row, service dir,
+        # or live spawned process already exists (operators launch
+        # w0/w1/... by hand — colliding would double-run one spool)
+        while True:
+            wid = f"w{self._next_ordinal}"
+            self._next_ordinal += 1
+            if wid in self._spawned \
+                    or self.table.read(wid) is not None \
+                    or os.path.isdir(self.table.worker_dir(wid)):
+                continue
+            break
+        argv = [a.format(name=wid, fleet=self.dir)
+                for a in shlex.split(self.worker_cmd)]
+        logs = os.path.join(self.dir, "logs")
+        os.makedirs(logs, exist_ok=True)
+        log = open(os.path.join(logs, f"{wid}.log"), "ab")
+        self._spawned[wid] = subprocess.Popen(
+            argv, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log.close()
+        self._emit(wid, "spawned",
+                   reason="scale-up: fleet projection over the target "
+                          "window")
+        return wid
+
+    # ------------------------------------------------------------------
+    # the loop
+
+    def _drain_file(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, "DRAIN"))
+
+    def _fleet_idle(self, rows: Dict[str, dict]) -> bool:
+        return (not self.spool.pending_ids() and not self.assignments
+                and all(worker_load(r) == 0 for r in rows.values()))
+
+    def run(self, max_beats: Optional[int] = None,
+            drain_when_idle: bool = False,
+            drain_timeout_s: float = 120.0) -> int:
+        """Beat until drained. Exit 0 when the fleet drained idle, 75
+        when assignments were still in flight (workers checkpointed
+        them — restart the controller AND the same-named workers on
+        the same fleet directory to resume)."""
+        while True:
+            summary = self.beat()
+            if self._drain_file() \
+                    or (drain_when_idle
+                        and self._fleet_idle(self.table.rows())):
+                return self._drain(drain_timeout_s)
+            if max_beats is not None and self._beats >= max_beats:
+                return 0
+            if not summary["routed"] and not summary["harvested"]:
+                time.sleep(self.poll_interval_s)
+
+    def _drain(self, timeout_s: float) -> int:
+        try:
+            os.remove(os.path.join(self.dir, "DRAIN"))
+        except OSError:
+            pass
+        for wid in self.table.ids():
+            with open(os.path.join(self.table.worker_dir(wid),
+                                   "DRAIN"), "w"):
+                pass
+            self._emit(wid, "drain_requested",
+                       reason="fleet drain")
+        deadline = time.monotonic() + float(timeout_s)
+        while self.table.ids() and time.monotonic() < deadline:
+            time.sleep(self.poll_interval_s)
+            self._harvest()
+        self._harvest()
+        self._write_state()
+        in_flight = len(self.assignments)
+        if in_flight:
+            print(f"Fleet drained with {in_flight} request(s) in "
+                  "flight (checkpointed by their workers); exit 75 — "
+                  "restart the controller and the same-named workers "
+                  "to resume", flush=True)
+            return 75
+        print("Fleet drained idle; exit 0", flush=True)
+        return 0
+
+
+def main(argv=None) -> int:
+    """``python -m rram_caffe_simulation_tpu.serve.fleet`` — run the
+    fleet controller until drained."""
+    import argparse
+    import signal
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="rram-sweep-fleet",
+        description="fleet controller: one spool, N pod-backed "
+                    "workers (see serve/fleet/controller.py)")
+    p.add_argument("--fleet-dir", required=True,
+                   help="durable fleet root: spool/, workers/, "
+                        "fleet.jsonl, state.json")
+    p.add_argument("--heartbeat-timeout", type=float, default=10.0,
+                   help="seconds of heartbeat silence before a worker "
+                        "is declared dead and its requests requeue")
+    p.add_argument("--poll-interval", type=float, default=0.5)
+    p.add_argument("--default-iters", type=int, default=100,
+                   help="budget assumed for backlog projection when a "
+                        "request carries no 'iters'")
+    p.add_argument("--target-seconds", type=float, default=0.0,
+                   help="projected-backlog window the scaler steers "
+                        "toward; 0 disables scaling")
+    p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--max-workers", type=int, default=4)
+    p.add_argument("--worker-cmd", default=None,
+                   help="scale-up template, e.g. \"python -m "
+                        "rram_caffe_simulation_tpu.serve.fleet.worker "
+                        "--fleet-dir {fleet} --name {name} --solver "
+                        "s.prototxt\"")
+    p.add_argument("--drain-when-idle", action="store_true",
+                   help="drain the whole fleet once the spool is empty "
+                        "and every worker is idle (batch/CI mode)")
+    p.add_argument("--max-beats", type=int, default=0,
+                   help="stop after N controller beats (test hook); "
+                        "0 = unlimited")
+    args = p.parse_args(argv)
+
+    scaler = None
+    if args.target_seconds > 0:
+        scaler = BacklogScaler(target_seconds=args.target_seconds,
+                               min_workers=args.min_workers,
+                               max_workers=args.max_workers)
+    ctl = FleetController(
+        args.fleet_dir,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        poll_interval_s=args.poll_interval,
+        default_iters=args.default_iters,
+        scaler=scaler, worker_cmd=args.worker_cmd)
+
+    def _on_signal(signum, frame):
+        with open(os.path.join(ctl.dir, "DRAIN"), "w"):
+            pass
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"Fleet controller up: {ctl.dir} "
+          f"({len(ctl.table.ids())} worker(s) registered)", flush=True)
+    code = ctl.run(max_beats=args.max_beats or None,
+                   drain_when_idle=args.drain_when_idle)
+    sys.stdout.flush()
+    return code
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

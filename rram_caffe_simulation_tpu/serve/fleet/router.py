@@ -1,0 +1,137 @@
+"""Pinned-program routing — pure host-side decisions, no devices.
+
+A request may pin any subset of (process, dtype_policy, net, tiles);
+a worker row registers the canonical value for ALL of them. The
+router's contract:
+
+- a pin the request does not name matches ANY worker (an unpinned
+  request is happy wherever it lands — the default-physics tenant);
+- a named pin must equal the worker's registered canonical value
+  (callers canonicalize spellings BEFORE routing — the controller
+  runs request pins through FaultSpec/TileSpec when the framework is
+  importable, and the worker registered canonical strings);
+- among matching workers, the least-loaded wins (fewest
+  occupied lanes + queued configs, ties by worker id — deterministic,
+  so a replayed stream routes identically);
+- when NOTHING matches, the least-loaded *swappable* worker is picked
+  as the hot-swap victim: its compiled program set is re-pinned to
+  the request's demands (unnamed pins keep the victim's current
+  value), which the AOT compile cache turns into a re-place +
+  cache-hit, not a cold start. Workers already mid-swap count as
+  matching their swap TARGET, so a burst of same-pin requests piles
+  onto one swap instead of flipping the whole fleet.
+
+Every function is a pure function of plain dicts so the scheduler
+logic unit-tests without devices (tests/test_fleet.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .table import PIN_KEYS
+
+
+def request_pins(req: dict) -> Dict[str, str]:
+    """The pins a request names (canonical-spelling responsibility
+    lies with the caller), keyed by PIN_KEYS subset."""
+    return {k: str(req[k]) for k in PIN_KEYS
+            if req.get(k) is not None}
+
+
+def effective_pins(row: dict) -> Dict[str, str]:
+    """The pins a worker row currently answers for: its swap TARGET
+    while a swap is pending (requests routed today are admitted by
+    the post-swap service), its registered set otherwise."""
+    pend = row.get("pending_swap")
+    if isinstance(pend, dict) and pend:
+        return {str(k): str(v) for k, v in pend.items()}
+    return {str(k): str(v)
+            for k, v in (row.get("pinned") or {}).items()}
+
+
+def worker_matches(pins: Dict[str, str], row: dict) -> bool:
+    """True when every pin the request names equals the worker's
+    effective value."""
+    mine = effective_pins(row)
+    return all(mine.get(k) == v for k, v in pins.items())
+
+
+def worker_load(row: dict) -> int:
+    """Occupied lanes + queued configs — the least-loaded metric for
+    both match choice and swap-victim choice."""
+    return (int(row.get("occupied_lanes", 0))
+            + int(row.get("pending_configs", 0)))
+
+
+def _least_loaded(rows: Dict[str, dict], candidates: List[str]
+                  ) -> Optional[str]:
+    if not candidates:
+        return None
+    return min(candidates, key=lambda w: (worker_load(rows[w]), w))
+
+
+def pick_worker(pins: Dict[str, str], rows: Dict[str, dict]
+                ) -> Optional[str]:
+    """The least-loaded worker matching every named pin; None when no
+    worker matches."""
+    return _least_loaded(rows, [w for w, r in rows.items()
+                                if worker_matches(pins, r)])
+
+
+def pick_swap_victim(pins: Dict[str, str], rows: Dict[str, dict]
+                     ) -> Optional[str]:
+    """The least-loaded worker NOT already mid-swap — swapping a
+    worker whose queue is already promised to a different program set
+    would strand those requests behind a second recompile. A request
+    pinning a NET is only swapped onto workers that registered that
+    net among their known solvers (`nets` row field; a row without
+    one accepts anything, the pre-nets compatibility case)."""
+    want_net = pins.get("net")
+
+    def can_serve(r: dict) -> bool:
+        if r.get("pending_swap"):
+            return False
+        nets = r.get("nets")
+        return (want_net is None or nets is None
+                or want_net in nets)
+
+    return _least_loaded(rows, [w for w, r in rows.items()
+                                if can_serve(r)])
+
+
+def swap_target(pins: Dict[str, str], row: dict) -> Dict[str, str]:
+    """The full pinned set the victim swaps to: the request's named
+    pins over the victim's current values (a request pinning only
+    `process` keeps the victim's dtype_policy/net/tiles)."""
+    target = {str(k): str(v)
+              for k, v in (row.get("pinned") or {}).items()}
+    target.update(pins)
+    return target
+
+
+def route(pins: Dict[str, str], rows: Dict[str, dict]
+          ) -> Tuple[Optional[str], Optional[Dict[str, str]]]:
+    """(worker id, swap pinned-set or None). (None, None) when the
+    table is empty or every worker is mid-swap to something else —
+    the request stays pending (and the scaler sees the backlog)."""
+    wid = pick_worker(pins, rows)
+    if wid is not None:
+        return wid, None
+    victim = pick_swap_victim(pins, rows)
+    if victim is None:
+        return None, None
+    return victim, swap_target(pins, rows[victim])
+
+
+def requeue_plan(assignments: Dict[str, dict], dead: List[str],
+                 finished: Dict[str, str]) -> List[str]:
+    """Which request ids a dead-worker sweep must requeue: assigned to
+    a dead worker AND not already terminal in the dead worker's spool
+    (`finished` maps request id -> terminal status for work the worker
+    completed before dying — that work harvests normally; re-running
+    it would break the byte-identity contract for no durability
+    gain). Pure bookkeeping — tests/test_fleet.py pins it."""
+    dead_set = set(dead)
+    return sorted(rid for rid, a in assignments.items()
+                  if a.get("worker") in dead_set
+                  and rid not in finished)

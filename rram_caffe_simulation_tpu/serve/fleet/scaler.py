@@ -1,0 +1,98 @@
+"""Backlog-EMA worker scaling — the admission controller's projection,
+lifted fleet-wide.
+
+`SweepService` projects a request's turnaround as backlog
+lane-iterations over a dispatch-rate EMA; the fleet scaler runs the
+same arithmetic over the WHOLE fleet each controller beat:
+
+    projected_s = total backlog lane-iters / aggregate fleet rate
+
+and steers the worker count toward keeping that projection inside the
+target window:
+
+- projection > `target_seconds` for `up_after` consecutive beats (and
+  the backlog is real, not one straggler request) -> scale UP;
+- projection < `down_factor * target_seconds` for `down_after`
+  consecutive beats AND at least one worker is fully idle -> scale
+  DOWN (draining a busy worker would requeue work just to save a
+  process);
+- while NO rate has been measured yet (cold fleet), pending work with
+  zero workers scales up — the bootstrap case.
+
+The hysteresis counters make the decision a pure fold over observed
+beats: `decide()` is deterministic given the observation sequence, so
+tests/test_fleet.py pins the exact scale-up/-down beat. No devices,
+no framework imports.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class BacklogScaler:
+    """One instance per FleetController; `decide()` once per beat."""
+
+    def __init__(self, target_seconds: float = 60.0,
+                 min_workers: int = 1, max_workers: int = 4,
+                 up_after: int = 3, down_after: int = 10,
+                 down_factor: float = 0.25, ema: float = 0.3):
+        if not (0 < float(ema) <= 1):
+            raise ValueError(f"ema {ema!r} must be in (0, 1]")
+        if int(min_workers) < 0 or int(max_workers) < int(min_workers):
+            raise ValueError(
+                f"worker bounds ({min_workers}, {max_workers}) must "
+                "satisfy 0 <= min <= max")
+        self.target_seconds = float(target_seconds)
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.up_after = max(int(up_after), 1)
+        self.down_after = max(int(down_after), 1)
+        self.down_factor = float(down_factor)
+        self.ema = float(ema)
+        self.projected_s: Optional[float] = None   # smoothed projection
+        self._over = 0
+        self._under = 0
+
+    def observe(self, backlog_iters: float, rate_iters_per_s: float
+                ) -> Optional[float]:
+        """Fold one beat's fleet totals into the projection EMA.
+        Returns the smoothed projection (None until a rate exists)."""
+        if rate_iters_per_s <= 0:
+            return self.projected_s
+        raw = float(backlog_iters) / float(rate_iters_per_s)
+        self.projected_s = (raw if self.projected_s is None
+                            else (1 - self.ema) * self.projected_s
+                            + self.ema * raw)
+        return self.projected_s
+
+    def decide(self, backlog_iters: float, rate_iters_per_s: float,
+               workers: int, idle_workers: int = 0) -> int:
+        """+1 (spawn), -1 (drain one idle worker), or 0. `workers`
+        counts live workers, `idle_workers` those with zero occupied
+        lanes and zero queued configs."""
+        projected = self.observe(backlog_iters, rate_iters_per_s)
+        # bootstrap: work waiting and nobody to run it
+        if workers < self.min_workers \
+                or (workers == 0 and backlog_iters > 0):
+            self._over = self._under = 0
+            return 1 if workers < self.max_workers else 0
+        if projected is None:
+            return 0
+        if projected > self.target_seconds and backlog_iters > 0:
+            self._over += 1
+            self._under = 0
+            if self._over >= self.up_after \
+                    and workers < self.max_workers:
+                self._over = 0
+                return 1
+            return 0
+        self._over = 0
+        if projected < self.down_factor * self.target_seconds:
+            self._under += 1
+            if self._under >= self.down_after \
+                    and workers > self.min_workers and idle_workers > 0:
+                self._under = 0
+                return -1
+            return 0
+        self._under = 0
+        return 0

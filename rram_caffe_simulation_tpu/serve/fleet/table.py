@@ -1,0 +1,145 @@
+"""The fleet worker table — registration + heartbeats through the
+fleet directory.
+
+One JSON file per worker under ``<fleet>/workers/<wid>.json`` (atomic
+temp-file + rename writes, same discipline as the spool). A row is the
+worker's self-description:
+
+- `pinned`: the compiled program set — canonical fault-process spec,
+  dtype_policy ("f32" when none), net name, canonical tile-mapping
+  spec, mesh descriptor — what the router matches request pins
+  against;
+- `heartbeat_time`: refreshed every worker tick; a row staler than the
+  controller's `heartbeat_timeout_s` declares the worker dead and its
+  in-flight requests requeue onto survivors (the at-least-once
+  completion contract, lifted one level);
+- load (`occupied_lanes`, `pending_configs`, `steps_per_sec`): what
+  the router's least-loaded choice and the scaler's projected-backlog
+  arithmetic read;
+- `pending_swap`: set while a hot-swap command is queued — the row
+  matches requests against the swap TARGET pins so the stream keeps
+  routing to the worker that is about to serve it.
+
+The worker's own service directory lives NEXT to its row
+(``<fleet>/workers/<wid>/``: a full SweepService dir — spool/,
+requests/, metrics.jsonl). Swap commands are a sibling control file
+(``<wid>.swap.json``) the worker consumes.
+
+Dependency-free (no jax): the controller, tests, and monitoring
+scripts read the table without dragging in the framework.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..spool import _atomic_write
+
+#: pin keys a worker row's `pinned` dict carries (and a request may
+#: name); "mesh" is registered for operators but never matched — any
+#: worker topology serves any request (SNIPPETS.md [2]'s "same code
+#: from 8 chips to 6000")
+PIN_KEYS = ("process", "dtype_policy", "net", "tiles")
+
+
+class WorkerTable:
+    """Filesystem view of ``<fleet>/workers/``."""
+
+    def __init__(self, fleet_dir: str):
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        self.root = os.path.join(self.fleet_dir, "workers")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _row_path(self, wid: str) -> str:
+        return os.path.join(self.root, f"{wid}.json")
+
+    def worker_dir(self, wid: str) -> str:
+        """The worker's own SweepService directory."""
+        return os.path.join(self.root, wid)
+
+    def swap_path(self, wid: str) -> str:
+        return os.path.join(self.root, f"{wid}.swap.json")
+
+    # ------------------------------------------------------------------
+    # worker side
+
+    def register(self, wid: str, row: dict) -> dict:
+        row = dict(row, worker=wid, registered_time=time.time(),
+                   heartbeat_time=time.time())
+        _atomic_write(self._row_path(wid), row)
+        return row
+
+    def heartbeat(self, wid: str, updates: Optional[dict] = None
+                  ) -> Optional[dict]:
+        """Refresh the row's heartbeat (+ load fields). None when the
+        row is gone — the controller declared this worker dead and
+        removed it; the worker should exit rather than resurrect a
+        row whose requests were already requeued elsewhere."""
+        row = self.read(wid)
+        if row is None:
+            return None
+        row.update(updates or {})
+        row["heartbeat_time"] = time.time()
+        _atomic_write(self._row_path(wid), row)
+        return row
+
+    def unregister(self, wid: str):
+        """Clean exit: the worker removes its own row (a MISSING row is
+        a clean departure; a STALE row is a death)."""
+        try:
+            os.remove(self._row_path(wid))
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------------
+    # swap commands (controller writes, worker consumes)
+
+    def command_swap(self, wid: str, pinned: Dict[str, str]):
+        _atomic_write(self.swap_path(wid),
+                      {"pinned": dict(pinned), "time": time.time()})
+
+    def read_swap(self, wid: str) -> Optional[dict]:
+        try:
+            with open(self.swap_path(wid)) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def clear_swap(self, wid: str):
+        try:
+            os.remove(self.swap_path(wid))
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------------
+    # controller side
+
+    def read(self, wid: str) -> Optional[dict]:
+        try:
+            with open(self._row_path(wid)) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def rows(self) -> Dict[str, dict]:
+        """Every registered worker row, keyed by worker id."""
+        out = {}
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".json") or name.endswith(".swap.json"):
+                continue
+            wid = name[:-len(".json")]
+            row = self.read(wid)
+            if row is not None:
+                out[wid] = row
+        return out
+
+    def ids(self) -> List[str]:
+        return sorted(self.rows())
+
+    def remove(self, wid: str):
+        """Controller-side removal of a dead worker's row (its service
+        directory is left on disk for post-mortems)."""
+        self.unregister(wid)
+        self.clear_swap(wid)

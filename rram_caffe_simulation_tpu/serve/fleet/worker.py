@@ -1,0 +1,475 @@
+"""FleetWorker — a pod-backed `SweepService` wrapped for the fleet.
+
+One worker process = one warm `SweepService` lane pool (its own mesh
+topology via ``--mesh``) living in ``<fleet>/workers/<name>/`` plus
+the fleet chores around it:
+
+- **registration + heartbeats** (table.py): the worker publishes its
+  pinned program set — canonical (fault_process, dtype_policy, net,
+  tiles, mesh) — and refreshes its row with live load every tick; a
+  worker whose row the controller removed (declared dead after a
+  stale heartbeat) stops serving instead of double-running requests
+  that already requeued elsewhere;
+- **hot program swap**: on a ``<name>.swap.json`` command the worker
+  pauses admission (race-free: the service checks the command file at
+  every admission pass), lets in-flight requests finish, then
+  ACTIVATES the service for the new pins. The previous service is
+  PARKED, not torn down — the resident program cache
+  (``--resident-programs``) keeps its compiled executables and device
+  state in memory, so swapping back to a set this worker held before
+  is a pure re-activation: zero compiles, zero persistent-cache
+  deserialization, swap = re-place state + program-cache hit. A
+  first-seen set builds fresh (the decoded-dataset cache and any
+  key-matching XLA entries from the ``--cache-dir`` snapshot soften
+  it). The measured latency, `resident` flag, and cache counter
+  delta land on a `worker` record (event "swap") plus a `span`
+  record in the worker's metrics stream;
+- **drain**: the controller's per-worker DRAIN file flows through the
+  service's normal drain path (in-flight work checkpointed, exit 75;
+  idle exit 0) and the worker unregisters its row — a clean departure
+  (missing row), distinct from a death (stale row).
+
+    python -m rram_caffe_simulation_tpu.serve.fleet.worker \\
+        --fleet-dir /runs/fleet --name w0 \\
+        --solver models/.../solver.prototxt --lanes 8 --chunk 8
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from .table import WorkerTable
+
+#: how many service scheduling beats run between fleet chores
+#: (heartbeat + swap check) — at the service's poll interval this
+#: bounds heartbeat staleness for an idle worker
+DEFAULT_TICK_BEATS = 2
+
+
+class FleetWorker:
+    """One fleet worker: service + registration + swap machinery."""
+
+    def __init__(self, fleet_dir: str, name: str, solver: str, *,
+                 nets: Optional[Dict[str, str]] = None,
+                 fault_process: Optional[str] = None,
+                 tile_spec: Optional[str] = None,
+                 dtype_policy: Optional[str] = None,
+                 net_name: Optional[str] = None,
+                 tick_beats: int = DEFAULT_TICK_BEATS,
+                 resident_programs: int = 2,
+                 service_kw: Optional[dict] = None):
+        self.table = WorkerTable(fleet_dir)
+        self.name = str(name)
+        self.dir = self.table.worker_dir(self.name)
+        #: net name -> solver prototxt path; swaps may only re-pin to
+        #: nets this worker was launched knowing about
+        self.nets = dict(nets or {})
+        if net_name is None:
+            net_name = os.path.splitext(os.path.basename(solver))[0]
+        self.nets.setdefault(net_name, solver)
+        self.tick_beats = max(int(tick_beats), 1)
+        self.service_kw = dict(service_kw or {})
+        #: the resident program cache: canonical-pinned-set -> PARKED
+        #: SweepService, compiled executables and all. Swapping back
+        #: to a resident set is a pure in-memory re-activation — zero
+        #: compiles, zero persistent-cache deserialization — which is
+        #: what makes the hot swap actually hot (and sidesteps a
+        #: jaxlib fragility: deserializing cached AOT executables
+        #: intermittently corrupts the heap on CPU jaxlib 0.4.36).
+        #: Dormant services keep their device state resident; size the
+        #: cache (`--resident-programs`) to the tenant shapes you
+        #: oscillate between and the accelerator memory you can spare.
+        self.resident_programs = max(int(resident_programs), 1)
+        self._resident: Dict[str, object] = {}
+        self.swap_count = 0
+        self.service = None
+        t0 = time.perf_counter()
+        self._construct(net_name, fault_process, tile_spec,
+                        dtype_policy)
+        self._setup_s = time.perf_counter() - t0
+        row = self._row_fields()
+        row["setup_s"] = round(self._setup_s, 3)
+        self.table.register(self.name, row)
+        self.service._log_service_record(self._worker_record(
+            "registered", pinned=self.service.pinned(),
+            lanes=self.service.runner.n))
+
+    # ------------------------------------------------------------------
+    # service construction + the resident program cache
+
+    @staticmethod
+    def _pin_key(pinned: Dict[str, str]) -> str:
+        return json.dumps({str(k): str(v) for k, v in pinned.items()},
+                          sort_keys=True)
+
+    def _sockets_enabled(self) -> bool:
+        return self.service_kw.get("socket_path", "") is not None
+
+    def _construct(self, net_name: str, fault_process, tile_spec,
+                   dtype_policy):
+        """Build a fresh SweepService for the pinned set, make it the
+        active one, and register it in the resident cache."""
+        from ..service import SweepService
+        solver = self.nets.get(net_name)
+        if solver is None:
+            raise ValueError(
+                f"worker {self.name} does not know net {net_name!r} "
+                f"(launched with {sorted(self.nets)}) — pass it via "
+                "--net NAME=SOLVER")
+        if dtype_policy in (None, "f32"):
+            dtype_policy = None
+        svc = SweepService(
+            solver, self.dir,
+            fault_process=fault_process, tile_spec=tile_spec,
+            dtype_policy=dtype_policy, net_name=net_name,
+            **self.service_kw)
+        # race-free swap ordering: the controller writes the swap
+        # command STRICTLY BEFORE routing mismatched requests into
+        # this spool, and the service checks this gate at every
+        # admission pass — so a freshly routed request can never be
+        # admitted (and pin-rejected) by the pre-swap program, however
+        # the file writes interleave with the serve loop
+        svc.admission_gate = (
+            lambda: self.table.read_swap(self.name) is None)
+        self.service = svc
+        self._resident[self._pin_key(svc.pinned())] = svc
+        self._evict_residents()
+        return svc
+
+    def _activate(self, target: Dict[str, str]) -> bool:
+        """Make the service for `target` active: a resident
+        re-activation when this worker held it before (True), a fresh
+        construction otherwise (False). The previous service is
+        PARKED, not closed — its compiled programs and device state
+        stay resident for the swap back."""
+        old = self.service
+        old.suspend_socket()
+        key = self._pin_key(target)
+        cached = self._resident.pop(key, None)
+        if cached is not None:
+            self._resident[key] = cached      # LRU bump
+            self.service = cached
+            cached.pause_admission = False
+            if self._sockets_enabled():
+                cached.resume_socket()
+            return True
+        self._construct(target.get("net", old.net_name),
+                        target.get("process"), target.get("tiles"),
+                        target.get("dtype_policy"))
+        return False
+
+    def _return_mismatched_pending(self, target: Dict[str, str]):
+        """Move still-pending worker-spool requests whose pins do not
+        match the swap TARGET back to the fleet spool (at the fleet
+        level they are `active`, claimed to us — requeue strips the
+        claim so the controller re-routes them)."""
+        from ..spool import Spool
+        from .controller import canonicalize_pins
+        from .router import request_pins
+        fleet_spool = None
+        for rid in self.service.spool.pending_ids():
+            req = self.service.spool.read(rid)
+            if req is None:
+                continue
+            try:
+                pins = canonicalize_pins(request_pins(req))
+            except ValueError:
+                continue   # the post-swap admission will reject it
+            if all(target.get(k) == v for k, v in pins.items()):
+                continue
+            if fleet_spool is None:
+                fleet_spool = Spool(os.path.join(self.table.fleet_dir,
+                                                 "spool"))
+            try:
+                fleet_spool.requeue(rid)
+            except (OSError, ValueError):
+                continue   # not fleet-claimed (direct submission)
+            try:
+                os.remove(self.service.spool._path("pending", rid))
+            except OSError:
+                pass
+            print(f"Fleet worker {self.name}: returned pending "
+                  f"request {rid} to the fleet spool (pins {pins} do "
+                  "not match the swap target)", flush=True)
+
+    def _evict_residents(self):
+        while len(self._resident) > self.resident_programs:
+            for key, svc in self._resident.items():
+                if svc is not self.service:
+                    del self._resident[key]
+                    svc.close()
+                    break
+            else:
+                return
+
+    # ------------------------------------------------------------------
+    # table plumbing
+
+    def _row_fields(self) -> dict:
+        import socket
+        view = self.service.stats()
+        return {
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "pinned": self.service.pinned(),
+            "nets": sorted(self.nets),
+            "lanes": int(view.get("lanes") or 0),
+            "occupied_lanes": int(view.get("occupied_lanes") or 0),
+            "pending_configs": int(view.get("pending_configs") or 0),
+            "steps_per_sec": float(view.get("steps_per_sec") or 0.0),
+            "swap_count": self.swap_count,
+        }
+
+    def _worker_record(self, event: str, **kw) -> dict:
+        from ...observe import make_worker_record
+        kw = {k: v for k, v in kw.items() if v is not None}
+        return make_worker_record(int(self.service.runner.iter),
+                                  self.name, event, **kw)
+
+    def _heartbeat(self) -> bool:
+        """Refresh the table row; False when the row is gone (the
+        controller declared this worker dead — stop serving)."""
+        return self.table.heartbeat(self.name,
+                                    self._row_fields()) is not None
+
+    # ------------------------------------------------------------------
+    # hot swap
+
+    def _maybe_swap(self) -> bool:
+        """Apply a queued swap command once no request is in flight.
+        Returns True when a swap was applied (the service object was
+        replaced)."""
+        cmd = self.table.read_swap(self.name)
+        if cmd is None:
+            return False
+        target = {str(k): str(v)
+                  for k, v in (cmd.get("pinned") or {}).items()}
+        if target == self.service.pinned():
+            self.table.clear_swap(self.name)
+            return False
+        # while the command stands, the admission gate holds pending
+        # requests for the rebuilt service whose pins they match;
+        # in-flight ones finish under the old program first
+        if self.service._active_ids():
+            return False
+        net_name = target.get("net", self.service.net_name)
+        if net_name not in self.nets:
+            # refusal protocol: clear the command so the controller's
+            # reconcile pass (swap file gone + row still un-re-pinned)
+            # drops its pending_swap overlay instead of wedging
+            self.table.clear_swap(self.name)
+            self.service._log_service_record(self._worker_record(
+                "swap_refused", pinned=target,
+                reason=f"unknown net {net_name!r} (worker knows "
+                       f"{sorted(self.nets)})"))
+            return False
+        # requests validly routed here BEFORE the swap command landed
+        # (they match the CURRENT pins, not the target) go back to the
+        # fleet spool for re-routing — the post-swap service would
+        # pin-reject them terminally otherwise
+        self._return_mismatched_pending(target)
+        from ... import cache as perf_cache
+        c0 = perf_cache.compile_cache_stats()
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        resident = self._activate(target)
+        # publish the new pins BEFORE clearing the command: the
+        # controller's reconcile pass distinguishes "applied" (row ==
+        # target) from "refused" (row unchanged) once the swap file is
+        # gone, so the row must never lag the clear
+        self.table.heartbeat(self.name, self._row_fields())
+        # consume the command BEFORE the warm beat (the gate opens),
+        # then run one serving beat INSIDE the swap window: a fresh
+        # program's XLA compiles are lazy (they fire at the first
+        # dispatched chunk), so this is where "re-place state +
+        # program-cache hit, not a cold start" is actually proven —
+        # the beat admits the requests that were waiting for the new
+        # pins and dispatches their first chunk (a RESIDENT
+        # re-activation's dispatch reuses the in-memory compiled
+        # executables: zero compiles of any kind)
+        self.table.clear_swap(self.name)
+        self.service.serve(max_beats=1)
+        swap_s = time.perf_counter() - t0
+        c1 = perf_cache.compile_cache_stats()
+        self.swap_count += 1
+        self.table.heartbeat(self.name, self._row_fields())
+        rec = self._worker_record(
+            "swap", pinned=self.service.pinned(), swap_s=swap_s,
+            resident=resident,
+            cache_hits=c1["hits"] - c0["hits"],
+            cache_misses=c1["misses"] - c0["misses"])
+        self.service._log_service_record(rec)
+        # the swap latency as a span on the fleet timeline (ISSUE 15):
+        # same record stream, Perfetto-ready shape
+        from ...observe.schema import SCHEMA_VERSION
+        self.service._log_service_record({
+            "schema_version": SCHEMA_VERSION, "type": "span",
+            "iter": int(self.service.runner.iter), "wall_time": wall0,
+            "name": "swap", "cat": "fleet", "kind": "span",
+            "dur_s": round(swap_s, 6), "thread": "fleet-worker",
+            "process": 0,
+            "args": {"worker": self.name,
+                     "process_spec": self.service.pinned()["process"]}})
+        print(f"Fleet worker {self.name} hot-swapped to "
+              f"{self.service.pinned()} in {swap_s:.2f} s "
+              f"({'RESIDENT program reactivated' if resident else 'fresh build'}"
+              f"; compile cache: +{c1['hits'] - c0['hits']} hits, "
+              f"+{c1['misses'] - c0['misses']} misses)", flush=True)
+        return True
+
+    # ------------------------------------------------------------------
+    # the loop
+
+    def run(self) -> int:
+        """Serve until drained (the controller's DRAIN file, SIGTERM
+        routed to `service.drain()`, or the controller removing our
+        row). Returns the service's drain exit code (0 idle / 75 with
+        checkpointed in-flight work)."""
+        try:
+            while True:
+                if not self._heartbeat():
+                    print(f"Fleet worker {self.name}: row removed by "
+                          "the controller (declared dead) — stopping "
+                          "so requeued work is not double-run",
+                          flush=True)
+                    return 0
+                self._maybe_swap()
+                code = self.service.serve(max_beats=self.tick_beats)
+                if self.service.drained:
+                    return code
+        finally:
+            self.table.unregister(self.name)
+            for svc in self._resident.values():
+                svc.close()   # idempotent; includes the active one
+
+
+def main(argv=None) -> int:
+    import argparse
+    import faulthandler
+    import signal
+    import sys
+
+    # fleet ops: a wedged worker can be asked for its Python stacks
+    # with SIGUSR1 (lands in the worker's log), and a native crash
+    # (SIGSEGV/SIGABRT) dumps tracebacks instead of dying silently
+    faulthandler.enable()
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+    p = argparse.ArgumentParser(
+        prog="rram-sweep-fleet-worker",
+        description="one fleet worker: a pod-backed SweepService with "
+                    "registration, heartbeats, and hot program swap "
+                    "(see serve/fleet/worker.py)")
+    p.add_argument("--fleet-dir", required=True)
+    p.add_argument("--name", required=True,
+                   help="worker id — the table row and service dir "
+                        "name; restart with the SAME name to resume "
+                        "its checkpointed work")
+    p.add_argument("--solver", required=True,
+                   help="solver prototxt for the default net")
+    p.add_argument("--net", action="append", default=[],
+                   metavar="NAME=SOLVER",
+                   help="extra net alias a swap may re-pin to "
+                        "(repeatable)")
+    p.add_argument("--net-name", default=None,
+                   help="name the default --solver registers under "
+                        "(default: file basename)")
+    p.add_argument("--fault-process", default=None)
+    p.add_argument("--tiles", default=None)
+    p.add_argument("--dtype-policy", default=None)
+    p.add_argument("--lanes", type=int, default=8)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--default-iters", type=int, default=100)
+    p.add_argument("--max-retries", type=int, default=1)
+    p.add_argument("--slo-seconds", type=float, default=0.0)
+    p.add_argument("--admission", default="queue",
+                   choices=["queue", "reject"])
+    p.add_argument("--poll-interval", type=float, default=0.5)
+    p.add_argument("--pipeline-depth", type=int, default=0)
+    p.add_argument("--mesh", default="",
+                   help="config mesh for THIS worker's lane pool "
+                        "(workers may run different topologies)")
+    p.add_argument("--trace", action="store_true")
+    p.add_argument("--save-fault-results", action="store_true")
+    p.add_argument("--allow-inject", action="store_true",
+                   help="TEST HOOK: honor requests' inject_nan field")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent compile/dataset cache root "
+                        "(RRAM_TPU_CACHE_DIR) — what makes a hot swap "
+                        "a cache hit instead of a recompile")
+    p.add_argument("--tick-beats", type=int,
+                   default=DEFAULT_TICK_BEATS,
+                   help="service beats between heartbeats/swap checks")
+    p.add_argument("--resident-programs", type=int, default=2,
+                   help="how many pinned program sets stay PARKED in "
+                        "memory (compiled executables + device state) "
+                        "so a swap back is a pure re-activation; size "
+                        "to the tenant shapes this worker oscillates "
+                        "between and the accelerator memory to spare")
+    args = p.parse_args(argv)
+
+    if args.cache_dir:
+        # PRIVATE per-worker snapshot of the shared warm cache
+        # (cache.clone_cache): N live jax processes sharing one
+        # persistent compilation cache intermittently corrupts
+        # deserialized executables, so each worker hard-links the
+        # completed entries into its own root at startup — the warm
+        # hits (and the hot-swap-as-cache-hit contract) survive, the
+        # cross-process races do not. One call then arms BOTH caches:
+        # the explicit root is latched as the active cache dir and
+        # dataset_cache_dir() resolves from it.
+        from ... import cache as perf_cache
+        private = os.path.join(os.path.abspath(args.cache_dir),
+                               f"worker-{args.name}")
+        n = perf_cache.clone_cache(args.cache_dir, private)
+        print(f"Fleet worker {args.name}: private cache snapshot at "
+              f"{private} ({n} entries linked)", flush=True)
+        # min_compile_time_s=0.05: only REAL programs (the chunk
+        # executables the hot swap re-places) ride the cache — the
+        # zeroed default would also cache every eager tiny-op
+        # executable, whose deserialization intermittently segfaults
+        # on this jaxlib (see enable_compilation_cache)
+        perf_cache.enable_compilation_cache(private,
+                                            min_compile_time_s=0.05)
+
+    nets = {}
+    for spec in args.net:
+        if "=" not in spec:
+            p.error(f"--net {spec!r} must be NAME=SOLVER")
+        nname, path = spec.split("=", 1)
+        nets[nname] = path
+
+    worker = FleetWorker(
+        args.fleet_dir, args.name, args.solver, nets=nets,
+        fault_process=args.fault_process, tile_spec=args.tiles,
+        dtype_policy=args.dtype_policy, net_name=args.net_name,
+        tick_beats=args.tick_beats,
+        resident_programs=args.resident_programs,
+        service_kw=dict(
+            lanes=args.lanes, chunk=args.chunk,
+            default_iters=args.default_iters,
+            max_retries=args.max_retries,
+            slo_seconds=args.slo_seconds, admission=args.admission,
+            poll_interval_s=args.poll_interval,
+            pipeline_depth=args.pipeline_depth,
+            mesh=args.mesh or None, trace=args.trace,
+            save_fault_results=args.save_fault_results,
+            allow_inject=args.allow_inject))
+
+    def _on_signal(signum, frame):
+        worker.service.drain()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"Fleet worker {worker.name} up: "
+          f"{json.dumps(worker.service.pinned())}", flush=True)
+    code = worker.run()
+    sys.stdout.flush()
+    return code
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -7,6 +7,15 @@ socket is absent — the service is down, draining, or started with
 the filesystem spool itself, so a request can always be handed off
 (the queue outlives the server; that is the point of the spool).
 
+Pointed at a FLEET directory (serve/fleet/ — it has a `workers/`
+table) the same client submits into the shared fleet spool, `stats`
+aggregates lanes/load/tenant shares across every worker, `status`
+follows the request to its assigned worker, and `tail` merges the
+per-worker record streams (a requeued request has one stream per
+attempt). `wait` exits with a DISTINCT code per outcome — 0
+completed, 1 failed, 2 rejected, and on timeout 3 preempted vs 4
+still-pending — so scripts branch without parsing JSON.
+
 Like spool.py this module is dependency-free (no jax, no framework
 imports): a monitoring script or another host sharing the filesystem
 can use it without dragging in the accelerator stack.
@@ -34,6 +43,37 @@ from .spool import Spool
 
 #: states reported by `status()` that end a request's lifecycle
 TERMINAL_STATES = ("completed", "failed", "rejected")
+
+#: CLI `wait` exit codes — distinct per outcome so scripts can branch
+#: (a failed sweep retries elsewhere, a preempted one waits for the
+#: resumed service, a still-pending one extends its timeout)
+WAIT_COMPLETED = 0
+WAIT_FAILED = 1
+WAIT_REJECTED = 2
+WAIT_PREEMPTED = 3     # timed out while preempted (service drained)
+WAIT_PENDING = 4       # timed out while still pending/running
+
+
+def wait_exit_code(req: Optional[dict]) -> int:
+    """Map a request payload to the CLI `wait` exit code. Non-terminal
+    payloads map to the timeout codes (preempted vs still-pending)."""
+    status = (req or {}).get("status", (req or {}).get("state"))
+    if status == "completed":
+        return WAIT_COMPLETED
+    if status == "failed":
+        return WAIT_FAILED
+    if status == "rejected":
+        return WAIT_REJECTED
+    if status == "preempted":
+        return WAIT_PREEMPTED
+    return WAIT_PENDING
+
+
+def is_fleet_dir(path: str) -> bool:
+    """True when `path` is a fleet directory (serve/fleet/): a worker
+    table lives under `workers/` — the client then aggregates across
+    the workers instead of expecting one service socket."""
+    return os.path.isdir(os.path.join(path, "workers"))
 
 
 class ServeClient:
@@ -87,6 +127,39 @@ class ServeClient:
         return self._spool
 
     # ------------------------------------------------------------------
+    # fleet directory support (serve/fleet/): the same client against
+    # a fleet root aggregates across the workers' service dirs
+
+    def _is_fleet(self) -> bool:
+        return is_fleet_dir(self.dir)
+
+    def _table(self):
+        """The fleet worker table (serve/fleet/table.py — like this
+        module it is dependency-free, so the client shares its
+        file-format knowledge instead of re-implementing it)."""
+        from .fleet.table import WorkerTable
+        return WorkerTable(self.dir)
+
+    def _worker_ids(self):
+        """Worker ids with a service directory under `workers/` —
+        includes departed/dead workers (no table row), whose streams
+        and spools still answer status/tail queries."""
+        root = os.path.join(self.dir, "workers")
+        try:
+            return sorted(n for n in os.listdir(root)
+                          if os.path.isdir(os.path.join(root, n)))
+        except FileNotFoundError:
+            return []
+
+    def _worker_client(self, wid: str) -> "ServeClient":
+        return ServeClient(self._table().worker_dir(wid),
+                           timeout_s=self.timeout_s)
+
+    def _worker_rows(self) -> dict:
+        """The worker table (registration + heartbeat rows)."""
+        return self._table().rows()
+
+    # ------------------------------------------------------------------
     # ops
 
     def ping(self) -> bool:
@@ -108,11 +181,32 @@ class ServeClient:
 
     def status(self, request_id: str) -> Optional[dict]:
         """The request's current payload (spool file merged with the
-        service's live progress when it answers); None = unknown id."""
+        service's live progress when it answers); None = unknown id.
+        Against a fleet directory the fleet spool answers, enriched
+        with the assigned worker's live view while the request is
+        routed."""
         resp = self._call({"op": "status", "id": request_id})
         if resp is not None:
             return resp["request"]
-        return self._spool_handle().read(request_id)
+        req = self._spool_handle().read(request_id)
+        if self._is_fleet():
+            if req is not None and req.get("state") == "active" \
+                    and req.get("worker"):
+                live = self._worker_client(req["worker"]) \
+                    .status(request_id)
+                if live is not None:
+                    merged = dict(req)
+                    merged.update(live)
+                    merged["worker"] = req["worker"]
+                    return merged
+            elif req is None:
+                # e.g. submitted straight to a worker, or a crashed
+                # controller: the worker spools still answer
+                for wid in self._worker_ids():
+                    live = self._worker_client(wid).status(request_id)
+                    if live is not None:
+                        return dict(live, worker=wid)
+        return req
 
     def result(self, request_id: str) -> Optional[dict]:
         """Alias of `status` — a terminal request's payload carries the
@@ -122,9 +216,59 @@ class ServeClient:
     def stats(self) -> Optional[dict]:
         """Service-level snapshot (lanes, occupancy, projection,
         per-tenant shares); None when the service is down (the spool
-        has no service-level view)."""
+        has no service-level view). Against a fleet directory the
+        snapshot AGGREGATES across workers: fleet totals, per-worker
+        pinned sets + live stats, per-tenant lane-iteration sums."""
         resp = self._call({"op": "stats"})
-        return resp["stats"] if resp is not None else None
+        if resp is not None:
+            return resp["stats"]
+        if not self._is_fleet():
+            return None
+        rows = self._worker_rows()
+        workers = {}
+        totals = {"lanes": 0, "occupied_lanes": 0,
+                  "pending_configs": 0, "steps_per_sec": 0.0}
+        tenant_iters = {}
+        req_counts = {}
+        for wid in self._worker_ids():
+            row = rows.get(wid)
+            entry = {"registered": row is not None}
+            if row is not None:
+                entry["pinned"] = row.get("pinned")
+                entry["heartbeat_age_s"] = round(
+                    max(time.time()
+                        - float(row.get("heartbeat_time", 0)), 0.0), 2)
+            ws = self._worker_client(wid).stats()
+            if ws is not None:
+                entry["stats"] = {k: ws.get(k) for k in
+                                  ("lanes", "occupied_lanes",
+                                   "pending_configs", "steps_per_sec",
+                                   "projected_s", "occupancy", "slo",
+                                   "iter")}
+                for k in totals:
+                    totals[k] += ws.get(k) or 0
+                for t, v in (ws.get("tenant_lane_iters")
+                             or {}).items():
+                    tenant_iters[t] = tenant_iters.get(t, 0) + int(v)
+                for s, n in (ws.get("requests") or {}).items():
+                    req_counts[s] = req_counts.get(s, 0) + int(n)
+            elif row is not None:
+                # service socket down: the heartbeat row's load fields
+                # are the freshest view we have
+                for k in totals:
+                    totals[k] += row.get(k) or 0
+            workers[wid] = entry
+        totals["steps_per_sec"] = round(totals["steps_per_sec"], 4)
+        return {
+            "fleet": True,
+            "workers": workers,
+            "alive_workers": len(rows),
+            "pending_requests":
+                len(self._spool_handle().pending_ids()),
+            "tenant_lane_iters": tenant_iters,
+            "requests": req_counts,
+            **totals,
+        }
 
     def drain(self) -> bool:
         """Ask the service to drain gracefully. Socket down -> drop the
@@ -167,14 +311,46 @@ class ServeClient:
         `follow`, keeps reading until a terminal record (or
         `timeout_s`). The stream is per-request, so a tenant tails
         their own request without seeing anyone else's."""
-        path = self.records_path(request_id)
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
-        pos = 0
+        if self._is_fleet():
+            # a fleet request's stream lives with whichever worker(s)
+            # served it — a requeued request has one stream per
+            # attempt, so re-scan the worker set each poll and tag
+            # each record with its worker. The terminal record lands
+            # on the final attempt's stream only.
+            pos: dict = {}
+            while True:
+                for wid in self._worker_ids():
+                    path = os.path.join(self.dir, "workers", wid,
+                                        "requests",
+                                        f"{request_id}.jsonl")
+                    if not os.path.exists(path):
+                        continue
+                    with open(path) as f:
+                        f.seek(pos.get(path, 0))
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            rec = json.loads(line)
+                            rec.setdefault("worker", wid)
+                            yield rec
+                            if rec.get("event") in TERMINAL_STATES:
+                                return
+                        pos[path] = f.tell()
+                if not follow:
+                    return
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    return
+                time.sleep(poll_s)
+        path = self.records_path(request_id)
+        fpos = 0
         while True:
             if os.path.exists(path):
                 with open(path) as f:
-                    f.seek(pos)
+                    f.seek(fpos)
                     for line in f:
                         line = line.strip()
                         if not line:
@@ -183,12 +359,32 @@ class ServeClient:
                         yield rec
                         if rec.get("event") in TERMINAL_STATES:
                             return
-                    pos = f.tell()
+                    fpos = f.tell()
             if not follow:
                 return
             if deadline is not None and time.monotonic() >= deadline:
                 return
             time.sleep(poll_s)
+
+
+def _wait_and_report(client: ServeClient, request_id: str,
+                     timeout_s: float) -> int:
+    """The CLI `wait` contract: print the terminal payload and exit
+    with a DISTINCT code per outcome (wait_exit_code) — 0 completed,
+    1 failed, 2 rejected; on timeout, 3 while preempted (a drained
+    service holds the checkpointed request) vs 4 still
+    pending/running — so scripts branch without parsing JSON."""
+    import sys
+    try:
+        req = client.wait(request_id, timeout_s=timeout_s)
+    except TimeoutError:
+        req = client.status(request_id) or {}
+        state = req.get("status", req.get("state", "unknown"))
+        print(f"timeout: request {request_id} not terminal after "
+              f"{timeout_s:g} s (last: {state})", file=sys.stderr)
+        return wait_exit_code(req)
+    print(json.dumps(req, indent=2))
+    return wait_exit_code(req)
 
 
 def main(argv=None) -> int:
@@ -216,6 +412,16 @@ def main(argv=None) -> int:
                          "configs")
     sp.add_argument("--iters", type=int, default=0,
                     help="iteration budget (0 = service default)")
+    sp.add_argument("--process", default=None,
+                    help="fault-process pin (fleet: routes to a "
+                         "matching worker or hot-swaps one; single "
+                         "service: must match its compiled physics)")
+    sp.add_argument("--tiles", default=None,
+                    help="tile-mapping pin (same contract)")
+    sp.add_argument("--dtype-policy", default=None,
+                    help="quantized-mode pin ('f32'|'ternary'|'int8')")
+    sp.add_argument("--net", default=None,
+                    help="net-name pin (the worker-table net name)")
     sp.add_argument("--tenant", default="default")
     sp.add_argument("--id", default=None,
                     help="explicit request id (default: generated)")
@@ -262,9 +468,13 @@ def main(argv=None) -> int:
             req["iters"] = args.iters
         if args.id:
             req["id"] = args.id
+        for pin in ("process", "tiles", "dtype_policy", "net"):
+            val = getattr(args, pin)
+            if val:
+                req[pin] = val
         out = client.submit(req)
         if args.wait:
-            out = client.wait(out["id"], timeout_s=args.timeout)
+            return _wait_and_report(client, out["id"], args.timeout)
         print(json.dumps(out, indent=2))
         return 0
     if args.op in ("status", "result"):
@@ -275,13 +485,20 @@ def main(argv=None) -> int:
         print(json.dumps(req, indent=2))
         return 0
     if args.op == "wait":
-        req = client.wait(args.id, timeout_s=args.timeout)
-        print(json.dumps(req, indent=2))
-        return 0 if req.get("status") == "completed" else 1
+        return _wait_and_report(client, args.id, args.timeout)
     if args.op == "tail":
-        for rec in client.tail(args.id, follow=not args.no_follow,
-                               timeout_s=args.timeout):
-            print(json.dumps(rec), flush=True)
+        try:
+            for rec in client.tail(args.id,
+                                   follow=not args.no_follow,
+                                   timeout_s=args.timeout):
+                print(json.dumps(rec), flush=True)
+        except BrokenPipeError:
+            # `tail ... | head` closed the pipe — that is the reader
+            # saying "enough", not an error
+            try:
+                sys.stdout.close()
+            except OSError:
+                pass
         return 0
     if args.op == "stats":
         stats = client.stats()

@@ -116,6 +116,8 @@ class SweepService:
                  mesh=None,
                  trace: bool = False,
                  profile_dir: Optional[str] = None,
+                 fault_process=None, tile_spec=None,
+                 dtype_policy=None, net_name: Optional[str] = None,
                  runner_kw: Optional[dict] = None):
         from ..observe import JsonlSink
         from ..observe.spans import OccupancyAggregator, SloAccountant
@@ -155,10 +157,37 @@ class SweepService:
         self._requests: Dict[str, dict] = {}   # id -> table entry
         self._cfg_req: Dict[int, str] = {}     # global config id -> id
         self._closed = False
+        #: fleet-worker hooks (serve/fleet/worker.py): with
+        #: `pause_admission` set, the loop leaves pending spool
+        #: requests untouched (a hot program swap is queued — they
+        #: will be admitted by the REBUILT service, whose pins they
+        #: match); `admission_gate` is the race-free version — a
+        #: callable checked at EVERY admission pass (the fleet worker
+        #: points it at its swap-command file, which the controller
+        #: writes strictly BEFORE routing mismatched requests into
+        #: this spool, so they can never be mis-admitted — and
+        #: mis-REJECTED — by the pre-swap program); `drained` records
+        #: that serve() returned through the drain path, so a wrapper
+        #: driving serve(max_beats=...) in a loop can tell a drain
+        #: from an exhausted beat budget.
+        self.pause_admission = False
+        self.admission_gate = None
+        self.drained = False
 
+        # the pinned program set (serve/fleet/): this service compiles
+        # ONE (fault_process, dtype_policy, net, tile_spec) — requests
+        # pinning anything else are refused at admission, and the fleet
+        # router sends them to a matching worker (or hot-swaps one)
+        # instead. `net_name` is the short name the worker table
+        # registers (defaults to the solver prototxt's basename).
         param = (read_solver_param(solver_param)
                  if isinstance(solver_param, (str, os.PathLike))
                  else solver_param)
+        if net_name is None and isinstance(solver_param,
+                                           (str, os.PathLike)):
+            net_name = os.path.splitext(
+                os.path.basename(str(solver_param)))[0]
+        self.net_name = str(net_name) if net_name else "default"
         if param.random_seed < 0:
             raise ValueError(
                 "SweepService needs solver random_seed >= 0: request "
@@ -174,7 +203,8 @@ class SweepService:
         param.ClearField("test_interval")
 
         resuming = os.path.exists(self._state_path())
-        self.solver = Solver(param)
+        self.solver = Solver(param, fault_process=fault_process,
+                             tile_spec=tile_spec)
         self.solver.enable_metrics(JsonlSink(
             os.path.join(self.dir, "metrics.jsonl"), append=resuming,
             unbuffered=True))
@@ -187,10 +217,13 @@ class SweepService:
         if isinstance(mesh, str):
             from ..parallel import mesh_from_spec
             mesh = mesh_from_spec(mesh)
+        runner_kw = dict(runner_kw or {})
+        if dtype_policy is not None:
+            runner_kw.setdefault("dtype_policy", dtype_policy)
         self.runner = SweepRunner(self.solver, n_configs=int(lanes),
                                   pipeline_depth=int(pipeline_depth),
                                   mesh=mesh,
-                                  **(runner_kw or {}))
+                                  **runner_kw)
         self.runner.enable_self_healing(
             budget=self.default_iters, max_retries=int(max_retries),
             backoff_iters=int(retry_backoff), start_empty=True,
@@ -373,7 +406,29 @@ class SweepService:
         pin is compared against."""
         return self.runner._process_canonical()
 
+    def pinned(self) -> Dict[str, str]:
+        """The canonical pinned program set this service compiled —
+        what the fleet worker table registers and the router matches
+        request pins against."""
+        mesh_axes = dict(getattr(self.runner.mesh, "shape", {}) or {})
+        mesh_desc = ("single" if not mesh_axes
+                     or all(v == 1 for v in mesh_axes.values())
+                     else ",".join(f"{k}={v}"
+                                   for k, v in sorted(mesh_axes.items())))
+        return {
+            "process": self._process_canonical(),
+            "dtype_policy": str(self.runner.dtype_policy or "f32"),
+            "net": self.net_name,
+            "tiles": self.runner._tile_canonical(),
+            "mesh": mesh_desc,
+        }
+
     def _admit_pending(self) -> int:
+        if self.pause_admission or (self.admission_gate is not None
+                                    and not self.admission_gate()):
+            # a hot swap is queued (serve/fleet/): pending requests
+            # wait for the rebuilt service whose pins they match
+            return 0
         admitted = 0
         for rid in self.spool.pending_ids():
             try:
@@ -460,6 +515,22 @@ class SweepService:
                                       f"{want_t!r} but this service "
                                       f"maps crossbars as {mine_t!r}")
                     continue
+            want_dp = req.get("dtype_policy")
+            if want_dp is not None:
+                # same contract again: the lane pool compiled ONE
+                # quantized sweep mode ("f32" = no policy)
+                mine_dp = str(self.runner.dtype_policy or "f32")
+                if want_dp != mine_dp:
+                    self._reject(req, f"request pins dtype_policy "
+                                      f"{want_dp!r} but this service "
+                                      f"runs {mine_dp!r}")
+                    continue
+            want_net = req.get("net")
+            if want_net is not None and want_net != self.net_name:
+                self._reject(req, f"request pins net {want_net!r} but "
+                                  f"this service trains "
+                                  f"{self.net_name!r}")
+                continue
             extra = req["iters"] * len(req["configs"])
             projected = self._projected_seconds(extra)
             at_risk = (self.slo_seconds > 0 and projected
@@ -634,7 +705,12 @@ class SweepService:
         rows = {}
         for name, v in fault_engine.iter_state_leaves(
                 self.runner.fault_states):
-            rows[name] = np.asarray(v[lane])
+            # .copy() is load-bearing: on the CPU backend np.asarray
+            # of the temporary `v[lane]` can be a ZERO-COPY view of an
+            # XLA buffer that is freed as soon as the jax array is
+            # collected — the npz written at harvest (beats later)
+            # would then serialize reused memory
+            rows[name] = np.asarray(v[lane]).copy()
         self._lane_results[int(cfg)] = rows
 
     def _save_fault_rows(self, rid: str, cfg: int):
@@ -886,6 +962,7 @@ class SweepService:
             os.remove(os.path.join(self.dir, "DRAIN"))
         except OSError:
             pass
+        self.drained = True
         in_flight = self._active_ids()
         if not in_flight and self.runner.healing_complete():
             try:
@@ -899,8 +976,17 @@ class SweepService:
         self.runner.checkpoint(self._ckpt_path())
         for rid in in_flight:
             # visible in stats()/state.json; _resume recomputes
-            # admitted/running from start_time when the lanes restore
+            # admitted/running from start_time when the lanes restore.
+            # The SPOOL file gets the status too: a client polling a
+            # drained (exited) service has only the spool to read, and
+            # `wait`'s distinct preempted-vs-pending exit codes depend
+            # on seeing it there.
             self._requests[rid]["status"] = "preempted"
+            try:
+                self.spool.update(rid, "active",
+                                  {"status": "preempted"})
+            except OSError:
+                pass
         self._write_state(with_checkpoint=True)
         for rid in in_flight:
             entry = self._requests[rid]
@@ -950,6 +1036,13 @@ class SweepService:
                 self._requests[rid] = entry
                 for cfg in entry["cfg_ids"]:
                     self._cfg_req[int(cfg)] = rid
+                try:
+                    # clear the drain's persisted "preempted" so spool
+                    # readers see the request live again
+                    self.spool.update(rid, "active",
+                                      {"status": entry["status"]})
+                except OSError:
+                    pass
                 self._emit_request(entry, "resumed",
                                   configs=entry["configs_total"],
                                   done=entry.get("done", 0))
@@ -1019,10 +1112,33 @@ class SweepService:
             for cfg in ids:
                 self._cfg_req[cfg] = rid
         self.spool.update(rid, "active", {"cfg_ids": ids,
-                                          "iters_granted": granted})
+                                          "iters_granted": granted,
+                                          "status": "admitted"})
         self._emit_request(entry, "resumed",
                           configs=entry["configs_total"], done=0,
                           reason=reason)
+
+    def suspend_socket(self):
+        """Stop the Unix-socket front door without closing the service
+        (serve/fleet/ parks dormant resident-program services; two
+        services must never race for one socket path)."""
+        if self._sock_server is not None:
+            # the successor service owns the socket path from here —
+            # a handler outliving stop()'s bounded join must not
+            # unlink the re-bound socket on its way out
+            self._sock_server._unlink_on_exit = False
+            self._sock_server.stop()
+            self._sock_server = None
+
+    def resume_socket(self, socket_path: Optional[str] = None):
+        """Re-open the front door after `suspend_socket` (fleet
+        reactivation)."""
+        if self._sock_server is not None or self._closed:
+            return
+        path = socket_path or os.path.join(self.dir, "service.sock")
+        if len(path) <= _MAX_SOCK_PATH:
+            self._sock_server = _SocketServer(self, path)
+            self._sock_server.start()
 
     def close(self):
         if self._closed:
@@ -1056,6 +1172,11 @@ class _SocketServer(threading.Thread):
         super().__init__(daemon=True, name="serve-frontdoor")
         self.service = service
         self.path = path
+        #: cleared by suspend_socket: a handler can outlive stop()'s
+        #: bounded join (conn recv timeout 5 s > join 2 s), and this
+        #: thread's exit path must then NOT unlink a path a successor
+        #: server (fleet hot swap) has already re-bound
+        self._unlink_on_exit = True
         try:
             os.remove(path)
         except OSError:
@@ -1082,10 +1203,11 @@ class _SocketServer(threading.Thread):
             finally:
                 conn.close()
         self._sock.close()
-        try:
-            os.remove(self.path)
-        except OSError:
-            pass
+        if self._unlink_on_exit:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
 
     def _handle(self, conn):
         conn.settimeout(5.0)
@@ -1192,6 +1314,21 @@ def main(argv=None) -> int:
                         "rows to requests/<id>.cfg<N>.faults.npz "
                         "(the byte-identity evidence the CI guard "
                         "compares)")
+    p.add_argument("--fault-process", default=None,
+                   help="fault-process spec the lane pool compiles "
+                        "(fault/processes/ registry; default "
+                        "endurance_stuck_at) — the service's pinned "
+                        "physics, matched against request 'process' "
+                        "pins")
+    p.add_argument("--tiles", default=None,
+                   help="tiled crossbar mapping spec (fault/mapping.py;"
+                        " default 1x1) — the pinned mapping")
+    p.add_argument("--dtype-policy", default=None,
+                   help="quantized sweep mode ('ternary' | 'int8'; "
+                        "default f32) — the pinned precision")
+    p.add_argument("--net-name", default=None,
+                   help="short net name for the worker table / request "
+                        "'net' pins (default: solver file basename)")
     p.add_argument("--mesh", default="",
                    help="config mesh for the lane pool, e.g. "
                         "'config=4' or 'config=all' — the warm lanes "
@@ -1228,7 +1365,9 @@ def main(argv=None) -> int:
         allow_inject=args.allow_inject,
         save_fault_results=args.save_fault_results,
         mesh=args.mesh or None,
-        trace=args.trace, profile_dir=args.profile_dir or None)
+        trace=args.trace, profile_dir=args.profile_dir or None,
+        fault_process=args.fault_process, tile_spec=args.tiles,
+        dtype_policy=args.dtype_policy, net_name=args.net_name)
 
     def _on_signal(signum, frame):
         service.drain()

@@ -110,6 +110,30 @@ def normalize_request(req: dict, default_iters: int = 0) -> dict:
                 "mapping spec string (at most 64 chars, e.g. '1x1' "
                 "or 'cells=256x256')")
         out["tiles"] = tiles.strip()
+    dp = out.get("dtype_policy")
+    if dp is not None:
+        # optional quantized-sweep-mode pin ("f32" | "ternary" |
+        # "int8"): like the process pin, a resident lane pool compiles
+        # ONE dtype policy, so a request naming a different one is
+        # routed to a matching fleet worker (or hot-swaps one) rather
+        # than silently served at the wrong precision. The legal-value
+        # check happens at admission (the spool stays dependency-free).
+        if not isinstance(dp, str) or not dp.strip() or len(dp) > 32:
+            raise ValueError(
+                f"request dtype_policy {dp!r} must be a non-empty "
+                "string of at most 32 chars (e.g. 'f32', 'ternary')")
+        out["dtype_policy"] = dp.strip()
+    net = out.get("net")
+    if net is not None:
+        # optional net pin: the short name a fleet worker registered
+        # its solver's net under — a request naming a different net is
+        # routed/swapped, never silently trained on the wrong model
+        if not isinstance(net, str) or not net.strip() \
+                or len(net) > 128:
+            raise ValueError(
+                f"request net {net!r} must be a non-empty string of "
+                "at most 128 chars (the worker-table net name)")
+        out["net"] = net.strip()
     iters = out.get("iters") or default_iters
     if not iters:
         # no explicit budget and no default known HERE (e.g. the
@@ -212,6 +236,26 @@ class Spool:
         if updates:
             req.update(updates)
         _atomic_write(self._path(dst, request_id), req)
+        os.remove(path)
+        return req
+
+    def requeue(self, request_id: str,
+                drop: tuple = ("cfg_ids", "iters_granted", "status",
+                               "worker", "submit_seen")) -> dict:
+        """active -> pending: put a claimed request back on the queue
+        (the fleet controller's dead-worker path — at-least-once
+        completion, lifted one level). The previous claimant's
+        bookkeeping fields are dropped so the next pickup starts a
+        fresh attempt; `submit_time` survives, so the request's
+        terminal `latency_s` spans the WHOLE fleet turnaround
+        including the failed attempt."""
+        path = self._path("active", request_id)
+        with open(path) as f:
+            req = json.load(f)
+        for key in drop:
+            req.pop(key, None)
+        req["requeues"] = int(req.get("requeues", 0)) + 1
+        _atomic_write(self._path("pending", request_id), req)
         os.remove(path)
         return req
 

@@ -195,16 +195,44 @@ def _natural_key(name):
 _PROC_RE = re.compile(r"^(?P<stem>.+)\.p(?P<proc>\d+)\.jsonl$")
 
 
+def _dir_metric_files(p):
+    return sorted(
+        (f for f in os.listdir(p)
+         if f.startswith("metrics") and f.endswith(".jsonl")),
+        key=_natural_key)
+
+
 def _expand_metric_paths(paths):
     """Directories (a sweep run dir, a service dir) expand to their
-    `metrics*.jsonl` streams in natural order; files pass through."""
+    `metrics*.jsonl` streams in natural order; files pass through. A
+    FLEET directory (serve/fleet/ — it has a `workers/` table)
+    expands to the controller's `fleet.jsonl` plus every worker's
+    service streams, so one digest covers the whole fleet; every
+    stream shares the wall epoch the span layer anchored (PR 14), so
+    the merge needs no clock reconciliation."""
     out = []
     for p in paths:
         if os.path.isdir(p):
-            names = sorted(
-                (f for f in os.listdir(p)
-                 if f.startswith("metrics") and f.endswith(".jsonl")),
-                key=_natural_key)
+            workers = os.path.join(p, "workers")
+            if os.path.isdir(workers):
+                found = []
+                fl = os.path.join(p, "fleet.jsonl")
+                if os.path.exists(fl):
+                    found.append(fl)
+                for wid in sorted(os.listdir(workers),
+                                  key=_natural_key):
+                    wdir = os.path.join(workers, wid)
+                    if not os.path.isdir(wdir):
+                        continue
+                    found += [os.path.join(wdir, n)
+                              for n in _dir_metric_files(wdir)]
+                if not found:
+                    raise FileNotFoundError(
+                        f"{p}: fleet directory has no fleet.jsonl or "
+                        "worker metrics*.jsonl streams yet")
+                out += found
+                continue
+            names = _dir_metric_files(p)
             if not names:
                 raise FileNotFoundError(
                     f"{p}: no metrics*.jsonl streams in directory")
@@ -281,7 +309,7 @@ def merge_metric_streams(paths):
 
 def _classify(streams):
     """Split merged stream records into the digest buckets."""
-    recs, retries, requests, spans = [], [], [], []
+    recs, retries, requests, spans, workers = [], [], [], [], []
     n_typed = 0
     for _, stream in streams:
         for rec in stream:
@@ -292,6 +320,8 @@ def _classify(streams):
                 requests.append(rec)
             elif rtype == "span":
                 spans.append(rec)
+            elif rtype == "worker":
+                workers.append(rec)
             elif rtype is not None:
                 # debug_trace / sentinel / setup records ride the same
                 # sink; the digest summarizes the display-interval
@@ -299,7 +329,35 @@ def _classify(streams):
                 n_typed += 1
             else:
                 recs.append(rec)
-    return recs, retries, requests, spans, n_typed
+    return recs, retries, requests, spans, workers, n_typed
+
+
+def _worker_digest(workers):
+    """Digest of fleet `worker` lifecycle records: per-event counts
+    plus the hot-swap evidence (latency + compile-cache hit ratio —
+    the 'swap, not cold start' claim in numbers)."""
+    by_event = {}
+    for r in workers:
+        by_event.setdefault(r.get("event", "?"), []).append(r)
+    parts = [f"{len(v)} {k}" for k, v in sorted(by_event.items())]
+    lines = [f"Fleet worker events ({len(workers)}): "
+             + ", ".join(parts)]
+    swaps = [r for r in by_event.get("swap", [])
+             if isinstance(r.get("swap_s"), (int, float))]
+    if swaps:
+        secs = [r["swap_s"] for r in swaps]
+        hits = sum(int(r.get("cache_hits", 0)) for r in swaps)
+        misses = sum(int(r.get("cache_misses", 0)) for r in swaps)
+        res = sum(1 for r in swaps if r.get("resident"))
+        lines.append(
+            f"Hot swaps: {len(swaps)}, mean {float(np.mean(secs)):g} s"
+            f" (max {max(secs):g} s), {res} resident reactivations, "
+            f"compile cache {hits} hits / {misses} misses across "
+            "swaps")
+    for r in by_event.get("dead", []):
+        lines.append(f"  worker {r.get('worker')} died: "
+                     f"{r.get('reason', '?')}")
+    return lines
 
 
 def summarize_metrics(paths):
@@ -311,14 +369,20 @@ def summarize_metrics(paths):
         paths = [paths]
     files = _expand_metric_paths(paths)
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, n_typed = _classify(streams)
+    recs, retries, requests, spans, workers, n_typed = \
+        _classify(streams)
     path = files[0] if len(files) == 1 else \
         f"{len(files)} files, {len(streams)} stream(s)"
-    if not recs and requests:
-        # a per-request stream (sweep service) carries lifecycle
-        # records only — digest those without demanding metrics
-        return "\n".join([f"Metrics log: {path}"]
-                         + _request_digest(requests))
+    if not recs and (requests or workers):
+        # a per-request stream (sweep service) or a controller-only
+        # fleet stream carries lifecycle records only — digest those
+        # without demanding metrics
+        lines = [f"Metrics log: {path}"]
+        if workers:
+            lines += _worker_digest(workers)
+        if requests:
+            lines += _request_digest(requests)
+        return "\n".join(lines)
     if not recs:
         return f"{path}: no records"
     first, last = recs[0], recs[-1]
@@ -366,6 +430,8 @@ def summarize_metrics(paths):
             diag = r.get("diagnosis") or "no diagnosis"
             lines.append(f"  config {r.get('config')} failed after "
                          f"{r.get('attempt')} attempt(s): {diag}")
+    if workers:
+        lines += _worker_digest(workers)
     if requests:
         lines += _request_digest(requests)
     lmap = last.get("lane_map")
@@ -446,22 +512,28 @@ def summarize_metrics(paths):
     return "\n".join(lines)
 
 
-def summarize_timeline(paths):
-    """The span-tracer view of a run/service directory (or explicit
-    files): fleet-wide lane occupancy (exact lane-iteration accounting
-    over every process's `lane_map` records), the per-phase host time
-    breakdown from `span` records, healing/lifecycle instants, and
-    per-request latency percentiles with the projected-vs-achieved
-    comparison the SLO accounting is about."""
-    from ..observe.spans import (OccupancyAggregator,
+def summarize_timeline(paths, slo_seconds: float = 0.0):
+    """The span-tracer view of a run/service/FLEET directory (or
+    explicit files): fleet-wide lane occupancy (exact lane-iteration
+    accounting over every worker's and process's `lane_map` records,
+    merged on the shared wall epoch), the per-phase host time
+    breakdown from `span` records, fleet worker lifecycle events,
+    healing/lifecycle instants, and per-request latency percentiles
+    plus the per-tenant SLO burn ledger (pass `slo_seconds` /
+    `--slo-seconds` for burn + violation rates; without a window the
+    ledger still reports per-tenant turnaround and projection
+    bias)."""
+    from ..observe.spans import (OccupancyAggregator, SloAccountant,
                                  latency_percentiles, phase_breakdown)
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     files = _expand_metric_paths(paths)
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, _ = _classify(streams)
+    recs, retries, requests, spans, workers, _ = _classify(streams)
     lines = [f"Timeline: {len(files)} file(s), "
              f"{len(streams)} stream(s)"] + notes
+    if workers:
+        lines += _worker_digest(workers)
 
     # --- fleet-wide lane occupancy (ROADMAP item 2's >90 % bar) ---
     occ = OccupancyAggregator()
@@ -540,14 +612,38 @@ def summarize_timeline(paths):
             f"Request latency ({pct['n']} terminal requests): "
             f"p50 {pct['p50_s']:g} s, p90 {pct['p90_s']:g} s, "
             f"p99 {pct['p99_s']:g} s, max {pct['max_s']:g} s")
+        # per-tenant SLO burn (observe/spans.py SloAccountant): the
+        # turnaround ledger a fleet operator steers by — with a
+        # window, burn + violation rates; always mean/max latency and
+        # the projection bias vs the admission EMA
+        slo = SloAccountant(slo_seconds)
+        for r in terminal:
+            slo.record(r.get("tenant", "?"), r["latency_s"],
+                       projected_s=r.get("projected_s"))
+        ledger = slo.summary() or {}
         by_tenant = {}
         for r in terminal:
             by_tenant.setdefault(r.get("tenant", "?"), []).append(r)
         for tenant in sorted(by_tenant):
             rs = by_tenant[tenant]
             tp = latency_percentiles([r["latency_s"] for r in rs])
-            lines.append(f"  tenant {tenant}: n={tp['n']} "
-                         f"p50 {tp['p50_s']:g} s max {tp['max_s']:g} s")
+            line = (f"  tenant {tenant}: n={tp['n']} "
+                    f"p50 {tp['p50_s']:g} s max {tp['max_s']:g} s")
+            entry = ledger.get(tenant, {})
+            if "burn_rate" in entry:
+                line += (f", SLO burn {entry['burn_rate']:g}x, "
+                         f"{entry['violations']}/{entry['requests']} "
+                         "violations")
+            if "projection_bias" in entry:
+                line += (f", achieved/projected "
+                         f"{entry['projection_bias']:g}x")
+            lines.append(line)
+        total = ledger.get("_total", {})
+        if "burn_rate" in total:
+            lines.append(
+                f"  fleet SLO burn (window {slo_seconds:g} s): "
+                f"{total['burn_rate']:g}x, "
+                f"violation rate {total['violation_rate']:g}")
         proj = [(r["latency_s"], r["projected_s"]) for r in terminal
                 if isinstance(r.get("projected_s"), (int, float))
                 and r["projected_s"] > 0]
@@ -579,7 +675,12 @@ def main(argv=None):
     p.add_argument("--timeline", action="store_true",
                    help="render the span-tracer view: fleet lane "
                         "occupancy, per-phase host time breakdown, "
-                        "and per-request latency percentiles")
+                        "worker lifecycle events, and per-request "
+                        "latency percentiles + per-tenant SLO burn")
+    p.add_argument("--slo-seconds", type=float, default=0.0,
+                   help="SLO window for --timeline's per-tenant burn/"
+                        "violation rates (0 = report latency and "
+                        "projection bias only)")
     args = p.parse_args(argv)
     from .parse_log import is_jsonl
     # metrics mode needs EVERY input to be a metrics source — a stray
@@ -591,7 +692,8 @@ def main(argv=None):
         if not metricsish:
             p.error("--timeline needs JSONL metrics logs or run "
                     "directories, not a net prototxt")
-        print(summarize_timeline(args.paths))
+        print(summarize_timeline(args.paths,
+                                 slo_seconds=args.slo_seconds))
         return 0
     if metricsish:
         print(summarize_metrics(args.paths))

@@ -226,6 +226,27 @@ SAMPLE_BAD_SPAN = {
     "args": {"k": [1, 2]},                       # empty name, bad pid,
 }                                                # non-scalar arg
 
+# fleet-worker lifecycle (serve/fleet/): controller routing/death
+# records in fleet.jsonl, swap/heartbeat records in the worker's own
+# stream — the `swap` record's cache counters are the hot-swap-as-
+# cache-hit evidence
+SAMPLE_GOOD_WORKER = {
+    "schema_version": 1, "type": "worker", "iter": 40,
+    "wall_time": 1722700000.0, "worker": "w0", "event": "swap",
+    "pinned": {"process": "conductance_drift:nu=0.2",
+               "dtype_policy": "f32", "net": "quick", "tiles": "1x1",
+               "mesh": "single"},
+    "swap_s": 1.9, "cache_hits": 12, "cache_misses": 0,
+}
+
+SAMPLE_BAD_WORKER = {
+    "schema_version": 1, "type": "worker", "iter": 40,
+    "wall_time": 1722700000.0, "worker": "", "event": "exploded",
+    "pinned": {"process": 3},                        # empty worker,
+    "swap_s": -1.0, "cache_hits": -2,                # unknown event,
+}                                                    # non-string pin,
+                                                     # negative counters
+
 # the cold-start breakdown record (cache.py / observe.make_setup_record),
 # including the async-pipeline accounting (async_exec.PipelineStats)
 SAMPLE_GOOD_SETUP = {
@@ -312,6 +333,7 @@ def main(argv=None) -> int:
                           ("retry", SAMPLE_GOOD_RETRY),
                           ("request", SAMPLE_GOOD_REQUEST),
                           ("fault_redraw", SAMPLE_GOOD_FAULT_REDRAW),
+                          ("worker", SAMPLE_GOOD_WORKER),
                           ("span", SAMPLE_GOOD_SPAN),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
@@ -329,6 +351,7 @@ def main(argv=None) -> int:
                           ("retry", SAMPLE_BAD_RETRY),
                           ("request", SAMPLE_BAD_REQUEST),
                           ("fault_redraw", SAMPLE_BAD_FAULT_REDRAW),
+                          ("worker", SAMPLE_BAD_WORKER),
                           ("span", SAMPLE_BAD_SPAN),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
@@ -339,7 +362,7 @@ def main(argv=None) -> int:
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (11 good records accepted, 11 bad "
+        print("sample self-check OK (12 good records accepted, 12 bad "
               f"records produced {n_bad} violations)")
         return 0
     if not args.files:

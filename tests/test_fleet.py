@@ -1,0 +1,392 @@
+"""Fleet service (serve/fleet/): the pure host-side scheduler logic —
+pin-matching + least-loaded routing, hot-swap victim selection and
+target composition, backlog-EMA scale decisions, dead-worker requeue
+bookkeeping, the worker table's heartbeat/registration lifecycle, the
+`worker` record type end to end (schema, sinks, summarize), the
+spool's requeue transition, and the client's wait exit codes. No
+devices, no solver builds — the full 2-worker byte-identity /
+SIGKILL-requeue / cache-hit-swap contract is CI-guarded by
+scripts/check_fleet.py."""
+import json
+import os
+import time
+
+import pytest
+
+from rram_caffe_simulation_tpu.observe import (CaffeLogSink,
+                                               make_worker_record,
+                                               validate_record,
+                                               worker_line)
+from rram_caffe_simulation_tpu.serve import Spool
+from rram_caffe_simulation_tpu.serve.fleet import (BacklogScaler,
+                                                   WorkerTable,
+                                                   effective_pins,
+                                                   pick_swap_victim,
+                                                   pick_worker,
+                                                   request_pins,
+                                                   requeue_plan, route,
+                                                   swap_target,
+                                                   worker_matches)
+from rram_caffe_simulation_tpu.serve.serve_client import (
+    WAIT_COMPLETED, WAIT_FAILED, WAIT_PENDING, WAIT_PREEMPTED,
+    WAIT_REJECTED, wait_exit_code)
+
+
+def _row(process="endurance_stuck_at", dtype_policy="f32", net="quick",
+         tiles="1x1", occupied=0, pending=0, **extra):
+    return dict({"pinned": {"process": process,
+                            "dtype_policy": dtype_policy,
+                            "net": net, "tiles": tiles,
+                            "mesh": "single"},
+                 "occupied_lanes": occupied,
+                 "pending_configs": pending}, **extra)
+
+
+# ---------------------------------------------------------------------------
+# router: pin matching
+
+
+def test_request_pins_subset():
+    req = {"configs": [{}], "process": "conductance_drift",
+           "tiles": "cells=8x8", "tenant": "a"}
+    assert request_pins(req) == {"process": "conductance_drift",
+                                 "tiles": "cells=8x8"}
+    assert request_pins({"configs": [{}]}) == {}
+
+
+def test_worker_matches_unnamed_pins_match_anything():
+    row = _row()
+    assert worker_matches({}, row)
+    assert worker_matches({"process": "endurance_stuck_at"}, row)
+    assert worker_matches({"process": "endurance_stuck_at",
+                           "net": "quick"}, row)
+    assert not worker_matches({"process": "conductance_drift"}, row)
+    assert not worker_matches({"dtype_policy": "ternary"}, row)
+
+
+def test_pending_swap_matches_target_not_current():
+    row = _row(process="endurance_stuck_at")
+    row["pending_swap"] = dict(row["pinned"],
+                               process="conductance_drift")
+    assert effective_pins(row)["process"] == "conductance_drift"
+    assert worker_matches({"process": "conductance_drift"}, row)
+    # mid-swap the OLD physics no longer matches: routing there would
+    # land requests behind a program set that is about to disappear
+    assert not worker_matches({"process": "endurance_stuck_at"}, row)
+
+
+def test_pick_worker_least_loaded_deterministic_ties():
+    rows = {"w0": _row(occupied=3), "w1": _row(occupied=1, pending=1),
+            "w2": _row(occupied=1, pending=1)}
+    # w1/w2 tie at load 2; the id breaks the tie deterministically
+    assert pick_worker({}, rows) == "w1"
+    rows["w1"]["occupied_lanes"] = 5
+    assert pick_worker({}, rows) == "w2"
+    assert pick_worker({"process": "nope"}, rows) is None
+
+
+# ---------------------------------------------------------------------------
+# router: hot-swap victim selection
+
+
+def test_route_swaps_least_loaded_victim_keeping_unnamed_pins():
+    rows = {"w0": _row(occupied=4),
+            "w1": _row(process="conductance_drift", occupied=1)}
+    wid, swap = route({"process": "read_disturb"}, rows)
+    assert wid == "w1"          # least loaded becomes the victim
+    # the request named only `process`: the victim keeps its own
+    # dtype_policy/net/tiles in the swap target
+    assert swap == dict(rows["w1"]["pinned"], process="read_disturb")
+
+
+def test_route_skips_mid_swap_victims():
+    rows = {"w0": _row(occupied=4),
+            "w1": _row(occupied=0,
+                       pending_swap={"process": "conductance_drift",
+                                     "dtype_policy": "f32",
+                                     "net": "quick", "tiles": "1x1",
+                                     "mesh": "single"})}
+    # w1 is the least loaded but already promised to a different
+    # program set — w0 takes the swap despite its load
+    assert pick_swap_victim({"process": "read_disturb"}, rows) == "w0"
+    wid, swap = route({"process": "read_disturb"}, rows)
+    assert wid == "w0" and swap["process"] == "read_disturb"
+    # ... while a request for the IN-FLIGHT target rides along on w1
+    wid, swap = route({"process": "conductance_drift"}, rows)
+    assert wid == "w1" and swap is None
+
+
+def test_route_empty_table():
+    assert route({"process": "x"}, {}) == (None, None)
+
+
+def test_swap_victim_respects_known_nets():
+    rows = {"w0": _row(occupied=0, nets=["quick"]),
+            "w1": _row(occupied=5, nets=["quick", "big"])}
+    # w0 is least loaded but cannot serve net 'big': w1 takes the swap
+    assert pick_swap_victim({"net": "big"}, rows) == "w1"
+    # nobody knows the net: the request stays pending rather than
+    # being swapped somewhere that must refuse it
+    assert pick_swap_victim({"net": "other"}, rows) is None
+    # a row without a nets field (pre-nets worker) accepts anything
+    rows["w2"] = _row(occupied=0)
+    assert pick_swap_victim({"net": "other"}, rows) == "w2"
+
+
+def test_swap_target_overlay():
+    row = _row()
+    target = swap_target({"process": "read_disturb",
+                          "dtype_policy": "ternary"}, row)
+    assert target == {"process": "read_disturb",
+                      "dtype_policy": "ternary", "net": "quick",
+                      "tiles": "1x1", "mesh": "single"}
+
+
+# ---------------------------------------------------------------------------
+# scaler: backlog-EMA decisions
+
+
+def test_scaler_bootstrap_and_hysteresis():
+    s = BacklogScaler(target_seconds=10.0, min_workers=0,
+                      max_workers=2, up_after=3, down_after=2,
+                      down_factor=0.25, ema=1.0)
+    # no workers + backlog: bootstrap scale-up, no hysteresis wait
+    assert s.decide(100, 0.0, workers=0) == 1
+    # projection = 100/2 = 50 s > 10 s target: needs up_after=3
+    # consecutive over-beats before the next +1
+    assert s.decide(100, 2.0, workers=1) == 0
+    assert s.decide(100, 2.0, workers=1) == 0
+    assert s.decide(100, 2.0, workers=1) == 1
+    # at max_workers the over-target projection changes nothing
+    assert s.decide(100, 2.0, workers=2) == 0
+    assert s.decide(100, 2.0, workers=2) == 0
+    assert s.decide(100, 2.0, workers=2) == 0
+
+
+def test_scaler_down_requires_idle_worker_and_floor():
+    s = BacklogScaler(target_seconds=10.0, min_workers=1,
+                      max_workers=4, up_after=2, down_after=2,
+                      down_factor=0.5, ema=1.0)
+    # projection 1/1 = 1 s < 0.5 * 10 s: two under-beats arm the
+    # scale-down, but it fires only with an idle worker to drain
+    assert s.decide(1, 1.0, workers=2, idle_workers=0) == 0
+    assert s.decide(1, 1.0, workers=2, idle_workers=0) == 0
+    assert s.decide(1, 1.0, workers=2, idle_workers=1) == -1
+    # at the min_workers floor nothing drains, idle or not
+    assert s.decide(1, 1.0, workers=1, idle_workers=1) == 0
+    assert s.decide(1, 1.0, workers=1, idle_workers=1) == 0
+
+
+def test_scaler_ema_smooths_projection():
+    s = BacklogScaler(target_seconds=10.0, ema=0.5)
+    assert s.observe(100, 10.0) == pytest.approx(10.0)
+    # raw drops to 0 but the EMA halves instead of collapsing
+    assert s.observe(0, 10.0) == pytest.approx(5.0)
+    assert s.observe(0, 10.0) == pytest.approx(2.5)
+    # no measured rate: the projection holds rather than divides by 0
+    assert s.observe(50, 0.0) == pytest.approx(2.5)
+
+
+def test_scaler_validates_bounds():
+    with pytest.raises(ValueError, match="ema"):
+        BacklogScaler(ema=0.0)
+    with pytest.raises(ValueError, match="bounds"):
+        BacklogScaler(min_workers=3, max_workers=1)
+
+
+# ---------------------------------------------------------------------------
+# dead-worker requeue bookkeeping
+
+
+def test_requeue_plan_only_unfinished_on_dead_workers():
+    assignments = {"r1": {"worker": "w0"}, "r2": {"worker": "w0"},
+                   "r3": {"worker": "w1"}, "r4": {"worker": "w0"}}
+    # r2 finished before the worker died: it harvests, never re-runs
+    plan = requeue_plan(assignments, ["w0"], {"r2": "done"})
+    assert plan == ["r1", "r4"]
+    assert requeue_plan(assignments, [], {}) == []
+    assert requeue_plan({}, ["w0"], {}) == []
+
+
+def test_spool_requeue_strips_claimant_bookkeeping(tmp_path):
+    spool = Spool(str(tmp_path / "spool"))
+    spool.submit({"id": "r-1", "configs": [{"mean": 5}],
+                  "tenant": "a"}, default_iters=4)
+    t0 = spool.read("r-1")["submit_time"]
+    spool.claim("r-1", {"worker": "w0", "cfg_ids": [0],
+                        "iters_granted": 8, "status": "admitted",
+                        "submit_seen": True})
+    req = spool.requeue("r-1")
+    assert spool.state_of("r-1") == "pending"
+    for stale in ("worker", "cfg_ids", "iters_granted", "status",
+                  "submit_seen"):
+        assert stale not in req
+    # latency accounting spans the whole fleet turnaround: the
+    # original submit_time survives the requeue
+    assert req["submit_time"] == t0
+    assert req["requeues"] == 1
+    req = spool.requeue(spool.claim("r-1")["id"])
+    assert req["requeues"] == 2
+    with pytest.raises(FileNotFoundError):
+        spool.requeue("r-404")
+
+
+# ---------------------------------------------------------------------------
+# worker table
+
+
+def test_worker_table_lifecycle(tmp_path):
+    tab = WorkerTable(str(tmp_path))
+    row = tab.register("w0", {"pinned": {"process": "p"}, "lanes": 4})
+    assert row["worker"] == "w0" and "heartbeat_time" in row
+    assert tab.ids() == ["w0"]
+    t0 = tab.read("w0")["heartbeat_time"]
+    time.sleep(0.01)
+    assert tab.heartbeat("w0", {"occupied_lanes": 3}) is not None
+    row = tab.read("w0")
+    assert row["occupied_lanes"] == 3 and row["heartbeat_time"] > t0
+    # swap command round-trip; the .swap.json file is NOT a table row
+    tab.command_swap("w0", {"process": "q"})
+    assert tab.ids() == ["w0"]
+    assert tab.read_swap("w0")["pinned"] == {"process": "q"}
+    tab.clear_swap("w0")
+    assert tab.read_swap("w0") is None
+    # clean departure: the row disappears; a heartbeat after removal
+    # reports the worker should stop (dead-declared semantics)
+    tab.unregister("w0")
+    assert tab.ids() == [] and tab.heartbeat("w0") is None
+
+
+# ---------------------------------------------------------------------------
+# `worker` record type end to end
+
+
+def test_worker_record_schema_good_and_bad():
+    rec = make_worker_record(7, "w1", "swap",
+                             pinned={"process": "conductance_drift"},
+                             swap_s=1.25, cache_hits=9, cache_misses=0)
+    assert validate_record(rec) == []
+    assert validate_record(
+        make_worker_record(0, "w0", "dead", reason="stale")) == []
+    bad = dict(rec, event="exploded")
+    assert any("unknown event" in e for e in validate_record(bad))
+    bad = dict(rec, swap_s=-1)
+    assert any("swap_s" in e for e in validate_record(bad))
+    bad = dict(rec, worker="")
+    assert any("worker" in e for e in validate_record(bad))
+    bad = dict(rec, pinned={"process": 3})
+    assert any("pinned" in e for e in validate_record(bad))
+
+
+def test_worker_line_and_caffe_sink(tmp_path):
+    rec = make_worker_record(7, "w1", "swap",
+                             pinned={"process": "conductance_drift"},
+                             swap_s=1.25, cache_hits=9, cache_misses=0)
+    line = worker_line(rec)
+    assert "w1" in line and "hot-swapped" in line \
+        and "9 hits/0 misses" in line
+    assert "requeued request r-9" in worker_line(
+        make_worker_record(0, "w0", "requeued", request="r-9"))
+    path = str(tmp_path / "caffe.log")
+    sink = CaffeLogSink(path)
+    sink.write(rec)
+    sink.close()
+    with open(path) as f:
+        text = f.read()
+    assert "hot-swapped" in text
+
+
+def test_summarize_fleet_dir_digests_workers(tmp_path):
+    from rram_caffe_simulation_tpu.tools.summarize import (
+        summarize_metrics, summarize_timeline)
+    os.makedirs(tmp_path / "workers" / "w0")
+    recs = [make_worker_record(0, "w0", "registered", lanes=2),
+            make_worker_record(3, "w0", "swap", swap_s=2.0,
+                               cache_hits=4, cache_misses=0),
+            make_worker_record(5, "w0", "dead", reason="stale")]
+    with open(tmp_path / "fleet.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    with open(tmp_path / "workers" / "w0" / "metrics.jsonl",
+              "w") as f:
+        f.write(json.dumps(
+            {"schema_version": 1, "type": "request", "iter": 5,
+             "wall_time": 1.0, "request": "r1", "tenant": "alice",
+             "event": "completed", "configs": 1, "done": 1,
+             "latency_s": 4.0, "projected_s": 2.0}) + "\n")
+    out = summarize_metrics(str(tmp_path))
+    assert "1 registered" in out and "1 swap" in out \
+        and "1 dead" in out
+    assert "4 hits / 0 misses" in out
+    tl = summarize_timeline(str(tmp_path), slo_seconds=10.0)
+    assert "SLO burn 0.4x" in tl
+    assert "achieved/projected 2x" in tl
+    assert "worker w0 died" in tl
+
+
+# ---------------------------------------------------------------------------
+# private cache snapshots (the concurrent-process safety story)
+
+
+def test_clone_cache_links_completed_entries_only(tmp_path):
+    from rram_caffe_simulation_tpu.cache import clone_cache
+    src = tmp_path / "shared"
+    (src / "xla" / "deep").mkdir(parents=True)
+    (src / "datasets").mkdir()
+    (src / "xla" / "a-cache").write_bytes(b"exe-a")
+    (src / "xla" / "deep" / "b-cache").write_bytes(b"exe-b")
+    (src / "xla" / "c-cache.tmp.123").write_bytes(b"half-written")
+    (src / "datasets" / "d.npz").write_bytes(b"data")
+    dst = tmp_path / "shared" / "worker-w0"
+    n = clone_cache(str(src), str(dst))
+    assert n == 3
+    assert (dst / "xla" / "a-cache").read_bytes() == b"exe-a"
+    assert (dst / "xla" / "deep" / "b-cache").read_bytes() == b"exe-b"
+    assert (dst / "datasets" / "d.npz").read_bytes() == b"data"
+    # in-flight temp files are not entries yet
+    assert not (dst / "xla" / "c-cache.tmp.123").exists()
+    # idempotent: a re-clone links nothing new
+    assert clone_cache(str(src), str(dst)) == 0
+    # entries are hard links (metadata-only snapshot) and a writer's
+    # temp-file + rename REPLACES the shared entry without mutating
+    # the snapshot's bytes
+    assert os.stat(dst / "xla" / "a-cache").st_nlink == 2
+    tmp = src / "xla" / "a-cache.tmp.9"
+    tmp.write_bytes(b"exe-a2")
+    os.replace(tmp, src / "xla" / "a-cache")
+    assert (dst / "xla" / "a-cache").read_bytes() == b"exe-a"
+
+
+# ---------------------------------------------------------------------------
+# client wait exit codes
+
+
+def test_wait_exit_codes_branch_per_outcome():
+    assert wait_exit_code({"status": "completed"}) == WAIT_COMPLETED
+    assert wait_exit_code({"status": "failed"}) == WAIT_FAILED
+    assert wait_exit_code({"status": "rejected"}) == WAIT_REJECTED
+    assert wait_exit_code({"status": "preempted"}) == WAIT_PREEMPTED
+    assert wait_exit_code({"state": "pending"}) == WAIT_PENDING
+    assert wait_exit_code(None) == WAIT_PENDING
+    # the five outcomes stay distinct — scripts branch on them
+    codes = {WAIT_COMPLETED, WAIT_FAILED, WAIT_REJECTED,
+             WAIT_PREEMPTED, WAIT_PENDING}
+    assert len(codes) == 5
+
+
+# ---------------------------------------------------------------------------
+# request pins through the spool
+
+
+def test_normalize_request_dtype_and_net_pins():
+    from rram_caffe_simulation_tpu.serve import normalize_request
+    out = normalize_request({"configs": [{"mean": 1}],
+                             "dtype_policy": " ternary ",
+                             "net": "quick"}, default_iters=4)
+    assert out["dtype_policy"] == "ternary" and out["net"] == "quick"
+    with pytest.raises(ValueError, match="dtype_policy"):
+        normalize_request({"configs": [{"mean": 1}],
+                           "dtype_policy": ""}, default_iters=4)
+    with pytest.raises(ValueError, match="net"):
+        normalize_request({"configs": [{"mean": 1}], "net": 7},
+                          default_iters=4)
