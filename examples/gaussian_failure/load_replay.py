@@ -1,0 +1,715 @@
+#!/usr/bin/env python
+"""Load-replay harness: make the fleet sweat under the watchtower.
+
+Replays a deterministic, bursty, multi-tenant request stream — an
+order of magnitude past the fleet guard's 6 requests — through a REAL
+2-worker fleet with the full metrics plane live (per-beat worker
+socket scrapes, the ``<fleet>/metrics.prom`` Prometheus rollup, and
+the declarative alert rules), then stresses the alert lifecycle with
+an induced swap storm and a SIGKILL, and (optionally) drives the
+BacklogScaler through a spawn/drain cycle on a second fleet.
+
+Legs, in order:
+
+1. **Dedicated references (the unmonitored run)**: the stream's two
+   physics subsets through two dedicated, socket-less, watchtower-less
+   `SweepService`s — the ground truth the MONITORED fleet must
+   reproduce byte-for-byte (losses + fault npz + config-id
+   allocation). Monitoring that perturbs results is worse than no
+   monitoring.
+2. **Monitored replay**: the same stream, submitted on its bursty
+   arrival schedule, through one fleet spool feeding 2 pinned
+   subprocess workers while the controller scrapes, evaluates alert
+   rules, and rewrites the rollup every beat. Measures sustained
+   occupancy, p50/p99 turnaround, and SLO burn.
+3. **SIGKILL**: the drift worker dies mid-request — `worker_death`
+   fires, the request requeues and completes on the survivor (which
+   hot-swaps to drift).
+4. **Swap storm**: alternating-pin requests ping-pong the sole
+   survivor between its two resident program sets — `swap_storm`
+   fires on each command beat and resolves once the storm drains.
+5. **Scaler cycle** (``--scaler-leg``): a fresh fleet born with ZERO
+   workers and a deep backlog — the controller spawns workers from
+   ``worker_cmd`` (scale up), then drains an idle one once the
+   projection collapses (scale down).
+
+    python examples/gaussian_failure/load_replay.py \\
+        --requests 60 --bench-out BENCH_FLEET_LOAD_r01.json
+
+`scripts/check_fleet_load.py` runs this same harness at guard scale
+in CI. Deterministic given ``--seed``: the stream, pins, and burst
+schedule all come from one `random.Random`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import random
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LANES = 4
+CHUNK = 10
+PROC_A = "endurance_stuck_at"
+PROC_B = "conductance_drift:nu=0.1"
+TENANTS = ("alice", "bob", "carol", "dave")
+SLO_SECONDS = 30.0
+MIN_OCCUPANCY = 0.90
+
+
+# ---------------------------------------------------------------------------
+# the stream
+
+def build_stream(n_requests=60, seed=1701, iters=20):
+    """The deterministic bursty multi-tenant stream: a list of request
+    dicts (sortable ids = submission order) each carrying an
+    ``offset_s`` arrival time. Bursts of 4-8 requests land together
+    (multi-tenant, mixed physics) separated by short gaps — the
+    arrival pattern that makes the BacklogScaler's projection move.
+    Each request carries 3-5 configs so a burst's share per pinned
+    worker stays >= the lane count through the burst's drain — the
+    occupancy floor is a property of the stream, not of luck."""
+    rng = random.Random(seed)
+    out, t = [], 0.0
+    i = 0
+    while i < n_requests:
+        burst = min(rng.randint(4, 8), n_requests - i)
+        for _ in range(burst):
+            tenant = rng.choice(TENANTS)
+            proc = PROC_A if rng.random() < 0.5 else PROC_B
+            configs = [{"mean": rng.randint(430, 530),
+                        "std": rng.randint(80, 110)}
+                       for _ in range(rng.randint(3, 5))]
+            out.append({"id": f"m{i:04d}-{tenant}", "tenant": tenant,
+                        "process": proc, "iters": iters,
+                        "configs": configs,
+                        "offset_s": round(t + rng.random() * 0.2, 3)})
+            i += 1
+        t += rng.uniform(1.0, 2.5)
+    return out
+
+
+def build_storm(n=6, iters=10):
+    """The adversarial swap-storm mix: single-config requests strictly
+    alternating the two physics. Against a one-worker fleet every
+    request forces a hot swap — after the first build both program
+    sets are resident, so the storm is a resident-reactivation
+    ping-pong (the cheap kind of sweat)."""
+    out = []
+    for i in range(n):
+        proc = PROC_B if i % 2 == 0 else PROC_A
+        out.append({"id": f"s{i:02d}-storm", "tenant": "storm",
+                    "process": proc, "iters": iters,
+                    "configs": [{"mean": 500 - 5 * i, "std": 100}]})
+    return out
+
+
+def watchtower_rules():
+    """The default rule set re-tuned for guard timescales: a swap
+    command lands on ONE beat (the next command is seconds of rebuild
+    away), so `swap_storm` trips per command beat instead of requiring
+    three consecutive ones."""
+    from rram_caffe_simulation_tpu.serve.fleet.alerts import (
+        DEFAULT_RULES, AlertRule)
+    rules = []
+    for spec in DEFAULT_RULES:
+        spec = dict(spec)
+        if spec["name"] == "swap_storm":
+            spec["for_beats"] = 1
+            spec["clear_beats"] = 8
+        rules.append(AlertRule.from_dict(spec))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# fixtures (same tiny LMDB + net as scripts/check_fleet.py)
+
+def build_db(path):
+    import numpy as np
+    from rram_caffe_simulation_tpu.data import lmdb_py
+    from rram_caffe_simulation_tpu.data.db import array_to_datum
+    rng = np.random.RandomState(0)
+    with lmdb_py.BulkWriter(path) as w:
+        for i in range(24):
+            img = rng.randint(0, 255, (1, 8, 8), dtype=np.uint8)
+            w.put(b"%08d" % i,
+                  array_to_datum(img, int(img.mean() // 64))
+                  .SerializeToString())
+
+
+def write_solver(path, db):
+    with open(path, "w") as f:
+        f.write(f"""
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+type: "SGD"
+max_iter: 1000
+display: 0
+random_seed: 3
+snapshot_prefix: "{os.path.dirname(path)}/snap"
+failure_pattern {{ type: "gaussian" mean: 500 std: 100 }}
+net_param {{
+  name: "loadreplay"
+  layer {{ name: "data" type: "Data" top: "data" top: "label"
+    data_param {{ source: "{db}" batch_size: 8 }}
+    transform_param {{ scale: 0.00390625 }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 4
+      weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+    bottom: "label" top: "loss" }}
+}}
+""")
+
+
+def _clean(entry):
+    return {k: v for k, v in entry.items() if k != "offset_s"}
+
+
+def run_dedicated(solver, service_dir, proc, entries):
+    """The unmonitored reference: one dedicated service (no socket, no
+    controller, no watchtower) fed `entries` in submission order."""
+    from rram_caffe_simulation_tpu.serve import Spool, SweepService
+    svc = SweepService(solver, service_dir, lanes=LANES, chunk=CHUNK,
+                       default_iters=CHUNK, max_retries=1,
+                       socket_path=None, save_fault_results=True,
+                       poll_interval_s=0.05,
+                       fault_process=(None if proc == PROC_A
+                                      else proc))
+    for e in entries:
+        svc.spool.submit(_clean(e))
+    code = svc.serve(drain_when_idle=True)
+    svc.close()
+    if code != 0:
+        raise RuntimeError(f"dedicated {proc} service exited {code}")
+    spool = Spool(os.path.join(service_dir, "spool"))
+    return {e["id"]: spool.read(e["id"]) for e in entries}, service_dir
+
+
+def _npz_bytes(root, fname):
+    import numpy as np
+    with np.load(os.path.join(root, "requests", fname)) as z:
+        return {k: z[k].tobytes() for k in z.files}
+
+
+def compare_results(stream, fleet_spool, worker_dirs, worker_spools,
+                    dedicated):
+    """Monitored fleet vs unmonitored references: list of mismatch
+    strings (empty = byte-identical)."""
+    import numpy as np
+    bad = []
+    for e in stream:
+        rid, proc = e["id"], e["process"]
+        ded_req, ded_root = dedicated[proc]
+        ref = ded_req[rid]
+        got = fleet_spool.read(rid)
+        if got is None or got.get("state") != "done":
+            bad.append(f"{rid}: not terminal "
+                       f"({got and got.get('state')})")
+            continue
+        if got.get("status") != "completed":
+            bad.append(f"{rid}: ended {got.get('status')!r} "
+                       f"({got.get('reason')!r})")
+            continue
+        wid = got.get("worker")
+        wreq = worker_spools[wid].read(rid)
+        if wreq.get("cfg_ids") != ref.get("cfg_ids"):
+            bad.append(f"{rid}: cfg ids {wreq.get('cfg_ids')} on "
+                       f"{wid} != dedicated {ref.get('cfg_ids')}")
+            continue
+        for cfg, v in got.get("results", {}).items():
+            rv = ref["results"][cfg]
+            if np.float64(v["loss"]).tobytes() \
+                    != np.float64(rv["loss"]).tobytes():
+                bad.append(f"{rid}/{cfg}: loss {v['loss']!r} != "
+                           f"dedicated {rv['loss']!r}")
+            elif _npz_bytes(worker_dirs[wid], v["fault_npz"]) \
+                    != _npz_bytes(ded_root, rv["fault_npz"]):
+                bad.append(f"{rid}/{cfg}: fault npz differs")
+    return bad
+
+
+def measure_occupancy(worker_dirs, lanes):
+    """Merged steady-state lane occupancy across the fleet.
+
+    check_serve_contract/check_fleet exclude the run TAIL — records
+    where "remaining work cannot fill the pool" — using the stream's
+    FINAL config total, which is exact for their all-at-once
+    submission. Under bursty arrivals that rule under-counts: a chunk
+    that ran while a burst drained and the next burst had not ARRIVED
+    yet would be charged against occupancy for work that did not
+    exist. The faithful generalization scans metrics.jsonl in append
+    order and excludes records where (configs admitted SO FAR - done)
+    < lanes — the same "pool cannot be filled" criterion, evaluated
+    against what had actually arrived. Returns
+    (steady_mean, steady_n, duty_mean, all_n): `steady_mean` is the
+    guarded metric; `duty_mean` is the unexcluded all-records mean
+    (the burst-gap duty cycle), reported for honesty."""
+    occ, duty = [], []
+    for root in worker_dirs.values():
+        done_iters = []
+        rows = []                        # (chunk rec, admitted so far)
+        admitted = 0
+        path = os.path.join(root, "metrics.jsonl")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("type") == "request":
+                    if rec.get("event") == "config_done":
+                        done_iters.append(rec["iter"])
+                    elif rec.get("event") == "admitted":
+                        admitted += rec.get("configs", 0)
+                elif rec.get("type") is None \
+                        and isinstance(rec.get("lane_map"), list):
+                    rows.append((rec, admitted))
+        for rec, adm in rows:
+            lm = rec["lane_map"]
+            frac = sum(1 for c in lm if c >= 0) / len(lm)
+            duty.append(frac)
+            done = sum(1 for it in done_iters if it <= rec["iter"])
+            if adm - done < lanes:
+                continue
+            occ.append(frac)
+    if not duty:
+        return 0.0, 0, 0.0, 0
+    steady = (sum(occ) / len(occ), len(occ)) if occ else (0.0, 0)
+    return steady[0], steady[1], sum(duty) / len(duty), len(duty)
+
+
+def alert_events(fleet_jsonl):
+    """alert name -> {"firing": n, "resolved": n} from fleet.jsonl."""
+    out = {}
+    if not os.path.exists(fleet_jsonl):
+        return out
+    with open(fleet_jsonl) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") != "alert":
+                continue
+            slot = out.setdefault(rec["alert"],
+                                  {"firing": 0, "resolved": 0})
+            if rec.get("event") in slot:
+                slot[rec["event"]] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the harness
+
+def _beat_until(ctl, cond, deadline_s, sleep_s=0.1, what="condition"):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        ctl.beat()
+        if cond():
+            return
+        time.sleep(sleep_s)
+    raise RuntimeError(f"load replay: {what} not reached within "
+                       f"{deadline_s:g} s")
+
+
+def run(workdir, n_requests=60, iters=20, seed=1701, storm_n=6,
+        scaler_leg=True, verbose=True):
+    """The full replay. Returns the measurement summary dict; raises
+    RuntimeError when the fleet cannot be driven through the legs."""
+    from rram_caffe_simulation_tpu import cache as perf_cache
+    from rram_caffe_simulation_tpu.observe.metrics_registry import (
+        parse_exposition, validate_rollup)
+    from rram_caffe_simulation_tpu.serve import Spool
+    from rram_caffe_simulation_tpu.serve.fleet import WorkerTable
+    from rram_caffe_simulation_tpu.serve.fleet.controller import \
+        FleetController
+
+    def say(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    os.makedirs(workdir, exist_ok=True)
+    cache_dir = os.path.join(workdir, "cache")
+    perf_cache.enable_compilation_cache(cache_dir,
+                                        min_compile_time_s=0.05)
+    os.environ["RRAM_TPU_CACHE_DIR"] = cache_dir
+    db = os.path.join(workdir, "db")
+    solver = os.path.join(workdir, "solver.prototxt")
+    build_db(db)
+    write_solver(solver, db)
+
+    stream = build_stream(n_requests, seed=seed, iters=iters)
+    total_cfgs = sum(len(e["configs"]) for e in stream)
+
+    say(f"=== leg 1: dedicated (unmonitored) references — "
+        f"{len(stream)} requests, {total_cfgs} configs ===")
+    t_ded = time.perf_counter()
+    a_entries = [e for e in stream if e["process"] == PROC_A]
+    b_entries = [e for e in stream if e["process"] == PROC_B]
+    ded_a, root_a = run_dedicated(
+        solver, os.path.join(workdir, "ded_a"), PROC_A, a_entries)
+    ded_b, root_b = run_dedicated(
+        solver, os.path.join(workdir, "ded_b"), PROC_B, b_entries)
+    dedicated = {PROC_A: (ded_a, root_a), PROC_B: (ded_b, root_b)}
+    ded_wall = time.perf_counter() - t_ded
+    say(f"dedicated references done in {ded_wall:.1f} s "
+        f"({len(a_entries)} endurance / {len(b_entries)} drift)")
+
+    say("=== leg 2: monitored replay — bursty arrivals over 2 pinned "
+        "workers, watchtower live ===")
+    fleet = os.path.join(workdir, "fleet")
+    os.makedirs(fleet, exist_ok=True)
+    fleet_spool = Spool(os.path.join(fleet, "spool"))
+    table = WorkerTable(fleet)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_cmd = [sys.executable, "-m",
+                "rram_caffe_simulation_tpu.serve.fleet.worker",
+                "--fleet-dir", fleet, "--solver", solver,
+                "--lanes", str(LANES), "--chunk", str(CHUNK),
+                "--default-iters", str(CHUNK),
+                "--poll-interval", "0.05", "--save-fault-results",
+                "--slo-seconds", str(SLO_SECONDS),
+                "--cache-dir", cache_dir]
+    logdir = os.path.join(fleet, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    procs = {}
+    for name, extra in (("w0", []),
+                        ("w1", ["--fault-process", PROC_B])):
+        log = open(os.path.join(logdir, f"{name}.log"), "wb")
+        procs[name] = subprocess.Popen(
+            base_cmd + ["--name", name] + extra, env=env, cwd=_REPO,
+            stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+    ctl = FleetController(fleet, heartbeat_timeout_s=30,
+                          poll_interval_s=0.0,
+                          alert_rules=watchtower_rules())
+    worker_dirs = {w: table.worker_dir(w) for w in ("w0", "w1")}
+    worker_spools = {w: Spool(os.path.join(d, "spool"))
+                     for w, d in worker_dirs.items()}
+    summary = {}
+    try:
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if set(table.ids()) >= {"w0", "w1"}:
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("workers never registered")
+        say("both workers registered; replaying the arrival schedule")
+
+        t_fleet = time.perf_counter()
+        t0 = time.monotonic()
+        idx, done = 0, set()
+        deadline = time.monotonic() + 1800
+        while time.monotonic() < deadline:
+            now = time.monotonic() - t0
+            while idx < len(stream) \
+                    and stream[idx]["offset_s"] <= now:
+                fleet_spool.submit(_clean(stream[idx]))
+                idx += 1
+            ctl.beat()
+            for e in stream:
+                if e["id"] not in done \
+                        and fleet_spool.state_of(e["id"]) == "done":
+                    done.add(e["id"])
+            if idx == len(stream) and len(done) == len(stream):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"monitored replay incomplete: {len(done)}/"
+                f"{len(stream)} terminal inside 1800 s")
+        fleet_wall = time.perf_counter() - t_fleet
+        say(f"monitored replay: {len(stream)} requests terminal in "
+            f"{fleet_wall:.1f} s")
+
+        mismatches = compare_results(stream, fleet_spool, worker_dirs,
+                                     worker_spools, dedicated)
+        occupancy, occ_n, duty, duty_n = measure_occupancy(
+            worker_dirs, LANES)
+        say(f"byte-identity: {len(mismatches)} mismatch(es); "
+            f"occupancy {occupancy:.1%} over {occ_n} steady-state "
+            f"records (duty {duty:.1%} over all {duty_n})")
+
+        rollup_path = os.path.join(fleet, "metrics.prom")
+        rollup_text = open(rollup_path, encoding="utf-8").read()
+        rollup_violations = validate_rollup(rollup_text)
+        samples = parse_exposition(rollup_text)
+
+        def q(quant):
+            return samples.get(("rram_fleet_turnaround_seconds",
+                                (("quantile", quant),)), 0.0)
+
+        summary.update({
+            "requests_main": len(stream),
+            "configs_main": total_cfgs,
+            "identity_mismatches": mismatches,
+            "occupancy": round(occupancy, 4),
+            "occupancy_records": occ_n,
+            "lane_duty_ratio": round(duty, 4),
+            "lane_duty_records": duty_n,
+            "p50_s": round(q("0.5"), 2),
+            "p90_s": round(q("0.9"), 2),
+            "p99_s": round(q("0.99"), 2),
+            "slo_burn_rate": round(
+                samples.get(("rram_fleet_slo_burn_rate", ()), 0.0), 3),
+            "fleet_wall_s": round(fleet_wall, 2),
+            "ded_wall_s": round(ded_wall, 2),
+            "rollup_violations": rollup_violations,
+            "rollup_path": rollup_path,
+        })
+
+        say("=== leg 3: SIGKILL the drift worker mid-request ===")
+        kill_entry = {"id": "x0-kill", "tenant": "alice",
+                      "process": PROC_B, "iters": 10 * iters,
+                      "configs": [{"mean": 500, "std": 100},
+                                  {"mean": 480, "std": 100}]}
+        fleet_spool.submit(kill_entry)
+        started = os.path.join(worker_dirs["w1"], "requests",
+                               "x0-kill.jsonl")
+        victim_pid = int(table.read("w1")["pid"])
+        _beat_until(ctl, lambda: os.path.exists(started)
+                    and "started" in open(started).read(),
+                    600, what="kill request start")
+        os.kill(victim_pid, signal.SIGKILL)
+        procs["w1"].wait()
+        say(f"SIGKILLed w1 (pid {victim_pid})")
+        _beat_until(ctl,
+                    lambda: fleet_spool.state_of("x0-kill") == "done",
+                    600, sleep_s=0.2, what="killed-request completion")
+        final = fleet_spool.read("x0-kill")
+        if final.get("status") != "completed":
+            raise RuntimeError(f"kill request ended "
+                               f"{final.get('status')!r}")
+        say(f"killed request completed on {final.get('worker')} "
+            "(requeue + hot swap)")
+
+        say(f"=== leg 4: swap storm — {storm_n} alternating-pin "
+            "requests against the sole survivor ===")
+        storm = build_storm(storm_n, iters=max(iters // 2, 10))
+        for e in storm:
+            fleet_spool.submit(_clean(e))
+        _beat_until(ctl,
+                    lambda: all(fleet_spool.state_of(e["id"]) == "done"
+                                for e in storm),
+                    900, sleep_s=0.2, what="storm drain")
+        storm_status = {e["id"]: fleet_spool.read(e["id"]).get("status")
+                        for e in storm}
+        if set(storm_status.values()) != {"completed"}:
+            raise RuntimeError(f"storm requests not all completed: "
+                               f"{storm_status}")
+        # idle beats so the beat-counted hysteresis can resolve what
+        # the storm fired
+        for _ in range(15):
+            ctl.beat()
+            time.sleep(0.05)
+        alerts = alert_events(os.path.join(fleet, "fleet.jsonl"))
+        say(f"alert lifecycle: { {k: dict(v) for k, v in alerts.items()} }")
+
+        summary.update({
+            "requests_total": len(stream) + 1 + len(storm),
+            "configs_total": total_cfgs + 2
+            + sum(len(e["configs"]) for e in storm),
+            "storm_requests": len(storm),
+            "kill_completed_on": final.get("worker"),
+            "alerts": alerts,
+        })
+
+        # clean drain of the survivor
+        with open(os.path.join(worker_dirs["w0"], "DRAIN"), "w"):
+            pass
+        procs["w0"].wait(timeout=120)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    if scaler_leg:
+        say("=== leg 5: scaler cycle — zero workers, deep backlog, "
+            "spawn up then drain down ===")
+        summary["scale"] = run_scaler_leg(workdir, solver, cache_dir,
+                                          verbose=verbose)
+    return summary
+
+
+def run_scaler_leg(workdir, solver, cache_dir, verbose=True):
+    """A fresh fleet born empty: the controller must spawn workers
+    from `worker_cmd` to absorb the backlog (scale up) and drain an
+    idle one once the projection collapses (scale down)."""
+    from rram_caffe_simulation_tpu.serve import Spool
+    from rram_caffe_simulation_tpu.serve.fleet import BacklogScaler
+    from rram_caffe_simulation_tpu.serve.fleet.controller import \
+        FleetController
+
+    fleet = os.path.join(workdir, "fleet_scale")
+    os.makedirs(fleet, exist_ok=True)
+    worker_cmd = (
+        f"{sys.executable} -m "
+        "rram_caffe_simulation_tpu.serve.fleet.worker "
+        "--fleet-dir {fleet} --name {name} "
+        f"--solver {solver} --lanes 2 --chunk {CHUNK} "
+        f"--default-iters {CHUNK} --poll-interval 0.05 "
+        f"--cache-dir {cache_dir}")
+    # min_workers=0 makes the down half of the cycle rate-independent:
+    # the bootstrap spawn (backlog with zero workers) is the UP, and
+    # once the backlog drains the idle worker is over the floor and
+    # gets drained — the cycle completes whatever the measured rate
+    # projects against the target
+    scaler = BacklogScaler(target_seconds=2.0, min_workers=0,
+                           max_workers=2, up_after=2, down_after=3,
+                           down_factor=0.5)
+    ctl = FleetController(fleet, heartbeat_timeout_s=60,
+                          poll_interval_s=0.0, default_iters=40,
+                          scaler=scaler, worker_cmd=worker_cmd,
+                          alert_rules=watchtower_rules())
+    spool = Spool(os.path.join(fleet, "spool"))
+    entries = [{"id": f"b{i:02d}-scale", "tenant": "batch",
+                "process": PROC_A, "iters": 40,
+                "configs": [{"mean": 500 - i, "std": 100}
+                            for _ in range(3)]}
+               for i in range(8)]
+    for e in entries:
+        spool.submit(e)
+    try:
+        def cycled():
+            state = json.load(open(os.path.join(fleet, "state.json")))
+            wt = state.get("watchtower") or {}
+            return (all(spool.state_of(e["id"]) == "done"
+                        for e in entries)
+                    and wt.get("scale_ups", 0) >= 1
+                    and wt.get("scale_downs", 0) >= 1)
+
+        _beat_until(ctl, cycled, 900, sleep_s=0.1,
+                    what="scaler up/down cycle")
+        with open(os.path.join(fleet, "DRAIN"), "w"):
+            pass
+        code = ctl._drain(timeout_s=180)
+        if code != 0:
+            raise RuntimeError(f"scaler-leg fleet drain exited {code}")
+    finally:
+        for p in ctl._spawned.values():
+            if p.poll() is None:
+                p.kill()
+    state = json.load(open(os.path.join(fleet, "state.json")))
+    wt = state.get("watchtower") or {}
+    result = {"ups": int(wt.get("scale_ups", 0)),
+              "downs": int(wt.get("scale_downs", 0))}
+    if verbose:
+        print(f"scaler cycle: {result['ups']} up / "
+              f"{result['downs']} down", flush=True)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+def bench_row(summary):
+    alerts = summary.get("alerts") or {}
+    scale = summary.get("scale") or {}
+    return {
+        "bench": "fleet_load_replay",
+        "workers": 2,
+        "lanes_per_worker": LANES,
+        "requests": summary.get("requests_total", 0),
+        "configs": summary.get("configs_total", 0),
+        "occupancy": summary.get("occupancy", 0.0),
+        "lane_duty_ratio": summary.get("lane_duty_ratio", 0.0),
+        "p50_turnaround_seconds": summary.get("p50_s", 0.0),
+        "p99_turnaround_seconds": summary.get("p99_s", 0.0),
+        "slo_burn_rate": summary.get("slo_burn_rate", 0.0),
+        "alerts_fired": sum(v["firing"] for v in alerts.values()),
+        "alerts_resolved": sum(v["resolved"] for v in alerts.values()),
+        "storm_requests": summary.get("storm_requests", 0),
+        "scale_ups": scale.get("ups", 0),
+        "scale_downs": scale.get("downs", 0),
+        "fleet_wall_seconds": summary.get("fleet_wall_s", 0.0),
+        "configs_per_hour_aggregate": round(
+            summary.get("configs_main", 0) * 3600.0
+            / max(summary.get("fleet_wall_s", 1.0), 1e-9), 1),
+        "byte_identical": not summary.get("identity_mismatches"),
+        "note": "bursty multi-tenant load replay under the live "
+                "watchtower (per-beat scrapes + rollup + alert "
+                "rules): monitored fleet byte-identical to the "
+                "unmonitored dedicated references; SIGKILL + swap "
+                "storm alert lifecycle; scaler spawn/drain cycle; "
+                "occupancy is steady-state (pool-fillable records), "
+                "lane_duty_ratio the unexcluded burst-gap duty "
+                "cycle; CPU-measured at guard scale",
+    }
+
+
+def main(argv=None):
+    import argparse
+    import tempfile
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=60,
+                    help="main-phase stream size (storm + kill ride "
+                         "on top)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=1701)
+    ap.add_argument("--storm", type=int, default=6,
+                    help="swap-storm request count")
+    ap.add_argument("--workdir", default=None,
+                    help="working root (default: a fresh tempdir)")
+    ap.add_argument("--no-scaler-leg", action="store_true")
+    ap.add_argument("--bench-out", default=None,
+                    help="write the BENCH_FLEET_LOAD row here")
+    args = ap.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_load_")
+    summary = run(workdir, n_requests=args.requests, iters=args.iters,
+                  seed=args.seed, storm_n=args.storm,
+                  scaler_leg=not args.no_scaler_leg)
+
+    ok = True
+    if summary["identity_mismatches"]:
+        ok = False
+        print(f"FAIL: {len(summary['identity_mismatches'])} "
+              "byte-identity mismatch(es) under monitoring:")
+        for m in summary["identity_mismatches"][:10]:
+            print(f"  - {m}")
+    if summary["rollup_violations"]:
+        ok = False
+        print(f"FAIL: rollup exposition violations: "
+              f"{summary['rollup_violations']}")
+    if summary["occupancy"] < MIN_OCCUPANCY:
+        ok = False
+        print(f"FAIL: sustained occupancy {summary['occupancy']:.1%} "
+              f"< {MIN_OCCUPANCY:.0%}")
+    resolved = [a for a, v in (summary.get("alerts") or {}).items()
+                if v["firing"] and v["resolved"]]
+    if not resolved:
+        ok = False
+        print("FAIL: no alert completed a firing->resolved lifecycle")
+
+    print(json.dumps(summary, indent=2, default=str))
+    if ok and args.bench_out:
+        row = bench_row(summary)
+        with open(args.bench_out, "w") as f:
+            json.dump(row, f, indent=2)
+            f.write("\n")
+        print(f"bench row written to {args.bench_out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

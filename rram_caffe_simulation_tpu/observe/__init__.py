@@ -29,15 +29,23 @@ observable in three layers:
    lifetimes), exported as schema-validated `span` JSONL records and
    Perfetto-loadable Chrome-trace timelines, plus the utilization
    layer (lane-occupancy rollups, SLO burn-rate accounting, per-phase
-   time breakdowns) that `summarize --timeline` renders.
+   time breakdowns) that `summarize --timeline` renders;
+6. live metrics plane (metrics_registry.py): a dependency-free
+   counter/gauge/histogram registry fed from the record streams above
+   (or a live `SweepService.stats()` view), rendered as
+   Prometheus/OpenMetrics text for the `metrics` socket op, the fleet
+   controller's `fleet/metrics.prom` rollup, and `caffe fleet top`.
 """
 from .counters import global_norm_sq, mean_abs, to_host, write_traffic_saved
 from .debug import OVERFLOW_LIMIT, PHASES, NetDebugSpec, sentinel_tree
 from .schema import SCHEMA_VERSION, validate_record
-from .sink import (CaffeLogSink, JsonlSink, MetricsLogger,
+from .metrics_registry import (MetricsRegistry, fold_record,
+                               parse_exposition, registry_from_stats,
+                               registry_from_streams, validate_exposition)
+from .sink import (CaffeLogSink, JsonlSink, MetricsLogger, alert_line,
                    debug_trace_lines, fault_redraw_line,
-                   make_fault_redraw_record, make_record,
-                   make_request_record, make_retry_record,
+                   make_alert_record, make_fault_redraw_record,
+                   make_record, make_request_record, make_retry_record,
                    make_setup_record, make_worker_record, request_line,
                    retry_line, sentinel_line, setup_line, worker_line)
 from .spans import (OccupancyAggregator, SloAccountant, SpanTracer,
@@ -52,6 +60,9 @@ __all__ = [
     "make_request_record", "request_line",
     "make_fault_redraw_record", "fault_redraw_line",
     "make_worker_record", "worker_line",
+    "make_alert_record", "alert_line",
+    "MetricsRegistry", "registry_from_stats", "registry_from_streams",
+    "fold_record", "parse_exposition", "validate_exposition",
     "debug_trace_lines", "sentinel_line",
     "global_norm_sq", "write_traffic_saved", "to_host", "mean_abs",
     "NetDebugSpec", "sentinel_tree", "PHASES", "OVERFLOW_LIMIT",

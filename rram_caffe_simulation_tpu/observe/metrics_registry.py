@@ -1,0 +1,490 @@
+"""Dependency-free live metrics plane: counters, gauges, histograms.
+
+This module is the in-memory half of the fleet watchtower.  It never
+imports jax/numpy (like ``observe.schema``) so the controller, the
+``fleet top`` view, and the CI check scripts can all load it by file
+path without pulling in the framework.
+
+A :class:`MetricsRegistry` is a flat bag of named metric families with
+optional labels.  It is fed two ways:
+
+- :func:`registry_from_stats` snapshots a ``SweepService.stats()`` view
+  (occupancy summary, SLO accountant summary, request table, lane
+  counts) into gauges/counters.  This is what the ``metrics`` socket op
+  returns, built on demand at scrape time — the serve loop does no
+  extra work when nobody is scraping.
+- :func:`fold_record` folds one observe JSONL record (request
+  lifecycle, retry/quarantine, worker swap/heartbeat, lane_map) into a
+  registry, so the same signals can be rebuilt offline from the record
+  streams that already exist.
+
+Rendering follows the Prometheus/OpenMetrics text exposition format
+(``# HELP``/``# TYPE`` comment lines, ``name{label="v"} value`` sample
+lines, terminated by ``# EOF``).  :func:`parse_exposition` reads that
+text back into ``{(name, labels): value}`` and
+:func:`validate_exposition` returns a list of format violations — the
+check scripts treat an exposition the way they treat a JSONL record.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+EXPOSITION_EOF = "# EOF"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>\S+)\s*$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+
+DEFAULT_SWAP_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+DEFAULT_LATENCY_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
+
+KIND_COUNTER = "counter"
+KIND_GAUGE = "gauge"
+KIND_HISTOGRAM = "histogram"
+
+
+def _labels_key(labels):
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(value):
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _format_value(value):
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * len(self.buckets)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        v = float(value)
+        self.total += v
+        self.count += 1
+        for i, edge in enumerate(self.buckets):
+            if v <= edge:
+                self.counts[i] += 1
+                break
+
+
+class MetricsRegistry:
+    """A small labelled metric store with Prometheus text rendering."""
+
+    def __init__(self, namespace="rram"):
+        self.namespace = namespace
+        # name -> {"kind": ..., "help": ..., "samples": {labels_key: value}}
+        self._families = {}
+
+    # -- declaration ---------------------------------------------------
+    def _family(self, name, kind, help_text):
+        fam = self._families.get(name)
+        if fam is None:
+            fam = {"kind": kind, "help": help_text or "", "samples": {}}
+            self._families[name] = fam
+        elif fam["kind"] != kind:
+            raise ValueError(
+                f"metric {name!r} already declared as {fam['kind']}, not {kind}"
+            )
+        return fam
+
+    # -- write paths ---------------------------------------------------
+    def inc(self, name, value=1.0, help="", **labels):
+        """Add to a counter (monotonic; negative increments rejected)."""
+        if float(value) < 0:
+            raise ValueError(f"counter {name!r} increment must be >= 0")
+        fam = self._family(name, KIND_COUNTER, help)
+        key = _labels_key(labels)
+        fam["samples"][key] = fam["samples"].get(key, 0.0) + float(value)
+
+    def set(self, name, value, help="", **labels):
+        """Set a gauge to an instantaneous value."""
+        fam = self._family(name, KIND_GAUGE, help)
+        fam["samples"][_labels_key(labels)] = float(value)
+
+    def observe(self, name, value, buckets=DEFAULT_LATENCY_BUCKETS,
+                help="", **labels):
+        """Record one observation into a histogram family."""
+        fam = self._family(name, KIND_HISTOGRAM, help)
+        key = _labels_key(labels)
+        hist = fam["samples"].get(key)
+        if hist is None:
+            hist = fam["samples"][key] = _Histogram(buckets)
+        hist.observe(value)
+
+    # -- read paths ----------------------------------------------------
+    def get(self, name, default=None, **labels):
+        fam = self._families.get(name)
+        if fam is None:
+            return default
+        val = fam["samples"].get(_labels_key(labels))
+        if val is None:
+            return default
+        if isinstance(val, _Histogram):
+            return val.count
+        return val
+
+    def families(self):
+        return dict(self._families)
+
+    # -- rendering -----------------------------------------------------
+    def render(self):
+        """Prometheus/OpenMetrics text exposition, ``# EOF`` terminated."""
+        lines = []
+        for name in sorted(self._families):
+            fam = self._families[name]
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            for key in sorted(fam["samples"]):
+                val = fam["samples"][key]
+                if isinstance(val, _Histogram):
+                    lines.extend(self._render_histogram(name, key, val))
+                else:
+                    lines.append(self._sample_line(name, key, val))
+        lines.append(EXPOSITION_EOF)
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _sample_line(name, labels_key, value, suffix=""):
+        if labels_key:
+            body = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in labels_key
+            )
+            return f"{name}{suffix}{{{body}}} {_format_value(value)}"
+        return f"{name}{suffix} {_format_value(value)}"
+
+    @classmethod
+    def _render_histogram(cls, name, labels_key, hist):
+        lines = []
+        cumulative = 0
+        for edge, n in zip(hist.buckets, hist.counts):
+            cumulative += n
+            key = labels_key + (("le", _format_value(edge)),)
+            lines.append(cls._sample_line(name + "_bucket", tuple(sorted(key)),
+                                          cumulative))
+        key = labels_key + (("le", "+Inf"),)
+        lines.append(cls._sample_line(name + "_bucket", tuple(sorted(key)),
+                                      hist.count))
+        lines.append(cls._sample_line(name, labels_key, hist.total, "_sum"))
+        lines.append(cls._sample_line(name, labels_key, hist.count, "_count"))
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# Feeding a registry from a live SweepService.stats() view
+# ---------------------------------------------------------------------------
+
+def registry_from_stats(view, registry=None):
+    """Snapshot a ``SweepService.stats()`` view into a registry.
+
+    Only reads the dict — never touches the service — so it is safe to
+    call from the socket thread at scrape time.
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+    view = view or {}
+
+    reg.set("rram_lanes", view.get("lanes") or 0,
+            help="configured sweep lanes")
+    reg.set("rram_occupied_lanes", view.get("occupied_lanes") or 0,
+            help="lanes currently running a config")
+    reg.set("rram_pending_configs", view.get("pending_configs") or 0,
+            help="admitted configs waiting for a lane")
+    reg.set("rram_steps_per_sec", view.get("steps_per_sec") or 0.0,
+            help="EMA of training iterations per second")
+    reg.set("rram_projected_backlog_seconds", view.get("projected_s") or 0.0,
+            help="projected seconds to drain admitted work")
+    if view.get("slo_seconds"):
+        reg.set("rram_slo_seconds", view["slo_seconds"],
+                help="per-request turnaround objective")
+    if view.get("iter") is not None:
+        reg.set("rram_service_iter", view.get("iter") or 0,
+                help="serve-loop beat counter")
+
+    for status, count in sorted((view.get("requests") or {}).items()):
+        reg.set("rram_requests", count, help="requests by status",
+                status=status)
+
+    for tenant, iters in sorted((view.get("tenant_lane_iters") or {}).items()):
+        reg.inc("rram_tenant_lane_iters_total", iters,
+                help="lane-iterations charged per tenant", tenant=tenant)
+
+    occ = view.get("occupancy") or {}
+    if occ.get("beats"):
+        reg.set("rram_occupancy_ratio", occ.get("occupancy") or 0.0,
+                help="occupied / total lane-iterations since start")
+        reg.inc("rram_lane_iters_total", occ.get("occupied_lane_iters") or 0,
+                help="lane-iterations by utilization", kind="occupied")
+        reg.inc("rram_lane_iters_total", occ.get("total_lane_iters") or 0,
+                kind="capacity")
+
+    slo = view.get("slo") or {}
+    for tenant, row in sorted(slo.items()):
+        if not isinstance(row, dict) or not row.get("requests"):
+            continue
+        reg.set("rram_slo_burn_rate", row.get("burn_rate") or 0.0,
+                help="mean turnaround / SLO objective (>1 = burning)",
+                tenant=tenant)
+        reg.set("rram_slo_violation_ratio", row.get("violation_rate") or 0.0,
+                help="fraction of requests past the objective",
+                tenant=tenant)
+        if row.get("projection_bias") is not None:
+            reg.set("rram_projection_bias", row["projection_bias"],
+                    help="actual / projected turnaround (1.0 = honest ETA)",
+                    tenant=tenant)
+        reg.set("rram_request_turnaround_seconds_mean",
+                row.get("mean_latency_s") or 0.0,
+                help="mean request turnaround", tenant=tenant)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Feeding a registry from the existing observe JSONL record streams
+# ---------------------------------------------------------------------------
+
+def fold_record(reg, rec):
+    """Fold one observe record into ``reg``.  Unknown types are ignored."""
+    rtype = rec.get("type")
+    if rtype == "request":
+        status = rec.get("status") or rec.get("event") or "unknown"
+        reg.inc("rram_request_events_total", 1,
+                help="request lifecycle transitions",
+                status=str(status), tenant=str(rec.get("tenant") or ""))
+        if rec.get("turnaround_s") is not None:
+            reg.observe("rram_request_turnaround_seconds",
+                        rec["turnaround_s"],
+                        help="request turnaround latency")
+    elif rtype == "retry":
+        reg.inc("rram_retry_total", 1, help="lane retry events",
+                reason=str(rec.get("reason") or ""))
+        if rec.get("quarantined"):
+            reg.inc("rram_quarantine_total", 1,
+                    help="configs quarantined after retry exhaustion")
+    elif rtype == "quarantine":
+        reg.inc("rram_quarantine_total", 1,
+                help="configs quarantined after retry exhaustion")
+    elif rtype == "worker":
+        event = rec.get("event")
+        if event == "swap":
+            reg.inc("rram_swap_total", 1, help="program hot swaps",
+                    worker=str(rec.get("worker") or ""))
+            if rec.get("seconds") is not None:
+                reg.observe("rram_swap_seconds", rec["seconds"],
+                            buckets=DEFAULT_SWAP_BUCKETS,
+                            help="hot swap wall time")
+        elif event in ("dead", "reaped"):
+            reg.inc("rram_worker_deaths_total", 1,
+                    help="workers reaped after missed heartbeats")
+        elif event == "heartbeat":
+            reg.set("rram_worker_up", 1, help="worker liveness",
+                    worker=str(rec.get("worker") or ""))
+    elif rtype == "lane_map":
+        lanes = rec.get("lanes") or []
+        occupied = sum(1 for l in lanes if isinstance(l, dict)
+                       and l.get("cfg_id") is not None)
+        reg.inc("rram_lane_iters_total", occupied * (rec.get("chunk") or 1),
+                help="lane-iterations by utilization", kind="occupied")
+        reg.inc("rram_lane_iters_total", len(lanes) * (rec.get("chunk") or 1),
+                kind="capacity")
+    elif rtype == "alert":
+        state = 1.0 if rec.get("event") == "firing" else 0.0
+        reg.set("rram_alert_firing", state, help="1 while the rule fires",
+                alert=str(rec.get("alert") or ""))
+    return reg
+
+
+def registry_from_streams(paths, registry=None):
+    """Rebuild a registry offline from metrics JSONL stream files."""
+    reg = registry if registry is not None else MetricsRegistry()
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        fold_record(reg, rec)
+        except OSError:
+            continue
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Parsing / validating exposition text (check scripts, fleet top)
+# ---------------------------------------------------------------------------
+
+def parse_exposition(text):
+    """Parse exposition text into ``{(name, ((k, v), ...)): float}``.
+
+    Histogram series parse as their component ``_bucket``/``_sum``/
+    ``_count`` samples.  Raises ``ValueError`` on malformed lines.
+    """
+    samples = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample {raw!r}")
+        labels = {}
+        body = m.group("labels")
+        if body:
+            pos = 0
+            while pos < len(body):
+                pm = _LABEL_PAIR_RE.match(body, pos)
+                if not pm:
+                    raise ValueError(
+                        f"line {lineno}: bad label syntax in {raw!r}")
+                labels[pm.group("key")] = pm.group("val")
+                pos = pm.end()
+        val = m.group("value")
+        if val == "+Inf":
+            value = math.inf
+        elif val == "-Inf":
+            value = -math.inf
+        else:
+            try:
+                value = float(val)
+            except ValueError:
+                raise ValueError(f"line {lineno}: bad value {val!r}")
+        samples[(m.group("name"), _labels_key(labels))] = value
+    return samples
+
+
+def validate_exposition(text):
+    """Return a list of format violations (empty = valid exposition)."""
+    violations = []
+    if not isinstance(text, str) or not text.strip():
+        return ["exposition: empty text"]
+    typed = {}
+    seen_samples = set()
+    lines = text.splitlines()
+    for lineno, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 4)
+            if len(parts) < 4:
+                violations.append(f"line {lineno}: malformed TYPE line")
+                continue
+            name, kind = parts[2], parts[3]
+            if not _NAME_RE.match(name):
+                violations.append(f"line {lineno}: bad metric name {name!r}")
+            if kind not in (KIND_COUNTER, KIND_GAUGE, KIND_HISTOGRAM):
+                violations.append(
+                    f"line {lineno}: unknown metric type {kind!r}")
+            if name in typed:
+                violations.append(f"line {lineno}: duplicate TYPE for {name}")
+            typed[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            violations.append(f"line {lineno}: unparseable sample {raw!r}")
+            continue
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+                break
+        if base not in typed:
+            violations.append(
+                f"line {lineno}: sample {name!r} has no preceding TYPE line")
+        body = m.group("labels")
+        if body:
+            pos = 0
+            while pos < len(body):
+                pm = _LABEL_PAIR_RE.match(body, pos)
+                if not pm:
+                    violations.append(
+                        f"line {lineno}: bad label syntax in {raw!r}")
+                    break
+                if not _LABEL_RE.match(pm.group("key")):
+                    violations.append(
+                        f"line {lineno}: bad label name {pm.group('key')!r}")
+                pos = pm.end()
+        val = m.group("value")
+        if val not in ("+Inf", "-Inf"):
+            try:
+                fval = float(val)
+            except ValueError:
+                violations.append(f"line {lineno}: bad value {val!r}")
+            else:
+                if typed.get(base) == KIND_COUNTER and fval < 0:
+                    violations.append(
+                        f"line {lineno}: counter {name} is negative")
+        key = (name, line.split()[0])
+        if key in seen_samples and "{" not in line:
+            violations.append(f"line {lineno}: duplicate sample {name}")
+        seen_samples.add(key)
+    stripped = [l.strip() for l in lines if l.strip()]
+    if not stripped or stripped[-1] != EXPOSITION_EOF:
+        violations.append("exposition: missing '# EOF' terminator")
+    return violations
+
+
+__all__ = [
+    "MetricsRegistry",
+    "registry_from_stats",
+    "fold_record",
+    "registry_from_streams",
+    "parse_exposition",
+    "validate_exposition",
+    "validate_rollup",
+    "EXPOSITION_EOF",
+    "DEFAULT_SWAP_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+
+def validate_rollup(text, require=("rram_fleet_workers",)):
+    """Validate a fleet rollup: well-formed exposition + required families."""
+    violations = validate_exposition(text)
+    if violations:
+        return violations
+    try:
+        samples = parse_exposition(text)
+    except ValueError as exc:
+        return [str(exc)]
+    names = {name for name, _ in samples}
+    for req in require:
+        if req not in names:
+            violations.append(f"rollup: missing required metric {req!r}")
+    return violations

@@ -410,6 +410,45 @@ WORKER_FIELDS = {
     "reason": (str, False),         # dead / requeued: why
 }
 
+# --- alert records (fleet watchtower rule engine) ---
+#
+# Emitted by the FleetController's declarative rule engine
+# (serve/fleet/alerts.py) on STATE TRANSITIONS only: one record when a
+# rule crosses its threshold and holds for `for_beats` consecutive
+# beats ("firing"), one when it holds clear for the resolve hysteresis
+# ("resolved") — never one per beat, so a flapping metric at the
+# threshold produces no record storm. `metric` names the fleet rollup
+# gauge the rule watches, `value` the observation that crossed, and
+# `threshold`/`for_beats` echo the rule so the record is
+# self-describing without the rule file::
+#
+#     {"schema_version": 1, "type": "alert", "iter": 310,
+#      "wall_time": 1722700000.1, "alert": "slo_burn",
+#      "event": "firing", "metric": "rram_slo_burn_rate",
+#      "value": 1.8, "threshold": 1.0, "for_beats": 3,
+#      "severity": "page", "worker": "w1",
+#      "reason": "tenant _total burn 1.8 > 1.0 for 3 beats"}
+
+ALERT_EVENTS = ("firing", "resolved")
+
+ALERT_SEVERITIES = ("info", "warn", "page")
+
+ALERT_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),            # controller beat counter
+    "wall_time": (_NUM, True),
+    "alert": (str, True),           # rule name (e.g. "slo_burn")
+    "event": (str, True),           # firing | resolved
+    "metric": (str, False),         # rollup metric the rule watches
+    "value": (_NUM, False),         # observation at the transition
+    "threshold": (_NUM, False),     # rule threshold
+    "for_beats": (int, False),      # firing hysteresis (beats held)
+    "severity": (str, False),       # info | warn | page
+    "worker": (str, False),         # worker-scoped rules (death, swap)
+    "reason": (str, False),         # human-readable one-liner
+}
+
 # --- fault_redraw records (restore fallback announcement) ---
 #
 # Emitted by Solver.restore when a snapshot PREDATES fault-state
@@ -696,6 +735,28 @@ def _validate_worker(rec) -> list:
     return errs
 
 
+def _validate_alert(rec) -> list:
+    errs = _check_fields(rec, ALERT_FIELDS, "alert")
+    errs += _check_iter(rec, "alert")
+    event = rec.get("event")
+    if isinstance(event, str) and event not in ALERT_EVENTS:
+        errs.append(f"alert: unknown event {event!r} "
+                    f"(expected one of {ALERT_EVENTS})")
+    severity = rec.get("severity")
+    if isinstance(severity, str) and severity not in ALERT_SEVERITIES:
+        errs.append(f"alert: unknown severity {severity!r} "
+                    f"(expected one of {ALERT_SEVERITIES})")
+    for key in ("alert", "metric", "worker", "reason"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"alert: {key} must be non-empty")
+    for_beats = rec.get("for_beats")
+    if isinstance(for_beats, int) and not isinstance(for_beats, bool) \
+            and for_beats < 1:
+        errs.append("alert: for_beats must be >= 1")
+    return errs
+
+
 def _validate_fault_redraw(rec) -> list:
     errs = _check_fields(rec, FAULT_REDRAW_FIELDS, "fault_redraw")
     errs += _check_iter(rec, "fault_redraw")
@@ -773,6 +834,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_fault_redraw(rec)
     if rtype == "worker":
         return _check_version(rec) + _validate_worker(rec)
+    if rtype == "alert":
+        return _check_version(rec) + _validate_alert(rec)
     if rtype == "span":
         return _check_version(rec) + _validate_span(rec)
     if rtype is not None:

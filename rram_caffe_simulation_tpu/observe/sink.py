@@ -290,6 +290,63 @@ def worker_line(record: dict) -> str:
     return head + tail
 
 
+def make_alert_record(iteration: int, alert: str, event: str,
+                      metric: Optional[str] = None,
+                      value: Optional[float] = None,
+                      threshold: Optional[float] = None,
+                      for_beats: Optional[int] = None,
+                      severity: Optional[str] = None,
+                      worker: Optional[str] = None,
+                      reason: Optional[str] = None) -> dict:
+    """One alert-rule state transition (schema.py ALERT_FIELDS):
+    `firing` when the watched rollup metric crossed its threshold and
+    held for the rule's hysteresis, `resolved` when it held clear
+    again.  Emitted by the FleetController's rule engine only on
+    transitions, never per beat."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "alert",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "alert": str(alert),
+        "event": str(event),
+    }
+    if metric is not None:
+        rec["metric"] = str(metric)
+    if value is not None:
+        rec["value"] = round(float(value), 6)
+    if threshold is not None:
+        rec["threshold"] = round(float(threshold), 6)
+    if for_beats is not None:
+        rec["for_beats"] = int(for_beats)
+    if severity is not None:
+        rec["severity"] = str(severity)
+    if worker is not None:
+        rec["worker"] = str(worker)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    return rec
+
+
+def alert_line(record: dict) -> str:
+    """One-line text form of an `alert` record."""
+    event = record.get("event")
+    head = f"ALERT {record.get('alert')}"
+    if event == "resolved":
+        head = f"RESOLVED {record.get('alert')}"
+    tail = ""
+    if record.get("metric") is not None and record.get("value") is not None:
+        tail += f": {record['metric']}={record['value']:g}"
+        if record.get("threshold") is not None:
+            cmp = ">" if event == "firing" else "vs"
+            tail += f" {cmp} {record['threshold']:g}"
+    if record.get("worker"):
+        tail += f" (worker {record['worker']})"
+    if record.get("reason"):
+        tail += f" — {record['reason']}"
+    return head + tail
+
+
 def make_fault_redraw_record(iteration: int, snapshot: str,
                              reason: str) -> dict:
     """The restore-fallback announcement (schema.py

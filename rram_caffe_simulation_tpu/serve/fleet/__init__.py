@@ -17,10 +17,17 @@ workers, pinned-program routing and hot swap.
   (tests/test_fleet.py); `scripts/check_fleet.py` is the CI guard for
   the whole subsystem (mixed-physics byte-identity, SIGKILL requeue,
   cache-hit swaps, fleet occupancy).
+- `alerts` (alerts.py): the watchtower's declarative alert rules with
+  firing/resolved hysteresis; the controller evaluates them each beat
+  against the fleet rollup it writes to ``<fleet>/metrics.prom``, and
+  ``caffe fleet top`` (top.py) renders the live view —
+  `scripts/check_fleet_load.py` is the CI guard (load replay, alert
+  lifecycle, rollup parse, byte-identity under monitoring).
 
 Run the controller with ``python -m rram_caffe_simulation_tpu.serve.fleet``
 and workers with ``python -m rram_caffe_simulation_tpu.serve.fleet.worker``.
 """
+from .alerts import AlertEngine, AlertRule, default_rules
 from .router import (effective_pins, pick_swap_victim, pick_worker,
                      request_pins, requeue_plan, route, swap_target,
                      worker_load, worker_matches)
@@ -29,6 +36,7 @@ from .table import PIN_KEYS, WorkerTable
 
 __all__ = [
     "FleetController", "FleetWorker", "WorkerTable", "BacklogScaler",
+    "AlertEngine", "AlertRule", "default_rules",
     "PIN_KEYS", "request_pins", "effective_pins", "worker_matches",
     "worker_load", "pick_worker", "pick_swap_victim", "swap_target",
     "route", "requeue_plan",

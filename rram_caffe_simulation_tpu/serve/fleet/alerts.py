@@ -1,0 +1,208 @@
+"""Declarative alert rules with firing/resolved hysteresis.
+
+Pure data + arithmetic, no framework imports (like ``router``/
+``scaler``/``table`` in this package), so the rule engine is
+unit-testable without jax and loadable by the dependency-free check
+scripts.
+
+A rule watches ONE metric in the fleet observation dict the controller
+assembles each beat (the same values it writes to ``fleet/
+metrics.prom``).  Three comparators cover the watchtower's needs:
+
+- ``">"`` / ``"<"``   — level rules (SLO burn above 1, occupancy below
+  the floor);
+- ``"delta>"``        — growth rules on monotonic counters or EMAs
+  (backlog EMA growing beat over beat, worker-death / swap / quarantine
+  counters ticking up: a per-beat increase above the threshold breaches).
+
+Hysteresis is symmetric and beat-counted: a rule FIRES only after the
+condition holds for ``for_beats`` consecutive beats, and RESOLVES only
+after it stays clear for ``clear_beats`` consecutive beats.  A single
+clear beat resets the firing counter (and vice versa), so a metric
+flapping across the threshold every beat produces **no** transitions at
+all — the no-flapping property the tests pin.
+
+The engine reports only TRANSITIONS; the controller turns each into a
+schema-validated ``alert`` record and mirrors active alerts into the
+rollup as ``rram_alert_firing`` gauges.
+"""
+
+from __future__ import annotations
+
+import json
+
+ALERT_OPS = (">", "<", "delta>")
+
+#: Default watchtower rules.  `metric` names a key of the controller's
+#: per-beat fleet observation dict (which mirrors the rollup gauges).
+DEFAULT_RULES = (
+    {"name": "slo_burn", "metric": "slo_burn_rate", "op": ">",
+     "threshold": 1.0, "for_beats": 3, "clear_beats": 3,
+     "severity": "page",
+     "help": "fleet-wide mean turnaround exceeds the SLO objective"},
+    {"name": "occupancy_floor", "metric": "occupancy_ratio", "op": "<",
+     "threshold": 0.5, "for_beats": 5, "clear_beats": 3,
+     "severity": "warn", "when_metric": "backlog_iters",
+     "when_above": 0.0,
+     "help": "lanes idle while a backlog is waiting"},
+    {"name": "backlog_growth", "metric": "backlog_ema", "op": "delta>",
+     "threshold": 0.0, "for_beats": 5, "clear_beats": 3,
+     "severity": "warn",
+     "help": "projected backlog EMA growing beat over beat"},
+    {"name": "worker_death", "metric": "worker_deaths_total",
+     "op": "delta>", "threshold": 0.0, "for_beats": 1, "clear_beats": 5,
+     "severity": "page",
+     "help": "a worker was reaped after missed heartbeats"},
+    {"name": "swap_storm", "metric": "swap_total", "op": "delta>",
+     "threshold": 0.0, "for_beats": 3, "clear_beats": 3,
+     "severity": "warn",
+     "help": "program hot-swaps on consecutive beats (pin thrash)"},
+    {"name": "quarantine_rate", "metric": "quarantine_total",
+     "op": "delta>", "threshold": 0.0, "for_beats": 2, "clear_beats": 5,
+     "severity": "page",
+     "help": "configs being quarantined beat over beat"},
+)
+
+
+class AlertRule:
+    """One declarative rule: metric, comparator, threshold, hysteresis."""
+
+    __slots__ = ("name", "metric", "op", "threshold", "for_beats",
+                 "clear_beats", "severity", "help", "when_metric",
+                 "when_above")
+
+    def __init__(self, name, metric, op, threshold, for_beats=3,
+                 clear_beats=3, severity="warn", help="",
+                 when_metric=None, when_above=0.0):
+        if op not in ALERT_OPS:
+            raise ValueError(f"rule {name!r}: unknown op {op!r} "
+                             f"(expected one of {ALERT_OPS})")
+        if int(for_beats) < 1 or int(clear_beats) < 1:
+            raise ValueError(f"rule {name!r}: hysteresis must be >= 1 beat")
+        self.name = str(name)
+        self.metric = str(metric)
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_beats = int(for_beats)
+        self.clear_beats = int(clear_beats)
+        self.severity = str(severity)
+        self.help = str(help)
+        self.when_metric = when_metric
+        self.when_above = float(when_above)
+
+    @classmethod
+    def from_dict(cls, spec):
+        known = {k: spec[k] for k in
+                 ("name", "metric", "op", "threshold", "for_beats",
+                  "clear_beats", "severity", "help", "when_metric",
+                  "when_above") if k in spec}
+        return cls(**known)
+
+    def breaches(self, value, prev):
+        """Does `value` breach this rule?  `prev` is the last observation
+        (for delta rules); returns None when undecidable this beat."""
+        if value is None:
+            return None
+        if self.op == ">":
+            return float(value) > self.threshold
+        if self.op == "<":
+            return float(value) < self.threshold
+        if prev is None:
+            return None
+        return (float(value) - float(prev)) > self.threshold
+
+
+def default_rules(occupancy_floor=None, slo_burn_limit=None):
+    """The built-in rule set, optionally re-thresholded."""
+    rules = []
+    for spec in DEFAULT_RULES:
+        spec = dict(spec)
+        if occupancy_floor is not None \
+                and spec["name"] == "occupancy_floor":
+            spec["threshold"] = float(occupancy_floor)
+        if slo_burn_limit is not None and spec["name"] == "slo_burn":
+            spec["threshold"] = float(slo_burn_limit)
+        rules.append(AlertRule.from_dict(spec))
+    return rules
+
+
+def load_rules(path):
+    """Load a JSON rule file: a list of rule dicts (see DEFAULT_RULES)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        specs = json.load(fh)
+    if not isinstance(specs, list):
+        raise ValueError(f"{path}: rule file must be a JSON list")
+    return [AlertRule.from_dict(s) for s in specs]
+
+
+class AlertEngine:
+    """Evaluates rules against per-beat observations, tracking state."""
+
+    def __init__(self, rules=None):
+        self.rules = list(rules) if rules is not None else default_rules()
+        # name -> {"firing": bool, "breach": n, "clear": n, "prev": val}
+        self._state = {r.name: {"firing": False, "breach": 0, "clear": 0,
+                                "prev": None} for r in self.rules}
+
+    def active(self):
+        """Names of currently-firing rules (sorted)."""
+        return sorted(n for n, s in self._state.items() if s["firing"])
+
+    def evaluate(self, obs):
+        """Fold one beat's observation dict; return transition dicts.
+
+        Each transition is ``{"alert", "event", "metric", "value",
+        "threshold", "for_beats", "severity", "reason"}`` ready to feed
+        ``make_alert_record``.
+        """
+        transitions = []
+        for rule in self.rules:
+            st = self._state[rule.name]
+            value = obs.get(rule.metric)
+            gated = False
+            if rule.when_metric is not None:
+                guard = obs.get(rule.when_metric)
+                gated = guard is None or float(guard) <= rule.when_above
+            breach = None if gated else rule.breaches(value, st["prev"])
+            if value is not None:
+                st["prev"] = float(value)
+            if breach is None:
+                # Undecidable beat (missing metric / first delta sample /
+                # gated): counts neither way.
+                continue
+            if breach:
+                st["breach"] += 1
+                st["clear"] = 0
+                if not st["firing"] and st["breach"] >= rule.for_beats:
+                    st["firing"] = True
+                    transitions.append(self._transition(
+                        rule, "firing", value,
+                        f"{rule.metric} {rule.op} {rule.threshold:g} "
+                        f"for {st['breach']} beats"))
+            else:
+                st["clear"] += 1
+                st["breach"] = 0
+                if st["firing"] and st["clear"] >= rule.clear_beats:
+                    st["firing"] = False
+                    transitions.append(self._transition(
+                        rule, "resolved", value,
+                        f"{rule.metric} clear of {rule.threshold:g} "
+                        f"for {st['clear']} beats"))
+        return transitions
+
+    @staticmethod
+    def _transition(rule, event, value, reason):
+        return {
+            "alert": rule.name,
+            "event": event,
+            "metric": rule.metric,
+            "value": float(value),
+            "threshold": rule.threshold,
+            "for_beats": rule.for_beats,
+            "severity": rule.severity,
+            "reason": reason,
+        }
+
+
+__all__ = ["AlertRule", "AlertEngine", "default_rules", "load_rules",
+           "DEFAULT_RULES", "ALERT_OPS"]

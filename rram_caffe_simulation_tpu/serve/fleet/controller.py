@@ -37,7 +37,9 @@ host can run it against a shared filesystem).
 """
 from __future__ import annotations
 
+import collections
 import json
+import math
 import os
 import shlex
 import socket
@@ -48,6 +50,7 @@ from typing import Dict, List, Optional
 _HOSTNAME = socket.gethostname()
 
 from ..spool import Spool, _atomic_write, normalize_request
+from .alerts import AlertEngine
 from .router import (request_pins, requeue_plan, route, worker_load)
 from .scaler import BacklogScaler
 from .table import WorkerTable
@@ -97,7 +100,9 @@ class FleetController:
                  poll_interval_s: float = 0.5,
                  default_iters: int = 100,
                  scaler: Optional[BacklogScaler] = None,
-                 worker_cmd: Optional[str] = None):
+                 worker_cmd: Optional[str] = None,
+                 alert_rules: Optional[list] = None,
+                 scrape_sockets: bool = True):
         self.dir = os.path.abspath(fleet_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.spool = Spool(os.path.join(self.dir, "spool"))
@@ -108,6 +113,23 @@ class FleetController:
         self.scaler = scaler
         self.worker_cmd = worker_cmd
         self.metrics_path = os.path.join(self.dir, "fleet.jsonl")
+        #: the watchtower (ISSUE 16): a fleet-wide Prometheus rollup
+        #: rewritten every beat, plus a declarative alert rule engine
+        #: whose firing/resolved transitions land as schema-validated
+        #: `alert` records on fleet.jsonl
+        self.rollup_path = os.path.join(self.dir, "metrics.prom")
+        self.alert_engine = AlertEngine(alert_rules)
+        self.scrape_sockets = bool(scrape_sockets)
+        #: monotonic watchtower counters (persisted so the delta rules
+        #: survive a controller restart)
+        self._deaths_total = 0
+        self._swap_cmds_total = 0
+        self._quarantine_total = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._last_scale_decision = 0
+        #: harvested request turnarounds (bounded) -> rollup quantiles
+        self._latencies = collections.deque(maxlen=4096)
         self._beats = 0
         #: request id -> {"worker", "attempt"} for routed, unharvested
         #: requests (persisted in state.json across restarts)
@@ -148,6 +170,12 @@ class FleetController:
         self.assignments = dict(state.get("assignments", {}))
         self.pending_swaps = dict(state.get("pending_swaps", {}))
         self._next_ordinal = int(state.get("next_ordinal", 0))
+        counters = state.get("watchtower") or {}
+        self._deaths_total = int(counters.get("deaths", 0))
+        self._swap_cmds_total = int(counters.get("swap_cmds", 0))
+        self._quarantine_total = int(counters.get("quarantines", 0))
+        self._scale_ups = int(counters.get("scale_ups", 0))
+        self._scale_downs = int(counters.get("scale_downs", 0))
 
     def _write_state(self):
         _atomic_write(self._state_path(), {
@@ -155,6 +183,13 @@ class FleetController:
             "assignments": self.assignments,
             "pending_swaps": self.pending_swaps,
             "next_ordinal": self._next_ordinal,
+            "watchtower": {
+                "deaths": self._deaths_total,
+                "swap_cmds": self._swap_cmds_total,
+                "quarantines": self._quarantine_total,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+            },
         })
 
     def _emit(self, wid: str, event: str, **kw):
@@ -187,12 +222,15 @@ class FleetController:
         harvested = self._harvest()
         routed = self._route_pending(rows)
         scale = self._apply_scale(rows)
+        alerts = self._watchtower(rows)
         self._write_state()
         return {"beat": self._beats, "workers": sorted(rows),
                 "dead": dead, "harvested": harvested,
                 "routed": routed, "scale": scale,
                 "pending": len(self.spool.pending_ids()),
-                "assigned": len(self.assignments)}
+                "assigned": len(self.assignments),
+                "alerts": alerts,
+                "firing": self.alert_engine.active()}
 
     def _reconcile_swaps(self, rows: Dict[str, dict]):
         """Clear a pending swap once the worker re-registered with the
@@ -255,6 +293,7 @@ class FleetController:
                    for wid, row in rows.items()}
         dead = [wid for wid, r in reasons.items() if r is not None]
         for wid in dead:
+            self._deaths_total += 1
             self._emit(wid, "dead", reason=reasons[wid],
                        pinned=rows[wid].get("pinned"))
             # work it finished before dying harvests normally; only
@@ -306,6 +345,8 @@ class FleetController:
                        ("status", "results", "latency_s", "reason")
                        if req.get(k) is not None}
             payload["worker"] = wid
+            if payload.get("latency_s") is not None:
+                self._latencies.append(float(payload["latency_s"]))
             self.spool.finish(rid, payload)
             del self.assignments[rid]
             done.append(rid)
@@ -322,6 +363,7 @@ class FleetController:
                 req = normalize_request(dict(raw, id=rid), 0)
                 pins = canonicalize_pins(request_pins(req))
             except ValueError as e:
+                self._quarantine_total += 1
                 self.spool.quarantine(rid, f"invalid request: {e}")
                 continue
             wid, swap = route(pins, rows)
@@ -333,6 +375,7 @@ class FleetController:
                     * len(req.get("configs") or []))
                 continue
             if swap is not None:
+                self._swap_cmds_total += 1
                 self.table.command_swap(wid, swap)
                 self.pending_swaps[wid] = swap
                 rows[wid] = dict(rows[wid], pending_swap=swap)
@@ -347,6 +390,7 @@ class FleetController:
                 # controller re-routing after the copy landed): treat
                 # as assigned rather than duplicating the file
                 if "already exists" not in str(e):
+                    self._quarantine_total += 1
                     self.spool.quarantine(rid, str(e))
                     continue
             attempt = int(raw.get("requeues", 0)) + 1
@@ -388,9 +432,12 @@ class FleetController:
         decision = self.scaler.decide(backlog, rate,
                                       len(rows) + starting,
                                       idle_workers=len(idle))
+        self._last_scale_decision = decision
         if decision > 0:
+            self._scale_ups += 1
             self._spawn_worker()
         elif decision < 0 and idle:
+            self._scale_downs += 1
             victim = min(idle, key=lambda w: (worker_load(rows[w]), w))
             with open(os.path.join(self.table.worker_dir(victim),
                                    "DRAIN"), "w"):
@@ -431,6 +478,232 @@ class FleetController:
                    reason="scale-up: fleet projection over the target "
                           "window")
         return wid
+
+    # ------------------------------------------------------------------
+    # watchtower: per-beat rollup + alert rules (ISSUE 16)
+
+    def _scrape_worker(self, wid: str) -> Optional[dict]:
+        """One `metrics` scrape of a worker's service front door:
+        parsed exposition samples, or None when the socket is down
+        (the heartbeat-row snapshot is the fallback)."""
+        path = os.path.join(self.table.worker_dir(wid), "service.sock")
+        if not self.scrape_sockets or not os.path.exists(path):
+            return None
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(1.0)
+        try:
+            sock.connect(path)
+            sock.sendall(b'{"op": "metrics"}\n')
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(1 << 20)
+                if not chunk:
+                    break
+                buf += chunk
+            resp = json.loads(buf.decode())
+        except (OSError, ValueError):
+            return None
+        finally:
+            sock.close()
+        if not resp.get("ok") or "exposition" not in resp:
+            return None
+        from ...observe.metrics_registry import parse_exposition
+        try:
+            return parse_exposition(resp["exposition"])
+        except ValueError:
+            return None
+
+    def _worker_view(self, wid: str, row: dict) -> dict:
+        """A uniform per-worker health view: from a live socket scrape
+        when possible, else from the heartbeat row's stats snapshot
+        (satellite: the table alone is enough to run the rollup)."""
+        scraped = self._scrape_worker(wid)
+        if scraped is not None:
+            requests = {}
+            for (name, labels), value in scraped.items():
+                if name == "rram_requests":
+                    status = dict(labels).get("status", "")
+                    requests[status] = int(value)
+            tot = (("tenant", "_total"),)
+            return {
+                "source": "socket",
+                "occupancy": scraped.get(("rram_occupancy_ratio", ()),
+                                         0.0),
+                "slo_burn": scraped.get(("rram_slo_burn_rate", tot),
+                                        0.0),
+                "projection_bias": scraped.get(
+                    ("rram_projection_bias", tot), 0.0),
+                "requests": requests,
+                "active_requests": int(requests.get("running", 0)
+                                       + requests.get("admitted", 0)),
+                "projected_s": scraped.get(
+                    ("rram_projected_backlog_seconds", ()), 0.0),
+            }
+        snap = row.get("stats") or {}
+        return {
+            "source": "table",
+            "occupancy": float(snap.get("occupancy") or 0.0),
+            "slo_burn": float(snap.get("slo_burn") or 0.0),
+            "projection_bias": float(snap.get("projection_bias")
+                                     or 0.0),
+            "requests": dict(snap.get("requests") or {}),
+            "active_requests": int(snap.get("active_requests") or 0),
+            "projected_s": float(snap.get("projected_s") or 0.0),
+        }
+
+    def _fleet_observation(self, rows: Dict[str, dict],
+                           views: Dict[str, dict]) -> dict:
+        """The per-beat metric dict the alert rules evaluate — the
+        same values the rollup publishes as fleet-level gauges."""
+        lanes = sum(int(r.get("lanes", 0)) for r in rows.values())
+        occupied = sum(int(r.get("occupied_lanes", 0))
+                       for r in rows.values())
+        backlog = self._pending_backlog_iters + sum(
+            int(r.get("pending_configs", 0)) * self.default_iters
+            for r in rows.values())
+        burn = max([float(v.get("slo_burn") or 0.0)
+                    for v in views.values()], default=0.0)
+        ema = self.scaler.projected_s if self.scaler is not None \
+            else None
+        return {
+            "workers": len(rows),
+            "lanes": lanes,
+            "occupied_lanes": occupied,
+            "occupancy_ratio": (occupied / lanes) if lanes else 0.0,
+            "backlog_iters": float(backlog),
+            "backlog_ema": (float(ema) if ema is not None
+                            else float(backlog)),
+            "slo_burn_rate": burn,
+            "worker_deaths_total": float(self._deaths_total),
+            "swap_total": float(self._swap_cmds_total),
+            "quarantine_total": float(self._quarantine_total),
+            "pending_requests": len(self.spool.pending_ids()),
+            "assigned_requests": len(self.assignments),
+        }
+
+    def _write_rollup(self, rows: Dict[str, dict],
+                      views: Dict[str, dict], obs: dict):
+        """Rewrite <fleet>/metrics.prom atomically with the fleet-wide
+        gauges/counters, per-worker series, and active-alert gauges."""
+        from ...observe.metrics_registry import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.set("rram_fleet_beat", self._beats,
+                help="controller scheduling beats")
+        reg.set("rram_fleet_workers", obs["workers"],
+                help="registered live workers")
+        reg.set("rram_fleet_lanes", obs["lanes"],
+                help="lanes across the fleet")
+        reg.set("rram_fleet_occupied_lanes", obs["occupied_lanes"],
+                help="occupied lanes across the fleet")
+        reg.set("rram_fleet_occupancy_ratio", obs["occupancy_ratio"],
+                help="occupied / total lanes this beat")
+        reg.set("rram_fleet_backlog_iters", obs["backlog_iters"],
+                help="unserved lane-iterations (routed + unrouted)")
+        reg.set("rram_fleet_backlog_ema", obs["backlog_ema"],
+                help="scaler's smoothed backlog projection (seconds "
+                     "when a scaler runs, raw iters otherwise)")
+        reg.set("rram_fleet_slo_burn_rate", obs["slo_burn_rate"],
+                help="worst per-worker SLO burn rate")
+        reg.set("rram_fleet_pending_requests", obs["pending_requests"],
+                help="fleet-spool requests awaiting routing")
+        reg.set("rram_fleet_assigned_requests",
+                obs["assigned_requests"],
+                help="requests routed and in flight")
+        reg.set("rram_fleet_scale_decision", self._last_scale_decision,
+                help="last scaler decision (+1 up / -1 down / 0)")
+        reg.inc("rram_fleet_worker_deaths_total", self._deaths_total,
+                help="workers reaped since fleet birth")
+        reg.inc("rram_fleet_swap_commands_total", self._swap_cmds_total,
+                help="hot-swap commands issued")
+        reg.inc("rram_fleet_quarantine_total", self._quarantine_total,
+                help="requests quarantined at the fleet door")
+        reg.inc("rram_fleet_scale_events_total", self._scale_ups,
+                help="scaler actions taken", direction="up")
+        reg.inc("rram_fleet_scale_events_total", self._scale_downs,
+                direction="down")
+        if self._latencies:
+            ordered = sorted(self._latencies)
+
+            def pct(p):
+                k = int(math.ceil(p * len(ordered))) - 1
+                return ordered[max(0, min(len(ordered) - 1, k))]
+
+            reg.set("rram_fleet_turnaround_seconds_count",
+                    len(ordered),
+                    help="harvested turnarounds in the quantile window")
+            for q in (0.5, 0.9, 0.99):
+                reg.set("rram_fleet_turnaround_seconds", pct(q),
+                        help="request turnaround quantiles "
+                             "(nearest-rank over the harvest window)",
+                        quantile=f"{q:g}")
+        firing = set(self.alert_engine.active())
+        for rule in self.alert_engine.rules:
+            reg.set("rram_alert_firing",
+                    1 if rule.name in firing else 0,
+                    help="1 while the alert rule fires",
+                    alert=rule.name)
+        now = time.time()
+        for wid in sorted(rows):
+            row, view = rows[wid], views[wid]
+            reg.set("rram_worker_up", 1, help="worker liveness",
+                    worker=wid)
+            reg.set("rram_worker_heartbeat_age_seconds",
+                    max(now - float(row.get("heartbeat_time", now)),
+                        0.0),
+                    help="seconds since the row refreshed", worker=wid)
+            reg.set("rram_worker_lanes", int(row.get("lanes", 0)),
+                    help="worker lane pool size", worker=wid)
+            reg.set("rram_worker_occupied_lanes",
+                    int(row.get("occupied_lanes", 0)),
+                    help="worker lanes running a config", worker=wid)
+            reg.set("rram_worker_pending_configs",
+                    int(row.get("pending_configs", 0)),
+                    help="configs queued on the worker", worker=wid)
+            reg.set("rram_worker_steps_per_sec",
+                    float(row.get("steps_per_sec", 0.0)),
+                    help="worker dispatch-rate EMA", worker=wid)
+            reg.inc("rram_worker_swap_total",
+                    int(row.get("swap_count", 0)),
+                    help="hot swaps applied by the worker", worker=wid)
+            reg.set("rram_worker_occupancy_ratio",
+                    float(view.get("occupancy") or 0.0),
+                    help="worker exact lane-iteration occupancy",
+                    worker=wid)
+            reg.set("rram_worker_slo_burn",
+                    float(view.get("slo_burn") or 0.0),
+                    help="worker per-tenant-total SLO burn",
+                    worker=wid)
+            reg.set("rram_worker_active_requests",
+                    int(view.get("active_requests") or 0),
+                    help="admitted + running requests", worker=wid)
+            for status, count in sorted(
+                    (view.get("requests") or {}).items()):
+                reg.set("rram_worker_requests", int(count),
+                        help="worker requests by status", worker=wid,
+                        status=str(status))
+        tmp = self.rollup_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(reg.render())
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.rollup_path)
+
+    def _watchtower(self, rows: Dict[str, dict]) -> List[str]:
+        """Evaluate the alert rules on this beat's fleet observation,
+        emit transition records, and rewrite the rollup."""
+        views = {wid: self._worker_view(wid, row)
+                 for wid, row in rows.items()}
+        obs = self._fleet_observation(rows, views)
+        transitions = self.alert_engine.evaluate(obs)
+        if transitions:
+            from ...observe import alert_line, make_alert_record
+            for t in transitions:
+                rec = make_alert_record(self._beats, **t)
+                _append_jsonl(self.metrics_path, rec)
+                print(f"Fleet watchtower: {alert_line(rec)}",
+                      flush=True)
+        self._write_rollup(rows, views, obs)
+        return [f"{t['alert']}:{t['event']}" for t in transitions]
 
     # ------------------------------------------------------------------
     # the loop
@@ -519,6 +792,13 @@ def main(argv=None) -> int:
                         "rram_caffe_simulation_tpu.serve.fleet.worker "
                         "--fleet-dir {fleet} --name {name} --solver "
                         "s.prototxt\"")
+    p.add_argument("--alert-rules", default=None,
+                   help="JSON rule file overriding the built-in alert "
+                        "rules (see serve/fleet/alerts.py "
+                        "DEFAULT_RULES for the shape)")
+    p.add_argument("--no-scrape", action="store_true",
+                   help="skip per-beat worker socket scrapes; the "
+                        "rollup runs from heartbeat rows alone")
     p.add_argument("--drain-when-idle", action="store_true",
                    help="drain the whole fleet once the spool is empty "
                         "and every worker is idle (batch/CI mode)")
@@ -532,12 +812,17 @@ def main(argv=None) -> int:
         scaler = BacklogScaler(target_seconds=args.target_seconds,
                                min_workers=args.min_workers,
                                max_workers=args.max_workers)
+    rules = None
+    if args.alert_rules:
+        from .alerts import load_rules
+        rules = load_rules(args.alert_rules)
     ctl = FleetController(
         args.fleet_dir,
         heartbeat_timeout_s=args.heartbeat_timeout,
         poll_interval_s=args.poll_interval,
         default_iters=args.default_iters,
-        scaler=scaler, worker_cmd=args.worker_cmd)
+        scaler=scaler, worker_cmd=args.worker_cmd,
+        alert_rules=rules, scrape_sockets=not args.no_scrape)
 
     def _on_signal(signum, frame):
         with open(os.path.join(ctl.dir, "DRAIN"), "w"):

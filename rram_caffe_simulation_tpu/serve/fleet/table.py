@@ -16,6 +16,12 @@ worker's self-description:
 - load (`occupied_lanes`, `pending_configs`, `steps_per_sec`): what
   the router's least-loaded choice and the scaler's projected-backlog
   arithmetic read;
+- `stats`: the watchtower snapshot (backlog projection, exact
+  occupancy ratio, per-status request counts, active requests, SLO
+  burn / projection bias) refreshed with every heartbeat — enough for
+  `ServeClient stats` and the controller's ``metrics.prom`` rollup to
+  run SOCKET-FREE from the table alone (a down front door degrades
+  the plane to heartbeat granularity, never to blindness);
 - `pending_swap`: set while a hot-swap command is queued — the row
   matches requests against the swap TARGET pins so the stream keeps
   routing to the worker that is about to serve it.

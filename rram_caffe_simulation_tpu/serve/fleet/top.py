@@ -1,0 +1,175 @@
+"""``caffe fleet top`` — a curses-free live terminal fleet view.
+
+Polls the controller's ``<fleet>/metrics.prom`` rollup (rewritten
+atomically every beat) plus the worker table rows, and repaints one
+plain-text frame per interval with ANSI clear-screen — no curses, no
+external dependencies, works over ssh and in CI (``--once`` prints a
+single frame and exits, which is how the tests drive it).
+
+The view is read-only: it never touches the spool, the sockets, or
+the table — killing it mid-frame cannot perturb the fleet, and a
+monitored run stays byte-identical to an unmonitored one.
+
+    caffe fleet top --fleet-dir /runs/fleet
+    caffe fleet top --fleet-dir /runs/fleet --once   # one frame (CI)
+"""
+from __future__ import annotations
+
+import os
+import time
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def _load_rollup(fleet_dir):
+    """Parsed rollup samples, or None when no rollup exists yet."""
+    from ...observe.metrics_registry import parse_exposition
+    path = os.path.join(fleet_dir, "metrics.prom")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return parse_exposition(fh.read())
+    except (OSError, ValueError):
+        return None
+
+
+def _load_rows(fleet_dir):
+    from .table import WorkerTable
+    try:
+        return WorkerTable(fleet_dir).rows()
+    except OSError:
+        return {}
+
+
+def _get(samples, name, default=0.0, **labels):
+    key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+    val = samples.get(key)
+    return default if val is None else val
+
+
+def _fmt_age(seconds):
+    if seconds < 100:
+        return f"{seconds:.1f}s"
+    if seconds < 6000:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def render_frame(fleet_dir, samples, rows, now=None):
+    """One frame of the fleet view as a string (pure; unit-testable)."""
+    now = time.time() if now is None else now
+    lines = []
+    if samples is None:
+        lines.append(f"fleet {fleet_dir}")
+        lines.append("no rollup yet (metrics.prom absent) — is the "
+                     "controller beating?")
+        if rows:
+            lines.append(f"worker table has {len(rows)} row(s): "
+                         + ", ".join(sorted(rows)))
+        return "\n".join(lines) + "\n"
+
+    beat = int(_get(samples, "rram_fleet_beat"))
+    workers = int(_get(samples, "rram_fleet_workers"))
+    lanes = int(_get(samples, "rram_fleet_lanes"))
+    occupied = int(_get(samples, "rram_fleet_occupied_lanes"))
+    occ = _get(samples, "rram_fleet_occupancy_ratio")
+    backlog = _get(samples, "rram_fleet_backlog_iters")
+    ema = _get(samples, "rram_fleet_backlog_ema")
+    pending = int(_get(samples, "rram_fleet_pending_requests"))
+    assigned = int(_get(samples, "rram_fleet_assigned_requests"))
+    burn = _get(samples, "rram_fleet_slo_burn_rate")
+    p50 = _get(samples, "rram_fleet_turnaround_seconds", None,
+               quantile="0.5")
+    p99 = _get(samples, "rram_fleet_turnaround_seconds", None,
+               quantile="0.99")
+
+    lines.append(f"fleet {fleet_dir}  beat {beat}  "
+                 f"workers {workers}  lanes {occupied}/{lanes} "
+                 f"({occ:.0%} occupied)")
+    lat = "p50 —  p99 —" if p50 is None else \
+        f"p50 {p50:.2f}s  p99 {p99:.2f}s"
+    lines.append(f"backlog {backlog:g} iters (ema {ema:g})  "
+                 f"pending {pending}  in-flight {assigned}  "
+                 f"{lat}  slo burn {burn:.2f}")
+
+    firing = sorted(
+        dict(labels).get("alert", "")
+        for (name, labels), value in samples.items()
+        if name == "rram_alert_firing" and value >= 1)
+    if firing:
+        lines.append("ALERTS FIRING: " + ", ".join(firing))
+    else:
+        lines.append("alerts: none firing")
+
+    lines.append("")
+    lines.append(f"{'WORKER':<10}{'AGE':>6}{'LANES':>7}{'PEND':>6}"
+                 f"{'ACTIVE':>8}{'STEP/S':>9}{'SWAPS':>7}{'OCC':>6}"
+                 "  PINNED")
+    wids = sorted(set(
+        dict(labels).get("worker", "")
+        for (name, labels), _ in samples.items()
+        if name == "rram_worker_up") | set(rows))
+    for wid in wids:
+        row = rows.get(wid) or {}
+        age = now - float(row.get("heartbeat_time", now))
+        lanes_w = int(_get(samples, "rram_worker_lanes",
+                           row.get("lanes", 0), worker=wid))
+        occ_w = int(_get(samples, "rram_worker_occupied_lanes",
+                         row.get("occupied_lanes", 0), worker=wid))
+        pend_w = int(_get(samples, "rram_worker_pending_configs",
+                          row.get("pending_configs", 0), worker=wid))
+        active = int(_get(samples, "rram_worker_active_requests", 0,
+                          worker=wid))
+        sps = _get(samples, "rram_worker_steps_per_sec",
+                   row.get("steps_per_sec", 0.0), worker=wid)
+        swaps = int(_get(samples, "rram_worker_swap_total",
+                         row.get("swap_count", 0), worker=wid))
+        occr = _get(samples, "rram_worker_occupancy_ratio", 0.0,
+                    worker=wid)
+        pinned = row.get("pinned") or {}
+        pin = ",".join(f"{k}={pinned[k]}" for k in
+                       ("process", "net", "tiles", "dtype_policy")
+                       if pinned.get(k))
+        lines.append(f"{wid:<10}{_fmt_age(age):>6}"
+                     f"{f'{occ_w}/{lanes_w}':>7}{pend_w:>6}"
+                     f"{active:>8}{sps:>9.1f}{swaps:>7}"
+                     f"{occr:>6.0%}  {pin}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="caffe fleet top",
+        description="live fleet view over the controller's "
+                    "metrics.prom rollup (see serve/fleet/top.py)")
+    p.add_argument("--fleet-dir", required=True)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between repaints")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (CI / scripting)")
+    p.add_argument("--frames", type=int, default=0,
+                   help="stop after N frames (test hook); 0 = forever")
+    args = p.parse_args(argv)
+
+    fleet = os.path.abspath(args.fleet_dir)
+    frames = 0
+    try:
+        while True:
+            frame = render_frame(fleet, _load_rollup(fleet),
+                                 _load_rows(fleet))
+            if args.once:
+                print(frame, end="", flush=True)
+                return 0
+            print(CLEAR + frame, end="", flush=True)
+            frames += 1
+            if args.frames and frames >= args.frames:
+                return 0
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

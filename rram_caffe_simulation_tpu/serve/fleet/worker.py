@@ -210,6 +210,9 @@ class FleetWorker:
     def _row_fields(self) -> dict:
         import socket
         view = self.service.stats()
+        reqs = view.get("requests") or {}
+        occ = view.get("occupancy") or {}
+        slo = (view.get("slo") or {}).get("_total") or {}
         return {
             "pid": os.getpid(),
             "host": socket.gethostname(),
@@ -220,6 +223,23 @@ class FleetWorker:
             "pending_configs": int(view.get("pending_configs") or 0),
             "steps_per_sec": float(view.get("steps_per_sec") or 0.0),
             "swap_count": self.swap_count,
+            # watchtower snapshot: enough state on the heartbeat row
+            # for ServeClient stats and the controller's rollup to
+            # work SOCKET-FREE from the worker table alone
+            "stats": {
+                "iter": int(view.get("iter") or 0),
+                "requests": {str(k): int(v) for k, v in reqs.items()},
+                "active_requests": int(reqs.get("running") or 0)
+                                   + int(reqs.get("admitted") or 0),
+                "projected_s": round(float(view.get("projected_s")
+                                           or 0.0), 3),
+                "occupancy": round(float(occ.get("occupancy") or 0.0),
+                                   4),
+                "slo_burn": round(float(slo.get("burn_rate") or 0.0),
+                                  4),
+                "projection_bias": round(float(slo.get(
+                    "projection_bias") or 0.0), 4),
+            },
         }
 
     def _worker_record(self, event: str, **kw) -> dict:

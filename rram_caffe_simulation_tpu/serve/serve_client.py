@@ -253,10 +253,27 @@ class ServeClient:
                 for s, n in (ws.get("requests") or {}).items():
                     req_counts[s] = req_counts.get(s, 0) + int(n)
             elif row is not None:
-                # service socket down: the heartbeat row's load fields
-                # are the freshest view we have
+                # service socket down: the heartbeat row IS the stats
+                # view — its load fields plus the watchtower snapshot
+                # the worker publishes on every heartbeat, so fleet
+                # stats stay complete socket-free (table-only mode)
                 for k in totals:
                     totals[k] += row.get(k) or 0
+                snap = row.get("stats") or {}
+                entry["stats"] = {
+                    "lanes": row.get("lanes"),
+                    "occupied_lanes": row.get("occupied_lanes"),
+                    "pending_configs": row.get("pending_configs"),
+                    "steps_per_sec": row.get("steps_per_sec"),
+                    "projected_s": snap.get("projected_s"),
+                    "occupancy": snap.get("occupancy"),
+                    "slo_burn": snap.get("slo_burn"),
+                    "active_requests": snap.get("active_requests"),
+                    "iter": snap.get("iter"),
+                    "source": "heartbeat_row",
+                }
+                for s, n in (snap.get("requests") or {}).items():
+                    req_counts[s] = req_counts.get(s, 0) + int(n)
             workers[wid] = entry
         totals["steps_per_sec"] = round(totals["steps_per_sec"], 4)
         return {

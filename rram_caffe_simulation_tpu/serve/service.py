@@ -1244,6 +1244,14 @@ class _SocketServer(threading.Thread):
             return {"ok": True, "request": req}
         if op == "stats":
             return {"ok": True, "stats": svc.stats()}
+        if op == "metrics":
+            # Prometheus exposition built ON DEMAND from the lock-
+            # protected stats snapshot: the serve loop does no extra
+            # work when nobody scrapes, so a monitored run stays
+            # byte-identical to an unmonitored one.
+            from ..observe.metrics_registry import registry_from_stats
+            return {"ok": True,
+                    "exposition": registry_from_stats(svc.stats()).render()}
         if op == "drain":
             svc.drain()
             return {"ok": True, "draining": True}

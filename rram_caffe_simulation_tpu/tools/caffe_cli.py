@@ -574,6 +574,20 @@ def serve(args):
     return serve_main(extra + list(args.args))
 
 
+@register
+def fleet(args):
+    """Fleet front end: `caffe fleet top -- --fleet-dir DIR` runs the
+    live watchtower view (serve/fleet/top.py); anything else — `caffe
+    fleet -- --fleet-dir DIR ...` — runs the controller
+    (USAGE.md "Fleet service")."""
+    rest = list(args.args)
+    if rest and rest[0] == "top":
+        from ..serve.fleet.top import main as top_main
+        return top_main(rest[1:])
+    from ..serve.fleet.controller import main as fleet_main
+    return fleet_main(rest)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="caffe", description="command line brew",
@@ -703,7 +717,7 @@ def main(argv=None):
                         or args.command in ("train_net", "finetune_net",
                                             "test_net",
                                             "net_speed_benchmark",
-                                            "serve"))
+                                            "serve", "fleet"))
     if args.args and not takes_positional:
         p.error(f"unrecognized arguments: {' '.join(args.args)}")
     return BREW[args.command](args)

@@ -202,14 +202,35 @@ def _dir_metric_files(p):
         key=_natural_key)
 
 
-def _expand_metric_paths(paths):
+def _dir_request_files(p):
+    """A service directory's per-request lifecycle streams
+    (``requests/*.jsonl``) — the fallback for a REQUEST-ONLY directory
+    (e.g. a spool-fed service that never armed tracing): request
+    records normally ride the metrics stream too, so these are read
+    only when no metrics*.jsonl exists (reading both would double-count
+    lifecycle transitions)."""
+    rdir = os.path.join(p, "requests")
+    if not os.path.isdir(rdir):
+        return []
+    return [os.path.join(rdir, f)
+            for f in sorted(os.listdir(rdir), key=_natural_key)
+            if f.endswith(".jsonl")]
+
+
+def _expand_metric_paths(paths, strict=True):
     """Directories (a sweep run dir, a service dir) expand to their
     `metrics*.jsonl` streams in natural order; files pass through. A
     FLEET directory (serve/fleet/ — it has a `workers/` table)
     expands to the controller's `fleet.jsonl` plus every worker's
     service streams, so one digest covers the whole fleet; every
     stream shares the wall epoch the span layer anchored (PR 14), so
-    the merge needs no clock reconciliation."""
+    the merge needs no clock reconciliation.
+
+    A directory with no metrics streams falls back to its
+    ``requests/*.jsonl`` lifecycle streams; with nothing at all it
+    raises FileNotFoundError under ``strict`` (the default) or is
+    skipped with ``strict=False`` (the --timeline path, which renders
+    a clean "no spans recorded" digest instead of a traceback)."""
     out = []
     for p in paths:
         if os.path.isdir(p):
@@ -224,19 +245,29 @@ def _expand_metric_paths(paths):
                     wdir = os.path.join(workers, wid)
                     if not os.path.isdir(wdir):
                         continue
-                    found += [os.path.join(wdir, n)
+                    metric = [os.path.join(wdir, n)
                               for n in _dir_metric_files(wdir)]
+                    found += metric if metric \
+                        else _dir_request_files(wdir)
                 if not found:
-                    raise FileNotFoundError(
-                        f"{p}: fleet directory has no fleet.jsonl or "
-                        "worker metrics*.jsonl streams yet")
+                    if strict:
+                        raise FileNotFoundError(
+                            f"{p}: fleet directory has no fleet.jsonl "
+                            "or worker metrics*.jsonl streams yet")
+                    continue
                 out += found
                 continue
             names = _dir_metric_files(p)
-            if not names:
+            if names:
+                out += [os.path.join(p, n) for n in names]
+                continue
+            reqs = _dir_request_files(p)
+            if reqs:
+                out += reqs
+                continue
+            if strict:
                 raise FileNotFoundError(
                     f"{p}: no metrics*.jsonl streams in directory")
-            out += [os.path.join(p, n) for n in names]
         else:
             out.append(p)
     return out
@@ -527,7 +558,14 @@ def summarize_timeline(paths, slo_seconds: float = 0.0):
                                  latency_percentiles, phase_breakdown)
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
-    files = _expand_metric_paths(paths)
+    files = _expand_metric_paths(paths, strict=False)
+    if not files:
+        # empty/absent streams (a directory the service has not
+        # written to yet, or one holding only non-stream artifacts):
+        # a clean digest, never a traceback
+        return ("Timeline: 0 file(s), 0 stream(s)\n"
+                "no spans recorded (no metrics*.jsonl, fleet.jsonl, "
+                "or requests/*.jsonl streams found)")
     streams, notes = merge_metric_streams(files)
     recs, retries, requests, spans, workers, _ = _classify(streams)
     lines = [f"Timeline: {len(files)} file(s), "

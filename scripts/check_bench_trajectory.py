@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""CI guard: every BENCH_*.json row is schema-valid and the trajectory
+is monotone-or-explained.
+
+Two row shapes exist on the trajectory and both are held to a shared
+minimal schema:
+
+- **parsed rows** (``BENCH_r05.json``, ``BENCH_TILED_IMAGENET_r01.json``):
+  the bench.py harness shape — ``{"n", "cmd", "rc", "parsed": {"metric",
+  "value", "unit", ...}}`` with ``rc == 0`` and a positive numeric
+  ``value``;
+- **fleet rows** (``BENCH_FLEET_r01.json``, ``BENCH_FLEET_LOAD_r01.json``):
+  flat dicts marked by a ``"bench"`` name with non-negative numeric
+  fields (``workers``, ``requests``, ``occupancy``, ...).
+
+Rows group into SERIES by filename — ``BENCH_<SERIES>_r<N>[_variant]``
+(no series tag = the main img/s/chip line) — and within a series each
+row's primary metric is compared against the PRIOR revision:
+
+- a drop is FLAGGED (printed, with the delta) but only fails the guard
+  with ``--strict``: the trajectory legitimately steps down when the
+  measurement host changes (the r05 TPU row vs the CPU-remeasured r06),
+  and such rows declare it in their ``note``;
+- a row whose note declares reduced scale / CPU measurement /
+  non-comparability is reported as non-comparable instead of flagged.
+
+Schema violations always fail (exit 1). Stdlib-only — no framework
+import, so this guard runs anywhere.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_NAME_RE = re.compile(
+    r"^BENCH_(?:(?P<series>[A-Z0-9]+(?:_[A-Z0-9]+)*)_)?"
+    r"r(?P<rev>\d+)(?:_(?P<variant>[a-z][a-z0-9_]*))?\.json$")
+
+#: note substrings that declare a row non-comparable to its
+#: predecessor (different host / scale), case-insensitive
+_NONCOMPARABLE = ("cpu-measured", "cpu-only", "reduced scale",
+                  "not comparable", "interpret-mode", "guard scale")
+
+_NUM = (int, float)
+
+
+def _is_num(v):
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def parse_name(name):
+    """(series, variant, revision) for a BENCH file name, or None."""
+    m = _NAME_RE.match(name)
+    if not m:
+        return None
+    return (m.group("series") or "", m.group("variant") or "",
+            int(m.group("rev")))
+
+
+def validate_row(row):
+    """Shared minimal schema; returns a list of violations."""
+    errs = []
+    if not isinstance(row, dict):
+        return ["row is not a JSON object"]
+    if "parsed" in row:
+        parsed = row["parsed"]
+        if not isinstance(parsed, dict):
+            errs.append("parsed: not an object")
+        else:
+            metric = parsed.get("metric")
+            if not isinstance(metric, str) or not metric:
+                errs.append("parsed.metric: missing or empty")
+            value = parsed.get("value")
+            if not _is_num(value) or value <= 0:
+                errs.append("parsed.value: must be a positive number")
+            unit = parsed.get("unit")
+            if unit is not None and (not isinstance(unit, str)
+                                     or not unit):
+                errs.append("parsed.unit: must be a non-empty string")
+        rc = row.get("rc")
+        if rc is None:
+            errs.append("rc: missing (did the bench command exit?)")
+        elif not isinstance(rc, int) or isinstance(rc, bool) or rc != 0:
+            errs.append(f"rc: {rc!r} != 0 (row published from a "
+                        "failed run)")
+        n = row.get("n")
+        if n is not None and (not isinstance(n, int)
+                              or isinstance(n, bool) or n < 1):
+            errs.append(f"n: {n!r} must be a positive int")
+        if not isinstance(row.get("cmd"), str) or not row.get("cmd"):
+            errs.append("cmd: missing — a row must record how to "
+                        "reproduce it")
+    elif "bench" in row:
+        if not isinstance(row["bench"], str) or not row["bench"]:
+            errs.append("bench: must be a non-empty name")
+        for key, val in row.items():
+            if _is_num(val) and val < 0:
+                errs.append(f"{key}: negative ({val!r})")
+        occ = row.get("occupancy")
+        if occ is not None and (not _is_num(occ) or occ > 1.0):
+            errs.append(f"occupancy: {occ!r} must be a ratio <= 1.0")
+        if not isinstance(row.get("note"), str) or not row.get("note"):
+            errs.append("note: missing — a fleet row must explain "
+                        "what it measured")
+    else:
+        errs.append("row has neither 'parsed' (bench.py shape) nor "
+                    "'bench' (fleet shape) — unknown bench schema")
+    return errs
+
+
+def primary_metric(row):
+    """(name, value, higher_is_better) for trajectory comparison."""
+    if "parsed" in row and isinstance(row["parsed"], dict):
+        v = row["parsed"].get("value")
+        if _is_num(v):
+            return ("parsed.value", float(v), True)
+    if "bench" in row:
+        v = row.get("configs_per_hour_aggregate")
+        if _is_num(v):
+            return ("configs_per_hour_aggregate", float(v), True)
+        v = row.get("occupancy")
+        if _is_num(v):
+            return ("occupancy", float(v), True)
+    return None
+
+
+def noncomparable_reason(row):
+    note = (str(row.get("note") or "")
+            + " " + str(row.get("tail") or "")).lower()
+    for marker in _NONCOMPARABLE:
+        if marker in note:
+            return marker
+    return None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default=ROOT,
+                   help="repo root holding the BENCH_*.json rows")
+    p.add_argument("--strict", action="store_true",
+                   help="unexplained metric regressions fail the "
+                        "guard instead of being flagged")
+    args = p.parse_args(argv)
+
+    paths = sorted(glob.glob(os.path.join(args.root, "BENCH_*.json")))
+    if not paths:
+        print(f"check_bench_trajectory: no BENCH_*.json under "
+              f"{args.root}", file=sys.stderr)
+        return 1
+
+    failures = 0
+    flagged = 0
+    series = {}
+    for path in paths:
+        name = os.path.basename(path)
+        parsed_name = parse_name(name)
+        if parsed_name is None:
+            print(f"FAIL {name}: filename does not match "
+                  "BENCH_[SERIES_]rNN[_variant].json")
+            failures += 1
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                row = json.load(fh)
+        except ValueError as e:
+            print(f"FAIL {name}: unparseable JSON ({e})")
+            failures += 1
+            continue
+        errs = validate_row(row)
+        if errs:
+            failures += 1
+            print(f"FAIL {name}: {len(errs)} schema violation(s)")
+            for e in errs:
+                print(f"  - {e}")
+            continue
+        skey = (parsed_name[0], parsed_name[1])
+        series.setdefault(skey, []).append((parsed_name[2], name, row))
+        print(f"ok   {name}")
+
+    for (sname, variant), rows in sorted(series.items()):
+        rows.sort()
+        label = sname or "main"
+        if variant:
+            label += f"/{variant}"
+        for (prev, cur) in zip(rows, rows[1:]):
+            pm_prev = primary_metric(prev[2])
+            pm_cur = primary_metric(cur[2])
+            if pm_prev is None or pm_cur is None \
+                    or pm_prev[0] != pm_cur[0]:
+                continue
+            _, v_prev, _ = pm_prev
+            metric, v_cur, _ = pm_cur
+            if v_cur >= v_prev:
+                continue
+            reason = noncomparable_reason(cur[2])
+            delta = (v_cur - v_prev) / v_prev * 100.0
+            if reason is not None:
+                print(f"note {cur[1]}: {metric} {v_cur:g} < prior "
+                      f"{prev[1]} {v_prev:g} ({delta:+.1f}%) — "
+                      f"declared non-comparable (\"{reason}\")")
+            else:
+                flagged += 1
+                print(f"FLAG {cur[1]}: {metric} regressed "
+                      f"{v_prev:g} -> {v_cur:g} ({delta:+.1f}%) vs "
+                      f"{prev[1]} with no explaining note")
+
+    total = sum(len(r) for r in series.values())
+    print(f"bench trajectory: {total} row(s) across "
+          f"{len(series)} series; {failures} schema failure(s), "
+          f"{flagged} unexplained regression(s)")
+    if failures:
+        return 1
+    if flagged and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""CI guard for the fleet watchtower (ISSUE 16): the load-replay
+harness at guard scale — 2 workers, ~60 bursty multi-tenant requests,
+one induced swap storm, one SIGKILL — with the metrics plane live the
+whole time. Asserts:
+
+1. every request reaches a terminal state (completed);
+2. at least one alert rule completed a full firing -> resolved
+   lifecycle (schema-validated `alert` records on fleet.jsonl);
+3. the ``<fleet>/metrics.prom`` Prometheus rollup parses and passes
+   exposition validation;
+4. the MONITORED run's results are byte-identical (losses + fault
+   npz + config-id allocation) to the unmonitored dedicated
+   references — the zero-perturbation contract;
+5. sustained steady-state occupancy >= 90% under the bursty schedule.
+
+The harness itself lives in examples/gaussian_failure/load_replay.py
+(loaded by file path — examples/ is not a package); run it directly
+with --bench-out to publish a BENCH_FLEET_LOAD row.
+
+    python scripts/check_fleet_load.py [--bench-out BENCH_FLEET_LOAD_rNN.json]
+
+Exit status: 0 = every contract holds, 1 = any violation.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HARNESS = os.path.join(_REPO, "examples", "gaussian_failure",
+                        "load_replay.py")
+
+
+def _load_harness():
+    spec = importlib.util.spec_from_file_location("_load_replay",
+                                                  _HARNESS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=60,
+                    help="main-phase stream size (>= 10x the fleet "
+                         "guard's 6)")
+    ap.add_argument("--iters", type=int, default=10,
+                    help="iterations per config (guard scale)")
+    ap.add_argument("--bench-out", default=None,
+                    help="also publish a BENCH_FLEET_LOAD row here")
+    args = ap.parse_args()
+
+    lr = _load_harness()
+    workdir = tempfile.mkdtemp(prefix="fleet_load_guard_")
+    summary = lr.run(workdir, n_requests=args.requests,
+                     iters=args.iters, scaler_leg=True)
+
+    # 1. every request terminal (run() raises when the spool stalls;
+    # identity pass below re-checks completed status per request)
+    total = summary["requests_total"]
+    print(f"OK: all {total} requests ({summary['requests_main']} "
+          f"replay + 1 kill + {summary['storm_requests']} storm) "
+          "reached a terminal state")
+
+    # 2. alert lifecycle
+    alerts = summary.get("alerts") or {}
+    cycled = sorted(a for a, v in alerts.items()
+                    if v["firing"] and v["resolved"])
+    if not cycled:
+        return _fail(f"no alert completed firing -> resolved "
+                     f"(saw: {alerts})")
+    if "worker_death" not in alerts or not alerts["worker_death"]["firing"]:
+        return _fail("the SIGKILL never fired `worker_death`")
+    if "swap_storm" not in alerts or not alerts["swap_storm"]["firing"]:
+        return _fail("the induced storm never fired `swap_storm`")
+    print(f"OK: alert lifecycle: {cycled} fired AND resolved "
+          f"(all events: { {k: dict(v) for k, v in alerts.items()} })")
+
+    # 3. the rollup parses and validates
+    if summary["rollup_violations"]:
+        return _fail("rollup exposition violations: "
+                     f"{summary['rollup_violations']}")
+    print(f"OK: {summary['rollup_path']} parses and passes "
+          "exposition validation")
+
+    # 4. byte-identity under monitoring
+    if summary["identity_mismatches"]:
+        for m in summary["identity_mismatches"][:10]:
+            print(f"  - {m}")
+        return _fail(f"{len(summary['identity_mismatches'])} "
+                     "byte-identity mismatch(es): monitoring "
+                     "perturbed the results")
+    print(f"OK: monitored replay byte-identical to the unmonitored "
+          f"dedicated references ({summary['requests_main']} requests"
+          f", {summary['configs_main']} configs: losses + fault npz "
+          "+ config-id allocation)")
+
+    # 5. sustained occupancy
+    if summary["occupancy"] < lr.MIN_OCCUPANCY:
+        return _fail(f"sustained occupancy {summary['occupancy']:.1%}"
+                     f" < {lr.MIN_OCCUPANCY:.0%} over "
+                     f"{summary['occupancy_records']} records")
+    print(f"OK: sustained occupancy {summary['occupancy']:.1%} over "
+          f"{summary['occupancy_records']} steady-state lane_map "
+          f"records (duty {summary['lane_duty_ratio']:.1%} over all "
+          f"{summary['lane_duty_records']}); p50 {summary['p50_s']:g}"
+          f" s / p99 {summary['p99_s']:g} s, SLO burn "
+          f"{summary['slo_burn_rate']:g}")
+
+    # scaler cycle (the bench claim; the leg raises when it stalls)
+    scale = summary.get("scale") or {}
+    if not (scale.get("ups", 0) >= 1 and scale.get("downs", 0) >= 1):
+        return _fail(f"scaler leg completed without a full cycle: "
+                     f"{scale}")
+    print(f"OK: scaler cycle: {scale['ups']} spawn(s) up, "
+          f"{scale['downs']} drain(s) down")
+
+    if args.bench_out:
+        row = lr.bench_row(summary)
+        with open(args.bench_out, "w") as f:
+            json.dump(row, f, indent=2)
+            f.write("\n")
+        print(f"bench row written to {args.bench_out}")
+
+    print("fleet load-replay contract holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -291,6 +291,58 @@ SAMPLE_BAD_SETUP = {
 }
 
 
+# watchtower alert transitions (serve/fleet/alerts.py AlertEngine →
+# schema.py ALERT_FIELDS): one record per firing/resolved edge in
+# fleet.jsonl — steady state emits nothing
+SAMPLE_GOOD_ALERT = {
+    "schema_version": 1, "type": "alert", "iter": 40,
+    "wall_time": 1722700000.0, "alert": "slo_burn", "event": "firing",
+    "metric": "slo_burn_rate", "value": 1.8, "threshold": 1.0,
+    "for_beats": 3, "severity": "page",
+    "reason": "slo_burn_rate > 1.0 for 3 beat(s)",
+}
+
+SAMPLE_BAD_ALERT = {
+    "schema_version": 1, "type": "alert", "iter": 40,
+    "wall_time": 1722700000.0, "alert": "", "event": "wobbling",
+    "metric": "slo_burn_rate", "value": "high",   # empty name, unknown
+    "threshold": 1.0, "for_beats": 0,             # event, non-numeric
+    "severity": "shrug",                          # value, for_beats<1,
+}                                                 # unknown severity
+
+# Prometheus/OpenMetrics text exposition (observe/metrics_registry.py):
+# what the `metrics` socket op and the controller's metrics.prom rollup
+# emit — validated by validate_exposition, not the record schema
+SAMPLE_GOOD_EXPOSITION = """\
+# HELP rram_occupancy_ratio occupied / total lane-iters
+# TYPE rram_occupancy_ratio gauge
+rram_occupancy_ratio 0.9375
+# HELP rram_requests request count by terminal/live status
+# TYPE rram_requests counter
+rram_requests{status="completed"} 12
+rram_requests{status="failed"} 1
+# EOF
+"""
+
+SAMPLE_BAD_EXPOSITION = """\
+rram_requests{status="completed"} 12
+# TYPE rram_requests counter
+rram_requests{status="failed"} -1
+bad name! 3
+"""
+# sample before TYPE, negative counter, bad metric name, missing # EOF
+
+
+def _load_metrics_registry():
+    path = os.path.join(_REPO, "rram_caffe_simulation_tpu", "observe",
+                        "metrics_registry.py")
+    spec = importlib.util.spec_from_file_location("_metrics_registry",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def check_file(path: str, schema) -> list:
     errs = []
     n = 0
@@ -337,7 +389,8 @@ def main(argv=None) -> int:
                           ("span", SAMPLE_GOOD_SPAN),
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
-                          ("setup", SAMPLE_GOOD_SETUP)):
+                          ("setup", SAMPLE_GOOD_SETUP),
+                          ("alert", SAMPLE_GOOD_ALERT)):
             errs = schema.validate_record(rec)
             if errs:
                 print(f"good {name} sample REJECTED by its own schema:")
@@ -355,15 +408,30 @@ def main(argv=None) -> int:
                           ("span", SAMPLE_BAD_SPAN),
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
-                          ("setup", SAMPLE_BAD_SETUP)):
+                          ("setup", SAMPLE_BAD_SETUP),
+                          ("alert", SAMPLE_BAD_ALERT)):
             errs = schema.validate_record(rec)
             if not errs:
                 print(f"known-bad {name} sample PASSED validation "
                       "(schema lost its teeth)")
                 return 1
             n_bad += len(errs)
-        print("sample self-check OK (12 good records accepted, 12 bad "
-              f"records produced {n_bad} violations)")
+        mreg = _load_metrics_registry()
+        expo_errs = mreg.validate_exposition(SAMPLE_GOOD_EXPOSITION)
+        if expo_errs:
+            print("good exposition sample REJECTED:")
+            for e in expo_errs:
+                print(f"  {e}")
+            return 1
+        expo_bad = mreg.validate_exposition(SAMPLE_BAD_EXPOSITION)
+        if not expo_bad:
+            print("known-bad exposition sample PASSED validation "
+                  "(exposition validator lost its teeth)")
+            return 1
+        n_bad += len(expo_bad)
+        print("sample self-check OK (13 good records + 1 exposition "
+              f"accepted, 13 bad records + 1 bad exposition produced "
+              f"{n_bad} violations)")
         return 0
     if not args.files:
         p.error("give at least one JSONL file (or --sample)")

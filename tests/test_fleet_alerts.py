@@ -1,0 +1,259 @@
+"""Fleet watchtower (serve/fleet/alerts.py + observe/metrics_registry):
+the `alert` record type end to end (schema, maker, log line), the
+rule engine's firing/resolved hysteresis (no flapping at the
+threshold), the metrics registry's exposition render/parse/validate
+round-trip, and the stats-view → exposition mapping. No devices, no
+sockets — the live-fleet alert lifecycle is CI-guarded by
+scripts/check_fleet_load.py."""
+import pytest
+
+from rram_caffe_simulation_tpu.observe import (alert_line,
+                                               make_alert_record,
+                                               validate_record)
+from rram_caffe_simulation_tpu.observe.metrics_registry import (
+    MetricsRegistry, fold_record, parse_exposition, registry_from_stats,
+    validate_exposition)
+from rram_caffe_simulation_tpu.serve.fleet import (AlertEngine,
+                                                   AlertRule,
+                                                   default_rules)
+
+
+# ---------------------------------------------------------------------------
+# alert record type: maker -> schema -> log line
+
+
+def test_alert_record_roundtrip():
+    rec = make_alert_record(40, "slo_burn", "firing",
+                            metric="slo_burn_rate", value=1.8,
+                            threshold=1.0, for_beats=3,
+                            severity="page",
+                            reason="slo_burn_rate > 1.0 for 3 beat(s)")
+    assert rec["type"] == "alert"
+    assert validate_record(rec) == []
+    line = alert_line(rec)
+    assert "ALERT" in line and "slo_burn" in line
+
+
+def test_alert_record_resolved_event():
+    rec = make_alert_record(50, "occupancy_floor", "resolved",
+                            metric="occupancy_ratio", value=0.93,
+                            threshold=0.5, severity="warn")
+    assert validate_record(rec) == []
+    assert "RESOLVED" in alert_line(rec)
+
+
+def test_alert_record_bad_event_and_severity_rejected():
+    rec = make_alert_record(40, "slo_burn", "firing", severity="page")
+    rec["event"] = "wobbling"
+    errs = validate_record(rec)
+    assert any("event" in e for e in errs)
+    rec2 = make_alert_record(40, "slo_burn", "firing")
+    rec2["severity"] = "shrug"
+    assert any("severity" in e for e in validate_record(rec2))
+
+
+def test_alert_record_empty_name_rejected():
+    rec = make_alert_record(40, "x", "firing")
+    rec["alert"] = ""
+    assert validate_record(rec)
+
+
+def test_alert_record_for_beats_floor():
+    rec = make_alert_record(40, "x", "firing", for_beats=0)
+    assert any("for_beats" in e for e in validate_record(rec))
+
+
+# ---------------------------------------------------------------------------
+# AlertRule: comparators
+
+
+def _rule(**kw):
+    base = {"name": "r", "metric": "m", "op": ">", "threshold": 1.0,
+            "for_beats": 2, "clear_beats": 2, "severity": "warn"}
+    base.update(kw)
+    return AlertRule.from_dict(base)
+
+
+def test_rule_gt_lt():
+    r = _rule(op=">")
+    assert r.breaches(1.5, None) is True
+    assert r.breaches(1.0, None) is False      # boundary is NOT a breach
+    r2 = _rule(op="<", threshold=0.5)
+    assert r2.breaches(0.2, None) is True
+    assert r2.breaches(0.5, None) is False
+
+
+def test_rule_delta_needs_prior_beat():
+    r = _rule(op="delta>", threshold=0.0)
+    assert r.breaches(5.0, None) is None       # first beat: undecidable
+    assert r.breaches(6.0, 5.0) is True
+    assert r.breaches(6.0, 6.0) is False
+
+
+def test_rule_unknown_op_rejected():
+    with pytest.raises(ValueError):
+        _rule(op="~=")
+
+
+# ---------------------------------------------------------------------------
+# AlertEngine: hysteresis
+
+
+def _engine(for_beats=3, clear_beats=3, **kw):
+    return AlertEngine([AlertRule.from_dict(
+        dict({"name": "burn", "metric": "burn", "op": ">",
+              "threshold": 1.0, "for_beats": for_beats,
+              "clear_beats": clear_beats, "severity": "page"}, **kw))])
+
+
+def test_fires_only_after_for_beats_consecutive():
+    eng = _engine(for_beats=3)
+    assert eng.evaluate({"burn": 2.0}) == []
+    assert eng.evaluate({"burn": 2.0}) == []
+    out = eng.evaluate({"burn": 2.0})
+    assert [t["event"] for t in out] == ["firing"]
+    assert eng.active() == ["burn"]
+    # stays firing silently — transitions only
+    assert eng.evaluate({"burn": 2.0}) == []
+
+
+def test_resolves_only_after_clear_beats_consecutive():
+    eng = _engine(for_beats=1, clear_beats=3)
+    assert [t["event"] for t in eng.evaluate({"burn": 2.0})] == \
+        ["firing"]
+    assert eng.evaluate({"burn": 0.5}) == []
+    assert eng.evaluate({"burn": 0.5}) == []
+    out = eng.evaluate({"burn": 0.5})
+    assert [t["event"] for t in out] == ["resolved"]
+    assert eng.active() == []
+
+
+def test_no_flapping_at_threshold():
+    """Values oscillating across the threshold every beat never
+    accumulate `for_beats` consecutive breaches — the alert must stay
+    silent through the whole oscillation."""
+    eng = _engine(for_beats=3, clear_beats=3)
+    for i in range(20):
+        val = 1.5 if i % 2 == 0 else 0.5
+        assert eng.evaluate({"burn": val}) == []
+    assert eng.active() == []
+
+
+def test_single_clear_beat_resets_firing_counter():
+    eng = _engine(for_beats=3)
+    eng.evaluate({"burn": 2.0})
+    eng.evaluate({"burn": 2.0})
+    eng.evaluate({"burn": 0.5})                # reset
+    eng.evaluate({"burn": 2.0})
+    assert eng.evaluate({"burn": 2.0}) == []   # only 2 consecutive
+    assert [t["event"] for t in eng.evaluate({"burn": 2.0})] == \
+        ["firing"]
+
+
+def test_missing_metric_counts_neither_way():
+    eng = _engine(for_beats=2)
+    eng.evaluate({"burn": 2.0})
+    assert eng.evaluate({}) == []              # gap: no decision
+    # counter was held (not reset): next breach is the 2nd consecutive
+    assert [t["event"] for t in eng.evaluate({"burn": 2.0})] == \
+        ["firing"]
+
+
+def test_when_guard_gates_evaluation():
+    eng = _engine(for_beats=2, when_metric="backlog", when_above=0.0)
+    # guard closed: breach-level values don't count
+    assert eng.evaluate({"burn": 2.0, "backlog": 0.0}) == []
+    assert eng.evaluate({"burn": 2.0, "backlog": 0.0}) == []
+    assert eng.active() == []
+    # guard open: now they do
+    eng.evaluate({"burn": 2.0, "backlog": 5.0})
+    out = eng.evaluate({"burn": 2.0, "backlog": 5.0})
+    assert [t["event"] for t in out] == ["firing"]
+
+
+def test_transition_dict_feeds_record_maker():
+    eng = _engine(for_beats=1)
+    (t,) = eng.evaluate({"burn": 2.0})
+    rec = make_alert_record(7, **t)
+    assert validate_record(rec) == []
+    assert rec["alert"] == "burn" and rec["event"] == "firing"
+
+
+def test_default_rules_cover_issue_slos():
+    names = {r.name for r in AlertEngine(None).rules}
+    assert {"slo_burn", "occupancy_floor", "backlog_growth",
+            "worker_death", "swap_storm",
+            "quarantine_rate"} <= names
+    # re-thresholding hooks take
+    rules = {r.name: r for r in default_rules(occupancy_floor=0.8,
+                                              slo_burn_limit=2.0)}
+    assert rules["occupancy_floor"].threshold == 0.8
+    assert rules["slo_burn"].threshold == 2.0
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: render / parse / validate round-trip
+
+
+def test_registry_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("rram_requests", 3, status="completed")
+    reg.set("rram_occupancy_ratio", 0.9375)
+    reg.observe("rram_swap_seconds", 0.18, buckets=(0.1, 0.25, 1.0))
+    text = reg.render()
+    assert validate_exposition(text) == []
+    samples = parse_exposition(text)
+    assert samples[("rram_requests",
+                    (("status", "completed"),))] == 3.0
+    assert samples[("rram_occupancy_ratio", ())] == 0.9375
+    # histogram renders cumulative buckets + sum + count
+    assert samples[("rram_swap_seconds_bucket",
+                    (("le", "0.25"),))] == 1.0
+    assert samples[("rram_swap_seconds_bucket",
+                    (("le", "+Inf"),))] == 1.0
+    assert samples[("rram_swap_seconds_count", ())] == 1.0
+
+
+def test_counter_rejects_negative_increment():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.inc("rram_requests", -1, status="failed")
+
+
+def test_validate_exposition_catches_violations():
+    bad = ('rram_requests{status="completed"} 12\n'
+           "# TYPE rram_requests counter\n"
+           "bad name! 3\n")
+    errs = validate_exposition(bad)
+    assert errs
+    assert any("EOF" in e for e in errs)
+
+
+def test_registry_from_stats_maps_service_view():
+    view = {"lanes": 4, "occupied_lanes": 3, "pending_configs": 2,
+            "steps_per_sec": 80.0, "projected_s": 1.5,
+            "slo_seconds": 60.0, "iter": 120,
+            "requests": {"completed": 5, "running": 1},
+            "tenant_lane_iters": {"alice": 400},
+            "occupancy": {"beats": 100, "occupancy": 0.9,
+                          "occupied_lane_iters": 360,
+                          "total_lane_iters": 400},
+            "slo": {"_total": {"burn_rate": 0.4, "violation_rate": 0.0,
+                               "projection_bias": 1.01,
+                               "mean_latency_s": 12.0, "requests": 5}}}
+    text = registry_from_stats(view).render()
+    assert validate_exposition(text) == []
+    samples = parse_exposition(text)
+    assert samples[("rram_lanes", ())] == 4.0
+    assert samples[("rram_occupancy_ratio", ())] == 0.9
+    assert samples[("rram_requests", (("status", "completed"),))] == 5.0
+    assert samples[("rram_slo_burn_rate",
+                    (("tenant", "_total"),))] == 0.4
+
+
+def test_fold_record_alert_sets_firing_gauge():
+    reg = MetricsRegistry()
+    fold_record(reg, make_alert_record(10, "slo_burn", "firing"))
+    assert reg.get("rram_alert_firing", alert="slo_burn") == 1.0
+    fold_record(reg, make_alert_record(20, "slo_burn", "resolved"))
+    assert reg.get("rram_alert_firing", alert="slo_burn") == 0.0
