@@ -232,6 +232,11 @@ class SetupStats:
         # explicit params this run trains under (FaultSpec.to_model —
         # {"spec": canonical, "processes": {...}})
         self.fault_model = None
+        # tiled-mapping coverage (ISSUE 17): fault-target layers a
+        # non-default tile spec did NOT cover (conv layers bypass the
+        # crossbar tiling; Solver.tiles_bypassed) — None/[] = full
+        # coverage
+        self.tiles_bypassed = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -267,7 +272,8 @@ class SetupStats:
             fault_state_format=self.fault_format,
             config_shards=self.config_shards,
             fault_model=self.fault_model,
-            engine_fallback_reason=self.engine_fallback_reason)
+            engine_fallback_reason=self.engine_fallback_reason,
+            tiles_bypassed=self.tiles_bypassed)
 
 
 class _Timed:

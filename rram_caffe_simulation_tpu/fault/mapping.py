@@ -279,7 +279,122 @@ def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# per-tile wear census (the observe `health` record's sensor core)
+
+def health_tiles(shape, tiles) -> Tuple[Tuple[int, int], list, List[int]]:
+    """Tile enumeration for the wear census over one STORED param
+    shape: 2-D shapes follow the TileSpec grid (None / default = one
+    tile); non-2-D fault targets (biases, conv kernels under
+    `conv_also`) are a single tile by definition. Host-side geometry —
+    returns ((gr, gc), [slice tuple or None per tile], [cells per
+    tile]) so the jitted census program never has to return static
+    values."""
+    if len(shape) == 2 and tiles is not None and not tiles.is_default:
+        grid = tiles.grid(shape)
+        sls = [sl for _, sl in tiles.tile_slices(shape)]
+        cells = [(r1 - r0) * (c1 - c0) for r0, r1, c0, c1 in sls]
+        return grid, sls, cells
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return (1, 1), [None], [n]
+
+
+def _tile_views(arrs, sl, param_ndim):
+    """One tile's view of each array (ellipsis slicing, so leading
+    config axes ride through untouched)."""
+    if sl is None:
+        return arrs
+    r0, r1, c0, c1 = sl
+    if param_ndim == 2:
+        return tuple(a[..., r0:r1, c0:c1] for a in arrs)
+    return arrs
+
+
+def log_histogram(x, edges, axes):
+    """Histogram counts of `x` over the fixed bin layout every health
+    census shares: bin 0 = (-inf, 0] (broken / just-written), bin i =
+    (edges[i-1], edges[i]] with an implicit leading edge of 0, last
+    bin = beyond the top edge — len(edges) + 2 bins total, stacked on
+    a new trailing axis. Pure comparisons + integer sums, so a NumPy
+    reimplementation is bit-exact."""
+    import jax.numpy as jnp
+    thresholds = [0.0] + [float(e) for e in edges]
+    idx = sum((x > t).astype(jnp.int32) for t in thresholds)
+    return jnp.stack(
+        [jnp.sum((idx == b).astype(jnp.int32), axis=axes)
+         for b in range(len(thresholds) + 1)], axis=-1)
+
+
+def per_tile_health(life, stuck, tiles, edges, param_ndim) -> dict:
+    """Traced per-tile wear census for ONE lifetime-bearing fault leaf
+    (observe/health.py drives it every `health_every` iterations —
+    this never runs inside the train step): remaining-lifetime
+    histogram over the fixed log-spaced `edges` (log_histogram bin
+    layout; bin 0 = broken), broken-cell fraction, mean remaining
+    lifetime, and the stuck-value composition of the broken cells.
+
+    `param_ndim` is the STORED param rank (2 = a crossbar matrix
+    following the tile grid; anything else = one tile); leading config
+    axes pass through, so the sweep's config-stacked leaves yield
+    per-config vectors. Returns {"life_hist": i32[..., T, B],
+    "broken_frac"/"life_mean": f32[..., T], "stuck_neg"/"stuck_zero"/
+    "stuck_pos": i32[..., T]} in tile-major order, B = len(edges)+2;
+    geometry (grid, cells) comes from `health_tiles` host-side."""
+    import jax.numpy as jnp
+    shape = life.shape[life.ndim - param_ndim:]
+    _, sls, _ = health_tiles(shape, tiles if param_ndim == 2 else None)
+    axes = (-2, -1) if param_ndim == 2 else (-1,)
+    hist, bfrac, lmean = [], [], []
+    s_neg, s_zero, s_pos = [], [], []
+    for sl in sls:
+        lt, st = _tile_views((life, stuck), sl, param_ndim)
+        broken = lt <= 0
+        hist.append(log_histogram(lt, edges, axes))
+        bfrac.append(jnp.mean(broken.astype(jnp.float32), axis=axes))
+        lmean.append(jnp.mean(lt, axis=axes).astype(jnp.float32))
+        s_neg.append(jnp.sum((broken & (st == -1.0)).astype(jnp.int32),
+                             axis=axes))
+        s_zero.append(jnp.sum((broken & (st == 0.0)).astype(jnp.int32),
+                              axis=axes))
+        s_pos.append(jnp.sum((broken & (st == 1.0)).astype(jnp.int32),
+                             axis=axes))
+    return {
+        "life_hist": jnp.stack(hist, axis=-2),
+        "broken_frac": jnp.stack(bfrac, axis=-1),
+        "life_mean": jnp.stack(lmean, axis=-1),
+        "stuck_neg": jnp.stack(s_neg, axis=-1),
+        "stuck_zero": jnp.stack(s_zero, axis=-1),
+        "stuck_pos": jnp.stack(s_pos, axis=-1),
+    }
+
+
+def per_tile_ages(age, tiles, edges, param_ndim) -> dict:
+    """Traced per-tile drift-age distribution for ONE `drift_age` leaf
+    (conductance_drift's health contribution): age histogram over the
+    fixed log-spaced `edges` (bin 0 = age <= 0, written this step /
+    never drifted), mean and max age per tile. Same tile-major layout
+    and leading-axis pass-through as `per_tile_health`."""
+    import jax.numpy as jnp
+    shape = age.shape[age.ndim - param_ndim:]
+    _, sls, _ = health_tiles(shape, tiles if param_ndim == 2 else None)
+    axes = (-2, -1) if param_ndim == 2 else (-1,)
+    hist, amean, amax = [], [], []
+    for sl in sls:
+        (at,) = _tile_views((age,), sl, param_ndim)
+        hist.append(log_histogram(at, edges, axes))
+        amean.append(jnp.mean(at, axis=axes).astype(jnp.float32))
+        amax.append(jnp.max(at, axis=axes).astype(jnp.float32))
+    return {
+        "age_hist": jnp.stack(hist, axis=-2),
+        "age_mean": jnp.stack(amean, axis=-1),
+        "age_max": jnp.stack(amax, axis=-1),
+    }
+
+
 __all__ = [
     "TileSpec", "DEFAULT_TILES", "MAX_TILES_PER_LAYER", "canonical",
-    "split_bounds", "tiled_draw", "per_tile_counters",
+    "split_bounds", "tiled_draw", "per_tile_counters", "health_tiles",
+    "log_histogram", "per_tile_health", "per_tile_ages",
 ]

@@ -263,6 +263,22 @@ class ProcessStack:
                 out[p.process_name] = c
         return out
 
+    def health(self, state, life_view, stuck_view, edges,
+               ndims) -> dict:
+        """The stack's merged per-(param, tile) wear census
+        (observe/health.py): each process contributes its stats under
+        the shared param keys — the clamp family the lifetime/stuck
+        histograms, conductance_drift its age distribution. Stat names
+        are disjoint by construction (at most one clamp process), so
+        the merge is a plain dict update per param."""
+        out: dict = {}
+        for p in self.processes:
+            h = p.health(state, life_view, stuck_view, self.tiles,
+                         edges, ndims)
+            for name, stats in h.items():
+                out.setdefault(name, {}).update(stats)
+        return out
+
     def __repr__(self):
         return f"<ProcessStack {self.canonical()!r}>"
 

@@ -145,6 +145,28 @@ class FaultProcess:
                       for v in life_view.values()), jnp.int32(0))
         return {"broken": broken}
 
+    def health(self, state: dict, life_view: Dict[str, jax.Array],
+               stuck_view: Dict[str, jax.Array], tiles, edges: dict,
+               ndims: Dict[str, int]) -> dict:
+        """This process's per-(param, tile) contribution to the wear
+        census (observe/health.py; traced in a SEPARATE small program
+        every `health_every` iterations, never inside the train step).
+        Returns {param: {stat: array}}; stats merge disjointly across
+        the stack. `edges` holds the fixed log-spaced bin layouts
+        ({"life": ..., "age": ...}), `ndims` the STORED rank of each
+        fault target (leading config axes excluded). The default is
+        the clamp family's lifetime/stuck census — the one definition
+        endurance_stuck_at, read_disturb, and permanent_fault_map
+        share; lifetime-less processes contribute nothing unless they
+        override (conductance_drift reports its age distribution)."""
+        if not self.has_lifetimes:
+            return {}
+        from .. import mapping as fault_mapping
+        return {name: fault_mapping.per_tile_health(
+                    life_view[name], stuck_view[name], tiles,
+                    edges["life"], ndims[name])
+                for name in sorted(life_view)}
+
     # --- packing -------------------------------------------------------
     def write_quantum(self, decrement: float) -> float:
         """The lifetime quantum the packed counter banks divide by

@@ -122,3 +122,15 @@ class ConductanceDrift(FaultProcess):
             n += v.size
         return {"drifted": drifted,
                 "age_mean": age_sum / max(n, 1)}
+
+    def health(self, state, life_view, stuck_view, tiles, edges,
+               ndims):
+        # the age-distribution census the counters() scalar always
+        # collapsed: per (param, tile), how long each cell has drifted
+        # unwritten — the retention-loss exposure map the aging
+        # campaigns read
+        from .. import mapping as fault_mapping
+        return {name: fault_mapping.per_tile_ages(
+                    state["drift_age"][name], tiles, edges["age"],
+                    ndims[name])
+                for name in sorted(state["drift_age"])}

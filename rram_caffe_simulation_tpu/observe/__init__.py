@@ -34,20 +34,33 @@ observable in three layers:
    counter/gauge/histogram registry fed from the record streams above
    (or a live `SweepService.stats()` view), rendered as
    Prometheus/OpenMetrics text for the `metrics` socket op, the fleet
-   controller's `fleet/metrics.prom` rollup, and `caffe fleet top`.
+   controller's `fleet/metrics.prom` rollup, and `caffe fleet top`;
+7. crossbar health plane (health.py): the per-(param, tile) wear
+   census — `CensusProgram`, a separate small jitted program over the
+   resident fault state run every `health_every` iterations (the train
+   step is untouched, so arming it perturbs nothing), emitting
+   schema-validated `health` records (lifetime-remaining and drift-age
+   histograms on fixed log-spaced bins, stuck-value composition) — and
+   `HealthLedger`, the host-side wear-rate trender and
+   remaining-useful-life forecaster behind `summarize --health`, the
+   service `stats()["health"]` view, and the fleet `rram_health_*`
+   gauges + `wear_cliff` alert rule.
 """
 from .counters import global_norm_sq, mean_abs, to_host, write_traffic_saved
 from .debug import OVERFLOW_LIMIT, PHASES, NetDebugSpec, sentinel_tree
+from .health import (AGE_EDGES, LIFE_EDGES, RUL_THRESHOLD,
+                     CensusProgram, HealthLedger)
 from .schema import SCHEMA_VERSION, validate_record
 from .metrics_registry import (MetricsRegistry, fold_record,
                                parse_exposition, registry_from_stats,
                                registry_from_streams, validate_exposition)
 from .sink import (CaffeLogSink, JsonlSink, MetricsLogger, alert_line,
-                   debug_trace_lines, fault_redraw_line,
+                   debug_trace_lines, fault_redraw_line, health_line,
                    make_alert_record, make_fault_redraw_record,
-                   make_record, make_request_record, make_retry_record,
-                   make_setup_record, make_worker_record, request_line,
-                   retry_line, sentinel_line, setup_line, worker_line)
+                   make_health_record, make_record, make_request_record,
+                   make_retry_record, make_setup_record,
+                   make_worker_record, request_line, retry_line,
+                   sentinel_line, setup_line, worker_line)
 from .spans import (OccupancyAggregator, SloAccountant, SpanTracer,
                     latency_percentiles, make_span_record,
                     merge_chrome_traces, phase_breakdown, span_line)
@@ -61,6 +74,9 @@ __all__ = [
     "make_fault_redraw_record", "fault_redraw_line",
     "make_worker_record", "worker_line",
     "make_alert_record", "alert_line",
+    "make_health_record", "health_line",
+    "CensusProgram", "HealthLedger", "LIFE_EDGES", "AGE_EDGES",
+    "RUL_THRESHOLD",
     "MetricsRegistry", "registry_from_stats", "registry_from_streams",
     "fold_record", "parse_exposition", "validate_exposition",
     "debug_trace_lines", "sentinel_line",

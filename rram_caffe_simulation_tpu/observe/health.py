@@ -1,0 +1,329 @@
+"""Crossbar health plane: on-device wear census + host-side wear ledger.
+
+The observe stack so far watches the FLEET (spans, metrics plane,
+alerts) but is blind to the devices it schedules: nothing tracks how
+worn each crossbar tile is, how fast drift is aging cells, or when a
+config's accuracy will fall off a cliff. This module is that sensor
+layer (ROADMAP items 1 and 4 read it — the aging campaigns and the
+co-design search both need wear-resolved telemetry):
+
+1. **Census** (`CensusProgram`): a compact device-health snapshot
+   computed by a SEPARATE small jitted program over the resident fault
+   state — per-(param, tile) remaining-lifetime histograms over fixed
+   log-spaced bins, broken fraction, mean lifetime, stuck-value
+   composition (fault/mapping.py per_tile_health), and the drift-age
+   distribution (per_tile_ages via each FaultProcess's `health` hook).
+   Invoked host-side every `health_every` iterations, so steady-state
+   cost is ~zero and — critically — the TRAIN STEP program is
+   untouched: arming the census perturbs nothing (losses and fault npz
+   stay byte-identical; `health_every=0` never builds the program at
+   all). Under the sweep's config-stacked state every stat gains a
+   leading per-config axis and the record carries `lane_map`, so
+   censuses stay attributable across self-healing refills.
+
+2. **Ledger** (`HealthLedger`): a host-side, dependency-free (no
+   jax/numpy — summarize and the fleet tooling ingest plain record
+   dicts) wear ledger integrating censuses over time into
+   per-(config, param, tile) wear-rate trends, a write-traffic
+   estimate (the life_mean drop between censuses divided by the write
+   quantum — no cross-step device state needed, so checkpoint/restore
+   and lane refills cost nothing), and a remaining-useful-life
+   forecast: projected iterations until a tile's broken fraction
+   crosses `threshold`. Two methods: "trend" (>= 2 censuses — linear
+   extrapolation of the broken-fraction trend, exact on a linear wear
+   cliff) and "bin" (a single census — the nearest lifetime-histogram
+   bin edge divided by the write quantum, a one-write-per-iteration
+   worst case).
+
+Rendered three ways: `summarize --health` (worst-tile heatmap table +
+RUL per config), `caffe fleet top` (WEAR column), and the fleet rollup
+(`rram_health_*` gauges via registry_from_stats / fold_record) so the
+alert engine's `wear_cliff` rule can fire before accuracy collapses.
+CI: scripts/check_health_telemetry.py pins the zero-perturbation
+contract, the NumPy-oracle census for all four fault processes, the
+planted-cliff RUL, and the fleet gauge + alert lifecycle.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+#: remaining-lifetime bin edges (cell writes remaining). Fixed —
+#: ledger trends difference histograms ACROSS censuses, which only
+#: works when every census shares one bin layout. Bin 0 = (-inf, 0]
+#: (broken), bin i = (edges[i-1], edges[i]], last bin = beyond 1e8
+#: (the reference's mean-lifetime operating point).
+LIFE_EDGES = (1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8)
+
+#: drift-age bin edges (iterations since last write). Bin 0 = age <= 0
+#: (written this step / never drifted).
+AGE_EDGES = (1e1, 1e2, 1e3, 1e4, 1e5)
+
+#: default broken-fraction threshold the RUL forecast projects to —
+#: past ~30% dead cells per tile the remap strategies run out of spare
+#: rows and accuracy falls off the cliff the alert is named after
+RUL_THRESHOLD = 0.3
+
+#: per-(config, param, tile) census samples the ledger retains for the
+#: wear-rate trend fit (old samples age out; the trend is local)
+LEDGER_HISTORY = 64
+
+
+class CensusProgram:
+    """The jitted wear-census program over one fault-state structure.
+
+    Built once per arming (`stack` is the ProcessStack, `stacked`
+    whether leaves carry a leading config axis, `pack_spec` the
+    fault/packed.py spec when the state is bank-packed) and reused
+    every census tick — jax caches the compiled program by leaf
+    shapes, so a self-healing refill (same shapes) recompiles nothing.
+    Calling it fetches the stats to host and merges the host-side tile
+    geometry; the result is the `params` payload of a `health` record
+    (sink.make_health_record)."""
+
+    def __init__(self, stack, stacked: bool = False, pack_spec=None):
+        self.stack = stack
+        self.stacked = bool(stacked)
+        self.pack_spec = pack_spec
+        self._fn = None
+
+    def _build(self):
+        import jax
+        stack, pack_spec = self.stack, self.pack_spec
+        lead = 1 if self.stacked else 0
+        edges = {"life": LIFE_EDGES, "age": AGE_EDGES}
+
+        def census(state):
+            if "life_q" in state:
+                from ..fault import packed as fault_packed
+                state = fault_packed.unpacked_view(state, pack_spec)
+            ndims = {}
+            for group in state.values():
+                for k, v in group.items():
+                    ndims.setdefault(k, getattr(v, "ndim", 0) - lead)
+            return stack.health(state, state.get("lifetimes", {}),
+                                state.get("stuck", {}), edges, ndims)
+
+        return jax.jit(census)
+
+    def __call__(self, state) -> dict:
+        """Census the state and return the host-side `params` payload:
+        {param: {"grid": [gr, gc], "cells": [...], stat: nested
+        lists}}. The jit keeps the reductions collective-safe under a
+        config-sharded mesh (every process calls at the same point;
+        only process 0 writes the record)."""
+        import jax
+        import numpy as np
+        if self._fn is None:
+            self._fn = self._build()
+        stats = jax.device_get(self._fn(state))
+        lead = 1 if self.stacked else 0
+        shapes = {}
+        for group, leaves in state.items():
+            if not isinstance(leaves, dict):
+                continue
+            for k, v in leaves.items():
+                shp = tuple(getattr(v, "shape", ()))
+                if group == "stuck_bits":
+                    continue   # packed 4-cells-per-byte; life_q covers
+                shapes.setdefault(k, shp[lead:])
+        from ..fault import mapping as fault_mapping
+        out = {}
+        for name, st in stats.items():
+            grid, _, cells = fault_mapping.health_tiles(
+                shapes.get(name, ()), self.stack.tiles)
+            entry = {"grid": [int(grid[0]), int(grid[1])],
+                     "cells": [int(c) for c in cells]}
+            for key, v in st.items():
+                entry[key] = np.asarray(v).tolist()
+            out[name] = entry
+        return out
+
+
+def _slope(samples: List[Tuple[int, float]]) -> float:
+    """Least-squares slope of (iter, value) samples — the wear-rate
+    trend (d value / d iter). 0.0 when degenerate."""
+    n = len(samples)
+    if n < 2:
+        return 0.0
+    mx = sum(s[0] for s in samples) / n
+    my = sum(s[1] for s in samples) / n
+    den = sum((s[0] - mx) ** 2 for s in samples)
+    if den <= 0:
+        return 0.0
+    return sum((s[0] - mx) * (s[1] - my) for s in samples) / den
+
+
+class HealthLedger:
+    """Host-side wear ledger over a stream of `health` records (module
+    docstring item 2). Keys are (config, param, tile) — config -1 for
+    a single (non-sweep) run; under a sweep `lane_map` attributes each
+    lane's column to its config id, so a refilled lane starts a fresh
+    series for the NEW config instead of corrupting the old one's
+    trend."""
+
+    def __init__(self, threshold: float = RUL_THRESHOLD,
+                 history: int = LEDGER_HISTORY):
+        self.threshold = float(threshold)
+        self.history = max(int(history), 2)
+        #: (config, param, tile) -> [(iter, broken_frac, life_mean)]
+        self._series: Dict[tuple, list] = {}
+        #: (config, param, tile) -> {"cells", "grid", "life_hist"}
+        self._meta: Dict[tuple, dict] = {}
+        self._decrement = 1.0
+        self._life_edges: tuple = tuple(LIFE_EDGES)
+        self._censuses = 0
+
+    # --- ingest --------------------------------------------------------
+    def update(self, rec: dict):
+        """Ingest one `health` record (other record types are
+        ignored, so callers can feed a whole metrics stream)."""
+        if not isinstance(rec, dict) or rec.get("type") != "health":
+            return
+        it = int(rec.get("iter", 0))
+        dec = rec.get("decrement")
+        if isinstance(dec, (int, float)) and dec > 0:
+            self._decrement = float(dec)
+        edges = rec.get("life_edges")
+        if isinstance(edges, list) and edges:
+            self._life_edges = tuple(float(e) for e in edges)
+        lane_map = rec.get("lane_map")
+        self._censuses += 1
+        for pname, st in (rec.get("params") or {}).items():
+            if not isinstance(st, dict):
+                continue
+            bf, lm = st.get("broken_frac"), st.get("life_mean")
+            if not isinstance(bf, list):
+                continue
+            hist = st.get("life_hist")
+            cells = st.get("cells")
+            grid = st.get("grid")
+            if lane_map is None:
+                self._ingest(-1, pname, it, bf, lm, hist, cells, grid)
+                continue
+            for lane, cfg in enumerate(lane_map):
+                if cfg < 0 or lane >= len(bf):
+                    continue
+                self._ingest(int(cfg), pname, it, bf[lane],
+                             lm[lane] if isinstance(lm, list) else None,
+                             hist[lane] if isinstance(hist, list)
+                             else None, cells, grid)
+
+    def _ingest(self, cfg, pname, it, bf, lm, hist, cells, grid):
+        if not isinstance(bf, list):
+            return
+        for t, frac in enumerate(bf):
+            key = (cfg, pname, t)
+            series = self._series.setdefault(key, [])
+            # a checkpoint-resumed stream may replay the census at the
+            # restore iteration — identical sample, keep one
+            if series and series[-1][0] == it:
+                series[-1] = (it, float(frac),
+                              float(lm[t]) if isinstance(lm, list)
+                              else None)
+            else:
+                series.append((it, float(frac),
+                               float(lm[t]) if isinstance(lm, list)
+                               else None))
+            del series[:-self.history]
+            meta = self._meta.setdefault(key, {})
+            if isinstance(cells, list) and t < len(cells):
+                meta["cells"] = int(cells[t])
+            if isinstance(grid, list):
+                meta["grid"] = list(grid)
+            if isinstance(hist, list) and t < len(hist):
+                meta["life_hist"] = list(hist[t])
+
+    # --- forecasts -----------------------------------------------------
+    def forecast(self, threshold: Optional[float] = None) -> list:
+        """Per-(config, param, tile) wear rows, worst first: broken
+        fraction now, wear rate (d broken_frac / d iter), estimated
+        write traffic (writes/cell/iter from the life_mean trend), and
+        the remaining-useful-life projection `rul_iters` — iterations
+        until broken_frac crosses the threshold ("trend" method), or
+        the nearest-histogram-bin worst case from a single census
+        ("bin"). rul_iters is None when the tile shows no wear at
+        all."""
+        th = self.threshold if threshold is None else float(threshold)
+        rows = []
+        for key in sorted(self._series):
+            cfg, pname, tile = key
+            series = self._series[key]
+            it, bf, lm = series[-1]
+            rate = _slope([(s[0], s[1]) for s in series])
+            lm_rate = _slope([(s[0], s[2]) for s in series
+                              if s[2] is not None])
+            write_rate = (-lm_rate / self._decrement
+                          if lm_rate < 0 else 0.0)
+            rul = method = None
+            if bf >= th:
+                rul, method = 0.0, "trend"
+            elif len(series) >= 2:
+                if rate > 0:
+                    rul, method = (th - bf) / rate, "trend"
+            else:
+                rul = self._bin_rul(key, th)
+                if rul is not None:
+                    method = "bin"
+            rows.append({
+                "config": cfg, "param": pname, "tile": tile,
+                "iter": it, "broken_frac": bf,
+                "wear_rate": rate, "write_rate": write_rate,
+                "rul_iters": rul, "method": method,
+            })
+        rows.sort(key=lambda r: (r["rul_iters"]
+                                 if r["rul_iters"] is not None
+                                 else float("inf"), -r["broken_frac"]))
+        return rows
+
+    def _bin_rul(self, key, th) -> Optional[float]:
+        """Single-census nearest-bin forecast: the smallest histogram
+        edge below which at least `th` of the tile's cells sit — those
+        cells die within edge/decrement iterations at one write
+        quantum per iteration."""
+        meta = self._meta.get(key, {})
+        hist = meta.get("life_hist")
+        cells = meta.get("cells")
+        if not hist or not cells:
+            return None
+        cum = 0
+        for b, count in enumerate(hist):
+            cum += count
+            if cum / max(cells, 1) > th:
+                if b == 0:
+                    return 0.0
+                edge = self._life_edges[min(b - 1,
+                                            len(self._life_edges) - 1)]
+                return edge / self._decrement
+        return None
+
+    # --- rollup views --------------------------------------------------
+    def summary(self) -> Optional[dict]:
+        """The fleet-scrape view (SweepService.stats()["health"] /
+        the worker heartbeat row): census count, worst broken
+        fraction, fastest wear rate, and the minimum RUL across every
+        (config, param, tile). None until the first census lands."""
+        rows = self.forecast()
+        if not rows:
+            return None
+        ruls = [r["rul_iters"] for r in rows
+                if r["rul_iters"] is not None]
+        return {
+            "censuses": self._censuses,
+            "configs": len({r["config"] for r in rows}),
+            "tiles": len(rows),
+            "broken_frac_max": round(
+                max(r["broken_frac"] for r in rows), 6),
+            "wear_rate_max": round(
+                max(r["wear_rate"] for r in rows), 10),
+            "rul_iters_min": (round(min(ruls), 2) if ruls else None),
+        }
+
+    def worst_tiles(self, n: int = 8) -> list:
+        """The n worst forecast rows (summarize's heatmap table)."""
+        return self.forecast()[:max(int(n), 0)]
+
+
+__all__ = [
+    "LIFE_EDGES", "AGE_EDGES", "RUL_THRESHOLD", "LEDGER_HISTORY",
+    "CensusProgram", "HealthLedger",
+]

@@ -263,7 +263,31 @@ def registry_from_stats(view, registry=None):
         reg.set("rram_request_turnaround_seconds_mean",
                 row.get("mean_latency_s") or 0.0,
                 help="mean request turnaround", tenant=tenant)
+
+    _fold_health_summary(reg, view.get("health"))
     return reg
+
+
+def _fold_health_summary(reg, health):
+    """Export a HealthLedger.summary() dict as rram_health_* gauges."""
+    if not isinstance(health, dict):
+        return
+    reg.set("rram_health_censuses", health.get("censuses") or 0,
+            help="wear censuses ingested by the health ledger")
+    reg.set("rram_health_tiles", health.get("tiles") or 0,
+            help="(config, param, tile) wear series tracked")
+    reg.set("rram_health_configs", health.get("configs") or 0,
+            help="configs with wear telemetry")
+    if health.get("broken_frac_max") is not None:
+        reg.set("rram_health_broken_frac_max",
+                health["broken_frac_max"],
+                help="worst per-tile broken-cell fraction")
+    if health.get("wear_rate_max") is not None:
+        reg.set("rram_health_wear_rate_max", health["wear_rate_max"],
+                help="fastest per-tile wear rate (broken frac / iter)")
+    if health.get("rul_iters_min") is not None:
+        reg.set("rram_health_rul_iters_min", health["rul_iters_min"],
+                help="minimum remaining-useful-life forecast (iters)")
 
 
 # ---------------------------------------------------------------------------
@@ -318,7 +342,36 @@ def fold_record(reg, rec):
         state = 1.0 if rec.get("event") == "firing" else 0.0
         reg.set("rram_alert_firing", state, help="1 while the rule fires",
                 alert=str(rec.get("alert") or ""))
+    elif rtype == "health":
+        # offline rebuild of the wear gauges: fold each census's worst
+        # tile (the ledger does trend/RUL; the registry keeps the
+        # instantaneous worst-of-latest-census signal)
+        reg.inc("rram_health_censuses", 1,
+                help="wear censuses folded from the record stream")
+        worst = 0.0
+        tiles = 0
+        for st in (rec.get("params") or {}).values():
+            if not isinstance(st, dict):
+                continue
+            tiles += len(st.get("cells") or [])
+            worst = max([worst] + _flat_numbers(st.get("broken_frac")))
+        reg.set("rram_health_broken_frac_max", worst,
+                help="worst per-tile broken-cell fraction")
+        if tiles:
+            reg.set("rram_health_tiles", tiles,
+                    help="(param, tile) cells censused per record")
     return reg
+
+
+def _flat_numbers(val):
+    if isinstance(val, (int, float)) and not isinstance(val, bool):
+        return [float(val)]
+    if isinstance(val, list):
+        out = []
+        for v in val:
+            out.extend(_flat_numbers(v))
+        return out
+    return []
 
 
 def registry_from_streams(paths, registry=None):

@@ -248,6 +248,13 @@ SETUP_FIELDS = {
     "config_shards": (int, False),
     "fault_model": (dict, False),
     "engine_fallback_reason": (str, False),
+    # the tiles-bypass loud-warning trail (same contract as
+    # engine_fallback_reason): the layer names a non-default tile
+    # spec did NOT cover — convolution layers bypass the crossbar
+    # tile mapping today — so a tiled log can never silently claim
+    # conv weights sat on tiled crossbars. Non-empty list of layer
+    # names; omitted entirely when every fault target is tiled.
+    "tiles_bypassed": (str, False),
 }
 
 # `fault_model` (optional, fault-engine runs) names the fault-process
@@ -462,7 +469,14 @@ ALERT_FIELDS = {
 #     {"schema_version": 1, "type": "fault_redraw", "iter": 4000,
 #      "wall_time": 1722700000.1,
 #      "snapshot": "/runs/q_iter_4000.faultstate",
-#      "reason": "snapshot predates fault-state capture"}
+#      "reason": "snapshot predates fault-state capture (active fault "
+#                "process: endurance_stuck_at)",
+#      "tiles": "2x2"}
+#
+# `tiles` (optional) is the active canonical tile-mapping spec: a
+# redraw under a non-default grid re-rolls per-(param, tile)
+# INDEPENDENT draws — a different experiment from an untiled redraw —
+# so the trail names the grid alongside the process stack.
 
 FAULT_REDRAW_FIELDS = {
     "schema_version": (int, True),
@@ -471,6 +485,64 @@ FAULT_REDRAW_FIELDS = {
     "wall_time": (_NUM, True),
     "snapshot": (str, True),    # the .faultstate path that was missing
     "reason": (str, True),
+    "tiles": (str, False),      # active canonical tile spec
+}
+
+# --- health records (crossbar wear census, observe/health.py) ---
+#
+# One per `health_every` iterations while the wear telemetry is armed
+# (Solver.enable_health / SweepRunner(health_every=)): the per-(param,
+# tile) device-health census a SEPARATE small jitted program computes
+# over the resident fault state — the train step is untouched, so an
+# armed run stays byte-identical on losses and fault state
+# (CI-guarded). `params` maps each fault-target key to its per-tile
+# stats in tile-major order: `life_hist` counts cells per fixed
+# log-spaced remaining-lifetime bin (`life_edges`; bin 0 = (-inf, 0]
+# = broken, last bin = beyond the top edge), `broken_frac`/`life_mean`
+# /`stuck_neg|zero|pos` the clamp family's wear composition, and
+# `age_hist`/`age_mean`/`age_max` (over `age_edges`) the drift-age
+# distribution when conductance_drift is in the stack. Under a sweep
+# every stat gains a leading per-config axis and `lane_map` attributes
+# each column to its config id (same contract as the metrics record),
+# so censuses survive self-healing refills. `every` is the census
+# cadence, `decrement` the stack's write quantum (what the ledger
+# divides lifetime by to get iterations), `process` the canonical
+# stack spec, `tiles` the canonical tile-mapping spec::
+#
+#     {"schema_version": 1, "type": "health", "iter": 400,
+#      "wall_time": 1722700000.1, "every": 200, "decrement": 100.0,
+#      "process": "endurance_stuck_at", "tiles": "2x2",
+#      "life_edges": [100.0, 1000.0, ...], "age_edges": [10.0, ...],
+#      "params": {"fc1/0": {"grid": [2, 2], "cells": [64, 64, 64, 64],
+#                 "life_hist": [[3, 0, 1, 60, 0, 0, 0, 0, 0], ...],
+#                 "broken_frac": [0.05, 0.0, 0.0, 0.0],
+#                 "life_mean": [812.5, 900.0, 912.0, 904.1],
+#                 "stuck_neg": [1, 0, 0, 0], "stuck_zero": [2, 0, 0, 0],
+#                 "stuck_pos": [0, 0, 0, 0]}}}
+
+#: per-param census stats and their nesting depth floor/ceiling:
+#: vectors are [T] (single run) or [C][T] (sweep); histograms [T][B]
+#: or [C][T][B]. `grid`/`cells` are host geometry — never config-
+#: stacked.
+HEALTH_STAT_DEPTHS = {
+    "life_hist": (2, 3), "broken_frac": (1, 2), "life_mean": (1, 2),
+    "stuck_neg": (1, 2), "stuck_zero": (1, 2), "stuck_pos": (1, 2),
+    "age_hist": (2, 3), "age_mean": (1, 2), "age_max": (1, 2),
+}
+
+HEALTH_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),
+    "wall_time": (_NUM, True),
+    "every": (int, True),
+    "decrement": (_NUM, True),
+    "process": (str, True),      # canonical fault-process stack spec
+    "life_edges": (_NUM, True),  # non-empty list of bin edges
+    "tiles": (str, False),       # canonical tile spec (non-default)
+    "age_edges": (_NUM, False),  # present when drift is in the stack
+    "lane_map": (int, False),    # sweep: config id per lane (-1 idle)
+    "params": (dict, True),
 }
 
 # --- span records (host-side time spans, observe/spans.py) ---
@@ -767,6 +839,90 @@ def _validate_fault_redraw(rec) -> list:
     return errs
 
 
+def _nested_numbers(val, lo: int, hi: int) -> bool:
+    """A health stat: a NON-EMPTY list nested between `lo` and `hi`
+    levels deep whose leaves are all numbers (the census never emits
+    an empty tile/config axis — that is an emission bug, not data).
+    Sibling elements must agree on being lists or leaves."""
+    if hi == 0:
+        return not isinstance(val, bool) and isinstance(val, _NUM)
+    if not isinstance(val, list) or not val:
+        return (lo <= 0 and not isinstance(val, bool)
+                and isinstance(val, _NUM))
+    if any(isinstance(v, list) for v in val):
+        return all(isinstance(v, list)
+                   and _nested_numbers(v, lo - 1, hi - 1)
+                   for v in val)
+    return lo <= 1 and all(not isinstance(v, bool)
+                           and isinstance(v, _NUM) for v in val)
+
+
+def _validate_health(rec) -> list:
+    errs = _check_fields(rec, HEALTH_FIELDS, "health")
+    errs += _check_iter(rec, "health")
+    every = rec.get("every")
+    if isinstance(every, int) and not isinstance(every, bool) \
+            and every < 1:
+        errs.append("health: every must be >= 1")
+    dec = rec.get("decrement")
+    if isinstance(dec, _NUM) and not isinstance(dec, bool) and dec <= 0:
+        errs.append("health: decrement must be > 0")
+    for key in ("process", "tiles"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"health: {key} must be non-empty")
+    for key in ("life_edges", "age_edges"):
+        val = rec.get(key)
+        if val is not None and not _nested_numbers(val, 1, 1):
+            errs.append(f"health: {key} must be a non-empty list of "
+                        "numbers")
+    lmap = rec.get("lane_map")
+    if lmap is not None:
+        vals = lmap if isinstance(lmap, list) else [lmap]
+        if any(isinstance(v, int) and not isinstance(v, bool)
+               and v < -1 for v in vals):
+            errs.append("health: lane_map config ids must be >= -1")
+    params = rec.get("params")
+    if isinstance(params, dict):
+        if not params:
+            errs.append("health: params must be non-empty")
+        for name, entry in params.items():
+            where = f"health.params[{name!r}]"
+            if not isinstance(entry, dict):
+                errs.append(f"{where}: not an object")
+                continue
+            grid = entry.get("grid")
+            if not (isinstance(grid, list) and len(grid) == 2
+                    and all(isinstance(g, int)
+                            and not isinstance(g, bool) and g >= 1
+                            for g in grid)):
+                errs.append(f"{where}.grid: expected [rows, cols] "
+                            ">= 1 each")
+            cells = entry.get("cells")
+            if not (isinstance(cells, list) and cells
+                    and all(isinstance(c, int)
+                            and not isinstance(c, bool) and c >= 1
+                            for c in cells)):
+                errs.append(f"{where}.cells: expected a non-empty "
+                            "list of cell counts >= 1")
+            stats = 0
+            for key, val in entry.items():
+                if key in ("grid", "cells"):
+                    continue
+                depths = HEALTH_STAT_DEPTHS.get(key)
+                if depths is None:
+                    errs.append(f"{where}.{key}: unknown census stat")
+                    continue
+                stats += 1
+                if not _nested_numbers(val, *depths):
+                    errs.append(
+                        f"{where}.{key}: expected numbers nested "
+                        f"{depths[0]}-{depths[1]} lists deep")
+            if not stats:
+                errs.append(f"{where}: carries no census stat")
+    return errs
+
+
 def _validate_span(rec) -> list:
     errs = _check_fields(rec, SPAN_FIELDS, "span")
     errs += _check_iter(rec, "span")
@@ -836,6 +992,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_worker(rec)
     if rtype == "alert":
         return _check_version(rec) + _validate_alert(rec)
+    if rtype == "health":
+        return _check_version(rec) + _validate_health(rec)
     if rtype == "span":
         return _check_version(rec) + _validate_span(rec)
     if rtype is not None:

@@ -348,12 +348,16 @@ def alert_line(record: dict) -> str:
 
 
 def make_fault_redraw_record(iteration: int, snapshot: str,
-                             reason: str) -> dict:
+                             reason: str,
+                             tiles: Optional[str] = None) -> dict:
     """The restore-fallback announcement (schema.py
     FAULT_REDRAW_FIELDS): a snapshot with no fault-state file resumed
     with the construction-time fresh draw — the reference's silent
-    re-draw semantics, made loud."""
-    return {
+    re-draw semantics, made loud. `tiles` is the active canonical
+    tile-mapping spec: a redraw under a non-default grid re-rolls
+    per-(param, tile) independent draws — a different experiment —
+    so the trail names the grid alongside the process stack."""
+    rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "fault_redraw",
         "iter": int(iteration),
@@ -361,14 +365,78 @@ def make_fault_redraw_record(iteration: int, snapshot: str,
         "snapshot": str(snapshot),
         "reason": str(reason),
     }
+    if tiles is not None:
+        rec["tiles"] = str(tiles)
+    return rec
 
 
 def fault_redraw_line(record: dict) -> str:
     """One-line text form of a `fault_redraw` record."""
-    return (f"Fault state RE-DRAWN at iteration {record.get('iter')}: "
-            f"{record.get('reason')} (expected "
+    tiles = ""
+    if record.get("tiles"):
+        tiles = f" under tile mapping {record['tiles']}"
+    return (f"Fault state RE-DRAWN at iteration {record.get('iter')}"
+            f"{tiles}: {record.get('reason')} (expected "
             f"{record.get('snapshot')}); resumed degradation will NOT "
             "match the pre-snapshot trajectory")
+
+
+def make_health_record(iteration: int, params: dict, process: str,
+                       every: int, decrement: float,
+                       life_edges, age_edges=None,
+                       tiles: Optional[str] = None,
+                       lane_map=None) -> dict:
+    """One crossbar wear census (schema.py HEALTH_FIELDS): `params` is
+    the CensusProgram payload ({param: {"grid", "cells", per-tile
+    stats}}), `process` the canonical fault-process stack spec,
+    `every` the census cadence, `decrement` the stack's write quantum,
+    `life_edges`/`age_edges` the fixed bin layouts, `tiles` the
+    canonical tile spec (omit for the default 1x1), `lane_map` the
+    sweep's config-per-lane attribution (same contract as the metrics
+    record)."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "health",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "every": int(every),
+        "decrement": float(decrement),
+        "process": str(process),
+        "life_edges": [float(e) for e in life_edges],
+        "params": params,
+    }
+    if age_edges is not None:
+        rec["age_edges"] = [float(e) for e in age_edges]
+    if tiles is not None:
+        rec["tiles"] = str(tiles)
+    if lane_map is not None:
+        rec["lane_map"] = [int(i) for i in lane_map]
+    return rec
+
+
+def _flat_max(v):
+    """Max leaf of a nested census stat (number or nested lists)."""
+    if isinstance(v, list):
+        vals = [_flat_max(x) for x in v]
+        return max(vals) if vals else 0.0
+    return v
+
+
+def health_line(record: dict) -> str:
+    """One-line text form of a `health` record: the worst tile's
+    broken fraction across every param — the census headline a text
+    log can carry without the histograms."""
+    params = record.get("params") or {}
+    worst, where = 0.0, "?"
+    for name, st in params.items():
+        bf = _flat_max(st.get("broken_frac", 0.0)) \
+            if isinstance(st, dict) else 0.0
+        if bf >= worst:
+            worst, where = bf, name
+    tiles = f", tiles {record['tiles']}" if record.get("tiles") else ""
+    return (f"Health census at iteration {record.get('iter')}: "
+            f"{len(params)} param(s){tiles}, worst tile broken "
+            f"fraction {worst:g} ({where})")
 
 
 def make_setup_record(decode_s: float, compile_s: float,
@@ -380,8 +448,8 @@ def make_setup_record(decode_s: float, compile_s: float,
                       fault_state_format: Optional[str] = None,
                       config_shards: Optional[int] = None,
                       fault_model: Optional[dict] = None,
-                      engine_fallback_reason: Optional[str] = None
-                      ) -> dict:
+                      engine_fallback_reason: Optional[str] = None,
+                      tiles_bypassed=None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -426,6 +494,11 @@ def make_setup_record(decode_s: float, compile_s: float,
         # engine="pallas" request resolved to the jax engine, so the
         # log can never attribute a jax run to the kernel
         rec["engine_fallback_reason"] = str(engine_fallback_reason)
+    if tiles_bypassed:
+        # the tiles-bypass trail (same contract): layers a non-default
+        # tile spec did NOT cover — conv layers bypass the crossbar
+        # mapping — so a tiled log names what stayed untiled
+        rec["tiles_bypassed"] = [str(n) for n in tiles_bypassed]
     return rec
 
 
@@ -444,6 +517,10 @@ def setup_line(record: dict) -> str:
     ftail = ""
     if isinstance(fm, dict) and fm.get("spec"):
         ftail = f"; fault model {fm['spec']}"
+    bypassed = record.get("tiles_bypassed")
+    if bypassed:
+        ftail += ("; tiles bypassed: "
+                  + ", ".join(str(n) for n in bypassed))
     return (f"Setup: decode {record.get('decode_seconds', 0):g} s, "
             f"compile {record.get('compile_seconds', 0):g} s{extra} "
             f"(compile cache {cache.get('compile', '?')}, "
@@ -697,6 +774,10 @@ class CaffeLogSink:
         if rtype == "span":
             from .spans import span_line
             self._emit(span_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "health":
+            self._emit(health_line(record))
             self._maybe_flush()
             return
         if rtype is not None:

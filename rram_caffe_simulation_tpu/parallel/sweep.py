@@ -193,7 +193,8 @@ class SweepRunner:
                  pipeline_depth: Optional[int] = None,
                  stall_timeout_s: Optional[float] = None,
                  engine: str = "jax", packed_state: bool = False,
-                 dtype_policy=None, fused_epilogue=None):
+                 dtype_policy=None, fused_epilogue=None,
+                 health_every: int = 0):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -359,6 +360,23 @@ class SweepRunner:
         # metrics enabled (Solver.enable_metrics before building the
         # runner switches the counters on)
         self.last_metrics = {}
+        # crossbar health plane (observe/health.py, ISSUE 17): every
+        # `health_every` iterations the dispatcher runs a SEPARATE
+        # jitted census over the resident (possibly packed) fault
+        # states at the _finish_step barrier — the train step program
+        # never changes, and the per-config stat vectors carry
+        # lane_map so censuses stay attributable across self-healing
+        # refills. 0 = off.
+        self._health_every = int(health_every or 0)
+        if self._health_every < 0:
+            raise ValueError(
+                f"health_every must be >= 0, got {health_every!r}")
+        self._health_census = None   # CensusProgram, built lazily
+        self._health_ledger = None
+        self._last_health_tick = None
+        if self._health_every:
+            from ..observe import health as obs_health
+            self._health_ledger = obs_health.HealthLedger()
 
         # engine="pallas" under a mesh (ISSUE 13): a config-only mesh
         # runs the kernel SHARDED — the custom_vmap seam wraps the
@@ -1731,6 +1749,8 @@ class SweepRunner:
         self.setup.engine_fallback_reason = self.engine_fallback_reason
         fs = getattr(self.solver, "fault_spec", None)
         self.setup.fault_model = fs.to_model() if fs is not None else None
+        self.setup.tiles_bypassed = getattr(
+            self.solver, "tiles_bypassed", None) or None
         return self.setup.record(setup_s)
 
     def _owned_config_block(self) -> tuple:
@@ -2192,6 +2212,7 @@ class SweepRunner:
                 self._agree_stall()
             self._service_watchdog()
             self._drain_spans()
+            self._maybe_health()
             return self._last_host
         t0 = time.perf_counter()
         if stacked:
@@ -2201,7 +2222,79 @@ class SweepRunner:
             out = (np.asarray(losses), jax.tree.map(np.asarray, outputs))
         self.pipeline.host_blocked_s += time.perf_counter() - t0
         self._drain_spans()
+        self._maybe_health()
         return out
+
+    def _maybe_health_boundary(self):
+        """Chunk-boundary census check: when `iter` crossed a
+        health_every boundary mid-step(), drain the pipelined consumer
+        FIRST (restoring the sink's single-writer invariant — the
+        census record must not race the consumer thread's bookkeeping)
+        and census. The tick pre-check keeps the off-boundary cost to
+        one integer division, so pipelining only stalls on the rare
+        census beat."""
+        every = self._health_every
+        if not every:
+            return
+        tick = self.iter // every
+        if self._last_health_tick is not None \
+                and tick == self._last_health_tick:
+            return
+        self._drain_consumer()
+        self._maybe_health()
+
+    def _maybe_health(self):
+        """Census tick at a drained barrier (the end-of-step() drain or
+        _maybe_health_boundary's: the consumer thread is idle, so
+        logging here cannot race it). Fires whenever `iter` crossed a
+        health_every boundary since the last tick."""
+        every = self._health_every
+        if not every:
+            return None
+        tick = self.iter // every
+        if self._last_health_tick is None:
+            # arm at the current tick: first census at the NEXT
+            # boundary (nothing has worn at build/restore time)
+            self._last_health_tick = tick
+            return None
+        if tick == self._last_health_tick:
+            return None
+        self._last_health_tick = tick
+        from ..observe import health as obs_health
+        from ..observe import sink as obs_sink
+        solver = self.solver
+        stack = solver.fault_process
+        if self._health_census is None:
+            self._health_census = obs_health.CensusProgram(
+                stack, stacked=True, pack_spec=self._pack_spec)
+        params = self._health_census(self.fault_states)
+        h = self._healing
+        lane_map = ([int(c) for c in h.lane_cfg] if h is not None
+                    else list(range(self.n)))
+        tspec = getattr(solver, "tile_spec", None)
+        tiles = (tspec.canonical()
+                 if tspec is not None and not tspec.is_default
+                 else None)
+        rec = obs_sink.make_health_record(
+            self.iter, params, process=stack.canonical(), every=every,
+            decrement=stack.write_quantum(solver.fail_decrement),
+            life_edges=obs_health.LIFE_EDGES,
+            age_edges=obs_health.AGE_EDGES, tiles=tiles,
+            lane_map=lane_map)
+        if self._health_ledger is not None:
+            self._health_ledger.update(rec)
+        logger = (solver.metrics_logger
+                  if solver._metrics_enabled else None)
+        if logger is not None:
+            logger.log(rec)
+        return rec
+
+    def health_summary(self):
+        """The fleet-scrape health view (HealthLedger.summary()):
+        None until the first census lands or when health_every=0."""
+        if self._health_ledger is None:
+            return None
+        return self._health_ledger.summary()
 
     def step(self, iters: int = 1, chunk: int = 1):
         """Run `iters` sweep iterations; `chunk` > 1 scans that many
@@ -2374,6 +2467,7 @@ class SweepRunner:
                 self._after_dispatch(k, self.iter - 1, losses, outputs,
                                      mets, self.quarantine)
                 done += k
+                self._maybe_health_boundary()
                 if self._service_watchdog():
                     break
                 if self._heal_pass(k, losses):
@@ -2407,6 +2501,7 @@ class SweepRunner:
                                      self.quarantine, stacked=False)
                 self.iter += 1
                 done += 1
+                self._maybe_health_boundary()
                 if self._service_watchdog():
                     break
                 if self._heal_pass(1, loss, stacked=False):
@@ -2440,6 +2535,7 @@ class SweepRunner:
             self._after_dispatch(k, self.iter - 1, losses, outputs, mets,
                                  self.quarantine)
             done += k
+            self._maybe_health_boundary()
             if self._service_watchdog():
                 break
             if self._heal_pass(k, losses):
@@ -3064,6 +3160,11 @@ class SweepRunner:
         self.last_metrics = {}
         self._last_host = None
         self._record_t0 = None
+        # the restored iteration invalidates the census tick anchor —
+        # the next health census fires at the next boundary (the
+        # ledger dedups a replayed same-iteration census, so a resumed
+        # record stream cannot double-count)
+        self._last_health_tick = None
         with self._watchdog_lock:
             self._watchdog_event = None
         # a noted-but-unagreed stall belongs to the abandoned timeline

@@ -61,6 +61,17 @@ DEFAULT_RULES = (
      "op": "delta>", "threshold": 0.0, "for_beats": 2, "clear_beats": 5,
      "severity": "page",
      "help": "configs being quarantined beat over beat"},
+    # crossbar health plane (observe/health.py): fires when any
+    # worker's worst tile crosses the RUL projection threshold —
+    # accuracy falls off the cliff once remap spares run out. Gated on
+    # health_reporting_workers so a fleet with wear telemetry off (the
+    # metric absent or 0) can neither fire nor flap.
+    {"name": "wear_cliff", "metric": "health_broken_frac_max",
+     "op": ">", "threshold": 0.3, "for_beats": 2, "clear_beats": 2,
+     "severity": "page", "when_metric": "health_reporting_workers",
+     "when_above": 0.0,
+     "help": "a crossbar tile's broken-cell fraction crossed the "
+             "remap-spare cliff"},
 )
 
 

@@ -525,7 +525,7 @@ class FleetController:
                     status = dict(labels).get("status", "")
                     requests[status] = int(value)
             tot = (("tenant", "_total"),)
-            return {
+            view = {
                 "source": "socket",
                 "occupancy": scraped.get(("rram_occupancy_ratio", ()),
                                          0.0),
@@ -539,8 +539,24 @@ class FleetController:
                 "projected_s": scraped.get(
                     ("rram_projected_backlog_seconds", ()), 0.0),
             }
+            # crossbar health plane: present only once the worker's
+            # wear ledger has censuses (registry_from_stats exports
+            # the gauges conditionally, mirroring stats()["health"])
+            if ("rram_health_censuses", ()) in scraped:
+                view["health"] = {
+                    "censuses": scraped.get(
+                        ("rram_health_censuses", ()), 0),
+                    "broken_frac_max": scraped.get(
+                        ("rram_health_broken_frac_max", ())),
+                    "wear_rate_max": scraped.get(
+                        ("rram_health_wear_rate_max", ())),
+                    "rul_iters_min": scraped.get(
+                        ("rram_health_rul_iters_min", ())),
+                    "tiles": scraped.get(("rram_health_tiles", ()), 0),
+                }
+            return view
         snap = row.get("stats") or {}
-        return {
+        view = {
             "source": "table",
             "occupancy": float(snap.get("occupancy") or 0.0),
             "slo_burn": float(snap.get("slo_burn") or 0.0),
@@ -550,6 +566,9 @@ class FleetController:
             "active_requests": int(snap.get("active_requests") or 0),
             "projected_s": float(snap.get("projected_s") or 0.0),
         }
+        if isinstance(snap.get("health"), dict):
+            view["health"] = dict(snap["health"])
+        return view
 
     def _fleet_observation(self, rows: Dict[str, dict],
                            views: Dict[str, dict]) -> dict:
@@ -565,7 +584,25 @@ class FleetController:
                     for v in views.values()], default=0.0)
         ema = self.scaler.projected_s if self.scaler is not None \
             else None
+        # crossbar health plane: fleet-level wear signals over the
+        # workers that report censuses. health_reporting_workers gates
+        # the wear_cliff rule (alerts.py): with zero reporting workers
+        # the wear metrics are absent, so the rule sees breach=None and
+        # can neither fire nor flap on a health-disabled fleet.
+        health = [v["health"] for v in views.values()
+                  if isinstance(v.get("health"), dict)
+                  and v["health"].get("censuses")]
+        bf = [h.get("broken_frac_max") for h in health
+              if isinstance(h.get("broken_frac_max"), (int, float))]
+        ruls = [h.get("rul_iters_min") for h in health
+                if isinstance(h.get("rul_iters_min"), (int, float))]
+        obs_health = {"health_reporting_workers": float(len(health))}
+        if bf:
+            obs_health["health_broken_frac_max"] = float(max(bf))
+        if ruls:
+            obs_health["health_rul_iters_min"] = float(min(ruls))
         return {
+            **obs_health,
             "workers": len(rows),
             "lanes": lanes,
             "occupied_lanes": occupied,
@@ -604,6 +641,17 @@ class FleetController:
                      "when a scaler runs, raw iters otherwise)")
         reg.set("rram_fleet_slo_burn_rate", obs["slo_burn_rate"],
                 help="worst per-worker SLO burn rate")
+        reg.set("rram_health_reporting_workers",
+                obs.get("health_reporting_workers", 0.0),
+                help="workers with wear-census telemetry this beat")
+        if obs.get("health_broken_frac_max") is not None:
+            reg.set("rram_health_broken_frac_max",
+                    obs["health_broken_frac_max"],
+                    help="fleet-worst per-tile broken-cell fraction")
+        if obs.get("health_rul_iters_min") is not None:
+            reg.set("rram_health_rul_iters_min",
+                    obs["health_rul_iters_min"],
+                    help="fleet-minimum remaining-useful-life (iters)")
         reg.set("rram_fleet_pending_requests", obs["pending_requests"],
                 help="fleet-spool requests awaiting routing")
         reg.set("rram_fleet_assigned_requests",
@@ -676,6 +724,18 @@ class FleetController:
             reg.set("rram_worker_active_requests",
                     int(view.get("active_requests") or 0),
                     help="admitted + running requests", worker=wid)
+            wh = view.get("health")
+            if isinstance(wh, dict) and wh.get("censuses"):
+                if wh.get("broken_frac_max") is not None:
+                    reg.set("rram_worker_health_broken_frac_max",
+                            float(wh["broken_frac_max"]),
+                            help="worker-worst per-tile broken-cell "
+                                 "fraction", worker=wid)
+                if wh.get("rul_iters_min") is not None:
+                    reg.set("rram_worker_health_rul_iters_min",
+                            float(wh["rul_iters_min"]),
+                            help="worker-minimum remaining-useful-life "
+                                 "(iters)", worker=wid)
             for status, count in sorted(
                     (view.get("requests") or {}).items()):
                 reg.set("rram_worker_requests", int(count),

@@ -90,6 +90,17 @@ def render_frame(fleet_dir, samples, rows, now=None):
     lines.append(f"backlog {backlog:g} iters (ema {ema:g})  "
                  f"pending {pending}  in-flight {assigned}  "
                  f"{lat}  slo burn {burn:.2f}")
+    # crossbar health plane: shown only when any worker reports wear
+    # censuses (rram_health_reporting_workers > 0)
+    reporting = _get(samples, "rram_health_reporting_workers", 0.0)
+    if reporting:
+        bf = _get(samples, "rram_health_broken_frac_max", None)
+        rul = _get(samples, "rram_health_rul_iters_min", None)
+        wear = "—" if bf is None else f"{bf:.1%}"
+        horizon = "—" if rul is None else f"{rul:g} iters"
+        lines.append(f"wear: worst tile {wear} broken  "
+                     f"min RUL {horizon}  "
+                     f"({int(reporting)} worker(s) reporting)")
 
     firing = sorted(
         dict(labels).get("alert", "")
@@ -103,7 +114,7 @@ def render_frame(fleet_dir, samples, rows, now=None):
     lines.append("")
     lines.append(f"{'WORKER':<10}{'AGE':>6}{'LANES':>7}{'PEND':>6}"
                  f"{'ACTIVE':>8}{'STEP/S':>9}{'SWAPS':>7}{'OCC':>6}"
-                 "  PINNED")
+                 f"{'WEAR':>7}  PINNED")
     wids = sorted(set(
         dict(labels).get("worker", "")
         for (name, labels), _ in samples.items()
@@ -125,6 +136,12 @@ def render_frame(fleet_dir, samples, rows, now=None):
                          row.get("swap_count", 0), worker=wid))
         occr = _get(samples, "rram_worker_occupancy_ratio", 0.0,
                     worker=wid)
+        wear_bf = _get(samples, "rram_worker_health_broken_frac_max",
+                       None, worker=wid)
+        if wear_bf is None:
+            snap = (row.get("stats") or {}).get("health") or {}
+            wear_bf = snap.get("broken_frac_max")
+        wear = "—" if wear_bf is None else f"{float(wear_bf):.1%}"
         pinned = row.get("pinned") or {}
         pin = ",".join(f"{k}={pinned[k]}" for k in
                        ("process", "net", "tiles", "dtype_policy")
@@ -132,7 +149,7 @@ def render_frame(fleet_dir, samples, rows, now=None):
         lines.append(f"{wid:<10}{_fmt_age(age):>6}"
                      f"{f'{occ_w}/{lanes_w}':>7}{pend_w:>6}"
                      f"{active:>8}{sps:>9.1f}{swaps:>7}"
-                     f"{occr:>6.0%}  {pin}")
+                     f"{occr:>6.0%}{wear:>7}  {pin}")
     return "\n".join(lines) + "\n"
 
 

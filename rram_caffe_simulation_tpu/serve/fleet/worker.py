@@ -213,6 +213,25 @@ class FleetWorker:
         reqs = view.get("requests") or {}
         occ = view.get("occupancy") or {}
         slo = (view.get("slo") or {}).get("_total") or {}
+        stats = {
+            "iter": int(view.get("iter") or 0),
+            "requests": {str(k): int(v) for k, v in reqs.items()},
+            "active_requests": int(reqs.get("running") or 0)
+                               + int(reqs.get("admitted") or 0),
+            "projected_s": round(float(view.get("projected_s")
+                                       or 0.0), 3),
+            "occupancy": round(float(occ.get("occupancy") or 0.0),
+                               4),
+            "slo_burn": round(float(slo.get("burn_rate") or 0.0),
+                              4),
+            "projection_bias": round(float(slo.get(
+                "projection_bias") or 0.0), 4),
+        }
+        # crossbar health plane: the wear-ledger rollup rides the
+        # heartbeat row only once censuses exist, so the controller
+        # can tell "health off / no data yet" from "healthy"
+        if isinstance(view.get("health"), dict):
+            stats["health"] = view["health"]
         return {
             "pid": os.getpid(),
             "host": socket.gethostname(),
@@ -226,20 +245,7 @@ class FleetWorker:
             # watchtower snapshot: enough state on the heartbeat row
             # for ServeClient stats and the controller's rollup to
             # work SOCKET-FREE from the worker table alone
-            "stats": {
-                "iter": int(view.get("iter") or 0),
-                "requests": {str(k): int(v) for k, v in reqs.items()},
-                "active_requests": int(reqs.get("running") or 0)
-                                   + int(reqs.get("admitted") or 0),
-                "projected_s": round(float(view.get("projected_s")
-                                           or 0.0), 3),
-                "occupancy": round(float(occ.get("occupancy") or 0.0),
-                                   4),
-                "slo_burn": round(float(slo.get("burn_rate") or 0.0),
-                                  4),
-                "projection_bias": round(float(slo.get(
-                    "projection_bias") or 0.0), 4),
-            },
+            "stats": stats,
         }
 
     def _worker_record(self, event: str, **kw) -> dict:

@@ -118,6 +118,7 @@ class SweepService:
                  profile_dir: Optional[str] = None,
                  fault_process=None, tile_spec=None,
                  dtype_policy=None, net_name: Optional[str] = None,
+                 health_every: int = 0,
                  runner_kw: Optional[dict] = None):
         from ..observe import JsonlSink
         from ..observe.spans import OccupancyAggregator, SloAccountant
@@ -220,6 +221,12 @@ class SweepService:
         runner_kw = dict(runner_kw or {})
         if dtype_policy is not None:
             runner_kw.setdefault("dtype_policy", dtype_policy)
+        if health_every:
+            # crossbar health plane (observe/health.py): the runner
+            # censuses lane wear every `health_every` iterations;
+            # stats()["health"] and the `metrics` socket op surface
+            # the ledger's rollup as rram_health_* gauges
+            runner_kw.setdefault("health_every", int(health_every))
         self.runner = SweepRunner(self.solver, n_configs=int(lanes),
                                   pipeline_depth=int(pipeline_depth),
                                   mesh=mesh,
@@ -936,6 +943,11 @@ class SweepService:
                 # request lands
                 "occupancy": self._occ.summary(),
                 "slo": self._slo.summary(),
+                # crossbar health plane (observe/health.py): the
+                # runner's wear-ledger rollup — None until the first
+                # census (or with health_every=0), so scrapers can
+                # tell "no data" from "healthy"
+                "health": self.runner.health_summary(),
             }
 
     def _state_path(self) -> str:
@@ -1353,6 +1365,11 @@ def main(argv=None) -> int:
                         "(default <service-dir>/trace); share it with "
                         "a jax.profiler capture to view host spans "
                         "alongside device traces")
+    p.add_argument("--health-every", type=int, default=0,
+                   help="crossbar wear-census cadence in iterations "
+                        "(observe/health.py): emit schema-validated "
+                        "`health` records + rram_health_* gauges; "
+                        "0 = off")
     args = p.parse_args(argv)
 
     weights = {}
@@ -1375,7 +1392,8 @@ def main(argv=None) -> int:
         mesh=args.mesh or None,
         trace=args.trace, profile_dir=args.profile_dir or None,
         fault_process=args.fault_process, tile_spec=args.tiles,
-        dtype_policy=args.dtype_policy, net_name=args.net_name)
+        dtype_policy=args.dtype_policy, net_name=args.net_name,
+        health_every=args.health_every)
 
     def _on_signal(signum, frame):
         service.drain()

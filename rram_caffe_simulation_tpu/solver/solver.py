@@ -150,6 +150,13 @@ class Solver:
         # computation even when debug_info is unset ---
         self._watchdog = None      # None | "halt" | "snapshot"
         self.debug_spec = None     # NetDebugSpec once tracing is built
+        # --- crossbar health plane (observe/health.py): armed with
+        # enable_health(); the census is a SEPARATE jitted program over
+        # the resident fault state, so the train step never changes ---
+        self._health_every = 0
+        self._health_census = None   # CensusProgram once armed
+        self._health_ledger = None   # HealthLedger once armed
+        self._last_health_tick = None
         # SweepRunner installs its checkpoint() here so the watchdog's
         # "snapshot" policy captures the SWEEP state (stacked params /
         # fault state / quarantine), not just this scalar solver's
@@ -256,6 +263,30 @@ class Solver:
                 "crossbar mapping needs failure_pattern "
                 "{ type: 'gaussian' } and at least one fault-target "
                 "layer")
+        # Tiled-mapping coverage (ISSUE 17 satellite): a non-default
+        # tile spec only partitions 2-D crossbar weights — conv fault
+        # targets (failure_pattern.conv_also) keep the untiled draw and
+        # read. Loud, never silent: the run would otherwise report
+        # per-tile wear for a mapping that covers only part of the
+        # fault-prone set. Named layers ride the `setup` record as
+        # `tiles_bypassed` (cache.SetupStats).
+        self.tiles_bypassed = []
+        if not self.tile_spec.is_default and self.fault_state is not None:
+            flat_shapes = self._flat(self.params)
+            # >2-D only: 1-D biases are a single crossbar column by
+            # construction, not a coverage gap
+            self.tiles_bypassed = sorted(
+                {k.rsplit("/", 1)[0] for k in self._fault_keys
+                 if len(flat_shapes[k].shape) > 2})
+            if self.tiles_bypassed:
+                print(
+                    "WARNING: tile spec "
+                    f"{self.tile_spec.canonical()!r} does not cover "
+                    "non-matrix fault-target layer(s) "
+                    f"{', '.join(self.tiles_bypassed)} — conv params "
+                    "bypass the crossbar tiling (untiled fault draw "
+                    "and read); per-tile wear telemetry reports them "
+                    "as a single tile", file=sys.stderr, flush=True)
         if (param.HasField("rram_forward")
                 and (param.rram_forward.sigma or param.rram_forward.adc_bits)
                 and self.fault_state is None):
@@ -1086,6 +1117,83 @@ class Solver:
         self._mclock = _IntervalClock()
         return self.metrics_logger
 
+    def enable_health(self, every: int, threshold: float = None):
+        """Arm the crossbar health plane (observe/health.py): every
+        `every` iterations a SEPARATE small jitted census program runs
+        over the resident fault state and emits a schema-validated
+        `health` record — per-(param, tile) remaining-lifetime
+        histograms, broken fraction, stuck composition, drift ages —
+        to the metric sinks, and feeds the host-side `health_ledger`
+        (wear-rate trends + remaining-useful-life forecast,
+        `summarize --health`).
+
+        Unlike enable_metrics this may be called at any time: the
+        train step program is untouched (that is the zero-perturbation
+        contract scripts/check_health_telemetry.py pins). `every=0`
+        disarms. Requires an active fault engine — with no fault state
+        there is nothing to census."""
+        every = int(every)
+        if every < 0:
+            raise ValueError(f"health_every must be >= 0, got {every}")
+        if every and self.fault_state is None:
+            raise ValueError(
+                "enable_health needs an active fault engine "
+                "(failure_pattern { type: 'gaussian' } and at least "
+                "one fault-target layer) — there is no device wear "
+                "state to census without one")
+        from ..observe import health as obs_health
+        self._health_every = every
+        self._health_census = None   # rebuilt lazily on first tick
+        if every:
+            kw = ({"threshold": float(threshold)}
+                  if threshold is not None else {})
+            self._health_ledger = obs_health.HealthLedger(**kw)
+            self._last_health_tick = None
+        return self._health_ledger
+
+    @property
+    def health_ledger(self):
+        return self._health_ledger
+
+    def _maybe_health(self):
+        """Census tick: run the jitted census when `iter` crossed a
+        health_every boundary since the last tick. Called from the
+        step()/step_fused() loop tails, so chunked stepping censuses at
+        most once per chunk (cadence is best-effort >= every)."""
+        every = self._health_every
+        if not every or self.fault_state is None:
+            return None
+        tick = self.iter // every
+        if self._last_health_tick is None:
+            # arm at the current tick so the census first fires at the
+            # NEXT boundary, not at iteration 0 (nothing has worn yet)
+            self._last_health_tick = tick
+            return None
+        if tick == self._last_health_tick:
+            return None
+        self._last_health_tick = tick
+        from ..observe import health as obs_health
+        from ..observe import sink as obs_sink
+        if self._health_census is None:
+            self._health_census = obs_health.CensusProgram(
+                self.fault_process, stacked=False)
+        params = self._health_census(self.fault_state)
+        tspec = getattr(self, "tile_spec", None)
+        tiles = (tspec.canonical()
+                 if tspec is not None and not tspec.is_default else None)
+        rec = obs_sink.make_health_record(
+            self.iter, params,
+            process=self.fault_process.canonical(), every=every,
+            decrement=self.fault_process.write_quantum(
+                self.fail_decrement),
+            life_edges=obs_health.LIFE_EDGES,
+            age_edges=obs_health.AGE_EDGES, tiles=tiles)
+        if self.metrics_logger is not None:
+            self.metrics_logger.log(rec)
+        if self._health_ledger is not None:
+            self._health_ledger.update(rec)
+        return rec
+
     def enable_watchdog(self, policy: str = "halt"):
         """Arm the divergence watchdog (CLI: `--watchdog`). The jitted
         step then carries the in-jit numeric health sentinels
@@ -1549,6 +1657,8 @@ class Solver:
                         writes_saved_acc=clock.ws)
                     clock.reset(now)
             self.iter += 1
+            if self._health_every:
+                self._maybe_health()
             if (param.snapshot and self.iter % param.snapshot == 0):
                 t0 = time.perf_counter()
                 self.snapshot()
@@ -1740,6 +1850,8 @@ class Solver:
                 self.snapshot()
                 if track:
                     clock.exclude(t0)
+            if self._health_every:
+                self._maybe_health()
             done += n
             if self._requested_action == "stop":
                 break
@@ -2028,11 +2140,15 @@ class Solver:
             active = (self.fault_spec.canonical()
                       if getattr(self, "fault_spec", None) is not None
                       else "endurance_stuck_at")
+            tspec = getattr(self, "tile_spec", None)
+            tiles = (tspec.canonical()
+                     if tspec is not None and not tspec.is_default
+                     else None)
             rec = obs_sink.make_fault_redraw_record(
                 self.iter, fault_file,
                 "snapshot predates fault-state capture; fault state "
                 f"re-drawn from the failure_pattern (active fault "
-                f"process: {active})")
+                f"process: {active})", tiles=tiles)
             print("WARNING: " + obs_sink.fault_redraw_line(rec),
                   file=sys.stderr, flush=True)
             if self.metrics_logger is not None:
@@ -2080,6 +2196,9 @@ class Solver:
                     for gid, arr in
                     self.fault_state["remap_slots"].items()}
             self.fault_state = restored
+        # the restored iteration invalidates the census tick anchor —
+        # re-arm so the next health census fires at the next boundary
+        self._last_health_tick = None
 
     # observability -----------------------------------------------------
     def broken_fraction(self) -> float:

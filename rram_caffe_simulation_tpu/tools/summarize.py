@@ -24,6 +24,14 @@ the per-phase host time breakdown from `span` records, and
 per-request latency percentiles from the `request` lifecycle records.
 
     python -m rram_caffe_simulation_tpu.tools.summarize <run-dir> --timeline
+
+`--health` renders the crossbar health plane instead (observe/
+health.py): the stream's `health` census records feed a HealthLedger
+and the digest is a worst-tile wear table — broken fraction, wear
+rate, estimated write traffic, and the remaining-useful-life
+projection per (config, param, tile).
+
+    python -m rram_caffe_simulation_tpu.tools.summarize <run-dir> --health
 """
 import argparse
 import json
@@ -341,6 +349,7 @@ def merge_metric_streams(paths):
 def _classify(streams):
     """Split merged stream records into the digest buckets."""
     recs, retries, requests, spans, workers = [], [], [], [], []
+    health, alerts = [], []
     n_typed = 0
     for _, stream in streams:
         for rec in stream:
@@ -353,6 +362,10 @@ def _classify(streams):
                 spans.append(rec)
             elif rtype == "worker":
                 workers.append(rec)
+            elif rtype == "health":
+                health.append(rec)
+            elif rtype == "alert":
+                alerts.append(rec)
             elif rtype is not None:
                 # debug_trace / sentinel / setup records ride the same
                 # sink; the digest summarizes the display-interval
@@ -360,7 +373,8 @@ def _classify(streams):
                 n_typed += 1
             else:
                 recs.append(rec)
-    return recs, retries, requests, spans, workers, n_typed
+    return recs, retries, requests, spans, workers, health, alerts, \
+        n_typed
 
 
 def _worker_digest(workers):
@@ -391,6 +405,47 @@ def _worker_digest(workers):
     return lines
 
 
+def _health_digest(health):
+    """One-screen digest of `health` census records (observe/health.py):
+    the ledger's rollup summary — worst broken fraction, fastest wear
+    rate, minimum remaining useful life. `--health` renders the full
+    per-tile forecast table."""
+    from ..observe.health import HealthLedger
+    ledger = HealthLedger()
+    for rec in health:
+        ledger.update(rec)
+    s = ledger.summary()
+    if s is None:
+        return [f"Health censuses: {len(health)} record(s), "
+                "no per-tile stats"]
+    rul = s["rul_iters_min"]
+    return [
+        f"Health censuses: {s['censuses']} over {s['configs']} "
+        f"config(s), {s['tiles']} (config,param,tile) series: "
+        f"worst broken_frac {_fmt_num(s['broken_frac_max'])}, "
+        f"wear rate max {_fmt_num(s['wear_rate_max'])}/iter, "
+        f"min RUL {_fmt_num(rul)}"
+        + (" iters" if rul is not None else "")
+        + " (--health forecasts per tile)"]
+
+
+def _alert_digest(alerts):
+    """Digest of watchtower `alert` transition records: per-event
+    counts plus the set of alerts still firing at stream end."""
+    by_event = {}
+    state = {}
+    for r in alerts:
+        by_event.setdefault(r.get("event", "?"), []).append(r)
+        state[r.get("alert", "?")] = r.get("event")
+    parts = [f"{len(v)} {k}" for k, v in sorted(by_event.items())]
+    lines = [f"Alert transitions ({len(alerts)}): " + ", ".join(parts)]
+    firing = sorted(n for n, ev in state.items() if ev == "firing")
+    if firing:
+        lines.append("  still firing at stream end: "
+                     + ", ".join(firing))
+    return lines
+
+
 def summarize_metrics(paths):
     """One-screen digest of one or more JSONL metrics logs (schema:
     observe/schema.py / USAGE.md Observability). `paths` is a single
@@ -400,11 +455,11 @@ def summarize_metrics(paths):
         paths = [paths]
     files = _expand_metric_paths(paths)
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, workers, n_typed = \
+    recs, retries, requests, spans, workers, health, alerts, n_typed = \
         _classify(streams)
     path = files[0] if len(files) == 1 else \
         f"{len(files)} files, {len(streams)} stream(s)"
-    if not recs and (requests or workers):
+    if not recs and (requests or workers or health or alerts):
         # a per-request stream (sweep service) or a controller-only
         # fleet stream carries lifecycle records only — digest those
         # without demanding metrics
@@ -413,6 +468,10 @@ def summarize_metrics(paths):
             lines += _worker_digest(workers)
         if requests:
             lines += _request_digest(requests)
+        if health:
+            lines += _health_digest(health)
+        if alerts:
+            lines += _alert_digest(alerts)
         return "\n".join(lines)
     if not recs:
         return f"{path}: no records"
@@ -465,6 +524,10 @@ def summarize_metrics(paths):
         lines += _worker_digest(workers)
     if requests:
         lines += _request_digest(requests)
+    if health:
+        lines += _health_digest(health)
+    if alerts:
+        lines += _alert_digest(alerts)
     lmap = last.get("lane_map")
     if isinstance(lmap, list):
         # keep the one-screen contract: a 500-lane sweep's full map
@@ -543,6 +606,77 @@ def summarize_metrics(paths):
     return "\n".join(lines)
 
 
+def summarize_health(paths, threshold=None, top=16):
+    """The crossbar-health view of one or more metrics streams: every
+    `health` census record feeds an observe/health.py HealthLedger
+    (replica collapse and stream merge exactly as summarize_metrics —
+    census records are process-0 bookkeeping, so replicas dedup), and
+    the digest is the ledger's worst-tile forecast table plus the
+    rollup summary the fleet scrapes. `threshold` overrides the
+    broken-fraction cliff the RUL projects to (default
+    observe.health.RUL_THRESHOLD)."""
+    from ..observe.health import HealthLedger, RUL_THRESHOLD
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    files = _expand_metric_paths(paths)
+    streams, notes = merge_metric_streams(files)
+    _, _, _, _, _, health, alerts, _ = _classify(streams)
+    path = files[0] if len(files) == 1 else \
+        f"{len(files)} files, {len(streams)} stream(s)"
+    lines = [f"Health: {path}"] + notes
+    if not health:
+        lines.append("no health census records (run with "
+                     "health_every > 0 / --health-every to arm the "
+                     "wear census)")
+        return "\n".join(lines)
+    th = RUL_THRESHOLD if threshold is None else float(threshold)
+    ledger = HealthLedger(threshold=th)
+    for rec in health:
+        ledger.update(rec)
+    first, last = health[0], health[-1]
+    lines.append(f"Census records: {len(health)} "
+                 f"(iter {first.get('iter')} .. {last.get('iter')}, "
+                 f"every {last.get('every')} iters)")
+    proc = last.get("process")
+    if proc:
+        lines.append(f"Fault process: {proc}")
+    s = ledger.summary() or {}
+    rul = s.get("rul_iters_min")
+    lines.append(
+        f"Ledger: {s.get('configs')} config(s), {s.get('tiles')} "
+        f"(config,param,tile) series; worst broken_frac "
+        f"{_fmt_num(s.get('broken_frac_max'))}, wear rate max "
+        f"{_fmt_num(s.get('wear_rate_max'))}/iter, min RUL "
+        f"{_fmt_num(rul)}"
+        + (" iters" if rul is not None else "")
+        + f" (cliff at broken_frac {th:g})")
+    rows = ledger.worst_tiles(top)
+    if rows:
+        header = ("CONFIG", "PARAM", "TILE", "BROKEN", "WEAR/ITER",
+                  "WRITES/CELL/ITER", "RUL ITERS", "METHOD")
+        table = [header]
+        for r in rows:
+            cfg = "-" if r["config"] < 0 else str(r["config"])
+            rul_r = r["rul_iters"]
+            table.append((
+                cfg, str(r["param"]), str(r["tile"]),
+                f"{r['broken_frac']:.4f}",
+                f"{r['wear_rate']:.3e}",
+                f"{r['write_rate']:g}",
+                "-" if rul_r is None else f"{rul_r:.0f}",
+                r["method"] or "-"))
+        widths = [max(len(t[i]) for t in table)
+                  for i in range(len(header))]
+        lines.append(f"Worst {len(rows)} tile(s) by remaining useful "
+                     "life:")
+        for t in table:
+            lines.append("  " + "  ".join(
+                c.ljust(w) for c, w in zip(t, widths)).rstrip())
+    if alerts:
+        lines += _alert_digest(alerts)
+    return "\n".join(lines)
+
+
 def summarize_timeline(paths, slo_seconds: float = 0.0):
     """The span-tracer view of a run/service/FLEET directory (or
     explicit files): fleet-wide lane occupancy (exact lane-iteration
@@ -567,7 +701,8 @@ def summarize_timeline(paths, slo_seconds: float = 0.0):
                 "no spans recorded (no metrics*.jsonl, fleet.jsonl, "
                 "or requests/*.jsonl streams found)")
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, workers, _ = _classify(streams)
+    recs, retries, requests, spans, workers, _, _, _ = \
+        _classify(streams)
     lines = [f"Timeline: {len(files)} file(s), "
              f"{len(streams)} stream(s)"] + notes
     if workers:
@@ -719,6 +854,16 @@ def main(argv=None):
                    help="SLO window for --timeline's per-tenant burn/"
                         "violation rates (0 = report latency and "
                         "projection bias only)")
+    p.add_argument("--health", action="store_true",
+                   help="render the crossbar health view: wear census "
+                        "ledger, worst-tile forecast table, and "
+                        "remaining-useful-life projections")
+    p.add_argument("--rul-threshold", type=float, default=None,
+                   help="broken-fraction cliff the --health RUL "
+                        "forecast projects to (default: "
+                        "observe.health.RUL_THRESHOLD)")
+    p.add_argument("--top", type=int, default=16,
+                   help="rows in the --health worst-tile table")
     args = p.parse_args(argv)
     from .parse_log import is_jsonl
     # metrics mode needs EVERY input to be a metrics source — a stray
@@ -732,6 +877,14 @@ def main(argv=None):
                     "directories, not a net prototxt")
         print(summarize_timeline(args.paths,
                                  slo_seconds=args.slo_seconds))
+        return 0
+    if args.health:
+        if not metricsish:
+            p.error("--health needs JSONL metrics logs or run "
+                    "directories, not a net prototxt")
+        print(summarize_health(args.paths,
+                               threshold=args.rul_threshold,
+                               top=args.top))
         return 0
     if metricsish:
         print(summarize_metrics(args.paths))

@@ -1,0 +1,564 @@
+#!/usr/bin/env python
+"""CI guard for the crossbar health plane (observe/health.py): wear
+telemetry must observe without perturbing, count without approximating,
+forecast without drifting, and surface without flapping.
+
+Four checks:
+
+1. **Zero-perturbation**: a run with the wear census armed
+   (``health_every > 0``) is BYTE-identical to an unarmed run — per-
+   iteration losses, every fault-state leaf, and the non-health metric
+   records (timing fields excluded) all compare equal, on both the
+   single-solver and the config-stacked sweep paths. Arming health on
+   a live solver must leave the already-built train-step program
+   OBJECT-identical (the census is a separate jitted program), and
+   ``health_every=0`` must build nothing at all.
+2. **NumPy-oracle census**: the jitted census program over hand-built
+   small-integer states reproduces a pure-NumPy reimplementation for
+   all four fault processes — the clamp family's lifetime histogram /
+   broken fraction / stuck composition (endurance_stuck_at,
+   read_disturb, permanent_fault_map) and conductance_drift's age
+   distribution — integer stats bit-exact, float stats to 1e-6, on
+   both the flat and the config-stacked (sweep) layouts.
+3. **Planted-cliff RUL**: a fabricated census stream with a linear
+   broken-fraction ramp must forecast the threshold crossing exactly
+   ("trend" is least-squares over the ramp), and a single census must
+   fall back to the histogram-bin worst case ("bin").
+4. **Fleet rollup + wear_cliff lifecycle**: a framework-free
+   FleetController over fabricated worker rows publishes the
+   ``rram_health_*`` gauges in metrics.prom, ``caffe fleet top``
+   renders the wear line, and the ``wear_cliff`` alert FIRES after
+   two breaching beats, RESOLVES after two clear beats, and stays
+   silent on a fleet with no wear telemetry (the reporting-workers
+   gate).
+
+    python scripts/check_health_telemetry.py
+
+Exit status: 0 = all hold, 1 = any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ITERS = 12
+EVERY = 3
+N_CONFIGS = 3
+
+NET = """
+name: "HealthNet"
+layer { name: "data" type: "Input" top: "data" top: "target"
+  input_param { shape { dim: 8 dim: 6 } shape { dim: 8 dim: 2 } } }
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.5 }
+    bias_filler { type: "constant" value: 0.1 } } }
+layer { name: "relu1" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 2
+    weight_filler { type: "gaussian" std: 0.5 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc2"
+  bottom: "target" top: "loss" }
+"""
+
+#: record fields that legitimately differ between two identical runs
+TIMING_FIELDS = ("wall_time", "step_latency_s", "iters_per_s")
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def _solver(prefix: str, sink=None):
+    import numpy as np
+    from google.protobuf import text_format
+    from rram_caffe_simulation_tpu.proto import pb
+    from rram_caffe_simulation_tpu.solver import Solver
+
+    sp = pb.SolverParameter()
+    text_format.Parse(NET, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 10 ** 6
+    sp.display = 1
+    sp.random_seed = 7
+    sp.snapshot_prefix = prefix
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = 900.0
+    sp.failure_pattern.std = 150.0
+    rng = np.random.RandomState(3)
+    data = rng.randn(8, 6).astype(np.float32)
+    target = rng.randn(8, 2).astype(np.float32)
+    s = Solver(sp, train_feed=lambda: {"data": data, "target": target},
+               tile_spec="2x2")
+    if sink is not None:
+        s.enable_metrics(sink)
+    return s
+
+
+def _fault_bytes(tree):
+    import jax
+    import numpy as np
+    flat, _ = jax.tree.flatten(tree)
+    return [np.asarray(v).tobytes() for v in flat]
+
+
+def _strip_timing(records):
+    out = []
+    for r in records:
+        if r.get("type") == "health":
+            continue
+        out.append({k: v for k, v in r.items()
+                    if k not in TIMING_FIELDS})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. zero-perturbation
+
+
+def check_zero_perturbation(failures, work):
+    import numpy as np
+
+    # --- single solver, armed vs unarmed ---
+    sink_a, sink_b = ListSink(), ListSink()
+    sa = _solver(os.path.join(work, "zp_armed"), sink_a)
+    sb = _solver(os.path.join(work, "zp_plain"), sink_b)
+    sa.enable_health(EVERY)
+    # health_every=0 is an explicit disarm: nothing may be built
+    sb.enable_health(0)
+    for _ in range(ITERS):
+        sa.step(1)
+        sb.step(1)
+    if sb._health_census is not None or sb._health_ledger is not None:
+        failures.append("health_every=0 built census machinery")
+    la = [r.get("loss") for r in sink_a.records
+          if r.get("type") is None]
+    lb = [r.get("loss") for r in sink_b.records
+          if r.get("type") is None]
+    if la != lb:
+        failures.append(f"armed losses diverged: {la} vs {lb}")
+    if _fault_bytes(sa.fault_state) != _fault_bytes(sb.fault_state):
+        failures.append("armed fault state not byte-identical to "
+                        "unarmed")
+    if _strip_timing(sink_a.records) != _strip_timing(sink_b.records):
+        failures.append("armed non-health records differ from unarmed")
+    n_health = sum(1 for r in sink_a.records
+                   if r.get("type") == "health")
+    if n_health < 2:
+        failures.append(f"armed run emitted {n_health} health "
+                        "record(s); expected >= 2")
+    if sa.health_ledger is None or sa.health_ledger.summary() is None:
+        failures.append("armed solver ledger never saw a census")
+
+    # arming health on a LIVE solver must not rebuild the train step
+    sc = _solver(os.path.join(work, "zp_live"))
+    sc.step(1)
+    fn_before = sc._step_fn
+    if fn_before is None:
+        failures.append("no train-step program after step() "
+                        "(test harness assumption broke)")
+    sc.enable_health(EVERY)
+    sc.step(ITERS - 1)
+    if sc._step_fn is not fn_before:
+        failures.append("enable_health rebuilt the train-step program "
+                        "(census must be a separate jitted program)")
+
+    # --- sweep, armed vs unarmed ---
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    sink_c, sink_d = ListSink(), ListSink()
+    ra = SweepRunner(_solver(os.path.join(work, "zp_sw_a"), sink_c),
+                     n_configs=N_CONFIGS, health_every=EVERY)
+    rb = SweepRunner(_solver(os.path.join(work, "zp_sw_b"), sink_d),
+                     n_configs=N_CONFIGS)
+    la, lb = [], []
+    for _ in range(ITERS // 3):
+        loss_a, _ = ra.step(3, chunk=3)
+        loss_b, _ = rb.step(3, chunk=3)
+        la.append(np.asarray(loss_a))
+        lb.append(np.asarray(loss_b))
+    if np.stack(la).tobytes() != np.stack(lb).tobytes():
+        failures.append("sweep armed losses not byte-identical")
+    if _fault_bytes(ra.fault_states) != _fault_bytes(rb.fault_states):
+        failures.append("sweep armed fault states not byte-identical")
+    if _strip_timing(sink_c.records) != _strip_timing(sink_d.records):
+        failures.append("sweep armed non-health records differ")
+    h = [r for r in sink_c.records if r.get("type") == "health"]
+    if not h:
+        failures.append("armed sweep emitted no health records")
+    for rec in h:
+        if rec.get("lane_map") != list(range(N_CONFIGS)):
+            failures.append(f"sweep census lane_map {rec.get('lane_map')}"
+                            f" != identity over {N_CONFIGS} lanes")
+            break
+    if not failures:
+        print("zero-perturbation OK (solver + sweep byte-identical "
+              f"armed vs unarmed; {n_health} solver censuses, "
+              f"{len(h)} sweep censuses; train-step program untouched)")
+
+
+# ---------------------------------------------------------------------------
+# 2. NumPy-oracle census
+
+
+def _np_log_histogram(x, edges, axes):
+    import numpy as np
+    thresholds = [0.0] + [float(e) for e in edges]
+    idx = sum((x > t).astype(np.int32) for t in thresholds)
+    return np.stack(
+        [np.sum((idx == b).astype(np.int32), axis=axes)
+         for b in range(len(thresholds) + 1)], axis=-1)
+
+
+def _np_clamp_census(life, stuck, sls, edges, param_ndim):
+    import numpy as np
+    axes = (-2, -1) if param_ndim == 2 else (-1,)
+
+    def view(a, sl):
+        if sl is None or param_ndim != 2:
+            return a
+        r0, r1, c0, c1 = sl
+        return a[..., r0:r1, c0:c1]
+
+    hist, bfrac, lmean = [], [], []
+    s_neg, s_zero, s_pos = [], [], []
+    for sl in sls:
+        lt, st = view(life, sl), view(stuck, sl)
+        broken = lt <= 0
+        hist.append(_np_log_histogram(lt, edges, axes))
+        bfrac.append(np.mean(broken.astype(np.float32), axis=axes,
+                             dtype=np.float32))
+        lmean.append(np.mean(lt, axis=axes,
+                             dtype=np.float32).astype(np.float32))
+        s_neg.append(np.sum((broken & (st == -1.0)).astype(np.int32),
+                            axis=axes))
+        s_zero.append(np.sum((broken & (st == 0.0)).astype(np.int32),
+                             axis=axes))
+        s_pos.append(np.sum((broken & (st == 1.0)).astype(np.int32),
+                            axis=axes))
+    return {
+        "life_hist": np.stack(hist, axis=-2),
+        "broken_frac": np.stack(bfrac, axis=-1),
+        "life_mean": np.stack(lmean, axis=-1),
+        "stuck_neg": np.stack(s_neg, axis=-1),
+        "stuck_zero": np.stack(s_zero, axis=-1),
+        "stuck_pos": np.stack(s_pos, axis=-1),
+    }
+
+
+def _np_age_census(age, sls, edges, param_ndim):
+    import numpy as np
+    axes = (-2, -1) if param_ndim == 2 else (-1,)
+
+    def view(a, sl):
+        if sl is None or param_ndim != 2:
+            return a
+        r0, r1, c0, c1 = sl
+        return a[..., r0:r1, c0:c1]
+
+    hist, amean, amax = [], [], []
+    for sl in sls:
+        at = view(age, sl)
+        hist.append(_np_log_histogram(at, edges, axes))
+        amean.append(np.mean(at, axis=axes,
+                             dtype=np.float32).astype(np.float32))
+        amax.append(np.max(at, axis=axes).astype(np.float32))
+    return {
+        "age_hist": np.stack(hist, axis=-2),
+        "age_mean": np.stack(amean, axis=-1),
+        "age_max": np.stack(amax, axis=-1),
+    }
+
+
+def _compare_stats(failures, tag, got, want):
+    import numpy as np
+    for key in sorted(want):
+        if key not in got:
+            failures.append(f"{tag}: census missing stat {key!r}")
+            continue
+        g, w = np.asarray(got[key]), np.asarray(want[key])
+        if g.shape != w.shape:
+            failures.append(f"{tag}.{key}: shape {g.shape} != oracle "
+                            f"{w.shape}")
+        elif np.issubdtype(w.dtype, np.integer):
+            if not np.array_equal(g, w):
+                failures.append(f"{tag}.{key}: integer stats not "
+                                f"bit-exact\n{g}\nvs\n{w}")
+        elif not np.allclose(g, w, rtol=1e-6, atol=0):
+            failures.append(f"{tag}.{key}: float stats off by more "
+                            f"than 1e-6\n{g}\nvs\n{w}")
+
+
+def check_census_oracle(failures):
+    import numpy as np
+    from rram_caffe_simulation_tpu.fault import mapping
+    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    from rram_caffe_simulation_tpu.fault.processes import FaultSpec
+    from rram_caffe_simulation_tpu.observe.health import (
+        AGE_EDGES, LIFE_EDGES, CensusProgram)
+
+    rng = np.random.RandomState(11)
+    tiles = TileSpec.parse("2x2")
+    shape = (6, 6)
+    _, sls, _ = mapping.health_tiles(shape, tiles)
+
+    # small integers: every reduction is exact in f32 AND f64, so a
+    # NumPy mismatch is a real semantics bug, never rounding noise
+    life = rng.randint(-3, 200, size=shape).astype(np.float32)
+    stuck = rng.choice([-1.0, 0.0, 1.0], size=shape).astype(np.float32)
+    bias_life = rng.randint(-3, 200, size=(5,)).astype(np.float32)
+    bias_stuck = rng.choice([-1.0, 0.0, 1.0], size=(5,)).astype(
+        np.float32)
+
+    for spec in ("endurance_stuck_at", "read_disturb",
+                 "permanent_fault_map:fraction=0.05"):
+        stack = FaultSpec.parse(spec).build(tiles=tiles)
+        state = {"lifetimes": {"w/0": life, "w/1": bias_life},
+                 "stuck": {"w/0": stuck, "w/1": bias_stuck}}
+        got = CensusProgram(stack)(state)
+        _compare_stats(failures, f"{spec} w/0", got["w/0"],
+                       _np_clamp_census(life, stuck, sls, LIFE_EDGES,
+                                        2))
+        _compare_stats(failures, f"{spec} w/1", got["w/1"],
+                       _np_clamp_census(bias_life, bias_stuck, [None],
+                                        LIFE_EDGES, 1))
+        if got["w/0"]["grid"] != [2, 2] or got["w/1"]["grid"] != [1, 1]:
+            failures.append(f"{spec}: census grids wrong "
+                            f"({got['w/0']['grid']}, "
+                            f"{got['w/1']['grid']})")
+
+    # conductance_drift: the age distribution
+    age = rng.randint(0, 5000, size=shape).astype(np.float32)
+    rate = rng.rand(*shape).astype(np.float32)
+    stack = FaultSpec.parse("conductance_drift:nu=0.2").build(
+        tiles=tiles)
+    state = {"drift_age": {"w/0": age}, "drift_rate": {"w/0": rate}}
+    got = CensusProgram(stack)(state)
+    _compare_stats(failures, "conductance_drift w/0", got["w/0"],
+                   _np_age_census(age, sls, AGE_EDGES, 2))
+
+    # the config-stacked (sweep) layout: a leading config axis on
+    # every leaf must yield per-config stat vectors
+    life_c = rng.randint(-3, 200, size=(N_CONFIGS,) + shape).astype(
+        np.float32)
+    stuck_c = rng.choice([-1.0, 0.0, 1.0],
+                         size=(N_CONFIGS,) + shape).astype(np.float32)
+    stack = FaultSpec.parse("endurance_stuck_at").build(tiles=tiles)
+    got = CensusProgram(stack, stacked=True)(
+        {"lifetimes": {"w/0": life_c}, "stuck": {"w/0": stuck_c}})
+    _compare_stats(failures, "stacked endurance w/0", got["w/0"],
+                   _np_clamp_census(life_c, stuck_c, sls, LIFE_EDGES,
+                                    2))
+    if not failures:
+        print("NumPy-oracle census OK (endurance_stuck_at, "
+              "read_disturb, permanent_fault_map, conductance_drift; "
+              "flat + config-stacked layouts)")
+
+
+# ---------------------------------------------------------------------------
+# 3. planted-cliff RUL
+
+
+def check_planted_cliff(failures):
+    from rram_caffe_simulation_tpu.observe.health import (LIFE_EDGES,
+                                                          HealthLedger)
+
+    every, slope, dec = 50, 0.0005, 100.0
+    led = HealthLedger(threshold=0.3)
+    for it in range(every, 501, every):
+        led.update({
+            "type": "health", "iter": it, "every": every,
+            "decrement": dec, "life_edges": list(LIFE_EDGES),
+            "params": {"fc/0": {
+                "grid": [1, 1], "cells": [100],
+                "broken_frac": [slope * it],
+                "life_mean": [1e6 - dec * it]}}})
+    rows = led.forecast()
+    if len(rows) != 1:
+        failures.append(f"planted cliff: {len(rows)} forecast rows, "
+                        "expected 1")
+        return
+    r = rows[0]
+    true_cross = 0.3 / slope          # iteration 600
+    projected = r["iter"] + (r["rul_iters"] or 0.0)
+    if r["method"] != "trend":
+        failures.append(f"planted cliff: method {r['method']!r}, "
+                        "expected 'trend'")
+    # least squares over an exactly linear ramp: the projection must
+    # land on the true crossing well inside one census interval
+    if abs(projected - true_cross) > every:
+        failures.append(
+            f"planted cliff: projected crossing {projected:g} not "
+            f"within one census interval of the true {true_cross:g}")
+    if abs(projected - true_cross) > 1e-3:
+        failures.append(
+            f"planted cliff: linear ramp should project exactly "
+            f"(got {projected:g}, true {true_cross:g})")
+    if abs(r["write_rate"] - 1.0) > 1e-6:
+        failures.append(f"planted cliff: write_rate {r['write_rate']:g}"
+                        " != 1.0 (life_mean fell one quantum/iter)")
+
+    # single census: the histogram-bin worst case. 40% of cells inside
+    # the first finite bin (0, 1e2] -> cum > 0.3 at bin 1 -> the bin's
+    # LOWER edge is edges[0]=1e2 -> RUL = 1e2 / decrement
+    led2 = HealthLedger(threshold=0.3)
+    led2.update({
+        "type": "health", "iter": 100, "every": 100,
+        "decrement": dec, "life_edges": list(LIFE_EDGES),
+        "params": {"fc/0": {
+            "grid": [1, 1], "cells": [100],
+            "life_hist": [[0, 40, 10, 50, 0, 0, 0, 0, 0]],
+            "broken_frac": [0.0],
+            "life_mean": [5000.0]}}})
+    r2 = led2.forecast()[0]
+    if r2["method"] != "bin":
+        failures.append(f"single census: method {r2['method']!r}, "
+                        "expected 'bin'")
+    want = LIFE_EDGES[0] / dec
+    if r2["rul_iters"] != want:
+        failures.append(f"single census: bin RUL {r2['rul_iters']} "
+                        f"!= {want}")
+    if not failures:
+        print("planted-cliff RUL OK (trend projection exact on the "
+              "linear ramp; single-census bin fallback)")
+
+
+# ---------------------------------------------------------------------------
+# 4. fleet rollup + wear_cliff lifecycle
+
+
+def _health_stats(bf, rul):
+    return {"health": {"censuses": 4, "configs": 2, "tiles": 8,
+                       "broken_frac_max": bf, "wear_rate_max": 1e-4,
+                       "rul_iters_min": rul}}
+
+
+def check_fleet_rollup(failures, work):
+    from rram_caffe_simulation_tpu.observe import schema
+    from rram_caffe_simulation_tpu.observe.metrics_registry import (
+        parse_exposition, validate_exposition)
+    from rram_caffe_simulation_tpu.serve.fleet import WorkerTable
+    from rram_caffe_simulation_tpu.serve.fleet.controller import (
+        FleetController)
+    from rram_caffe_simulation_tpu.serve.fleet import top as fleet_top
+
+    fleet = os.path.join(work, "fleet")
+    ctl = FleetController(fleet, heartbeat_timeout_s=1e6,
+                          poll_interval_s=0.0, scrape_sockets=False)
+    table = WorkerTable(fleet)
+    base = {"lanes": 4, "occupied_lanes": 4, "pending_configs": 0,
+            "steps_per_sec": 10.0, "swap_count": 0,
+            "pinned": {"process": "endurance_stuck_at"}, "stats": {}}
+
+    def beat(stats):
+        table.heartbeat("w0", {"stats": stats})
+        return ctl.beat()
+
+    # no wear telemetry: the gate must keep wear_cliff silent even
+    # though health_broken_frac_max is absent every beat
+    table.register("w0", dict(base))
+    for _ in range(4):
+        summary = beat({})
+        if "wear_cliff" in summary["firing"]:
+            failures.append("wear_cliff fired on a fleet with no "
+                            "wear telemetry")
+    rollup = open(os.path.join(fleet, "metrics.prom")).read()
+    samples = parse_exposition(rollup)
+    if samples.get(("rram_health_reporting_workers", ())) != 0:
+        failures.append("rram_health_reporting_workers != 0 on a "
+                        "health-disabled fleet")
+    if ("rram_health_broken_frac_max", ()) in samples:
+        failures.append("rram_health_broken_frac_max published with "
+                        "no reporting workers")
+
+    # healthy wear telemetry: gauges publish, alert stays clear
+    beat(_health_stats(0.08, 9000.0))
+    rollup = open(os.path.join(fleet, "metrics.prom")).read()
+    errs = validate_exposition(rollup)
+    if errs:
+        failures.append(f"rollup exposition invalid: {errs}")
+    samples = parse_exposition(rollup)
+    checks = {
+        ("rram_health_reporting_workers", ()): 1.0,
+        ("rram_health_broken_frac_max", ()): 0.08,
+        ("rram_health_rul_iters_min", ()): 9000.0,
+    }
+    for key, want in checks.items():
+        if samples.get(key) != want:
+            failures.append(f"rollup {key[0]} = {samples.get(key)}, "
+                            f"expected {want}")
+    wkey = ("rram_worker_health_broken_frac_max",
+            (("worker", "w0"),))
+    if samples.get(wkey) != 0.08:
+        failures.append("per-worker wear gauge missing from rollup")
+
+    # the fleet-top frame must render the wear plane
+    frame = fleet_top.render_frame(fleet, samples,
+                                   table.rows(), now=0.0)
+    if "wear: worst tile" not in frame or "WEAR" not in frame:
+        failures.append("caffe fleet top frame lacks the wear line / "
+                        f"WEAR column:\n{frame}")
+
+    # cliff: two breaching beats fire, two clear beats resolve
+    for _ in range(2):
+        summary = beat(_health_stats(0.45, 40.0))
+    if "wear_cliff" not in summary["firing"]:
+        failures.append("wear_cliff did not fire after 2 breaching "
+                        "beats")
+    for _ in range(2):
+        summary = beat(_health_stats(0.05, 8000.0))
+    if "wear_cliff" in summary["firing"]:
+        failures.append("wear_cliff did not resolve after 2 clear "
+                        "beats")
+    events = []
+    with open(os.path.join(fleet, "fleet.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("type") != "alert":
+                continue
+            errs = schema.validate_record(rec)
+            if errs:
+                failures.append(f"alert record invalid: {errs}")
+            if rec.get("alert") == "wear_cliff":
+                events.append(rec.get("event"))
+    if events != ["firing", "resolved"]:
+        failures.append(f"wear_cliff transitions {events}, expected "
+                        "['firing', 'resolved']")
+    if not failures:
+        print("fleet rollup + wear_cliff OK (gauges published, top "
+              "frame renders wear, alert fired and resolved, "
+              "no-telemetry fleet stayed silent)")
+
+
+def main() -> int:
+    failures = []
+    work = tempfile.mkdtemp(prefix="health_telemetry_")
+
+    check_zero_perturbation(failures, work)
+    check_census_oracle(failures)
+    check_planted_cliff(failures)
+    check_fleet_rollup(failures, work)
+
+    if failures:
+        print("\nHEALTH TELEMETRY GUARD FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("health telemetry guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -310,6 +310,42 @@ SAMPLE_BAD_ALERT = {
     "severity": "shrug",                          # value, for_beats<1,
 }                                                 # unknown severity
 
+# crossbar wear census (observe/health.py CensusProgram →
+# schema.py HEALTH_FIELDS): per-(param, tile) remaining-lifetime
+# histograms over the fixed log-spaced bins plus the clamp family's
+# wear composition; a sweep record stacks a leading config axis on
+# every stat and carries lane_map
+SAMPLE_GOOD_HEALTH = {
+    "schema_version": 1, "type": "health", "iter": 400,
+    "wall_time": 1722700000.0, "every": 200, "decrement": 100.0,
+    "process": "endurance_stuck_at", "tiles": "2x2",
+    "life_edges": [1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8],
+    "params": {"fc1/0": {
+        "grid": [2, 2], "cells": [64, 64, 64, 64],
+        "life_hist": [[3, 0, 1, 60, 0, 0, 0, 0, 0],
+                      [0, 0, 0, 64, 0, 0, 0, 0, 0],
+                      [1, 0, 2, 61, 0, 0, 0, 0, 0],
+                      [0, 0, 0, 64, 0, 0, 0, 0, 0]],
+        "broken_frac": [0.046875, 0.0, 0.015625, 0.0],
+        "life_mean": [812.5, 900.0, 871.0, 904.1],
+        "stuck_neg": [1, 0, 0, 0], "stuck_zero": [2, 0, 1, 0],
+        "stuck_pos": [0, 0, 0, 0]}},
+}
+
+SAMPLE_BAD_HEALTH = {
+    "schema_version": 1, "type": "health", "iter": 400,
+    "wall_time": 1722700000.0, "every": 0,        # every < 1
+    "decrement": -1.0, "process": "",             # bad quantum, empty
+    "life_edges": [],                             # spec, empty edges
+    "lane_map": [0, -2],                          # -2 not a config id
+    "params": {"fc1/0": {
+        "grid": [2],                              # not [rows, cols]
+        "cells": [],                              # empty cell counts
+        "broken_frac": 0.1,                       # not a list
+        "mystery_stat": [1.0]},                   # unknown census stat
+        "fc2/0": "worn"},                         # entry not an object
+}
+
 # Prometheus/OpenMetrics text exposition (observe/metrics_registry.py):
 # what the `metrics` socket op and the controller's metrics.prom rollup
 # emit — validated by validate_exposition, not the record schema
@@ -390,7 +426,8 @@ def main(argv=None) -> int:
                           ("debug_trace", SAMPLE_GOOD_DEBUG),
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP),
-                          ("alert", SAMPLE_GOOD_ALERT)):
+                          ("alert", SAMPLE_GOOD_ALERT),
+                          ("health", SAMPLE_GOOD_HEALTH)):
             errs = schema.validate_record(rec)
             if errs:
                 print(f"good {name} sample REJECTED by its own schema:")
@@ -409,7 +446,8 @@ def main(argv=None) -> int:
                           ("debug_trace", SAMPLE_BAD_DEBUG),
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP),
-                          ("alert", SAMPLE_BAD_ALERT)):
+                          ("alert", SAMPLE_BAD_ALERT),
+                          ("health", SAMPLE_BAD_HEALTH)):
             errs = schema.validate_record(rec)
             if not errs:
                 print(f"known-bad {name} sample PASSED validation "
@@ -429,8 +467,8 @@ def main(argv=None) -> int:
                   "(exposition validator lost its teeth)")
             return 1
         n_bad += len(expo_bad)
-        print("sample self-check OK (13 good records + 1 exposition "
-              f"accepted, 13 bad records + 1 bad exposition produced "
+        print("sample self-check OK (14 good records + 1 exposition "
+              f"accepted, 14 bad records + 1 bad exposition produced "
               f"{n_bad} violations)")
         return 0
     if not args.files:

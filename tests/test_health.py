@@ -1,0 +1,261 @@
+"""Crossbar health plane (observe/health.py): the wear census vs a
+NumPy oracle, the HealthLedger's RUL forecasting, health-record schema
+validation, and the summarize digests over mixed metric streams."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rram_caffe_simulation_tpu.fault.mapping import TileSpec, health_tiles
+from rram_caffe_simulation_tpu.fault.processes import FaultSpec
+from rram_caffe_simulation_tpu.observe.health import (
+    LIFE_EDGES, CensusProgram, HealthLedger)
+from rram_caffe_simulation_tpu.observe.schema import validate_record
+
+
+def _np_log_histogram(x, edges, axes):
+    thresholds = [0.0] + [float(e) for e in edges]
+    idx = sum((x > t).astype(np.int32) for t in thresholds)
+    return np.stack(
+        [np.sum((idx == b).astype(np.int32), axis=axes)
+         for b in range(len(thresholds) + 1)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# census vs NumPy oracle
+
+
+def test_census_matches_numpy_oracle():
+    """The jitted census over a hand-built small-integer clamp state
+    reproduces pure NumPy: integer histograms/counts bit-exact, float
+    means to 1e-6, with the 2x2 tile geometry of health_tiles."""
+    rng = np.random.RandomState(5)
+    tiles = TileSpec.parse("2x2")
+    shape = (6, 4)
+    life = rng.randint(-2, 120, size=shape).astype(np.float32)
+    stuck = rng.choice([-1.0, 0.0, 1.0], size=shape).astype(np.float32)
+    stack = FaultSpec.parse("endurance_stuck_at").build(tiles=tiles)
+    got = CensusProgram(stack)(
+        {"lifetimes": {"w/0": life}, "stuck": {"w/0": stuck}})["w/0"]
+
+    _, sls, _ = health_tiles(shape, tiles)
+    assert got["grid"] == [2, 2] and len(sls) == 4
+    broken = life <= 0
+    for t, (r0, r1, c0, c1) in enumerate(sls):
+        lt = life[r0:r1, c0:c1]
+        st = stuck[r0:r1, c0:c1]
+        bt = broken[r0:r1, c0:c1]
+        assert np.array_equal(
+            np.asarray(got["life_hist"])[t],
+            _np_log_histogram(lt, LIFE_EDGES, (-2, -1)))
+        assert np.asarray(got["broken_frac"])[t] == pytest.approx(
+            bt.mean(), abs=1e-6)
+        assert np.asarray(got["life_mean"])[t] == pytest.approx(
+            lt.mean(), rel=1e-6)
+        assert np.asarray(got["stuck_zero"])[t] == \
+            int((bt & (st == 0.0)).sum())
+        assert np.asarray(got["stuck_neg"])[t] == \
+            int((bt & (st == -1.0)).sum())
+        assert np.asarray(got["stuck_pos"])[t] == \
+            int((bt & (st == 1.0)).sum())
+
+
+def test_census_stacked_config_axis():
+    """stacked=True (the sweep layout): a leading config axis on every
+    leaf yields per-config stat vectors — trailing tile axis, config
+    axis first, and each config's slice equals its own flat census."""
+    rng = np.random.RandomState(9)
+    tiles = TileSpec.parse("2x2")
+    n_cfg, shape = 3, (4, 4)
+    life = rng.randint(-2, 80, size=(n_cfg,) + shape).astype(np.float32)
+    stuck = rng.choice([-1.0, 0.0, 1.0],
+                       size=(n_cfg,) + shape).astype(np.float32)
+    stack = FaultSpec.parse("endurance_stuck_at").build(tiles=tiles)
+    got = CensusProgram(stack, stacked=True)(
+        {"lifetimes": {"w/0": life}, "stuck": {"w/0": stuck}})["w/0"]
+    assert np.asarray(got["broken_frac"]).shape == (n_cfg, 4)
+    assert np.asarray(got["life_hist"]).shape == \
+        (n_cfg, 4, len(LIFE_EDGES) + 2)
+    flat = CensusProgram(stack)(
+        {"lifetimes": {"w/0": life[1]}, "stuck": {"w/0": stuck[1]}})
+    assert np.array_equal(np.asarray(got["life_hist"])[1],
+                          np.asarray(flat["w/0"]["life_hist"]))
+    assert np.allclose(np.asarray(got["broken_frac"])[1],
+                       np.asarray(flat["w/0"]["broken_frac"]))
+
+
+# ---------------------------------------------------------------------------
+# HealthLedger forecasting
+
+
+def _census(it, bf, life_mean, every=50, hist=None):
+    params = {"fc/0": {"grid": [1, 1], "cells": [100],
+                       "broken_frac": [bf], "life_mean": [life_mean]}}
+    if hist is not None:
+        params["fc/0"]["life_hist"] = [hist]
+    return {"type": "health", "iter": it, "every": every,
+            "decrement": 100.0, "life_edges": list(LIFE_EDGES),
+            "params": params}
+
+
+def test_ledger_trend_forecast_exact_on_linear_ramp():
+    """A linear broken_frac ramp projects the threshold crossing
+    exactly (least squares is exact on a line), and the falling
+    life_mean recovers the write rate in quanta/cell/iter."""
+    led = HealthLedger(threshold=0.3)
+    for it in range(50, 501, 50):
+        led.update(_census(it, 0.0005 * it, 1e6 - 100.0 * it))
+    (row,) = led.forecast()
+    assert row["method"] == "trend"
+    # true crossing: 0.3 / 0.0005 = iteration 600, last census at 500
+    assert row["iter"] + row["rul_iters"] == pytest.approx(600.0,
+                                                           abs=1e-3)
+    assert row["write_rate"] == pytest.approx(1.0)
+    s = led.summary()
+    assert s["censuses"] == 10 and s["tiles"] == 1
+    assert s["rul_iters_min"] == pytest.approx(100.0, abs=1e-3)
+
+
+def test_ledger_bin_fallback_single_census():
+    """One census has no trend: RUL falls back to the lifetime
+    histogram — the lower edge of the bin where the cumulative broken
+    fraction crosses the threshold, divided by the write quantum."""
+    led = HealthLedger(threshold=0.3)
+    hist = [0, 40, 10, 50, 0, 0, 0, 0, 0]   # 40% inside (0, 1e2]
+    led.update(_census(100, 0.0, 5000.0, every=100, hist=hist))
+    (row,) = led.forecast()
+    assert row["method"] == "bin"
+    assert row["rul_iters"] == LIFE_EDGES[0] / 100.0
+    # already past the cliff: RUL is zero, not negative
+    led2 = HealthLedger(threshold=0.3)
+    led2.update(_census(100, 0.45, 5000.0, every=100))
+    (row2,) = led2.forecast()
+    assert row2["rul_iters"] == 0.0
+
+
+def test_ledger_dedups_replayed_census():
+    """Restore replays the checkpoint-iteration census; the ledger
+    keeps one sample per (series, iter), so the trend is the two-point
+    line (100, 0.01)-(150, 0.02), not a double-counted triangle."""
+    led = HealthLedger(threshold=0.3)
+    led.update(_census(100, 0.01, 9000.0))
+    led.update(_census(100, 0.01, 9000.0))
+    led.update(_census(150, 0.02, 8000.0))
+    (row,) = led.forecast()
+    assert row["method"] == "trend"
+    # slope 2e-4/iter from bf 0.02 -> cliff 0.3 in exactly 1400 iters
+    assert row["rul_iters"] == pytest.approx(1400.0, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# schema
+
+
+def _good_health_record():
+    return {
+        "schema_version": 1, "type": "health", "iter": 400,
+        "wall_time": 1722700000.0, "every": 200, "decrement": 100.0,
+        "process": "endurance_stuck_at", "tiles": "2x2",
+        "life_edges": list(LIFE_EDGES),
+        "params": {"fc1/0": {
+            "grid": [2, 2], "cells": [64, 64, 64, 64],
+            "life_hist": [[0, 1, 2, 61, 0, 0, 0, 0, 0]] * 4,
+            "broken_frac": [0.0, 0.015625, 0.0, 0.0],
+            "life_mean": [151.2, 148.9, 150.1, 149.7],
+            "stuck_zero": [0, 1, 0, 0]}}}
+
+
+def test_health_record_schema_good_and_bad():
+    assert validate_record(_good_health_record()) == []
+    bad = _good_health_record()
+    bad["every"] = 0
+    bad["decrement"] = -1.0
+    bad["life_edges"] = []
+    bad["params"]["fc1/0"]["grid"] = [2]
+    bad["params"]["fc1/0"]["mystery_stat"] = [1.0]
+    errs = validate_record(bad)
+    assert any("every" in e for e in errs)
+    assert any("decrement" in e for e in errs)
+    assert any("life_edges" in e for e in errs)
+    assert any("grid" in e for e in errs)
+    assert any("mystery_stat" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# summarize over mixed streams (health + alert + span + metrics)
+
+
+def _mixed_stream(proc):
+    recs = [
+        {"iter": 0, "wall_time": 1.0, "loss": 2.0},
+        {"iter": 200, "wall_time": 2.0, "loss": 1.5},
+        dict(_good_health_record(), iter=200),
+        dict(_good_health_record(), iter=400),
+        {"schema_version": 1, "type": "alert", "iter": 3,
+         "wall_time": 2.5, "alert": "wear_cliff", "event": "firing",
+         "metric": "rram_health_broken_frac_max", "value": 0.45,
+         "threshold": 0.3},
+        {"schema_version": 1, "type": "alert", "iter": 6,
+         "wall_time": 3.0, "alert": "wear_cliff", "event": "resolved"},
+    ]
+    # span records are process-LOCAL: each replica carries its own
+    recs.append({"schema_version": 1, "type": "span", "iter": 200,
+                 "wall_time": 2.0, "name": "census", "cat": "health",
+                 "kind": "span", "dur_s": 0.01, "thread": "main",
+                 "process": proc})
+    return recs
+
+
+def test_summarize_mixed_streams_digest_and_replica_collapse(tmp_path):
+    """summarize over pod replicas of a stream that interleaves
+    metrics, health censuses, alert transitions, and spans: replicas
+    collapse to one canonical copy (no double-counted censuses), and
+    the digest carries the health rollup and the alert transitions."""
+    from rram_caffe_simulation_tpu.tools.summarize import (
+        summarize_health, summarize_metrics)
+    paths = []
+    for proc in (0, 1):
+        p = tmp_path / f"run.p{proc}.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n"
+                             for r in _mixed_stream(proc)))
+        paths.append(str(p))
+    digest = summarize_metrics(paths)
+    assert "merged 2 process replicas" in digest
+    assert "Health censuses: 2" in digest
+    assert "worst broken_frac" in digest
+    assert "Alert transitions (2): 1 firing, 1 resolved" in digest
+    assert "still firing" not in digest     # resolved closed it out
+
+    forecast = summarize_health(paths)
+    assert "Census records: 2 (iter 200 .. 400, every 200 iters)" \
+        in forecast
+    assert "Fault process: endurance_stuck_at" in forecast
+    assert "RUL ITERS" in forecast and "fc1/0" in forecast
+    assert "METHOD" in forecast
+
+
+def test_summarize_mixed_streams_still_firing(tmp_path):
+    """An alert with no resolving transition is called out."""
+    from rram_caffe_simulation_tpu.tools.summarize import (
+        summarize_metrics)
+    recs = _mixed_stream(0)[:-2]            # drop resolved + span
+    p = tmp_path / "run.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    digest = summarize_metrics(str(p))
+    assert "still firing at stream end: wear_cliff" in digest
+
+
+def test_summarize_health_empty_stream(tmp_path):
+    """A metrics stream with no census records gets the arming hint,
+    not a crash or an empty table."""
+    from rram_caffe_simulation_tpu.tools.summarize import (
+        summarize_health)
+    p = tmp_path / "run.jsonl"
+    p.write_text(json.dumps({"iter": 0, "wall_time": 1.0,
+                             "loss": 2.0}) + "\n")
+    out = summarize_health(str(p))
+    assert "no health census records" in out
+    assert "health_every > 0" in out
