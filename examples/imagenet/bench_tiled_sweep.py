@@ -1,17 +1,31 @@
 """ImageNet-resolution tiled-crossbar fault sweep bench (ROADMAP item 1
-deliverable / ISSUE 11 acceptance): a VGG-class FC layer at 224x224
-input resolution, its weight matrix split across multiple physical
-crossbar tiles (fault/mapping.py), trained as a config-SHARDED
-Monte-Carlo fault sweep with the per-tile fault census flowing through
-the observe schema.
+deliverable / ISSUE 11 acceptance; ISSUE 18 adds the conv row): a
+VGG-class layer at 224x224 input resolution, its weight split across
+multiple physical crossbar tiles (fault/mapping.py), trained as a
+config-SHARDED Monte-Carlo fault sweep with the per-tile fault census
+flowing through the observe schema.
 
-The net is a deliberately small VGG-shaped head — one strided conv +
-pool feeding an fc6-style InnerProduct — so the bench runs anywhere,
-but the LAYER is the real thing: 224x224x3 input, an FC crossbar
-bigger than one physical array (stored (512, 784); under the default
-``cells=256x256`` mapping that is a 2x4 = 8-tile grid, each tile with
-its own independent fault draw and its own ADC on the analog partial
-sums). The sweep's config axis lays over every visible device
+Two nets, picked by ``--net``:
+
+``vgg-fc`` (default) — one strided conv + pool feeding an fc6-style
+InnerProduct, so the bench runs anywhere, but the LAYER is the real
+thing: 224x224x3 input, an FC crossbar bigger than one physical array
+(stored (512, 784); under the default ``cells=256x256`` mapping that
+is a 2x4 = 8-tile grid, each tile with its own independent fault draw
+and its own ADC on the analog partial sums).
+
+``vgg-conv`` (ISSUE 18) — a conv stack with EVERY weight on a crossbar
+(``failure_pattern { conv_also: true }``): conv1 8x8/8 and conv2 3x3
+kernels mapped over their im2col (C*kh*kw, C_out) views (under the
+conv default ``cells=128x128``: conv1 view 192x16 -> 2x1 grid, conv2
+view 144x32 -> 2x1 grid) plus an FC head. The conv im2col GEMM is
+timed BOTH ways on the jax engine — ``premat`` (patches materialized
+once, default) and ``tilewise`` (K-slabs extracted inside the tile
+loop, RRAM_CONV_IM2COL=tilewise) — and the row records the resolved
+engine / fused-epilogue state and the runner's ``bytes_per_step_est``
+HBM floor.
+
+The sweep's config axis lays over every visible device
 (``TILED_BENCH_MESH``, default ``config=all``) as ONE GSPMD program —
 the PR 9 pod path — and metrics records carry ``fault.per_tile``
 (schema-validated here before the row is printed).
@@ -22,13 +36,16 @@ Environment knobs:
   TILED_BENCH_STEPS     timed steps (default 30)
   TILED_BENCH_CHUNK     scan chunk (default 10)
   TILED_BENCH_BATCH     images per step per config (default 8)
-  TILED_BENCH_TILES     TileSpec (default cells=256x256)
+  TILED_BENCH_TILES     TileSpec (default cells=256x256;
+                        vgg-conv default cells=128x128)
   TILED_BENCH_MESH      mesh spec (default config=all; '' = no mesh)
+  TILED_BENCH_ENGINE    sweep engine, "jax" | "pallas" (default jax)
   TILED_BENCH_DEVICES   on CPU hosts: force N virtual devices
                         (default 4; set before JAX initializes)
 
 Prints exactly ONE JSON line on stdout.
 """
+import argparse
 import json
 import os
 import sys
@@ -53,10 +70,10 @@ N_CONFIGS = int(os.environ.get("TILED_BENCH_CONFIGS", "8"))
 STEPS = int(os.environ.get("TILED_BENCH_STEPS", "30"))
 CHUNK = int(os.environ.get("TILED_BENCH_CHUNK", "10"))
 BATCH = int(os.environ.get("TILED_BENCH_BATCH", "8"))
-TILES = os.environ.get("TILED_BENCH_TILES", "cells=256x256")
 MESH = os.environ.get("TILED_BENCH_MESH", "config=all")
+ENGINE = os.environ.get("TILED_BENCH_ENGINE", "jax")
 
-NET = """
+NET_FC = """
 name: "VGGTiledHead"
 layer { name: "data" type: "Input" top: "data" top: "label"
   input_param { shape { dim: %(batch)d dim: 3 dim: 224 dim: 224 }
@@ -81,72 +98,132 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "fc7"
   bottom: "label" top: "loss" }
 """
 
+# the ISSUE 18 conv row: every weight on a crossbar (conv_also), the
+# conv kernels tiled over their im2col views
+NET_CONV = """
+name: "VGGTiledConv"
+layer { name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: %(batch)d dim: 3 dim: 224 dim: 224 }
+                shape { dim: %(batch)d dim: 10 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 16 kernel_size: 8 stride: 8
+    weight_filler { type: "gaussian" std: 0.01 }
+    bias_filler { type: "constant" value: 0 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "conv2" type: "Convolution" bottom: "conv1" top: "conv2"
+  convolution_param { num_output: 32 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.02 }
+    bias_filler { type: "constant" value: 0 } } }
+layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 4 stride: 4 } }
+layer { name: "fc6" type: "InnerProduct" bottom: "pool2" top: "fc6"
+  inner_product_param { num_output: 128
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.1 } } }
+layer { name: "relu6" type: "ReLU" bottom: "fc6" top: "fc6" }
+layer { name: "fc7" type: "InnerProduct" bottom: "fc6" top: "fc7"
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc7"
+  bottom: "label" top: "loss" }
+"""
+
+
+class _Sink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--net", choices=("vgg-fc", "vgg-conv"),
+                    default="vgg-fc",
+                    help="vgg-fc: tiled FC crossbar (ISSUE 11 row); "
+                         "vgg-conv: conv stack with every weight on a "
+                         "crossbar via im2col tiling (ISSUE 18 row)")
+    args = ap.parse_args()
+    conv_net = args.net == "vgg-conv"
+    tiles = os.environ.get("TILED_BENCH_TILES") or (
+        "cells=128x128" if conv_net else "cells=256x256")
+
     import numpy as np
     from google.protobuf import text_format
 
     import jax
 
-    from rram_caffe_simulation_tpu.fault.mapping import TileSpec
+    from rram_caffe_simulation_tpu.fault.mapping import (
+        TileSpec, crossbar_view_shape)
     from rram_caffe_simulation_tpu.observe import schema as obs_schema
     from rram_caffe_simulation_tpu.parallel import SweepRunner
     from rram_caffe_simulation_tpu.parallel.mesh import mesh_from_spec
     from rram_caffe_simulation_tpu.proto import pb
     from rram_caffe_simulation_tpu.solver import Solver
 
-    sp = pb.SolverParameter()
-    text_format.Parse(NET % {"batch": BATCH}, sp.net_param)
-    sp.base_lr = 0.0002   # stable on the random-data proxy batch
-    sp.lr_policy = "fixed"
-    sp.max_iter = 10 ** 9
-    sp.display = 0
-    sp.random_seed = 11
-    sp.snapshot_prefix = "/tmp/tiled_imagenet_bench"
-    # lifetimes sized so cells BREAK inside the timed window — the
-    # per-tile census then shows real spatial structure, not zeros
-    sp.failure_pattern.type = "gaussian"
-    sp.failure_pattern.mean = STEPS * 50.0
-    sp.failure_pattern.std = STEPS * 15.0
-    sp.rram_forward.sigma = 0.0
-    sp.rram_forward.adc_bits = 4     # the per-tile ADC width
-
     rng = np.random.RandomState(5)
     data = rng.randn(BATCH, 3, 224, 224).astype(np.float32)
     label = rng.randn(BATCH, 10).astype(np.float32)
-    solver = Solver(sp, train_feed=lambda: {"data": data,
-                                            "label": label},
-                    tile_spec=TILES)
 
-    class _Sink:
-        def __init__(self):
-            self.records = []
+    def build_solver():
+        sp = pb.SolverParameter()
+        text_format.Parse((NET_CONV if conv_net else NET_FC)
+                          % {"batch": BATCH}, sp.net_param)
+        sp.base_lr = 0.0002   # stable on the random-data proxy batch
+        sp.lr_policy = "fixed"
+        sp.max_iter = 10 ** 9
+        sp.display = 0
+        sp.random_seed = 11
+        sp.snapshot_prefix = "/tmp/tiled_imagenet_bench"
+        # lifetimes sized so cells BREAK inside the timed window — the
+        # per-tile census then shows real spatial structure, not zeros
+        sp.failure_pattern.type = "gaussian"
+        sp.failure_pattern.mean = STEPS * 50.0
+        sp.failure_pattern.std = STEPS * 15.0
+        if conv_net:
+            sp.failure_pattern.conv_also = True
+        sp.rram_forward.sigma = 0.0
+        sp.rram_forward.adc_bits = 4     # the per-tile ADC width
+        solver = Solver(sp, train_feed=lambda: {"data": data,
+                                                "label": label},
+                        tile_spec=tiles)
+        sink = _Sink()
+        solver.enable_metrics(sink)
+        sp.display = CHUNK   # records at chunk boundaries
+        return solver, sink
 
-        def write(self, rec):
-            self.records.append(rec)
+    def timed_run(solver):
+        """Compile + warm up, then time STEPS sweep iterations."""
+        mesh = mesh_from_spec(MESH) if MESH else None
+        t0 = time.perf_counter()
+        runner = SweepRunner(solver, n_configs=N_CONFIGS, mesh=mesh,
+                             pipeline_depth=0, engine=ENGINE)
+        runner.step(CHUNK, chunk=CHUNK)   # compile + warmup
+        jax.block_until_ready(runner.params)
+        setup_s = time.perf_counter() - t0
 
-    sink = _Sink()
-    solver.enable_metrics(sink)
-    sp.display = CHUNK   # records at chunk boundaries
+        t0 = time.perf_counter()
+        runner.step(STEPS, chunk=CHUNK)
+        jax.block_until_ready(runner.params)
+        dt = time.perf_counter() - t0
+        return runner, setup_s, dt
 
-    tspec = TileSpec.parse(TILES)
+    solver, sink = build_solver()
+    tspec = TileSpec.parse(tiles)
     flat = solver._flat(solver.params)
-    grids = {k: list(tspec.grid(v.shape))
-             for k, v in flat.items()
-             if k in solver._fault_keys and v.ndim == 2}
+    grids, views = {}, {}
+    for k, v in flat.items():
+        if k not in solver._fault_keys or v.ndim < 2:
+            continue
+        grids[k] = list(tspec.grid(v.shape))
+        if v.ndim > 2:
+            # conv kernels tile over their im2col (K, N) view
+            views[k] = list(crossbar_view_shape(v.shape))
 
-    mesh = mesh_from_spec(MESH) if MESH else None
-    t0 = time.perf_counter()
-    runner = SweepRunner(solver, n_configs=N_CONFIGS, mesh=mesh,
-                         pipeline_depth=0)
-    runner.step(CHUNK, chunk=CHUNK)   # compile + warmup
-    jax.block_until_ready(runner.params)
-    setup_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    runner.step(STEPS, chunk=CHUNK)
-    jax.block_until_ready(runner.params)
-    dt = time.perf_counter() - t0
+    runner, setup_s, dt = timed_run(solver)
 
     # the last fault-bearing record's per-tile census, schema-checked
     recs = [r for r in sink.records if "fault" in r]
@@ -164,33 +241,66 @@ def main():
             "broken_frac_mean": round(float(bf.mean()), 4),
             "broken_frac_max": round(float(bf.max()), 4),
         }
+        if "view" in e:
+            census[k]["im2col_view"] = (
+                np.asarray(e["view"]).reshape(-1, 2)[0].tolist())
     broken = runner.broken_fractions()
+    setup_rec = runner.setup_record(setup_s)
     n_chips = len(np.asarray(runner.mesh.devices).ravel())
     img_s = N_CONFIGS * BATCH * STEPS / dt
+    engine_resolved = runner.engine_resolved
+    fused = bool(runner.fused_epilogue_resolved)
     runner.close()
+
+    extra = {
+        "input_resolution": "3x224x224",
+        "net": args.net,
+        "tile_spec": tspec.canonical(),
+        "tile_grids": grids,
+        "per_tile_census_final": census,
+        "broken_fraction_mean": round(float(np.mean(broken)), 4),
+        "mesh": dict(runner.mesh.shape),
+        "chips": n_chips,
+        "n_configs": N_CONFIGS, "batch": BATCH,
+        "steps_timed": STEPS, "chunk": CHUNK,
+        "seconds": round(dt, 3),
+        "setup_seconds": round(setup_s, 1),
+        "configs_per_hour_aggregate": round(
+            N_CONFIGS * STEPS / dt * 3600.0 / 5000.0, 2),
+        "engine": engine_resolved,
+        "fused_epilogue": fused,
+        "bytes_per_step_est": setup_rec.get("bytes_per_step_est"),
+        "backend": jax.default_backend(),
+    }
+    if views:
+        extra["im2col_views"] = views
+    if conv_net:
+        # ISSUE 18 "measured both ways": re-trace the conv im2col GEMM
+        # with the K-slabs extracted inside the tile loop instead of a
+        # single pre-materialized patch matrix (jax engine only — the
+        # Pallas launch always consumes the pre-materialized operand)
+        extra["conv_im2col_mode"] = os.environ.get(
+            "RRAM_CONV_IM2COL", "premat")
+        if engine_resolved == "jax":
+            os.environ["RRAM_CONV_IM2COL"] = "tilewise"
+            try:
+                solver2, _ = build_solver()
+                runner2, _, dt2 = timed_run(solver2)
+                runner2.close()
+                extra["img_s_chip_tilewise"] = round(
+                    N_CONFIGS * BATCH * STEPS / dt2 / n_chips, 2)
+                extra["seconds_tilewise"] = round(dt2, 3)
+            finally:
+                os.environ.pop("RRAM_CONV_IM2COL", None)
 
     print(json.dumps({
         "metric": "images/sec/chip, ImageNet-resolution tiled-crossbar "
-                  f"fault sweep ({N_CONFIGS} configs config-sharded "
-                  f"over {n_chips} chips, tiles={tspec.canonical()})",
+                  f"fault sweep ({args.net}, {N_CONFIGS} configs "
+                  f"config-sharded over {n_chips} chips, "
+                  f"tiles={tspec.canonical()})",
         "value": round(img_s / n_chips, 2),
         "unit": "img/s/chip",
-        "extra": {
-            "input_resolution": "3x224x224",
-            "tile_spec": tspec.canonical(),
-            "tile_grids": grids,
-            "per_tile_census_final": census,
-            "broken_fraction_mean": round(float(np.mean(broken)), 4),
-            "mesh": dict(runner.mesh.shape),
-            "chips": n_chips,
-            "n_configs": N_CONFIGS, "batch": BATCH,
-            "steps_timed": STEPS, "chunk": CHUNK,
-            "seconds": round(dt, 3),
-            "setup_seconds": round(setup_s, 1),
-            "configs_per_hour_aggregate": round(
-                N_CONFIGS * STEPS / dt * 3600.0 / 5000.0, 2),
-            "backend": jax.default_backend(),
-        },
+        "extra": extra,
     }))
 
 

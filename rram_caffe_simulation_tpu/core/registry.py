@@ -91,8 +91,10 @@ class LayerContext:
     # is documented ONCE: the ENGINE MATRIX in fault/hw_aware.py.
     crossbar: Optional[dict] = None
     # Tiled crossbar mapping (fault/mapping.py, static): maps a
-    # fault-target layer name -> (tr, tc) tile cell dims over its
-    # STORED weight shape. A listed layer computes its matmul as
+    # fault-target layer name -> (tr, tc) tile cell dims — over the
+    # STORED weight shape for InnerProduct layers, over the im2col
+    # (C_in*kh*kw, C_out) weight VIEW for Convolution layers (ISSUE
+    # 18). A listed layer computes its matmul as
     # per-tile ADC-quantized partial sums accumulated across the
     # K-tile axis (adc_bits per tile instead of one whole-output ADC)
     # — on the pure path via hw_aware.tiled_crossbar_matmul, on the

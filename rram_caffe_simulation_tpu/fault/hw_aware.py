@@ -84,6 +84,16 @@ path's per-call `quantize_ste`). `tiled_crossbar_matmul` is the
 pure-path twin, used by the jax engine's layer path and the parity
 guard (scripts/check_tiled_mapping.py). `tiles=None` (the default 1x1
 spec) builds the exact historical kernels.
+
+Conv layers ride the SAME kernel through their im2col view (ISSUE 18):
+ops/vision.py lowers a tiled Convolution to patch rows (M = N*OH*OW,
+K = C_in*kh*kw) against the flattened (K, C_out) weight view and calls
+`crossbar_matmul` with the tile grid over that view — the operand is
+just another (M, K) matrix, so the config-batched launch, custom_vmap
+seam, shard_map dispatch, and per-lane seed words all carry over
+unchanged. The pure jax engine additionally offers a lazy operand mode
+(`tiled_crossbar_matmul_slabs`): per-K-tile patch-slab extraction
+inside the tile loop, bit-identical to the pre-materialized operand.
 """
 from __future__ import annotations
 
@@ -731,6 +741,44 @@ def tiled_crossbar_matmul(x, w_eff, bk: int, bn: int, adc_bits: int,
             acc = part if acc is None else acc + part
         cols.append(acc)
     y = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
+    return y[:m, :N]
+
+
+def tiled_crossbar_matmul_slabs(x_slab, w_eff, bk: int, bn: int,
+                                adc_bits: int, m: int,
+                                preferred_element_type=None):
+    """`tiled_crossbar_matmul` with a LAZY x operand: `x_slab(k0, k1)`
+    returns the (m, k1-k0) column slab of the conceptual (m, K) matrix
+    for K-rows [k0, k1), k1 clipped to K. The conv im2col path's
+    "tilewise" operand mode (ops/vision.py, RRAM_CONV_IM2COL): instead
+    of materializing the full patch matrix, each K-tile's patch slab is
+    extracted on demand inside the tile loop — lower peak memory, the
+    extraction repeated per K-tile instead of once.
+
+    Bit-identity contract with the premat twin: each slab is zero-padded
+    to the identical (bm, bk) block the premat path slices out of its
+    padded operand, the dots run K-tile-outer but accumulate into each
+    N-tile's accumulator in the same increasing-k0 order, and the
+    per-tile ADC sees the identical block bytes — so a slab function
+    whose values match the premat operand's columns yields bit-identical
+    output (guarded by tests/test_conv_tiles.py)."""
+    bk, bn = int(bk), int(bn)
+    K, N = w_eff.shape
+    m = int(m)
+    bm = _m_block(m)
+    wp = jnp.pad(w_eff, ((0, -K % bk), (0, -N % bn)))
+    Kp, Np = wp.shape
+    accs = [None] * (Np // bn)
+    for k0 in range(0, Kp, bk):
+        k1 = min(k0 + bk, K)
+        slab = jnp.pad(x_slab(k0, k1),
+                       ((0, bm - m), (0, bk - (k1 - k0))))
+        for j, n0 in enumerate(range(0, Np, bn)):
+            part = jnp.dot(slab, wp[k0:k0 + bk, n0:n0 + bn],
+                           preferred_element_type=preferred_element_type)
+            part = quantize_ste(part, int(adc_bits))
+            accs[j] = part if accs[j] is None else accs[j] + part
+    y = accs[0] if len(accs) == 1 else jnp.concatenate(accs, axis=1)
     return y[:m, :N]
 
 

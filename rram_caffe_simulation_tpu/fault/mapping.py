@@ -47,11 +47,17 @@ Spec syntax (canonical forms shown):
   at most R x C cells, the CIM-Explorer array-size axis; the per-layer
   tile GRID is auto-derived as (ceil(d0/R), ceil(d1/C)).
 
-Tiles are defined over the STORED 2-D weight shape (Caffe layout); the
-consuming layer maps them onto the crossbar (K, N) view through its
-own `transpose` flag. Non-2-D fault targets (biases; conv kernels
-under `conv_also`) always resolve to a single tile — they are not
-crossbar matrices.
+Tiles are defined over the STORED 2-D weight shape (Caffe layout) for
+FC params; the consuming layer maps them onto the crossbar (K, N) view
+through its own `transpose` flag. Conv kernels (stored >2-D, Caffe
+OIHW `(C_out, C_in/g, kh, kw)`) map onto the crossbar through their
+im2col view `(K, N) = (C_in/g*kh*kw, C_out)` — the exact GEMM view
+`lax.conv_general_dilated_patches` multiplies against (ISSUE 18) — so
+their TileSpec geometry, per-tile draws, census, and wear telemetry
+are all defined over `im2col_shape(stored)` (`to_im2col` /
+`from_im2col` are the exact reshape bijections between the two
+layouts). 1-D fault targets (biases) always resolve to a single tile —
+they are not crossbar matrices.
 
 This module keeps its parse/geometry layer dependency-light (pure
 Python) so analysis tooling — fault/codesign.py, the serve admission
@@ -131,11 +137,15 @@ class TileSpec:
 
     # --- per-layer geometry -------------------------------------------
     def tile_dims(self, shape) -> Tuple[int, int]:
-        """Cells per tile (tr, tc) over a STORED 2-D shape. Grid form
-        ceil-divides the dims; cells form clamps to the matrix."""
+        """Cells per tile (tr, tc) over the crossbar-mapped 2-D view of
+        a stored shape: the stored dims for a 2-D matrix, the im2col
+        (K, N) view for a >2-D conv kernel. Grid form ceil-divides the
+        dims; cells form clamps to the matrix."""
+        if len(shape) > 2:
+            shape = im2col_shape(shape)
         if len(shape) != 2:
             raise ValueError(
-                f"tile_dims is defined over 2-D shapes, got {shape}")
+                f"tile_dims is defined over >=2-D shapes, got {shape}")
         d0, d1 = int(shape[0]), int(shape[1])
         if self.mode == "cells":
             return min(self.a, d0), min(self.b, d1)
@@ -144,8 +154,11 @@ class TileSpec:
     def grid(self, shape) -> Tuple[int, int]:
         """The effective tile grid (gr, gc) for a stored shape: always
         derived from `tile_dims` (so grid-form requests larger than the
-        matrix clamp down and every tile is non-empty); non-2-D shapes
-        are a single tile by definition."""
+        matrix clamp down and every tile is non-empty). >2-D conv
+        kernels tile over their im2col (K, N) view; 1-D shapes are a
+        single tile by definition."""
+        if len(shape) > 2:
+            shape = im2col_shape(shape)
         if len(shape) != 2:
             return (1, 1)
         tr, tc = self.tile_dims(shape)
@@ -167,7 +180,11 @@ class TileSpec:
     def bounds(self, shape) -> Tuple[List[Tuple[int, int]],
                                      List[Tuple[int, int]]]:
         """([row (lo, hi)...], [col (lo, hi)...]) cell-block boundaries
-        over a stored 2-D shape, tile-major (row blocks outer)."""
+        over the crossbar-mapped 2-D view (the stored dims for a 2-D
+        shape, the im2col (K, N) view for a >2-D conv kernel),
+        tile-major (row blocks outer)."""
+        if len(shape) > 2:
+            shape = im2col_shape(shape)
         tr, tc = self.tile_dims(shape)
         return (split_bounds(int(shape[0]), tr),
                 split_bounds(int(shape[1]), tc))
@@ -208,6 +225,59 @@ def canonical(text) -> str:
 
 
 # ---------------------------------------------------------------------------
+# the conv im2col crossbar view (ISSUE 18)
+#
+# A stored conv kernel (Caffe OIHW, (C_out, C_in/g, kh, kw)) reads on
+# the crossbar as the im2col GEMM operand: column j of the (K, N) view
+# is output filter j flattened over (C_in/g, kh, kw) — the exact matrix
+# `lax.conv_general_dilated_patches` output rows multiply against. All
+# tile geometry / draws / census for >2-D fault targets are defined
+# over this view; the bijections below are pure reshapes (no copy
+# semantics beyond layout), so `from_im2col(to_im2col(w), w.shape)` is
+# byte-exact.
+
+def im2col_shape(shape) -> Tuple[int, int]:
+    """(K, N) im2col crossbar view dims of a stored >2-D conv kernel
+    shape: K = prod(shape[1:]) patch features, N = shape[0] output
+    channels."""
+    if len(shape) <= 2:
+        raise ValueError(
+            f"im2col_shape is defined over >2-D conv kernels, "
+            f"got {tuple(shape)}")
+    k = 1
+    for d in shape[1:]:
+        k *= int(d)
+    return (k, int(shape[0]))
+
+
+def crossbar_view_shape(shape) -> Tuple[int, ...]:
+    """The 2-D shape TileSpec geometry is defined over: the stored
+    shape for <=2-D params, the im2col (K, N) view for conv kernels."""
+    if len(shape) > 2:
+        return im2col_shape(shape)
+    return tuple(int(d) for d in shape)
+
+
+def to_im2col(arr, param_ndim=None):
+    """Reshape a stored conv kernel array (..., C_out, C_in/g, kh, kw)
+    to its (..., K, N) im2col crossbar view. `param_ndim` is the
+    trailing stored rank (default: all of `arr.ndim`); leading config
+    axes ride through untouched."""
+    nd = arr.ndim if param_ndim is None else int(param_ndim)
+    lead = tuple(arr.shape[:arr.ndim - nd])
+    n = int(arr.shape[arr.ndim - nd])
+    return arr.reshape(lead + (n, -1)).swapaxes(-1, -2)
+
+
+def from_im2col(view, shape):
+    """Inverse of `to_im2col`: a (..., K, N) im2col view back to the
+    stored conv kernel shape (leading axes preserved)."""
+    shape = tuple(int(d) for d in shape)
+    lead = tuple(view.shape[:view.ndim - 2])
+    return view.swapaxes(-1, -2).reshape(lead + shape)
+
+
+# ---------------------------------------------------------------------------
 # per-(layer, tile) independent draws
 
 def tiled_draw(key, shape, tiles, draw_fn):
@@ -218,14 +288,22 @@ def tiled_draw(key, shape, tiles, draw_fn):
     reproducible from (key, spec) alone and tile (i, j)'s cells depend
     only on (key, tile index, tile shape).
 
-    The single-tile case (tiles None / the default spec / a non-2-D
+    >2-D conv kernels tile over their im2col (K, N) view: the blocks
+    are drawn and assembled in view layout (the crossbar's physical
+    cell layout), then reshaped back to the STORED shape via
+    `from_im2col` — the fault state keeps the stored layout every
+    elementwise consumer (Fail, the packed banks, the fused epilogue)
+    already handles.
+
+    The single-tile case (tiles None / the default spec / a 1-D
     shape / a matrix one tile covers) calls `draw_fn(key, shape)`
     directly with the UNFOLDED key — byte-identical to the pre-tiling
     draw, which is the 1x1 identity contract the CI guard pins."""
-    grid = ((1, 1) if tiles is None or len(shape) != 2
+    shape = tuple(int(d) for d in shape)
+    grid = ((1, 1) if tiles is None or len(shape) < 2
             else tiles.grid(shape))
     if grid[0] * grid[1] == 1:
-        return draw_fn(key, tuple(shape))
+        return draw_fn(key, shape)
     import jax
     import jax.numpy as jnp
     rb, cb = tiles.bounds(shape)
@@ -239,24 +317,34 @@ def tiled_draw(key, shape, tiles, draw_fn):
             t += 1
         rows.append(blocks[0] if len(blocks) == 1
                     else jnp.concatenate(blocks, axis=1))
-    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+    out = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+    return from_im2col(out, shape) if len(shape) > 2 else out
 
 
 # ---------------------------------------------------------------------------
 # tile-resolved fault census (the observe `fault.per_tile` block)
 
 def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
-    """Traced per-tile census reductions for ONE 2-D fault leaf:
+    """Traced per-tile census reductions for ONE >=2-D fault leaf:
     broken-cell fraction, minimum remaining lifetime, and the stuck-
     value histogram of the BROKEN cells per tile (how many dead cells
     read -1 / 0 / +1 — the spatial defect map per physical array).
+    >2-D conv leaves are censused over their im2col (K, N) view (the
+    tile layout the draws and the crossbar read use), and the record
+    carries the view dims so readers can label the geometry.
 
     Returns {"grid": i32[2], "broken_frac": f32[T], "life_min": f32[T],
     "stuck_neg"/"stuck_zero"/"stuck_pos": i32[T]} with T = gr * gc in
-    tile-major order. Under the sweep's config vmap each array gains
-    the leading config axis; `counters.to_host` listifies them for the
-    metrics record (schema: observe/schema.py PER_TILE_FIELDS)."""
+    tile-major order (plus "view": i32[2] for conv leaves). Under the
+    sweep's config vmap each array gains the leading config axis;
+    `counters.to_host` listifies them for the metrics record (schema:
+    observe/schema.py PER_TILE_FIELDS)."""
     import jax.numpy as jnp
+    view = None
+    if life.ndim > 2:
+        view = im2col_shape(life.shape)
+        life = to_im2col(life)
+        stuck = to_im2col(stuck)
     gr, gc = tiles.grid(life.shape)
     broken_frac, life_min = [], []
     s_neg, s_zero, s_pos = [], [], []
@@ -269,7 +357,7 @@ def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
         s_neg.append(jnp.sum(broken & (st == -1.0)).astype(jnp.int32))
         s_zero.append(jnp.sum(broken & (st == 0.0)).astype(jnp.int32))
         s_pos.append(jnp.sum(broken & (st == 1.0)).astype(jnp.int32))
-    return {
+    out = {
         "grid": jnp.asarray([gr, gc], jnp.int32),
         "broken_frac": jnp.stack(broken_frac),
         "life_min": jnp.stack(life_min),
@@ -277,6 +365,9 @@ def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
         "stuck_zero": jnp.stack(s_zero),
         "stuck_pos": jnp.stack(s_pos),
     }
+    if view is not None:
+        out["view"] = jnp.asarray(list(view), jnp.int32)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -284,13 +375,13 @@ def per_tile_counters(life, stuck, tiles: TileSpec) -> dict:
 
 def health_tiles(shape, tiles) -> Tuple[Tuple[int, int], list, List[int]]:
     """Tile enumeration for the wear census over one STORED param
-    shape: 2-D shapes follow the TileSpec grid (None / default = one
-    tile); non-2-D fault targets (biases, conv kernels under
-    `conv_also`) are a single tile by definition. Host-side geometry —
-    returns ((gr, gc), [slice tuple or None per tile], [cells per
-    tile]) so the jitted census program never has to return static
-    values."""
-    if len(shape) == 2 and tiles is not None and not tiles.is_default:
+    shape: >=2-D shapes follow the TileSpec grid (None / default = one
+    tile) — >2-D conv kernels over their im2col (K, N) view, whose
+    slices index that view; 1-D fault targets (biases) are a single
+    tile by definition. Host-side geometry — returns ((gr, gc),
+    [slice tuple or None per tile], [cells per tile]) so the jitted
+    census program never has to return static values."""
+    if len(shape) >= 2 and tiles is not None and not tiles.is_default:
         grid = tiles.grid(shape)
         sls = [sl for _, sl in tiles.tile_slices(shape)]
         cells = [(r1 - r0) * (c1 - c0) for r0, r1, c0, c1 in sls]
@@ -336,13 +427,22 @@ def per_tile_health(life, stuck, tiles, edges, param_ndim) -> dict:
     lifetime, and the stuck-value composition of the broken cells.
 
     `param_ndim` is the STORED param rank (2 = a crossbar matrix
-    following the tile grid; anything else = one tile); leading config
-    axes pass through, so the sweep's config-stacked leaves yield
-    per-config vectors. Returns {"life_hist": i32[..., T, B],
+    following the tile grid; >2 = a conv kernel following the grid
+    over its im2col (K, N) view — censused in view layout; 1 = one
+    tile); leading config axes pass through, so the sweep's
+    config-stacked leaves yield per-config vectors. Returns
+    {"life_hist": i32[..., T, B],
     "broken_frac"/"life_mean": f32[..., T], "stuck_neg"/"stuck_zero"/
     "stuck_pos": i32[..., T]} in tile-major order, B = len(edges)+2;
     geometry (grid, cells) comes from `health_tiles` host-side."""
     import jax.numpy as jnp
+    if param_ndim > 2:
+        # conv leaf: census in the im2col crossbar layout the tile
+        # grid is defined over (an exact reshape; cells are the same,
+        # only their tile membership follows the physical mapping)
+        life = to_im2col(life, param_ndim)
+        stuck = to_im2col(stuck, param_ndim)
+        param_ndim = 2
     shape = life.shape[life.ndim - param_ndim:]
     _, sls, _ = health_tiles(shape, tiles if param_ndim == 2 else None)
     axes = (-2, -1) if param_ndim == 2 else (-1,)
@@ -374,9 +474,13 @@ def per_tile_ages(age, tiles, edges, param_ndim) -> dict:
     """Traced per-tile drift-age distribution for ONE `drift_age` leaf
     (conductance_drift's health contribution): age histogram over the
     fixed log-spaced `edges` (bin 0 = age <= 0, written this step /
-    never drifted), mean and max age per tile. Same tile-major layout
-    and leading-axis pass-through as `per_tile_health`."""
+    never drifted), mean and max age per tile. Same tile-major layout,
+    im2col conv-view routing, and leading-axis pass-through as
+    `per_tile_health`."""
     import jax.numpy as jnp
+    if param_ndim > 2:
+        age = to_im2col(age, param_ndim)
+        param_ndim = 2
     shape = age.shape[age.ndim - param_ndim:]
     _, sls, _ = health_tiles(shape, tiles if param_ndim == 2 else None)
     axes = (-2, -1) if param_ndim == 2 else (-1,)
@@ -395,6 +499,7 @@ def per_tile_ages(age, tiles, edges, param_ndim) -> dict:
 
 __all__ = [
     "TileSpec", "DEFAULT_TILES", "MAX_TILES_PER_LAYER", "canonical",
-    "split_bounds", "tiled_draw", "per_tile_counters", "health_tiles",
+    "split_bounds", "im2col_shape", "crossbar_view_shape", "to_im2col",
+    "from_im2col", "tiled_draw", "per_tile_counters", "health_tiles",
     "log_histogram", "per_tile_health", "per_tile_ages",
 ]

@@ -255,11 +255,14 @@ class Net:
         (blobs, loss, new_params) when with_updates (BatchNorm moving
         stats) is requested. `adc_bits` (static) turns on the hardware-aware
         ADC output quantization in crossbar (InnerProduct) layers;
-        `crossbar` routes named InnerProduct layers through the fused
-        Pallas conductance-noise kernel (see LayerContext.crossbar);
-        `tiles` switches named InnerProduct layers to the tiled
-        crossbar mapping — per-tile ADC partial sums over per-layer
-        tile grids (see LayerContext.tiles / fault/mapping.py).
+        `crossbar` routes named InnerProduct/Convolution layers through
+        the fused Pallas conductance-noise kernel (see
+        LayerContext.crossbar; conv layers feed it their im2col GEMM,
+        ISSUE 18); `tiles` switches named InnerProduct/Convolution
+        layers to the tiled crossbar mapping — per-tile ADC partial
+        sums over per-layer tile grids, conv tiles defined over the
+        im2col (K, N) weight view (see LayerContext.tiles /
+        fault/mapping.py).
 
         Debug capture points (observe/debug.py — the `debug_info` deep
         trace; both default off and add NOTHING to the traced program

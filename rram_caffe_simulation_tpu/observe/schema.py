@@ -123,11 +123,13 @@ FAULT_FIELDS = {
     # "conductance_drift": {"drifted": 9000, "age_mean": 41.2}}
     "per_process": (dict, False),
     # tile-resolved census (fault/mapping.py per_tile_counters, only
-    # under a non-default tile spec): per 2-D fault target, the tile
+    # under a non-default tile spec): per >=2-D fault target, the tile
     # grid plus per-tile vectors in tile-major order — broken-cell
     # fraction, min remaining lifetime, and the broken-cell stuck
-    # histogram (counts reading -1/0/+1). Under a sweep every vector
-    # gains a leading per-config axis (lists of lists).
+    # histogram (counts reading -1/0/+1). Conv fault targets census
+    # over their im2col (K, N) view and carry its dims as "view"
+    # (ISSUE 18). Under a sweep every vector gains a leading
+    # per-config axis (lists of lists).
     "per_tile": (dict, False),
 }
 
@@ -140,6 +142,10 @@ PER_PARAM_FIELDS = {
 
 PER_TILE_FIELDS = {
     "grid": (list, True),
+    # conv fault targets only: the im2col (K, N) crossbar view dims
+    # the grid partitions (absent for FC weights, whose grid covers
+    # the stored matrix)
+    "view": (list, False),
     "broken_frac": (list, True),
     "life_min": (list, True),
     "stuck_neg": (list, True),

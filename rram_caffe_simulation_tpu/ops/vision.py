@@ -6,10 +6,24 @@ TPU design notes: Caffe lowers conv to im2col+GEMM by hand; here convolution
 is a single `lax.conv_general_dilated`, which XLA tiles directly onto the MXU
 — the entire im2col machinery (util/im2col.*) is subsumed. Blob layout keeps
 Caffe's NCHW semantics; XLA assigns physical TPU layouts itself.
+
+Tiled crossbar mapping (ISSUE 18): when `LayerContext.tiles` names a
+Convolution layer, its forward recovers Caffe's im2col+GEMM framing
+explicitly — `lax.conv_general_dilated_patches` rows against the
+flattened `(K, N) = (C_in/g*kh*kw, C_out)` weight view — so the GEMM
+can route through the same per-tile ADC crossbar read the InnerProduct
+path uses (fault/hw_aware.py `crossbar_matmul` on the pallas engine,
+`tiled_crossbar_matmul` on the jax engine). The patch operand is
+pre-materialized by default; `RRAM_CONV_IM2COL=tilewise` switches the
+jax engine to lazy per-K-tile slab extraction (bit-identical values —
+patch extraction is an exact gather — lower peak memory, re-extracted
+per tile). An un-named conv layer traces the exact pre-PR
+`conv_general_dilated` program.
 """
 from __future__ import annotations
 
 import functools
+import os
 import zlib
 
 import numpy as np
@@ -104,7 +118,9 @@ class ConvolutionLayer(_BaseConv):
     """reference conv_layer.cpp + base_conv_layer.cpp (im2col+GEMM with
     groups) -> XLA convolution; small group counts unroll into
     per-group convs + concat (see _GROUP_SPLIT_MAX), larger ones use
-    feature_group_count."""
+    feature_group_count. Under a tile mapping (`ctx.tiles` names this
+    layer) the forward is the explicit im2col GEMM routed through the
+    tiled crossbar read instead — see the module docstring."""
 
     def _conv(self, x, w):
         conv = functools.partial(
@@ -116,12 +132,114 @@ class ConvolutionLayer(_BaseConv):
             preferred_element_type=x.dtype)
         return _grouped_conv(conv, x, w, self.group)
 
+    def _out_hw(self, x):
+        return tuple(
+            (x.shape[2 + i] + 2 * self.pad[i]
+             - (self.dilation[i] * (self.kernel[i] - 1) + 1))
+            // self.stride[i] + 1
+            for i in range(len(self.kernel)))
+
+    def _patch_rows(self, x, c0=0, c1=None):
+        """im2col rows of bottom channels [c0, c1): a
+        (N*OH*OW, (c1-c0)*kh*kw) matrix in channel-major feature order
+        — index c*(kh*kw) + spatial — matching the stored weight's
+        `w.reshape(C_out, -1)` flatten, so rows @ view is exactly the
+        conv. HIGHEST precision: the one-hot extraction conv must
+        reproduce activation values bit-exactly (TPU's default MXU
+        precision rounds f32 operands through bf16), keeping the
+        premat and tilewise operands byte-identical."""
+        xs = x if c0 == 0 and (c1 is None or c1 == x.shape[1]) \
+            else x[:, c0:c1]
+        p = lax.conv_general_dilated_patches(
+            xs, filter_shape=self.kernel, window_strides=self.stride,
+            padding=[(p, p) for p in self.pad],
+            rhs_dilation=self.dilation,
+            dimension_numbers=DIMNUMS_2D,
+            precision=lax.Precision.HIGHEST)
+        n_, f, oh, ow = p.shape
+        return p.transpose(0, 2, 3, 1).reshape(n_ * oh * ow, f)
+
+    def _crossbar_conv(self, x, w, ctx, tl, cb):
+        """The tiled crossbar read of this conv layer: im2col patch
+        rows against the flattened (K, N) = (C_in*kh*kw, C_out) weight
+        view, per-(K, N)-tile ADC partial sums accumulated across the
+        K-tile (input-patch) axis — the InnerProduct read structure
+        over the im2col view. `tl` = (bk, bn) tile cell dims over the
+        view (solver._tiles_ctx); `cb` = the pallas-engine crossbar
+        context (broken/stuck in STORED layout, reshaped here to the
+        view the kernel's block grid tiles) or None for the jax engine
+        (the stored weight already carries the perturbed/faulty read
+        values the solver installed)."""
+        if self.group != 1:
+            raise ValueError(
+                f"layer {self.name!r}: grouped convolution "
+                f"(group={self.group}) is not mappable onto the im2col "
+                "crossbar view — each group is a separate GEMM and the "
+                "tile grid would straddle group boundaries; train this "
+                "layer untiled (tile_spec='1x1') or ungrouped")
+        bk, bn = int(tl[0]), int(tl[1])
+        adc = int(getattr(ctx, "adc_bits", 0) or 0)
+        n = x.shape[0]
+        oh, ow = self._out_hw(x)
+        wv = w.reshape(w.shape[0], -1).T  # (K, C_out) im2col view
+        mode = os.environ.get("RRAM_CONV_IM2COL",
+                              "premat").strip().lower() or "premat"
+        if mode not in ("premat", "tilewise"):
+            raise ValueError(
+                f"RRAM_CONV_IM2COL={mode!r}: expected 'premat' "
+                "(pre-materialized patch operand) or 'tilewise' "
+                "(lazy per-K-tile slab extraction, jax engine)")
+        if cb is not None:
+            # Fused Pallas crossbar read (one launch per shard under
+            # the sweep's config vmap / shard_map — the custom_vmap
+            # seam in fault/hw_aware.py): the patch operand is always
+            # pre-materialized, since the kernel's BlockSpec already
+            # streams (bm, bk) slabs of it through VMEM.
+            from ..fault.hw_aware import crossbar_matmul
+            from ..fault.mapping import to_im2col
+            broken, stuck, seed, sigma, q_bits = cb[:5]
+            shard_mesh = cb[5] if len(cb) > 5 else None
+            xm = self._patch_rows(x)
+            y = crossbar_matmul(
+                xm.astype(jnp.float32), wv.astype(jnp.float32),
+                to_im2col(broken),
+                to_im2col(stuck).astype(jnp.float32),
+                seed, sigma, q_bits, (bk, bn, adc),
+                shard_mesh).astype(x.dtype)
+        elif mode == "tilewise":
+            from ..fault.hw_aware import tiled_crossbar_matmul_slabs
+            khw = self.kernel[0] * self.kernel[1]
+
+            def slab(k0, k1):
+                # extract only the channels covering view rows
+                # [k0, k1) and slice the overhang — an exact gather,
+                # so every column is byte-identical to the premat
+                # operand's
+                ch0, ch1 = k0 // khw, -(-k1 // khw)
+                rows = self._patch_rows(x, ch0, ch1)
+                return rows[:, k0 - ch0 * khw:k1 - ch0 * khw]
+
+            y = tiled_crossbar_matmul_slabs(
+                slab, wv, bk, bn, adc, n * oh * ow,
+                preferred_element_type=x.dtype)
+        else:
+            from ..fault.hw_aware import tiled_crossbar_matmul
+            y = tiled_crossbar_matmul(
+                self._patch_rows(x), wv, bk, bn, adc,
+                preferred_element_type=x.dtype)
+        return y.reshape(n, oh, ow, -1).transpose(0, 3, 1, 2)
+
     def apply(self, params, bottoms, ctx):
         # Shared filters applied to each bottom independently
         # (conv_layer.cpp loops over bottom.size()).
+        tl = getattr(ctx, "tiles", None)
+        tl = tl.get(self.name) if tl else None
+        cb = getattr(ctx, "crossbar", None)
+        cb = cb.get(self.name) if cb else None
         tops = []
         for x in bottoms:
-            y = self._conv(x, params[0])
+            y = (self._crossbar_conv(x, params[0], ctx, tl, cb)
+                 if tl is not None else self._conv(x, params[0]))
             if self.bias_term:
                 y = y + params[1].reshape((1, -1) + (1,) * (y.ndim - 2))
             tops.append(y)
@@ -136,6 +254,14 @@ class DeconvolutionLayer(_BaseConv):
     deconv = True
 
     def apply(self, params, bottoms, ctx):
+        tl = getattr(ctx, "tiles", None)
+        if tl and self.name in tl:
+            # solver._check_tile_coverage refuses this earlier; the
+            # guard here keeps a hand-built LayerContext loud too
+            raise ValueError(
+                f"layer {self.name!r}: Deconvolution has no im2col "
+                "crossbar mapping (its GEMM transposes the weight "
+                "view); train it untiled (tile_spec='1x1')")
         x = bottoms[0]
         # Gradient-of-conv formulation: dilate the input by stride, pad by
         # (effective_kernel - 1 - pad), and convolve with the flipped kernel.

@@ -263,30 +263,41 @@ class Solver:
                 "crossbar mapping needs failure_pattern "
                 "{ type: 'gaussian' } and at least one fault-target "
                 "layer")
-        # Tiled-mapping coverage (ISSUE 17 satellite): a non-default
-        # tile spec only partitions 2-D crossbar weights — conv fault
-        # targets (failure_pattern.conv_also) keep the untiled draw and
-        # read. Loud, never silent: the run would otherwise report
-        # per-tile wear for a mapping that covers only part of the
-        # fault-prone set. Named layers ride the `setup` record as
-        # `tiles_bypassed` (cache.SetupStats).
+        # Tiled-mapping coverage (ISSUE 18): a non-default tile spec
+        # now covers conv fault targets too — their draws, census, and
+        # read all follow the im2col (K, N) view (fault/mapping.py,
+        # ops/vision.py) — so the old >2-D tiles-bypass warning path is
+        # gone because the bypass is gone. What remains genuinely
+        # unmappable fails LOUDLY here, naming the layer and why,
+        # instead of silently sweeping a mapping that covers only part
+        # of the fault-prone set. `tiles_bypassed` stays as the (now
+        # always-empty) `setup` record field (cache.SetupStats).
         self.tiles_bypassed = []
         if not self.tile_spec.is_default and self.fault_state is not None:
             flat_shapes = self._flat(self.params)
-            # >2-D only: 1-D biases are a single crossbar column by
-            # construction, not a coverage gap
-            self.tiles_bypassed = sorted(
-                {k.rsplit("/", 1)[0] for k in self._fault_keys
-                 if len(flat_shapes[k].shape) > 2})
-            if self.tiles_bypassed:
-                print(
-                    "WARNING: tile spec "
-                    f"{self.tile_spec.canonical()!r} does not cover "
-                    "non-matrix fault-target layer(s) "
-                    f"{', '.join(self.tiles_bypassed)} — conv params "
-                    "bypass the crossbar tiling (untiled fault draw "
-                    "and read); per-tile wear telemetry reports them "
-                    "as a single tile", file=sys.stderr, flush=True)
+            for k in self._fault_keys:
+                if len(flat_shapes[k].shape) <= 2:
+                    continue  # biases/matrices: always mappable
+                lname = k.rsplit("/", 1)[0]
+                layer = self.net.layer_by_name.get(lname)
+                tname = getattr(layer, "type_name", None)
+                if tname == "Deconvolution":
+                    raise ValueError(
+                        f"tile_spec {self.tile_spec.canonical()!r} "
+                        f"cannot map fault-target layer {lname!r}: "
+                        "Deconvolution has no im2col crossbar mapping "
+                        "(its GEMM transposes the weight view); drop "
+                        "conv_also for it or train with "
+                        "tile_spec='1x1'")
+                if getattr(layer, "group", 1) != 1:
+                    raise ValueError(
+                        f"tile_spec {self.tile_spec.canonical()!r} "
+                        f"cannot map fault-target layer {lname!r}: "
+                        f"grouped convolution (group={layer.group}) — "
+                        "each group is a separate im2col GEMM, so one "
+                        "tile grid would straddle group boundaries; "
+                        "train it untiled (tile_spec='1x1') or "
+                        "ungrouped")
         if (param.HasField("rram_forward")
                 and (param.rram_forward.sigma or param.rram_forward.adc_bits)
                 and self.fault_state is None):
@@ -446,14 +457,18 @@ class Solver:
 
     def _tiles_ctx(self):
         """Tiled crossbar mapping (fault/mapping.py): per-layer tile
-        cell dims over the STORED weight shape, for every fault-target
-        FC weight the configured spec splits into more than one tile —
-        the `tiles` kwarg Net.apply threads to the layers, shared by
-        the TRAIN step and test-phase inference (the chip reads every
-        crossbar through its tiles, train or test). The default 1x1
-        spec (and every single-tile layer) populates nothing, so the
-        untiled traced program is byte-identical — the contract
-        scripts/check_tiled_mapping.py guards. None when untiled."""
+        cell dims for every fault-target weight the configured spec
+        splits into more than one tile — the `tiles` kwarg Net.apply
+        threads to the layers, shared by the TRAIN step and test-phase
+        inference (the chip reads every crossbar through its tiles,
+        train or test). FC weights carry dims over the STORED shape
+        (the layer's `transpose` flag maps them to the crossbar view);
+        conv weights (failure_pattern.conv_also, ISSUE 18) carry dims
+        over their im2col (K, N) view, which the conv layer consumes
+        directly. The default 1x1 spec (and every single-tile layer)
+        populates nothing, so the untiled traced program is
+        byte-identical — the contract scripts/check_tiled_mapping.py
+        guards. None when untiled."""
         tspec = getattr(self, "tile_spec", None)
         if tspec is None or tspec.is_default:
             return None
@@ -463,6 +478,15 @@ class Solver:
             shape = flat_shapes[wkey].shape
             if len(shape) == 2 and tspec.n_tiles(shape) > 1:
                 out[wkey.rsplit("/", 1)[0]] = tspec.tile_dims(shape)
+        for k in self._fault_keys:
+            shape = flat_shapes[k].shape
+            if len(shape) > 2 and tspec.n_tiles(shape) > 1:
+                lname = k.rsplit("/", 1)[0]
+                layer = self.net.layer_by_name.get(lname)
+                # Deconvolution / grouped conv were refused at
+                # construction (the tiled-mapping coverage check)
+                if getattr(layer, "type_name", None) == "Convolution":
+                    out[lname] = tspec.tile_dims(shape)
         return out or None
 
     def make_train_step(self, hw_engine: str = "auto",
@@ -706,6 +730,18 @@ class Solver:
                 "sweeps): those wrappers bypass the layer context that "
                 "carries the per-layer tile grids. Train with "
                 "tile_spec='1x1' or without the wrapper.")
+        if use_pallas and tiles_ctx:
+            # tiled conv weights ride the fused kernel too (ISSUE 18):
+            # their im2col GEMM is just another (M, K) x (K, N) read,
+            # so the layer hands the kernel the view-shaped operands.
+            # UNTILED conv fault targets keep the pure perturbation
+            # below — the pre-existing pallas-engine program for them,
+            # numerics unchanged.
+            flat_shapes0 = self._flat(self.params)
+            crossbar_keys = crossbar_keys | {
+                k for k in fault_keys
+                if len(flat_shapes0[k].shape) > 2
+                and k.rsplit("/", 1)[0] in tiles_ctx}
 
         def _broken_stuck(fault_state, k):
             """The read-side broken mask + stuck values of one fault
@@ -1029,7 +1065,9 @@ class Solver:
                         # tile-resolved fault census (fault/mapping.py
                         # per_tile_counters): broken fraction, min
                         # lifetime, and the broken-cell stuck histogram
-                        # PER CROSSBAR TILE of every 2-D fault target —
+                        # PER CROSSBAR TILE of every >=2-D fault
+                        # target (conv kernels census over their
+                        # im2col view and carry its dims as "view") —
                         # only under a non-default tile spec, so the
                         # default metrics tree (and program) is
                         # unchanged
@@ -1040,7 +1078,7 @@ class Solver:
                             pt = {}
                             for k in fault_keys:
                                 life_k = lv.get(k)
-                                if life_k is None or life_k.ndim != 2:
+                                if life_k is None or life_k.ndim < 2:
                                     continue
                                 _, stuck_k = _broken_stuck(fault_state,
                                                            k)

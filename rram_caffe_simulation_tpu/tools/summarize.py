@@ -589,6 +589,16 @@ def summarize_metrics(paths):
                 grid = np.asarray(e.get("grid", [])).reshape(-1)
                 gtxt = (f"{int(grid[0])}x{int(grid[1])}"
                         if grid.size >= 2 else "?")
+                # conv fault targets carry their im2col (K, N) view
+                # dims (ISSUE 18): label the geometry the grid
+                # partitions, e.g. `conv3 [KxN im2col 2304x256,
+                # 9x1 grid]`
+                view = np.asarray(e.get("view", [])).reshape(-1)
+                if view.size >= 2:
+                    gtxt = (f"[KxN im2col {int(view[0])}x{int(view[1])}"
+                            f", {gtxt} grid]")
+                else:
+                    gtxt = f"grid={gtxt}"
                 bf = np.asarray(e.get("broken_frac", 0.0), np.float64)
                 lm = np.asarray(e.get("life_min", 0.0), np.float64)
                 # tiles are the LAST axis (a sweep prepends configs):
@@ -599,7 +609,7 @@ def summarize_metrics(paths):
                     str(int(np.sum(np.asarray(e.get(c, 0)))))
                     for c in ("stuck_neg", "stuck_zero", "stuck_pos"))
                 lines.append(
-                    f"  tiles   {key:20s} grid={gtxt} "
+                    f"  tiles   {key:20s} {gtxt} "
                     f"broken_frac_max={_fmt_num(float(bf.max()))}"
                     f"@t{tile_idx} life_min={_fmt_num(float(lm.min()))}"
                     f" stuck(-1/0/+1)={hist}")

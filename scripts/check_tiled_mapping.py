@@ -26,6 +26,20 @@ adc_bits = 4):
    under "2x2" must refuse to restore into a "1x1" runner (and vice
    versa) with an error naming both specs — the v6 checkpoint pin.
 
+Then the SAME contracts on a net mixing Convolution + InnerProduct
+fault targets (``conv_also``, ISSUE 18 — the conv weights tile over
+their im2col (K, N) views):
+
+5. **Conv 1x1 identity, both engines**: the 1x1 build is byte-
+   identical to the untiled build on the jax engine AND on the pallas
+   engine (where the conv forward must keep tracing the original
+   `conv_general_dilated` program).
+6. **Conv tiled engine parity**: a multi-tile conv+FC sweep
+   (``cells=8x2``: conv1 view (18, 3) -> 3x2 grid) on the pallas
+   engine produces per-lane losses bit-exact to the pure-JAX tiled
+   path, fault-bank bytes identical.
+7. **Conv mismatched-spec restore refused**, naming both specs.
+
     python scripts/check_tiled_mapping.py
 
 Exit status: 0 = all hold, 1 = any violation.
@@ -47,7 +61,25 @@ N_CONFIGS = 3
 MEAN, STD = 250.0, 30.0   # cells break inside the 12-iter window
 
 
-def _solver(prefix: str, tiles=None):
+CONV_NET = """
+name: "TiledConvNet"
+layer { name: "data" type: "Input" top: "data" top: "target"
+  input_param { shape { dim: 4 dim: 2 dim: 8 dim: 8 }
+                shape { dim: 4 dim: 2 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 stride: 2
+    weight_filler { type: "gaussian" std: 0.3 }
+    bias_filler { type: "constant" value: 0.05 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc1" type: "InnerProduct" bottom: "conv1" top: "fc1"
+  inner_product_param { num_output: 2
+    weight_filler { type: "gaussian" std: 0.3 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc1"
+  bottom: "target" top: "loss" }
+"""
+
+
+def _solver(prefix: str, tiles=None, conv: bool = False):
     import numpy as np
     from google.protobuf import text_format
     from rram_caffe_simulation_tpu.proto import pb
@@ -70,7 +102,7 @@ def _solver(prefix: str, tiles=None):
       bottom: "target" top: "loss" }
     """
     sp = pb.SolverParameter()
-    text_format.Parse(net, sp.net_param)
+    text_format.Parse(CONV_NET if conv else net, sp.net_param)
     sp.base_lr = 0.05
     sp.lr_policy = "fixed"
     sp.max_iter = 10 ** 6
@@ -80,21 +112,30 @@ def _solver(prefix: str, tiles=None):
     sp.failure_pattern.type = "gaussian"
     sp.failure_pattern.mean = MEAN
     sp.failure_pattern.std = STD
+    if conv:
+        # every weight on a crossbar: conv1 tiles over its im2col view
+        sp.failure_pattern.conv_also = True
     # sigma 0 + per-tile ADC: deterministic, and the ternary grid
     # below engages the fused kernel on the pallas engine
     sp.rram_forward.sigma = 0.0
     sp.rram_forward.adc_bits = 4
     rng = np.random.RandomState(3)
-    data = rng.randn(8, 6).astype(np.float32)
-    target = rng.randn(8, 2).astype(np.float32)
+    if conv:
+        data = rng.randn(4, 2, 8, 8).astype(np.float32)
+        target = rng.randn(4, 2).astype(np.float32)
+    else:
+        data = rng.randn(8, 6).astype(np.float32)
+        target = rng.randn(8, 2).astype(np.float32)
     return Solver(sp, train_feed=lambda: {"data": data,
                                           "target": target},
                   tile_spec=tiles)
 
 
-def _runner(workdir: str, tag: str, tiles=None, **kw):
+def _runner(workdir: str, tag: str, tiles=None, conv: bool = False,
+            **kw):
     from rram_caffe_simulation_tpu.parallel import SweepRunner
-    return SweepRunner(_solver(os.path.join(workdir, tag), tiles),
+    return SweepRunner(_solver(os.path.join(workdir, tag), tiles,
+                               conv=conv),
                        n_configs=N_CONFIGS, dtype_policy="ternary",
                        pipeline_depth=0, **kw)
 
@@ -248,6 +289,70 @@ def main() -> int:
     other.close()
     tj.close()
     tp.close()
+
+    # --- conv + InnerProduct mixed net (ISSUE 18) -----------------------
+
+    # 5. conv 1x1 identity, both engines
+    for eng in ("jax", "pallas"):
+        cr = _runner(work, f"conv_ref_{eng}", conv=True, engine=eng)
+        ct = _runner(work, f"conv_t11_{eng}", tiles="1x1", conv=True,
+                     engine=eng)
+        l_cr = _run_chunks(cr)
+        l_ct = _run_chunks(ct)
+        if l_cr.tobytes() != l_ct.tobytes():
+            failures.append(f"conv 1x1 ({eng}) losses not "
+                            f"byte-identical to untiled:\n{l_cr}\nvs"
+                            f"\n{l_ct}")
+        _compare_states(failures, f"conv 1x1 ({eng}) state", cr, ct)
+        cr.close()
+        ct.close()
+    if not failures:
+        print("conv 1x1 identity OK on both engines (losses + every "
+              "state leaf byte-identical)")
+
+    # 6. conv tiled (cells=8x2: conv1 im2col view (18, 3) -> 3x2 grid,
+    #    fc1 (2, 27) -> 1x14) pallas == pure-JAX, bit-exact per lane
+    cj = _runner(work, "conv_tiled_jax", tiles="cells=8x2", conv=True)
+    cp = _runner(work, "conv_tiled_pallas", tiles="cells=8x2",
+                 conv=True, engine="pallas")
+    l_cj = _run_chunks(cj)
+    l_cp = _run_chunks(cp)
+    if cp.engine_resolved != "pallas":
+        failures.append("conv tiled pallas runner resolved to "
+                        f"{cp.engine_resolved!r} — the conv kernel "
+                        "parity check tested nothing")
+    if l_cj.tobytes() != l_cp.tobytes():
+        failures.append("conv tiled pallas losses not bit-exact to "
+                        f"tiled pure-JAX:\n{l_cj}\nvs\n{l_cp}")
+    _compare_states(failures, "conv tiled engine-parity state", cj, cp,
+                    prefix="fault/")
+    if not failures:
+        print("conv tiled cells=8x2 engine parity OK (pallas == "
+              "pure-JAX: per-lane losses bit-exact, fault "
+              "transitions byte-identical)")
+    if float(cj.broken_fractions().max()) <= 0:
+        failures.append("no conv-net cell broke inside the window — "
+                        "lower MEAN")
+
+    # 7. conv mismatched-tile-spec restore refused
+    cck = os.path.join(work, "conv_tiled.ckpt.npz")
+    cj.checkpoint(cck)
+    cother = _runner(work, "conv_untiled_restore", conv=True)
+    try:
+        cother.restore(cck)
+        failures.append("restore of a cells=8x2 conv checkpoint into "
+                        "a 1x1 runner was NOT refused")
+    except ValueError as e:
+        msg = str(e)
+        if "cells=8x2" not in msg or "1x1" not in msg:
+            failures.append("conv tile-spec refusal does not name "
+                            f"both specs: {msg!r}")
+        else:
+            print("conv mismatched-tile-spec restore refused loudly "
+                  "(names both specs)")
+    cother.close()
+    cj.close()
+    cp.close()
 
     if failures:
         print("\nTILED MAPPING GUARD FAILED:", file=sys.stderr)

@@ -1,0 +1,418 @@
+"""Conv on crossbars via im2col tile mapping (ISSUE 18): the stored
+OIHW <-> im2col (K, N) view bijections, per-tile conv fault draws, the
+tiled im2col crossbar GEMM against a NumPy oracle, the 1x1/no-engine
+byte-identity contract vs `lax.conv_general_dilated`, Pallas-vs-pure-
+JAX bit-exactness on conv sweep losses and fault transitions, the
+premat/tilewise operand-mode identity, per-tile census + health for
+conv params, and the loud unmappable-layer raises."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+from google.protobuf import text_format
+
+from rram_caffe_simulation_tpu.fault import init_fault_state
+from rram_caffe_simulation_tpu.fault.hw_aware import quantize_ste
+from rram_caffe_simulation_tpu.fault.mapping import (
+    TileSpec, crossbar_view_shape, from_im2col, im2col_shape, to_im2col)
+from rram_caffe_simulation_tpu.proto import pb
+from rram_caffe_simulation_tpu.solver import Solver
+
+from test_fault import make_pattern
+
+CONV_TILE_NET = """
+name: "ConvTileNet"
+layer { name: "data" type: "Input" top: "data" top: "target"
+  input_param { shape { dim: 4 dim: 2 dim: 8 dim: 8 }
+                shape { dim: 4 dim: 2 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 stride: 2
+    weight_filler { type: "gaussian" std: 0.3 }
+    bias_filler { type: "constant" value: 0.05 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc1" type: "InnerProduct" bottom: "conv1" top: "fc1"
+  inner_product_param { num_output: 2
+    weight_filler { type: "gaussian" std: 0.3 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fc1" bottom: "target"
+  top: "loss" }
+"""
+
+
+def conv_solver(tmp_path, tile_spec=None, mean=150.0, std=10.0,
+                adc_bits=3, sigma=0.0, display=0, net=CONV_TILE_NET):
+    """Mixed conv + InnerProduct net with every weight fault-prone
+    (conv_also): conv1 stored (3, 2, 3, 3) -> im2col view (18, 3)."""
+    sp = pb.SolverParameter()
+    text_format.Parse(net, sp.net_param)
+    sp.base_lr = 0.05
+    sp.lr_policy = "fixed"
+    sp.max_iter = 100
+    sp.display = display
+    sp.random_seed = 9
+    sp.snapshot_prefix = str(tmp_path / "snap")
+    sp.failure_pattern.type = "gaussian"
+    sp.failure_pattern.mean = mean
+    sp.failure_pattern.std = std
+    sp.failure_pattern.conv_also = True
+    if adc_bits or sigma:
+        sp.rram_forward.sigma = sigma
+        sp.rram_forward.adc_bits = adc_bits
+    rng = np.random.RandomState(4)
+    data = rng.randn(4, 2, 8, 8).astype(np.float32)
+    target = rng.randn(4, 2).astype(np.float32)
+    return Solver(sp, train_feed=lambda: {"data": data,
+                                          "target": target},
+                  tile_spec=tile_spec)
+
+
+# ---------------------------------------------------------------------------
+# im2col view geometry
+
+
+def test_im2col_view_bijection():
+    """to_im2col/from_im2col are exact inverses; column j of the view
+    is output-channel j's flattened kernel (the `w.reshape(C_out, -1)`
+    flatten), so view GEMM == conv GEMM."""
+    shape = (3, 2, 3, 3)
+    assert im2col_shape(shape) == (18, 3)
+    assert crossbar_view_shape(shape) == (18, 3)
+    assert crossbar_view_shape((10, 6)) == (10, 6)
+    with pytest.raises(ValueError, match="2-D"):
+        im2col_shape((10, 6))
+    rng = np.random.RandomState(0)
+    w = rng.randn(*shape).astype(np.float32)
+    v = np.asarray(to_im2col(jnp.asarray(w)))
+    assert v.shape == (18, 3)
+    for j in range(shape[0]):
+        assert np.array_equal(v[:, j], w[j].ravel())
+    back = np.asarray(from_im2col(jnp.asarray(v), shape))
+    assert back.tobytes() == w.tobytes()
+    # leading config axes ride through (the sweep's stacked leaves)
+    stacked = jnp.asarray(np.stack([w, 2 * w]))
+    sv = np.asarray(to_im2col(stacked, param_ndim=4))
+    assert sv.shape == (2, 18, 3)
+    assert np.array_equal(sv[0], v)
+    sb = np.asarray(from_im2col(jnp.asarray(sv), shape))
+    assert sb.shape == (2,) + shape and np.array_equal(sb[0], w)
+
+
+def test_conv_tile_geometry_over_view():
+    ts = TileSpec.parse("cells=8x2")
+    assert ts.tile_dims((3, 2, 3, 3)) == (8, 2)
+    assert ts.grid((3, 2, 3, 3)) == (3, 2)     # view (18, 3)
+    rows, cols = ts.bounds((3, 2, 3, 3))
+    assert rows == [(0, 8), (8, 16), (16, 18)]
+    assert cols == [(0, 2), (2, 3)]
+
+
+# ---------------------------------------------------------------------------
+# per-tile conv fault draws
+
+
+def test_conv_tiled_draw_independence_and_single_tile_identity():
+    """Multi-tile conv grids draw independently per VIEW tile
+    (deterministically); the default spec and tiles=None stay
+    byte-identical to the untiled draw."""
+    key = jax.random.PRNGKey(0)
+    shapes = {"conv1/0": (4, 3, 3, 3), "conv1/1": (4,)}
+    pat = make_pattern(mean=400.0, std=100.0)
+    base = init_fault_state(key, shapes, pat)
+    t11 = init_fault_state(key, shapes, pat, tiles=TileSpec.parse("1x1"))
+    for g in base:
+        for k in base[g]:
+            assert (np.asarray(base[g][k]).tobytes()
+                    == np.asarray(t11[g][k]).tobytes())
+    ts = TileSpec.parse("cells=9x2")     # view (27, 4) -> 3x2 grid
+    a = init_fault_state(key, shapes, pat, tiles=ts)
+    b = init_fault_state(key, shapes, pat, tiles=ts)
+    life = np.asarray(a["lifetimes"]["conv1/0"])
+    assert life.shape == (4, 3, 3, 3)    # state keeps the STORED layout
+    assert (life.tobytes()
+            == np.asarray(b["lifetimes"]["conv1/0"]).tobytes())
+    assert (life.tobytes()
+            != np.asarray(base["lifetimes"]["conv1/0"]).tobytes())
+    # the 1-D bias stays a single tile
+    assert (np.asarray(a["lifetimes"]["conv1/1"]).tobytes()
+            == np.asarray(base["lifetimes"]["conv1/1"]).tobytes())
+    # tiles are independent draws over the im2col view: no two view
+    # blocks share bytes
+    view = np.asarray(to_im2col(jnp.asarray(life)))
+    blocks = [view[r0:r1, c0:c1].tobytes()
+              for _, (r0, r1, c0, c1) in ts.tile_slices((4, 3, 3, 3))]
+    assert len(blocks) == 6 and len(set(blocks)) == len(blocks)
+
+
+# ---------------------------------------------------------------------------
+# the im2col crossbar GEMM vs a NumPy oracle
+
+
+def _np_im2col(x, kernel, stride, pad):
+    """NumPy im2col rows (N*OH*OW, C*kh*kw), channel-major features."""
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (w + 2 * pad - kw) // stride + 1
+    rows = np.zeros((n * oh * ow, c * kh * kw), x.dtype)
+    r = 0
+    for b in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[b, :, i * stride:i * stride + kh,
+                           j * stride:j * stride + kw]
+                rows[r] = patch.reshape(-1)
+                r += 1
+    return rows, oh, ow
+
+
+def _conv_layer(tiles=None, adc_bits=3, pad=1, stride=2, group=1,
+                num_output=4, in_shape=(2, 2, 5, 5)):
+    from rram_caffe_simulation_tpu.core.registry import LayerContext
+    from rram_caffe_simulation_tpu.ops.vision import ConvolutionLayer
+    lp = pb.LayerParameter(name="c", type="Convolution")
+    lp.bottom.append("x")
+    lp.top.append("y")
+    cp = lp.convolution_param
+    cp.num_output = num_output
+    cp.kernel_size.append(3)
+    cp.stride.append(stride)
+    cp.pad.append(pad)
+    cp.group = group
+    layer = ConvolutionLayer(lp, pb.TRAIN)
+    layer.setup([in_shape])
+    ctx = LayerContext(phase=pb.TRAIN, adc_bits=adc_bits,
+                       tiles={"c": tiles} if tiles else None)
+    return layer, ctx
+
+
+def test_conv_im2col_crossbar_matmul_vs_numpy_oracle():
+    """The tiled conv forward is exactly: NumPy im2col rows @ the (K,
+    N) weight view, per-(K, N)-tile ADC quantization of the analog
+    partial sums, digital accumulation across the K-tile axis."""
+    layer, ctx = _conv_layer(tiles=(8, 3), adc_bits=3)
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 2, 5, 5).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    (y,), _ = layer.apply([jnp.asarray(w), jnp.asarray(b)],
+                          [jnp.asarray(x)], ctx)
+    rows, oh, ow = _np_im2col(x, (3, 3), 2, 1)
+    wv = w.reshape(4, -1).T                      # (18, 4) view
+    want = np.zeros((rows.shape[0], 4), np.float32)
+    for n0 in range(0, 4, 3):
+        n1 = min(n0 + 3, 4)
+        acc = np.zeros((rows.shape[0], n1 - n0), np.float32)
+        for k0 in range(0, 18, 8):
+            k1 = min(k0 + 8, 18)
+            part = rows[:, k0:k1] @ wv[k0:k1, n0:n1]
+            acc = acc + np.asarray(quantize_ste(jnp.asarray(part), 3))
+        want[:, n0:n1] = acc
+    want = want.reshape(2, oh, ow, 4).transpose(0, 3, 1, 2) \
+        + b.reshape(1, 4, 1, 1)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=0, atol=2e-5)
+
+
+def test_conv_premat_tilewise_operand_modes_bit_identical(monkeypatch):
+    """RRAM_CONV_IM2COL=tilewise (K-slabs extracted inside the tile
+    loop) must be byte-identical to the default pre-materialized
+    operand — exact-gather extraction + identical padded block shapes
+    + the same accumulation order."""
+    layer, ctx = _conv_layer(tiles=(7, 2), adc_bits=4)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(3, 2, 5, 5).astype(np.float32))
+    w = jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(4).astype(np.float32))
+    monkeypatch.delenv("RRAM_CONV_IM2COL", raising=False)
+    (y_pre,), _ = layer.apply([w, b], [x], ctx)
+    monkeypatch.setenv("RRAM_CONV_IM2COL", "tilewise")
+    (y_tw,), _ = layer.apply([w, b], [x], ctx)
+    assert (np.asarray(y_pre).tobytes() == np.asarray(y_tw).tobytes())
+    monkeypatch.setenv("RRAM_CONV_IM2COL", "bogus")
+    with pytest.raises(ValueError, match="RRAM_CONV_IM2COL"):
+        layer.apply([w, b], [x], ctx)
+
+
+def test_conv_layer_unmappable_raises():
+    """Grouped conv under a tile mapping fails loudly, naming the
+    layer; a hand-built deconv LayerContext does too."""
+    layer, ctx = _conv_layer(tiles=(4, 2), group=2, num_output=4,
+                             in_shape=(2, 4, 5, 5))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 5, 5).astype(np.float32))
+    w = jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(4).astype(np.float32))
+    with pytest.raises(ValueError, match="'c'.*group"):
+        layer.apply([w, b], [x], ctx)
+
+    from rram_caffe_simulation_tpu.core.registry import LayerContext
+    from rram_caffe_simulation_tpu.ops.vision import DeconvolutionLayer
+    lp = pb.LayerParameter(name="up", type="Deconvolution")
+    lp.bottom.append("x")
+    lp.top.append("y")
+    lp.convolution_param.num_output = 2
+    lp.convolution_param.kernel_size.append(2)
+    lp.convolution_param.stride.append(2)
+    dl = DeconvolutionLayer(lp, pb.TRAIN)
+    dl.setup([(1, 3, 4, 4)])
+    dctx = LayerContext(phase=pb.TRAIN, tiles={"up": (2, 2)})
+    with pytest.raises(ValueError, match="'up'.*Deconvolution"):
+        dl.apply([jnp.zeros((3, 2, 2, 2)), jnp.zeros((2,))],
+                 [jnp.zeros((1, 3, 4, 4))], dctx)
+
+
+# ---------------------------------------------------------------------------
+# solver end to end: byte identity, routing, loud raises
+
+
+def test_conv_solver_1x1_no_engine_byte_identical(tmp_path):
+    """The acceptance contract: tile_spec None / '1x1' / a cells spec
+    whose grid is 1x1 everywhere all trace the SAME program — the
+    original `lax.conv_general_dilated` conv — and train
+    byte-identically."""
+    a = conv_solver(tmp_path / "a")
+    b = conv_solver(tmp_path / "b", tile_spec="1x1")
+    c = conv_solver(tmp_path / "c", tile_spec="cells=1024x1024")
+    for s in (a, b, c):
+        s.step(5)
+    assert (a._materialize_smoothed_loss()
+            == b._materialize_smoothed_loss()
+            == c._materialize_smoothed_loss())
+    fa, fb, fc = (s._flat(s.params) for s in (a, b, c))
+    for k in fa:
+        assert np.asarray(fa[k]).tobytes() == np.asarray(fb[k]).tobytes()
+        assert np.asarray(fa[k]).tobytes() == np.asarray(fc[k]).tobytes()
+    for g in a.fault_state:
+        for k in a.fault_state[g]:
+            assert (np.asarray(a.fault_state[g][k]).tobytes()
+                    == np.asarray(b.fault_state[g][k]).tobytes())
+
+
+def test_conv_solver_tiled_read_changes_forward(tmp_path):
+    """A non-1x1 conv grid actually routes through the tiled crossbar
+    read: with identical seeds, the per-tile ADC partial sums produce
+    a different training trajectory than the whole-output ADC."""
+    a = conv_solver(tmp_path / "a", mean=1e6, std=10.0)
+    b = conv_solver(tmp_path / "b", mean=1e6, std=10.0,
+                    tile_spec="cells=8x2")
+    a.step(2)
+    b.step(2)
+    assert (a._materialize_smoothed_loss()
+            != b._materialize_smoothed_loss())
+
+
+def test_conv_solver_unmappable_layers_raise(tmp_path):
+    deconv_net = CONV_TILE_NET.replace(
+        'name: "conv1" type: "Convolution"',
+        'name: "conv1" type: "Deconvolution"')
+    with pytest.raises(ValueError, match="conv1.*Deconvolution"):
+        conv_solver(tmp_path / "d", tile_spec="cells=8x2",
+                    net=deconv_net)
+    grouped_net = CONV_TILE_NET.replace(
+        "num_output: 3 kernel_size: 3",
+        "num_output: 4 group: 2 kernel_size: 3")
+    with pytest.raises(ValueError, match="conv1.*group"):
+        conv_solver(tmp_path / "g", tile_spec="cells=8x2",
+                    net=grouped_net)
+    # untiled (default spec), both still train — the raise is scoped
+    # to the unmappable (spec, layer) pair, not the layer itself
+    conv_solver(tmp_path / "d2", net=deconv_net).step(1)
+    conv_solver(tmp_path / "g2", net=grouped_net).step(1)
+
+
+# ---------------------------------------------------------------------------
+# Pallas engine parity on the conv sweep
+
+
+def test_conv_sweep_pallas_vs_jax_bit_identical(tmp_path):
+    """sigma == 0 with the ternary grid on: the config-batched Pallas
+    im2col-GEMM launch (interpret mode off-TPU) must reproduce the
+    pure-JAX tiled conv path exactly — sweep losses AND the fault-bank
+    bytes driven by those forwards."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    mk = lambda d: conv_solver(tmp_path / d, mean=250.0, std=30.0,
+                               adc_bits=0, tile_spec="cells=8x2")
+    r_jax = SweepRunner(mk("j"), n_configs=2, engine="jax",
+                        dtype_policy="ternary")
+    r_pal = SweepRunner(mk("p"), n_configs=2, engine="pallas",
+                        dtype_policy="ternary")
+    assert r_pal.engine_resolved == "pallas"
+    l_jax, _ = r_jax.step(4, chunk=2)
+    l_pal, _ = r_pal.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_jax), np.asarray(l_pal))
+    for g in r_jax.fault_states:
+        for k in r_jax.fault_states[g]:
+            assert (np.asarray(r_jax.fault_states[g][k]).tobytes()
+                    == np.asarray(r_pal.fault_states[g][k]).tobytes()), \
+                f"fault bank {g}/{k} diverged across engines"
+
+
+# ---------------------------------------------------------------------------
+# per-tile census + health records for conv params
+
+
+def test_conv_per_tile_census_record_and_summarize(tmp_path, capsys):
+    """Tiled conv runs emit schema-valid fault.per_tile entries in
+    VIEW geometry (with the `view` field) and summarize labels them
+    with the im2col dims."""
+    from rram_caffe_simulation_tpu.observe import JsonlSink
+    from rram_caffe_simulation_tpu.observe import schema as obs_schema
+    from rram_caffe_simulation_tpu.tools import summarize
+
+    s = conv_solver(tmp_path, tile_spec="cells=8x2", display=2)
+    path = tmp_path / "metrics.jsonl"
+    s.enable_metrics(JsonlSink(str(path), unbuffered=True))
+    s.step(6)
+    recs = [json.loads(l) for l in
+            path.read_text().strip().splitlines()]
+    recs = [r for r in recs if "fault" in r]
+    assert recs
+    for r in recs:
+        assert obs_schema.validate_record(r) == []
+    pt = recs[-1]["fault"]["per_tile"]
+    assert pt["conv1/0"]["grid"] == [3, 2]        # view (18, 3)
+    assert pt["conv1/0"]["view"] == [18, 3]
+    assert len(pt["conv1/0"]["broken_frac"]) == 6
+    assert "view" not in pt["fc1/0"]              # FC stays stored
+    # the census is over the view: tile 0 covers view[0:8, 0:2]
+    life = np.asarray(to_im2col(jnp.asarray(
+        s.fault_state["lifetimes"]["conv1/0"])))
+    assert pt["conv1/0"]["broken_frac"][0] == pytest.approx(
+        (life[0:8, 0:2] <= 0).mean(), abs=1e-6)
+    summarize.main([str(path)])
+    out = capsys.readouterr().out
+    assert "KxN im2col 18x3" in out and "3x2 grid" in out
+
+
+def test_conv_per_tile_health_census(tmp_path):
+    """The wear-census health plane follows the conv im2col grid too:
+    per-tile stats over the VIEW, geometry from health_tiles."""
+    from rram_caffe_simulation_tpu.fault.processes import FaultSpec
+    from rram_caffe_simulation_tpu.observe.health import CensusProgram
+    rng = np.random.RandomState(7)
+    tiles = TileSpec.parse("cells=8x2")
+    shape = (3, 2, 3, 3)
+    life = rng.randint(-2, 120, size=shape).astype(np.float32)
+    stuck = rng.choice([-1.0, 0.0, 1.0], size=shape).astype(np.float32)
+    stack = FaultSpec.parse("endurance_stuck_at").build(tiles=tiles)
+    got = CensusProgram(stack)(
+        {"lifetimes": {"conv1/0": life},
+         "stuck": {"conv1/0": stuck}})["conv1/0"]
+    assert got["grid"] == [3, 2] and len(got["cells"]) == 6
+    lv = np.asarray(to_im2col(jnp.asarray(life)))
+    sv = np.asarray(to_im2col(jnp.asarray(stuck)))
+    for t, (r0, r1, c0, c1) in tiles.tile_slices(shape):
+        lt, st = lv[r0:r1, c0:c1], sv[r0:r1, c0:c1]
+        bt = lt <= 0
+        assert got["cells"][t] == lt.size
+        assert np.asarray(got["broken_frac"])[t] == pytest.approx(
+            bt.mean(), abs=1e-6)
+        assert np.asarray(got["life_mean"])[t] == pytest.approx(
+            lt.mean(), rel=1e-6)
+        assert np.asarray(got["stuck_zero"])[t] == \
+            int((bt & (st == 0.0)).sum())

@@ -461,9 +461,12 @@ def test_tilespec_geometry():
     c = TileSpec.parse("cells=4x4")
     assert c.tile_dims((10, 6)) == (4, 4)
     assert c.grid((10, 6)) == (3, 2)
-    # non-2-D shapes are a single tile by definition
+    # 1-D shapes are a single tile by definition; conv kernels (>2-D)
+    # tile over their im2col (C*kh*kw, C_out) view (ISSUE 18)
     assert c.grid((7,)) == (1, 1)
-    assert c.n_tiles((2, 3, 4, 4)) == 1
+    assert c.grid((2, 3, 4, 4)) == (12, 1)   # view (48, 2), 4x4 cells
+    assert c.n_tiles((2, 3, 4, 4)) == 12
+    assert c.tile_dims((2, 3, 4, 4)) == (4, 2)  # cells clamp to the view cols
     # tile-major enumeration is the draw-fold / census order
     idx = [t for t, _ in g.tile_slices((10, 6))]
     assert idx == [0, 1, 2, 3]
