@@ -14,16 +14,20 @@ thing: 224x224x3 input, an FC crossbar bigger than one physical array
 is a 2x4 = 8-tile grid, each tile with its own independent fault draw
 and its own ADC on the analog partial sums).
 
-``vgg-conv`` (ISSUE 18) — a conv stack with EVERY weight on a crossbar
-(``failure_pattern { conv_also: true }``): conv1 8x8/8 and conv2 3x3
-kernels mapped over their im2col (C*kh*kw, C_out) views (under the
-conv default ``cells=128x128``: conv1 view 192x16 -> 2x1 grid, conv2
-view 144x32 -> 2x1 grid) plus an FC head. The conv im2col GEMM is
-timed BOTH ways on the jax engine — ``premat`` (patches materialized
-once, default) and ``tilewise`` (K-slabs extracted inside the tile
-loop, RRAM_CONV_IM2COL=tilewise) — and the row records the resolved
-engine / fused-epilogue state and the runner's ``bytes_per_step_est``
-HBM floor.
+``vgg-conv`` (ISSUE 18; ISSUE 19 adds the implicit row) — a conv stack
+with EVERY weight on a crossbar (``failure_pattern { conv_also:
+true }``): conv1 8x8/8 and conv2 3x3 kernels mapped over their im2col
+(C*kh*kw, C_out) views (under the conv default ``cells=128x128``:
+conv1 view 192x16 -> 2x1 grid, conv2 view 144x32 -> 2x1 grid) plus an
+FC head. The conv im2col GEMM is timed in ALL THREE operand modes —
+``premat`` (patches materialized once, default), ``tilewise``
+(K-slabs extracted inside the jax-engine tile loop) and ``implicit``
+(the operand block gathered in-kernel / per-slab from the raw
+activation; the patch matrix never exists in HBM) — and the row
+records each mode's resolved state, ``bytes_per_step_est`` HBM floor
+and ``conv_patch_bytes`` patch-operand share. ``--conv-im2col`` picks
+the PRIMARY row's mode (default: the runner's resolution chain —
+Solver knob, then the RRAM_CONV_IM2COL env fallback, then premat).
 
 The sweep's config axis lays over every visible device
 (``TILED_BENCH_MESH``, default ``config=all``) as ONE GSPMD program —
@@ -146,6 +150,14 @@ def main():
                     help="vgg-fc: tiled FC crossbar (ISSUE 11 row); "
                          "vgg-conv: conv stack with every weight on a "
                          "crossbar via im2col tiling (ISSUE 18 row)")
+    ap.add_argument("--conv-im2col",
+                    choices=("premat", "tilewise", "implicit"),
+                    default=None,
+                    help="conv im2col operand mode for the primary "
+                         "timed run (default: the runner's resolution "
+                         "chain — Solver knob / RRAM_CONV_IM2COL env "
+                         "/ premat); the vgg-conv row times the other "
+                         "modes too for the comparison columns")
     args = ap.parse_args()
     conv_net = args.net == "vgg-conv"
     tiles = os.environ.get("TILED_BENCH_TILES") or (
@@ -195,12 +207,13 @@ def main():
         sp.display = CHUNK   # records at chunk boundaries
         return solver, sink
 
-    def timed_run(solver):
+    def timed_run(solver, conv_im2col=None):
         """Compile + warm up, then time STEPS sweep iterations."""
         mesh = mesh_from_spec(MESH) if MESH else None
         t0 = time.perf_counter()
         runner = SweepRunner(solver, n_configs=N_CONFIGS, mesh=mesh,
-                             pipeline_depth=0, engine=ENGINE)
+                             pipeline_depth=0, engine=ENGINE,
+                             conv_im2col=conv_im2col)
         runner.step(CHUNK, chunk=CHUNK)   # compile + warmup
         jax.block_until_ready(runner.params)
         setup_s = time.perf_counter() - t0
@@ -223,7 +236,8 @@ def main():
             # conv kernels tile over their im2col (K, N) view
             views[k] = list(crossbar_view_shape(v.shape))
 
-    runner, setup_s, dt = timed_run(solver)
+    runner, setup_s, dt = timed_run(solver,
+                                    conv_im2col=args.conv_im2col)
 
     # the last fault-bearing record's per-tile census, schema-checked
     recs = [r for r in sink.records if "fault" in r]
@@ -250,6 +264,8 @@ def main():
     img_s = N_CONFIGS * BATCH * STEPS / dt
     engine_resolved = runner.engine_resolved
     fused = bool(runner.fused_epilogue_resolved)
+    conv_resolved = runner.conv_im2col_resolved
+    conv_reason = runner.conv_im2col_reason
     runner.close()
 
     extra = {
@@ -271,27 +287,45 @@ def main():
         "fused_epilogue": fused,
         "bytes_per_step_est": setup_rec.get("bytes_per_step_est"),
         "backend": jax.default_backend(),
+        # the trajectory guard (scripts/check_bench_trajectory.py)
+        # reads this to decide cross-revision comparability
+        "note": ("CPU-measured (virtual host devices) at reduced "
+                 "scale; relative operand-mode comparison only — "
+                 "replay on TPU for absolute img/s/chip"
+                 if jax.default_backend() == "cpu"
+                 else f"{jax.default_backend()}-measured"),
     }
     if views:
         extra["im2col_views"] = views
     if conv_net:
-        # ISSUE 18 "measured both ways": re-trace the conv im2col GEMM
-        # with the K-slabs extracted inside the tile loop instead of a
-        # single pre-materialized patch matrix (jax engine only — the
-        # Pallas launch always consumes the pre-materialized operand)
-        extra["conv_im2col_mode"] = os.environ.get(
-            "RRAM_CONV_IM2COL", "premat")
-        if engine_resolved == "jax":
-            os.environ["RRAM_CONV_IM2COL"] = "tilewise"
-            try:
-                solver2, _ = build_solver()
-                runner2, _, dt2 = timed_run(solver2)
-                runner2.close()
-                extra["img_s_chip_tilewise"] = round(
-                    N_CONFIGS * BATCH * STEPS / dt2 / n_chips, 2)
-                extra["seconds_tilewise"] = round(dt2, 3)
-            finally:
-                os.environ.pop("RRAM_CONV_IM2COL", None)
+        # ISSUE 19 "measured all three ways": the primary run's
+        # resolved operand mode plus one re-traced run per OTHER mode,
+        # so the row carries the premat/tilewise/implicit comparison
+        # (img/s/chip, bytes_per_step_est HBM floor, and the
+        # conv_patch_bytes patch-operand share each mode moves).
+        # tilewise on the Pallas engine resolves to premat (recorded),
+        # so its column then duplicates the premat one — by design.
+        extra["conv_im2col_mode"] = conv_resolved or "premat"
+        if conv_reason:
+            extra["conv_im2col_reason"] = conv_reason
+        extra["conv_patch_bytes"] = setup_rec.get("conv_patch_bytes")
+        for mode in ("premat", "tilewise", "implicit"):
+            if mode == (conv_resolved or "premat"):
+                continue
+            solver2, _ = build_solver()
+            runner2, setup2_s, dt2 = timed_run(solver2,
+                                               conv_im2col=mode)
+            rec2 = runner2.setup_record(setup2_s)
+            extra[f"img_s_chip_{mode}"] = round(
+                N_CONFIGS * BATCH * STEPS / dt2 / n_chips, 2)
+            extra[f"seconds_{mode}"] = round(dt2, 3)
+            extra[f"bytes_per_step_est_{mode}"] = rec2.get(
+                "bytes_per_step_est")
+            extra[f"conv_patch_bytes_{mode}"] = rec2.get(
+                "conv_patch_bytes")
+            extra[f"conv_im2col_resolved_{mode}"] = \
+                runner2.conv_im2col_resolved
+            runner2.close()
 
     print(json.dumps({
         "metric": "images/sec/chip, ImageNet-resolution tiled-crossbar "

@@ -237,6 +237,14 @@ class SetupStats:
         # crossbar tiling; Solver.tiles_bypassed) — None/[] = full
         # coverage
         self.tiles_bypassed = None
+        # conv im2col operand mode (ISSUE 19): the RESOLVED mode a
+        # tiled-conv sweep traced (premat | tilewise | implicit; None =
+        # no tiled conv layer), the fallback/engagement reason, and the
+        # patch-operand share of bytes_per_step
+        # (SweepRunner.conv_patch_bytes_est)
+        self.conv_im2col = None
+        self.conv_im2col_reason = None
+        self.conv_patch_bytes = None
         self._h0 = _counts["hits"]
         self._m0 = _counts["misses"]
 
@@ -273,7 +281,10 @@ class SetupStats:
             config_shards=self.config_shards,
             fault_model=self.fault_model,
             engine_fallback_reason=self.engine_fallback_reason,
-            tiles_bypassed=self.tiles_bypassed)
+            tiles_bypassed=self.tiles_bypassed,
+            conv_im2col=self.conv_im2col,
+            conv_im2col_reason=self.conv_im2col_reason,
+            conv_patch_bytes=self.conv_patch_bytes)
 
 
 class _Timed:

@@ -113,6 +113,15 @@ class LayerContext:
     seq_mesh: Optional[Any] = None
     seq_axis: str = "seq"
     seq_impl: str = "ring"
+    # Conv im2col operand mode (ISSUE 19, static): how a TILED
+    # Convolution layer builds its (M, K) patch GEMM operand —
+    # "premat" (materialized once), "tilewise" (lazy per-K-tile slabs,
+    # jax engine) or "implicit" (in-kernel / plan-driven gather from
+    # the raw activation; the patch matrix never exists in HBM). None
+    # defers to the RRAM_CONV_IM2COL env var, then "premat". The
+    # solver resolves and records the effective mode
+    # (`make_train_step(conv_im2col=)`); see ops/vision.py.
+    conv_im2col: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -94,6 +94,23 @@ seam, shard_map dispatch, and per-lane seed words all carry over
 unchanged. The pure jax engine additionally offers a lazy operand mode
 (`tiled_crossbar_matmul_slabs`): per-K-tile patch-slab extraction
 inside the tile loop, bit-identical to the pre-materialized operand.
+
+Implicit im2col (ISSUE 19): `crossbar_conv_matmul` is the conv-native
+entry — it takes the RAW NCHW activation and gathers each (bm, bk)
+operand block INSIDE the kernel from the spatially zero-padded, flat
+activation via a precomputed additive address plan
+(fault/mapping.py `im2col_index_plan`: block[i, kk] =
+xflat[row_base[i] + col_off[kk]], masked by `broadcasted_iota` against
+the logical (M, K) bounds so alignment padding stays exactly zero and
+cannot raise a tile ADC's abs-max). The flattened patch matrix —
+a kh*kw× activation blow-up for overlapping convs — never exists in
+HBM; per-lane seed words, per-tile ADC accumulation, the custom_vmap
+batching seam, and `shard_map` config dispatch are the SAME code paths
+as `crossbar_matmul`, so losses and fault banks are bit-identical to
+the premat launch (guarded by tests/test_conv_tiles.py and
+scripts/check_tiled_mapping.py). v1 backward: cotangents replay the
+premat patches-based VJP (`conv_patch_rows` is materialized in the
+backward only) — the engine resolution records this note.
 """
 from __future__ import annotations
 
@@ -697,6 +714,445 @@ def _cm_bwd(sigma, q_bits, tiles, shard_mesh, res, g):
 
 
 crossbar_matmul.defvjp(_cm_fwd, _cm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# implicit im2col: the conv-native kernel family (ISSUE 19) — each
+# (bm, bk) operand block is gathered in-kernel from the raw (padded,
+# flattened) NCHW activation via the additive address plan of
+# fault/mapping.py; the flattened patch matrix never exists in HBM
+
+def _gather_block(xflat, rb, co, k, bk: int, m: int, kdim: int):
+    """Gather one (bm, bk) implicit-im2col operand block: `xflat` is
+    the flat zero-padded activation, `rb`/`co` the (bm,)/(bk,) int32
+    plan slices, `k` the K-tile program id. The iota masks zero every
+    alignment-padding row/column EXACTLY — the premat operand's padding
+    is literal zeros, and a nonzero garbage row would raise the tile
+    ADC's abs-max dynamic range (`_adc_read`), breaking the
+    bit-identity contract. Plan padding entries address offset 0, so
+    the gather itself is always in bounds."""
+    idx = rb[:, None] + co[None, :]
+    xb = jnp.take(xflat, idx)
+    bm = rb.shape[0]
+    row_ok = jax.lax.broadcasted_iota(jnp.int32, (bm, bk), 0) < m
+    col_ok = (k * bk
+              + jax.lax.broadcasted_iota(jnp.int32, (bm, bk), 1)) < kdim
+    return jnp.where(row_ok & col_ok, xb, 0.0)
+
+
+def _make_implicit_kernel(q_levels: float, adc_levels: float,
+                          m: int, kdim: int, bk: int):
+    """Implicit-im2col twin of `_make_crossbar_kernel`: identical
+    weight-side math (PRNG seed words, `_w_eff`, per-tile `_adc_read`),
+    but the x operand block is gathered in-kernel from the flat padded
+    activation instead of arriving as a pre-materialized (bm, bk)
+    BlockSpec slab. The M grid is pinned to one block by the tiled
+    launch, so grid axis 0 is a singleton."""
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        if q_levels:
+            (seed_ref, scale_ref, x_ref, rb_ref, co_ref, w_ref,
+             broken_ref, stuck_ref, sigma_ref, o_ref) = refs
+        else:
+            (seed_ref, x_ref, rb_ref, co_ref, w_ref, broken_ref,
+             stuck_ref, sigma_ref, o_ref) = refs
+            scale_ref = None
+        j = pl.program_id(1)
+        k = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        # same seed-word discipline as the premat kernel: seed and tile
+        # index are separate words, tile index is j*nk + k
+        pltpu.prng_seed(seed_ref[0], j * nk + k)
+        eps = _gauss_tile(w_ref[:].shape)
+        xb = _gather_block(x_ref[0], rb_ref[0], co_ref[0], k, bk, m,
+                           kdim)
+        w_eff = _w_eff(w_ref[:], broken_ref[:], stuck_ref[:],
+                       sigma_ref[0], eps, q_levels,
+                       scale_ref[0] if q_levels else None)
+        part = jnp.dot(xb, w_eff, preferred_element_type=jnp.float32)
+        o_ref[:] += _adc_read(part, adc_levels)
+    return kernel
+
+
+def _make_implicit_kernel_hostnoise(q_levels: float, adc_levels: float,
+                                    m: int, kdim: int, bk: int):
+    """Interpret-mode twin of `_make_implicit_kernel` (the Gaussian
+    draw arrives as an input, like every hostnoise kernel)."""
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        if q_levels:
+            (scale_ref, x_ref, rb_ref, co_ref, w_ref, broken_ref,
+             stuck_ref, eps_ref, sigma_ref, o_ref) = refs
+        else:
+            (x_ref, rb_ref, co_ref, w_ref, broken_ref, stuck_ref,
+             eps_ref, sigma_ref, o_ref) = refs
+            scale_ref = None
+        k = pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        xb = _gather_block(x_ref[0], rb_ref[0], co_ref[0], k, bk, m,
+                           kdim)
+        w_eff = _w_eff(w_ref[:], broken_ref[:], stuck_ref[:],
+                       sigma_ref[0], eps_ref[:], q_levels,
+                       scale_ref[0] if q_levels else None)
+        part = jnp.dot(xb, w_eff, preferred_element_type=jnp.float32)
+        o_ref[:] += _adc_read(part, adc_levels)
+    return kernel
+
+
+def _make_implicit_batched_kernel(q_levels: float, draw_noise: bool,
+                                  adc_levels: float, m: int, kdim: int,
+                                  bk: int):
+    """Config-grid twin of `_make_implicit_kernel` (grid
+    (cfg, 1, gn, gk)): per-lane seed words + the same (j*nk + k) tile
+    index, per-lane weight/fault/scale rows. Whether x is shared or
+    per-lane is decided entirely by the x BlockSpec index map — the
+    body always reads `x_ref[0]`, a (F,) flat activation."""
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        if q_levels:
+            (seed_ref, scale_ref, x_ref, rb_ref, co_ref, w_ref,
+             broken_ref, stuck_ref, sigma_ref, o_ref) = refs
+        else:
+            (seed_ref, x_ref, rb_ref, co_ref, w_ref, broken_ref,
+             stuck_ref, sigma_ref, o_ref) = refs
+            scale_ref = None
+        c = pl.program_id(0)
+        j = pl.program_id(2)
+        k = pl.program_id(3)
+        nk = pl.num_programs(3)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        w = w_ref[0]
+        if draw_noise:
+            pltpu.prng_seed(seed_ref[c], j * nk + k)
+            eps = _gauss_tile(w.shape)
+        else:
+            eps = None
+        xb = _gather_block(x_ref[0], rb_ref[0], co_ref[0], k, bk, m,
+                           kdim)
+        w_eff = _w_eff(w, broken_ref[0], stuck_ref[0],
+                       sigma_ref[0] if draw_noise else None, eps,
+                       q_levels, scale_ref[c] if q_levels else None)
+        part = jnp.dot(xb, w_eff, preferred_element_type=jnp.float32)
+        o_ref[0] += _adc_read(part, adc_levels)
+    return kernel
+
+
+def _make_implicit_batched_kernel_hostnoise(q_levels: float,
+                                            draw_noise: bool,
+                                            adc_levels: float, m: int,
+                                            kdim: int, bk: int):
+    """Interpret-mode twin of `_make_implicit_batched_kernel`."""
+    import jax.experimental.pallas as pl
+
+    def kernel(*refs):
+        refs = list(refs)
+        scale_ref = refs.pop(0) if q_levels else None
+        x_ref, rb_ref, co_ref, w_ref, broken_ref, stuck_ref = refs[:6]
+        refs = refs[6:]
+        eps_ref = refs.pop(0) if draw_noise else None
+        sigma_ref, o_ref = refs
+        c = pl.program_id(0)
+        k = pl.program_id(3)
+
+        @pl.when(k == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        xb = _gather_block(x_ref[0], rb_ref[0], co_ref[0], k, bk, m,
+                           kdim)
+        w_eff = _w_eff(w_ref[0], broken_ref[0], stuck_ref[0],
+                       sigma_ref[0] if draw_noise else None,
+                       eps_ref[0] if draw_noise else None,
+                       q_levels, scale_ref[c] if q_levels else None)
+        part = jnp.dot(xb, w_eff, preferred_element_type=jnp.float32)
+        o_ref[0] += _adc_read(part, adc_levels)
+    return kernel
+
+
+def _implicit_plan_arrays(x_shape, geom, tiles):
+    """Resolve an implicit launch's static plan + block geometry: the
+    padded device-side plan operands — (1, bm) row_base and (1, Kp)
+    col_off int32 arrays (plan entries past the logical M/K bounds
+    address offset 0 and are zero-masked in-kernel) — plus the logical
+    (m, kdim) operand dims and the `_tile_blocks` launch knobs."""
+    from .mapping import im2col_index_plan
+
+    rb_np, co_np, m, kdim, _ = im2col_index_plan(x_shape, geom)
+    bm, bn, bk, adc_levels = _tile_blocks(tiles, m)
+    rb = jnp.asarray(np.pad(rb_np, (0, bm - m)))[None, :]
+    co = jnp.asarray(np.pad(co_np, (0, -kdim % bk)))[None, :]
+    return rb, co, m, kdim, bm, bn, bk, adc_levels
+
+
+def _pallas_forward_implicit(x, w, broken, stuck, seed, sigma,
+                             q_bits=0, tiles=None, geom=None):
+    """Single-config implicit-im2col launch: like `_pallas_forward` on
+    the (M, K) patch view, but x arrives as the RAW NCHW activation and
+    the operand blocks are gathered in-kernel. `tiles` and `geom` are
+    mandatory statics — the tile grid defines the block geometry, the
+    conv geometry defines the address plan."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .mapping import pad_activation_flat
+
+    if tiles is None or geom is None:
+        raise ValueError(
+            "implicit im2col needs static tiles=(bk, bn, adc_bits) and "
+            "a conv_geom tuple")
+    n = w.shape[1]
+    rb, co, m, kdim, bm, bn, bk, adc_levels = _implicit_plan_arrays(
+        x.shape, geom, tiles)
+    xflat = pad_activation_flat(x, geom)[None, :]
+
+    def pad(a, r, c):
+        return jnp.pad(a, ((0, -a.shape[0] % r), (0, -a.shape[1] % c)))
+
+    wp = pad(w, bk, bn)
+    bp = pad(broken, bk, bn)
+    sp = pad(stuck, bk, bn)
+    gk = wp.shape[0] // bk
+    gn = wp.shape[1] // bn
+    on_tpu = jax.default_backend() == "tpu"
+    levels = _q_levels(q_bits)
+    # identical quantization grid to the premat launch: max-abs over
+    # the padded weight matrix (padding zeros never raise it)
+    scale = ([jnp.max(jnp.abs(wp)).reshape(1)] if levels else [])
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    scale_spec = [smem] if levels else []
+    xspec = pl.BlockSpec((1, xflat.shape[1]), lambda i, j, k: (0, 0))
+    rbspec = pl.BlockSpec((1, bm), lambda i, j, k: (0, 0))
+    cospec = pl.BlockSpec((1, bk), lambda i, j, k: (0, k))
+    wspec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
+    common = dict(
+        grid=(1, gn, gk),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((bm, wp.shape[1]), jnp.float32),
+    )
+    sig = jnp.asarray([sigma], jnp.float32)
+    if on_tpu:
+        out = pl.pallas_call(
+            _make_implicit_kernel(levels, adc_levels, m, kdim, bk),
+            in_specs=[smem] + scale_spec
+            + [xspec, rbspec, cospec, wspec, wspec, wspec, smem],
+            **common,
+        )(jnp.asarray([seed], jnp.int32), *scale, xflat, rb, co, wp,
+          bp, sp, sig)
+    else:
+        # same host draw as the premat interpret branch: PRNGKey(seed)
+        # over the padded (Kp, Np) weight shape -> identical noise
+        eps = jax.random.normal(jax.random.PRNGKey(seed), wp.shape,
+                                jnp.float32)
+        out = pl.pallas_call(
+            _make_implicit_kernel_hostnoise(levels, adc_levels, m,
+                                            kdim, bk),
+            in_specs=scale_spec
+            + [xspec, rbspec, cospec, wspec, wspec, wspec, wspec,
+               smem],
+            interpret=True,
+            **common,
+        )(*scale, xflat, rb, co, wp, bp, sp, eps, sig)
+    return out[:m, :n]
+
+
+def _pallas_forward_implicit_batched(x, w, broken, stuck, seeds, sigma,
+                                     q_bits=0, tiles=None, geom=None):
+    """Config-batched implicit launch: x is the raw NCHW activation,
+    SHARED (4-D) or per-lane (5-D, leading config axis); w/broken/stuck
+    (C, K, N) and seeds (C,) per lane. One pallas_call over grid
+    (C, 1, gn, gk) — neither the per-lane weights nor ANY patch matrix
+    ever materialize in HBM."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .mapping import pad_activation_flat
+
+    if tiles is None or geom is None:
+        raise ValueError(
+            "implicit im2col needs static tiles=(bk, bn, adc_bits) and "
+            "a conv_geom tuple")
+    cfg = w.shape[0]
+    x_batched = x.ndim == 5
+    n = w.shape[2]
+    rb, co, m, kdim, bm, bn, bk, adc_levels = _implicit_plan_arrays(
+        x.shape[-4:], geom, tiles)
+    xflat = pad_activation_flat(x, geom)
+    if not x_batched:
+        xflat = xflat[None, :]
+
+    def pad3(a, r, c):
+        return jnp.pad(a, ((0, 0), (0, -a.shape[1] % r),
+                           (0, -a.shape[2] % c)))
+
+    wp = pad3(w, bk, bn)
+    bp = pad3(broken, bk, bn)
+    sp = pad3(stuck, bk, bn)
+    gk = wp.shape[1] // bk
+    gn = wp.shape[2] // bn
+    on_tpu = jax.default_backend() == "tpu"
+    levels = _q_levels(q_bits)
+    draw = bool(sigma)
+    scale = ([jnp.max(jnp.abs(wp), axis=(1, 2))] if levels else [])
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    scale_spec = [smem] if levels else []
+    fdim = xflat.shape[1]
+    xspec = (pl.BlockSpec((1, fdim), lambda c, i, j, k: (c, 0))
+             if x_batched
+             else pl.BlockSpec((1, fdim), lambda c, i, j, k: (0, 0)))
+    rbspec = pl.BlockSpec((1, bm), lambda c, i, j, k: (0, 0))
+    cospec = pl.BlockSpec((1, bk), lambda c, i, j, k: (0, k))
+    wspec = pl.BlockSpec((1, bk, bn), lambda c, i, j, k: (c, k, j))
+    common = dict(
+        grid=(cfg, 1, gn, gk),
+        out_specs=pl.BlockSpec((1, bm, bn), lambda c, i, j, k: (c, i, j)),
+        out_shape=jax.ShapeDtypeStruct((cfg, bm, wp.shape[2]),
+                                       jnp.float32),
+    )
+    sig = jnp.asarray([sigma], jnp.float32)
+    if on_tpu:
+        out = pl.pallas_call(
+            _make_implicit_batched_kernel(levels, draw, adc_levels, m,
+                                          kdim, bk),
+            in_specs=[smem] + scale_spec
+            + [xspec, rbspec, cospec, wspec, wspec, wspec, smem],
+            **common,
+        )(jnp.asarray(seeds, jnp.int32), *scale, xflat, rb, co, wp,
+          bp, sp, sig)
+    else:
+        eps = ([jax.vmap(lambda s: jax.random.normal(
+                    jax.random.PRNGKey(s), wp.shape[1:], jnp.float32))(
+                        seeds)] if draw else [])
+        eps_spec = [wspec] if draw else []
+        out = pl.pallas_call(
+            _make_implicit_batched_kernel_hostnoise(levels, draw,
+                                                    adc_levels, m,
+                                                    kdim, bk),
+            in_specs=scale_spec
+            + [xspec, rbspec, cospec, wspec, wspec, wspec]
+            + eps_spec + [smem],
+            interpret=True,
+            **common,
+        )(*scale, xflat, rb, co, wp, bp, sp, *eps, sig)
+    return out[:, :m, :n]
+
+
+@functools.lru_cache(maxsize=None)
+def _vmappable_implicit(sigma: float, q_bits: int, tiles, geom,
+                        shard_mesh=None):
+    """`_vmappable_forward`'s implicit-im2col twin: the SAME custom_vmap
+    dispatch rules (full (w, broken, stuck, seed) batch -> one
+    config-grid launch; mixed batching -> per-lane single kernels under
+    lax.map; `shard_mesh` wraps the dispatch in shard_map over the
+    config axis), keyed additionally by the static conv geometry that
+    drives the address plan."""
+    import jax.custom_batching
+
+    @jax.custom_batching.custom_vmap
+    def fwd(x, w, broken, stuck, seed):
+        return _pallas_forward_implicit(x, w, broken, stuck, seed,
+                                        sigma, q_bits, tiles, geom)
+
+    @fwd.def_vmap
+    def _rule(axis_size, in_batched, x, w, broken, stuck, seed):
+        wb, bb, sb, seedb = in_batched[1:]   # x may be shared
+
+        def dispatch(x, w, broken, stuck, seed):
+            if wb and bb and sb and seedb:
+                return _pallas_forward_implicit_batched(
+                    x, w, broken, stuck, seed, sigma, q_bits, tiles,
+                    geom)
+            return per_lane_map(
+                lambda *lane: _pallas_forward_implicit(
+                    *lane, sigma, q_bits, tiles, geom),
+                (x, w, broken, stuck, seed), in_batched)
+
+        if shard_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            out = config_shard_map(
+                dispatch, shard_mesh, (x, w, broken, stuck, seed),
+                in_batched, out_specs=P("config", None, None))
+        else:
+            out = dispatch(x, w, broken, stuck, seed)
+        return out, True
+    return fwd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def crossbar_conv_matmul(x, w, broken, stuck, seed, sigma, q_bits=0,
+                         tiles=None, geom=None, shard_mesh=None):
+    """`crossbar_matmul` for a tiled Convolution WITHOUT materializing
+    its im2col patch operand: x is the raw (N, C, H, W) activation,
+    `geom` the static `conv_geom` tuple (kh, kw, sh, sw, ph, pw, dh,
+    dw), and each (bm, bk) operand block is gathered inside the kernel
+    from the flat zero-padded activation via the precomputed additive
+    address plan (fault/mapping.py). w/broken/stuck are the layer's
+    (K, N) im2col crossbar view, exactly as the premat call site passes
+    them; `tiles` is mandatory (the tile grid defines the kernel block
+    geometry). Returns the (M, N) = (N*OH*OW, C_out) GEMM result —
+    bit-identical to `crossbar_matmul(patch_rows(x), ...)` because the
+    in-kernel gather reads the same exact values Precision.HIGHEST
+    patch extraction copies, and every weight-side op is shared code.
+
+    vmap / `shard_mesh` semantics are `crossbar_matmul`'s, via the same
+    custom_vmap + shard_map seams. Backward (v1, recorded by the engine
+    resolution): cotangents replay the premat patches-based VJP — the
+    patch matrix IS materialized in the backward, dx flowing through
+    the exact patch-extraction transpose and dw through
+    patch_rows(x).T @ g with broken cells zeroed, so training cotangent
+    bytes match the premat path too."""
+    if tiles is None or geom is None:
+        raise ValueError(
+            "crossbar_conv_matmul needs static tiles=(bk, bn, adc_bits) "
+            "and a conv_geom tuple")
+    return _vmappable_implicit(float(sigma), int(q_bits), tiles, geom,
+                               shard_mesh)(
+        x, w, broken.astype(jnp.float32), stuck.astype(jnp.float32),
+        seed)
+
+
+def _ccm_fwd(x, w, broken, stuck, seed, sigma, q_bits, tiles, geom,
+             shard_mesh):
+    y = crossbar_conv_matmul(x, w, broken, stuck, seed, sigma, q_bits,
+                             tiles, geom, shard_mesh)
+    return y, (x, w, broken, stuck)
+
+
+def _ccm_bwd(sigma, q_bits, tiles, geom, shard_mesh, res, g):
+    # the premat backward, replayed exactly (same products, same
+    # order): dx via the patch-extraction transpose, dw against the
+    # forward's patch rows with broken cells zeroed. The patch matrix
+    # materializes HERE only — the v1 trade the resolution records.
+    from .mapping import conv_patch_rows
+    x, w, broken, stuck = res
+    wv = w
+    if q_bits:
+        wv = _quantize_tile(w, jnp.max(jnp.abs(w)), _q_levels(q_bits))
+    w_masked = jnp.where(broken, stuck.astype(w.dtype), wv)
+    xm, patch_vjp = jax.vjp(lambda t: conv_patch_rows(t, geom), x)
+    dxm = g @ w_masked.T
+    dx, = patch_vjp(dxm)
+    dw = xm.T @ g
+    dw = jnp.where(broken, 0.0, dw)
+    return dx, dw, None, None, None
+
+
+crossbar_conv_matmul.defvjp(_ccm_fwd, _ccm_bwd)
 
 
 def tiled_crossbar_matmul(x, w_eff, bk: int, bn: int, adc_bits: int,

@@ -278,6 +278,111 @@ def from_im2col(view, shape):
 
 
 # ---------------------------------------------------------------------------
+# implicit im2col: static address plans over the raw NCHW activation
+#
+# The patch matrix row m = ((n*OH)+oh)*OW + ow, column kk =
+# (c*kh + r)*kw + s of a 2-D conv reads ONE element of the spatially
+# zero-padded activation, at flat offset
+#
+#   idx[m, kk] = row_base[m] + col_off[kk]
+#
+# because the address decomposes ADDITIVELY: the patch origin
+# (n, oh*sh, ow*sw) contributes row_base, the in-patch offset
+# (c, r*dh, s*dw) contributes col_off. Two small int32 vectors (M and
+# K entries) therefore address the whole (M, K) operand — the kernel
+# (or a jax-engine slab closure) gathers any (bm, bk) block as
+# xflat[row_base[i0:i1, None] + col_off[None, k0:k1]] without the
+# flattened patch matrix ever existing in HBM. Padding is plain
+# zero-padding of the activation, so gathered values are bit-identical
+# to `lax.conv_general_dilated_patches` output (an exact gather at
+# Precision.HIGHEST) — the hinge of the premat/implicit parity
+# contract in fault/hw_aware.py.
+
+def conv_geom(kernel, stride, pad, dilation) -> Tuple[int, ...]:
+    """Canonical static-geometry tuple (kh, kw, sh, sw, ph, pw, dh, dw)
+    of a 2-D conv — hashable, so it can key the lru_cached custom_vmap
+    seam in fault/hw_aware.py. Raises for non-2-D spatial geometry
+    (the caller falls back to premat, loudly)."""
+    if len(kernel) != 2 or len(stride) != 2 or len(pad) != 2 \
+            or len(dilation) != 2:
+        raise ValueError(
+            f"implicit im2col needs 2-D spatial geometry, got "
+            f"kernel={tuple(kernel)} stride={tuple(stride)} "
+            f"pad={tuple(pad)} dilation={tuple(dilation)}")
+    return (int(kernel[0]), int(kernel[1]), int(stride[0]), int(stride[1]),
+            int(pad[0]), int(pad[1]), int(dilation[0]), int(dilation[1]))
+
+
+def im2col_index_plan(x_shape, geom):
+    """Precomputed implicit-im2col address plan for an NCHW activation
+    of static shape `x_shape` under `conv_geom` tuple `geom`.
+
+    Returns ``(row_base, col_off, m, k, padded_shape)``: int32 numpy
+    vectors of length M = N*OH*OW and K = C*kh*kw holding the additive
+    flat-offset decomposition above, the logical operand dims, and the
+    (N, C, H+2ph, W+2pw) shape the activation must be zero-padded to
+    before flattening. Pure numpy — runs at trace time, never inside
+    the jaxpr."""
+    import numpy as np
+
+    n, c, h, w = (int(d) for d in x_shape)
+    kh, kw, sh, sw, ph, pw, dh, dw = geom
+    hp, wp = h + 2 * ph, w + 2 * pw
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    if oh < 1 or ow < 1:
+        raise ValueError(
+            f"implicit im2col: empty output window for x={tuple(x_shape)} "
+            f"geom={geom}")
+    base_n = np.arange(n, dtype=np.int64) * (c * hp * wp)
+    base_oh = np.arange(oh, dtype=np.int64) * (sh * wp)
+    base_ow = np.arange(ow, dtype=np.int64) * sw
+    row_base = (base_n[:, None, None] + base_oh[None, :, None]
+                + base_ow[None, None, :]).reshape(-1)
+    off_c = np.arange(c, dtype=np.int64) * (hp * wp)
+    off_r = np.arange(kh, dtype=np.int64) * (dh * wp)
+    off_s = np.arange(kw, dtype=np.int64) * dw
+    col_off = (off_c[:, None, None] + off_r[None, :, None]
+               + off_s[None, None, :]).reshape(-1)
+    if int(row_base[-1] + col_off[-1]) >= n * c * hp * wp:
+        raise AssertionError("implicit im2col plan addresses out of range")
+    return (row_base.astype(np.int32), col_off.astype(np.int32),
+            n * oh * ow, c * kh * kw, (n, c, hp, wp))
+
+
+def pad_activation_flat(x, geom):
+    """Spatially zero-pad an NCHW activation per `geom` and flatten the
+    trailing 4 dims — the only array the implicit-im2col gather reads.
+    Leading config axes ride through (a (C, N, Cin, H, W) batch flattens
+    to (C, F)). jnp, so it traces; padding with exact zeros keeps
+    gathered conv-halo values bit-identical to the patches extraction."""
+    import jax.numpy as jnp
+
+    ph, pw = geom[4], geom[5]
+    widths = [(0, 0)] * (x.ndim - 2) + [(ph, ph), (pw, pw)]
+    return jnp.pad(x, widths).reshape(x.shape[:x.ndim - 4] + (-1,))
+
+
+def conv_patch_rows(x, geom):
+    """Materialized (N*OH*OW, C*kh*kw) im2col patch rows of an NCHW
+    activation at Precision.HIGHEST — the exact-gather extraction the
+    premat operand mode uses (`ops/vision.ConvolutionLayer._patch_rows`)
+    and the implicit mode's v1 backward replays so its cotangents stay
+    bit-identical to premat's."""
+    import jax.numpy as jnp  # noqa: F401  (keeps lazy-jax discipline)
+    from jax import lax
+
+    kh, kw, sh, sw, ph, pw, dh, dw = geom
+    p = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)], rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST)
+    n_, f, oh, ow = p.shape
+    return p.transpose(0, 2, 3, 1).reshape(n_ * oh * ow, f)
+
+
+# ---------------------------------------------------------------------------
 # per-(layer, tile) independent draws
 
 def tiled_draw(key, shape, tiles, draw_fn):

@@ -245,7 +245,7 @@ class Net:
               iteration=None, with_updates: bool = False,
               start: Optional[str] = None, end: Optional[str] = None,
               adc_bits: int = 0, crossbar: Optional[dict] = None,
-              tiles: Optional[dict] = None,
+              tiles: Optional[dict] = None, conv_im2col=None,
               compute_dtype=None, seq_mesh=None, seq_impl: str = "ring",
               probes: Optional[dict] = None,
               trace_sites: Optional[dict] = None):
@@ -262,7 +262,9 @@ class Net:
         layers to the tiled crossbar mapping — per-tile ADC partial
         sums over per-layer tile grids, conv tiles defined over the
         im2col (K, N) weight view (see LayerContext.tiles /
-        fault/mapping.py).
+        fault/mapping.py); `conv_im2col` (static) selects how tiled
+        conv layers build that GEMM's patch operand —
+        premat/tilewise/implicit, see LayerContext.conv_im2col.
 
         Debug capture points (observe/debug.py — the `debug_info` deep
         trace; both default off and add NOTHING to the traced program
@@ -277,7 +279,8 @@ class Net:
         batch = batch or {}
         ctx = LayerContext(phase=self.phase, rng=rng, iteration=iteration,
                            adc_bits=adc_bits, crossbar=crossbar,
-                           tiles=tiles, compute_dtype=compute_dtype,
+                           tiles=tiles, conv_im2col=conv_im2col,
+                           compute_dtype=compute_dtype,
                            seq_mesh=seq_mesh, seq_impl=seq_impl)
         run_layers = self.layer_range(start, end)
         produced_in_range = {t for l in run_layers for t in l.lp.top}

@@ -261,7 +261,19 @@ SETUP_FIELDS = {
     # conv weights sat on tiled crossbars. Non-empty list of layer
     # names; omitted entirely when every fault target is tiled.
     "tiles_bypassed": (str, False),
+    # conv im2col operand-mode trail (ISSUE 19): the RESOLVED mode a
+    # tiled-conv sweep traced ("premat" | "tilewise" | "implicit"),
+    # the recorded resolution reason (why a requested mode fell back,
+    # or — for implicit — that the backward still materializes patch
+    # rows), and the patch-operand share of bytes_per_step_est in
+    # bytes (SweepRunner.conv_patch_bytes_est). All three omitted
+    # when the run has no tiled conv layer.
+    "conv_im2col": (str, False),
+    "conv_im2col_reason": (str, False),
+    "conv_patch_bytes": (int, False),
 }
+
+CONV_IM2COL_MODES = ("premat", "tilewise", "implicit")
 
 # `fault_model` (optional, fault-engine runs) names the fault-process
 # stack the run trains under (fault/processes/): `spec` is the
@@ -688,7 +700,7 @@ def _validate_setup(rec) -> list:
                 errs.append(f"setup.cache.{key}: unknown state {val!r} "
                             f"(expected one of {SETUP_CACHE_STATES})")
     for key in ("decode_seconds", "compile_seconds", "setup_seconds",
-                "bytes_per_step_est"):
+                "bytes_per_step_est", "conv_patch_bytes"):
         val = rec.get(key)
         if isinstance(val, _NUM) and not isinstance(val, bool) \
                 and val < 0:
@@ -705,6 +717,14 @@ def _validate_setup(rec) -> list:
     if isinstance(fb, str) and not fb:
         errs.append("setup.engine_fallback_reason: must be non-empty "
                     "(omit the field when no fallback happened)")
+    cmode = rec.get("conv_im2col")
+    if isinstance(cmode, str) and cmode not in CONV_IM2COL_MODES:
+        errs.append(f"setup.conv_im2col: unknown mode {cmode!r} "
+                    f"(expected one of {CONV_IM2COL_MODES})")
+    creason = rec.get("conv_im2col_reason")
+    if isinstance(creason, str) and not creason:
+        errs.append("setup.conv_im2col_reason: must be non-empty "
+                    "(omit the field when there is nothing to say)")
     fm = rec.get("fault_model")
     if isinstance(fm, dict):
         errs += _check_fields(fm, FAULT_MODEL_FIELDS,

@@ -449,7 +449,10 @@ def make_setup_record(decode_s: float, compile_s: float,
                       config_shards: Optional[int] = None,
                       fault_model: Optional[dict] = None,
                       engine_fallback_reason: Optional[str] = None,
-                      tiles_bypassed=None) -> dict:
+                      tiles_bypassed=None,
+                      conv_im2col: Optional[str] = None,
+                      conv_im2col_reason: Optional[str] = None,
+                      conv_patch_bytes: Optional[int] = None) -> dict:
     """One `setup` record per process cold start (schema.py): the
     decode/compile split of the setup wall clock plus each cache's
     hit/miss — the record benches and CI track to hold the cold-start
@@ -466,7 +469,12 @@ def make_setup_record(decode_s: float, compile_s: float,
     `fault_model` (fault-engine runs) names the fault-process stack and
     its explicit parameters ({"spec": canonical_spec, "processes":
     {name: params}} — fault/processes/FaultSpec.to_model), so a log is
-    attributable to the physics that produced it."""
+    attributable to the physics that produced it. `conv_im2col` /
+    `conv_im2col_reason` / `conv_patch_bytes` (ISSUE 19, tiled-conv
+    sweeps) record the RESOLVED conv im2col operand mode, the
+    fallback/engagement reason, and the patch-operand share of
+    bytes_per_step_est — the mode is part of the run manifest, never
+    an invisible env var."""
     rec = {
         "schema_version": SCHEMA_VERSION,
         "type": "setup",
@@ -499,6 +507,12 @@ def make_setup_record(decode_s: float, compile_s: float,
         # tile spec did NOT cover — conv layers bypass the crossbar
         # mapping — so a tiled log names what stayed untiled
         rec["tiles_bypassed"] = [str(n) for n in tiles_bypassed]
+    if conv_im2col is not None:
+        rec["conv_im2col"] = str(conv_im2col)
+    if conv_im2col_reason is not None:
+        rec["conv_im2col_reason"] = str(conv_im2col_reason)
+    if conv_patch_bytes is not None:
+        rec["conv_patch_bytes"] = int(conv_patch_bytes)
     return rec
 
 

@@ -13,12 +13,30 @@ explicitly — `lax.conv_general_dilated_patches` rows against the
 flattened `(K, N) = (C_in/g*kh*kw, C_out)` weight view — so the GEMM
 can route through the same per-tile ADC crossbar read the InnerProduct
 path uses (fault/hw_aware.py `crossbar_matmul` on the pallas engine,
-`tiled_crossbar_matmul` on the jax engine). The patch operand is
-pre-materialized by default; `RRAM_CONV_IM2COL=tilewise` switches the
-jax engine to lazy per-K-tile slab extraction (bit-identical values —
-patch extraction is an exact gather — lower peak memory, re-extracted
-per tile). An un-named conv layer traces the exact pre-PR
-`conv_general_dilated` program.
+`tiled_crossbar_matmul` on the jax engine).
+
+The patch OPERAND MODE (ISSUE 19) is `LayerContext.conv_im2col`
+(threaded from `Solver(conv_im2col=)` / `SweepRunner(conv_im2col=)`;
+the `RRAM_CONV_IM2COL` env var remains the fallback for hand-built
+contexts), one of:
+
+- ``premat`` (default): the (N*OH*OW, C_in*kh*kw) patch matrix is
+  materialized once per forward. Both engines.
+- ``tilewise``: lazy per-K-tile slab extraction inside the jax
+  engine's tile loop (bit-identical values — patch extraction is an
+  exact gather — lower peak memory, re-extracted per tile). On the
+  pallas engine the solver resolves it to premat with a recorded
+  reason (the kernel already streams (bm, bk) slabs through VMEM).
+- ``implicit``: the patch matrix never exists in HBM. The pallas
+  engine gathers each (bm, bk) operand block IN-KERNEL from the raw
+  padded activation (`crossbar_conv_matmul`, fault/hw_aware.py); the
+  jax engine gathers each K-tile slab through the same precomputed
+  additive address plan (fault/mapping.py `im2col_index_plan`). Both
+  bit-identical to premat; backward replays the premat patches-based
+  VJP (v1 — the engine resolution records the note).
+
+An un-named conv layer traces the exact pre-PR `conv_general_dilated`
+program.
 """
 from __future__ import annotations
 
@@ -182,19 +200,40 @@ class ConvolutionLayer(_BaseConv):
         n = x.shape[0]
         oh, ow = self._out_hw(x)
         wv = w.reshape(w.shape[0], -1).T  # (K, C_out) im2col view
-        mode = os.environ.get("RRAM_CONV_IM2COL",
-                              "premat").strip().lower() or "premat"
-        if mode not in ("premat", "tilewise"):
+        mode = getattr(ctx, "conv_im2col", None)
+        if not mode:
+            mode = os.environ.get("RRAM_CONV_IM2COL",
+                                  "premat").strip().lower() or "premat"
+        if mode not in ("premat", "tilewise", "implicit"):
             raise ValueError(
-                f"RRAM_CONV_IM2COL={mode!r}: expected 'premat' "
-                "(pre-materialized patch operand) or 'tilewise' "
-                "(lazy per-K-tile slab extraction, jax engine)")
-        if cb is not None:
+                f"RRAM_CONV_IM2COL / conv_im2col={mode!r}: expected "
+                "'premat' (pre-materialized patch operand), 'tilewise' "
+                "(lazy per-K-tile slab extraction, jax engine) or "
+                "'implicit' (in-kernel / plan-driven patch gather)")
+        if cb is not None and mode == "implicit":
+            # Implicit-im2col Pallas read: the raw NCHW activation goes
+            # straight to the kernel, which gathers each (bm, bk)
+            # operand block via the static address plan — the patch
+            # matrix never exists in HBM (fault/hw_aware.py).
+            from ..fault.hw_aware import crossbar_conv_matmul
+            from ..fault.mapping import conv_geom, to_im2col
+            broken, stuck, seed, sigma, q_bits = cb[:5]
+            shard_mesh = cb[5] if len(cb) > 5 else None
+            geom = conv_geom(self.kernel, self.stride, self.pad,
+                             self.dilation)
+            y = crossbar_conv_matmul(
+                x.astype(jnp.float32), wv.astype(jnp.float32),
+                to_im2col(broken),
+                to_im2col(stuck).astype(jnp.float32),
+                seed, sigma, q_bits, (bk, bn, adc), geom,
+                shard_mesh).astype(x.dtype)
+        elif cb is not None:
             # Fused Pallas crossbar read (one launch per shard under
             # the sweep's config vmap / shard_map — the custom_vmap
-            # seam in fault/hw_aware.py): the patch operand is always
-            # pre-materialized, since the kernel's BlockSpec already
-            # streams (bm, bk) slabs of it through VMEM.
+            # seam in fault/hw_aware.py): the patch operand is
+            # pre-materialized (mode "tilewise" lands here too — the
+            # solver records that resolution — since the kernel's
+            # BlockSpec already streams (bm, bk) slabs through VMEM).
             from ..fault.hw_aware import crossbar_matmul
             from ..fault.mapping import to_im2col
             broken, stuck, seed, sigma, q_bits = cb[:5]
@@ -206,6 +245,28 @@ class ConvolutionLayer(_BaseConv):
                 to_im2col(stuck).astype(jnp.float32),
                 seed, sigma, q_bits, (bk, bn, adc),
                 shard_mesh).astype(x.dtype)
+        elif mode == "implicit":
+            # jax-engine implicit: plan-driven K-tile slab gather from
+            # the flat padded activation — same address plan as the
+            # kernel, fed to the lazy-operand tiled read. Gathers are
+            # exact, so every slab is byte-identical to the premat
+            # operand's columns.
+            from ..fault.hw_aware import tiled_crossbar_matmul_slabs
+            from ..fault.mapping import (conv_geom, im2col_index_plan,
+                                         pad_activation_flat)
+            geom = conv_geom(self.kernel, self.stride, self.pad,
+                             self.dilation)
+            rb_np, co_np, _, _, _ = im2col_index_plan(x.shape, geom)
+            xflat = pad_activation_flat(x, geom)
+            rb = jnp.asarray(rb_np)
+            co = jnp.asarray(co_np)
+
+            def slab(k0, k1):
+                return xflat[rb[:, None] + co[None, k0:k1]]
+
+            y = tiled_crossbar_matmul_slabs(
+                slab, wv, bk, bn, adc, n * oh * ow,
+                preferred_element_type=x.dtype)
         elif mode == "tilewise":
             from ..fault.hw_aware import tiled_crossbar_matmul_slabs
             khw = self.kernel[0] * self.kernel[1]

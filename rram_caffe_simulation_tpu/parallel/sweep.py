@@ -75,6 +75,20 @@ def _warn_engine_fallback(reason: str):
           file=sys.stderr)
 
 
+def _warn_conv_fallback(requested: str, resolved: str, reason: str):
+    """One-time stderr notice that a conv_im2col operand-mode request
+    resolved to a different mode (ISSUE 19's loud-fallback contract —
+    e.g. tilewise on the pallas engine, or implicit over unsupported
+    geometry); the reason also lands in
+    `SweepRunner.conv_im2col_reason` and the setup record."""
+    key = f"conv:{requested}->{resolved}:{reason}"
+    if key in _ENGINE_FALLBACK_WARNED:
+        return
+    _ENGINE_FALLBACK_WARNED.add(key)
+    print(f"[sweep] conv_im2col={requested!r} resolved to "
+          f"{resolved!r}: {reason}", file=sys.stderr)
+
+
 def stack_fault_states(key, param_shapes: Dict[str, tuple], pattern,
                        n_configs: int, means=None, stds=None, rows=None,
                        process=None, tiles=None):
@@ -194,7 +208,7 @@ class SweepRunner:
                  stall_timeout_s: Optional[float] = None,
                  engine: str = "jax", packed_state: bool = False,
                  dtype_policy=None, fused_epilogue=None,
-                 health_every: int = 0):
+                 health_every: int = 0, conv_im2col=None):
         if solver.fault_state is None:
             raise ValueError("SweepRunner needs a solver with a "
                              "failure_pattern")
@@ -209,7 +223,12 @@ class SweepRunner:
         # quantizes the fault-target weight reads through the
         # quantize_ste ADC grid; `fused_epilogue` (None=auto) fuses the
         # SGD update + packed fault transition into the kernel tail
-        # (fault/fused.py — banks read-modified-written in VMEM).
+        # (fault/fused.py — banks read-modified-written in VMEM);
+        # `conv_im2col` (None | premat | tilewise | implicit) picks how
+        # tiled conv layers build their im2col GEMM operand — implicit
+        # gathers it in-kernel / through the address plan, so the patch
+        # matrix never lands in HBM (ISSUE 19; the resolution lands on
+        # conv_im2col_resolved/_reason and in the setup record).
         # See fault/hw_aware.py ENGINE MATRIX.
         if engine == "auto":
             engine = "jax"     # sweeps opt in to pallas explicitly
@@ -507,7 +526,7 @@ class SweepRunner:
             apply_fn=apply_fn, dtype_policy=dtype_policy,
             fault_format="packed" if packed_state else "f32",
             pack_spec=self._pack_spec, shard_mesh=self._shard_mesh,
-            fused_epilogue=fused_epilogue)
+            fused_epilogue=fused_epilogue, conv_im2col=conv_im2col)
         # retained for the virtual-time vmap variant (per-lane batch /
         # iteration / rng axes — built lazily by enable_self_healing)
         self._base_step = base
@@ -532,6 +551,23 @@ class SweepRunner:
             base, "fused_epilogue_resolved", False)
         self.fused_epilogue_reason = getattr(
             base, "fused_epilogue_reason", None)
+        # conv im2col operand-mode resolution (ISSUE 19): the mode that
+        # actually traced (None = no tiled conv layer, mode inert) plus
+        # the solver's recorded reason — both land in the observe setup
+        # record. A resolved mode that differs from the request is the
+        # loud-fallback contract, same stderr channel as the engine.
+        self.conv_im2col_requested = getattr(
+            base, "conv_im2col_requested", "premat")
+        self.conv_im2col_resolved = getattr(
+            base, "conv_im2col_resolved", None)
+        self.conv_im2col_reason = getattr(base, "conv_im2col_reason",
+                                          None)
+        if (self.conv_im2col_resolved is not None
+                and self.conv_im2col_resolved
+                != self.conv_im2col_requested):
+            _warn_conv_fallback(self.conv_im2col_requested,
+                                self.conv_im2col_resolved,
+                                self.conv_im2col_reason or "")
         # axes: params, history, fault_state, batch(shared), it(shared),
         # rng(per-config), do_remap(shared)
         vstep = jax.vmap(base, in_axes=(0, 0, 0, None, None, 0, None))
@@ -1703,8 +1739,12 @@ class SweepRunner:
         the bandwidth estimate honest when the state is spread over N
         chips. Activations are excluded (shape-dependent and largely
         fused) — the estimate tracks the RESIDENT-state floor the
-        packed / quantized engines attack, not total traffic. bench.py
-        divides it by the measured step time for the
+        packed / quantized engines attack, not total traffic — with ONE
+        exception (ISSUE 19): materialized conv im2col patch operands
+        (`conv_patch_bytes_est`), the kh*kw× blow-up the implicit
+        operand mode exists to eliminate; leaving it out would make
+        premat and implicit look identical on the very axis they
+        differ. bench.py divides it by the measured step time for the
         achieved-bandwidth-floor figure in the BENCH trajectory."""
         cshards = int(self.mesh.shape.get("config", 1))
         dshards = int(self.mesh.shape.get("data", 1))
@@ -1726,7 +1766,57 @@ class SweepRunner:
             if self._batch_sharding is not None:
                 batch_bytes = -(-batch_bytes // dshards)
             total += batch_bytes
+        total += self.conv_patch_bytes_est()
         return int(total)
+
+    def conv_patch_bytes_est(self) -> int:
+        """Estimated per-chip bytes of the conv im2col patch operands
+        ONE sweep step materializes, by RESOLVED operand mode (ISSUE
+        19) — the term BENCH_CONV_TILED_r01 understated (it counted
+        only resident state while premat builds an (M, K) f32 patch
+        matrix per tiled conv layer per lane):
+
+        - premat: lanes_local * M * K * 4 per tiled conv layer (the
+          full patch matrix, M = N*OH*OW rows, K = C_in*kh*kw).
+        - tilewise: lanes_local * M * bk * 4 peak (one K-tile slab
+          live at a time, re-extracted per tile).
+        - implicit: lanes_local * padded-activation bytes (the flat
+          zero-padded NCHW copy the in-kernel gather reads — the only
+          operand-side array; the patch matrix never exists).
+
+        0 when no conv layer is tiled. Forward-pass estimate (the v1
+        implicit backward re-materializes patch rows; that cotangent
+        term is premat-shaped on every mode and excluded like all
+        other activation traffic)."""
+        solver = self.solver
+        tiles_ctx = (solver._tiles_ctx()
+                     if solver.fault_state is not None else None)
+        if not tiles_ctx:
+            return 0
+        mode = self.conv_im2col_resolved or "premat"
+        cshards = int(self.mesh.shape.get("config", 1))
+        lanes = -(-self.n // cshards)
+        total = 0
+        for lname, tl in tiles_ctx.items():
+            layer = solver.net.layer_by_name.get(lname)
+            if getattr(layer, "type_name", "") != "Convolution":
+                continue
+            n_, _, oh, ow = (int(d) for d in layer.top_shapes[0])
+            m = n_ * oh * ow
+            kdim = 1
+            for d in layer.weight_shape[1:]:
+                kdim *= int(d)
+            if mode == "premat":
+                total += m * kdim * 4
+            elif mode == "tilewise":
+                total += m * min(int(tl[0]), kdim) * 4
+            else:  # implicit
+                bshape = solver.net.blob_shapes[layer.lp.bottom[0]]
+                _, c_in, h, w = (int(d) for d in bshape[:4])
+                hp = h + 2 * int(layer.pad[0])
+                wp = w + 2 * int(layer.pad[1])
+                total += n_ * c_in * hp * wp * 4
+        return int(total * lanes)
 
     def setup_record(self, setup_s: Optional[float] = None) -> dict:
         """The schema-versioned `setup` record for this runner's cold
@@ -1751,6 +1841,13 @@ class SweepRunner:
         self.setup.fault_model = fs.to_model() if fs is not None else None
         self.setup.tiles_bypassed = getattr(
             self.solver, "tiles_bypassed", None) or None
+        # conv operand mode (ISSUE 19): the RESOLVED mode (absent when
+        # no conv layer is tiled), the fallback/engagement reason, and
+        # the measured patch-operand share of bytes_per_step_est
+        self.setup.conv_im2col = self.conv_im2col_resolved
+        self.setup.conv_im2col_reason = self.conv_im2col_reason
+        cpb = self.conv_patch_bytes_est()
+        self.setup.conv_patch_bytes = cpb if cpb else None
         return self.setup.record(setup_s)
 
     def _owned_config_block(self) -> tuple:

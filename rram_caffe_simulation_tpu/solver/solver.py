@@ -101,7 +101,7 @@ class Solver:
     def __init__(self, param, train_feed: Optional[Callable] = None,
                  test_feeds=None, compute_dtype=None,
                  fail_decrement: Optional[float] = None,
-                 fault_process=None, tile_spec=None):
+                 fault_process=None, tile_spec=None, conv_im2col=None):
         if isinstance(param, str):
             param = uio.read_solver_param(param)
         # cold-start layer: when RRAM_TPU_CACHE_DIR is set, every jitted
@@ -217,6 +217,17 @@ class Solver:
         if tile_spec is None and param.HasField("rram_forward"):
             tile_spec = getattr(param.rram_forward, "tiles", "") or None
         self.tile_spec = TileSpec.parse(tile_spec)
+        # Conv im2col operand mode (ISSUE 19): the first-class knob the
+        # RRAM_CONV_IM2COL env peek grew into. None = defer to the env
+        # var at make_train_step time, then "premat". Validated here so
+        # a typo is loud at construction, not at trace time.
+        if conv_im2col is not None:
+            conv_im2col = str(conv_im2col).strip().lower()
+            if conv_im2col not in ("premat", "tilewise", "implicit"):
+                raise ValueError(
+                    f"Solver(conv_im2col={conv_im2col!r}): expected "
+                    "'premat', 'tilewise' or 'implicit'")
+        self.conv_im2col = conv_im2col
         self._fault_keys = [fault_engine.param_key(r.layer_name, r.slot)
                             for r in self.net.failure_param_refs]
         if (param.HasField("failure_pattern")
@@ -494,7 +505,7 @@ class Solver:
                         with_metrics=None, with_debug=None,
                         dtype_policy=None, fault_format: str = "f32",
                         pack_spec=None, shard_mesh=None,
-                        fused_epilogue=None):
+                        fused_epilogue=None, conv_im2col=None):
         """Build the pure step function
         (params, history, fault_state, batch, it, rng, do_remap)
           -> (params', history', fault_state', loss, outputs, metrics)
@@ -577,7 +588,20 @@ class Solver:
         resolution lands on `step.fused_epilogue_resolved` /
         `step.fused_epilogue_reason` (and the engine fallback on
         `step.hw_engine_fallback_reason`) — bit-identical either way
-        (scripts/check_kernel_parity.py)."""
+        (scripts/check_kernel_parity.py).
+
+        `conv_im2col` (None | "premat" | "tilewise" | "implicit",
+        ISSUE 19) selects how tiled Convolution layers build their
+        im2col GEMM operand (ops/vision.py). None defers to
+        `Solver(conv_im2col=)`, then the RRAM_CONV_IM2COL env var, then
+        "premat". The RESOLVED mode + reason land on
+        `step.conv_im2col_resolved` / `step.conv_im2col_reason`
+        (None resolved = no tiled conv layer, the mode is inert):
+        "tilewise" on the pallas engine resolves to premat (recorded),
+        non-2-D geometry falls back to premat (recorded), and an
+        engaged "implicit" records the v1 backward note — every mode
+        is bit-identical to premat on losses and fault banks
+        (tests/test_conv_tiles.py, scripts/check_tiled_mapping.py)."""
         net = self.net
         param = self.param
         solver_type = self.type
@@ -743,6 +767,71 @@ class Solver:
                 if len(flat_shapes0[k].shape) > 2
                 and k.rsplit("/", 1)[0] in tiles_ctx}
 
+        # Conv im2col operand-mode resolution (ISSUE 19). Requested
+        # mode precedence: make_train_step arg > Solver(conv_im2col=) >
+        # RRAM_CONV_IM2COL env > "premat". The RESOLVED mode + reason
+        # land on the step function (mirroring hw_engine_resolved) and,
+        # via the SweepRunner, in the observe setup record — the mode
+        # is never invisible in run manifests again, and fallbacks are
+        # recorded, not silent.
+        conv_mode = conv_im2col
+        if conv_mode is None:
+            conv_mode = getattr(self, "conv_im2col", None)
+        if conv_mode is None:
+            conv_mode = (os.environ.get("RRAM_CONV_IM2COL", "")
+                         .strip().lower() or None)
+        conv_mode = str(conv_mode).strip().lower() if conv_mode \
+            else "premat"
+        if conv_mode not in ("premat", "tilewise", "implicit"):
+            raise ValueError(
+                f"conv_im2col={conv_mode!r}: expected 'premat', "
+                "'tilewise' or 'implicit'")
+        conv_tiled = [l for l in (tiles_ctx or {})
+                      if getattr(self.net.layer_by_name.get(l),
+                                 "type_name", "") == "Convolution"]
+        conv_mode_reason = None
+        if not conv_tiled:
+            # no tiled conv GEMM for the mode to select — record the
+            # inertness instead of claiming a mode that traced nothing
+            conv_mode_resolved = None
+            if conv_mode != "premat":
+                conv_mode_reason = (
+                    f"conv_im2col={conv_mode!r} is inert: no tiled "
+                    "Convolution fault target in this net")
+        elif use_pallas and conv_mode == "tilewise":
+            conv_mode_resolved = "premat"
+            conv_mode_reason = (
+                "tilewise is a jax-engine operand mode; the Pallas "
+                "kernel already streams (bm, bk) slabs of the premat "
+                "operand through VMEM — resolved to premat")
+        elif conv_mode == "implicit":
+            from ..fault.mapping import conv_geom
+            bad = None
+            for lname in conv_tiled:
+                layer = self.net.layer_by_name[lname]
+                try:
+                    conv_geom(layer.kernel, layer.stride, layer.pad,
+                              layer.dilation)
+                except ValueError as e:
+                    bad = f"{lname}: {e}"
+                    break
+            if bad is not None:
+                conv_mode_resolved = "premat"
+                conv_mode_reason = (
+                    f"implicit im2col unsupported — {bad}; resolved "
+                    "to premat")
+            else:
+                conv_mode_resolved = "implicit"
+                # engaged, with the v1 trade on record (the ISSUE's
+                # "the resolution must say so"): forward never builds
+                # the patch matrix, backward still does
+                conv_mode_reason = (
+                    "backward materializes im2col patch rows "
+                    "(patches-based VJP, v1); forward gathers "
+                    "in-kernel")
+        else:
+            conv_mode_resolved = conv_mode
+
         def _broken_stuck(fault_state, k):
             """The read-side broken mask + stuck values of one fault
             key, either format: packed compares the integer counter
@@ -824,6 +913,9 @@ class Solver:
                     # (gated above to the untiled spec) need not grow
                     # the kwarg
                     extra = {**extra, "tiles": tiles_ctx}
+                    if conv_tiled:
+                        extra = {**extra,
+                                 "conv_im2col": conv_mode_resolved}
                 blobs, loss, newp = (apply_fn or net.apply)(
                     p, run_batch, rng=rng, iteration=it, with_updates=True,
                     adc_bits=adc_bits, crossbar=crossbar,
@@ -1124,6 +1216,9 @@ class Solver:
         step.hw_engine_fallback_reason = engine_fallback_reason
         step.fused_epilogue_resolved = fused_on
         step.fused_epilogue_reason = None if fused_on else fused_reason
+        step.conv_im2col_requested = conv_mode
+        step.conv_im2col_resolved = conv_mode_resolved
+        step.conv_im2col_reason = conv_mode_reason
         return step
 
     def _compiled_step(self):
@@ -1987,6 +2082,14 @@ class Solver:
                          if self.fault_state is not None else None)
             extra = ({"tiles": tiles_ctx}
                      if tiles_ctx is not None else {})
+            # conv operand mode rides into test reads too (the jax
+            # path — no crossbar ctx at test time, so all three modes
+            # are valid); env fallback matches make_train_step
+            conv_mode = getattr(self, "conv_im2col", None) or \
+                (os.environ.get("RRAM_CONV_IM2COL", "")
+                 .strip().lower() or None)
+            if tiles_ctx is not None and conv_mode:
+                extra = {**extra, "conv_im2col": conv_mode}
 
             def run(params, batch, rng):
                 blobs, loss = net.apply(params, batch, rng=rng,

@@ -2,7 +2,7 @@
 """CI guard: every BENCH_*.json row is schema-valid and the trajectory
 is monotone-or-explained.
 
-Two row shapes exist on the trajectory and both are held to a shared
+Three row shapes exist on the trajectory and all are held to a shared
 minimal schema:
 
 - **parsed rows** (``BENCH_r05.json``, ``BENCH_TILED_IMAGENET_r01.json``):
@@ -11,7 +11,11 @@ minimal schema:
   ``value``;
 - **fleet rows** (``BENCH_FLEET_r01.json``, ``BENCH_FLEET_LOAD_r01.json``):
   flat dicts marked by a ``"bench"`` name with non-negative numeric
-  fields (``workers``, ``requests``, ``occupancy``, ...).
+  fields (``workers``, ``requests``, ``occupancy``, ...);
+- **raw rows** (``BENCH_CONV_TILED_r*.json``): the bench script's own
+  print shape — top-level ``{"metric", "value", "unit", "extra": {...}}``
+  with a positive numeric ``value`` and a ``note`` (top-level or in
+  ``extra``) saying what host/scale it measured.
 
 Rows group into SERIES by filename — ``BENCH_<SERIES>_r<N>[_variant]``
 (no series tag = the main img/s/chip line) — and within a series each
@@ -106,9 +110,32 @@ def validate_row(row):
         if not isinstance(row.get("note"), str) or not row.get("note"):
             errs.append("note: missing — a fleet row must explain "
                         "what it measured")
+    elif "metric" in row:
+        # the raw bench-print shape (BENCH_CONV_TILED_r*): the script's
+        # own JSON blob, no harness wrapper
+        metric = row.get("metric")
+        if not isinstance(metric, str) or not metric:
+            errs.append("metric: missing or empty")
+        value = row.get("value")
+        if not _is_num(value) or value <= 0:
+            errs.append("value: must be a positive number")
+        unit = row.get("unit")
+        if not isinstance(unit, str) or not unit:
+            errs.append("unit: must be a non-empty string")
+        extra = row.get("extra")
+        if extra is not None and not isinstance(extra, dict):
+            errs.append("extra: not an object")
+        note = row.get("note")
+        if not note and isinstance(extra, dict):
+            note = extra.get("note")
+        if not isinstance(note, str) or not note:
+            errs.append("note: missing — a raw row must say what "
+                        "host/scale it measured (top-level or "
+                        "extra.note)")
     else:
-        errs.append("row has neither 'parsed' (bench.py shape) nor "
-                    "'bench' (fleet shape) — unknown bench schema")
+        errs.append("row has neither 'parsed' (bench.py shape), "
+                    "'bench' (fleet shape), nor 'metric' (raw bench "
+                    "print) — unknown bench schema")
     return errs
 
 
@@ -125,12 +152,19 @@ def primary_metric(row):
         v = row.get("occupancy")
         if _is_num(v):
             return ("occupancy", float(v), True)
+    if "metric" in row:
+        v = row.get("value")
+        if _is_num(v):
+            return ("value", float(v), True)
     return None
 
 
 def noncomparable_reason(row):
+    extra = row.get("extra")
     note = (str(row.get("note") or "")
-            + " " + str(row.get("tail") or "")).lower()
+            + " " + str(row.get("tail") or "")
+            + " " + str(extra.get("note") if isinstance(extra, dict)
+                        else "")).lower()
     for marker in _NONCOMPARABLE:
         if marker in note:
             return marker

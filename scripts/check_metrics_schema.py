@@ -273,6 +273,14 @@ SAMPLE_GOOD_SETUP = {
     "fault_model": {"spec": "conductance_drift:nu=0.2"
                             "+endurance_stuck_at",
                     "processes": {"conductance_drift": {"nu": 0.2}}},
+    # conv im2col operand-mode trail (ISSUE 19): resolved mode,
+    # resolution reason, and the patch-operand share of
+    # bytes_per_step_est
+    "conv_im2col": "implicit",
+    "conv_im2col_reason": "backward materializes im2col patch rows "
+                          "(patches-based VJP, v1); forward gathers "
+                          "in-kernel",
+    "conv_patch_bytes": 4816896,
 }
 
 SAMPLE_BAD_SETUP = {
@@ -288,6 +296,9 @@ SAMPLE_BAD_SETUP = {
                         "nu": [0.2]}}},              # not number/string
     "pipeline": {"depth": 2,                         # chunks missing
                  "host_blocked_seconds": -0.5},      # negative time
+    "conv_im2col": "magic",                          # unknown mode
+    "conv_im2col_reason": "",                        # empty reason
+    "conv_patch_bytes": -4,                          # negative bytes
 }
 
 

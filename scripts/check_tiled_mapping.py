@@ -40,6 +40,14 @@ their im2col (K, N) views):
    path, fault-bank bytes identical.
 7. **Conv mismatched-spec restore refused**, naming both specs.
 
+8. **Implicit im2col identity (ISSUE 19)**: the same tiled conv sweep
+   with ``conv_im2col="implicit"`` (pallas engine: the (bm, bk)
+   operand block gathered INSIDE the kernel from the raw activation —
+   the flattened patch matrix never exists in HBM) is bit-exact to
+   the premat run on per-lane losses AND fault-bank bytes, with the
+   engagement asserted via the runner's recorded resolution (a silent
+   premat fallback would make the check vacuous).
+
     python scripts/check_tiled_mapping.py
 
 Exit status: 0 = all hold, 1 = any violation.
@@ -353,6 +361,35 @@ def main() -> int:
     cother.close()
     cj.close()
     cp.close()
+
+    # 8. implicit im2col (ISSUE 19): in-kernel gather == premat operand
+    ip = _runner(work, "conv_implicit_pre", tiles="cells=8x2",
+                 conv=True, engine="pallas")
+    ii = _runner(work, "conv_implicit", tiles="cells=8x2", conv=True,
+                 engine="pallas", conv_im2col="implicit")
+    if ii.engine_resolved != "pallas":
+        failures.append("implicit-im2col runner resolved to engine "
+                        f"{ii.engine_resolved!r} — the implicit check "
+                        "tested nothing")
+    if ii.conv_im2col_resolved != "implicit":
+        failures.append("conv_im2col='implicit' resolved to "
+                        f"{ii.conv_im2col_resolved!r} "
+                        f"({ii.conv_im2col_reason}) — a silent premat "
+                        "fallback makes this check vacuous")
+    l_ip = _run_chunks(ip)
+    l_ii = _run_chunks(ii)
+    if l_ip.tobytes() != l_ii.tobytes():
+        failures.append("implicit-im2col losses not bit-exact to the "
+                        f"premat operand:\n{l_ip}\nvs\n{l_ii}")
+    _compare_states(failures, "implicit-im2col state", ip, ii,
+                    prefix="fault/")
+    if not failures:
+        print("implicit im2col OK (in-kernel gather == premat "
+              "operand: per-lane losses bit-exact, fault banks "
+              "byte-identical; resolution recorded as "
+              f"{ii.conv_im2col_resolved!r})")
+    ip.close()
+    ii.close()
 
     if failures:
         print("\nTILED MAPPING GUARD FAILED:", file=sys.stderr)

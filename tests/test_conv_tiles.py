@@ -353,6 +353,202 @@ def test_conv_sweep_pallas_vs_jax_bit_identical(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# implicit im2col (ISSUE 19): in-kernel gather vs the premat operand
+
+
+def _cfg_mesh(n: int):
+    """A config-only mesh over the first n virtual CPU devices
+    (conftest forces an 8-device host)."""
+    from rram_caffe_simulation_tpu.parallel.mesh import make_mesh
+    return make_mesh({"config": n}, devices=jax.devices()[:n])
+
+
+def test_conv_implicit_layer_mode_bit_identical():
+    """conv_im2col='implicit' at the layer level (jax engine: plan-
+    driven gather slabs over the padded flat activation) is byte-
+    identical to premat and tilewise, including strided + padded
+    geometry — the gather IS the im2col extraction."""
+    from rram_caffe_simulation_tpu.core.registry import LayerContext
+    for pad, stride in ((1, 2), (0, 1), (2, 3)):
+        layer, ctx = _conv_layer(tiles=(7, 2), adc_bits=4, pad=pad,
+                                 stride=stride, in_shape=(3, 2, 7, 7))
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(3, 2, 7, 7).astype(np.float32))
+        w = jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32))
+        b = jnp.asarray(rng.randn(4).astype(np.float32))
+        outs = {}
+        for mode in (None, "tilewise", "implicit"):
+            mctx = LayerContext(phase=ctx.phase, adc_bits=ctx.adc_bits,
+                                tiles=ctx.tiles, conv_im2col=mode)
+            (y,), _ = layer.apply([w, b], [x], mctx)
+            outs[mode] = np.asarray(y).tobytes()
+        assert outs[None] == outs["tilewise"] == outs["implicit"], \
+            f"operand modes diverged at pad={pad} stride={stride}"
+
+
+def test_conv_implicit_backward_parity():
+    """The implicit conv VJP (patches-based, v1) must match the premat
+    backward bit-for-bit: same quantize/mask replay, same patch_vjp
+    scatter — dx AND dw byte-identical, with and without noise/quant."""
+    from rram_caffe_simulation_tpu.fault.hw_aware import (
+        crossbar_conv_matmul, crossbar_matmul)
+    from rram_caffe_simulation_tpu.fault.mapping import (
+        conv_geom, conv_patch_rows)
+    rng = np.random.RandomState(5)
+    geom = conv_geom((3, 3), (2, 2), (1, 1), (1, 1))
+    x = jnp.asarray(rng.randn(2, 2, 6, 6).astype(np.float32))
+    w = jnp.asarray(rng.randn(18, 4).astype(np.float32))
+    broken = jnp.asarray(rng.rand(18, 4) < 0.2)
+    stuck = jnp.asarray(np.where(rng.rand(18, 4) < 0.5, 1.0, -1.0)
+                        .astype(np.float32))
+    seed = jnp.uint32(7)
+    tiles = (8, 3, 3)                       # (bk, bn, adc_bits)
+    for sigma, q_bits in ((0.0, 0), (0.1, 3)):
+        def f_imp(x, w):
+            return jnp.sum(crossbar_conv_matmul(
+                x, w, broken, stuck, seed, sigma, q_bits, tiles,
+                geom) ** 2)
+
+        def f_pre(x, w):
+            rows = conv_patch_rows(x, geom)
+            return jnp.sum(crossbar_matmul(
+                rows, w, broken, stuck, seed, sigma, q_bits,
+                tiles) ** 2)
+
+        gi = jax.grad(f_imp, argnums=(0, 1))(x, w)
+        gp = jax.grad(f_pre, argnums=(0, 1))(x, w)
+        for a, b in zip(gi, gp):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+                f"grad diverged at sigma={sigma} q_bits={q_bits}"
+
+
+def test_conv_sweep_tilewise_slabs_under_config_vmap(tmp_path):
+    """tilewise K-slab extraction under the sweep's config vmap (jax
+    engine, n_configs > 1) stays byte-identical to premat — losses AND
+    fault banks; the resolution lands on the runner."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    mk = lambda d, **kw: SweepRunner(
+        conv_solver(tmp_path / d, mean=250.0, std=30.0, adc_bits=0,
+                    tile_spec="cells=8x2"),
+        n_configs=3, engine="jax", dtype_policy="ternary", **kw)
+    r_pre = mk("pre")
+    r_tw = mk("tw", conv_im2col="tilewise")
+    assert r_tw.conv_im2col_resolved == "tilewise"
+    l_pre, _ = r_pre.step(4, chunk=2)
+    l_tw, _ = r_tw.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_pre), np.asarray(l_tw))
+    for g in r_pre.fault_states:
+        for k in r_pre.fault_states[g]:
+            assert (np.asarray(r_pre.fault_states[g][k]).tobytes()
+                    == np.asarray(r_tw.fault_states[g][k]).tobytes())
+
+
+def test_conv_sweep_implicit_pallas_bit_identical(tmp_path):
+    """The tentpole contract, single device: conv_im2col='implicit' on
+    the Pallas engine (in-kernel gather from the raw activation; the
+    patch matrix never exists in HBM) reproduces the premat sweep
+    exactly — losses AND fault-bank bytes — and the setup record says
+    so, with the patch-operand share shrunk accordingly."""
+    from rram_caffe_simulation_tpu.observe import schema as obs_schema
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    mk = lambda d, **kw: SweepRunner(
+        conv_solver(tmp_path / d, mean=250.0, std=30.0, adc_bits=0,
+                    tile_spec="cells=8x2"),
+        n_configs=2, engine="pallas", dtype_policy="ternary", **kw)
+    r_pre = mk("pre")
+    r_imp = mk("imp", conv_im2col="implicit")
+    assert r_imp.engine_resolved == "pallas"
+    assert r_imp.conv_im2col_resolved == "implicit"
+    assert "backward" in r_imp.conv_im2col_reason   # v1 caveat recorded
+    l_pre, _ = r_pre.step(4, chunk=2)
+    l_imp, _ = r_imp.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_pre), np.asarray(l_imp))
+    for g in r_pre.fault_states:
+        for k in r_pre.fault_states[g]:
+            assert (np.asarray(r_pre.fault_states[g][k]).tobytes()
+                    == np.asarray(r_imp.fault_states[g][k]).tobytes()), \
+                f"fault bank {g}/{k} diverged across operand modes"
+    # bytes accounting: the implicit patch share (raw padded activation)
+    # is smaller than premat's M*K rows, and bytes_per_step_est carries
+    # the difference
+    assert 0 < r_imp.conv_patch_bytes_est() < r_pre.conv_patch_bytes_est()
+    assert (r_pre.bytes_per_step_est() - r_imp.bytes_per_step_est()
+            == r_pre.conv_patch_bytes_est() - r_imp.conv_patch_bytes_est())
+    for r, mode in ((r_pre, "premat"), (r_imp, "implicit")):
+        rec = r.setup_record()
+        assert rec["conv_im2col"] == mode
+        assert rec["conv_patch_bytes"] == r.conv_patch_bytes_est()
+        assert obs_schema.validate_record(rec) == []
+
+
+def test_conv_sweep_implicit_config_sharded_bit_identical(tmp_path):
+    """conv_im2col='implicit' under the config-SHARDED mesh (shard_map
+    dispatch, packed banks, fused epilogue engaged) is bit-exact vs
+    the single-device premat sweep on losses and raw packed banks."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    mk = lambda d, mesh, **kw: SweepRunner(
+        conv_solver(tmp_path / d, mean=250.0, std=30.0, adc_bits=0,
+                    tile_spec="cells=8x2"),
+        n_configs=2, mesh=mesh, engine="pallas",
+        dtype_policy="ternary", packed_state=True, **kw)
+    r_pre = mk("pre", _cfg_mesh(1))
+    r_sh = mk("sh", _cfg_mesh(2), conv_im2col="implicit")
+    assert r_sh.engine_resolved == "pallas"
+    assert r_sh.conv_im2col_resolved == "implicit"
+    assert r_sh._shard_mesh is not None      # the shard_map dispatch
+    assert r_sh.fused_epilogue_resolved      # fused tail engaged
+    l_pre, _ = r_pre.step(4, chunk=2)
+    l_sh, _ = r_sh.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_pre), np.asarray(l_sh))
+    for g in ("life_q", "stuck_bits"):
+        for k in r_pre.fault_states[g]:
+            assert (np.asarray(r_pre.fault_states[g][k]).tobytes()
+                    == np.asarray(r_sh.fault_states[g][k]).tobytes()), \
+                f"packed bank {g}/{k} diverged under the sharded mesh"
+
+
+def test_conv_tilewise_on_pallas_resolves_premat(tmp_path):
+    """tilewise is a jax-engine operand mode; requesting it on the
+    Pallas engine falls back to premat LOUDLY — recorded reason, same
+    losses."""
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    mk = lambda d, **kw: SweepRunner(
+        conv_solver(tmp_path / d, mean=250.0, std=30.0, adc_bits=0,
+                    tile_spec="cells=8x2"),
+        n_configs=2, engine="pallas", dtype_policy="ternary", **kw)
+    r_pre = mk("pre")
+    r_tw = mk("tw", conv_im2col="tilewise")
+    assert r_tw.conv_im2col_requested == "tilewise"
+    assert r_tw.conv_im2col_resolved == "premat"
+    assert "tilewise" in r_tw.conv_im2col_reason
+    l_pre, _ = r_pre.step(4, chunk=2)
+    l_tw, _ = r_tw.step(4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(l_pre), np.asarray(l_tw))
+
+
+def test_conv_im2col_solver_knob_and_env_fallback(tmp_path, monkeypatch):
+    """Solver(conv_im2col=) is the first-class knob; the
+    RRAM_CONV_IM2COL env peek stays as fallback; unknown values raise
+    at construction."""
+    monkeypatch.delenv("RRAM_CONV_IM2COL", raising=False)
+    s = conv_solver(tmp_path / "a")
+    assert s.conv_im2col is None
+    with pytest.raises(ValueError, match="conv_im2col"):
+        from rram_caffe_simulation_tpu.solver import Solver as _S
+        sp = s.param
+        _S(sp, train_feed=lambda: {}, conv_im2col="bogus")
+    # env fallback reaches the step resolution when no knob is set
+    from rram_caffe_simulation_tpu.parallel import SweepRunner
+    monkeypatch.setenv("RRAM_CONV_IM2COL", "implicit")
+    r_env = SweepRunner(
+        conv_solver(tmp_path / "env", mean=250.0, std=30.0,
+                    adc_bits=0, tile_spec="cells=8x2"),
+        n_configs=2, engine="jax", dtype_policy="ternary")
+    assert r_env.conv_im2col_requested == "implicit"
+    assert r_env.conv_im2col_resolved == "implicit"
+
+
+# ---------------------------------------------------------------------------
 # per-tile census + health records for conv params
 
 
