@@ -55,8 +55,9 @@ from .metrics_registry import (MetricsRegistry, fold_record,
                                parse_exposition, registry_from_stats,
                                registry_from_streams, validate_exposition)
 from .sink import (CaffeLogSink, JsonlSink, MetricsLogger, alert_line,
-                   debug_trace_lines, fault_redraw_line, health_line,
-                   make_alert_record, make_fault_redraw_record,
+                   chaos_line, debug_trace_lines, fault_redraw_line,
+                   health_line, make_alert_record, make_chaos_record,
+                   make_fault_redraw_record,
                    make_health_record, make_record, make_request_record,
                    make_retry_record, make_setup_record,
                    make_worker_record, request_line, retry_line,
@@ -74,6 +75,7 @@ __all__ = [
     "make_fault_redraw_record", "fault_redraw_line",
     "make_worker_record", "worker_line",
     "make_alert_record", "alert_line",
+    "make_chaos_record", "chaos_line",
     "make_health_record", "health_line",
     "CensusProgram", "HealthLedger", "LIFE_EDGES", "AGE_EDGES",
     "RUL_THRESHOLD",

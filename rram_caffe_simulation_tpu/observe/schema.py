@@ -46,7 +46,9 @@ Further record types are keyed by a `"type"` field (records without one
 are the metrics record above): `setup` — one per process cold start,
 the decode/compile breakdown plus per-cache hit/miss (documented inline
 below) — `retry`, `request`, `worker` (fleet-service worker lifecycle,
-serve/fleet/), `fault_redraw`, `span` (host-side time spans from
+serve/fleet/), `alert` (watchtower rule transitions), `chaos`
+(deterministic failure injections, serve/fleet/chaos.py),
+`fault_redraw`, `span` (host-side time spans from
 observe/spans.py, documented inline below), and two that carry the
 `debug_info` deep traces:
 
@@ -474,6 +476,40 @@ ALERT_FIELDS = {
     "reason": (str, False),         # human-readable one-liner
 }
 
+# --- chaos records (deterministic failure injection) ---
+#
+# Emitted by the fleet chaos plane (serve/fleet/chaos.py) at the
+# moment each seeded injection is applied, so a trace reads as "what
+# was done to the fleet" next to the `worker`/`alert` records showing
+# how the fleet survived it. `iter` is the plan's own monotonic beat
+# clock (it keeps counting across controller restarts), `seed` the
+# plan seed that makes the schedule reproducible, `target` the victim
+# (a worker id, or the torn file's path), `stage` the beat stage a
+# controller kill struck at, `offset` the byte offset a torn/truncated
+# write stopped at, and `beats` a stall's duration::
+#
+#     {"schema_version": 1, "type": "chaos", "iter": 12,
+#      "wall_time": 1722700000.1, "event": "controller_kill",
+#      "seed": 7, "stage": "route", "offset": 113,
+#      "reason": "SIGKILL mid-beat between claim and copy"}
+
+CHAOS_EVENTS = ("worker_kill", "controller_kill", "torn_write",
+                "socket_drop", "socket_timeout", "heartbeat_stall")
+
+CHAOS_FIELDS = {
+    "schema_version": (int, True),
+    "type": (str, True),
+    "iter": (int, True),            # chaos-plan beat clock
+    "wall_time": (_NUM, True),
+    "event": (str, True),           # one of CHAOS_EVENTS
+    "seed": (int, False),           # plan seed (schedule reproducer)
+    "target": (str, False),         # victim worker id / torn file path
+    "stage": (str, False),          # controller_kill: beat stage hit
+    "offset": (int, False),         # torn write / commit byte offset
+    "beats": (int, False),          # heartbeat_stall: beats stalled
+    "reason": (str, False),         # human-readable one-liner
+}
+
 # --- fault_redraw records (restore fallback announcement) ---
 #
 # Emitted by Solver.restore when a snapshot PREDATES fault-state
@@ -855,6 +891,25 @@ def _validate_alert(rec) -> list:
     return errs
 
 
+def _validate_chaos(rec) -> list:
+    errs = _check_fields(rec, CHAOS_FIELDS, "chaos")
+    errs += _check_iter(rec, "chaos")
+    event = rec.get("event")
+    if isinstance(event, str) and event not in CHAOS_EVENTS:
+        errs.append(f"chaos: unknown event {event!r} "
+                    f"(expected one of {CHAOS_EVENTS})")
+    for key in ("target", "stage", "reason"):
+        val = rec.get(key)
+        if isinstance(val, str) and not val:
+            errs.append(f"chaos: {key} must be non-empty")
+    for key, lo in (("seed", 0), ("offset", 0), ("beats", 1)):
+        val = rec.get(key)
+        if isinstance(val, int) and not isinstance(val, bool) \
+                and val < lo:
+            errs.append(f"chaos: {key} must be >= {lo}")
+    return errs
+
+
 def _validate_fault_redraw(rec) -> list:
     errs = _check_fields(rec, FAULT_REDRAW_FIELDS, "fault_redraw")
     errs += _check_iter(rec, "fault_redraw")
@@ -1018,6 +1073,8 @@ def validate_record(rec) -> list:
         return _check_version(rec) + _validate_worker(rec)
     if rtype == "alert":
         return _check_version(rec) + _validate_alert(rec)
+    if rtype == "chaos":
+        return _check_version(rec) + _validate_chaos(rec)
     if rtype == "health":
         return _check_version(rec) + _validate_health(rec)
     if rtype == "span":

@@ -347,6 +347,61 @@ def alert_line(record: dict) -> str:
     return head + tail
 
 
+def make_chaos_record(iteration: int, event: str,
+                      seed: Optional[int] = None,
+                      target: Optional[str] = None,
+                      stage: Optional[str] = None,
+                      offset: Optional[int] = None,
+                      beats: Optional[int] = None,
+                      reason: Optional[str] = None) -> dict:
+    """One deterministic failure injection (schema.py CHAOS_FIELDS):
+    emitted by the fleet chaos plane (serve/fleet/chaos.py) at the
+    moment the injection is applied — worker_kill / controller_kill /
+    torn_write / socket_drop / socket_timeout / heartbeat_stall — so
+    a trace shows exactly what was done to the fleet alongside the
+    `worker` and `alert` records showing how it survived. `iteration`
+    is the plan's own beat clock (monotonic across controller
+    restarts)."""
+    rec = {
+        "schema_version": SCHEMA_VERSION,
+        "type": "chaos",
+        "iter": int(iteration),
+        "wall_time": time.time(),
+        "event": str(event),
+    }
+    if seed is not None:
+        rec["seed"] = int(seed)
+    if target is not None:
+        rec["target"] = str(target)
+    if stage is not None:
+        rec["stage"] = str(stage)
+    if offset is not None:
+        rec["offset"] = int(offset)
+    if beats is not None:
+        rec["beats"] = int(beats)
+    if reason is not None:
+        rec["reason"] = str(reason)
+    return rec
+
+
+def chaos_line(record: dict) -> str:
+    """One-line text form of a `chaos` record."""
+    head = f"CHAOS {record.get('event')}"
+    if record.get("target"):
+        head += f" -> {record['target']}"
+    if record.get("stage"):
+        head += f" at stage {record['stage']}"
+    if record.get("offset") is not None:
+        head += f" (byte offset {record['offset']})"
+    if record.get("beats") is not None:
+        head += f" for {record['beats']} beat(s)"
+    if record.get("seed") is not None:
+        head += f" [seed {record['seed']}]"
+    if record.get("reason"):
+        head += f" — {record['reason']}"
+    return head
+
+
 def make_fault_redraw_record(iteration: int, snapshot: str,
                              reason: str,
                              tiles: Optional[str] = None) -> dict:
@@ -783,6 +838,10 @@ class CaffeLogSink:
             return
         if rtype == "worker":
             self._emit(worker_line(record))
+            self._maybe_flush()
+            return
+        if rtype == "chaos":
+            self._emit(chaos_line(record))
             self._maybe_flush()
             return
         if rtype == "span":

@@ -23,11 +23,20 @@ workers, pinned-program routing and hot swap.
   ``caffe fleet top`` (top.py) renders the live view —
   `scripts/check_fleet_load.py` is the CI guard (load replay, alert
   lifecycle, rollup parse, byte-identity under monitoring).
+- `ChaosPlan` (chaos.py): a seeded, reproducible failure-injection
+  schedule on the controller's beat clock — worker SIGKILL, mid-beat
+  controller kills (including a commit record torn at a seeded byte
+  offset), torn spool/table writes, dropped/timed-out scrapes,
+  stalled heartbeats — each applied injection a schema-validated
+  ``chaos`` record; `scripts/check_fleet_chaos.py` is the CI guard
+  (exactly-once terminal records and byte-identical results under
+  chaos, across multiple seeds).
 
 Run the controller with ``python -m rram_caffe_simulation_tpu.serve.fleet``
 and workers with ``python -m rram_caffe_simulation_tpu.serve.fleet.worker``.
 """
 from .alerts import AlertEngine, AlertRule, default_rules
+from .chaos import KILL_STAGES, ChaosPlan, ControllerKilled
 from .router import (effective_pins, pick_swap_victim, pick_worker,
                      request_pins, requeue_plan, route, swap_target,
                      worker_load, worker_matches)
@@ -37,6 +46,7 @@ from .table import PIN_KEYS, WorkerTable
 __all__ = [
     "FleetController", "FleetWorker", "WorkerTable", "BacklogScaler",
     "AlertEngine", "AlertRule", "default_rules",
+    "ChaosPlan", "ControllerKilled", "KILL_STAGES",
     "PIN_KEYS", "request_pins", "effective_pins", "worker_matches",
     "worker_load", "pick_worker", "pick_swap_victim", "swap_target",
     "route", "requeue_plan",

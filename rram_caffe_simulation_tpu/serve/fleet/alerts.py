@@ -72,6 +72,22 @@ DEFAULT_RULES = (
      "when_above": 0.0,
      "help": "a crossbar tile's broken-cell fraction crossed the "
              "remap-spare cliff"},
+    # chaos / exactly-once hardening (ISSUE 20): scrape_failures_max
+    # is the WORST per-worker consecutive-failure streak — transient
+    # blips (streak 1-2) ride through the retry/backoff without
+    # paging anyone, a wedged socket (streak 3+) fires after two
+    # beats and clears two beats after the first successful scrape
+    {"name": "scrape_failures", "metric": "scrape_failures_max",
+     "op": ">", "threshold": 2.0, "for_beats": 2, "clear_beats": 2,
+     "severity": "warn",
+     "help": "a worker's metrics socket has failed several "
+             "consecutive scrapes (backoff active; rollup degraded "
+             "to heartbeat rows for that worker)"},
+    {"name": "poison_quarantine", "metric": "poison_total",
+     "op": "delta>", "threshold": 0.0, "for_beats": 1,
+     "clear_beats": 3, "severity": "warn",
+     "help": "torn/unparseable spool, worker-table, or state files "
+             "were quarantined to <fleet>/poison/ this beat"},
 )
 
 

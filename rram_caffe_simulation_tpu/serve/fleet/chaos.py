@@ -1,0 +1,327 @@
+"""Deterministic chaos-injection plane for the sweep fleet (ISSUE 20).
+
+The paper's thesis is that hardware faults are inevitable and must be
+survived by design; the fleet that simulates those faults at scale
+deserves the same treatment. A `ChaosPlan` is a SEEDED, reproducible
+schedule of failure injections on the controller's beat clock:
+
+==================  =================================================
+injection           what it does / what it exercises
+==================  =================================================
+``worker_kill``     SIGKILL a live same-host worker pid — dead-worker
+                    detection, at-least-once requeue, exactly-once
+                    harvest dedup across the retry
+``controller_kill`` raise `ControllerKilled` at a seeded beat STAGE
+                    (after reap / harvest / mid-route between a claim
+                    and its worker copy / at the state.json commit,
+                    torn at a seeded byte offset) — the harness cold-
+                    restarts the controller on the same fleet dir and
+                    the journaled beat must recover with no lost,
+                    orphaned, or double-routed request
+``torn_write``      write truncated JSON bytes directly into the
+                    fleet spool's pending/ or the worker table — the
+                    poison-quarantine path (`<fleet>/poison/`)
+``socket_drop``     fail every worker scrape this beat as a refused
+                    connection — scrape failure counters + backoff +
+                    the `scrape_failures` alert rule
+``socket_timeout``  same, surfaced as a timeout
+``heartbeat_stall`` backdate a worker row's heartbeat — the stale-
+                    heartbeat reap arm and the live-pid 10x grace
+==================  =================================================
+
+Every applied injection lands as a schema-validated ``chaos`` record
+(observe/schema.py CHAOS_FIELDS) on ``<fleet>/fleet.jsonl``, so a
+trace shows exactly what was done to the fleet next to the `worker`
+and `alert` records showing how it survived.
+
+The plan keeps its OWN monotonic beat clock (`tick`), so the schedule
+is immune to controller restarts — a controller killed at plan-beat 7
+resumes the same schedule at plan-beat 8 when its replacement starts
+beating. Same seed, same knobs => byte-identical schedule: the guard
+(`scripts/check_fleet_chaos.py`) replays failures across >= 3 seeds.
+
+Dependency-free like router/table/alerts (no jax; the observe record
+builder is imported lazily), so tests drive it without the framework.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import time
+from typing import List, Optional
+
+from ..spool import _atomic_write
+
+#: beat stages a controller_kill can strike at (checkpoint() names)
+KILL_STAGES = ("reap", "harvest", "claim", "route", "commit")
+
+
+class ControllerKilled(Exception):
+    """Raised mid-beat by an armed controller_kill injection. The
+    harness treats it as the SIGKILL it simulates: discard the
+    controller object and cold-restart one on the same fleet dir."""
+
+    def __init__(self, stage: str, offset: Optional[int] = None):
+        self.stage = stage
+        self.offset = offset
+        msg = f"chaos: controller killed at stage {stage!r}"
+        if offset is not None:
+            msg += f" (commit torn at byte {offset})"
+        super().__init__(msg)
+
+
+def _append_jsonl(path: str, rec: dict):
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+class ChaosPlan:
+    """One seeded, reproducible chaos schedule (see module docstring).
+
+    Attach to a controller with ``FleetController(..., chaos=plan)``;
+    the controller calls `begin_beat` first thing every beat and
+    `maybe_kill(stage)` at its transaction checkpoints. The plan
+    object must outlive controller restarts (the harness holds it) —
+    its beat clock and remaining schedule carry across."""
+
+    def __init__(self, seed: int, *,
+                 horizon_beats: int = 32,
+                 start_beat: int = 2,
+                 worker_kills: int = 1,
+                 controller_kills: int = 1,
+                 torn_writes: int = 2,
+                 socket_drops: int = 2,
+                 heartbeat_stalls: int = 1,
+                 stall_s: float = 30.0,
+                 kill_stages: tuple = KILL_STAGES):
+        if int(horizon_beats) <= int(start_beat):
+            raise ValueError("horizon_beats must exceed start_beat")
+        self.seed = int(seed)
+        self.stall_s = float(stall_s)
+        rng = random.Random(self.seed)
+
+        def beats(n):
+            return [rng.randrange(int(start_beat),
+                                  int(horizon_beats)) for _ in range(n)]
+
+        events: List[dict] = []
+        for b in beats(worker_kills):
+            events.append({"beat": b, "event": "worker_kill",
+                           "pick": rng.randrange(1 << 30)})
+        for b in beats(controller_kills):
+            events.append({"beat": b, "event": "controller_kill",
+                           "stage": rng.choice(list(kill_stages)),
+                           "offset": rng.randrange(4096)})
+        for b in beats(torn_writes):
+            events.append({"beat": b, "event": "torn_write",
+                           "offset": rng.randrange(8, 160),
+                           "pick": rng.randrange(1 << 30)})
+        for b in beats(socket_drops):
+            events.append({"beat": b,
+                           "event": rng.choice(["socket_drop",
+                                                "socket_timeout"])})
+        for b in beats(heartbeat_stalls):
+            events.append({"beat": b, "event": "heartbeat_stall",
+                           "pick": rng.randrange(1 << 30)})
+        events.sort(key=lambda e: (e["beat"], e["event"]))
+        #: the full generated schedule (introspection / guard asserts)
+        self.schedule: List[dict] = [dict(e) for e in events]
+        self._pending: List[dict] = events
+        self.beat = 0                  # the plan's own monotonic clock
+        self._armed_kill: Optional[dict] = None
+        self._socket_fault: Optional[str] = None
+        self._metrics_path: Optional[str] = None
+        #: applied injections, as the emitted chaos records
+        self.applied: List[dict] = []
+
+    # ------------------------------------------------------------------
+    # record plumbing
+
+    def _emit(self, event: str, **kw):
+        from ...observe import make_chaos_record
+        kw = {k: v for k, v in kw.items() if v is not None}
+        rec = make_chaos_record(self.beat, event, seed=self.seed, **kw)
+        self.applied.append(rec)
+        if self._metrics_path:
+            try:
+                _append_jsonl(self._metrics_path, rec)
+            except OSError:
+                pass
+        return rec
+
+    # ------------------------------------------------------------------
+    # the controller-facing surface
+
+    def tick(self) -> int:
+        self.beat += 1
+        return self.beat
+
+    @property
+    def socket_fault(self) -> Optional[str]:
+        """"drop" / "timeout" while a socket injection covers this
+        beat — `_scrape_worker` consults it instead of the socket."""
+        return self._socket_fault
+
+    def begin_beat(self, controller) -> List[dict]:
+        """Advance the plan clock and apply every injection due at
+        this plan beat. Returns the chaos records emitted. A due
+        controller_kill only ARMS here — it fires at its stage via
+        `maybe_kill` so the strike lands mid-transaction."""
+        self.tick()
+        self._metrics_path = controller.metrics_path
+        self._socket_fault = None
+        applied = []
+        while self._pending and self._pending[0]["beat"] <= self.beat:
+            ev = self._pending.pop(0)
+            kind = ev["event"]
+            if kind == "worker_kill":
+                applied.append(self._kill_worker(controller, ev))
+            elif kind == "controller_kill":
+                if self._armed_kill is None:
+                    self._armed_kill = ev
+                else:           # one armed kill at a time; defer
+                    ev["beat"] = self.beat + 1
+                    self._pending.insert(0, ev)
+                    break
+            elif kind == "torn_write":
+                applied.append(self._torn_write(controller, ev))
+            elif kind in ("socket_drop", "socket_timeout"):
+                self._socket_fault = ("drop" if kind == "socket_drop"
+                                      else "timeout")
+                applied.append(self._emit(
+                    kind, reason="worker metric scrapes fail this "
+                                 "beat"))
+            elif kind == "heartbeat_stall":
+                applied.append(self._stall_heartbeat(controller, ev))
+        return [a for a in applied if a is not None]
+
+    def maybe_kill(self, stage: str):
+        """Controller checkpoint: raise `ControllerKilled` when an
+        armed kill names this stage. Stage "commit" is handled by
+        `tear_commit` instead (the kill tears the commit record). An
+        armed "claim" kill whose beat routed NOTHING (the claim
+        checkpoint is per-request) degrades to the end-of-route
+        checkpoint, so every scheduled kill applies deterministically
+        instead of hanging armed forever on an idle fleet."""
+        armed = self._armed_kill
+        if armed is None or armed["stage"] == "commit":
+            return
+        if armed["stage"] != stage \
+                and not (stage == "route" and armed["stage"] == "claim"):
+            return
+        self._armed_kill = None
+        self._emit("controller_kill", stage=stage,
+                   reason="SIGKILL mid-beat; cold restart must "
+                          "recover with no lost or duplicated request")
+        raise ControllerKilled(stage)
+
+    def tear_commit(self, state_path: str, payload: dict):
+        """Stage-"commit" kill: the simulated SIGKILL lands mid-write
+        of state.json, so the commit record is left TORN at the seeded
+        byte offset (written directly, not via the atomic tempfile —
+        that is the point), then the controller dies. Restart must
+        quarantine the torn record and rebuild from the spool."""
+        armed = self._armed_kill
+        if armed is None or armed["stage"] != "commit":
+            return
+        self._armed_kill = None
+        blob = json.dumps(payload, indent=2).encode()
+        offset = armed["offset"] % max(1, len(blob))
+        with open(state_path, "wb") as f:
+            f.write(blob[:offset])
+        self._emit("controller_kill", stage="commit", offset=offset,
+                   target=state_path,
+                   reason="SIGKILL mid-write of the state.json commit "
+                          "record; the torn file must quarantine on "
+                          "restart")
+        raise ControllerKilled("commit", offset)
+
+    # ------------------------------------------------------------------
+    # individual injections
+
+    def _kill_worker(self, controller, ev) -> Optional[dict]:
+        rows = controller.table.rows()
+        victims = sorted(
+            wid for wid, row in rows.items()
+            if row.get("pid") and row.get("host") == _hostname())
+        if not victims:
+            return None
+        wid = victims[ev["pick"] % len(victims)]
+        pid = int(rows[wid]["pid"])
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            return None
+        return self._emit("worker_kill", target=wid,
+                          reason=f"SIGKILL pid {pid}; in-flight "
+                                 "requests must requeue exactly once")
+
+    def _torn_write(self, controller, ev) -> dict:
+        """Drop truncated JSON bytes under a live consumer directory —
+        alternating between the fleet spool's pending/ and the worker
+        table — exercising the poison quarantine instead of a beat
+        crash."""
+        junk = json.dumps({"id": f"chaos-torn-{self.beat}",
+                           "tenant": "chaos",
+                           "configs": [{"mean": 500.0, "std": 100.0}],
+                           "iters": 10_000_000,
+                           "submit_time": time.time()}, indent=2)
+        blob = junk.encode()[:max(1, ev["offset"] % 160)]
+        if ev["pick"] % 2 == 0:
+            path = os.path.join(controller.spool.root, "pending",
+                                f"zz-chaos-torn-{self.beat}.json")
+        else:
+            path = os.path.join(controller.table.root,
+                                f"chaos-ghost-{self.beat}.json")
+        with open(path, "wb") as f:
+            f.write(blob)
+        return self._emit("torn_write", target=path,
+                          offset=len(blob),
+                          reason="truncated JSON dropped under a live "
+                                 "consumer dir; must quarantine to "
+                                 "poison/, not crash the beat")
+
+    def _stall_heartbeat(self, controller, ev) -> Optional[dict]:
+        rows = controller.table.rows()
+        if not rows:
+            return None
+        wids = sorted(rows)
+        wid = wids[ev["pick"] % len(wids)]
+        row = dict(rows[wid])
+        row["heartbeat_time"] = (float(row.get("heartbeat_time",
+                                               time.time()))
+                                 - self.stall_s)
+        _atomic_write(controller.table._row_path(wid), row)
+        beats = max(1, int(self.stall_s
+                           / max(controller.poll_interval_s, 1e-9))
+                    if controller.poll_interval_s else 1)
+        return self._emit("heartbeat_stall", target=wid,
+                          beats=min(beats, 1_000_000),
+                          reason=f"heartbeat backdated {self.stall_s:g}"
+                                 " s; a live pid gets the 10x grace, a"
+                                 " dead one reaps")
+
+    # ------------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Counts by kind: scheduled vs applied (guard asserts)."""
+        sched: dict = {}
+        for e in self.schedule:
+            sched[e["event"]] = sched.get(e["event"], 0) + 1
+        done: dict = {}
+        for r in self.applied:
+            done[r["event"]] = done.get(r["event"], 0) + 1
+        return {"seed": self.seed, "scheduled": sched,
+                "applied": done,
+                "pending": len(self._pending),
+                "beat": self.beat}
+
+
+def _hostname() -> str:
+    import socket
+    return socket.gethostname()
+
+
+__all__ = ["ChaosPlan", "ControllerKilled", "KILL_STAGES"]

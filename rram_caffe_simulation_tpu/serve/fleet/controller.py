@@ -45,6 +45,7 @@ import shlex
 import socket
 import subprocess
 import time
+import zlib
 from typing import Dict, List, Optional
 
 _HOSTNAME = socket.gethostname()
@@ -56,9 +57,13 @@ from .scaler import BacklogScaler
 from .table import WorkerTable
 
 #: fields the controller strips when copying a request between spools
-#: (stale bookkeeping from a previous claimant must not ride along)
+#: (stale bookkeeping from a previous claimant must not ride along);
+#: `attempt` is re-stamped explicitly on every delivery
 _BOOKKEEPING = ("cfg_ids", "iters_granted", "status", "worker",
-                "submit_seen", "state")
+                "attempt", "submit_seen", "state")
+
+#: scrape-retry backoff cap, in beats (capped exponential: 1, 2, 4, 8)
+_SCRAPE_BACKOFF_CAP = 8
 
 
 def _append_jsonl(path: str, rec: dict):
@@ -102,11 +107,20 @@ class FleetController:
                  scaler: Optional[BacklogScaler] = None,
                  worker_cmd: Optional[str] = None,
                  alert_rules: Optional[list] = None,
-                 scrape_sockets: bool = True):
+                 scrape_sockets: bool = True,
+                 chaos=None):
         self.dir = os.path.abspath(fleet_dir)
         os.makedirs(self.dir, exist_ok=True)
-        self.spool = Spool(os.path.join(self.dir, "spool"))
-        self.table = WorkerTable(self.dir)
+        #: poison quarantine (ISSUE 20): unparseable spool / worker-
+        #: table files move here instead of crashing the beat loop
+        self.poison_dir = os.path.join(self.dir, "poison")
+        self.spool = Spool(os.path.join(self.dir, "spool"),
+                           poison_dir=self.poison_dir)
+        self.table = WorkerTable(self.dir,
+                                 poison_dir=self.poison_dir)
+        #: optional deterministic failure-injection plan
+        #: (serve/fleet/chaos.py) — None in production
+        self.chaos = chaos
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.poll_interval_s = float(poll_interval_s)
         self.default_iters = int(default_iters)
@@ -125,9 +139,19 @@ class FleetController:
         self._deaths_total = 0
         self._swap_cmds_total = 0
         self._quarantine_total = 0
+        self._poison_total = 0
         self._scale_ups = 0
         self._scale_downs = 0
         self._last_scale_decision = 0
+        #: per-worker CONSECUTIVE scrape-failure streaks (sticky until
+        #: a scrape succeeds or the worker is reaped) + the beat each
+        #: backed-off worker may be scraped again
+        self._scrape_failures: Dict[str, int] = {}
+        self._scrape_retry_beat: Dict[str, int] = {}
+        #: set when a state/rollup write failed (ENOSPC, EIO): the run
+        #: loop degrades to drain-with-checkpoint instead of crash-
+        #: looping on a full disk
+        self._force_drain = False
         #: harvested request turnarounds (bounded) -> rollup quantiles
         self._latencies = collections.deque(maxlen=4096)
         self._beats = 0
@@ -145,11 +169,19 @@ class FleetController:
         self._pending_backlog_iters = 0
         if os.path.exists(self._state_path()):
             self._load_state()
-        # crash-window recovery: a request CLAIMED in a beat that died
-        # before its state write is active in the fleet spool (the
-        # claim persisted the worker/attempt fields) but absent from
-        # the loaded assignments — rebuild those entries, or the
-        # request would never harvest and never requeue
+        # crash-window recovery, in journal order. First finish any
+        # rename walk that died between its atomic destination write
+        # and its source remove (claim / requeue / finish caught by a
+        # SIGKILL): the destination is the commit point, so
+        # resolve_dual completes the move instead of double-seeing
+        # the request.
+        for rid in self.spool.dual_ids():
+            self.spool.resolve_dual(rid)
+        # A request CLAIMED in a beat that died before its state write
+        # is active in the fleet spool (the claim persisted the
+        # worker/attempt fields) but absent from the loaded
+        # assignments — rebuild those entries, or the request would
+        # never harvest and never requeue.
         for req in self.spool.active():
             rid = req.get("id")
             if rid and rid not in self.assignments \
@@ -157,6 +189,13 @@ class FleetController:
                 self.assignments[rid] = {
                     "worker": str(req["worker"]),
                     "attempt": int(req.get("attempt", 1))}
+        # And the mirror image: a loaded assignment whose request is
+        # no longer active (harvested/requeued after the last state
+        # write) is stale — drop it, or _harvest could try to finish
+        # an already-terminal request (the exactly-once gap).
+        for rid in list(self.assignments):
+            if self.spool.state_of(rid) != "active":
+                del self.assignments[rid]
 
     # ------------------------------------------------------------------
     # persistence + records
@@ -165,8 +204,29 @@ class FleetController:
         return os.path.join(self.dir, "state.json")
 
     def _load_state(self):
-        with open(self._state_path()) as f:
-            state = json.load(f)
+        try:
+            with open(self._state_path()) as f:
+                state = json.load(f)
+        except ValueError as e:
+            # a torn commit record (SIGKILL mid-write on a filesystem
+            # without atomic rename, or a chaos injection): quarantine
+            # the bytes and rebuild from the spool — the active files
+            # carry worker+attempt, so nothing is lost
+            os.makedirs(self.poison_dir, exist_ok=True)
+            dst = os.path.join(self.poison_dir, "state.json")
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = os.path.join(self.poison_dir, f"state.json.{n}")
+            try:
+                os.replace(self._state_path(), dst)
+                self._poison_total += 1
+            except OSError:
+                pass
+            print(f"Fleet controller: torn state.json quarantined to "
+                  f"{dst} ({e}); rebuilding from the spool",
+                  flush=True)
+            return
         self.assignments = dict(state.get("assignments", {}))
         self.pending_swaps = dict(state.get("pending_swaps", {}))
         self._next_ordinal = int(state.get("next_ordinal", 0))
@@ -174,11 +234,12 @@ class FleetController:
         self._deaths_total = int(counters.get("deaths", 0))
         self._swap_cmds_total = int(counters.get("swap_cmds", 0))
         self._quarantine_total = int(counters.get("quarantines", 0))
+        self._poison_total = int(counters.get("poisons", 0))
         self._scale_ups = int(counters.get("scale_ups", 0))
         self._scale_downs = int(counters.get("scale_downs", 0))
 
     def _write_state(self):
-        _atomic_write(self._state_path(), {
+        payload = {
             "schema_version": 1,
             "assignments": self.assignments,
             "pending_swaps": self.pending_swaps,
@@ -187,10 +248,16 @@ class FleetController:
                 "deaths": self._deaths_total,
                 "swap_cmds": self._swap_cmds_total,
                 "quarantines": self._quarantine_total,
+                "poisons": self._poison_total,
                 "scale_ups": self._scale_ups,
                 "scale_downs": self._scale_downs,
             },
-        })
+        }
+        if self.chaos is not None:
+            # a stage-"commit" kill tears the record at a seeded byte
+            # offset and raises — the restart path must recover
+            self.chaos.tear_commit(self._state_path(), payload)
+        _atomic_write(self._state_path(), payload)
 
     def _emit(self, wid: str, event: str, **kw):
         from ...observe import make_worker_record
@@ -212,18 +279,43 @@ class FleetController:
     def beat(self) -> dict:
         """One scheduling pass: reap dead workers, harvest terminal
         requests, route pending ones, apply a scale decision. Returns
-        a summary dict (what the CLI prints at --verbose)."""
+        a summary dict (what the CLI prints at --verbose).
+
+        The beat is an idempotent journaled transaction (ISSUE 20):
+        every state move is an atomic rename whose destination is the
+        commit point (claim carries worker+attempt, finish carries
+        the terminal payload), interrupted moves are completed by
+        `resolve_dual` on the next pass, and `state.json` — written
+        LAST — is only a cache of what the spool already proves. A
+        SIGKILL at any byte offset mid-beat therefore recovers on
+        restart with no lost, orphaned, or double-routed request
+        (chaos-guarded by scripts/check_fleet_chaos.py)."""
         self._beats += 1
+        if self.chaos is not None:
+            self.chaos.begin_beat(self)
+        self._heal_spool()
         rows = self.table.rows()
+        dead = self._reap_poisoned()
         self._reconcile_swaps(rows)
-        dead = self._reap(rows)
+        dead += self._reap(rows)
         for wid in dead:
             rows.pop(wid, None)
+        self._checkpoint("reap")
         harvested = self._harvest()
+        self._checkpoint("harvest")
+        self._redeliver()
         routed = self._route_pending(rows)
+        self._checkpoint("route")
         scale = self._apply_scale(rows)
-        alerts = self._watchtower(rows)
-        self._write_state()
+        try:
+            alerts = self._watchtower(rows)
+        except OSError as e:
+            alerts = []
+            self._degrade(e)
+        try:
+            self._write_state()
+        except OSError as e:
+            self._degrade(e)
         return {"beat": self._beats, "workers": sorted(rows),
                 "dead": dead, "harvested": harvested,
                 "routed": routed, "scale": scale,
@@ -231,6 +323,74 @@ class FleetController:
                 "assigned": len(self.assignments),
                 "alerts": alerts,
                 "firing": self.alert_engine.active()}
+
+    def _checkpoint(self, stage: str):
+        """Chaos hook: a seeded controller_kill strikes between beat
+        stages here (no-op without an attached plan)."""
+        if self.chaos is not None:
+            self.chaos.maybe_kill(stage)
+
+    def _heal_spool(self):
+        """Complete any fleet-spool rename that a previous crash left
+        halfway (the request file present under two state dirs), and
+        keep the in-memory assignments consistent with the outcome."""
+        for rid in self.spool.dual_ids():
+            state = self.spool.resolve_dual(rid)
+            if state == "active":
+                req = self.spool.read(rid)
+                if req and req.get("worker") \
+                        and rid not in self.assignments:
+                    self.assignments[rid] = {
+                        "worker": str(req["worker"]),
+                        "attempt": int(req.get("attempt", 1))}
+            elif state in ("pending", "done", None):
+                self.assignments.pop(rid, None)
+
+    def _reap_poisoned(self) -> List[str]:
+        """A worker whose table row was quarantined as unparseable is
+        declared dead LOUDLY — same protocol as a missed heartbeat
+        (the worker's next heartbeat sees its row gone and exits) —
+        instead of silently vanishing with its requests orphaned."""
+        dead = []
+        for p in self.table.drain_poisoned():
+            wid = p["worker"]
+            self._poison_total += 1
+            self._deaths_total += 1
+            self._emit(wid, "dead",
+                       reason="worker table row unparseable; "
+                              f"quarantined to {p['moved_to']}")
+            finished = {}
+            wspool = self._worker_spool(wid)
+            for rid, a in self.assignments.items():
+                if a.get("worker") == wid \
+                        and wspool.state_of(rid) == "done":
+                    finished[rid] = "done"
+            for rid in requeue_plan(self.assignments, [wid], finished):
+                self._requeue(rid, wid)
+            self.table.remove(wid)
+            self.pending_swaps.pop(wid, None)
+            self._spawned.pop(wid, None)
+            self._scrape_failures.pop(wid, None)
+            self._scrape_retry_beat.pop(wid, None)
+            dead.append(wid)
+        return dead
+
+    def _degrade(self, err: Exception):
+        """A failed state/rollup write (ENOSPC, EIO) must not become
+        a crash loop: request a fleet drain — workers checkpoint
+        their in-flight requests, the run loop exits 75, and the
+        operator restarts on a healthy disk to resume."""
+        if self._force_drain:
+            return
+        self._force_drain = True
+        print(f"Fleet controller: write failure ({err}); degrading "
+              "to drain-with-checkpoint (exit 75 resumes)", flush=True)
+        try:
+            with open(os.path.join(self.dir, "DRAIN"), "w"):
+                pass
+        except OSError:
+            pass    # even the flag write failed; the in-memory flag
+                    # still drains this process
 
     def _reconcile_swaps(self, rows: Dict[str, dict]):
         """Clear a pending swap once the worker re-registered with the
@@ -309,6 +469,8 @@ class FleetController:
             self.table.remove(wid)
             self.pending_swaps.pop(wid, None)
             self._spawned.pop(wid, None)
+            self._scrape_failures.pop(wid, None)
+            self._scrape_retry_beat.pop(wid, None)
         return dead
 
     def _requeue(self, rid: str, wid: str):
@@ -334,23 +496,72 @@ class FleetController:
                           "requeued onto survivors (at-least-once)")
 
     def _harvest(self) -> List[str]:
-        """Fold workers' terminal spool files into the fleet done/."""
+        """Fold workers' terminal spool files into the fleet done/.
+
+        Exactly-once: the fleet-level terminal record commits at most
+        once per (request, attempt). A request already terminal at
+        fleet level (a crashed controller's finish committed before
+        its state write) just drops its stale assignment, and a done
+        file stamped with a DIFFERENT attempt (debris of an earlier
+        at-least-once retry) never completes the current one."""
         done = []
         for rid, a in list(self.assignments.items()):
             wid = a["worker"]
+            if self.spool.state_of(rid) == "done":
+                # the terminal record already committed — dedup, do
+                # not land a second one
+                del self.assignments[rid]
+                continue
             req = self._worker_spool(wid).read(rid)
             if req is None or req.get("state") != "done":
+                continue
+            if int(req.get("attempt", a["attempt"])) \
+                    != int(a["attempt"]):
                 continue
             payload = {k: req[k] for k in
                        ("status", "results", "latency_s", "reason")
                        if req.get(k) is not None}
             payload["worker"] = wid
+            payload["attempt"] = int(a["attempt"])
             if payload.get("latency_s") is not None:
                 self._latencies.append(float(payload["latency_s"]))
-            self.spool.finish(rid, payload)
+            try:
+                self.spool.finish(rid, payload)
+            except FileNotFoundError:
+                # requeued out from under us (e.g. the worker was
+                # reaped this very beat): the new attempt owns the
+                # request now
+                continue
             del self.assignments[rid]
             done.append(rid)
         return done
+
+    def _redeliver(self):
+        """Heal the claim->copy crash window: an assignment whose
+        worker has NO copy of the request in any spool state means
+        the controller died between the fleet-spool claim (the commit
+        record) and the worker-spool submit — deliver the copy now.
+        The submit is refused on a duplicate id, so delivery stays
+        at-most-once per attempt."""
+        for rid, a in list(self.assignments.items()):
+            wid = a["worker"]
+            wspool = self._worker_spool(wid)
+            if wspool.state_of(rid) is not None:
+                continue
+            raw = self.spool.read(rid)
+            if raw is None or raw.get("state") != "active":
+                continue
+            clean = {k: v for k, v in raw.items()
+                     if k not in _BOOKKEEPING}
+            clean["attempt"] = int(a["attempt"])
+            try:
+                wspool.submit(clean)
+            except ValueError:
+                continue
+            self._emit(wid, "assigned", request=rid,
+                       reason="redelivered: a controller crash "
+                              "landed between the claim and the "
+                              "worker copy")
 
     def _route_pending(self, rows: Dict[str, dict]) -> List[str]:
         routed = []
@@ -381,8 +592,21 @@ class FleetController:
                 rows[wid] = dict(rows[wid], pending_swap=swap)
                 self._emit(wid, "swap_requested", request=rid,
                            pinned=swap)
+            # journaled transaction order: the fleet-spool CLAIM (an
+            # atomic pending->active rename carrying worker+attempt)
+            # is the commit record for this routing decision, and the
+            # worker-spool copy follows it. A crash between the two
+            # re-delivers via _redeliver; the old order (copy first)
+            # could DOUBLE-ROUTE — a controller killed between copy
+            # and claim would re-route the still-pending request to a
+            # different worker while the first copy kept running.
+            attempt = int(raw.get("requeues", 0)) + 1
+            self.spool.claim(rid, {"worker": wid, "attempt": attempt})
+            self.assignments[rid] = {"worker": wid, "attempt": attempt}
+            self._checkpoint("claim")
             clean = {k: v for k, v in req.items()
                      if k not in _BOOKKEEPING}
+            clean["attempt"] = attempt
             try:
                 self._worker_spool(wid).submit(clean)
             except ValueError as e:
@@ -391,11 +615,14 @@ class FleetController:
                 # as assigned rather than duplicating the file
                 if "already exists" not in str(e):
                     self._quarantine_total += 1
-                    self.spool.quarantine(rid, str(e))
+                    self.assignments.pop(rid, None)
+                    try:
+                        self.spool.finish(
+                            rid, {"status": "rejected",
+                                  "reason": str(e)})
+                    except FileNotFoundError:
+                        pass
                     continue
-            attempt = int(raw.get("requeues", 0)) + 1
-            self.spool.claim(rid, {"worker": wid, "attempt": attempt})
-            self.assignments[rid] = {"worker": wid, "attempt": attempt}
             # the routed load is visible to the next pick immediately
             rows[wid] = dict(
                 rows[wid],
@@ -485,9 +712,23 @@ class FleetController:
     def _scrape_worker(self, wid: str) -> Optional[dict]:
         """One `metrics` scrape of a worker's service front door:
         parsed exposition samples, or None when the socket is down
-        (the heartbeat-row snapshot is the fallback)."""
+        (the heartbeat-row snapshot is the fallback).
+
+        Failures are STICKY per worker: consecutive failed scrapes
+        count into `self._scrape_failures` (exported per-worker as
+        `rram_scrape_failures` and fleet-wide as the
+        `scrape_failures_max` observation the alert rule watches) and
+        push the next attempt out by a capped exponential backoff, so
+        a wedged socket costs one connect per backoff window instead
+        of one per beat. Any success clears the streak."""
         path = os.path.join(self.table.worker_dir(wid), "service.sock")
         if not self.scrape_sockets or not os.path.exists(path):
+            return None
+        if self._beats < self._scrape_retry_beat.get(wid, 0):
+            return None                      # still backing off
+        if self.chaos is not None and self.chaos.socket_fault:
+            self._scrape_failed(
+                wid, f"chaos socket_{self.chaos.socket_fault}")
             return None
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(1.0)
@@ -501,17 +742,39 @@ class FleetController:
                     break
                 buf += chunk
             resp = json.loads(buf.decode())
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            self._scrape_failed(wid, str(e) or type(e).__name__)
             return None
         finally:
             sock.close()
         if not resp.get("ok") or "exposition" not in resp:
+            self._scrape_failed(wid, "bad metrics response")
             return None
         from ...observe.metrics_registry import parse_exposition
         try:
-            return parse_exposition(resp["exposition"])
-        except ValueError:
+            samples = parse_exposition(resp["exposition"])
+        except ValueError as e:
+            self._scrape_failed(wid, f"bad exposition: {e}")
             return None
+        self._scrape_failures.pop(wid, None)
+        self._scrape_retry_beat.pop(wid, None)
+        return samples
+
+    def _scrape_failed(self, wid: str, reason: str):
+        """Bump the worker's consecutive-failure streak and schedule
+        the next attempt: capped exponential backoff (1, 2, 4, 8, 8...
+        beats) plus a deterministic 0/1-beat jitter (crc32, NOT hash()
+        — that one is salted per process) so a fleet-wide outage's
+        retries don't all land on the same beat."""
+        n = self._scrape_failures.get(wid, 0) + 1
+        self._scrape_failures[wid] = n
+        backoff = min(1 << min(n - 1, 3), _SCRAPE_BACKOFF_CAP)
+        jitter = zlib.crc32(f"{wid}:{n}".encode()) % 2
+        self._scrape_retry_beat[wid] = self._beats + backoff + jitter
+        if n == 1 or n % 5 == 0:
+            print(f"Fleet controller: scrape of {wid} failed "
+                  f"({reason}); streak {n}, retrying in "
+                  f"{backoff + jitter} beat(s)", flush=True)
 
     def _worker_view(self, wid: str, row: dict) -> dict:
         """A uniform per-worker health view: from a live socket scrape
@@ -614,6 +877,9 @@ class FleetController:
             "worker_deaths_total": float(self._deaths_total),
             "swap_total": float(self._swap_cmds_total),
             "quarantine_total": float(self._quarantine_total),
+            "poison_total": float(self._poison_total),
+            "scrape_failures_max": float(
+                max(self._scrape_failures.values(), default=0)),
             "pending_requests": len(self.spool.pending_ids()),
             "assigned_requests": len(self.assignments),
         }
@@ -665,6 +931,9 @@ class FleetController:
                 help="hot-swap commands issued")
         reg.inc("rram_fleet_quarantine_total", self._quarantine_total,
                 help="requests quarantined at the fleet door")
+        reg.inc("rram_fleet_poison_total", self._poison_total,
+                help="torn/unparseable spool, table, or state files "
+                     "quarantined to poison/")
         reg.inc("rram_fleet_scale_events_total", self._scale_ups,
                 help="scaler actions taken", direction="up")
         reg.inc("rram_fleet_scale_events_total", self._scale_downs,
@@ -724,6 +993,11 @@ class FleetController:
             reg.set("rram_worker_active_requests",
                     int(view.get("active_requests") or 0),
                     help="admitted + running requests", worker=wid)
+            reg.set("rram_scrape_failures",
+                    int(self._scrape_failures.get(wid, 0)),
+                    help="consecutive failed metric scrapes of the "
+                         "worker's front door (0 clears on success)",
+                    worker=wid)
             wh = view.get("health")
             if isinstance(wh, dict) and wh.get("censuses"):
                 if wh.get("broken_frac_max") is not None:
@@ -751,6 +1025,11 @@ class FleetController:
     def _watchtower(self, rows: Dict[str, dict]) -> List[str]:
         """Evaluate the alert rules on this beat's fleet observation,
         emit transition records, and rewrite the rollup."""
+        for move in self.spool.drain_poisoned():
+            self._poison_total += 1
+            print("Fleet controller: quarantined torn spool file "
+                  f"{move['request']} ({move['state']}) -> "
+                  f"{move['moved_to']}: {move['reason']}", flush=True)
         views = {wid: self._worker_view(wid, row)
                  for wid, row in rows.items()}
         obs = self._fleet_observation(rows, views)
@@ -784,7 +1063,7 @@ class FleetController:
         the same fleet directory to resume)."""
         while True:
             summary = self.beat()
-            if self._drain_file() \
+            if self._force_drain or self._drain_file() \
                     or (drain_when_idle
                         and self._fleet_idle(self.table.rows())):
                 return self._drain(drain_timeout_s)
@@ -865,6 +1144,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-beats", type=int, default=0,
                    help="stop after N controller beats (test hook); "
                         "0 = unlimited")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="attach a seeded chaos plan (see fleet/"
+                        "chaos.py): deterministic failure injection "
+                        "on the beat clock; 0 disables. A "
+                        "controller_kill injection exits 70 — restart "
+                        "on the same fleet dir to prove recovery")
     args = p.parse_args(argv)
 
     scaler = None
@@ -876,13 +1161,18 @@ def main(argv=None) -> int:
     if args.alert_rules:
         from .alerts import load_rules
         rules = load_rules(args.alert_rules)
+    chaos = None
+    if args.chaos_seed:
+        from .chaos import ChaosPlan
+        chaos = ChaosPlan(args.chaos_seed)
     ctl = FleetController(
         args.fleet_dir,
         heartbeat_timeout_s=args.heartbeat_timeout,
         poll_interval_s=args.poll_interval,
         default_iters=args.default_iters,
         scaler=scaler, worker_cmd=args.worker_cmd,
-        alert_rules=rules, scrape_sockets=not args.no_scrape)
+        alert_rules=rules, scrape_sockets=not args.no_scrape,
+        chaos=chaos)
 
     def _on_signal(signum, frame):
         with open(os.path.join(ctl.dir, "DRAIN"), "w"):
@@ -892,8 +1182,16 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
     print(f"Fleet controller up: {ctl.dir} "
           f"({len(ctl.table.ids())} worker(s) registered)", flush=True)
-    code = ctl.run(max_beats=args.max_beats or None,
-                   drain_when_idle=args.drain_when_idle)
+    try:
+        code = ctl.run(max_beats=args.max_beats or None,
+                       drain_when_idle=args.drain_when_idle)
+    except Exception as e:
+        from .chaos import ControllerKilled
+        if not isinstance(e, ControllerKilled):
+            raise
+        print(f"Fleet controller: {e}; exit 70 — restart on the same "
+              "fleet dir to prove recovery", flush=True)
+        code = 70
     sys.stdout.flush()
     return code
 

@@ -51,12 +51,26 @@ PIN_KEYS = ("process", "dtype_policy", "net", "tiles")
 
 
 class WorkerTable:
-    """Filesystem view of ``<fleet>/workers/``."""
+    """Filesystem view of ``<fleet>/workers/``.
 
-    def __init__(self, fleet_dir: str):
+    With `poison_dir` set (the controller's handle), an unparseable
+    row file — never a half-finished write, since rows are written
+    atomically — is moved aside instead of silently vanishing the
+    worker: the move lands in `self.poisoned` so the controller can
+    treat the worker as dead LOUDLY (requeue + alert) rather than
+    leaving its in-flight requests orphaned behind an invisible row."""
+
+    def __init__(self, fleet_dir: str,
+                 poison_dir: Optional[str] = None):
         self.fleet_dir = os.path.abspath(fleet_dir)
         self.root = os.path.join(self.fleet_dir, "workers")
+        self.poison_dir = poison_dir
+        #: poison moves since the last `drain_poisoned()`:
+        #: {"worker", "moved_to", "reason"} dicts
+        self.poisoned: list = []
         os.makedirs(self.root, exist_ok=True)
+        if poison_dir:
+            os.makedirs(poison_dir, exist_ok=True)
 
     def _row_path(self, wid: str) -> str:
         return os.path.join(self.root, f"{wid}.json")
@@ -126,8 +140,38 @@ class WorkerTable:
         try:
             with open(self._row_path(wid)) as f:
                 return json.load(f)
-        except (FileNotFoundError, ValueError):
+        except FileNotFoundError:
             return None
+        except ValueError as e:
+            self._poison_row(wid, e)
+            return None
+
+    def _poison_row(self, wid: str, err: Exception):
+        """Quarantine a torn row file (controller handles only). The
+        caller sees None either way; with a poison dir the corrupt
+        bytes are preserved for post-mortems and `self.poisoned`
+        carries the event so the worker's death is loud, not a silent
+        table vanishing."""
+        if not self.poison_dir:
+            return
+        src = self._row_path(wid)
+        dst = os.path.join(self.poison_dir, f"workers-{wid}.json")
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.poison_dir,
+                               f"workers-{wid}.json.{n}")
+        try:
+            os.replace(src, dst)
+        except OSError:
+            return
+        self.poisoned.append({"worker": wid, "moved_to": dst,
+                              "reason": str(err)})
+
+    def drain_poisoned(self) -> list:
+        """Poison moves since the last drain (and clear the list)."""
+        out, self.poisoned = self.poisoned, []
+        return out
 
     def rows(self) -> Dict[str, dict]:
         """Every registered worker row, keyed by worker id."""

@@ -90,13 +90,40 @@ class ServeClient:
                                                        "service.sock")
         self.timeout_s = float(timeout_s)
         self._spool = None
+        #: consecutive socket-op failure streak; sticky until a call
+        #: succeeds. Feeds the retry backoff below.
+        self._sock_failures = 0
+        #: monotonic time before which `_call` skips the socket and
+        #: goes straight to the spool fallback (capped exponential
+        #: backoff, so a wedged front door costs one connect per
+        #: backoff window, not one per poll)
+        self._sock_retry_at = 0.0
+        #: test hook (chaos/regression): while > 0, each `_call`
+        #: consumes one and fails as if the socket dropped mid-read
+        self._drop_socket_ops = 0
 
     # ------------------------------------------------------------------
     # transport
 
+    def _sock_failed(self):
+        self._sock_failures += 1
+        backoff = min(0.25 * (1 << min(self._sock_failures - 1, 5)),
+                      8.0)
+        self._sock_retry_at = time.monotonic() + backoff
+
     def _call(self, msg: dict) -> Optional[dict]:
-        """One socket round-trip; None when the front door is down."""
+        """One socket round-trip; None when the front door is down OR
+        the response was torn/dropped mid-read. Every None falls back
+        to the durable spool path, so a transient socket drop degrades
+        a poll instead of crashing it; a failure streak backs the next
+        attempt off (capped exponential), any success clears it."""
         if not os.path.exists(self.socket_path):
+            return None
+        if time.monotonic() < self._sock_retry_at:
+            return None                      # still backing off
+        if self._drop_socket_ops > 0:
+            self._drop_socket_ops -= 1
+            self._sock_failed()
             return None
         sock = socket_mod.socket(socket_mod.AF_UNIX,
                                  socket_mod.SOCK_STREAM)
@@ -108,13 +135,21 @@ class ServeClient:
             while b"\n" not in buf:
                 chunk = sock.recv(65536)
                 if not chunk:
-                    return None
+                    # connection dropped mid-read: a torn (possibly
+                    # partial) response counts as a failure too
+                    raise ConnectionResetError(
+                        "socket closed before a full response")
                 buf += chunk
-        except (OSError, socket_mod.timeout):
+            # a torn response (the service died mid-write) must read
+            # as "front door down", not crash the caller's poll loop
+            resp = json.loads(buf.split(b"\n", 1)[0].decode())
+        except (OSError, ValueError, socket_mod.timeout):
+            self._sock_failed()
             return None
         finally:
             sock.close()
-        resp = json.loads(buf.split(b"\n", 1)[0].decode())
+        self._sock_failures = 0
+        self._sock_retry_at = 0.0
         if not resp.get("ok"):
             raise RuntimeError(
                 f"service refused {msg.get('op')!r}: "
@@ -330,6 +365,31 @@ class ServeClient:
         their own request without seeing anyone else's."""
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
+
+        def read_lines(path, start):
+            """Complete ("\\n"-terminated) records past byte `start`,
+            plus the offset consumed. A PARTIAL trailing line — the
+            writer mid-append, or a reader racing a torn write — is
+            NOT consumed: the position stays before it, so the next
+            poll re-reads it whole instead of crashing on half a
+            JSON object."""
+            with open(path, "rb") as f:
+                f.seek(start)
+                data = f.read()
+            recs, consumed = [], 0
+            for raw in data.splitlines(keepends=True):
+                if not raw.endswith(b"\n"):
+                    break               # partial tail: retry next poll
+                consumed += len(raw)
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    continue            # corrupt complete line: skip
+            return recs, start + consumed
+
         if self._is_fleet():
             # a fleet request's stream lives with whichever worker(s)
             # served it — a requeued request has one stream per
@@ -344,18 +404,13 @@ class ServeClient:
                                         f"{request_id}.jsonl")
                     if not os.path.exists(path):
                         continue
-                    with open(path) as f:
-                        f.seek(pos.get(path, 0))
-                        for line in f:
-                            line = line.strip()
-                            if not line:
-                                continue
-                            rec = json.loads(line)
-                            rec.setdefault("worker", wid)
-                            yield rec
-                            if rec.get("event") in TERMINAL_STATES:
-                                return
-                        pos[path] = f.tell()
+                    recs, pos[path] = read_lines(path,
+                                                 pos.get(path, 0))
+                    for rec in recs:
+                        rec.setdefault("worker", wid)
+                        yield rec
+                        if rec.get("event") in TERMINAL_STATES:
+                            return
                 if not follow:
                     return
                 if deadline is not None \
@@ -366,17 +421,11 @@ class ServeClient:
         fpos = 0
         while True:
             if os.path.exists(path):
-                with open(path) as f:
-                    f.seek(fpos)
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        rec = json.loads(line)
-                        yield rec
-                        if rec.get("event") in TERMINAL_STATES:
-                            return
-                    fpos = f.tell()
+                recs, fpos = read_lines(path, fpos)
+                for rec in recs:
+                    yield rec
+                    if rec.get("event") in TERMINAL_STATES:
+                        return
             if not follow:
                 return
             if deadline is not None and time.monotonic() >= deadline:

@@ -136,7 +136,12 @@ class SweepService:
         self.dir = os.path.abspath(service_dir)
         os.makedirs(self.dir, exist_ok=True)
         os.makedirs(os.path.join(self.dir, "requests"), exist_ok=True)
-        self.spool = Spool(os.path.join(self.dir, "spool"))
+        # the service owns its spool's consumption, so it also owns
+        # the poison quarantine: an unparseable spool file moves to
+        # <dir>/poison/ (surfaced via stats) instead of crash-looping
+        # the beat (ISSUE 20)
+        self.spool = Spool(os.path.join(self.dir, "spool"),
+                           poison_dir=os.path.join(self.dir, "poison"))
         self.chunk = int(chunk)
         self.default_iters = int(default_iters)
         self.slo_seconds = float(slo_seconds)
@@ -455,6 +460,26 @@ class SweepService:
                                   reason=entry["reason"])
                 continue
             if raw is None:
+                # with the poison dir attached the read QUARANTINES
+                # torn bytes instead of raising — the request still
+                # owes a terminal record, so reject it loudly (same
+                # contract as the ValueError arm below)
+                moves = self.spool.drain_poisoned()
+                mine = [m for m in moves if m["request"] == rid]
+                self.spool.poisoned.extend(
+                    m for m in moves if m["request"] != rid)
+                if mine:
+                    entry = self.spool.quarantine(
+                        rid, "unparseable request file quarantined "
+                             f"to {mine[0]['moved_to']}: "
+                             f"{mine[0]['reason']}")
+                    with self._stats_lock:
+                        self._requests[rid] = dict(
+                            entry, cfg_ids=[], configs_total=0,
+                            done=0, tenant="default")
+                    self._emit_request(self._requests[rid],
+                                       "rejected",
+                                       reason=entry["reason"])
                 continue
             try:
                 # raw files may be dropped into pending/ by anything
@@ -1017,8 +1042,27 @@ class SweepService:
         only possible after a crash, not a graceful drain) are
         re-admitted fresh: at-least-once completion, with the
         re-execution being a legitimate fresh Monte-Carlo attempt."""
-        with open(self._state_path()) as f:
-            state = json.load(f)
+        try:
+            with open(self._state_path()) as f:
+                state = json.load(f)
+        except ValueError as e:
+            # torn state.json (a crash mid-write on a filesystem
+            # without atomic rename): quarantine it and resume from
+            # the spool alone — active requests re-admit fresh below
+            dst = os.path.join(self.dir, "poison", "state.json")
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = os.path.join(self.dir, "poison",
+                                   f"state.json.{n}")
+            try:
+                os.replace(self._state_path(), dst)
+            except OSError:
+                pass
+            print(f"Sweep service: torn state.json quarantined to "
+                  f"{dst} ({e}); resuming from the spool", flush=True)
+            state = {}
         self._tenant_lane_iters = {
             str(k): int(v)
             for k, v in state.get("tenant_lane_iters", {}).items()}

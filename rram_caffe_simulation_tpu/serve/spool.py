@@ -17,6 +17,18 @@ timestamps, so "sorted" means "submission order" unless the caller
 chooses their own ordering by naming ids explicitly (the CI guard
 does, for determinism).
 
+Because every write is atomic, an UNPARSEABLE file in a state
+directory is never a half-finished write — it is corrupt bytes from
+outside the contract (a torn direct write, disk damage, a chaos
+injection). The owning consumer opens the spool with a `poison_dir`
+and such files are quarantined there instead of crashing the beat
+loop; read-only clients without one simply tolerate them (see
+`Spool._poison`). A request present in TWO state directories is a
+rename that died between its atomic destination write and its source
+remove — `resolve_dual` finishes the move deterministically, which is
+what makes the fleet controller's beat an idempotent journaled
+transaction (ISSUE 20).
+
 The spool is intentionally dependency-free (no jax) so clients — the
 `serve_client` library, shell scripts, another host sharing a
 filesystem — can submit without importing the framework.
@@ -165,18 +177,64 @@ def _atomic_write(path: str, payload: dict):
 class Spool:
     """The service-side view of the request queue (see module
     docstring). All mutation is rename-based and single-consumer: only
-    the service moves files out of pending/."""
+    the service moves files out of pending/.
 
-    def __init__(self, root: str):
+    **Poison quarantine** (opt-in via `poison_dir`): every write is
+    atomic, so an unparseable file in a state directory is never a
+    half-finished write — it is genuinely corrupt bytes (a torn direct
+    write from a crashed foreign producer, disk damage, or a chaos
+    injection). With `poison_dir` set the OWNING consumer (service /
+    fleet controller) moves such a file aside and keeps beating; the
+    moves land in `self.poisoned` for the owner to alert on. Without
+    it (read-only clients) a torn file is tolerated — `read` returns
+    None, `active` skips it — but never relocated: only the single
+    consumer may move files."""
+
+    def __init__(self, root: str, poison_dir: Optional[str] = None):
         self.root = root
+        self.poison_dir = poison_dir
+        #: poison moves since the last `drain_poisoned()` call:
+        #: {"request", "state", "moved_to", "reason"} dicts
+        self.poisoned: List[dict] = []
+        self.poison_total = 0
         for state in STATES:
             os.makedirs(os.path.join(root, state), exist_ok=True)
+        if poison_dir:
+            os.makedirs(poison_dir, exist_ok=True)
 
     def _dir(self, state: str) -> str:
         return os.path.join(self.root, state)
 
     def _path(self, state: str, request_id: str) -> str:
         return os.path.join(self._dir(state), f"{request_id}.json")
+
+    def _poison(self, path: str, state: str, err: Exception):
+        """Move an unparseable file out of the state directory (when
+        this handle owns a poison dir) so the consumer loop never
+        crashes — or spins — on the same corrupt bytes twice."""
+        if not self.poison_dir:
+            return
+        name = os.path.basename(path)
+        dst = os.path.join(self.poison_dir, f"{state}-{name}")
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.poison_dir, f"{state}-{name}.{n}")
+        try:
+            os.replace(path, dst)
+        except OSError:
+            return
+        self.poison_total += 1
+        self.poisoned.append({
+            "request": name[:-len(".json")] if name.endswith(".json")
+            else name,
+            "state": state, "moved_to": dst, "reason": str(err)})
+
+    def drain_poisoned(self) -> List[dict]:
+        """Poison moves since the last drain (and clear the list) —
+        the owner turns these into alert records."""
+        out, self.poisoned = self.poisoned, []
+        return out
 
     def submit(self, request: dict, default_iters: int = 0) -> str:
         """Validate + atomically spool a request into pending/.
@@ -205,7 +263,9 @@ class Spool:
 
     def read(self, request_id: str) -> Optional[dict]:
         """The request's current payload, from whichever state dir it
-        lives in (None when unknown)."""
+        lives in (None when unknown). Corrupt bytes never raise: a
+        torn file reads as None (and is quarantined when this handle
+        owns a poison dir)."""
         for state in STATES:
             path = self._path(state, request_id)
             try:
@@ -213,6 +273,9 @@ class Spool:
                     return dict(json.load(f), state=state)
             except FileNotFoundError:
                 continue
+            except ValueError as e:
+                self._poison(path, state, e)
+                return None
         return None
 
     def claim(self, request_id: str, updates: Optional[dict] = None
@@ -231,17 +294,30 @@ class Spool:
     def _advance(self, request_id: str, src: str, dst: str,
                  updates: Optional[dict]) -> dict:
         path = self._path(src, request_id)
-        with open(path) as f:
-            req = json.load(f)
+        dst_path = self._path(dst, request_id)
+        try:
+            with open(path) as f:
+                req = json.load(f)
+        except FileNotFoundError:
+            if os.path.exists(dst_path):
+                # idempotent re-advance: a previous call (or a
+                # controller that died between this advance and its
+                # state write) already committed the move — the
+                # destination file IS the record of that, so return
+                # it instead of raising
+                with open(dst_path) as f:
+                    return json.load(f)
+            raise
         if updates:
             req.update(updates)
-        _atomic_write(self._path(dst, request_id), req)
+        _atomic_write(dst_path, req)
         os.remove(path)
         return req
 
     def requeue(self, request_id: str,
                 drop: tuple = ("cfg_ids", "iters_granted", "status",
-                               "worker", "submit_seen")) -> dict:
+                               "worker", "attempt",
+                               "submit_seen")) -> dict:
         """active -> pending: put a claimed request back on the queue
         (the fleet controller's dead-worker path — at-least-once
         completion, lifted one level). The previous claimant's
@@ -284,11 +360,86 @@ class Spool:
         return payload
 
     def active(self) -> List[dict]:
-        """Every active request payload, in filename order."""
+        """Every active request payload, in filename order. Torn files
+        are skipped (and quarantined when this handle owns a poison
+        dir) — crash recovery must not crash on the crash's debris."""
         out = []
         for name in sorted(os.listdir(self._dir("active"))):
             if not name.endswith(".json"):
                 continue
-            with open(os.path.join(self._dir("active"), name)) as f:
-                out.append(json.load(f))
+            path = os.path.join(self._dir("active"), name)
+            try:
+                with open(path) as f:
+                    out.append(json.load(f))
+            except FileNotFoundError:
+                continue
+            except ValueError as e:
+                self._poison(path, "active", e)
         return out
+
+    def dual_ids(self) -> List[str]:
+        """Request ids present in MORE than one state directory — the
+        signature of a rename walk (claim / requeue / finish) that
+        died between its atomic destination write and its source
+        remove. `resolve_dual` finishes the interrupted move."""
+        seen: dict = {}
+        for state in STATES:
+            for name in os.listdir(self._dir(state)):
+                if name.endswith(".json") \
+                        and not name.count(".tmp."):
+                    seen.setdefault(name[:-len(".json")],
+                                    []).append(state)
+        return sorted(r for r, states in seen.items()
+                      if len(states) > 1)
+
+    def resolve_dual(self, request_id: str) -> Optional[str]:
+        """Finish a state move that crashed halfway (the request file
+        exists under two state dirs). The atomic destination write is
+        the commit point, so the DESTINATION always wins:
+
+        - active + done: a `finish` died before removing active/ —
+          done/ is terminal, drop the active copy;
+        - pending + active: either a `claim` (pending -> active) or a
+          `requeue` (active -> pending) died. The direction is
+          recoverable from the requeue counter — a requeue writes its
+          new pending copy with `requeues` bumped PAST the active
+          copy's, a claim's active copy carries the same count as the
+          pending file it came from. Torn halves lose to parseable
+          ones.
+
+        Returns the surviving state name (None when the request is
+        not dual)."""
+        def load(state):
+            try:
+                with open(self._path(state, request_id)) as f:
+                    return json.load(f)
+            except (FileNotFoundError, ValueError):
+                return None
+
+        def drop(state):
+            try:
+                os.remove(self._path(state, request_id))
+            except FileNotFoundError:
+                pass
+
+        here = [s for s in STATES
+                if os.path.exists(self._path(s, request_id))]
+        if len(here) < 2:
+            return here[0] if here else None
+        if "done" in here:
+            for state in here:
+                if state != "done":
+                    drop(state)
+            return "done"
+        pend, act = load("pending"), load("active")
+        if act is None:
+            drop("active")
+            return "pending"
+        if pend is None:
+            drop("pending")
+            return "active"
+        if int(pend.get("requeues", 0)) > int(act.get("requeues", 0)):
+            drop("active")      # crashed requeue: pending/ committed
+            return "pending"
+        drop("pending")         # crashed claim: active/ committed
+        return "active"

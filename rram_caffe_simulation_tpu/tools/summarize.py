@@ -349,7 +349,7 @@ def merge_metric_streams(paths):
 def _classify(streams):
     """Split merged stream records into the digest buckets."""
     recs, retries, requests, spans, workers = [], [], [], [], []
-    health, alerts = [], []
+    health, alerts, chaos = [], [], []
     n_typed = 0
     for _, stream in streams:
         for rec in stream:
@@ -366,6 +366,8 @@ def _classify(streams):
                 health.append(rec)
             elif rtype == "alert":
                 alerts.append(rec)
+            elif rtype == "chaos":
+                chaos.append(rec)
             elif rtype is not None:
                 # debug_trace / sentinel / setup records ride the same
                 # sink; the digest summarizes the display-interval
@@ -374,7 +376,7 @@ def _classify(streams):
             else:
                 recs.append(rec)
     return recs, retries, requests, spans, workers, health, alerts, \
-        n_typed
+        chaos, n_typed
 
 
 def _worker_digest(workers):
@@ -429,6 +431,33 @@ def _health_digest(health):
         + " (--health forecasts per tile)"]
 
 
+def _chaos_digest(chaos):
+    """Digest of `chaos` injection records (serve/fleet/chaos.py):
+    per-event counts plus a one-line entry per injection — what was
+    done to the fleet, next to the worker/alert records that show how
+    it survived."""
+    by_event = {}
+    for r in chaos:
+        by_event.setdefault(r.get("event", "?"), []).append(r)
+    parts = [f"{len(v)} {k}" for k, v in sorted(by_event.items())]
+    seeds = sorted({r.get("seed") for r in chaos
+                    if r.get("seed") is not None})
+    head = f"Chaos injections ({len(chaos)}): " + ", ".join(parts)
+    if seeds:
+        head += " [seed " + ", ".join(str(s) for s in seeds) + "]"
+    lines = [head]
+    for r in chaos:
+        bits = [f"beat {r.get('iter', '?')}: {r.get('event', '?')}"]
+        if r.get("target"):
+            bits.append(f"-> {r['target']}")
+        if r.get("stage"):
+            bits.append(f"at stage {r['stage']}")
+        if r.get("offset") is not None:
+            bits.append(f"(byte offset {r['offset']})")
+        lines.append("  " + " ".join(bits))
+    return lines
+
+
 def _alert_digest(alerts):
     """Digest of watchtower `alert` transition records: per-event
     counts plus the set of alerts still firing at stream end."""
@@ -455,11 +484,12 @@ def summarize_metrics(paths):
         paths = [paths]
     files = _expand_metric_paths(paths)
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, workers, health, alerts, n_typed = \
-        _classify(streams)
+    recs, retries, requests, spans, workers, health, alerts, chaos, \
+        n_typed = _classify(streams)
     path = files[0] if len(files) == 1 else \
         f"{len(files)} files, {len(streams)} stream(s)"
-    if not recs and (requests or workers or health or alerts):
+    if not recs and (requests or workers or health or alerts
+                     or chaos):
         # a per-request stream (sweep service) or a controller-only
         # fleet stream carries lifecycle records only — digest those
         # without demanding metrics
@@ -472,6 +502,8 @@ def summarize_metrics(paths):
             lines += _health_digest(health)
         if alerts:
             lines += _alert_digest(alerts)
+        if chaos:
+            lines += _chaos_digest(chaos)
         return "\n".join(lines)
     if not recs:
         return f"{path}: no records"
@@ -528,6 +560,8 @@ def summarize_metrics(paths):
         lines += _health_digest(health)
     if alerts:
         lines += _alert_digest(alerts)
+    if chaos:
+        lines += _chaos_digest(chaos)
     lmap = last.get("lane_map")
     if isinstance(lmap, list):
         # keep the one-screen contract: a 500-lane sweep's full map
@@ -630,7 +664,7 @@ def summarize_health(paths, threshold=None, top=16):
         paths = [paths]
     files = _expand_metric_paths(paths)
     streams, notes = merge_metric_streams(files)
-    _, _, _, _, _, health, alerts, _ = _classify(streams)
+    _, _, _, _, _, health, alerts, _, _ = _classify(streams)
     path = files[0] if len(files) == 1 else \
         f"{len(files)} files, {len(streams)} stream(s)"
     lines = [f"Health: {path}"] + notes
@@ -711,12 +745,17 @@ def summarize_timeline(paths, slo_seconds: float = 0.0):
                 "no spans recorded (no metrics*.jsonl, fleet.jsonl, "
                 "or requests/*.jsonl streams found)")
     streams, notes = merge_metric_streams(files)
-    recs, retries, requests, spans, workers, _, _, _ = \
+    recs, retries, requests, spans, workers, _, _, chaos, _ = \
         _classify(streams)
     lines = [f"Timeline: {len(files)} file(s), "
              f"{len(streams)} stream(s)"] + notes
     if workers:
         lines += _worker_digest(workers)
+    if chaos:
+        # injections belong on the timeline: each entry names the
+        # plan beat, so the lifecycle events around it read as
+        # cause -> recovery
+        lines += _chaos_digest(chaos)
 
     # --- fleet-wide lane occupancy (ROADMAP item 2's >90 % bar) ---
     occ = OccupancyAggregator()

@@ -1,0 +1,608 @@
+#!/usr/bin/env python
+"""CI guard for the fleet chaos plane (serve/fleet/chaos.py) and the
+exactly-once hardening it proves (ISSUE 20).
+
+**Leg A — seeded chaos sweeps, simulated workers (>= 3 seeds).**
+A host-only fleet (no jax): three registered workers whose "pods" are
+killable `sleep` subprocesses and whose request servicing is a
+deterministic pure function of the request configs. Each seed's
+`ChaosPlan` injects worker SIGKILL, controller kills at seeded beat
+stages (every seed is chosen so its schedule includes BOTH a commit
+tear at a seeded byte offset AND a mid-beat stage kill), torn spool /
+worker-table writes, socket faults, and a heartbeat stall. The
+harness cold-restarts the controller on every `ControllerKilled` and
+keeps beating until the plan is drained. Asserts, per seed:
+
+- every request terminal exactly once (present in done/ and ONLY
+  done/), status completed, results identical to the chaos-free
+  expectation;
+- every scheduled controller kill applied (restart count matches),
+  the commit kill's torn state.json quarantined to poison/;
+- both torn writes quarantined (poison/ non-empty, the
+  `rram_fleet_poison_total` rollup gauge exported);
+- every applied injection present on fleet.jsonl as a schema-valid
+  `chaos` record, and the same seed re-generates a byte-identical
+  schedule (reproducibility).
+
+Across seeds: commit-tear byte offsets actually vary, and the
+`poison_quarantine` alert lifecycle shows up on at least one fleet.
+
+**Leg B — real fleet, byte-identity under chaos (1 seed).**
+The check_fleet.py shape: one fleet spool, two REAL subprocess
+workers (shared default physics), an unpinned request stream — run
+under a chaos plan limited to controller kills + torn writes + socket
+faults + a heartbeat stall (no worker kills, so every request runs
+exactly once on one worker). The controller is cold-restarted on
+every kill. Afterwards each worker's served subset is replayed, in
+config-id order, through a dedicated single `SweepService` with
+identical parameters — losses, fault npz bytes, and config-id
+allocation must be byte-identical: chaos may delay work, never change
+its numbers.
+
+    python scripts/check_fleet_chaos.py [--skip-real]
+
+Exit status: 0 = every contract holds, 1 = any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: leg A seed scan starts — each start is advanced deterministically
+#: until the generated schedule contains BOTH a commit-stage kill
+#: (torn state.json at a seeded byte offset) and a mid-beat stage kill
+SEED_STARTS = (11, 101, 1001)
+
+LEG_A_KNOBS = dict(horizon_beats=18, start_beat=2, worker_kills=1,
+                   controller_kills=2, torn_writes=2, socket_drops=2,
+                   heartbeat_stalls=1)
+LEG_B_KNOBS = dict(horizon_beats=14, start_beat=2, worker_kills=0,
+                   controller_kills=2, torn_writes=1, socket_drops=1,
+                   heartbeat_stalls=1)
+
+#: leg A stream: (id, [(mean, std), ...]); ids sort in submission
+#: order. The last two are submitted MID-CHAOS (loop ticks 6 and 10)
+#: so routing keeps happening while kills are armed.
+SIM_REQUESTS = [
+    ("req-00", [(500.0, 100.0), (480.0, 100.0)]),
+    ("req-01", [(520.0, 90.0)]),
+    ("req-02", [(470.0, 85.0), (510.0, 85.0), (450.0, 85.0)]),
+    ("req-03", [(460.0, 95.0)]),
+    ("req-04", [(505.0, 70.0), (495.0, 70.0)]),
+    ("req-05", [(515.0, 60.0)]),
+]
+SIM_LATE = {"req-04": 6, "req-05": 10}
+
+#: leg B stream: (id, tenant, [(mean, std), ...], iters) — unpinned,
+#: so either worker may serve any of them
+REAL_REQUESTS = [
+    ("c0-alice", "alice",
+     [(500, 100), (480, 100), (460, 100), (440, 100)], 40),
+    ("c1-bob", "bob", [(520, 90), (450, 90)], 20),
+    ("c2-carol", "carol", [(470, 85), (510, 85)], 40),
+    ("c3-dave", "dave", [(500, 95), (490, 95), (510, 95)], 30),
+]
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def _pick_seed(start: int, knobs: dict) -> int:
+    """The first seed >= start whose schedule includes BOTH a commit
+    tear and a non-commit stage kill — a pure function of the
+    constructor, so the scan is deterministic."""
+    from rram_caffe_simulation_tpu.serve.fleet import ChaosPlan
+    seed = int(start)
+    while True:
+        stages = [e["stage"]
+                  for e in ChaosPlan(seed, **knobs).schedule
+                  if e["event"] == "controller_kill"]
+        if "commit" in stages and any(s != "commit" for s in stages):
+            return seed
+        seed += 1
+
+
+def _fake_results(configs) -> dict:
+    """The simulated worker's 'training': a pure function of the
+    request configs — identical no matter which worker or attempt
+    serves it, which is exactly the property chaos must preserve."""
+    return {str(i): {"loss": round(float(c["mean"]) / 1000.0
+                                   + float(c["std"]) / 10000.0
+                                   + 0.25 * i, 6)}
+            for i, c in enumerate(configs)}
+
+
+class _SimWorker:
+    """A fleet worker reduced to its protocol surface: a killable pid
+    (a `sleep` subprocess), a registered table row with heartbeats,
+    and a spool it drains — claiming on one harness tick, finishing on
+    the next, so a worker kill can land mid-flight."""
+
+    def __init__(self, fleet_dir: str, wid: str):
+        import socket
+        from rram_caffe_simulation_tpu.serve import Spool
+        from rram_caffe_simulation_tpu.serve.fleet import WorkerTable
+        self.wid = wid
+        self.table = WorkerTable(fleet_dir)
+        self.proc = subprocess.Popen(["sleep", "600"])
+        self.spool = Spool(os.path.join(self.table.worker_dir(wid),
+                                        "spool"))
+        self.inflight: set = set()
+        self.departed = False
+        self.table.register(wid, {
+            "pid": self.proc.pid, "host": socket.gethostname(),
+            "lanes": 4, "occupied_lanes": 0, "pending_configs": 0})
+
+    def alive(self) -> bool:
+        return not self.departed and self.proc.poll() is None
+
+    def tick(self):
+        if self.departed:
+            return
+        if self.proc.poll() is not None:      # chaos SIGKILLed the pod
+            self.departed = True
+            return
+        if self.table.read(self.wid) is None:  # declared dead; exit
+            self.stop()
+            return
+        for rid in sorted(self.inflight):
+            req = self.spool.read(rid)
+            if req is not None and req.get("state") == "active":
+                self.spool.finish(rid, {
+                    "status": "completed",
+                    "results": _fake_results(req.get("configs") or []),
+                    "latency_s": 0.01})
+            self.inflight.discard(rid)
+        for rid in self.spool.pending_ids():
+            if self.spool.read(rid) is None:
+                continue
+            self.spool.claim(rid)
+            self.inflight.add(rid)
+        self.table.heartbeat(self.wid, {
+            "occupied_lanes": len(self.inflight),
+            "pending_configs": 0})
+
+    def stop(self):
+        self.departed = True
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+
+def _chaos_records(metrics_path: str):
+    from rram_caffe_simulation_tpu.observe import validate_record
+    recs, violations = [], []
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("type") == "chaos":
+                    recs.append(rec)
+                    violations += validate_record(rec)
+    return recs, violations
+
+
+def _alert_events(metrics_path: str, alert: str):
+    events = []
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("type") == "alert" \
+                        and rec.get("alert") == alert:
+                    events.append(rec.get("event"))
+    return events
+
+
+def _run_chaos_sim(tmp: str, seed: int):
+    """One leg-A chaos sweep. Returns (failure message or None,
+    evidence dict for the cross-seed asserts)."""
+    from rram_caffe_simulation_tpu.serve import Spool
+    from rram_caffe_simulation_tpu.serve.fleet import (ChaosPlan,
+                                                       ControllerKilled)
+    from rram_caffe_simulation_tpu.serve.fleet.controller import \
+        FleetController
+
+    fleet = os.path.join(tmp, f"sim_{seed}")
+    os.makedirs(fleet, exist_ok=True)
+    spool = Spool(os.path.join(fleet, "spool"))
+    plan = ChaosPlan(seed, **LEG_A_KNOBS)
+    # reproducibility: the same seed + knobs regenerate the schedule
+    if ChaosPlan(seed, **LEG_A_KNOBS).schedule != plan.schedule:
+        return f"seed {seed}: schedule not reproducible", {}
+
+    workers = [_SimWorker(fleet, f"w{i}") for i in range(3)]
+    for rid, specs in SIM_REQUESTS:
+        if rid not in SIM_LATE:
+            spool.submit({"id": rid, "tenant": "chaos", "iters": 10,
+                          "configs": [{"mean": m, "std": s}
+                                      for m, s in specs]})
+
+    def make_ctl():
+        return FleetController(fleet, chaos=plan, scrape_sockets=False,
+                               poll_interval_s=0.0,
+                               heartbeat_timeout_s=5.0)
+
+    ctl = make_ctl()
+    restarts = 0
+    rids = [rid for rid, _ in SIM_REQUESTS]
+    try:
+        for loop in range(1, 801):
+            for rid, specs in SIM_REQUESTS:
+                if SIM_LATE.get(rid) == loop:
+                    spool.submit({"id": rid, "tenant": "chaos",
+                                  "iters": 10,
+                                  "configs": [{"mean": m, "std": s}
+                                              for m, s in specs]})
+            for w in workers:
+                w.tick()
+            try:
+                ctl.beat()
+            except ControllerKilled as e:
+                restarts += 1
+                print(f"  seed {seed}: {e}; cold restart", flush=True)
+                ctl = make_ctl()
+                continue
+            if all(spool.state_of(r) == "done" for r in rids) \
+                    and plan.summary()["pending"] == 0 \
+                    and plan._armed_kill is None \
+                    and not ctl.assignments:
+                break
+            time.sleep(0.02)
+        else:
+            return (f"seed {seed}: fleet never drained "
+                    f"({plan.summary()})"), {}
+    finally:
+        for w in workers:
+            w.stop()
+
+    # exactly-once terminal state + chaos-free-identical results
+    for rid, specs in SIM_REQUESTS:
+        states = [s for s in ("pending", "active", "done")
+                  if os.path.exists(spool._path(s, rid))]
+        if states != ["done"]:
+            return f"seed {seed}: {rid} in state dirs {states}", {}
+        req = spool.read(rid)
+        if req.get("status") != "completed":
+            return (f"seed {seed}: {rid} ended "
+                    f"{req.get('status')!r}"), {}
+        expect = _fake_results([{"mean": m, "std": s}
+                                for m, s in specs])
+        if req.get("results") != expect:
+            return (f"seed {seed}: {rid} results {req.get('results')} "
+                    f"!= chaos-free expectation {expect}"), {}
+
+    summary = plan.summary()
+    applied = summary["applied"]
+    sched = summary["scheduled"]
+    for kind in ("controller_kill", "worker_kill", "torn_write"):
+        if applied.get(kind, 0) != sched.get(kind, 0):
+            return (f"seed {seed}: {kind} applied "
+                    f"{applied.get(kind, 0)} != scheduled "
+                    f"{sched.get(kind, 0)}"), {}
+    if restarts != sched["controller_kill"]:
+        return (f"seed {seed}: {restarts} restarts != "
+                f"{sched['controller_kill']} scheduled kills"), {}
+
+    poison = os.path.join(fleet, "poison")
+    if not os.path.isdir(poison) or not os.listdir(poison):
+        return f"seed {seed}: poison/ empty after torn writes", {}
+    with open(os.path.join(fleet, "metrics.prom")) as f:
+        prom = f.read()
+    if "rram_fleet_poison_total" not in prom:
+        return (f"seed {seed}: rram_fleet_poison_total missing from "
+                "the rollup"), {}
+
+    recs, violations = _chaos_records(os.path.join(fleet,
+                                                   "fleet.jsonl"))
+    if violations:
+        return (f"seed {seed}: chaos record schema violations: "
+                f"{violations[:4]}"), {}
+    if len(recs) < sum(applied.values()):
+        return (f"seed {seed}: {len(recs)} chaos records on "
+                f"fleet.jsonl < {sum(applied.values())} applied"), {}
+    commit_offsets = [r["offset"] for r in recs
+                      if r["event"] == "controller_kill"
+                      and r.get("stage") == "commit"
+                      and isinstance(r.get("offset"), int)]
+    if not commit_offsets:
+        return (f"seed {seed}: no commit-stage kill record with a "
+                "byte offset"), {}
+    evidence = {
+        "commit_offsets": commit_offsets,
+        "poison_alert": "firing" in _alert_events(
+            os.path.join(fleet, "fleet.jsonl"), "poison_quarantine"),
+        "restarts": restarts,
+        "applied": applied,
+    }
+    print(f"  seed {seed}: {restarts} controller kills survived, "
+          f"commit tears at bytes {commit_offsets}, "
+          f"injections applied {applied}", flush=True)
+    return None, evidence
+
+
+def _leg_a() -> int:
+    print("=== leg A: seeded chaos sweeps, simulated fleet ===",
+          flush=True)
+    tmp = tempfile.mkdtemp(prefix="fleet_chaos_sim_")
+    all_offsets, any_poison_alert = [], False
+    for start in SEED_STARTS:
+        seed = _pick_seed(start, LEG_A_KNOBS)
+        err, ev = _run_chaos_sim(tmp, seed)
+        if err:
+            return _fail(err)
+        all_offsets += ev["commit_offsets"]
+        any_poison_alert = any_poison_alert or ev["poison_alert"]
+    if len(set(all_offsets)) < 2:
+        return _fail("commit tear offsets did not vary across seeds: "
+                     f"{all_offsets}")
+    if not any_poison_alert:
+        return _fail("poison_quarantine alert never fired on any "
+                     "seed's fleet")
+    print(f"OK: leg A: {len(SEED_STARTS)} seeds — every request "
+          "terminal exactly once with chaos-free-identical results, "
+          "every scheduled kill applied and survived, torn writes "
+          "quarantined, commit tears at distinct byte offsets "
+          f"{sorted(set(all_offsets))}, schema-valid chaos records "
+          "throughout", flush=True)
+    return 0
+
+
+# ----------------------------------------------------------------------
+# leg B: real fleet, byte-identity under chaos
+
+def _replay_reference(solver, replay_dir, ordered):
+    """Replay one worker's served subset, in its config-id order,
+    through a dedicated single service with the fleet workers'
+    parameters. Returns {original id: replayed payload} + the replay
+    root for npz comparison."""
+    from rram_caffe_simulation_tpu.serve import Spool, SweepService
+    svc = SweepService(solver, replay_dir, lanes=4, chunk=10,
+                       default_iters=10, max_retries=1,
+                       socket_path=None, save_fault_results=True,
+                       poll_interval_s=0.05)
+    rename = {}
+    for k, (rid, req) in enumerate(ordered):
+        qid = f"q{k:02d}"
+        rename[rid] = qid
+        svc.spool.submit({"id": qid, "tenant": req["tenant"],
+                          "iters": req["iters"],
+                          "configs": [dict(c)
+                                      for c in req["configs"]]})
+    code = svc.serve(max_beats=1)
+    if code == 0:
+        code = svc.serve(drain_when_idle=True)
+    svc.close()
+    if code != 0:
+        raise RuntimeError(f"replay service exited {code}")
+    spool = Spool(os.path.join(replay_dir, "spool"))
+    return {rid: spool.read(qid) for rid, qid in rename.items()}
+
+
+def _leg_b() -> int:
+    import numpy as np
+    import check_fleet as cf
+    from rram_caffe_simulation_tpu import cache as perf_cache
+    from rram_caffe_simulation_tpu.serve import Spool
+    from rram_caffe_simulation_tpu.serve.fleet import (ChaosPlan,
+                                                       ControllerKilled,
+                                                       WorkerTable)
+    from rram_caffe_simulation_tpu.serve.fleet.controller import \
+        FleetController
+
+    print("=== leg B: real 2-worker fleet under chaos, byte-identity "
+          "vs dedicated replays ===", flush=True)
+    tmp = tempfile.mkdtemp(prefix="fleet_chaos_real_")
+    cache_dir = os.path.join(tmp, "cache")
+    perf_cache.enable_compilation_cache(cache_dir,
+                                        min_compile_time_s=0.05)
+    os.environ["RRAM_TPU_CACHE_DIR"] = cache_dir
+    db = os.path.join(tmp, "db")
+    solver = os.path.join(tmp, "solver.prototxt")
+    cf._build_db(db)
+    cf._write_solver(solver, db)
+
+    fleet = os.path.join(tmp, "fleet")
+    os.makedirs(fleet, exist_ok=True)
+    fleet_spool = Spool(os.path.join(fleet, "spool"))
+    table = WorkerTable(fleet)
+    requests = {}
+    for rid, tenant, specs, iters in REAL_REQUESTS:
+        req = {"id": rid, "tenant": tenant, "iters": iters,
+               "configs": [{"mean": m, "std": s} for m, s in specs]}
+        requests[rid] = req
+        fleet_spool.submit(dict(req, configs=[dict(c)
+                                              for c in req["configs"]]))
+
+    seed = _pick_seed(7, LEG_B_KNOBS)
+    plan = ChaosPlan(seed, **LEG_B_KNOBS)
+    print(f"chaos seed {seed}: schedule "
+          f"{[(e['beat'], e['event']) for e in plan.schedule]}",
+          flush=True)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_cmd = [sys.executable, "-m",
+                "rram_caffe_simulation_tpu.serve.fleet.worker",
+                "--fleet-dir", fleet, "--solver", solver,
+                "--lanes", "4", "--chunk", "10",
+                "--default-iters", "10",
+                "--poll-interval", "0.05", "--save-fault-results",
+                "--cache-dir", cache_dir]
+    logdir = os.path.join(fleet, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    procs = {}
+    for name in ("w0", "w1"):
+        log = open(os.path.join(logdir, f"{name}.log"), "wb")
+        procs[name] = subprocess.Popen(base_cmd + ["--name", name],
+                                       env=env, cwd=_REPO,
+                                       stdout=log,
+                                       stderr=subprocess.STDOUT)
+        log.close()
+
+    def make_ctl():
+        return FleetController(fleet, heartbeat_timeout_s=30,
+                               poll_interval_s=0.0, chaos=plan)
+
+    rids = list(requests)
+    restarts = 0
+    try:
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if set(table.ids()) >= {"w0", "w1"}:
+                break
+            time.sleep(0.5)
+        else:
+            return _fail("leg B: subprocess workers never registered")
+        print("both subprocess workers registered", flush=True)
+        ctl = make_ctl()
+        deadline = time.monotonic() + 900
+        while time.monotonic() < deadline:
+            try:
+                ctl.beat()
+            except ControllerKilled as e:
+                restarts += 1
+                print(f"leg B: {e}; cold restart", flush=True)
+                ctl = make_ctl()
+                continue
+            if all(fleet_spool.state_of(r) == "done" for r in rids) \
+                    and plan.summary()["pending"] == 0 \
+                    and plan._armed_kill is None \
+                    and not ctl.assignments:
+                break
+            time.sleep(0.2)
+        else:
+            return _fail(f"leg B: fleet never drained "
+                         f"({plan.summary()})")
+
+        if restarts != LEG_B_KNOBS["controller_kills"]:
+            return _fail(f"leg B: {restarts} restarts != "
+                         f"{LEG_B_KNOBS['controller_kills']} "
+                         "scheduled controller kills")
+        recs, violations = _chaos_records(os.path.join(
+            fleet, "fleet.jsonl"))
+        if violations:
+            return _fail("leg B: chaos record schema violations: "
+                         f"{violations[:4]}")
+        if not any(r["event"] == "controller_kill"
+                   and r.get("stage") == "commit" for r in recs):
+            return _fail("leg B: the commit tear left no chaos record")
+
+        worker_dirs = {w: table.worker_dir(w) for w in ("w0", "w1")}
+        worker_spools = {w: Spool(os.path.join(d, "spool"))
+                         for w, d in worker_dirs.items()}
+        served = {w: [] for w in worker_dirs}
+        for rid in rids:
+            got = fleet_spool.read(rid)
+            if got is None or got.get("state") != "done" \
+                    or got.get("status") != "completed":
+                return _fail(f"leg B: {rid} not terminal-completed "
+                             f"({got and got.get('status')!r})")
+            holders = [w for w, sp in worker_spools.items()
+                       if sp.state_of(rid) is not None]
+            if len(holders) != 1:
+                return _fail(f"leg B: {rid} present in {holders} "
+                             "worker spools, expected exactly one")
+            if holders[0] != got.get("worker"):
+                return _fail(f"leg B: {rid} harvested from "
+                             f"{got.get('worker')} but lives in "
+                             f"{holders[0]}'s spool")
+            served[holders[0]].append(rid)
+
+        print("replaying each worker's served subset through a "
+              "dedicated reference service", flush=True)
+        for wid, mine in served.items():
+            if not mine:
+                continue
+            ordered = sorted(
+                ((rid, requests[rid]) for rid in mine),
+                key=lambda p: worker_spools[wid].read(p[0])
+                ["cfg_ids"][0])
+            refs = _replay_reference(
+                solver, os.path.join(tmp, f"replay_{wid}"), ordered)
+            for rid in mine:
+                ref = refs[rid]
+                got = fleet_spool.read(rid)
+                wreq = worker_spools[wid].read(rid)
+                if wreq.get("cfg_ids") != ref.get("cfg_ids"):
+                    return _fail(
+                        f"leg B: {rid} config ids "
+                        f"{wreq.get('cfg_ids')} on {wid} != replay "
+                        f"{ref.get('cfg_ids')}")
+                if set(got.get("results", {})) \
+                        != set(ref.get("results", {})):
+                    return _fail(f"leg B: {rid} result keys differ "
+                                 "from the replay")
+                for cfg, v in got["results"].items():
+                    rv = ref["results"][cfg]
+                    if np.float64(v["loss"]).tobytes() \
+                            != np.float64(rv["loss"]).tobytes():
+                        return _fail(
+                            f"leg B: {rid} config {cfg} loss "
+                            f"{v['loss']!r} != replay {rv['loss']!r}")
+                    a = cf._npz_bytes(worker_dirs[wid],
+                                      v["fault_npz"])
+                    b = cf._npz_bytes(os.path.join(tmp,
+                                                   f"replay_{wid}"),
+                                      rv["fault_npz"])
+                    if a != b:
+                        return _fail(f"leg B: {rid} config {cfg} "
+                                     "fault rows differ from the "
+                                     "replay")
+        print(f"OK: leg B: all {len(rids)} requests completed exactly "
+              f"once across {restarts} controller kills; losses + "
+              "fault npz + config-id allocation byte-identical to "
+              "the chaos-free dedicated replays", flush=True)
+        return 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def main() -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-real", action="store_true",
+                    help="run only the host-side simulated leg "
+                         "(no jax workers)")
+    args = ap.parse_args()
+    rc = _leg_a()
+    if rc:
+        return rc
+    if not args.skip_real:
+        rc = _leg_b()
+        if rc:
+            return rc
+    print("OK: fleet chaos plane holds — deterministic injection, "
+          "exactly-once delivery, poison quarantine, byte-identical "
+          "results under failure")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

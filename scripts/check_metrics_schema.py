@@ -357,6 +357,25 @@ SAMPLE_BAD_HEALTH = {
         "fc2/0": "worn"},                         # entry not an object
 }
 
+# chaos injections (serve/fleet/chaos.py ChaosPlan → schema.py
+# CHAOS_FIELDS): one record per APPLIED injection on fleet.jsonl;
+# `iter` is the plan's own beat clock (immune to controller restarts)
+SAMPLE_GOOD_CHAOS = {
+    "schema_version": 1, "type": "chaos", "iter": 7,
+    "wall_time": 1722700000.0, "event": "controller_kill",
+    "seed": 1234, "stage": "commit", "offset": 113,
+    "target": "/fleet/state.json",
+    "reason": "SIGKILL mid-write of the state.json commit record",
+}
+
+SAMPLE_BAD_CHAOS = {
+    "schema_version": 1, "type": "chaos", "iter": 7,
+    "wall_time": 1722700000.0, "event": "gremlins",  # unknown event,
+    "seed": -1, "offset": -8, "beats": 0,            # negative seed/
+    "target": "", "stage": 13,                       # offset, beats<1,
+}                                                    # empty target,
+                                                     # non-str stage
+
 # Prometheus/OpenMetrics text exposition (observe/metrics_registry.py):
 # what the `metrics` socket op and the controller's metrics.prom rollup
 # emit — validated by validate_exposition, not the record schema
@@ -438,6 +457,7 @@ def main(argv=None) -> int:
                           ("sentinel", SAMPLE_GOOD_SENTINEL),
                           ("setup", SAMPLE_GOOD_SETUP),
                           ("alert", SAMPLE_GOOD_ALERT),
+                          ("chaos", SAMPLE_GOOD_CHAOS),
                           ("health", SAMPLE_GOOD_HEALTH)):
             errs = schema.validate_record(rec)
             if errs:
@@ -458,6 +478,7 @@ def main(argv=None) -> int:
                           ("sentinel", SAMPLE_BAD_SENTINEL),
                           ("setup", SAMPLE_BAD_SETUP),
                           ("alert", SAMPLE_BAD_ALERT),
+                          ("chaos", SAMPLE_BAD_CHAOS),
                           ("health", SAMPLE_BAD_HEALTH)):
             errs = schema.validate_record(rec)
             if not errs:
@@ -478,8 +499,8 @@ def main(argv=None) -> int:
                   "(exposition validator lost its teeth)")
             return 1
         n_bad += len(expo_bad)
-        print("sample self-check OK (14 good records + 1 exposition "
-              f"accepted, 14 bad records + 1 bad exposition produced "
+        print("sample self-check OK (15 good records + 1 exposition "
+              f"accepted, 15 bad records + 1 bad exposition produced "
               f"{n_bad} violations)")
         return 0
     if not args.files:

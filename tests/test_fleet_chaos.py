@@ -1,0 +1,381 @@
+"""Chaos plane + exactly-once hardening (ISSUE 20): the seeded
+`ChaosPlan` schedule, poison quarantine of torn spool/worker-table
+files at several truncation offsets, `resolve_dual`'s crashed-rename
+direction logic, duplicate-harvest dedup by (request, attempt), the
+claim-first journaled routing order, the controller's scrape-failure
+backoff + `scrape_failures` alert rule, and the ServeClient's
+transient-socket-drop fallback. No devices, no solver builds — the
+end-to-end SIGKILL/cold-restart contract is CI-guarded by
+scripts/check_fleet_chaos.py."""
+import json
+import os
+import time
+
+import pytest
+
+from rram_caffe_simulation_tpu.observe import (make_chaos_record,
+                                               chaos_line,
+                                               validate_record)
+from rram_caffe_simulation_tpu.serve import Spool
+from rram_caffe_simulation_tpu.serve.fleet import (AlertEngine,
+                                                   ChaosPlan,
+                                                   ControllerKilled,
+                                                   KILL_STAGES,
+                                                   WorkerTable,
+                                                   default_rules)
+from rram_caffe_simulation_tpu.serve.fleet.controller import \
+    FleetController
+from rram_caffe_simulation_tpu.serve.serve_client import ServeClient
+
+
+def _fresh_row(lanes=2):
+    return {"pinned": {"process": "endurance_stuck_at",
+                       "dtype_policy": "f32", "net": "quick",
+                       "tiles": "1x1", "mesh": "single"},
+            "lanes": lanes, "occupied_lanes": 0,
+            "pending_configs": 0, "steps_per_sec": 100.0}
+
+
+def _controller(tmp_path, **kw):
+    kw.setdefault("scrape_sockets", False)
+    kw.setdefault("poll_interval_s", 0.01)
+    return FleetController(str(tmp_path / "fleet"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# ChaosPlan: seeded determinism + record schema
+
+
+def test_chaos_plan_deterministic_per_seed():
+    a = ChaosPlan(1234)
+    b = ChaosPlan(1234)
+    assert a.schedule == b.schedule
+    assert a.schedule  # non-empty with the default knobs
+    c = ChaosPlan(1235)
+    assert c.schedule != a.schedule
+    for ev in a.schedule:
+        assert ev["event"] in ("worker_kill", "controller_kill",
+                               "torn_write", "socket_drop",
+                               "socket_timeout", "heartbeat_stall")
+        if ev["event"] == "controller_kill":
+            assert ev["stage"] in KILL_STAGES
+
+
+def test_chaos_record_schema_good_and_bad():
+    rec = make_chaos_record(7, "torn_write", seed=99,
+                            target="/fleet/spool/pending/x.json",
+                            offset=42, reason="truncated JSON")
+    assert validate_record(rec) == []
+    assert "torn_write" in chaos_line(rec)
+    bad = dict(rec, event="gremlins", offset=-3)
+    errs = validate_record(bad)
+    assert errs and any("event" in e for e in errs)
+
+
+def test_chaos_plan_clock_survives_controller_restart(tmp_path):
+    plan = ChaosPlan(7, horizon_beats=6, start_beat=2,
+                     worker_kills=0, controller_kills=1,
+                     torn_writes=0, socket_drops=0,
+                     heartbeat_stalls=0)
+    ctl = _controller(tmp_path, chaos=plan)
+    killed_at = None
+    for _ in range(12):
+        try:
+            ctl.beat()
+        except ControllerKilled:
+            killed_at = plan.beat
+            break
+    assert killed_at is not None
+    # cold restart on the same dir, same plan object: the plan clock
+    # keeps counting instead of resetting
+    ctl2 = _controller(tmp_path, chaos=plan)
+    ctl2.beat()
+    assert plan.beat == killed_at + 1
+    kills = [r for r in plan.applied
+             if r["event"] == "controller_kill"]
+    assert len(kills) == 1 and validate_record(kills[0]) == []
+
+
+# ---------------------------------------------------------------------------
+# poison quarantine: torn files at several truncation offsets
+
+
+@pytest.mark.parametrize("offset", [1, 9, 33, 70])
+def test_torn_pending_file_quarantines_at_any_offset(tmp_path, offset):
+    ctl = _controller(tmp_path)
+    blob = json.dumps({"id": "torn-req", "tenant": "t",
+                       "configs": [{"mean": 500.0, "std": 100.0}],
+                       "submit_time": time.time()},
+                      indent=2).encode()
+    assert offset < len(blob)
+    torn = ctl.spool._path("pending", "torn-req")
+    with open(torn, "wb") as f:
+        f.write(blob[:offset])
+    ctl.beat()                      # must not raise
+    assert not os.path.exists(torn)
+    assert ctl._poison_total == 1
+    moved = os.listdir(ctl.poison_dir)
+    assert any(n.startswith("pending-torn-req") for n in moved)
+    # the beat after sees a clean spool — no re-count, no crash loop
+    ctl.beat()
+    assert ctl._poison_total == 1
+
+
+def test_torn_worker_row_reaps_loudly_and_requeues(tmp_path):
+    ctl = _controller(tmp_path)
+    ctl.table.register("w0", _fresh_row())
+    rid = ctl.spool.submit({"id": "r1", "tenant": "t",
+                            "configs": [{"mean": 500.0,
+                                         "std": 100.0}]})
+    ctl.beat()
+    assert ctl.assignments[rid]["worker"] == "w0"
+    # tear the row in place (simulating corrupt bytes on disk)
+    with open(ctl.table._row_path("w0"), "w") as f:
+        f.write('{"worker": "w0", "pin')
+    ctl.beat()
+    # the worker died LOUDLY: row quarantined, request requeued
+    assert ctl._poison_total >= 1
+    assert ctl._deaths_total == 1
+    assert "w0" not in ctl.table.rows()
+    assert ctl.spool.state_of(rid) == "pending"
+    assert rid not in ctl.assignments
+    assert any(n.startswith("workers-w0") for n in
+               os.listdir(ctl.poison_dir))
+
+
+def test_torn_state_json_rebuilds_from_spool(tmp_path):
+    ctl = _controller(tmp_path)
+    ctl.table.register("w0", _fresh_row())
+    rid = ctl.spool.submit({"id": "r1", "tenant": "t",
+                            "configs": [{"mean": 500.0,
+                                         "std": 100.0}]})
+    ctl.beat()
+    blob = open(ctl._state_path()).read()
+    with open(ctl._state_path(), "w") as f:
+        f.write(blob[:len(blob) // 2])          # torn commit record
+    ctl2 = _controller(tmp_path)
+    # the torn record quarantined, the claim rebuilt from the spool
+    assert ctl2.assignments[rid]["worker"] == "w0"
+    assert ctl2._poison_total == 1
+    assert os.path.exists(os.path.join(ctl2.poison_dir, "state.json"))
+
+
+# ---------------------------------------------------------------------------
+# resolve_dual: crashed-rename direction logic
+
+
+def test_resolve_dual_done_always_wins(tmp_path):
+    sp = Spool(str(tmp_path / "sp"))
+    rid = sp.submit({"id": "r", "tenant": "t",
+                     "configs": [{"mean": 1.0, "std": 1.0}]})
+    done = dict(json.load(open(sp._path("pending", rid))),
+                status="completed")
+    with open(sp._path("done", rid), "w") as f:
+        json.dump(done, f)
+    assert sp.dual_ids() == [rid]
+    assert sp.resolve_dual(rid) == "done"
+    assert sp.state_of(rid) == "done"
+
+
+def test_resolve_dual_crashed_claim_vs_crashed_requeue(tmp_path):
+    sp = Spool(str(tmp_path / "sp"))
+    # crashed CLAIM: active copy written, pending remove lost — both
+    # carry the same requeues count, so active (the destination) wins
+    rid = sp.submit({"id": "c", "tenant": "t",
+                     "configs": [{"mean": 1.0, "std": 1.0}]})
+    req = json.load(open(sp._path("pending", rid)))
+    with open(sp._path("active", rid), "w") as f:
+        json.dump(dict(req, worker="w0", attempt=1), f)
+    assert sp.resolve_dual(rid) == "active"
+    assert json.load(open(sp._path("active", rid)))["worker"] == "w0"
+    # crashed REQUEUE: pending copy written with requeues bumped PAST
+    # the active copy's, active remove lost — pending wins
+    with open(sp._path("pending", rid), "w") as f:
+        json.dump(dict(req, requeues=1), f)
+    assert sp.resolve_dual(rid) == "pending"
+    assert sp.state_of(rid) == "pending"
+
+
+def test_resolve_dual_torn_half_loses(tmp_path):
+    sp = Spool(str(tmp_path / "sp"))
+    rid = sp.submit({"id": "r", "tenant": "t",
+                     "configs": [{"mean": 1.0, "std": 1.0}]})
+    with open(sp._path("active", rid), "w") as f:
+        f.write('{"id": "r", "wor')        # torn active half
+    assert sp.resolve_dual(rid) == "pending"
+    assert sp.state_of(rid) == "pending"
+
+
+# ---------------------------------------------------------------------------
+# exactly-once harvest: dedup by (request, attempt)
+
+
+def _route_one(ctl, rid):
+    ctl.beat()
+    a = ctl.assignments[rid]
+    return a["worker"], int(a["attempt"])
+
+
+def test_harvest_ignores_stale_attempt_done_file(tmp_path):
+    ctl = _controller(tmp_path)
+    ctl.table.register("w0", _fresh_row())
+    rid = ctl.spool.submit({"id": "r1", "tenant": "t",
+                            "configs": [{"mean": 500.0,
+                                         "std": 100.0}]})
+    wid, attempt = _route_one(ctl, rid)
+    wspool = ctl._worker_spool(wid)
+    assert wspool.read(rid)["attempt"] == attempt
+    # debris of an EARLIER attempt: a done file stamped attempt-1
+    wspool.claim(rid)
+    wspool.finish(rid, {"status": "completed", "attempt": attempt - 1,
+                        "results": {"0": {"final_loss": 9.9}}})
+    ctl.beat()
+    assert ctl.spool.state_of(rid) == "active"   # NOT harvested
+    # the current attempt's terminal file harvests exactly once
+    wspool.update(rid, "done", {"attempt": attempt})
+    ctl.beat()
+    term = ctl.spool.read(rid)
+    assert term["state"] == "done"
+    assert term["attempt"] == attempt
+    assert rid not in ctl.assignments
+
+
+def test_duplicate_harvest_commits_terminal_record_once(tmp_path):
+    ctl = _controller(tmp_path)
+    ctl.table.register("w0", _fresh_row())
+    rid = ctl.spool.submit({"id": "r1", "tenant": "t",
+                            "configs": [{"mean": 500.0,
+                                         "std": 100.0}]})
+    wid, attempt = _route_one(ctl, rid)
+    wspool = ctl._worker_spool(wid)
+    wspool.claim(rid)
+    wspool.finish(rid, {"status": "completed",
+                        "results": {"0": {"final_loss": 1.0}},
+                        "latency_s": 0.5})
+    assert ctl.beat()["harvested"] == [rid]
+    before = json.load(open(ctl.spool._path("done", rid)))
+    # a crashed controller reloading a STALE assignment must not land
+    # a second terminal record (or resurrect the request)
+    ctl.assignments[rid] = {"worker": wid, "attempt": attempt}
+    assert ctl.beat()["harvested"] == []
+    after = json.load(open(ctl.spool._path("done", rid)))
+    assert after == before
+    assert rid not in ctl.assignments
+
+
+def test_route_claims_before_worker_copy(tmp_path):
+    """The fleet-spool claim is the routing commit record: a kill at
+    the 'claim' checkpoint leaves the request active+assigned but not
+    yet copied, and _redeliver heals it on the next beat — never a
+    second route to a different worker."""
+    plan = ChaosPlan(1, horizon_beats=2, start_beat=1,
+                     worker_kills=0, controller_kills=1,
+                     torn_writes=0, socket_drops=0, heartbeat_stalls=0,
+                     kill_stages=("claim",))
+    ctl = _controller(tmp_path, chaos=plan)
+    ctl.table.register("w0", _fresh_row())
+    rid = ctl.spool.submit({"id": "r1", "tenant": "t",
+                            "configs": [{"mean": 500.0,
+                                         "std": 100.0}]})
+    with pytest.raises(ControllerKilled):
+        ctl.beat()          # the kill strikes AT the claim checkpoint
+    # killed between claim and worker copy: active at fleet level,
+    # nothing in the worker spool yet
+    assert ctl.spool.state_of(rid) == "active"
+    assert ctl._worker_spool("w0").state_of(rid) is None
+    ctl2 = _controller(tmp_path, chaos=plan)
+    assert ctl2.assignments[rid]["worker"] == "w0"
+    ctl2.beat()
+    copy = ctl2._worker_spool("w0").read(rid)
+    assert copy is not None
+    assert copy["attempt"] == ctl2.assignments[rid]["attempt"]
+    # exactly one worker ever saw it, exactly one active file exists
+    assert ctl2.spool.state_of(rid) == "active"
+
+
+# ---------------------------------------------------------------------------
+# scrape-failure streaks: backoff + alert rule
+
+
+def test_scrape_failure_streak_backoff_and_alert(tmp_path):
+    ctl = _controller(tmp_path)
+    for n in range(1, 5):
+        ctl._scrape_failed("w0", "connection refused")
+        assert ctl._scrape_failures["w0"] == n
+    # capped exponential: retry beat never more than cap+jitter out
+    assert ctl._scrape_retry_beat["w0"] <= ctl._beats + 8 + 1
+    obs_metric = float(max(ctl._scrape_failures.values()))
+    engine = AlertEngine(default_rules())
+    base = {"scrape_failures_max": 0.0, "poison_total": 0.0}
+    engine.evaluate(base)
+    fired = []
+    for _ in range(3):
+        fired += engine.evaluate(dict(base,
+                                      scrape_failures_max=obs_metric))
+    assert any(t["alert"] == "scrape_failures"
+               and t["event"] == "firing" for t in fired)
+    # streak clears on success -> alert resolves after clear_beats
+    resolved = []
+    for _ in range(3):
+        resolved += engine.evaluate(base)
+    assert any(t["alert"] == "scrape_failures"
+               and t["event"] == "resolved" for t in resolved)
+
+
+def test_poison_quarantine_alert_fires_on_delta(tmp_path):
+    engine = AlertEngine(default_rules())
+    engine.evaluate({"poison_total": 0.0})
+    fired = engine.evaluate({"poison_total": 1.0})
+    assert any(t["alert"] == "poison_quarantine"
+               and t["event"] == "firing" for t in fired)
+
+
+# ---------------------------------------------------------------------------
+# ServeClient: transient socket drops degrade, never crash
+
+
+def test_client_status_survives_socket_drop(tmp_path):
+    svc = tmp_path / "svc"
+    sp = Spool(str(svc / "spool"))
+    rid = sp.submit({"id": "r1", "tenant": "t",
+                     "configs": [{"mean": 1.0, "std": 1.0}]})
+    client = ServeClient(str(svc))
+    # fake a live front door so _call takes the socket path
+    open(client.socket_path, "w").close()
+    client._drop_socket_ops = 2
+    req = client.status(rid)              # falls back to the spool
+    assert req is not None and req["state"] == "pending"
+    assert client._sock_failures == 1
+    assert client._sock_retry_at > 0      # backoff armed: the next
+    assert client._drop_socket_ops == 1   # poll skips the socket
+
+
+def test_client_wait_survives_mid_poll_drops(tmp_path):
+    svc = tmp_path / "svc"
+    sp = Spool(str(svc / "spool"))
+    rid = sp.submit({"id": "r1", "tenant": "t",
+                     "configs": [{"mean": 1.0, "std": 1.0}]})
+    sp.claim(rid)
+    sp.finish(rid, {"status": "completed", "results": {}})
+    client = ServeClient(str(svc))
+    open(client.socket_path, "w").close()
+    client._drop_socket_ops = 3           # every poll's op drops
+    req = client.wait(rid, timeout_s=5.0, poll_s=0.01)
+    assert req["status"] == "completed"
+
+
+def test_client_tail_tolerates_torn_trailing_line(tmp_path):
+    svc = tmp_path / "svc"
+    os.makedirs(svc / "requests")
+    client = ServeClient(str(svc))
+    path = client.records_path("r1")
+    full = json.dumps({"type": "request", "event": "admitted"})
+    torn = json.dumps({"type": "request", "event": "completed"})
+    with open(path, "w") as f:
+        f.write(full + "\n" + torn[:11])  # writer caught mid-append
+    got = list(client.tail("r1", follow=False))
+    assert [r["event"] for r in got] == ["admitted"]
+    with open(path, "a") as f:            # the append completes
+        f.write(torn[11:] + "\n")
+    got = list(client.tail("r1", follow=True, timeout_s=2.0))
+    assert [r["event"] for r in got] == ["admitted", "completed"]
